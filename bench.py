@@ -1,0 +1,105 @@
+"""Benchmark: flagship-model training throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: training tokens/sec/chip on a LLaMA-style decoder sized to fit the
+chip, ZeRO/bf16 fused train step (the BASELINE.json "ZeRO-3 tokens/sec/chip"
+family — single-chip proxy until multi-chip hardware is attached).
+vs_baseline compares achieved model FLOPs/s against the reference's
+49 TFLOPs/GPU ZeRO-3 claim (BASELINE.md: 512×V100 ZeRO-3 Offload sustained),
+scaled as MFU ratio: (our MFU) / (49/125 V100-peak MFU).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    on_tpu = jax.default_backend() == "tpu"
+    # Size to chip: ~350M params on a single v5e chip; tiny on CPU smoke runs.
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=24, num_heads=16, num_kv_heads=16, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
+            scan_layers=True)
+        batch, seq, steps = 4, 1024, 20
+    else:
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 3
+
+    model = LlamaModel(cfg)
+    ds_config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+    }
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1))
+    sample = {"input_ids": tokens[:1, :-1], "labels": tokens[:1, 1:]}
+    engine = deepspeed_tpu.initialize(model=model, config=ds_config,
+                                      sample_batch=sample)
+
+    def make_batch():
+        t = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    # warmup / compile. NOTE: through the axon remote-execution tunnel,
+    # jax.block_until_ready can return before execution; only a real host
+    # transfer (float()) forces the chain. Timing = async loop + one final
+    # transfer, minus the measured scalar-transfer latency.
+    batches = [make_batch() for _ in range(4)]
+    float(engine.train_batch(batches[0]))
+    loss = engine.train_batch(batches[1])
+    t_x0 = time.time()
+    float(loss)
+    xfer_latency = time.time() - t_x0
+
+    t0 = time.time()
+    for i in range(steps):
+        loss = engine.train_batch(batches[i % len(batches)])
+    float(loss)  # forces all `steps` chained updates
+    dt = max(time.time() - t0 - xfer_latency, 1e-6)
+
+    n_chips = jax.device_count()
+    tokens_per_sec = steps * batch * seq / dt
+    tok_per_chip = tokens_per_sec / n_chips
+
+    # model FLOPs ≈ 6 * params * tokens (fwd+bwd)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(engine.params))
+    flops_per_sec = 6.0 * n_params * tokens_per_sec / n_chips
+    # reference bar: 49 TFLOPs/GPU on V100 (125 TF peak) → MFU 0.392
+    ref_mfu = 49.0 / 125.0
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; CPU smoke placeholder
+    our_mfu = flops_per_sec / peak
+    vs_baseline = our_mfu / ref_mfu
+
+    print(json.dumps({
+        "metric": "llama350m_zero1_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "detail": {
+            "params": int(n_params), "batch": batch, "seq": seq,
+            "steps": steps, "wall_s": round(dt, 2),
+            "model_tflops_per_chip": round(flops_per_sec / 1e12, 2),
+            "mfu": round(our_mfu, 4), "backend": jax.default_backend(),
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability surface of
+DeepSpeed v0.9.3 (reference layout documented in SURVEY.md): ZeRO-style
+sharded training, tensor/pipeline/expert/sequence parallelism over a device
+mesh, an inference engine with TP sharding and KV caching, checkpointing,
+profiling, and the auxiliary subsystems — all designed for XLA's compilation
+model rather than translated from CUDA.
+
+Public entry points mirror the reference (``deepspeed/__init__.py:58,260``):
+
+    engine = deepspeed_tpu.initialize(model=..., config={...},
+                                      sample_batch=...)
+    loss = engine.train_batch(batch)
+
+    infer = deepspeed_tpu.init_inference(model=..., config={...})
+"""
+
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import logger  # noqa: F401
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+
+def initialize(model=None,
+               config=None,
+               loss_fn=None,
+               params=None,
+               mesh=None,
+               sharding_rules=None,
+               lr_scheduler=None,
+               sample_batch=None,
+               args=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               dist_init_required=None,
+               config_params=None):
+    """Create a training engine (reference ``deepspeed.initialize``).
+
+    Returns the engine. (The reference returns a 4-tuple
+    ``(engine, optimizer, dataloader, scheduler)``; on TPU the optimizer and
+    scheduler live inside the jitted step, so the engine is the single
+    handle. Use ``initialize_legacy`` for tuple-unpacking parity.)
+    """
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config"):
+        config = args.deepspeed_config
+    engine = DeepSpeedEngine(
+        model=model, config=config, loss_fn=loss_fn, params=params, mesh=mesh,
+        sharding_rules=sharding_rules, lr_scheduler=lr_scheduler,
+        sample_batch=sample_batch)
+    return engine
+
+
+def initialize_legacy(*posargs, **kwargs):
+    """4-tuple form for reference API parity."""
+    engine = initialize(*posargs, **kwargs)
+    return engine, engine.optimizer, None, engine.client_lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Create an inference engine (reference ``deepspeed.init_inference``)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def init_distributed(dist_backend="xla-ici", **kwargs):
+    comm.init_distributed(dist_backend=dist_backend, **kwargs)

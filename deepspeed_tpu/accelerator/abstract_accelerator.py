@@ -1,0 +1,134 @@
+"""Accelerator abstraction — the device-portability seam.
+
+TPU-native analogue of reference ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC): device naming, RNG, memory stats, dtype
+support flags, communication backend name, and op-registry dispatch. The
+reference's stream/event surface (CUDA streams, synchronization) maps to
+JAX's async dispatch queue: ``Stream`` is a no-op handle and
+``synchronize`` drains the queue, because XLA owns scheduling on TPU.
+"""
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # --- identity ---------------------------------------------------------
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int:
+        ...
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    # --- RNG --------------------------------------------------------------
+    @abc.abstractmethod
+    def manual_seed(self, seed: int):
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int:
+        ...
+
+    # --- synchronization (CUDA streams/events become queue drains) --------
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+
+        (jax.device_put(0) + 0).block_until_ready()
+
+    def stream(self, stream):  # context manager parity; XLA owns scheduling
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def current_stream(self, device_index=None):
+        return None
+
+    def default_stream(self, device_index=None):
+        return None
+
+    class Event:
+        def __init__(self, enable_timing: bool = False):
+            self.time = None
+
+        def record(self):
+            import time
+
+            self.time = time.time()
+
+        def synchronize(self):
+            pass
+
+        def elapsed_time(self, other) -> float:
+            return (other.time - self.time) * 1000.0
+
+    # --- memory -----------------------------------------------------------
+    @abc.abstractmethod
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None):
+        pass
+
+    def empty_cache(self) -> None:
+        pass
+
+    # --- dtype support ----------------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> List[Any]:
+        ...
+
+    # --- profiling ranges -------------------------------------------------
+    def range_push(self, msg: str):
+        pass
+
+    def range_pop(self):
+        pass
+
+    # --- op builder dispatch ---------------------------------------------
+    @abc.abstractmethod
+    def create_op_builder(self, class_name: str):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name: str):
+        ...

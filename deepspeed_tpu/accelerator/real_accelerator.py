@@ -1,0 +1,33 @@
+"""Accelerator selection (reference ``accelerator/real_accelerator.py:37,55``:
+``get_accelerator``/``set_accelerator``). Selection is trivial on this stack —
+the JAX platform decides — but the override hook is kept for tests and for
+future accelerator implementations."""
+
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+        _ACCELERATOR = TPU_Accelerator()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    try:
+        import jax
+
+        return jax.device_count() > 0
+    except Exception:
+        return False

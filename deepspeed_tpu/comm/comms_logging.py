@@ -1,0 +1,82 @@
+"""Collective-call logging (reference ``deepspeed/utils/comms_logging.py``).
+
+Inside ``jit`` a collective has no host-visible wall time, so the logger
+records two kinds of events: trace-time records (op name, payload bytes, axis)
+whenever a verb is traced, and eager wall-time records when verbs run outside
+jit. ``log_summary()`` aggregates like the reference (comm.py:409).
+"""
+
+import math
+from typing import Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def convert_size(size_bytes: float) -> str:
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+def get_msg_size_from_shape(shape, dtype) -> int:
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, debug: bool = False, prof_ops: List[str] = None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        # op name -> msg size -> [count, total_latency_ms, total_bytes]
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = {}
+
+    def configure(self, comms_config) -> None:
+        self.enabled = comms_config.enabled
+        self.verbose = comms_config.verbose
+        self.prof_all = comms_config.prof_all
+        self.debug = comms_config.debug
+        self.prof_ops = list(comms_config.prof_ops)
+
+    def should_profile(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name: str, latency_ms: float, msg_size: int) -> None:
+        if op_name not in self.comms_dict:
+            self.comms_dict[op_name] = {}
+        sizes = self.comms_dict[op_name]
+        if msg_size not in sizes:
+            sizes[msg_size] = [0, 0.0, 0.0]
+        rec = sizes[msg_size]
+        rec[0] += 1
+        rec[1] += latency_ms
+        rec[2] += msg_size
+        if self.verbose:
+            logger.info(
+                f"comm op: {op_name} | time (ms): {latency_ms:.2f} | "
+                f"msg size: {convert_size(msg_size)}"
+            )
+
+    def log_summary(self) -> str:
+        lines = [f"{'Op':<24}{'Message Size':<16}{'Count':<8}{'Total Latency(ms)':<20}{'Avg Latency(ms)':<18}"]
+        for op, sizes in sorted(self.comms_dict.items()):
+            for msg_size, (count, total_ms, _) in sorted(sizes.items()):
+                avg = total_ms / count if count else 0.0
+                lines.append(
+                    f"{op:<24}{convert_size(msg_size):<16}{count:<8}{total_ms:<20.2f}{avg:<18.3f}"
+                )
+        summary = "\n".join(lines)
+        logger.info("\n" + summary)
+        return summary

@@ -1,0 +1,58 @@
+"""Inference config (reference ``deepspeed/inference/config.py:126``)."""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    ep_size: int = 1
+    moe_experts: list = [1]
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Mirrors the reference's surface; CUDA-graph and kernel-injection knobs
+    are accepted for compatibility (XLA compiles whole programs, injection is
+    the default path here)."""
+
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    max_out_tokens: int = Field(1024, ge=1)
+    min_out_tokens: int = Field(1, ge=1)
+    max_tokens: Optional[int] = None
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False  # accepted, ignored (XLA compiles steps)
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    training_mp_size: int = 1
+    injection_policy: Optional[Dict] = None
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = None  # legacy alias bucket
+    mp_size: int = Field(1, json_schema_extra={
+        "deprecated": True, "new_param": "tensor_parallel.tp_size"})
+
+    def __init__(self, **data):
+        mp = data.pop("mp_size", None)
+        super().__init__(**data)
+        if mp and self.tensor_parallel.tp_size == 1:
+            self.tensor_parallel.tp_size = mp

@@ -1,0 +1,4 @@
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel, loss_fn
+
+__all__ = ["GPT2Config", "GPT2Model", "LlamaConfig", "LlamaModel", "loss_fn"]

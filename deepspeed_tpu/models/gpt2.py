@@ -1,0 +1,90 @@
+"""GPT-2-family decoder (learned positions, GELU MLP, LayerNorm).
+
+Parity target: reference injection containers ``gpt2``/``gptneo``/``opt``
+(deepspeed/module_inject/containers/). Also the BASELINE config #1 model
+("GPT-2 125M ZeRO-1 single-host").
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import MLP, SelfAttention, make_causal_mask
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    mlp_ratio: int = 4
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    tie_embeddings: bool = True
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                    max_seq_len=128)
+        base.update(kw)
+        return GPT2Config(**base)
+
+    @staticmethod
+    def gpt2_125m(**kw) -> "GPT2Config":
+        return GPT2Config(**kw)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln_1")(x)
+        h = SelfAttention(num_heads=cfg.num_heads, use_rope=False,
+                          dtype=cfg.dtype, use_bias=True, name="attn")(h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln_2")(x)
+        h = MLP(intermediate_size=cfg.mlp_ratio * cfg.hidden_size,
+                dtype=cfg.dtype, name="mlp")(h)
+        return x + h
+
+
+class GPT2Model(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wte")
+        wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wpe")
+        if positions is None:
+            positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+        x = wte(input_ids) + wpe(positions)
+        mask = make_causal_mask(S)
+
+        block_cls = GPT2Block
+        if cfg.remat:
+            block_cls = nn.remat(GPT2Block)
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"h_{i}")(x, mask)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+        return logits.astype(jnp.float32)

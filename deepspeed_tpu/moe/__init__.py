@@ -1,0 +1,2 @@
+from deepspeed_tpu.moe.layer import MoE, BatchedExperts
+from deepspeed_tpu.moe.sharded_moe import top1_gating, top2_gating, moe_dispatch_combine

@@ -1,0 +1,92 @@
+"""MoE flax layer (reference ``deepspeed/moe/layer.py:16`` ``MoE`` and
+``experts.py:10`` ``Experts``).
+
+The reference instantiates ``num_experts/ep_size`` local expert modules per
+rank; here experts are one batched parameter stack with a leading expert dim
+sharded over the ``data`` axis (expert-parallel groups are sub-groups of DP,
+reference utils/groups.py:108) — a single einsum runs every local expert on
+the MXU at once.
+
+Residual (PR-MoE) composition per reference layer.py:99: output =
+moe_out + mlp(x), with a learned coefficient over the two branches.
+"""
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import moe_dispatch_combine
+
+
+class BatchedExperts(nn.Module):
+    """[E, C, D] -> [E, C, D]: per-expert SwiGLU MLP as batched einsums."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # x: [E, C, D]
+        E, D, F = self.num_experts, self.hidden_size, self.intermediate_size
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("gate_proj", init, (E, D, F), jnp.float32)
+        w_up = self.param("up_proj", init, (E, D, F), jnp.float32)
+        w_down = self.param("down_proj", init, (E, F, D), jnp.float32)
+        xd = x.astype(self.dtype)
+        g = jnp.einsum("ecd,edf->ecf", xd, w_gate.astype(self.dtype))
+        u = jnp.einsum("ecd,edf->ecf", xd, w_up.astype(self.dtype))
+        h = nn.silu(g) * u
+        return jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+
+
+class MoE(nn.Module):
+    """Drop-in MoE block: [B, S, D] -> ([B, S, D], aux_loss)."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+    expert_shard_axis: Optional[str] = "data"
+    use_residual: bool = False  # PR-MoE (reference layer.py:99)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, S, D = x.shape
+        tokens = x.reshape(B * S, D)
+        gate_logits = nn.Dense(self.num_experts, use_bias=False,
+                               dtype=jnp.float32, param_dtype=jnp.float32,
+                               name="gate")(tokens.astype(jnp.float32))
+        experts = BatchedExperts(self.num_experts, D, self.intermediate_size,
+                                 self.dtype, name="experts")
+        noise_rng = None
+        if train and self.noisy_gate_policy == "RSample" and \
+                self.has_rng("gating"):
+            noise_rng = self.make_rng("gating")
+        out, aux = moe_dispatch_combine(
+            tokens, gate_logits, experts, k=self.k,
+            capacity_factor=self.capacity_factor if train else self.eval_capacity_factor,
+            min_capacity=self.min_capacity, noise_rng=noise_rng,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens,
+            expert_shard_axis=self.expert_shard_axis)
+        out = out.reshape(B, S, D)
+
+        if self.use_residual:
+            from deepspeed_tpu.models.transformer import GatedMLP
+
+            residual = GatedMLP(self.intermediate_size, dtype=self.dtype,
+                                name="residual_mlp")(x)
+            coef = nn.Dense(2, dtype=jnp.float32, name="coefficient")(
+                x.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1] + residual * coef[..., 1:2]
+        return out.astype(x.dtype), aux

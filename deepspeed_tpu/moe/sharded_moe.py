@@ -1,0 +1,158 @@
+"""Top-k gated expert dispatch — GShard-style, SPMD-native.
+
+TPU-native analogue of reference ``deepspeed/moe/sharded_moe.py``
+(``TopKGate`` :343, ``MOELayer`` :420, ``_AllToAll`` :90): top-1/top-2 gating
+with capacity, jitter noise, and load-balancing aux loss. Where the reference
+issues an explicit ``all_to_all_single`` to move token slots to expert-owner
+ranks, here the dispatched tensor carries a sharding constraint over the
+``data`` axis on its expert dim — XLA lowers the resharding to the same
+all_to_all over ICI, fused with the surrounding einsums.
+
+All shapes are static: capacity is computed from config at trace time, and
+token→slot assignment uses cumsum + one-hot (no sorting, no dynamic shapes),
+which keeps everything on the VPU/MXU.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_in_context_mesh(axis: Optional[str]) -> bool:
+    """True when a context mesh (jax.set_mesh) is active and carries ``axis``
+    with size > 1 — otherwise the sharding constraint is meaningless."""
+    if axis is None:
+        return False
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        return axis in mesh.axis_names and mesh.shape[axis] > 1
+    except Exception:
+        return False
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """reference sharded_moe.py:179 _capacity."""
+    cap = int(num_tokens * capacity_factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def top1_gating(logits: jnp.ndarray, capacity_factor: float, min_capacity: int,
+                noise_rng: Optional[jax.Array] = None,
+                noisy_gate_policy: Optional[str] = None,
+                drop_tokens: bool = True):
+    """Switch-style top-1 gating (reference top1gating sharded_moe.py:179).
+
+    logits: [T, E]. Returns (aux_loss, combine [T,E,C], dispatch bool [T,E,C]).
+    """
+    T, E = logits.shape
+    # drop_tokens=False must not drop: worst case every token picks one
+    # expert, so capacity = T keeps shapes static with no overflow
+    # (reference instead pads capacity to the observed max count).
+    C = T if not drop_tokens else _capacity(T, E, capacity_factor, min_capacity)
+
+    if noisy_gate_policy == "RSample" and noise_rng is not None:
+        logits_for_routing = logits + jax.random.normal(noise_rng, logits.shape)
+    else:
+        logits_for_routing = logits
+    gates = jax.nn.softmax(logits, axis=-1)                    # [T, E]
+    expert_idx = jnp.argmax(logits_for_routing, axis=-1)       # [T]
+    mask1 = _one_hot(expert_idx, E)                            # [T, E]
+
+    # position of each token within its expert's capacity
+    pos = jnp.cumsum(mask1, axis=0) - mask1                    # [T, E]
+    pos_in_expert = jnp.sum(pos * mask1, axis=-1)              # [T]
+    if drop_tokens:
+        keep = pos_in_expert < C
+        mask1 = mask1 * keep[:, None]
+
+    # load-balancing loss (reference l_aux: E * mean(me) . mean(ce))
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)                    # [T]
+    combine = (gate1[:, None] * mask1)[:, :, None] * \
+        _one_hot(pos_in_expert, C)[:, None, :]                 # [T, E, C]
+    dispatch = combine > 0
+    return aux_loss, combine, dispatch
+
+
+def top2_gating(logits: jnp.ndarray, capacity_factor: float, min_capacity: int,
+                noise_rng: Optional[jax.Array] = None,
+                drop_tokens: bool = True):
+    """GShard top-2 gating (reference top2gating sharded_moe.py:277)."""
+    T, E = logits.shape
+    C = 2 * T if not drop_tokens else _capacity(T, E, 2 * capacity_factor, min_capacity)
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    masked_gates = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(masked_gates, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    pos_in1 = jnp.sum(pos1 * mask1, axis=-1)
+    # second choices queue behind all first choices for the same expert
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+    pos_in2 = jnp.sum(pos2 * mask2, axis=-1)
+
+    if drop_tokens:
+        mask1 = mask1 * (pos_in1 < C)[:, None]
+        mask2 = mask2 * (pos_in2 < C)[:, None]
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    g1 = jnp.sum(gates * mask1, axis=-1)
+    g2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    combine = (g1[:, None] * mask1)[:, :, None] * _one_hot(pos_in1, C)[:, None, :] \
+        + (g2[:, None] * mask2)[:, :, None] * _one_hot(pos_in2, C)[:, None, :]
+    dispatch = combine > 0
+    return aux_loss, combine, dispatch
+
+
+def moe_dispatch_combine(x: jnp.ndarray, gate_logits: jnp.ndarray,
+                         expert_fn, k: int = 1,
+                         capacity_factor: float = 1.0, min_capacity: int = 4,
+                         noise_rng: Optional[jax.Array] = None,
+                         noisy_gate_policy: Optional[str] = None,
+                         drop_tokens: bool = True,
+                         expert_shard_axis: Optional[str] = "data"):
+    """Dispatch tokens → run experts → combine. x: [T, D], logits: [T, E].
+
+    ``expert_fn`` maps [E, C, D] → [E, C, D_out] (batched over experts).
+    The [E, C, D] tensors are sharding-constrained over ``expert_shard_axis``
+    on the E dim — the SPMD equivalent of the reference's all_to_all.
+    """
+    if k == 1:
+        aux, combine, dispatch = top1_gating(
+            gate_logits, capacity_factor, min_capacity, noise_rng,
+            noisy_gate_policy, drop_tokens)
+    elif k == 2:
+        aux, combine, dispatch = top2_gating(
+            gate_logits, capacity_factor, min_capacity, noise_rng, drop_tokens)
+    else:
+        raise ValueError(f"top-{k} gating not supported (reference supports 1/2)")
+
+    shard_axis = expert_shard_axis if _axis_in_context_mesh(expert_shard_axis) else None
+    expert_inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    if shard_axis is not None:
+        spec = jax.sharding.PartitionSpec(shard_axis)
+        expert_inputs = jax.lax.with_sharding_constraint(expert_inputs, spec)
+    expert_outputs = expert_fn(expert_inputs)                  # [E, C, D']
+    if shard_axis is not None:
+        expert_outputs = jax.lax.with_sharding_constraint(expert_outputs, spec)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
+    return out, aux

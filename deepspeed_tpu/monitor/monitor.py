@@ -1,0 +1,108 @@
+"""Metric event sinks (reference ``deepspeed/monitor/monitor.py:29``).
+
+``MonitorMaster`` fans out (name, value, step) events to TensorBoard, WandB,
+and CSV sinks, each config-gated. Event names keep the reference's contract
+(``Train/Samples/train_loss`` etc., SURVEY §8.6) so dashboards port
+unchanged. Only the JAX process 0 writes (reference checks rank 0).
+"""
+
+import os
+from typing import List, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = config.enabled
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not self.enabled or jax.process_index() != 0:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:  # tensorboard optional
+            logger.warning(f"TensorBoard unavailable ({e}); disabling tb monitor")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if not self.enabled or jax.process_index() != 0:
+            return
+        try:
+            import wandb
+
+            wandb.init(project=config.project, group=config.group, entity=config.team)
+            self._wandb = wandb
+        except Exception as e:
+            logger.warning(f"wandb unavailable ({e}); disabling wandb monitor")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._wandb is None:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: float(value)}, step=int(step))
+
+
+class csvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.filehandles = {}
+        self.output_path = None
+        if not self.enabled or jax.process_index() != 0:
+            return
+        self.output_path = os.path.join(config.output_path or "./csv_logs",
+                                        config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.output_path is None:
+            return
+        for name, value, step in event_list:
+            fname = name.replace("/", "_") + ".csv"
+            path = os.path.join(self.output_path, fname)
+            if name not in self.filehandles:
+                self.filehandles[name] = open(path, "a")
+            self.filehandles[name].write(f"{int(step)},{float(value)}\n")
+            self.filehandles[name].flush()
+
+
+class MonitorMaster(Monitor):
+    """Fan-out master (reference monitor/monitor.py:29)."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if jax.process_index() != 0:
+            return
+        for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if sink.enabled:
+                sink.write_events(event_list)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.optimizers import build_optimizer, register_optimizer

@@ -1,0 +1,188 @@
+"""Flash attention — Pallas TPU kernel.
+
+TPU-native replacement for the reference's attention kernels
+(``csrc/transformer/softmax_kernels.cu`` training path and the fused
+inference attention ``softmax_context`` in
+``csrc/transformer/inference/csrc/``): an online-softmax blocked attention
+that never materializes the [S, S] score matrix in HBM.
+
+Design:
+- grid (B, H, num_q_blocks, num_kv_blocks); the kv axis is innermost, so the
+  running max/sum/accumulator live in VMEM scratch across kv steps.
+- fp32 running statistics regardless of input dtype (matches the reference
+  kernels' fp32 softmax accumulation).
+- causal blocks above the diagonal are skipped entirely via ``pl.when``.
+- backward: recompute-based VJP through the XLA reference implementation —
+  numerically identical, fused by XLA; a Pallas bwd kernel is a later
+  optimization.
+
+Falls back to ``interpret=True`` off-TPU so tests run on the CPU mesh.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+    """[B,S,H,D] XLA attention — ground truth for tests and the VJP."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        S, Sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((S, Sk), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                      sm_scale: float, causal: bool, block_q: int, block_k: int,
+                      kv_len: int, num_kv_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: with block_q == block_k, kv block ki contributes iff ki <= qi
+    should_run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)            # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)            # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)            # (bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+
+        # mask: padded keys + causal upper triangle
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[...]                            # (bq, 128) broadcast copies
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)     # (bq, 1)
+        m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_next)                # (bq, 128)
+        p = jnp.exp(s - m_next[:, :1])                 # (bq, bk)
+        l_next = corr * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * corr[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = m_next
+        l_scr[...] = l_next
+
+    if causal:
+        # last kv block intersecting the causal triangle for this q block
+        # (handles unequal block_q/block_k)
+        last_k = jnp.minimum(num_kv_blocks - 1,
+                             (qi * block_q + block_q - 1) // block_k)
+    else:
+        last_k = num_kv_blocks - 1
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
+        o_ref[0, 0, ...] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, interpret: bool):
+    """q,k,v: [B,H,S,D] → o: [B,H,S,D]."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    q_pad = (-S) % block_q
+    k_pad = (-Sk) % block_k
+    if q_pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+    if k_pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+    Sq_p, Sk_p = S + q_pad, Sk + k_pad
+    nq, nk = Sq_p // block_q, Sk_p // block_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=Sk, num_kv_blocks=nk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    if q_pad:
+        out = out[:, :, :S, :]
+    return out
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k):
+    # [B,S,H,D] public layout → [B,H,S,D] kernel layout
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_fwd(qt, kt, vt, causal, sm_scale, block_q, block_k,
+                     interpret=_use_interpret())
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k), (q, k, v)
+
+
+def _bwd_rule(causal, sm_scale, block_q, block_k, residuals, do):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(do)
+
+
+_flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Blocked attention over [B, S, H, D] tensors.
+
+    ``sm_scale`` defaults to 1/sqrt(D). Differentiable (recompute VJP).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_attention(q, k, v, causal, float(sm_scale),
+                            int(block_q), int(block_k))

@@ -1,0 +1,84 @@
+"""Grouped symmetric/asymmetric INT8/INT4 quantization.
+
+TPU-native replacement for the reference quantizer kernels
+(``csrc/quantization/{quantize.cu,dequantize.cu,fake_quantizer.cu}``):
+per-group scale/offset (de)quantization and straight-through fake-quant for
+QAT/MoQ. Pure traced ops — XLA vectorizes these on the VPU and can feed
+int8 matmuls on the MXU; a Pallas variant is only worth it fused into a
+larger kernel.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _group_reshape(x: jnp.ndarray, num_groups: int) -> Tuple[jnp.ndarray, Tuple]:
+    orig_shape = x.shape
+    flat = x.reshape(num_groups, -1)
+    return flat, orig_shape
+
+
+def quantize_symmetric(x: jnp.ndarray, num_bits: int = 8,
+                       num_groups: int = 1):
+    """Per-group symmetric quantization. Returns (q, scale).
+
+    q is int8 (int4 values live in int8 storage, matching the reference's
+    packed int4 convention at the API level).
+    """
+    flat, orig = _group_reshape(x, num_groups)
+    qmax = float(2 ** (num_bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(orig), scale.astype(jnp.float32)
+
+
+def dequantize_symmetric(q: jnp.ndarray, scale: jnp.ndarray,
+                         num_groups: int = 1) -> jnp.ndarray:
+    flat, orig = _group_reshape(q.astype(jnp.float32), num_groups)
+    return (flat * scale).reshape(orig)
+
+
+def quantize_asymmetric(x: jnp.ndarray, num_bits: int = 8,
+                        num_groups: int = 1):
+    """Per-group asymmetric (min/max affine) quantization.
+    Returns (q, scale, zero_point)."""
+    flat, orig = _group_reshape(x, num_groups)
+    qmax = float(2 ** num_bits - 1)
+    mn = jnp.min(flat, axis=1, keepdims=True)
+    mx = jnp.max(flat, axis=1, keepdims=True)
+    scale = jnp.where(mx > mn, (mx - mn) / qmax, 1.0)
+    zp = jnp.round(-mn / scale)
+    q = jnp.clip(jnp.round(flat / scale) + zp, 0, qmax).astype(jnp.uint8)
+    return q.reshape(orig), scale.astype(jnp.float32), zp.astype(jnp.float32)
+
+
+def dequantize_asymmetric(q: jnp.ndarray, scale: jnp.ndarray, zero_point: jnp.ndarray,
+                          num_groups: int = 1) -> jnp.ndarray:
+    flat, orig = _group_reshape(q.astype(jnp.float32), num_groups)
+    return ((flat - zero_point) * scale).reshape(orig)
+
+
+@jax.custom_vjp
+def fake_quantize(x, num_bits, num_groups):
+    q, scale = quantize_symmetric(x, num_bits, num_groups)
+    return dequantize_symmetric(q, scale, num_groups)
+
+
+def _fq_fwd(x, num_bits, num_groups):
+    return fake_quantize(x, num_bits, num_groups), None
+
+
+def _fq_bwd(_, g):
+    return g, None, None  # straight-through estimator
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_int8_matmul_weights(w: jnp.ndarray, num_groups: int = 1):
+    """Weight-only int8 path for inference TP layers: store (q, scale),
+    dequantize into bf16 at use (XLA fuses the dequant into the matmul)."""
+    return quantize_symmetric(w, num_bits=8, num_groups=num_groups)

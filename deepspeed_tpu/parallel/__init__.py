@@ -1,0 +1,26 @@
+from deepspeed_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MESH_AXES,
+    PIPE_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+    data_parallel_size,
+    make_mesh,
+    mesh_axis_size,
+    resolve_mesh_dims,
+    single_device_mesh,
+)
+from deepspeed_tpu.parallel.partition import (  # noqa: F401
+    DEFAULT_TP_RULES,
+    batch_spec,
+    infer_param_spec,
+    replicated,
+    tree_param_specs,
+    tree_shardings,
+)
+from deepspeed_tpu.parallel.topology import (  # noqa: F401
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)

@@ -1,0 +1,112 @@
+"""Device-mesh construction.
+
+The reference builds NCCL process groups lazily per parallel mode
+(``deepspeed/utils/groups.py``, ``runtime/pipe/topology.py``); on TPU all of
+those become axes of one ``jax.sharding.Mesh``. Axis layout convention:
+
+    ("pipe", "data", "expert", "sequence", "tensor")
+
+outermost → innermost device order, so that tensor/sequence collectives (the
+chattiest) ride the innermost ICI links, and pipe (point-to-point only)
+crosses DCN when multi-slice. The "fsdp"/ZeRO axis is the same devices as
+"data": ZeRO shards over the data-parallel group exactly as the reference
+does (stage_1_and_2.py partitions over the DP group).
+
+The expert axis is folded out of the data axis at MoE layers via axis
+reshaping inside shard_map, matching the reference's expert-parallel groups
+being subsets of the DP group (utils/groups.py:108).
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from deepspeed_tpu.utils.logging import logger
+
+# canonical axis names, outermost first
+MESH_AXES = ("pipe", "data", "expert", "sequence", "tensor")
+
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+SEQUENCE_AXIS = "sequence"
+TENSOR_AXIS = "tensor"
+
+
+def resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
+    """Resolve -1 ('all remaining devices') and validate the product.
+
+    ``expert`` is NOT a device-consuming axis: expert groups are sub-groups
+    of the data axis (reference utils/groups.py:108), so it is excluded from
+    the device product and only validated for divisibility.
+    """
+    dims = {
+        "pipe": mesh_config.pipe,
+        "data": mesh_config.data,
+        "expert": mesh_config.expert,
+        "sequence": mesh_config.sequence,
+        "tensor": mesh_config.tensor,
+    }
+    device_axes = ("pipe", "data", "sequence", "tensor")
+    wildcard = [k for k in device_axes if dims[k] == -1]
+    fixed = int(np.prod([dims[k] for k in device_axes if dims[k] != -1]))
+    if len(wildcard) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {wildcard}")
+    if wildcard:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"Device count {n_devices} not divisible by fixed mesh axes product {fixed}"
+            )
+        dims[wildcard[0]] = n_devices // fixed
+    total = int(np.prod([dims[k] for k in device_axes]))
+    if total != n_devices:
+        raise ValueError(
+            f"Mesh {dims} requires {total} devices but {n_devices} are available"
+        )
+    for k, v in dims.items():
+        if v < 1:
+            raise ValueError(f"Mesh axis {k} must be >= 1, got {v}")
+    # expert axis must divide the ZeRO/data axis: expert groups are carved out
+    # of the DP group (reference utils/groups.py:108)
+    if dims["expert"] > 1 and dims["data"] % dims["expert"] != 0:
+        raise ValueError(
+            f"expert axis ({dims['expert']}) must divide data axis ({dims['data']})"
+        )
+    return dims
+
+
+def make_mesh(mesh_config=None, devices: Optional[Sequence] = None,
+              dims: Optional[Dict[str, int]] = None) -> Mesh:
+    """Build the global Mesh. ``expert`` is NOT a standalone mesh axis —
+    expert groups are sub-groups of ``data`` (see moe/). The mesh axes are
+    (pipe, data, sequence, tensor)."""
+    if devices is None:
+        devices = jax.devices()
+    if dims is None:
+        assert mesh_config is not None
+        dims = resolve_mesh_dims(mesh_config, len(devices))
+    axis_names = ("pipe", "data", "sequence", "tensor")
+    shape = (dims["pipe"], dims["data"], dims["sequence"], dims["tensor"])
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != device count {len(devices)}")
+    dev_array = np.asarray(devices).reshape(shape)
+    logger.info(f"Created device mesh pipe={shape[0]} data={shape[1]} "
+                f"sequence={shape[2]} tensor={shape[3]}")
+    return Mesh(dev_array, axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    """Trivial mesh over one device (single-chip debugging)."""
+    dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1)
+    return Mesh(dev, ("pipe", "data", "sequence", "tensor"))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.axis_names else 1
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return mesh_axis_size(mesh, DATA_AXIS)

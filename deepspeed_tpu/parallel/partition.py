@@ -1,0 +1,156 @@
+"""Sharding-rules engine: parameter path patterns -> PartitionSpec.
+
+This replaces three reference mechanisms at once:
+- Megatron-style ``mpu`` tensor-parallel layouts consumed by training
+  (deepspeed/utils/groups.py:59),
+- the auto-TP parser that walks a model finding linears to shard
+  (deepspeed/module_inject/auto_tp.py:84),
+- ZeRO parameter partitioning (runtime/zero/partition_parameters.py:603).
+
+Here all three are one thing: every parameter gets a ``PartitionSpec`` built
+from (a) regex rules mapping parameter paths to tensor-parallel dims, and
+(b) the ZeRO stage, which additionally shards a remaining dim along the
+``data`` axis (stage 3 shards parameters; stages 1-2 shard only optimizer
+state and gradients). XLA's SPMD partitioner then inserts the all-gathers /
+reduce-scatters the reference issues by hand.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, mesh_axis_size
+
+# A rule: (path_regex, spec) where spec is a tuple of axis names / None per dim.
+Rule = Tuple[str, Tuple[Optional[str], ...]]
+
+# Default tensor-parallel rules for the transformer models in
+# deepspeed_tpu/models: column-parallel QKV/up-proj, row-parallel out/down-proj,
+# vocab-sharded embedding. Matches what kernel-injection TP does per weight
+# class in the reference (module_inject/containers/base.py:215-242).
+DEFAULT_TP_RULES: List[Rule] = [
+    # expert-parallel: leading expert dim of batched expert stacks shards over
+    # the data axis (EP groups ⊂ DP group, reference utils/groups.py:108)
+    (r".*experts/(gate_proj|up_proj|down_proj|kernel).*", (DATA_AXIS, None, None)),
+    (r".*(wte|embed_tokens|word_embeddings|embedding)\b.*", (TENSOR_AXIS, None)),
+    (r".*(q_proj|k_proj|v_proj|qkv|query_key_value|c_attn).*kernel", (None, TENSOR_AXIS)),
+    (r".*(o_proj|out_proj|dense(?!_h)|c_proj(?=.*attn)|attn_out).*kernel", (TENSOR_AXIS, None)),
+    (r".*(gate_proj|up_proj|fc_in|c_fc|dense_h_to_4h|w1|w3).*kernel", (None, TENSOR_AXIS)),
+    (r".*(down_proj|fc_out|dense_4h_to_h|w2|mlp.*c_proj).*kernel", (TENSOR_AXIS, None)),
+    (r".*(lm_head|output_proj|final_proj).*kernel", (None, TENSOR_AXIS)),
+]
+
+
+def path_str(path: Tuple) -> str:
+    """Flatten a jax tree path into 'a/b/c' form."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _match_tp_rule(path: str, shape: Sequence[int], rules: List[Rule],
+                   mesh: Mesh) -> List[Optional[str]]:
+    spec: List[Optional[str]] = [None] * len(shape)
+    for pattern, rule_spec in rules:
+        if re.fullmatch(pattern, path) or re.match(pattern + r"$", path):
+            # align rule to trailing dims (rules written for 2D kernels apply
+            # to the last dims of higher-rank params)
+            offset = len(shape) - len(rule_spec)
+            if offset < 0:
+                continue
+            ok = True
+            applied = [None] * len(rule_spec)
+            for i, axis in enumerate(rule_spec):
+                if axis is None:
+                    continue
+                n = mesh_axis_size(mesh, axis)
+                if n <= 1:
+                    continue  # axis collapsed on this mesh; leave dim unsharded
+                if shape[offset + i] % n != 0:
+                    ok = False
+                    break
+                applied[i] = axis
+            if not ok:
+                continue
+            for i, axis in enumerate(applied):
+                if axis is not None:
+                    spec[offset + i] = axis
+            break
+    return spec
+
+
+def _maybe_shard_data_axis(spec: List[Optional[str]], shape: Sequence[int],
+                           mesh: Mesh, min_size: int = 2) -> List[Optional[str]]:
+    """ZeRO-3: shard the largest free dim along the data axis when divisible.
+
+    Equivalent of partition_parameters.py's flat-partition over the DP group —
+    except the partition stays tied to the logical dim so resharding on load
+    is metadata-only.
+    """
+    dp = mesh_axis_size(mesh, DATA_AXIS)
+    if dp <= 1 or DATA_AXIS in spec:  # expert stacks already shard over data
+        return spec
+    # pick the largest dim not already sharded whose size divides by dp
+    candidates = [
+        (shape[i], i) for i in range(len(shape))
+        if spec[i] is None and shape[i] % dp == 0 and shape[i] >= min_size
+    ]
+    if not candidates:
+        return spec
+    _, dim = max(candidates)
+    spec = list(spec)
+    spec[dim] = DATA_AXIS
+    return spec
+
+
+def infer_param_spec(path: str, shape: Sequence[int], mesh: Mesh,
+                     rules: Optional[List[Rule]] = None,
+                     shard_data_axis: bool = False) -> PartitionSpec:
+    """PartitionSpec for one parameter.
+
+    ``shard_data_axis=True`` adds ZeRO-3-style sharding over the data axis.
+    """
+    rules = DEFAULT_TP_RULES if rules is None else rules
+    spec = _match_tp_rule(path, shape, rules, mesh)
+    if shard_data_axis:
+        spec = _maybe_shard_data_axis(spec, shape, mesh)
+    return PartitionSpec(*spec)
+
+
+def tree_param_specs(params: Any, mesh: Mesh, rules: Optional[List[Rule]] = None,
+                     shard_data_axis: bool = False) -> Any:
+    """Pytree of PartitionSpecs matching ``params``."""
+    def spec_for(path, leaf):
+        if not hasattr(leaf, "shape") or leaf.ndim == 0:
+            return PartitionSpec()
+        return infer_param_spec(path_str(path), leaf.shape, mesh, rules, shard_data_axis)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def tree_shardings(params: Any, mesh: Mesh, rules: Optional[List[Rule]] = None,
+                   shard_data_axis: bool = False) -> Any:
+    specs = tree_param_specs(params, mesh, rules, shard_data_axis)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def batch_spec(mesh: Mesh, sequence_sharded: bool = False) -> PartitionSpec:
+    """Inputs: batch dim over data axis; optionally seq dim over sequence axis."""
+    if sequence_sharded and mesh_axis_size(mesh, "sequence") > 1:
+        return PartitionSpec(DATA_AXIS, "sequence")
+    return PartitionSpec(DATA_AXIS)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,100 @@
+"""Named-axis cartesian rank grid.
+
+TPU-native analogue of reference ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology`` :12, ``PipeDataParallelTopology`` :232,
+``PipeModelDataParallelTopology`` :244). On TPU the grid *is* the
+``jax.sharding.Mesh``; this class provides the same rank-mapping queries the
+reference exposes (rank <-> coordinate, filtered rank lists per axis) for the
+launcher, checkpoint naming, and tests, without owning any process groups —
+groups are mesh axes.
+"""
+
+from collections import namedtuple
+from itertools import product
+from typing import Dict, List
+
+
+class ProcessTopology:
+    """Maps n-dimensional axis coordinates to linear ranks, axes-major order.
+
+    The first axis in ``axes`` has the largest stride (outermost), matching
+    the reference's convention (pipe/topology.py:24-36).
+    """
+
+    def __init__(self, axes: List[str], dims: List[int]):
+        assert len(axes) == len(dims), "axes and dims must align"
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        for global_rank, coord in enumerate(product(*[range(d) for d in self.dims])):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, got {coord_kwargs}")
+        return self.mapping[self.ProcessCoord(**coord_kwargs)]
+
+    def get_axis_names(self) -> List[str]:
+        return list(self.axes)
+
+    def get_rank_repr(self, rank: int, omit_axes: List[str] = None, inner_sep: str = "_",
+                      outer_sep: str = "-") -> str:
+        """e.g. 'pipe_0-data_1' — used in checkpoint file naming."""
+        omit_axes = omit_axes if omit_axes is not None else ["data"]
+        coord = self.get_coord(rank)
+        return outer_sep.join(
+            f"{ax}{inner_sep}{getattr(coord, ax)}"
+            for ax in self.axes if ax not in omit_axes
+        )
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Lists of ranks that would form a communicator along ``axis``."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other_coord in product(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other_coord))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """All ranks whose coordinates match the given axis=value filters."""
+        def matches(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return sorted(rank for coord, rank in self.mapping.items() if matches(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """pipe × data grid (reference pipe/topology.py:232)."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """pipe × data × model grid for 3D parallelism (reference :244)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])

@@ -1,0 +1,20 @@
+"""Pluggable checkpoint backend ABC
+(reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``)."""
+
+import abc
+from typing import Any, Dict, Optional, Tuple
+
+
+class CheckpointEngine(abc.ABC):
+    @abc.abstractmethod
+    def save(self, save_dir: str, tag: str, state: Dict[str, Any],
+             meta: Dict[str, Any], save_latest: bool = True) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load(self, load_dir: str, tag: Optional[str],
+             template: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        ...
+
+    def commit(self, tag: str) -> bool:
+        return True

@@ -1,0 +1,364 @@
+"""The single JSON config that drives the whole framework.
+
+TPU-native analogue of reference ``deepspeed/runtime/config.py:674``
+(``DeepSpeedConfig``): one dict/file parsed into typed sub-configs with the
+batch-size triangle ``train_batch_size = micro_batch * gradient_accumulation
+* data_parallel_size`` auto-completed and validated.
+
+Differences from the reference, by design:
+- a ``mesh`` section declares the device mesh axes (data/fsdp/tensor/pipe/
+  expert/sequence); the reference's implicit process groups become mesh axes.
+- bf16 is the default precision (fp16+loss-scaling kept for parity).
+"""
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import (
+    DeepSpeedConfigModel,
+    dict_raise_error_on_duplicate_keys,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+LAMB_OPTIMIZER = "lamb"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+SGD_OPTIMIZER = "sgd"
+ADAGRAD_OPTIMIZER = "adagrad"
+LION_OPTIMIZER = "lion"
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+    ZERO_ONE_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER,
+    ADAGRAD_OPTIMIZER, LION_OPTIMIZER,
+]
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """`"fp16": {...}` — kept for parity; bf16 needs no loss scaling."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)  # 0 => dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, gt=0)
+    hysteresis: int = Field(2, ge=0)
+    min_loss_scale: float = Field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    """`"bf16": {...}` — native TPU precision."""
+
+    enabled: bool = True
+    # accumulate gradients across micro-batches in fp32 (reference
+    # bf16_optimizer grad accumulation dtype)
+    immediate_grad_update: bool = False
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = ADAMW_OPTIMIZER
+    params: Dict[str, Any] = {}
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = {}
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-specific: the device-mesh shape.
+
+    Axes (any may be 1 / omitted): ``pipe`` (pipeline stages), ``data``
+    (pure data parallel), ``fsdp`` (ZeRO sharding axis; merged with ``data``
+    when unset), ``expert`` (MoE expert parallel), ``sequence`` (Ulysses/ring
+    context parallel), ``tensor`` (megatron-style tensor parallel).
+
+    -1 for one axis means "all remaining devices".
+    """
+
+    pipe: int = 1
+    data: int = Field(-1)
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    # device assignment order, outermost first; DCN-crossing axes should be
+    # outermost so TP/SP collectives ride ICI.
+    axis_order: List[str] = ["pipe", "data", "expert", "sequence", "tensor"]
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """`"activation_checkpointing"` (reference activation_checkpointing/config).
+
+    On TPU this maps to jax.checkpoint (remat) policies.
+    """
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-specific: named remat policy ("nothing_saveable", "dots_saveable",
+    # "dots_with_no_batch_dims_saveable", "everything_saveable")
+    policy: str = "nothing_saveable"
+
+
+class TensorboardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = []
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: Union[str, int] = "auto"
+    partition: str = "best"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    # TPU-specific: microbatch schedule; "1f1b" | "gpipe" | "interleaved"
+    schedule: str = "1f1b"
+
+
+class MoEConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    moe_param_group: bool = False
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = {}
+    # TPU-specific: async orbax-style checkpointing
+    async_save: bool = True
+
+
+class DataTypeConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class AIOConfig(DeepSpeedConfigModel):
+    """Host async-IO knobs (reference aio_config.py); consumed by the C++
+    io thread-pool in deepspeed_tpu/ops/aio."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = [2, 4, 6]
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedConfig:
+    """Parse + validate the config dict (reference runtime/config.py:674)."""
+
+    def __init__(self, config: Union[str, Dict], mesh_shape: Optional[Dict[str, int]] = None,
+                 world_size: Optional[int] = None):
+        if isinstance(config, str):
+            with open(config, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys
+                )
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a config dict or path to a json file, got {type(config)}"
+            )
+
+        if world_size is None:
+            import jax
+
+            world_size = jax.device_count()
+        self.world_size = world_size
+
+        p = self._param_dict
+        self.train_batch_size: Optional[int] = p.get("train_batch_size")
+        self.train_micro_batch_size_per_gpu: Optional[int] = p.get(
+            "train_micro_batch_size_per_gpu"
+        )
+        self.gradient_accumulation_steps: Optional[int] = p.get(
+            "gradient_accumulation_steps"
+        )
+        self.steps_per_print: int = p.get("steps_per_print", 10)
+        self.dump_state: bool = p.get("dump_state", False)
+        self.gradient_clipping: float = p.get("gradient_clipping", 0.0)
+        self.prescale_gradients: bool = p.get("prescale_gradients", False)
+        self.gradient_predivide_factor: float = p.get("gradient_predivide_factor", 1.0)
+        self.sparse_gradients_enabled: bool = p.get("sparse_gradients", False)
+        self.communication_data_type: Optional[str] = p.get("communication_data_type")
+        self.disable_allgather: bool = p.get("disable_allgather", False)
+        self.wall_clock_breakdown: bool = p.get("wall_clock_breakdown", False)
+        self.memory_breakdown: bool = p.get("memory_breakdown", False)
+        self.seed: int = p.get("seed", 42)
+
+        self.zero_config = DeepSpeedZeroConfig(**p.get("zero_optimization", {}))
+        self.fp16 = FP16Config(**p.get("fp16", {}))
+        bf16_dict = p.get("bf16", p.get("bfloat16", {}))
+        if "enabled" not in bf16_dict and self.fp16.enabled:
+            bf16_dict = {**bf16_dict, "enabled": False}
+        self.bf16 = BF16Config(**bf16_dict)
+        self.optimizer = OptimizerConfig(**p["optimizer"]) if "optimizer" in p else None
+        self.scheduler = SchedulerConfig(**p["scheduler"]) if "scheduler" in p else None
+        self.mesh = MeshConfig(**p.get("mesh", {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **p.get("activation_checkpointing", {})
+        )
+        self.tensorboard = TensorboardConfig(**p.get("tensorboard", {}))
+        self.wandb = WandbConfig(**p.get("wandb", {}))
+        self.csv_monitor = CSVConfig(**p.get("csv_monitor", {}))
+        self.comms_logger = CommsLoggerConfig(**p.get("comms_logger", {}))
+        self.flops_profiler = FlopsProfilerConfig(**p.get("flops_profiler", {}))
+        self.pipeline = PipelineConfig(**p.get("pipeline", {}))
+        self.moe = MoEConfig(**p.get("moe", {}))
+        self.checkpoint_config = CheckpointConfig(**p.get("checkpoint", {}))
+        self.data_types = DataTypeConfig(**p.get("data_types", {}))
+        self.aio = AIOConfig(**p.get("aio", {}))
+        self.elasticity = ElasticityConfig(**p.get("elasticity", {}))
+        self.compression_config = p.get("compression_training", {})
+        self.data_efficiency_config = p.get("data_efficiency", {})
+        self.curriculum_learning_legacy = p.get("curriculum_learning", {})
+        self.monitor_config_enabled = (
+            self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+        )
+
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+
+        self._resolve_batch_config()
+        self._do_sanity_check()
+
+    # --- batch triangle (reference config.py:837 _configure_train_batch_size) ---
+    def _resolve_batch_config(self) -> None:
+        # data-parallel size for the triangle = world / (pipe*tensor*sequence)
+        m = self.mesh
+        denom = max(1, m.pipe) * max(1, m.tensor) * max(1, m.sequence)
+        dp_world = max(1, self.world_size // denom)
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp_world)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp_world)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp_world
+        elif train is not None:
+            gas = 1
+            micro = train // dp_world
+        elif micro is not None:
+            gas = 1
+            train = micro * dp_world
+        else:
+            micro, gas = 1, 1
+            train = dp_world
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+        self.data_parallel_size = dp_world
+
+    def _do_sanity_check(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        dp = self.data_parallel_size
+        if train != micro * gas * dp:
+            raise DeepSpeedConfigError(
+                f"Check batch related parameters. train_batch_size is not equal to "
+                f"micro_batch_per_gpu * gradient_accumulation_steps * data_parallel_size: "
+                f"{train} != {micro} * {gas} * {dp}"
+            )
+        if any(v <= 0 for v in (train, micro, gas)):
+            raise DeepSpeedConfigError(
+                f"Batch parameters must be positive: train={train} micro={micro} gas={gas}"
+            )
+        if self.optimizer is not None:
+            t = self.optimizer.type.lower()
+            if t not in DEEPSPEED_OPTIMIZERS:
+                logger.warning(
+                    f"Optimizer type {self.optimizer.type} is not a built-in; "
+                    f"it must be registered via deepspeed_tpu.ops.optimizer_registry"
+                )
+
+    # convenience views -----------------------------------------------------
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    def print_config(self) -> None:
+        logger.info(f"DeepSpeedConfig: {json.dumps(self._param_dict, indent=2, default=str)}")

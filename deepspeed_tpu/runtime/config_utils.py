@@ -1,0 +1,78 @@
+"""Config plumbing shared by every feature sub-config.
+
+TPU-native analogue of reference ``deepspeed/runtime/config_utils.py:16``
+(``DeepSpeedConfigModel``): a pydantic base model with alias support and a
+deprecated-field mechanism that transparently forwards old names to their
+replacements with a warning.
+"""
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all feature sub-configs.
+
+    Extra keys are rejected (strict parity with the reference's value checks),
+    aliases are honored on input, and fields marked ``deprecated=True`` in
+    ``json_schema_extra`` with a ``new_param`` entry are remapped.
+    """
+
+    model_config = ConfigDict(
+        extra="forbid",
+        populate_by_name=True,
+        validate_assignment=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data: Any):
+        if not strict:  # drop None values so field defaults apply
+            data = {k: v for k, v in data.items() if v is not None}
+        data = self._remap_deprecated(data)
+        super().__init__(**data)
+
+    @classmethod
+    def _remap_deprecated(cls, data: Dict[str, Any]) -> Dict[str, Any]:
+        for name, field in cls.model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated"):
+                continue
+            keys = {name}
+            if field.alias:
+                keys.add(field.alias)
+            present = keys & set(data.keys())
+            if not present:
+                continue
+            new_param = extra.get("new_param")
+            old_key = present.pop()
+            if new_param:
+                logger.warning(
+                    f"Config parameter {old_key} is deprecated; use {new_param} instead"
+                )
+                if new_param not in data:
+                    data[new_param] = data.pop(old_key)
+                else:
+                    data.pop(old_key)
+            else:
+                logger.warning(f"Config parameter {old_key} is deprecated and ignored")
+        return data
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json.load hook rejecting duplicate keys (reference config_utils.py:134)."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counts = {}
+        for k, _ in ordered_pairs:
+            counts[k] = counts.get(k, 0) + 1
+        dupes = [k for k, c in counts.items() if c > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {dupes}")
+    return d

@@ -1,0 +1,7 @@
+from deepspeed_tpu.runtime.fp16.loss_scaler import (  # noqa: F401
+    LossScalerState,
+    grads_finite,
+    make_dynamic_scaler_state,
+    make_static_scaler_state,
+    update_scaler,
+)

@@ -1,0 +1,62 @@
+"""Static and dynamic loss scaling (reference ``runtime/fp16/loss_scaler.py``
+:66/:90/:203). Pure-functional: scaler state is a small pytree carried through
+the jitted train step; overflow is detected from non-finite grads and the
+step is skipped inside jit with ``jnp.where`` (no host round-trip).
+
+bf16 training doesn't need this — it is wired only when fp16.enabled.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScalerState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    overflow_streak: jnp.ndarray  # consecutive good steps since last overflow
+    hysteresis: jnp.ndarray     # remaining tolerated overflows before cut
+
+
+def make_static_scaler_state(scale: float) -> LossScalerState:
+    return LossScalerState(
+        scale=jnp.asarray(scale, jnp.float32),
+        overflow_streak=jnp.zeros((), jnp.int32),
+        hysteresis=jnp.asarray(2, jnp.int32),
+    )
+
+
+def make_dynamic_scaler_state(initial_scale_power: int = 16,
+                              hysteresis: int = 2) -> LossScalerState:
+    return LossScalerState(
+        scale=jnp.asarray(2.0 ** initial_scale_power, jnp.float32),
+        overflow_streak=jnp.zeros((), jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+    )
+
+
+def grads_finite(grads: Any) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.asarray(True)
+    for g in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def update_scaler(state: LossScalerState, finite: jnp.ndarray,
+                  dynamic: bool, scale_window: int = 1000,
+                  scale_factor: float = 2.0, min_scale: float = 1.0,
+                  hysteresis: int = 2) -> LossScalerState:
+    """reference DynamicLossScaler.update_scale (:139)."""
+    if not dynamic:
+        return state
+    hyst = jnp.where(finite, state.hysteresis, state.hysteresis - 1)
+    cut = jnp.logical_and(~finite, hyst <= 0)
+    new_scale = jnp.where(
+        cut, jnp.maximum(state.scale / scale_factor, min_scale), state.scale)
+    streak = jnp.where(finite, state.overflow_streak + 1, 0)
+    grow = jnp.logical_and(finite, streak >= scale_window)
+    new_scale = jnp.where(grow, new_scale * scale_factor, new_scale)
+    streak = jnp.where(grow, 0, streak)
+    hyst = jnp.where(cut | grow, jnp.asarray(hysteresis, jnp.int32), hyst)
+    return LossScalerState(scale=new_scale, overflow_streak=streak, hysteresis=hyst)

@@ -1,0 +1,113 @@
+"""LR schedules (reference ``deepspeed/runtime/lr_schedules.py``).
+
+Same four families — LRRangeTest (:258), OneCycle (:361), WarmupLR (:626),
+WarmupDecayLR (:715) — expressed as pure step->lr callables (optax schedule
+convention) so they can live inside the jitted train step.
+"""
+
+import math
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+Schedule = Callable[[Any], Any]
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """Linearly/staircase-increasing LR probe (reference :258)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int = None,
+              decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0, **_) -> Schedule:
+    """Triangular cycle then decay (reference :361, momentum cycling omitted —
+    optax handles momentum separately)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None \
+        else cycle_first_step_size
+    total = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        in_up = step < cycle_first_step_size
+        up_frac = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down_frac = jnp.clip((step - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        cycle_lr = jnp.where(
+            in_up,
+            cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up_frac,
+            cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac,
+        )
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - total, 0.0) / decay_step_size
+            decay = 1.0 / (1.0 + decay_lr_rate * decay_steps)
+        else:
+            decay = 1.0
+        return jnp.where(step < total, cycle_lr, cycle_min_lr * decay)
+
+    return schedule
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
+    """Warm up then hold (reference :626)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip((step + 1) / warmup_num_steps, 1e-8, 1.0)
+        if warmup_type == "log":
+            # log warmup: gamma goes 0→1 as log(step) approaches log(warmup)
+            gamma = jnp.clip(1.0 + jnp.log(frac) / math.log(max(warmup_num_steps, 2)), 0.0, 1.0)
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_) -> Schedule:
+    """Warm up then linear decay to zero (reference :715)."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, warm(step), warmup_max_lr * decay)
+
+    return schedule
+
+
+_FACTORIES: Dict[str, Callable[..., Schedule]] = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+}
+
+
+def get_lr_schedule(name: str, params: Dict[str, Any]) -> Schedule:
+    if name not in _FACTORIES:
+        raise ValueError(f"Unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
+    return _FACTORIES[name](**params)

@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.pipe.schedule import (
+    InferenceSchedule, PipeInstruction, PipeSchedule, TrainSchedule,
+)
+from deepspeed_tpu.runtime.pipe.spmd import pipeline_partition, spmd_pipeline

@@ -1,0 +1,181 @@
+"""Pipeline schedules as instruction streams.
+
+Keeps the reference's genuinely good design (``runtime/pipe/schedule.py``:
+``PipeSchedule`` yielding ``PipeInstruction`` lists; ``TrainSchedule`` :189
+1F1B, ``InferenceSchedule`` :135) as a first-class, testable artifact. On
+TPU the SPMD executor (pipe/spmd.py) realizes the same dataflow implicitly,
+but the schedules remain the source of truth for step-count/bubble math,
+the wall-clock model used by the autotuner, and for a future
+instruction-interpreting executor over ``ppermute``.
+"""
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class ForwardPass(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class BackwardPass(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class SendActivation(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class RecvActivation(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class SendGrad(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class RecvGrad(PipeInstruction):
+    def __init__(self, buffer_id: int):
+        super().__init__(buffer_id=buffer_id)
+
+
+class PipeSchedule:
+    """Base schedule: yields lists of instructions per step."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference schedule.py:135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            valid = 0 <= micro_batch_id < self.micro_batches
+            if valid:
+                buf = self._buffer_idx(micro_batch_id)
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference schedule.py:189): warmup forwards, steady-state
+    alternating 1 forward / 1 backward, cooldown backwards, then reduce+step.
+    """
+
+    def steps(self):
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+            valid = 0 <= micro_batch_id < self.micro_batches
+            if valid:
+                buf = self._buffer_idx(micro_batch_id)
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(buffer_id=buf))
+                    else:
+                        cmds.append(RecvActivation(buffer_id=buf))
+                    cmds.append(ForwardPass(buffer_id=buf))
+                    if not self.is_last_stage:
+                        cmds.append(SendActivation(buffer_id=buf))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(buffer_id=buf))
+                    cmds.append(BackwardPass(buffer_id=buf))
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(buffer_id=buf))
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id: int):
+        """Even steps forward, odd steps backward, offset by stage position
+        so forward of stage s for microbatch m lands at step 2m + s and the
+        matching backward at 2(m + stages - 1) - s + 1."""
+        if _is_even(step_id) == _is_even(self.stage_id):
+            micro_batch_id = (step_id - self.stage_id) // 2
+            return micro_batch_id, True
+        micro_batch_id = (step_id - 2 * (self.stages - 1) + self.stage_id - 1) // 2
+        return micro_batch_id, False
+
+    def num_pipe_buffers(self) -> int:
+        """In-flight activations at this stage (1F1B memory bound)."""
+        return max(2, min(self.micro_batches, self.stages - self.stage_id))
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0

@@ -1,0 +1,10 @@
+from deepspeed_tpu.runtime.zero.config import (
+    DeepSpeedZeroConfig,
+    DeepSpeedZeroOffloadOptimizerConfig,
+    DeepSpeedZeroOffloadParamConfig,
+)
+from deepspeed_tpu.runtime.zero.stages import (
+    ZeroShardingPlan,
+    opt_state_shardings,
+    plan_zero_shardings,
+)

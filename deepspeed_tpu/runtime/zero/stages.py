@@ -1,0 +1,140 @@
+"""ZeRO stages as a sharding plan.
+
+The reference implements ZeRO with imperative machinery — flat fp16 buffers,
+IPG buckets, grad hooks, param gather/release hooks
+(stage_1_and_2.py, stage3.py, partition_parameters.py). On TPU the same
+memory behavior falls out of *where each pytree lives*:
+
+- stage 1: optimizer states sharded over the ``data`` axis. XLA turns the
+  grad reduction into reduce_scatter for the shard each rank updates and
+  all_gathers updated params — exactly the reference's
+  ``all_gather_dp_groups`` epilogue (runtime/utils.py:923).
+- stage 2: + gradients constrained to data-sharded, so the full-grad buffer
+  never materializes (the IPG bucket analogue; XLA overlaps the
+  reduce_scatter with backward compute like ``overlap_comm``).
+- stage 3: + parameters sharded over ``data``; XLA inserts per-layer
+  all_gathers during fwd/bwd — the coordinator's fetch/release with compiler
+  scheduling instead of Python trace machinery. With scan-over-layers models
+  the gather is per-block, bounding live memory like
+  ``max_live_parameters``.
+
+Offload: optimizer-state shardings get ``memory_kind='pinned_host'`` —
+the analogue of ZeRO-Offload's pinned CPU buffers + DeepSpeedCPUAdam; XLA
+streams shards HBM<->host around the update.
+"""
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.partition import path_str, infer_param_spec
+from deepspeed_tpu.utils.logging import logger
+
+
+class ZeroShardingPlan(NamedTuple):
+    """Shardings for every training-state pytree."""
+
+    param_specs: Any        # pytree of PartitionSpec for model params
+    grad_specs: Any         # pytree of PartitionSpec gradients are constrained to
+    opt_specs: Any          # pytree-spec applied to each optimizer-state leaf
+    param_shardings: Any    # NamedShardings (device memory)
+    opt_sharding_fn: Any    # leaf-path -> NamedSharding for optimizer state
+    offload_optimizer: bool
+
+
+def _specs(params: Any, mesh: Mesh, rules, shard_data: bool) -> Any:
+    def spec_for(path, leaf):
+        if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) == 0:
+            return PartitionSpec()
+        return infer_param_spec(path_str(path), leaf.shape, mesh, rules, shard_data)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _supports_host_memory(mesh: Mesh) -> bool:
+    try:
+        dev = mesh.devices.flat[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        return "pinned_host" in kinds
+    except Exception:
+        return False
+
+
+def plan_zero_shardings(params: Any, mesh: Mesh, zero_config, rules=None) -> ZeroShardingPlan:
+    stage = zero_config.stage
+    mics = getattr(zero_config, "mics_shard_size", -1)
+    if mics and mics > 0:
+        logger.warning("MiCS sub-group sharding is not yet wired; using full data-axis sharding")
+
+    param_specs = _specs(params, mesh, rules, shard_data=(stage >= 3))
+    grad_specs = _specs(params, mesh, rules, shard_data=(stage >= 2))
+    opt_specs = _specs(params, mesh, rules, shard_data=(stage >= 1))
+
+    offload = zero_config.offload_optimizer_device == "cpu"
+    host_ok = offload and _supports_host_memory(mesh)
+    if offload and not host_ok:
+        logger.warning("offload_optimizer=cpu requested but this backend lacks "
+                       "pinned_host memory; keeping optimizer states in HBM")
+
+    param_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def opt_sharding(spec: PartitionSpec) -> NamedSharding:
+        if host_ok:
+            return NamedSharding(mesh, spec, memory_kind="pinned_host")
+        return NamedSharding(mesh, spec)
+
+    return ZeroShardingPlan(
+        param_specs=param_specs,
+        grad_specs=grad_specs,
+        opt_specs=opt_specs,
+        param_shardings=param_shardings,
+        opt_sharding_fn=opt_sharding,
+        offload_optimizer=host_ok,
+    )
+
+
+def opt_state_shardings(opt_state: Any, params: Any, plan: ZeroShardingPlan,
+                        mesh: Mesh) -> Any:
+    """Shardings for an optax opt_state: leaves shaped like a param pytree get
+    that param's (stage>=1 data-sharded) spec; scalars/steps are replicated."""
+    flat_params, params_treedef = jax.tree_util.tree_flatten(params)
+    flat_specs = jax.tree_util.tree_leaves(
+        plan.opt_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def sharding_for(leaf):
+        if hasattr(leaf, "shape") and leaf.ndim > 0:
+            # match param-shaped leaves by shape identity walk
+            for p, s in zip(flat_params, flat_specs):
+                if p.shape == leaf.shape:
+                    return plan.opt_sharding_fn(s)
+        return NamedSharding(mesh, PartitionSpec())
+
+    def map_subtree(subtree):
+        # If this subtree has the same structure as params, map spec-wise.
+        try:
+            sub_flat, sub_def = jax.tree_util.tree_flatten(subtree)
+            if sub_def == params_treedef:
+                return jax.tree_util.tree_unflatten(
+                    sub_def, [plan.opt_sharding_fn(s) for s in flat_specs])
+        except Exception:
+            pass
+        return None
+
+    # optax states are tuples/namedtuples whose fields are either param-shaped
+    # pytrees (mu, nu, trace...) or scalars (count).
+    def walk(node):
+        mapped = map_subtree(node)
+        if mapped is not None:
+            return mapped
+        if isinstance(node, tuple) and type(node) is not tuple:  # NamedTuple
+            return type(node)(*[walk(x) for x in node])
+        if isinstance(node, (tuple, list)):
+            return type(node)(walk(x) for x in node)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return sharding_for(node)
+
+    return walk(opt_state)

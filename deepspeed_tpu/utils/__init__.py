@@ -1,0 +1,5 @@
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils import groups
+
+__all__ = ["logger", "log_dist", "SynchronizedWallClockTimer", "ThroughputTimer", "groups"]

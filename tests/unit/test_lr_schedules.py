@@ -1,0 +1,44 @@
+"""LR-schedule tests (reference tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    get_lr_schedule, lr_range_test, one_cycle, warmup_decay_lr, warmup_lr,
+)
+
+
+def test_warmup_linear():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                  warmup_type="linear")
+    assert float(s(0)) == pytest.approx(0.1)
+    assert float(s(9)) == pytest.approx(1.0)
+    assert float(s(100)) == pytest.approx(1.0)
+
+
+def test_warmup_decay():
+    s = warmup_decay_lr(total_num_steps=100, warmup_min_lr=0.0, warmup_max_lr=1.0,
+                        warmup_num_steps=10, warmup_type="linear")
+    assert float(s(9)) <= 1.0
+    assert float(s(100)) == pytest.approx(0.0)
+    assert float(s(55)) == pytest.approx(0.5)
+
+
+def test_one_cycle():
+    s = one_cycle(cycle_min_lr=0.1, cycle_max_lr=1.0, cycle_first_step_size=10)
+    assert float(s(0)) == pytest.approx(0.1)
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(20)) == pytest.approx(0.1)
+
+
+def test_lr_range_test_increases():
+    s = lr_range_test(lr_range_test_min_lr=0.01, lr_range_test_step_size=5,
+                      lr_range_test_step_rate=1.0)
+    values = [float(s(i)) for i in range(0, 20, 5)]
+    assert values == sorted(values)
+    assert values[-1] > values[0]
+
+
+def test_factory_unknown():
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})

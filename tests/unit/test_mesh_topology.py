@@ -1,0 +1,59 @@
+"""Mesh + topology tests (reference tests/unit/runtime/pipe/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.mesh import resolve_mesh_dims, make_mesh
+from deepspeed_tpu.parallel.topology import (
+    PipeDataParallelTopology, PipeModelDataParallelTopology, ProcessTopology,
+)
+from deepspeed_tpu.runtime.config import MeshConfig
+
+
+def test_resolve_wildcard():
+    dims = resolve_mesh_dims(MeshConfig(tensor=2, data=-1), 8)
+    assert dims["data"] == 4 and dims["tensor"] == 2
+
+
+def test_resolve_exact():
+    dims = resolve_mesh_dims(MeshConfig(pipe=2, data=2, tensor=2), 8)
+    assert dims == {"pipe": 2, "data": 2, "expert": 1, "sequence": 1, "tensor": 2}
+
+
+def test_resolve_mismatch_raises():
+    with pytest.raises(ValueError):
+        resolve_mesh_dims(MeshConfig(pipe=3, data=3), 8)
+
+
+def test_make_mesh_axes(dp4_tp2_mesh):
+    assert dp4_tp2_mesh.shape["data"] == 4
+    assert dp4_tp2_mesh.shape["tensor"] == 2
+    assert dp4_tp2_mesh.axis_names == ("pipe", "data", "sequence", "tensor")
+
+
+def test_topology_rank_mapping():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=0, data=1) == 1
+    assert topo.get_rank(pipe=1, data=0) == 2
+    assert topo.world_size() == 4
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert sorted(map(sorted, pipe_lists)) == [[0, 2], [1, 3]]
+    data_lists = topo.get_axis_comm_lists("data")
+    assert sorted(map(sorted, data_lists)) == [[0, 1], [2, 3]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    r = topo.get_rank_repr(0)
+    assert "pipe_0" in r and "model_0" in r

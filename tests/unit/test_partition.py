@@ -1,0 +1,72 @@
+"""Sharding-rules tests: TP rules + ZeRO data-axis sharding."""
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.partition import (
+    infer_param_spec, tree_param_specs,
+)
+from deepspeed_tpu.runtime.zero.stages import plan_zero_shardings
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+
+def test_tp_rules_qkv_column(dp4_tp2_mesh):
+    spec = infer_param_spec("layers_0/attn/q_proj/kernel", (64, 64), dp4_tp2_mesh)
+    assert spec == P(None, "tensor")
+
+
+def test_tp_rules_o_row(dp4_tp2_mesh):
+    spec = infer_param_spec("layers_0/attn/o_proj/kernel", (64, 64), dp4_tp2_mesh)
+    assert spec == P("tensor", None)
+
+
+def test_tp_rules_mlp(dp4_tp2_mesh):
+    up = infer_param_spec("layers_0/mlp/up_proj/kernel", (64, 128), dp4_tp2_mesh)
+    down = infer_param_spec("layers_0/mlp/down_proj/kernel", (128, 64), dp4_tp2_mesh)
+    assert up == P(None, "tensor")
+    assert down == P("tensor", None)
+
+
+def test_tp_rules_embed(dp4_tp2_mesh):
+    spec = infer_param_spec("embed_tokens/embedding", (256, 64), dp4_tp2_mesh)
+    assert spec == P("tensor", None)
+
+
+def test_tp_skips_indivisible(dp4_tp2_mesh):
+    spec = infer_param_spec("layers_0/attn/q_proj/kernel", (64, 63), dp4_tp2_mesh)
+    assert spec == P(None, None)
+
+
+def test_no_tp_axis_when_tp1(dp8_mesh):
+    spec = infer_param_spec("layers_0/attn/q_proj/kernel", (64, 64), dp8_mesh)
+    assert spec == P(None, None)
+
+
+def test_zero3_data_sharding(dp8_mesh):
+    spec = infer_param_spec("layers_0/mlp/gate_proj/kernel", (64, 128), dp8_mesh,
+                            shard_data_axis=True)
+    assert "data" in spec
+
+
+def test_zero3_plus_tp(dp4_tp2_mesh):
+    spec = infer_param_spec("layers_0/attn/q_proj/kernel", (64, 64), dp4_tp2_mesh,
+                            shard_data_axis=True)
+    # tensor on dim 1 from TP rule, data on dim 0 from ZeRO-3
+    assert spec == P("data", "tensor")
+
+
+def test_plan_stages(dp8_mesh):
+    params = {"w": jnp.zeros((64, 64)), "b": jnp.zeros((64,))}
+    for stage, param_sharded, grad_sharded, opt_sharded in [
+            (0, False, False, False), (1, False, False, True),
+            (2, False, True, True), (3, True, True, True)]:
+        plan = plan_zero_shardings(params, dp8_mesh, DeepSpeedZeroConfig(stage=stage))
+        has = lambda tree: any("data" in s for s in [tree["w"]])
+        assert has(plan.param_specs) == param_sharded, f"stage{stage} params"
+        assert has(plan.grad_specs) == grad_sharded, f"stage{stage} grads"
+        assert has(plan.opt_specs) == opt_sharded, f"stage{stage} opt"
+
+
+def test_tree_specs_scalar_ok(dp8_mesh):
+    specs = tree_param_specs({"s": jnp.zeros(())}, dp8_mesh, shard_data_axis=True)
+    assert specs["s"] == P()

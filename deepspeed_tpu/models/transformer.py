@@ -68,6 +68,32 @@ def dot_product_attention(q, k, v, mask=None, dropout_rng=None, dropout_rate=0.0
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def _sequence_parallel_attention(q, k, v, impl: str):
+    """Dispatch to Ulysses / ring context parallelism over the ambient mesh's
+    ``sequence`` axis (requires the engine's mesh context; [B,S,H,D] logical
+    arrays are mapped to per-device [B, S/P, H, D] shards)."""
+    from jax.sharding import PartitionSpec, get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or "sequence" not in mesh.axis_names or \
+            mesh.shape["sequence"] <= 1:
+        # no sequence axis active — plain causal attention
+        return dot_product_attention(q, k, v,
+                                     mask=make_causal_mask(q.shape[1]))
+    batch_axis = "data" if "data" in mesh.axis_names and \
+        q.shape[0] % mesh.shape["data"] == 0 and mesh.shape["data"] > 1 else None
+    spec = PartitionSpec(batch_axis, "sequence", None, None)
+
+    if impl == "ulysses":
+        from deepspeed_tpu.ops.ulysses import ulysses_attention as inner
+    else:
+        from deepspeed_tpu.ops.ring_attention import ring_attention as inner
+
+    return jax.shard_map(
+        lambda q_, k_, v_: inner(q_, k_, v_, causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
+
+
 class RMSNorm(nn.Module):
     """RMS layernorm (reference csrc/transformer/inference/csrc/rms_norm.cu)."""
 
@@ -146,6 +172,8 @@ class SelfAttention(nn.Module):
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
+        elif self.attention_impl in ("ulysses", "ring") and kv_cache is None:
+            out = _sequence_parallel_attention(q, k, v, self.attention_impl)
         else:
             dropout_rng = None
             if self.dropout_rate > 0.0 and not deterministic:

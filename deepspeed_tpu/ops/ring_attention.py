@@ -1,0 +1,82 @@
+"""Ring attention: context parallelism with O(S/P) memory per device.
+
+Second long-context mechanism (complements Ulysses, ops/ulysses.py): K/V
+blocks rotate around the ``sequence`` ring via ``ppermute`` while each
+device keeps only its query shard. Online-softmax statistics accumulate
+across ring steps, so the full [S, S] score matrix never exists anywhere —
+the multi-chip generalization of flash attention's blocking, with the
+ppermute overlapping compute on ICI.
+
+No head-divisibility constraint (unlike Ulysses); works for any P dividing
+the sequence. Causal masking uses global positions derived from the ring
+step. Differentiable (the scan of lax ops reverse-differentiates; memory for
+the backward is O(P) saved block stats — acceptable at test scale, a Pallas
+fused fwd+bwd is the optimization path).
+
+Call inside shard_map with q/k/v sequence-sharded: [B, S/P, H, D].
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, *, causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   axis_name: str = "sequence"):
+    """[B, S/P, H, D] per device → [B, S/P, H, D]."""
+    P = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, S_loc, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+
+    q32 = q.astype(jnp.float32) * sm_scale
+    # my global query positions
+    q_pos = me * S_loc + jnp.arange(S_loc)                     # [S/P]
+
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def step(carry, r):
+        k_cur, v_cur, acc, m_run, l_run = carry
+        # k_cur originated on rank (me - r) mod P
+        src = (me - r) % P
+        k_pos = src * S_loc + jnp.arange(S_loc)                # [S/P]
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]            # [S/P, S/P]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=-1)                            # [B,H,S/P]
+        m_new = jnp.maximum(m_run, m_cur)
+        # guard fully-masked rows (exp(NEG_INF - NEG_INF) would be 1)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m_run - m_new)
+        corr = jnp.where(m_run <= NEG_INF / 2, 0.0, corr)
+        l_new = l_run * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m_new, l_new), None
+
+    # carries must share the inputs' varying-axes type; deriving them from a
+    # zeroed slice of q is robust to whatever axis set the enclosing
+    # shard_map maps over (sequence alone, or data+sequence, ...)
+    qt = jnp.swapaxes(q32, 1, 2)                               # [B,H,S/P,D]
+    zero_like_q = qt * 0.0
+    acc0 = zero_like_q
+    m0 = zero_like_q[..., 0] + NEG_INF
+    l0 = zero_like_q[..., 0]
+    (_, _, acc, m_fin, l_fin), _ = lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(P))
+
+    out = acc / jnp.maximum(l_fin[..., None], 1e-30)           # [B,H,S/P,D]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

@@ -1,0 +1,60 @@
+"""Ulysses-style sequence-parallel attention (all-to-all head sharding).
+
+The reference (v0.9.3) has NO sequence parallelism (SURVEY §2.3) — its only
+long-context tools are block-sparse attention and curriculum seqlen. This
+module is the TPU-native long-context pillar: tokens are sharded over the
+``sequence`` mesh axis; at attention time an all_to_all swaps the sequence
+shard for a head shard (every device sees the full sequence for H/P heads),
+full attention runs locally (optionally via the Pallas flash kernel), and a
+second all_to_all restores sequence sharding. Both all_to_alls ride ICI.
+
+Call inside shard_map with q/k/v sequence-sharded: [B, S/P, H, D].
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _all_to_all_seq_to_heads(x, axis_name: str):
+    """[B, S/P, H, D] -> [B, S, H/P, D]."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _all_to_all_heads_to_seq(x, axis_name: str):
+    """[B, S, H/P, D] -> [B, S/P, H, D]."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      axis_name: str = "sequence",
+                      attention_impl: str = "xla"):
+    """Sequence-parallel attention. q/k/v: [B, S/P, H, D] (local shard).
+
+    Requires H % P == 0 (heads divisible by the sequence-axis size), the
+    same constraint DeepSpeed-Ulysses documents.
+    """
+    P = lax.axis_size(axis_name)
+    H = q.shape[2]
+    if H % P != 0:
+        raise ValueError(f"num_heads {H} must be divisible by sequence axis {P}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    qg = _all_to_all_seq_to_heads(q, axis_name)   # [B, S, H/P, D]
+    kg = _all_to_all_seq_to_heads(k, axis_name)
+    vg = _all_to_all_seq_to_heads(v, axis_name)
+
+    if attention_impl == "flash":
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    else:
+        from deepspeed_tpu.ops.flash_attention import _reference_attention
+
+        out = _reference_attention(qg, kg, vg, causal, sm_scale)
+
+    return _all_to_all_heads_to_seq(out, axis_name)  # [B, S/P, H, D]

@@ -339,6 +339,8 @@ class DeepSpeedEngine:
 
     # --- data placement -------------------------------------------------------
     def _shard_batch(self, batch: Dict[str, Any], leading_gas: bool = False):
+        seq_size = mesh_axis_size(self.mesh, "sequence")
+
         def put(x):
             x = jnp.asarray(x)
             if x.ndim == 0:
@@ -346,6 +348,10 @@ class DeepSpeedEngine:
             axes = [None] * x.ndim
             b_axis = 1 if leading_gas else 0
             axes[b_axis] = DATA_AXIS
+            # context parallelism: tokens shard over the sequence axis too
+            s_axis = b_axis + 1
+            if seq_size > 1 and x.ndim > s_axis and x.shape[s_axis] % seq_size == 0:
+                axes[s_axis] = "sequence"
             return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec(*axes)))
 
         return {k: put(v) for k, v in batch.items()}

@@ -1,0 +1,6 @@
+from deepspeed_tpu.checkpoint.consolidate import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    load_state_dict_from_consolidated,
+    restore_with_shardings,
+)

@@ -1,0 +1,85 @@
+"""Checkpoint consolidation + universal re-layout.
+
+TPU-native analogue of reference ``deepspeed/utils/zero_to_fp32.py`` (offline
+fp32 reconstruction from ZeRO shards) and ``checkpoint/universal_checkpoint.py``
+(per-param fragment re-layout for changed TP/PP/DP).
+
+On this stack both collapse to metadata operations: checkpoints store
+logical arrays + shard layouts (orbax), so
+
+- ``get_fp32_state_dict_from_zero_checkpoint``: restore with replicated
+  sharding → full fp32 arrays (no manual fragment stitching);
+- loading onto a different mesh/ZeRO stage: restore with the *new* plan's
+  shardings — the "universal checkpoint" re-chunking is done by the runtime.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _state_path(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}")
+    return os.path.abspath(os.path.join(checkpoint_dir, tag, "state"))
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict[str, Any]:
+    """Full (unsharded) fp32 params from a saved checkpoint
+    (reference zero_to_fp32.py:get_fp32_state_dict_from_zero_checkpoint)."""
+    path = _state_path(checkpoint_dir, tag)
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path)          # numpy arrays, fully gathered
+    params = restored["params"] if "params" in restored else restored
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x, dtype=np.float32)
+        if hasattr(x, "dtype") and np.issubdtype(np.asarray(x).dtype, np.floating)
+        else np.asarray(x),
+        params)
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None) -> str:
+    """Offline conversion CLI body (reference zero_to_fp32.py main)."""
+    state = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    flat = {}
+
+    def flatten(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                flatten(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            flat[prefix] = node
+
+    flatten("", state)
+    np.savez(output_file, **flat)
+    logger.info(f"wrote consolidated fp32 state ({len(flat)} tensors) to {output_file}")
+    return output_file
+
+
+def load_state_dict_from_consolidated(path: str) -> Dict[str, np.ndarray]:
+    loaded = np.load(path if path.endswith(".npz") else path + ".npz")
+    return {k: loaded[k] for k in loaded.files}
+
+
+def restore_with_shardings(checkpoint_dir: str, tag: Optional[str],
+                           abstract_state: Any) -> Any:
+    """Universal-checkpoint load: restore into the NEW sharding layout
+    (different mesh / ZeRO stage / TP degree). ``abstract_state`` is a pytree
+    of jax.ShapeDtypeStruct with target shardings."""
+    path = _state_path(checkpoint_dir, tag)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, abstract_state)

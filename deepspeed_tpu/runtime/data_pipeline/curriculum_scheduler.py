@@ -1,0 +1,115 @@
+"""Curriculum learning scheduler.
+
+Reference ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``:
+difficulty (typically sequence length) ramps from ``min_difficulty`` to
+``max_difficulty`` under fixed_linear / fixed_root / fixed_discrete /
+custom schedules. Pure arithmetic — ports conceptually intact; the engine
+truncates each batch's sequence dim to the current difficulty (a static
+slice per difficulty value; XLA compiles one program per distinct seqlen,
+which the difficulty_step quantization keeps to a handful).
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        assert "curriculum_type" in config and "min_difficulty" in config \
+            and "max_difficulty" in config, \
+            "curriculum config needs curriculum_type/min_difficulty/max_difficulty"
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        self.state["schedule_type"] = config["curriculum_type"]
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+
+        stype = config["curriculum_type"]
+        if stype in (FIXED_LINEAR, FIXED_ROOT):
+            sc = config["schedule_config"]
+            assert "total_curriculum_step" in sc and "difficulty_step" in sc
+            self.state["schedule"] = dict(sc)
+            if stype == FIXED_ROOT:
+                assert "root_degree" in sc
+        elif stype == FIXED_DISCRETE:
+            sc = config["schedule_config"]
+            assert "difficulty" in sc and "max_step" in sc
+            assert len(sc["difficulty"]) == len(sc["max_step"]) + 1
+            self.state["schedule"] = dict(sc)
+        elif stype == CUSTOM:
+            self.state["schedule"] = {}
+        else:
+            raise ValueError(f"Unknown curriculum schedule {stype}")
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def _fixed_linear(self, global_steps: int) -> int:
+        sc = self.state["schedule"]
+        frac = min(1.0, global_steps / sc["total_curriculum_step"])
+        diff = self.state["min_difficulty"] + frac * (
+            self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = sc["difficulty_step"]
+        return min(self.state["max_difficulty"],
+                   int(diff / step) * step if diff >= step else step)
+
+    def _fixed_root(self, global_steps: int) -> int:
+        sc = self.state["schedule"]
+        frac = min(1.0, global_steps / sc["total_curriculum_step"])
+        power = 1.0 / sc["root_degree"]
+        diff = self.state["min_difficulty"] + (frac ** power) * (
+            self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = sc["difficulty_step"]
+        return min(self.state["max_difficulty"],
+                   int(diff / step) * step if diff >= step else step)
+
+    def _fixed_discrete(self, global_steps: int) -> int:
+        sc = self.state["schedule"]
+        for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+            if global_steps <= max_step:
+                return diff
+        return sc["difficulty"][-1]
+
+    def update_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == FIXED_LINEAR:
+            d = self._fixed_linear(global_steps)
+        elif stype == FIXED_ROOT:
+            d = self._fixed_root(global_steps)
+        elif stype == FIXED_DISCRETE:
+            d = self._fixed_discrete(global_steps)
+        else:
+            assert self.custom_get_difficulty is not None, \
+                "custom curriculum requires set_custom_get_difficulty"
+            d = self.custom_get_difficulty(global_steps)
+        self.state["current_difficulty"] = d
+        return d
+
+    def state_dict(self) -> Dict[str, Any]:
+        return dict(self.state)
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.state.update(sd)
+
+
+def truncate_to_difficulty(batch: Dict[str, Any], difficulty: int,
+                           seq_keys=("input_ids", "labels", "positions",
+                                     "attention_mask")):
+    """Apply curriculum seqlen: slice the sequence dim (reference
+    engine.py:1702-1705 truncates inputs at the curriculum seqlen)."""
+    out = {}
+    for k, v in batch.items():
+        if k in seq_keys and getattr(v, "ndim", 0) >= 2 and v.shape[-1] > difficulty:
+            out[k] = v[..., :difficulty]
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,64 @@
+"""Curriculum-aware data sampling.
+
+Reference ``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(``DeepSpeedDataSampler``): samples batches whose difficulty metric stays
+under the curriculum's current threshold, clustering the dataset by a
+difficulty metric. Indices are deterministic in (seed, epoch, step) so all
+hosts draw identical batches without communication — the property the
+reference gets by broadcasting from rank 0.
+"""
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+)
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, difficulties: Sequence[float], batch_size: int,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 seed: int = 0, drop_last: bool = True):
+        self.difficulties = np.asarray(difficulties)
+        self.batch_size = batch_size
+        self.curriculum = curriculum
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        # difficulty-sorted clusters (reference builds an indexed dataset per
+        # metric bucket)
+        self.order = np.argsort(self.difficulties, kind="stable")
+
+    def eligible_indices(self) -> np.ndarray:
+        if self.curriculum is None:
+            return self.order
+        threshold = self.curriculum.get_current_difficulty()
+        mask = self.difficulties[self.order] <= threshold
+        eligible = self.order[mask]
+        if len(eligible) < self.batch_size:
+            eligible = self.order[: self.batch_size]
+        return eligible
+
+    def __iter__(self) -> Iterator[List[int]]:
+        while True:
+            if self.curriculum is not None:
+                self.curriculum.update_difficulty(self.global_step)
+            eligible = self.eligible_indices()
+            rng = np.random.default_rng(self.seed + self.global_step)
+            idx = rng.choice(eligible, size=self.batch_size,
+                             replace=len(eligible) < self.batch_size)
+            self.global_step += 1
+            yield idx.tolist()
+
+    def state_dict(self) -> Dict:
+        state = {"global_step": self.global_step}
+        if self.curriculum is not None:
+            state["curriculum"] = self.curriculum.state_dict()
+        return state
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.global_step = sd["global_step"]
+        if self.curriculum is not None and "curriculum" in sd:
+            self.curriculum.load_state_dict(sd["curriculum"])

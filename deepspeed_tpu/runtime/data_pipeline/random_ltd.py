@@ -1,0 +1,88 @@
+"""Random layerwise token dropping (random-LTD).
+
+TPU-native analogue of reference ``runtime/data_pipeline/data_routing/``
+(``RandomLTDScheduler`` scheduler.py:38) + the CUDA kernels
+``csrc/random_ltd/{token_sort.cu,gather_scatter.cu}``: middle layers run on
+a random subset of tokens; kept-token count ramps up over training. The
+gather/scatter kernels become ``jnp.take_along_axis`` /
+``.at[].set`` (XLA lowers these to efficient dynamic-slice/DUS on TPU);
+random sampling uses a sorted random permutation so kept tokens stay in
+causal order (the reference's token_sort kernel).
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_kept_tokens(rng: jax.Array, seq_len: int, keep: int,
+                       batch_size: int) -> jnp.ndarray:
+    """[B, keep] sorted indices of kept tokens (causal order preserved)."""
+    def one(key):
+        perm = jax.random.permutation(key, seq_len)[:keep]
+        return jnp.sort(perm)
+
+    keys = jax.random.split(rng, batch_size)
+    return jax.vmap(one)(keys)
+
+
+def gather_tokens(x: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """[B, S, D], [B, K] -> [B, K, D] (csrc gather_scatter.cu:gather)."""
+    return jnp.take_along_axis(x, indices[..., None], axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, dropped: jnp.ndarray,
+                   indices: jnp.ndarray) -> jnp.ndarray:
+    """Write [B, K, D] back into [B, S, D] at indices (scatter kernel)."""
+    B = full.shape[0]
+    b_idx = jnp.arange(B)[:, None]
+    return full.at[b_idx, indices].set(dropped)
+
+
+def slice_attention_mask(mask: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """[1/B, 1, S, S] additive mask → sliced [B, 1, K, K]
+    (csrc slice_attn_masks.cu)."""
+    B = indices.shape[0]
+    mask = jnp.broadcast_to(mask, (B,) + mask.shape[1:])
+    rows = jnp.take_along_axis(mask, indices[:, None, :, None], axis=2)
+    return jnp.take_along_axis(rows, indices[:, None, None, :], axis=3)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference scheduler.py:38): linear ramp from
+    ``random_ltd_schedule.min_value`` tokens to the full sequence."""
+
+    def __init__(self, config: Dict[str, Any]):
+        ltd = config.get("random_ltd", config)
+        self.enabled = ltd.get("enabled", False)
+        self.total_layers = ltd.get("total_layer_num", 0)
+        self.ltd_layers = ltd.get("random_ltd_layer_num", 0)
+        self.layer_ids = ltd.get("random_ltd_layer_id", [])
+        sched = ltd.get("random_ltd_schedule", {})
+        self.min_value = sched.get("min_value", 128)
+        self.max_value = sched.get("max_value", 512)
+        sconf = sched.get("schedule_config", {})
+        self.total_steps = sconf.get("total_curriculum_step", 1000)
+        self.difficulty_step = sconf.get("difficulty_step", 8)
+        self.current_seq = self.min_value
+        self.global_steps = 0
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def update_seq(self, global_steps: int) -> int:
+        frac = min(1.0, global_steps / max(self.total_steps, 1))
+        v = self.min_value + frac * (self.max_value - self.min_value)
+        v = int(v / self.difficulty_step) * self.difficulty_step
+        self.current_seq = max(self.min_value, min(self.max_value, v))
+        self.global_steps = global_steps
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq,
+                "global_steps": self.global_steps}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd["current_seq"]
+        self.global_steps = sd["global_steps"]

@@ -1,0 +1,87 @@
+"""Data loaders (reference ``deepspeed/runtime/dataloader.py``:
+``DeepSpeedDataLoader`` :41, ``RepeatingLoader`` :17).
+
+TPU-shaped: a loader yields dicts of numpy/jax arrays with the global batch
+leading dim; the engine shards them onto the mesh (data/sequence axes). No
+pinned-memory machinery — host→device transfer is one async device_put of
+the already-assembled global batch.
+"""
+
+import math
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference :17)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batched loader over an indexable dataset.
+
+    dataset: sequence of per-sample dicts (or tuples) of arrays.
+    Collation stacks along a new leading dim to the global batch size
+    (micro_batch * dp world — the engine consumes global batches directly).
+    """
+
+    def __init__(self, dataset: Sequence, batch_size: int,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None,
+                 data_sampler: Optional[Iterator[Sequence[int]]] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or self._default_collate
+        self.data_sampler = data_sampler
+        self._epoch = 0
+        if drop_last:
+            self.len = len(dataset) // batch_size
+        else:
+            self.len = math.ceil(len(dataset) / batch_size)
+
+    @staticmethod
+    def _default_collate(samples):
+        first = samples[0]
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(s[k]) for s in samples])
+                    for k in first}
+        if isinstance(first, (tuple, list)):
+            return tuple(np.stack([np.asarray(s[i]) for s in samples])
+                         for i in range(len(first)))
+        return np.stack([np.asarray(s) for s in samples])
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        if self.data_sampler is not None:
+            for idx_batch in self.data_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idx_batch])
+            return
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng(self.seed + self._epoch).shuffle(order)
+        for b in range(self.len):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])

@@ -462,6 +462,19 @@ class DeepSpeedEngine:
         with self._ctx():
             return self._jit_loss(self.params, batch)
 
+    def consolidated_state_dict(self, dtype=None):
+        """Full (replicated) parameter pytree as numpy — the live analogue of
+        the reference's ``_zero3_consolidated_16bit_state_dict``
+        (engine.py:3230): gathers every ZeRO shard."""
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def gather(p):
+            arr = jax.device_put(p, rep)
+            out = np.asarray(arr)
+            return out.astype(dtype) if dtype is not None else out
+
+        return jax.tree_util.tree_map(gather, self.params)
+
     # --- checkpointing --------------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None, save_latest: bool = True):

@@ -1,0 +1,142 @@
+"""Elasticity, flops profiler, activation checkpointing, runtime utils
+(reference tests/unit/{elasticity,profiling,runtime}/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityIncompatibleWorldSize, compute_elastic_config, get_valid_gpus,
+)
+from deepspeed_tpu.profiling import FlopsProfiler, count_params, profile_model
+from deepspeed_tpu.runtime.activation_checkpointing import (
+    checkpoint, checkpoint_wrapper, get_cuda_rng_tracker,
+    model_parallel_cuda_manual_seed,
+)
+from deepspeed_tpu.runtime.utils import (
+    CheckOverflow, clip_grad_norm_, flatten_dense_tensors, global_norm,
+    partition_balanced, partition_uniform, see_memory_usage,
+)
+
+
+# --- elasticity -------------------------------------------------------------
+
+def test_valid_gpus():
+    gpus = get_valid_gpus(batch_size=24, micro_batches=[2, 3], min_valid_gpus=1,
+                          max_valid_gpus=24)
+    # 24/2=12 slots, 24/3=8 slots: divisors of 12 and 8 within range
+    assert 4 in gpus and 12 in gpus and 8 in gpus
+    assert 5 not in gpus
+
+
+def test_elastic_config_basic():
+    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                         "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                         "max_gpus": 16, "version": 0.1}}
+    batch, gpus = compute_elastic_config(ds)
+    assert batch <= 64
+    for g in gpus:
+        assert batch % g == 0 or any(batch % (m * g) == 0 for m in [2, 4])
+
+
+def test_elastic_config_world_size_check():
+    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                         "micro_batch_sizes": [2], "min_gpus": 1,
+                         "max_gpus": 8, "version": 0.1}}
+    batch, gpus, micro = compute_elastic_config(ds, world_size=4,
+                                                return_microbatch=True)
+    assert 4 in gpus and micro == 2
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds, world_size=7)
+
+
+# --- flops profiler ---------------------------------------------------------
+
+def test_flops_profiler_matmul():
+    a = jnp.ones((64, 64))
+    prof = FlopsProfiler()
+    stats = prof.profile(lambda x: x @ x, a, time_it=True, iters=2)
+    # 64^3 * 2 flops ± fusion noise
+    assert stats["flops"] >= 2 * 64 ** 3 * 0.5
+    assert stats["duration"] > 0
+    report = prof.print_model_profile()
+    assert "FLOPs" in report
+
+
+def test_profile_model_counts_params():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    assert count_params(params) > 100_000
+    stats = profile_model(model, params, ids, time_it=False)
+    assert stats["flops"] > 0
+
+
+# --- activation checkpointing ----------------------------------------------
+
+def test_checkpoint_matches_plain():
+    def f(x):
+        return jnp.tanh(x @ x.T).sum()
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((16, 16)),
+                    jnp.float32)
+    g_plain = jax.grad(f)(x)
+    g_ckpt = jax.grad(lambda x: checkpoint(f, x))(x)
+    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_ckpt), rtol=1e-6)
+
+    wrapped = checkpoint_wrapper(f, policy="dots_saveable")
+    np.testing.assert_allclose(np.asarray(jax.grad(wrapped)(x)),
+                               np.asarray(g_plain), rtol=1e-6)
+
+
+def test_rng_tracker_fork():
+    model_parallel_cuda_manual_seed(123)
+    tracker = get_cuda_rng_tracker()
+    with tracker.fork() as k1:
+        v1 = jax.random.normal(k1, (4,))
+    with tracker.fork() as k2:
+        v2 = jax.random.normal(k2, (4,))
+    assert not np.allclose(np.asarray(v1), np.asarray(v2))
+
+
+# --- runtime utils ----------------------------------------------------------
+
+def test_partition_uniform():
+    assert partition_uniform(10, 4) == [0, 3, 6, 8, 10]
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+
+
+def test_partition_balanced_weighted():
+    bounds = partition_balanced([5, 1, 1, 1], 2)
+    assert bounds == [0, 1, 4]
+    bounds = partition_balanced([1, 1, 1, 1, 100], 2)
+    assert bounds[-2:] == [4, 5]  # heavy item isolated
+
+
+def test_global_norm_and_clip():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    assert float(global_norm(tree)) == pytest.approx(5.0)
+    clipped, norm = clip_grad_norm_(tree, 1.0)
+    assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+
+
+def test_check_overflow():
+    good = {"a": jnp.ones(3)}
+    bad = {"a": jnp.asarray([1.0, jnp.nan])}
+    assert not CheckOverflow.has_overflow(good)
+    assert CheckOverflow.has_overflow(bad)
+
+
+def test_flatten_roundtrip():
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(2)}
+    flat, unravel = flatten_dense_tensors(tree)
+    back = unravel(flat)
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(tree["w"]))
+
+
+def test_see_memory_usage_runs(capsys):
+    see_memory_usage("test", force=True)  # must not raise

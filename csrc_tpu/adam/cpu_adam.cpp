@@ -1,0 +1,57 @@
+// Host Adam/AdamW over flat float buffers — analogue of the reference's
+// AVX-vectorized csrc/adam/cpu_adam.cpp used by ZeRO-Offload. Written as
+// simple strided loops that g++ -O3 -march=native auto-vectorizes (the
+// image's GCC emits AVX2/AVX-512 where available), parallelized over
+// shards by the caller's thread pool (ops/aio.py reuses its workers).
+//
+// Build: g++ -O3 -march=native -shared -fPIC cpu_adam.cpp -o libdstpu_adam.so
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// One fused Adam(W) step over a contiguous fp32 shard.
+//   params/grads/exp_avg/exp_avg_sq: length n
+//   step: 1-based step count (for bias correction)
+//   adamw_mode: 1 → decoupled weight decay (AdamW), 0 → L2 into grads
+void dstpu_cpu_adam_step(float* params, const float* grads, float* exp_avg,
+                         float* exp_avg_sq, long long n, int step, float lr,
+                         float beta1, float beta2, float eps,
+                         float weight_decay, int adamw_mode) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = std::sqrt(bc2);
+  const float om_beta1 = 1.0f - beta1;
+  const float om_beta2 = 1.0f - beta2;
+
+  if (adamw_mode && weight_decay > 0.0f) {
+    const float decay = 1.0f - lr * weight_decay;
+    for (long long i = 0; i < n; ++i) params[i] *= decay;
+  }
+
+#pragma GCC ivdep
+  for (long long i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (!adamw_mode && weight_decay > 0.0f) g += weight_decay * params[i];
+    float m = exp_avg[i] = beta1 * exp_avg[i] + om_beta1 * g;
+    float v = exp_avg_sq[i] = beta2 * exp_avg_sq[i] + om_beta2 * g * g;
+    params[i] -= step_size * m / (std::sqrt(v) / bc2_sqrt + eps);
+  }
+}
+
+// Adagrad variant (reference csrc/adagrad/cpu_adagrad.cpp).
+void dstpu_cpu_adagrad_step(float* params, const float* grads, float* sq_sum,
+                            long long n, float lr, float eps,
+                            float weight_decay) {
+#pragma GCC ivdep
+  for (long long i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (weight_decay > 0.0f) g += weight_decay * params[i];
+    sq_sum[i] += g * g;
+    params[i] -= lr * g / (std::sqrt(sq_sum[i]) + eps);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,179 @@
+// Async block I/O thread pool — TPU-host analogue of the reference's
+// libaio-based csrc/aio (deepspeed_py_aio_handle.cpp): a submission queue of
+// pread/pwrite requests served by worker threads, used by the tensor-swap
+// layer (ZeRO-Infinity NVMe offload) to overlap disk traffic with device
+// compute. Plain C API for ctypes binding (no pybind11 in this image).
+//
+// Build: g++ -O3 -shared -fPIC -pthread aio.cpp -o libdstpu_aio.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int64_t id;
+  bool is_write;
+  std::string path;
+  void* buffer;
+  size_t nbytes;
+  size_t offset;
+};
+
+struct Completion {
+  int64_t id;
+  int64_t result;  // bytes moved, or -errno
+};
+
+class AioHandle {
+ public:
+  AioHandle(int block_size, int queue_depth, int thread_count)
+      : block_size_(block_size <= 0 ? (1 << 20) : block_size),
+        queue_depth_(queue_depth <= 0 ? 8 : queue_depth),
+        stop_(false),
+        next_id_(1),
+        inflight_(0) {
+    int n = thread_count <= 0 ? 1 : thread_count;
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] { this->worker_loop(); });
+    }
+  }
+
+  ~AioHandle() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int64_t submit(bool is_write, const char* path, void* buffer, size_t nbytes,
+                 size_t offset) {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_id_++;
+    queue_.push_back(Request{id, is_write, path, buffer, nbytes, offset});
+    ++inflight_;
+    cv_.notify_one();
+    return id;
+  }
+
+  // Blocks until every submitted request completes; returns number of
+  // failures (0 == clean).
+  int64_t wait_all() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return inflight_ == 0; });
+    int64_t failures = 0;
+    for (const auto& c : completions_) {
+      if (c.result < 0) ++failures;
+    }
+    completions_.clear();
+    return failures;
+  }
+
+  int64_t pending() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return inflight_;
+  }
+
+ private:
+  void worker_loop() {
+    for (;;) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        req = queue_.front();
+        queue_.pop_front();
+      }
+      int64_t result = execute(req);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        completions_.push_back(Completion{req.id, result});
+        --inflight_;
+        if (inflight_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  int64_t execute(const Request& req) {
+    int flags = req.is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+    int fd = ::open(req.path.c_str(), flags, 0644);
+    if (fd < 0) return -1;
+    size_t moved = 0;
+    const size_t chunk = static_cast<size_t>(block_size_);
+    char* buf = static_cast<char*>(req.buffer);
+    while (moved < req.nbytes) {
+      size_t len = std::min(chunk, req.nbytes - moved);
+      ssize_t rc =
+          req.is_write
+              ? ::pwrite(fd, buf + moved, len, req.offset + moved)
+              : ::pread(fd, buf + moved, len, req.offset + moved);
+      if (rc < 0) {
+        ::close(fd);
+        return -1;
+      }
+      if (rc == 0) break;  // EOF on read
+      moved += static_cast<size_t>(rc);
+    }
+    ::close(fd);
+    return static_cast<int64_t>(moved);
+  }
+
+  int block_size_;
+  int queue_depth_;
+  bool stop_;
+  int64_t next_id_;
+  int64_t inflight_;
+  std::deque<Request> queue_;
+  std::vector<Completion> completions_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dstpu_aio_create(int block_size, int queue_depth, int thread_count) {
+  return new AioHandle(block_size, queue_depth, thread_count);
+}
+
+void dstpu_aio_destroy(void* handle) {
+  delete static_cast<AioHandle*>(handle);
+}
+
+long long dstpu_aio_pwrite(void* handle, const char* path, void* buffer,
+                           long long nbytes, long long offset) {
+  return static_cast<AioHandle*>(handle)->submit(true, path, buffer,
+                                                 (size_t)nbytes, (size_t)offset);
+}
+
+long long dstpu_aio_pread(void* handle, const char* path, void* buffer,
+                          long long nbytes, long long offset) {
+  return static_cast<AioHandle*>(handle)->submit(false, path, buffer,
+                                                 (size_t)nbytes, (size_t)offset);
+}
+
+long long dstpu_aio_wait(void* handle) {
+  return static_cast<AioHandle*>(handle)->wait_all();
+}
+
+long long dstpu_aio_pending(void* handle) {
+  return static_cast<AioHandle*>(handle)->pending();
+}
+
+}  // extern "C"

@@ -1,0 +1,139 @@
+"""ctypes bindings for the native C++ components (csrc_tpu/).
+
+Replaces the reference's pybind11 extensions + JIT nvcc op builders: the
+shared libraries build once with g++ on first use (cached beside the
+sources), and load through ctypes — no torch cpp_extension machinery.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc_tpu")
+_BUILD_LOCK = threading.Lock()
+
+
+def _build(src_rel: str, out_name: str, extra_flags=()) -> str:
+    src = os.path.join(_CSRC, src_rel)
+    out = os.path.join(os.path.dirname(src), out_name)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    with _BUILD_LOCK:
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", *extra_flags,
+               src, "-o", out]
+        logger.info(f"building native lib: {' '.join(cmd)}")
+        subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
+# --- AIO --------------------------------------------------------------------
+
+class AsyncIOHandle:
+    """Async file I/O handle (reference csrc/aio aio_handle): submit
+    pread/pwrite of numpy buffers, overlap with compute, wait_all."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 thread_count: int = 4):
+        lib_path = _build("aio/aio.cpp", "libdstpu_aio.so")
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.dstpu_aio_create.restype = ctypes.c_void_p
+        self._lib.dstpu_aio_create.argtypes = [ctypes.c_int] * 3
+        self._lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
+        for fn in (self._lib.dstpu_aio_pwrite, self._lib.dstpu_aio_pread):
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_longlong, ctypes.c_longlong]
+        self._lib.dstpu_aio_wait.restype = ctypes.c_longlong
+        self._lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p]
+        self._lib.dstpu_aio_pending.restype = ctypes.c_longlong
+        self._lib.dstpu_aio_pending.argtypes = [ctypes.c_void_p]
+        self._handle = self._lib.dstpu_aio_create(block_size, queue_depth,
+                                                  thread_count)
+        # keep buffers alive until wait() — the C++ side reads them directly
+        self._live_buffers = []
+
+    def pwrite(self, path: str, array: np.ndarray, offset: int = 0) -> int:
+        arr = np.ascontiguousarray(array)
+        self._live_buffers.append(arr)
+        return self._lib.dstpu_aio_pwrite(
+            self._handle, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, offset)
+
+    def pread(self, path: str, array: np.ndarray, offset: int = 0) -> int:
+        assert array.flags["C_CONTIGUOUS"], "pread target must be contiguous"
+        self._live_buffers.append(array)
+        return self._lib.dstpu_aio_pread(
+            self._handle, path.encode(), array.ctypes.data_as(ctypes.c_void_p),
+            array.nbytes, offset)
+
+    def wait(self) -> int:
+        failures = self._lib.dstpu_aio_wait(self._handle)
+        self._live_buffers.clear()
+        return int(failures)
+
+    def pending(self) -> int:
+        return int(self._lib.dstpu_aio_pending(self._handle))
+
+    def close(self):
+        if self._handle:
+            self._lib.dstpu_aio_wait(self._handle)
+            self._lib.dstpu_aio_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --- CPU Adam ---------------------------------------------------------------
+
+class DeepSpeedCPUAdam:
+    """Host fused Adam over flat fp32 shards (reference
+    ops/adam/cpu_adam.py DeepSpeedCPUAdam). State lives in numpy; used for
+    host-offloaded optimizer partitions."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True):
+        lib_path = _build("adam/cpu_adam.cpp", "libdstpu_adam.so",
+                          extra_flags=("-march=native",))
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.dstpu_cpu_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+
+    def init_state(self, n: int):
+        return np.zeros(n, np.float32), np.zeros(n, np.float32)
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+             step: Optional[int] = None) -> None:
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        grads32 = np.ascontiguousarray(grads, np.float32)
+        self._lib.dstpu_cpu_adam_step(
+            params.ctypes.data_as(ctypes.c_void_p),
+            grads32.ctypes.data_as(ctypes.c_void_p),
+            exp_avg.ctypes.data_as(ctypes.c_void_p),
+            exp_avg_sq.ctypes.data_as(ctypes.c_void_p),
+            params.size, step, self.lr, self.betas[0], self.betas[1],
+            self.eps, self.weight_decay, 1 if self.adamw_mode else 0)

@@ -38,7 +38,9 @@ def initialize(model=None,
                model_parameters=None,
                training_data=None,
                dist_init_required=None,
-               config_params=None):
+               config_params=None,
+               model_config=None,
+               lora_adapters=None):
     """Create a training engine (reference ``deepspeed.initialize``).
 
     Returns the engine. (The reference returns a 4-tuple
@@ -50,11 +52,22 @@ def initialize(model=None,
         config = config_params
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
-    engine = DeepSpeedEngine(
-        model=model, config=config, loss_fn=loss_fn, params=params, mesh=mesh,
-        sharding_rules=sharding_rules, lr_scheduler=lr_scheduler,
-        sample_batch=sample_batch)
-    return engine
+
+    # engine dispatch (reference deepspeed/__init__.py:150-190): hybrid
+    # engine when hybrid_engine.enabled, else the core engine (the pipeline
+    # engine is the core engine — PP is a mesh axis, not a subclass)
+    resolved = config if isinstance(config, DeepSpeedConfig) \
+        else DeepSpeedConfig(config or {},
+                             world_size=mesh.size if mesh is not None else None)
+    common = dict(model=model, config=resolved, loss_fn=loss_fn, params=params,
+                  mesh=mesh, sharding_rules=sharding_rules,
+                  lr_scheduler=lr_scheduler, sample_batch=sample_batch)
+    if resolved.hybrid_engine.enabled:
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        return DeepSpeedHybridEngine(model_config=model_config,
+                                     lora_adapters=lora_adapters, **common)
+    return DeepSpeedEngine(**common)
 
 
 def initialize_legacy(*posargs, **kwargs):

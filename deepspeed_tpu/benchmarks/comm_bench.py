@@ -1,0 +1,81 @@
+"""Collective benchmark (reference ``bin/ds_bench`` → communication suite):
+times all_reduce / all_gather / reduce_scatter / all_to_all over the data
+axis across message sizes and reports algorithmic bandwidth."""
+
+import argparse
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+
+def _bench_op(mesh, op_name: str, nbytes: int, trials: int = 5) -> Dict:
+    n = max(1, nbytes // 4)
+    world = mesh.shape["data"]
+    n = (n // world) * world or world
+    x = jnp.ones((n,), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("data")))
+
+    ops = {
+        "all_reduce": (lambda t: dist.all_reduce(t, group="data"), P("data"), P("data")),
+        "all_gather": (lambda t: dist.all_gather(t, group="data"), P("data"), P("data", None)),
+        "reduce_scatter": (lambda t: dist.reduce_scatter(t, group="data"), P("data"), P("data")),
+        "all_to_all": (lambda t: dist.all_to_all_single(t, group="data"), P("data"), P("data")),
+    }
+    fn, in_spec, out_spec = ops[op_name]
+    jitted = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                                   out_specs=out_spec))
+    jitted(x).block_until_ready()  # compile
+    t0 = time.time()
+    out = None
+    for _ in range(trials):
+        out = jitted(x)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / trials
+    # algorithmic bandwidth: bytes moved per rank per second
+    algbw = nbytes / dt if dt > 0 else 0.0
+    # bus bandwidth correction factors (ring algorithms)
+    factor = {"all_reduce": 2 * (world - 1) / world,
+              "all_gather": (world - 1) / world,
+              "reduce_scatter": (world - 1) / world,
+              "all_to_all": (world - 1) / world}[op_name]
+    return {"op": op_name, "bytes": nbytes, "latency_ms": dt * 1e3,
+            "algbw_GBps": algbw / 1e9, "busbw_GBps": algbw * factor / 1e9}
+
+
+def run(sizes: List[int] = None, ops: List[str] = None, mesh=None,
+        trials: int = 5) -> List[Dict]:
+    if mesh is None:
+        n = jax.device_count()
+        mesh = make_mesh(dims={"pipe": 1, "data": n, "expert": 1,
+                               "sequence": 1, "tensor": 1})
+    sizes = sizes or [1 << 16, 1 << 20, 1 << 24]
+    ops = ops or ["all_reduce", "all_gather", "reduce_scatter", "all_to_all"]
+    results = []
+    for op in ops:
+        for size in sizes:
+            r = _bench_op(mesh, op, size, trials)
+            results.append(r)
+            print(f"{r['op']:<16}{r['bytes']:>12}B  {r['latency_ms']:8.3f} ms  "
+                  f"algbw {r['algbw_GBps']:8.3f} GB/s  busbw {r['busbw_GBps']:8.3f} GB/s")
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser(description="ICI collective benchmark")
+    parser.add_argument("--sizes", type=int, nargs="*", default=None)
+    parser.add_argument("--ops", type=str, nargs="*", default=None)
+    parser.add_argument("--trials", type=int, default=5)
+    args = parser.parse_args()
+    run(sizes=args.sizes, ops=args.ops, trials=args.trials)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

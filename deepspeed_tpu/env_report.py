@@ -1,0 +1,60 @@
+"""Environment/compatibility report (reference ``deepspeed/env_report.py``,
+the ``ds_report`` CLI): versions, devices, op-registry availability."""
+
+import importlib
+import sys
+
+
+def _version(mod_name: str) -> str:
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return "NOT INSTALLED"
+
+
+def op_report() -> str:
+    from deepspeed_tpu.ops.registry import all_op_builders
+
+    lines = ["-" * 60, "op name " + " " * 24 + "compatible", "-" * 60]
+    for name, cls in sorted(all_op_builders().items()):
+        try:
+            ok = "[OKAY]" if cls().is_compatible() else "[NO]"
+        except Exception:
+            ok = "[ERROR]"
+        lines.append(f"{name:<32}{ok}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    import deepspeed_tpu
+
+    lines = [
+        "-" * 60,
+        "DeepSpeed-TPU C++/JAX op report",
+        "-" * 60,
+        op_report(),
+        "-" * 60,
+        "DeepSpeed-TPU general environment info:",
+        f"deepspeed_tpu version .... {deepspeed_tpu.__version__}",
+        f"python ................... {sys.version.split()[0]}",
+        f"jax ...................... {_version('jax')}",
+        f"flax ..................... {_version('flax')}",
+        f"optax .................... {_version('optax')}",
+        f"orbax-checkpoint ......... {_version('orbax.checkpoint')}",
+        f"numpy .................... {_version('numpy')}",
+    ]
+    try:
+        import jax
+
+        lines.append(f"backend .................. {jax.default_backend()}")
+        lines.append(f"devices .................. {jax.devices()}")
+        lines.append(f"process count ............ {jax.process_count()}")
+    except Exception as e:
+        lines.append(f"jax device query failed: {e}")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

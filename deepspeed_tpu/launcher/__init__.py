@@ -1,0 +1,5 @@
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile,
+    main,
+    parse_inclusion_exclusion,
+)

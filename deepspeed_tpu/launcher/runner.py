@@ -1,0 +1,218 @@
+"""`dst` launcher CLI.
+
+TPU-native analogue of reference ``deepspeed/launcher/runner.py:377``: parses
+a hostfile (``host slots=N``), applies ``--include/--exclude`` filters, and
+launches the training script. Differences driven by the platform:
+
+- one process per HOST (JAX drives all local chips from one process), not
+  one per chip — so "slots" counts chips for bookkeeping but process count
+  equals host count;
+- rendezvous env is the JAX coordinator (``DS_TPU_COORDINATOR`` +
+  process_id/num_processes) instead of MASTER_ADDR/RANK per GPU;
+- multi-node transport is plain ssh fan-out (pdsh-style) — TPU pods also
+  commonly launch via GKE/gcloud, for which this module only needs to emit
+  the env block (``--print_env``).
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "JAX_PLATFORMS",
+               "XLA_FLAGS", "TPU_NAME"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="dst launcher — run a deepspeed_tpu training script on "
+                    "one or many TPU hosts")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: one 'hostname slots=N' per line")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="e.g. host1@host2:0,2 — hosts (and chips) to include")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="hosts/chips to exclude (mutually exclusive with -i)")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus",
+                        type=int, default=-1)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "local", "print"],
+                        help="ssh fan-out, local single-host, or print the "
+                             "per-host commands without running")
+    parser.add_argument("--print_env", action="store_true",
+                        help="print the env block each host receives")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--save_pid", action="store_true")
+    parser.add_argument("user_script", type=str, help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path: str) -> "OrderedDict[str, int]":
+    """Parse 'hostname slots=N' lines (reference runner.py:189)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    if not os.path.isfile(hostfile_path):
+        return resources
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                host, slots = line.split()
+                key, count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(key)
+                resources[host] = int(count)
+            except ValueError:
+                raise ValueError(f"Hostfile syntax error: {line!r} "
+                                 "(expected 'hostname slots=N')")
+    return resources
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'h1@h2:0,2' -> {h1: None, h2: [0, 2]} (None = all slots)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in spec.split("@"):
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = sorted(int(s) for s in slots.split(","))
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resources: Dict[str, int], include: str,
+                              exclude: str) -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude (reference runner.py:244)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full = OrderedDict((h, list(range(n))) for h, n in resources.items())
+    if include:
+        spec = _parse_filter(include)
+        out = OrderedDict()
+        for host, slots in spec.items():
+            if host not in full:
+                raise ValueError(f"include host {host} not in hostfile")
+            chosen = slots if slots is not None else full[host]
+            bad = set(chosen) - set(full[host])
+            if bad:
+                raise ValueError(f"include slots {sorted(bad)} out of range for {host}")
+            out[host] = chosen
+        return out
+    if exclude:
+        spec = _parse_filter(exclude)
+        out = OrderedDict()
+        for host, slots in full.items():
+            if host in spec:
+                if spec[host] is None:
+                    continue
+                keep = [s for s in slots if s not in spec[host]]
+                if keep:
+                    out[host] = keep
+            else:
+                out[host] = slots
+        return out
+    return full
+
+
+def build_host_env(host_index: int, num_hosts: int, coordinator: str,
+                   extra_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = {
+        "DS_TPU_COORDINATOR": coordinator,
+        "DS_TPU_NUM_PROCESSES": str(num_hosts),
+        "DS_TPU_PROCESS_ID": str(host_index),
+    }
+    for name in EXPORT_ENVS:
+        if name in os.environ:
+            env[name] = os.environ[name]
+    if os.path.isfile(DEEPSPEED_ENVIRONMENT_NAME):
+        with open(DEEPSPEED_ENVIRONMENT_NAME) as f:
+            for line in f:
+                if "=" in line:
+                    k, v = line.strip().split("=", 1)
+                    env[k] = v
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def build_commands(args, active: "OrderedDict[str, List[int]]"
+                   ) -> List[Tuple[str, List[str], Dict[str, str]]]:
+    hosts = list(active.keys())
+    coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
+    cmds = []
+    for idx, host in enumerate(hosts):
+        env = build_host_env(idx, len(hosts), coordinator)
+        payload = [sys.executable, args.user_script] + list(args.user_args)
+        if args.launcher == "ssh" and (len(hosts) > 1 or args.force_multi):
+            env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+            remote = f"cd {shlex.quote(os.getcwd())} && {env_str} " + \
+                " ".join(shlex.quote(p) for p in payload)
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+        else:
+            cmd = payload
+        cmds.append((host, cmd, env))
+    return cmds
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        # single-node fallback (reference: localhost with all visible chips)
+        n = args.num_gpus if args.num_gpus > 0 else 0
+        resources = OrderedDict([("localhost", n or 8)])
+    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[: args.num_nodes])
+    if not active:
+        raise ValueError("no hosts remain after include/exclude filtering")
+
+    cmds = build_commands(args, active)
+    if args.print_env or args.launcher == "print":
+        for host, cmd, env in cmds:
+            print(f"# {host}")
+            for k, v in env.items():
+                print(f"export {k}={v}")
+            print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+
+    procs = []
+    for host, cmd, env in cmds:
+        full_env = dict(os.environ)
+        full_env.update(env)
+        logger.info(f"launching on {host}: {' '.join(cmd[:4])}...")
+        procs.append(subprocess.Popen(cmd, env=full_env))
+
+    def _terminate(signum, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""LoRA adapters as pure pytree transforms.
+
+Supports the hybrid engine's fuse/unfuse cycle (reference
+``runtime/hybrid_engine.py:130-164`` ``fuse_lora_weight``/
+``unfuse_lora_weight`` and the hybrid-engine LoRA container feature):
+adapters live as a separate pytree {path: LoRAWeight(A, B, scaling)};
+``fuse`` adds scaling·A@B into the base kernels for fast inference, and
+``unfuse`` subtracts it back before training resumes. Pure functions of
+pytrees — no module surgery.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.partition import path_str
+
+
+class LoRAWeight(NamedTuple):
+    A: jnp.ndarray        # [in, r]
+    B: jnp.ndarray        # [r, out]
+    scaling: float
+
+
+def init_lora(params: Any, rank: int, alpha: float = 1.0,
+              match: Tuple[str, ...] = ("q_proj", "v_proj"),
+              rng: Optional[jax.Array] = None) -> Dict[str, LoRAWeight]:
+    """Create zero-initialized-B adapters for kernels whose path matches."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    adapters: Dict[str, LoRAWeight] = {}
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        p = path_str(path)
+        if not p.endswith("kernel") or getattr(leaf, "ndim", 0) < 2:
+            continue
+        if not any(m in p for m in match):
+            continue
+        rng, key = jax.random.split(rng)
+        in_dim, out_dim = leaf.shape[-2], leaf.shape[-1]
+        lead = leaf.shape[:-2]
+        A = jax.random.normal(key, lead + (in_dim, rank),
+                              jnp.float32) / jnp.sqrt(in_dim)
+        B = jnp.zeros(lead + (rank, out_dim), jnp.float32)
+        adapters[p] = LoRAWeight(A=A, B=B, scaling=alpha / rank)
+    return adapters
+
+
+def _apply_delta(params: Any, adapters: Dict[str, LoRAWeight], sign: float) -> Any:
+    def visit(path, leaf):
+        p = path_str(path)
+        if p in adapters:
+            ad = adapters[p]
+            delta = jnp.einsum("...ir,...ro->...io", ad.A, ad.B) * ad.scaling
+            return (leaf.astype(jnp.float32) + sign * delta).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def fuse_lora(params: Any, adapters: Dict[str, LoRAWeight]) -> Any:
+    """W ← W + s·A@B (reference fuse_lora_weight)."""
+    return _apply_delta(params, adapters, +1.0)
+
+
+def unfuse_lora(params: Any, adapters: Dict[str, LoRAWeight]) -> Any:
+    """W ← W − s·A@B (reference unfuse_lora_weight)."""
+    return _apply_delta(params, adapters, -1.0)
+
+
+def lora_forward_delta(x: jnp.ndarray, adapter: LoRAWeight) -> jnp.ndarray:
+    """Unfused-path contribution: x @ A @ B * s (training-time LoRA)."""
+    return (x @ adapter.A @ adapter.B) * adapter.scaling

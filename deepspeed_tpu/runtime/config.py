@@ -168,6 +168,18 @@ class MoEConfig(DeepSpeedConfigModel):
     moe_param_group: bool = False
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """`"hybrid_engine"` (reference deepspeed/runtime/config.py hybrid engine
+    section): RLHF actor train<->generate flip."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
@@ -271,6 +283,7 @@ class DeepSpeedConfig:
         self.pipeline = PipelineConfig(**p.get("pipeline", {}))
         self.moe = MoEConfig(**p.get("moe", {}))
         self.checkpoint_config = CheckpointConfig(**p.get("checkpoint", {}))
+        self.hybrid_engine = HybridEngineConfig(**p.get("hybrid_engine", {}))
         self.data_types = DataTypeConfig(**p.get("data_types", {}))
         self.aio = AIOConfig(**p.get("aio", {}))
         self.elasticity = ElasticityConfig(**p.get("elasticity", {}))

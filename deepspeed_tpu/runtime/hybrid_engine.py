@@ -1,0 +1,135 @@
+"""Hybrid engine — one set of weights for RLHF train + generate.
+
+TPU-native analogue of reference ``runtime/hybrid_engine.py:32``
+(``DeepSpeedHybridEngine``): the actor model trains under ZeRO and flips to
+an inference path for rollout generation. The reference gathers ZeRO-3
+params into injected CUDA containers and fuses LoRA (:178-282); here the
+flip is free of weight copies — ``generate`` jits the decode program against
+the *same* sharded param pytree the train step owns (XLA inserts the
+gathers), with optional LoRA fuse/unfuse around generation and a retained
+KV workspace between rollouts (the reference's ``retake_inference_cache``).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import LlamaDecoderModel, init_kv_caches
+from deepspeed_tpu.ops.lora import fuse_lora, unfuse_lora
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.timer import Timer
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    def __init__(self, *args, model_config=None, lora_adapters=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.model_cfg = model_config or getattr(self.module, "cfg", None)
+        self.lora_adapters = lora_adapters
+        self._lora_fused = False
+        self._decode_fn = None
+        self._kv_caches = None
+        self._in_eval = False
+        self.generate_time = 0.0
+        self.latency_timer = Timer("generate")
+
+    # --- train/eval flips (reference :386-434) ----------------------------
+    def eval(self):
+        """Enter generation mode: fuse LoRA into the base weights."""
+        if self.lora_adapters and not self._lora_fused:
+            self.params = jax.jit(
+                lambda p: fuse_lora(p, self.lora_adapters),
+                donate_argnums=(0,))(self.params)
+            self._lora_fused = True
+        self._in_eval = True
+
+    def train(self, mode: bool = True):
+        """Return to training: unfuse LoRA so adapter grads stay separate."""
+        if not mode:
+            return self.eval()
+        if self.lora_adapters and self._lora_fused:
+            self.params = jax.jit(
+                lambda p: unfuse_lora(p, self.lora_adapters),
+                donate_argnums=(0,))(self.params)
+            self._lora_fused = False
+        self._in_eval = False
+
+    # --- KV workspace mgmt (reference :165-177) ---------------------------
+    def _ensure_decode(self, batch_size: int, max_len: int):
+        assert self.model_cfg is not None, \
+            "hybrid engine generate() needs model_config (LlamaConfig)"
+        if self._kv_caches is not None and \
+                self._kv_caches[0].shape[1] == batch_size and \
+                self._kv_caches[0].shape[2] >= max_len:
+            return
+        decoder = LlamaDecoderModel(self.model_cfg)
+        self._kv_caches = init_kv_caches(self.model_cfg, batch_size, max_len,
+                                         self.compute_dtype)
+        self._decode_fn = jax.jit(
+            lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
+            donate_argnums=(2,))
+
+    def retake_inference_cache(self):
+        pass  # workspace persists as self._kv_caches; nothing to re-allocate
+
+    def release_inference_cache(self):
+        self._kv_caches = None
+        self._decode_fn = None
+
+    def reset_inference_cache(self):
+        if self._kv_caches is not None:
+            self._kv_caches = jax.tree_util.tree_map(jnp.zeros_like,
+                                                     self._kv_caches)
+
+    # --- generation (reference :178-282) ----------------------------------
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 rng: Optional[jax.Array] = None,
+                 eos_token_id: Optional[int] = None):
+        was_training = not self._in_eval
+        if was_training:
+            self.eval()
+        self.latency_timer.start()
+
+        input_ids = jnp.asarray(input_ids)
+        B, T = input_ids.shape
+        self._ensure_decode(B, T + max_new_tokens)
+        if rng is None:
+            rng = jax.random.PRNGKey(self.global_steps)
+
+        with self._ctx():
+            logits, caches = self._decode_fn(
+                self.params, input_ids, self._kv_caches,
+                jnp.asarray(0, jnp.int32))
+        next_logits = logits[:, -1, :]
+        out = [input_ids]
+        finished = jnp.zeros((B,), bool)
+        for i in range(max_new_tokens):
+            if temperature > 0.0:
+                rng, key = jax.random.split(rng)
+                scaled = next_logits / temperature
+                if top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                nxt = jax.random.categorical(key, scaled, axis=-1)
+            else:
+                nxt = jnp.argmax(next_logits, axis=-1)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            out.append(nxt[:, None])
+            if i == max_new_tokens - 1:
+                break
+            with self._ctx():
+                logits, caches = self._decode_fn(
+                    self.params, nxt[:, None], caches,
+                    jnp.asarray(T + i, jnp.int32))
+            next_logits = logits[:, 0, :]
+        self._kv_caches = caches
+
+        self.latency_timer.stop(synchronize=True)
+        self.generate_time = self.latency_timer.elapsed(reset=True)
+        if was_training:
+            self.train()
+        return jnp.concatenate(out, axis=1)

@@ -1,0 +1,96 @@
+"""Launcher tests (reference tests/unit/launcher/test_run.py pattern)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    build_commands, build_host_env, fetch_hostfile, main,
+    parse_args, parse_inclusion_exclusion,
+)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, "worker-0 slots=4\nworker-1 slots=4\n# c\n\n")
+    res = fetch_hostfile(path)
+    assert res == {"worker-0": 4, "worker-1": 4}
+
+
+def test_fetch_hostfile_bad_syntax(tmp_path):
+    path = _hostfile(tmp_path, "worker-0 slotz=4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_missing_hostfile_empty():
+    assert fetch_hostfile("/does/not/exist") == {}
+
+
+def test_include_filter():
+    res = {"h0": 4, "h1": 4, "h2": 4}
+    active = parse_inclusion_exclusion(res, "h0@h2:0,2", "")
+    assert active == {"h0": [0, 1, 2, 3], "h2": [0, 2]}
+
+
+def test_exclude_filter():
+    res = {"h0": 2, "h1": 2}
+    active = parse_inclusion_exclusion(res, "", "h1")
+    assert active == {"h0": [0, 1]}
+    active = parse_inclusion_exclusion(res, "", "h0:1")
+    assert active == {"h0": [0], "h1": [0, 1]}
+
+
+def test_include_exclude_conflict():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"h0": 1}, "h0", "h0")
+
+
+def test_include_unknown_host():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"h0": 1}, "nope", "")
+
+
+def test_build_host_env():
+    env = build_host_env(1, 4, "leader:29500")
+    assert env["DS_TPU_PROCESS_ID"] == "1"
+    assert env["DS_TPU_NUM_PROCESSES"] == "4"
+    assert env["DS_TPU_COORDINATOR"] == "leader:29500"
+
+
+def test_build_commands_multi_node(tmp_path):
+    path = _hostfile(tmp_path, "h0 slots=4\nh1 slots=4\n")
+    args = parse_args(["-H", path, "--launcher", "ssh", "train.py", "--foo"])
+    res = fetch_hostfile(path)
+    active = parse_inclusion_exclusion(res, "", "")
+    cmds = build_commands(args, active)
+    assert len(cmds) == 2
+    host, cmd, env = cmds[1]
+    assert host == "h1" and cmd[0] == "ssh"
+    assert "DS_TPU_PROCESS_ID=1" in cmd[-1]
+    assert "train.py" in cmd[-1]
+
+
+def test_launcher_print_mode(tmp_path, capsys):
+    path = _hostfile(tmp_path, "h0 slots=8\n")
+    rc = main(["-H", path, "--launcher", "print", "train.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DS_TPU_COORDINATOR" in out and "train.py" in out
+
+
+def test_launcher_local_runs_script(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "assert os.environ['DS_TPU_NUM_PROCESSES'] == '1'\n"
+        "print('probe-ok')\n")
+    rc = main(["-H", "/nonexistent", "--launcher", "local", str(script)])
+    assert rc == 0

@@ -67,6 +67,16 @@ def initialize(model=None,
 
         return DeepSpeedHybridEngine(model_config=model_config,
                                      lora_adapters=lora_adapters, **common)
+    if resolved.mesh.pipe > 1 and loss_fn is None:
+        # pipe axis requested → pipeline engine (analogue of the reference's
+        # PipelineModule dispatch, deepspeed/__init__.py:150-190)
+        from deepspeed_tpu.parallel.mesh import make_mesh as _mk
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        if common["mesh"] is None:
+            common["mesh"] = _mk(resolved.mesh)
+        common.pop("loss_fn")
+        return PipelineEngine(model_config=model_config, **common)
     return DeepSpeedEngine(**common)
 
 

@@ -1,0 +1,148 @@
+"""PipelineEngine — pipeline-parallel training.
+
+TPU-native analogue of reference ``runtime/pipe/engine.py:42``
+(``PipelineEngine``) + ``pipe/module.py`` (``PipelineModule``): instead of a
+subclassed engine interpreting instruction streams over p2p sockets, the
+pipeline is a *loss function*: inside one ``shard_map`` over the
+``(pipe, data)`` mesh axes, the scan-stacked transformer blocks (leading
+layer dim sharded over ``pipe``) run through the collective-permute pipeline
+(pipe/spmd.py), embedding/head/loss compute replicated per stage, and
+``jax.grad`` differentiates straight through — the backward 1F1B emerges
+from the transpose of the forward schedule. The engine machinery (ZeRO-1
+optimizer sharding, grad accumulation, fp16, checkpointing) is inherited
+unchanged from DeepSpeedEngine.
+
+Layer placement: the scan-stacked params' leading dim is the LayerSpec list;
+sharding it over ``pipe`` IS ``PipelineModule.partition_layers`` with
+uniform balancing (parts from runtime/utils.partition_uniform).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.models.llama import LlamaConfig
+from deepspeed_tpu.models.transformer import make_causal_mask
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.pipe.spmd import spmd_pipeline
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _pipe_block_specs(mesh) -> Dict[str, Any]:
+    """in_specs for the pipeline loss shard_map: blocks sharded over pipe,
+    everything else replicated across pipe and data."""
+    return {
+        "blocks": PartitionSpec("pipe"),
+        "other": PartitionSpec(),
+    }
+
+
+def make_pipeline_lm_loss(cfg: LlamaConfig, mesh, num_micro: Optional[int] = None):
+    """Causal-LM loss with the block stack pipelined over the pipe axis.
+
+    Expects LlamaModel(scan_layers=True) parameters: ``blocks/block/...``
+    leaves with leading dim num_layers (sharded over 'pipe' by the
+    PipelineEngine's sharding rules).
+    """
+    from deepspeed_tpu.models.llama import LlamaBlock, LlamaModel
+
+    P_pipe = mesh.shape["pipe"]
+    P_data = mesh.shape["data"]
+    M = num_micro or max(P_pipe, 1)
+    block = LlamaBlock(cfg)
+
+    def loss_fn(params, batch, rngs=None):
+        blocks = params["blocks"]["block"]
+        rest = {k: v for k, v in params.items() if k != "blocks"}
+
+        def inner(blocks_local, rest_rep, input_ids, labels):
+            B_loc, S = input_ids.shape
+            embed_tab = rest_rep["embed_tokens"]["embedding"]
+            x = embed_tab[input_ids].astype(cfg.dtype)
+            mask = make_causal_mask(S)
+            positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B_loc, 0)
+
+            assert B_loc % M == 0, (
+                f"local batch {B_loc} must divide into {M} pipeline microbatches")
+            micro = x.reshape(M, B_loc // M, S, x.shape[-1])
+            mpos = positions.reshape(M, B_loc // M, S)
+
+            def stage_fn(local_blocks, xm):
+                # apply this stage's layer shard sequentially
+                def layer(x, layer_params):
+                    y = block.apply({"params": layer_params}, x, mask,
+                                    mpos[0])
+                    return y, None
+
+                y, _ = lax.scan(layer, xm, local_blocks)
+                return y
+
+            y = spmd_pipeline(stage_fn, blocks_local, micro, axis_name="pipe")
+            y = y.reshape(B_loc, S, -1)
+
+            # final norm + head (replicated per stage)
+            scale = rest_rep["final_norm"]["scale"]
+            y32 = y.astype(jnp.float32)
+            var = jnp.mean(jnp.square(y32), axis=-1, keepdims=True)
+            y = (y32 * lax.rsqrt(var + cfg.rms_norm_eps) * scale).astype(cfg.dtype)
+            if cfg.tie_embeddings:
+                logits = (y.astype(jnp.float32) @ embed_tab.T.astype(jnp.float32))
+            else:
+                logits = y @ rest_rep["lm_head"]["kernel"].astype(cfg.dtype)
+            logits = logits.astype(jnp.float32)
+
+            valid = labels != -100
+            safe = jnp.where(valid, labels, 0)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            loss_sum = jnp.sum(jnp.where(valid, -ll, 0.0))
+            count = jnp.sum(valid)
+            # average over the full global batch (sum over data shards)
+            loss_sum = lax.psum(loss_sum, "data")
+            count = lax.psum(count, "data")
+            return loss_sum / jnp.maximum(count, 1)
+
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(PartitionSpec("pipe"), PartitionSpec(),
+                      PartitionSpec("data"), PartitionSpec("data")),
+            out_specs=PartitionSpec(),
+        )(blocks, rest, batch["input_ids"], batch["labels"])
+
+    return loss_fn
+
+
+def pipeline_sharding_rules():
+    """Extra rules: stacked block params shard their layer dim over pipe."""
+    from deepspeed_tpu.parallel.partition import DEFAULT_TP_RULES
+
+    return [(r"blocks/block/.*", ("pipe", None, None)),
+            (r"blocks/block/.*scale", ("pipe", None)),
+            *DEFAULT_TP_RULES]
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Engine whose loss pipelines the model over the pipe axis. Use via
+    ``deepspeed_tpu.initialize(..., model_config=cfg)`` with a mesh whose
+    pipe axis > 1 (the analogue of passing a PipelineModule)."""
+
+    def __init__(self, model=None, model_config: Optional[LlamaConfig] = None,
+                 num_micro: Optional[int] = None, **kwargs):
+        cfg = model_config or getattr(model, "cfg", None)
+        assert cfg is not None, "PipelineEngine needs the model config"
+        assert cfg.scan_layers, "PipelineEngine requires scan_layers=True " \
+            "(stacked blocks are the LayerSpec list)"
+        mesh = kwargs.get("mesh")
+        assert mesh is not None, "PipelineEngine needs an explicit mesh"
+        assert cfg.num_layers % mesh.shape["pipe"] == 0, (
+            f"{cfg.num_layers} layers must divide pipe={mesh.shape['pipe']}")
+        loss_fn = make_pipeline_lm_loss(cfg, mesh, num_micro)
+        if kwargs.get("sharding_rules") is None:
+            kwargs["sharding_rules"] = pipeline_sharding_rules()
+        super().__init__(model=model, loss_fn=loss_fn, **kwargs)
+        self.num_stages = mesh.shape["pipe"]
+        log_dist(f"PipelineEngine: {self.num_stages} stages x "
+                 f"{cfg.num_layers // self.num_stages} layers", ranks=[0])

@@ -33,31 +33,77 @@ def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
-def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0):
+def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0,
+                     rotary_dim: Optional[int] = None, interleaved: bool = False):
     """RoPE applied over the last dim of [B, S, H, D] given positions [B, S].
 
     Analogue of the reference's in-kernel rotary
     (csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu), traced so XLA
-    fuses it into the QK matmuls.
+    fuses it into the QK matmuls. ``rotary_dim`` rotates only the leading
+    slice of each head (GPT-J/NeoX partial rotary); ``interleaved`` uses the
+    rotate-every-two pairing (GPT-J) instead of the half-split pairing.
     """
     dim = x.shape[-1]
-    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    rot = rotary_dim or dim
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    inv_freq = 1.0 / (base ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
     freqs = positions[..., None].astype(jnp.float32) * inv_freq[None, None, :]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [B, S, D]
-    cos = jnp.cos(emb)[:, :, None, :]
-    sin = jnp.sin(emb)[:, :, None, :]
-    return (x * cos + rotate_half(x) * sin).astype(x.dtype)
+    if interleaved:
+        # pairs are (x0,x1),(x2,x3),… — duplicate each freq for its pair
+        cos = jnp.repeat(jnp.cos(freqs), 2, axis=-1)[:, :, None, :]
+        sin = jnp.repeat(jnp.sin(freqs), 2, axis=-1)[:, :, None, :]
+        x1 = x_rot[..., 0::2]
+        x2 = x_rot[..., 1::2]
+        rotated = jnp.stack([-x2, x1], axis=-1).reshape(x_rot.shape)
+    else:
+        emb = jnp.concatenate([freqs, freqs], axis=-1)  # [B, S, rot]
+        cos = jnp.cos(emb)[:, :, None, :]
+        sin = jnp.sin(emb)[:, :, None, :]
+        rotated = rotate_half(x_rot)
+    out = (x_rot * cos + rotated * sin).astype(x.dtype)
+    if rot == dim:
+        return out
+    return jnp.concatenate([out, x_pass], axis=-1)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (BLOOM; reference builds these host-side in
+    module_inject/containers/bloom.py and applies them in the softmax kernel)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    n = 2 ** int(math.floor(math.log2(num_heads)))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)[0::2][:num_heads - n]
+        slopes += extra
+    return jnp.asarray(slopes, dtype=jnp.float32)
+
+
+def alibi_bias(num_heads: int, q_len: int, k_len: int) -> jnp.ndarray:
+    """[1, H, Q, K] additive attention bias, slope * -(relative distance)."""
+    slopes = alibi_slopes(num_heads)  # [H]
+    qpos = jnp.arange(k_len - q_len, k_len, dtype=jnp.float32)[:, None]
+    kpos = jnp.arange(k_len, dtype=jnp.float32)[None, :]
+    rel = kpos - qpos  # <=0 in the causal region
+    return (slopes[None, :, None, None] * rel[None, None, :, :])
 
 
 def dot_product_attention(q, k, v, mask=None, dropout_rng=None, dropout_rate=0.0,
-                          deterministic=True, dtype=jnp.float32):
+                          deterministic=True, dtype=jnp.float32, scale=None):
     """Reference attention core in pure XLA ops.
 
     [B, S, H, D] layout. Softmax in fp32 for stability regardless of compute
-    dtype (matches the reference kernels' fp32 accumulation).
+    dtype (matches the reference kernels' fp32 accumulation). ``scale``
+    overrides the default 1/sqrt(head_dim) (GPT-Neo uses 1.0).
     """
     depth = q.shape[-1]
-    q = q / jnp.sqrt(depth).astype(q.dtype)
+    if scale is None:
+        scale = float(depth) ** -0.5
+    q = q * jnp.asarray(scale, dtype=q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     if mask is not None:
         scores = scores + mask
@@ -124,10 +170,14 @@ class SelfAttention(nn.Module):
     head_dim: Optional[int] = None
     use_rope: bool = True
     rope_base: float = 10000.0
+    rotary_dim: Optional[int] = None      # partial rotary (GPT-J/NeoX rotary_pct)
+    rotary_interleaved: bool = False      # GPT-J rotate-every-two pairing
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.bfloat16
     attention_impl: str = "xla"  # "xla" | "flash"
     use_bias: bool = False
+    out_bias: Optional[bool] = None       # None → use_bias; GPT-Neo: qkv no, out yes
+    attn_scale: Optional[float] = None    # None → 1/sqrt(head_dim); GPT-Neo: 1.0
 
     @nn.compact
     def __call__(self, x, mask=None, positions=None, deterministic=True,
@@ -150,8 +200,10 @@ class SelfAttention(nn.Module):
         if positions is None:
             positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
         if self.use_rope:
-            q = rotary_embedding(q, positions, self.rope_base)
-            k = rotary_embedding(k, positions, self.rope_base)
+            q = rotary_embedding(q, positions, self.rope_base,
+                                 self.rotary_dim, self.rotary_interleaved)
+            k = rotary_embedding(k, positions, self.rope_base,
+                                 self.rotary_dim, self.rotary_interleaved)
 
         updated_cache = None
         if kv_cache is not None:
@@ -181,10 +233,12 @@ class SelfAttention(nn.Module):
             out = dot_product_attention(
                 q, k, v, mask=mask, dropout_rng=dropout_rng,
                 dropout_rate=self.dropout_rate, deterministic=deterministic,
-                dtype=self.dtype)
+                dtype=self.dtype, scale=self.attn_scale)
 
         out = out.reshape(B, S, self.num_heads * head_dim)
-        out = dense(features, name="o_proj")(out)
+        o_bias = self.use_bias if self.out_bias is None else self.out_bias
+        out = nn.Dense(features, use_bias=o_bias, dtype=self.dtype,
+                       param_dtype=jnp.float32, name="o_proj")(out)
         if kv_cache is not None:
             return out, updated_cache
         return out
@@ -214,6 +268,7 @@ class MLP(nn.Module):
     intermediate_size: int
     dtype: Dtype = jnp.bfloat16
     use_bias: bool = True
+    activation: Callable = functools.partial(nn.gelu, approximate=True)
 
     @nn.compact
     def __call__(self, x):
@@ -221,5 +276,5 @@ class MLP(nn.Module):
         dense = functools.partial(nn.Dense, use_bias=self.use_bias,
                                   dtype=self.dtype, param_dtype=jnp.float32)
         h = dense(self.intermediate_size, name="c_fc")(x)
-        h = nn.gelu(h, approximate=True)
+        h = self.activation(h)
         return dense(features, name="c_proj")(h)

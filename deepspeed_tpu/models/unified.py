@@ -1,0 +1,206 @@
+"""Unified parametric transformer — the conversion target for HF model families.
+
+Where the reference ships one injection container per architecture
+(deepspeed/module_inject/containers/{gpt2,gptj,gptneo,gptneox,opt,bloom,
+bert,distil_bert,…}.py) each copying weights into the same fused
+``DeepSpeedTransformerInference`` module, the TPU build ships one parametric
+flax model whose config spans the same architecture space:
+
+- positions: learned (GPT-2/OPT/BERT), rotary incl. partial/interleaved
+  (GPT-J/NeoX), ALiBi (BLOOM), or none
+- norms: LayerNorm / RMSNorm, pre- or post-LN (BERT is post-LN)
+- MLP: GELU (exact or tanh-approx) / ReLU / SiLU, gated (LLaMA) or plain
+- residual topology: sequential, or parallel attention+MLP with shared
+  (GPT-J) or separate (GPT-NeoX) input norms
+- attention: MHA/GQA, per-layer local windows (GPT-Neo), causal or
+  bidirectional (BERT), optional no-scaling (GPT-Neo)
+
+``module_inject`` policies map an HF config + torch state_dict onto
+(TransformerConfig, params) — see deepspeed_tpu/module_inject/.
+"""
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import (
+    MLP, GatedMLP, RMSNorm, SelfAttention, alibi_bias, make_causal_mask,
+)
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    hidden_size: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: Optional[int] = None
+    intermediate_size: Optional[int] = None      # default 4*hidden
+    max_seq_len: int = 128
+
+    pos_emb: str = "learned"                     # learned|rotary|alibi|none
+    pos_offset: int = 0                          # OPT stores positions at +2
+    rope_base: float = 10000.0
+    rotary_dim: Optional[int] = None             # partial rotary
+    rotary_interleaved: bool = False             # GPT-J pairing
+
+    norm: str = "layernorm"                      # layernorm|rmsnorm
+    norm_eps: float = 1e-5
+    pre_ln: bool = True                          # False → post-LN (BERT)
+    final_norm: bool = True
+
+    activation: str = "gelu_new"                 # gelu|gelu_new|relu|silu
+    gated_mlp: bool = False
+
+    parallel_attn: bool = False                  # GPT-J / GPT-NeoX topology
+    parallel_shared_ln: bool = True              # GPT-J shares ln_1; NeoX doesn't
+
+    causal: bool = True                          # False → encoder (BERT)
+    attn_windows: Optional[Tuple[Optional[int], ...]] = None  # per-layer local window
+    attn_scale: Optional[float] = None           # None → 1/sqrt(d); GPT-Neo: 1.0
+
+    attn_bias: bool = True                       # bias on qkv projections
+    attn_out_bias: Optional[bool] = None         # None → attn_bias (GPT-Neo differs)
+    mlp_bias: bool = True
+    tie_embeddings: bool = True
+    token_type_vocab: int = 0                    # >0 → BERT token_type embeddings
+    embed_ln: bool = False                       # BLOOM word_embeddings_layernorm
+    lm_head: bool = True                         # False → encoder output only
+    lm_head_bias: bool = False                   # GPT-J's untied head has bias
+
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @staticmethod
+    def tiny(**kw) -> "TransformerConfig":
+        return TransformerConfig(**kw)
+
+
+def _act(name: str):
+    return {"gelu": lambda x: nn.gelu(x, approximate=False),
+            "gelu_new": lambda x: nn.gelu(x, approximate=True),
+            "relu": nn.relu,
+            "silu": nn.silu}[name]
+
+
+def _norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name=name)
+
+
+class UnifiedBlock(nn.Module):
+    cfg: TransformerConfig
+    layer_idx: int = 0
+
+    @nn.compact
+    def __call__(self, x, mask, positions):
+        cfg = self.cfg
+        attn = SelfAttention(
+            num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+            use_rope=cfg.pos_emb == "rotary", rope_base=cfg.rope_base,
+            rotary_dim=cfg.rotary_dim, rotary_interleaved=cfg.rotary_interleaved,
+            dtype=cfg.dtype, use_bias=cfg.attn_bias,
+            out_bias=cfg.attn_out_bias, attn_scale=cfg.attn_scale,
+            name="attn")
+        if cfg.gated_mlp:
+            mlp = GatedMLP(intermediate_size=cfg.ffn_size, dtype=cfg.dtype,
+                           use_bias=cfg.mlp_bias, activation=_act(cfg.activation),
+                           name="mlp")
+        else:
+            mlp = MLP(intermediate_size=cfg.ffn_size, dtype=cfg.dtype,
+                      use_bias=cfg.mlp_bias, activation=_act(cfg.activation),
+                      name="mlp")
+
+        if cfg.parallel_attn:
+            # x + attn(ln1(x)) + mlp(ln1(x) or ln2(x))  (GPT-J / GPT-NeoX)
+            h1 = _norm(cfg, "ln_1")(x)
+            h2 = h1 if cfg.parallel_shared_ln else _norm(cfg, "ln_2")(x)
+            return x + attn(h1, mask=mask, positions=positions) + mlp(h2)
+        if cfg.pre_ln:
+            h = attn(_norm(cfg, "ln_1")(x), mask=mask, positions=positions)
+            x = x + h
+            return x + mlp(_norm(cfg, "ln_2")(x))
+        # post-LN (BERT): ln(x + sub(x))
+        x = _norm(cfg, "ln_1")(x + attn(x, mask=mask, positions=positions))
+        return _norm(cfg, "ln_2")(x + mlp(x))
+
+
+def _window_mask(seq_len: int, window: int) -> jnp.ndarray:
+    """Additive causal mask restricted to a local window (GPT-Neo local attn)."""
+    i = jnp.arange(seq_len)[:, None]
+    j = jnp.arange(seq_len)[None, :]
+    ok = (j <= i) & (j > i - window)
+    return jnp.where(ok, 0.0, jnp.finfo(jnp.float32).min)[None, None, :, :]
+
+
+class TransformerLM(nn.Module):
+    """Decoder/encoder LM over UnifiedBlocks.
+
+    Returns fp32 logits (``lm_head``) or final hidden states (encoder mode).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, attention_mask=None,
+                 token_type_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wte")
+        x = wte(input_ids)
+        if positions is None:
+            positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+        if cfg.pos_emb == "learned":
+            wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=jnp.float32, name="wpe")
+            x = x + wpe(positions + cfg.pos_offset)
+        if cfg.token_type_vocab:
+            tte = nn.Embed(cfg.token_type_vocab, cfg.hidden_size, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="wtte")
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + tte(token_type_ids)
+        if cfg.embed_ln or not cfg.pre_ln:
+            # BLOOM word_embeddings_layernorm / BERT embeddings.LayerNorm
+            x = _norm(cfg, "ln_emb")(x)
+
+        if cfg.causal:
+            base_mask = make_causal_mask(S)
+        else:
+            base_mask = jnp.zeros((1, 1, S, S), dtype=jnp.float32)
+        if attention_mask is not None:
+            pad = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                            0.0, jnp.finfo(jnp.float32).min)
+            base_mask = base_mask + pad
+        if cfg.pos_emb == "alibi":
+            base_mask = base_mask + alibi_bias(cfg.num_heads, S, S)
+
+        block_cls = nn.remat(UnifiedBlock) if cfg.remat else UnifiedBlock
+        for i in range(cfg.num_layers):
+            mask = base_mask
+            if cfg.attn_windows is not None and cfg.attn_windows[i]:
+                mask = mask + _window_mask(S, cfg.attn_windows[i])
+            x = block_cls(cfg, layer_idx=i, name=f"layer_{i}")(x, mask, positions)
+
+        if cfg.final_norm:
+            x = _norm(cfg, "ln_f")(x)
+        if not cfg.lm_head:
+            return x.astype(jnp.float32)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              name="lm_head")(x)
+        return logits.astype(jnp.float32)

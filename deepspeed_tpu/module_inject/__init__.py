@@ -1,0 +1,20 @@
+"""module_inject — HF-model ingestion: policies, auto-TP, weight conversion.
+
+TPU-native analogue of ``deepspeed/module_inject`` (replace_module.py:282,
+auto_tp.py:84, policy.py:42, containers/): instead of surgically swapping
+``nn.Module``s for fused CUDA modules, a *policy* maps a HuggingFace
+architecture onto the unified flax transformer
+(deepspeed_tpu/models/unified.py) — a config + a converted parameter pytree +
+tensor-parallel sharding rules. XLA's SPMD partitioner then plays the role of
+``LinearAllreduce``/``LinearLayer``: the rules say which matmul dims shard
+over the ``tensor`` axis, and the compiler inserts the all-reduces the
+reference issues by hand.
+"""
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP  # noqa: F401
+from deepspeed_tpu.module_inject.policy import (  # noqa: F401
+    TransformerPolicy, policy_for, replace_policies,
+)
+from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
+    InjectedModel, convert_hf_model, replace_transformer_layer,
+)

@@ -1,0 +1,102 @@
+"""Auto tensor-parallelism (reference module_inject/auto_tp.py:84).
+
+The reference's ``AutoTP.tp_parser`` walks a torch module finding the linear
+layers whose outputs feed a residual sum — those become ``LinearAllreduce``
+(row-parallel); every other linear is sliced column-parallel. Here the same
+classification happens on *parameter paths* of a JAX pytree: the output is a
+rule list (path pattern → PartitionSpec) that the sharding-rules engine
+(deepspeed_tpu/parallel/partition.py) applies; XLA then inserts the
+all-reduces that ``LinearAllreduce.forward`` issues by hand.
+"""
+
+import re
+from typing import Any, List, Tuple
+
+import jax
+
+from deepspeed_tpu.parallel.mesh import TENSOR_AXIS
+from deepspeed_tpu.parallel.partition import Rule, path_str
+
+# Name fragments marking a row-parallel ("needs allreduce") projection: the
+# linear that closes attention or the MLP. Mirrors the reference's per-arch
+# ``gem_list`` accumulation (auto_tp.py:120-170) collapsed into one table.
+ROW_PARALLEL_MARKERS = (
+    "o_proj", "out_proj", "out_lin", "attn_out", "dense_4h_to_h", "down_proj",
+    "fc_out", "fc2", "w2", "attention.output.dense", "attention/output/dense",
+)
+# Column-parallel projections (sliced output dim, no collective needed).
+COL_PARALLEL_MARKERS = (
+    "q_proj", "k_proj", "v_proj", "query", "key", "value", "qkv",
+    "query_key_value", "c_attn", "gate_proj", "up_proj", "fc_in", "fc1",
+    "c_fc", "dense_h_to_4h", "w1", "w3", "lin1", "q_lin", "k_lin", "v_lin",
+    "intermediate.dense", "intermediate/dense",
+)
+EMBEDDING_MARKERS = ("wte", "embed_tokens", "word_embeddings", "embedding",
+                     "embed_in", "lm_head", "embed_out")
+
+
+class AutoTP:
+    """Classify a parameter tree into TP sharding rules."""
+
+    @staticmethod
+    def kernel_class(path: str) -> str:
+        """'row' | 'col' | 'embed' | 'replicate' for one param path."""
+        p = path.lower()
+        # attention's mlp c_proj vs attn c_proj both exist in GPT-2 naming;
+        # the reference treats both as row-parallel (each closes a residual)
+        if "c_proj" in p:
+            return "row"
+        for m in ROW_PARALLEL_MARKERS:
+            if m.replace(".", "/") in p or m in p:
+                return "row"
+        for m in COL_PARALLEL_MARKERS:
+            if m.replace(".", "/") in p or m in p:
+                return "col"
+        for m in EMBEDDING_MARKERS:
+            if re.search(rf"(^|/){m}(/|$)", p):
+                return "embed"
+        return "replicate"
+
+    @staticmethod
+    def tp_parser(params: Any) -> List[Rule]:
+        """Build sharding rules for an arbitrary params pytree.
+
+        Returns one exact-path rule per shardable parameter, so unknown
+        architectures get the same coverage the reference's parser achieves
+        by module inspection.
+        """
+        rules: List[Rule] = []
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in leaves:
+            if not hasattr(leaf, "shape") or len(getattr(leaf, "shape", ())) < 2:
+                continue
+            p = path_str(path)
+            base = p[:-len("/kernel")] if p.endswith("/kernel") else p
+            kind = AutoTP.kernel_class(base)
+            esc = re.escape(p)
+            if kind == "col":
+                rules.append((esc, (None, TENSOR_AXIS)))
+            elif kind == "row":
+                rules.append((esc, (TENSOR_AXIS, None)))
+            elif kind == "embed":
+                rules.append((esc, (TENSOR_AXIS, None)))
+        return rules
+
+    @staticmethod
+    def supported(params: Any) -> Tuple[bool, List[str]]:
+        """Whether the tree looks like a transformer we can shard; returns
+        (ok, unclassified-2D-param paths) — the analogue of the reference's
+        "unable to determine allreduce linears" failure mode."""
+        unknown = []
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        n_classified = 0
+        for path, leaf in leaves:
+            if not hasattr(leaf, "shape") or len(getattr(leaf, "shape", ())) != 2:
+                continue
+            p = path_str(path)
+            kind = AutoTP.kernel_class(p)
+            if kind == "replicate":
+                unknown.append(p)
+            else:
+                n_classified += 1
+        return n_classified > 0, unknown

@@ -1,0 +1,9 @@
+"""Per-architecture policies (reference module_inject/containers/*).
+
+Importing this package registers every policy with
+``deepspeed_tpu.module_inject.policy.replace_policies``.
+"""
+
+from deepspeed_tpu.module_inject.containers import (  # noqa: F401
+    bert, bloom, distilbert, gpt2, gptj, gptneo, gptneox, llama, megatron, opt,
+)

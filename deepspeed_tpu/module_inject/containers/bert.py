@@ -1,0 +1,56 @@
+"""BERT policy (reference module_inject/containers/bert.py — HFBertLayerPolicy).
+
+Post-LN encoder with token-type embeddings; output is final hidden states
+(the reference injects the fused layer into ``BertEncoder`` the same way).
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFBertLayerPolicy(TransformerPolicy):
+    model_types = ("bert",)
+    class_name_hints = ("Bert",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="learned",
+            norm="layernorm", norm_eps=hf_config.layer_norm_eps,
+            pre_ln=False, final_norm=False,
+            activation={"gelu": "gelu", "gelu_new": "gelu_new",
+                        "relu": "relu"}.get(hf_config.hidden_act, "gelu"),
+            causal=False, lm_head=False,
+            token_type_vocab=hf_config.type_vocab_size,
+            tie_embeddings=False,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "bert." if any(k.startswith("bert.") for k in sd) else ""
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}embeddings.word_embeddings.weight"])},
+            "wpe": {"embedding": _np(sd[f"{p}embeddings.position_embeddings.weight"])},
+            "wtte": {"embedding": _np(sd[f"{p}embeddings.token_type_embeddings.weight"])},
+            "ln_emb": ln_(sd, f"{p}embeddings.LayerNorm"),
+        }
+        for i in range(hf_config.num_hidden_layers):
+            b = f"{p}encoder.layer.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.attention.output.LayerNorm"),
+                "ln_2": ln_(sd, f"{b}.output.LayerNorm"),
+                "attn": {"q_proj": dense_(sd, f"{b}.attention.self.query"),
+                         "k_proj": dense_(sd, f"{b}.attention.self.key"),
+                         "v_proj": dense_(sd, f"{b}.attention.self.value"),
+                         "o_proj": dense_(sd, f"{b}.attention.output.dense")},
+                "mlp": {"c_fc": dense_(sd, f"{b}.intermediate.dense"),
+                        "c_proj": dense_(sd, f"{b}.output.dense")},
+            }
+        return params

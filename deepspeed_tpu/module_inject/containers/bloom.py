@@ -1,0 +1,54 @@
+"""BLOOM policy (reference module_inject/containers/bloom.py — BLOOMLayerPolicy).
+
+ALiBi positions (no position embeddings), embeddings LayerNorm, per-head
+interleaved fused QKV, GELU(tanh) MLP, tied embeddings.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy, split_fused_qkv,
+)
+
+
+@register_policy
+class BLOOMLayerPolicy(TransformerPolicy):
+    model_types = ("bloom",)
+    class_name_hints = ("Bloom",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            intermediate_size=4 * hf_config.hidden_size,
+            max_seq_len=2048,
+            pos_emb="alibi",
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu_new",
+            embed_ln=True,
+            tie_embeddings=True,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        head_dim = hf_config.hidden_size // hf_config.n_head
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}word_embeddings.weight"])},
+            "ln_emb": ln_(sd, f"{p}word_embeddings_layernorm"),
+            "ln_f": ln_(sd, f"{p}ln_f"),
+        }
+        for i in range(hf_config.n_layer):
+            b = f"{p}h.{i}"
+            attn = split_fused_qkv(sd[f"{b}.self_attention.query_key_value.weight"],
+                                   sd.get(f"{b}.self_attention.query_key_value.bias"),
+                                   hf_config.n_head, head_dim, layout="per_head")
+            attn["o_proj"] = dense_(sd, f"{b}.self_attention.dense")
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.input_layernorm"),
+                "ln_2": ln_(sd, f"{b}.post_attention_layernorm"),
+                "attn": attn,
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.dense_h_to_4h"),
+                        "c_proj": dense_(sd, f"{b}.mlp.dense_4h_to_h")},
+            }
+        return params

@@ -1,0 +1,53 @@
+"""DistilBERT policy (reference module_inject/containers/distil_bert.py).
+
+BERT-like post-LN encoder without token-type embeddings.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFDistilBertLayerPolicy(TransformerPolicy):
+    model_types = ("distilbert",)
+    class_name_hints = ("DistilBert",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.dim,
+            num_layers=hf_config.n_layers,
+            num_heads=hf_config.n_heads,
+            intermediate_size=hf_config.hidden_dim,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="learned",
+            norm="layernorm", norm_eps=1e-12,
+            pre_ln=False, final_norm=False,
+            activation={"gelu": "gelu", "relu": "relu"}.get(
+                hf_config.activation, "gelu"),
+            causal=False, lm_head=False,
+            tie_embeddings=False,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "distilbert." if any(k.startswith("distilbert.") for k in sd) else ""
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}embeddings.word_embeddings.weight"])},
+            "wpe": {"embedding": _np(sd[f"{p}embeddings.position_embeddings.weight"])},
+            "ln_emb": ln_(sd, f"{p}embeddings.LayerNorm"),
+        }
+        for i in range(hf_config.n_layers):
+            b = f"{p}transformer.layer.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.sa_layer_norm"),
+                "ln_2": ln_(sd, f"{b}.output_layer_norm"),
+                "attn": {"q_proj": dense_(sd, f"{b}.attention.q_lin"),
+                         "k_proj": dense_(sd, f"{b}.attention.k_lin"),
+                         "v_proj": dense_(sd, f"{b}.attention.v_lin"),
+                         "o_proj": dense_(sd, f"{b}.attention.out_lin")},
+                "mlp": {"c_fc": dense_(sd, f"{b}.ffn.lin1"),
+                        "c_proj": dense_(sd, f"{b}.ffn.lin2")},
+            }
+        return params

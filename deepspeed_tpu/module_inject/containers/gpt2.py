@@ -1,0 +1,55 @@
+"""GPT-2 policy (reference module_inject/containers/gpt2.py — HFGPT2LayerPolicy).
+
+GPT-2 stores projections as Conv1D ([in, out] — already flax kernel layout,
+no transpose) with a fused ``c_attn`` QKV split column-wise.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy, split_fused_qkv,
+)
+
+
+@register_policy
+class HFGPT2LayerPolicy(TransformerPolicy):
+    model_types = ("gpt2",)
+    class_name_hints = ("GPT2",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            max_seq_len=hf_config.n_positions,
+            pos_emb="learned",
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation={"gelu_new": "gelu_new", "gelu": "gelu",
+                        "relu": "relu"}.get(hf_config.activation_function,
+                                            "gelu_new"),
+            tie_embeddings=True,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        head_dim = hf_config.n_embd // hf_config.n_head
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}wte.weight"])},
+            "wpe": {"embedding": _np(sd[f"{p}wpe.weight"])},
+            "ln_f": ln_(sd, f"{p}ln_f"),
+        }
+        for i in range(hf_config.n_layer):
+            b = f"{p}h.{i}"
+            attn = split_fused_qkv(sd[f"{b}.attn.c_attn.weight"],
+                                   sd.get(f"{b}.attn.c_attn.bias"),
+                                   hf_config.n_head, head_dim, layout="concat")
+            attn["o_proj"] = dense_(sd, f"{b}.attn.c_proj", transpose=False)
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.ln_1"),
+                "ln_2": ln_(sd, f"{b}.ln_2"),
+                "attn": attn,
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.c_fc", transpose=False),
+                        "c_proj": dense_(sd, f"{b}.mlp.c_proj", transpose=False)},
+            }
+        return params

@@ -1,0 +1,57 @@
+"""GPT-J policy (reference module_inject/containers/gptj.py — HFGPTJLayerPolicy).
+
+Parallel attention+MLP sharing one LayerNorm, partial interleaved rotary
+(rotate-every-two over ``rotary_dim``), no attention biases, untied lm_head
+WITH bias.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFGPTJLayerPolicy(TransformerPolicy):
+    model_types = ("gptj",)
+    class_name_hints = ("GPTJ",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            max_seq_len=hf_config.n_positions,
+            pos_emb="rotary",
+            rotary_dim=hf_config.rotary_dim,
+            rotary_interleaved=True,
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu_new",
+            parallel_attn=True, parallel_shared_ln=True,
+            attn_bias=False, mlp_bias=True,
+            tie_embeddings=False, lm_head_bias=True,
+            final_norm=True,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}wte.weight"])},
+            "ln_f": ln_(sd, f"{p}ln_f"),
+        }
+        if "lm_head.weight" in sd:
+            params["lm_head"] = dense_(sd, "lm_head")
+        for i in range(hf_config.n_layer):
+            b = f"{p}h.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.ln_1"),
+                "attn": {"q_proj": dense_(sd, f"{b}.attn.q_proj"),
+                         "k_proj": dense_(sd, f"{b}.attn.k_proj"),
+                         "v_proj": dense_(sd, f"{b}.attn.v_proj"),
+                         "o_proj": dense_(sd, f"{b}.attn.out_proj")},
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.fc_in"),
+                        "c_proj": dense_(sd, f"{b}.mlp.fc_out")},
+            }
+        return params

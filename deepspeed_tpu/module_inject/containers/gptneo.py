@@ -1,0 +1,63 @@
+"""GPT-Neo policy (reference module_inject/containers/gptneo.py).
+
+GPT-2-like but with torch Linear storage (transpose), un-scaled attention
+(scale = 1.0), no QKV biases, and alternating global/local attention layers.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFGPTNEOLayerPolicy(TransformerPolicy):
+    model_types = ("gpt_neo",)
+    class_name_hints = ("GPTNeoFor", "GPTNeoModel")
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        # attention_types like [[["global","local"], 6]] → flat per-layer list
+        flat = []
+        for kinds, count in hf_config.attention_types:
+            flat += list(kinds) * count
+        windows = tuple(hf_config.window_size if k == "local" else None
+                        for k in flat[:hf_config.num_layers])
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_layers,
+            num_heads=hf_config.num_heads,
+            intermediate_size=hf_config.intermediate_size or
+            4 * hf_config.hidden_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="learned",
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation={"gelu_new": "gelu_new", "gelu": "gelu",
+                        "relu": "relu"}.get(hf_config.activation_function,
+                                            "gelu_new"),
+            attn_windows=windows if any(windows) else None,
+            attn_scale=1.0,
+            attn_bias=False, attn_out_bias=True,
+            tie_embeddings=True,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}wte.weight"])},
+            "wpe": {"embedding": _np(sd[f"{p}wpe.weight"])},
+            "ln_f": ln_(sd, f"{p}ln_f"),
+        }
+        for i in range(hf_config.num_layers):
+            b = f"{p}h.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.ln_1"),
+                "ln_2": ln_(sd, f"{b}.ln_2"),
+                "attn": {"q_proj": dense_(sd, f"{b}.attn.attention.q_proj"),
+                         "k_proj": dense_(sd, f"{b}.attn.attention.k_proj"),
+                         "v_proj": dense_(sd, f"{b}.attn.attention.v_proj"),
+                         "o_proj": dense_(sd, f"{b}.attn.attention.out_proj")},
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.c_fc"),
+                        "c_proj": dense_(sd, f"{b}.mlp.c_proj")},
+            }
+        return params

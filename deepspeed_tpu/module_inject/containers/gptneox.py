@@ -1,0 +1,63 @@
+"""GPT-NeoX policy (reference module_inject/containers/gptneox.py).
+
+Parallel attention+MLP with *separate* norms (``use_parallel_residual``),
+partial half-split rotary (``rotary_pct``), per-head fused QKV, untied
+``embed_out``.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy, split_fused_qkv,
+)
+
+
+@register_policy
+class GPTNEOXLayerPolicy(TransformerPolicy):
+    model_types = ("gpt_neox",)
+    class_name_hints = ("GPTNeoX",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+        rotary_dim = int(head_dim * hf_config.rotary_pct)
+        parallel = getattr(hf_config, "use_parallel_residual", True)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="rotary",
+            rotary_dim=rotary_dim,
+            rope_base=getattr(hf_config, "rotary_emb_base", 10000.0),
+            norm="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation={"gelu": "gelu", "gelu_new": "gelu_new",
+                        "relu": "relu"}.get(hf_config.hidden_act, "gelu"),
+            parallel_attn=parallel, parallel_shared_ln=False,
+            tie_embeddings=False,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+        head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}embed_in.weight"])},
+            "ln_f": ln_(sd, f"{p}final_layer_norm"),
+        }
+        if "embed_out.weight" in sd:
+            params["lm_head"] = dense_(sd, "embed_out")
+        for i in range(hf_config.num_hidden_layers):
+            b = f"{p}layers.{i}"
+            attn = split_fused_qkv(sd[f"{b}.attention.query_key_value.weight"],
+                                   sd.get(f"{b}.attention.query_key_value.bias"),
+                                   hf_config.num_attention_heads, head_dim,
+                                   layout="per_head")
+            attn["o_proj"] = dense_(sd, f"{b}.attention.dense")
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.input_layernorm"),
+                "ln_2": ln_(sd, f"{b}.post_attention_layernorm"),
+                "attn": attn,
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.dense_h_to_4h"),
+                        "c_proj": dense_(sd, f"{b}.mlp.dense_4h_to_h")},
+            }
+        return params

@@ -1,0 +1,60 @@
+"""LLaMA policy (reference module_inject/containers/llama.py).
+
+RMSNorm, full rotary (half-split pairing), SwiGLU gated MLP, GQA, no biases,
+untied lm_head.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFLlamaLayerPolicy(TransformerPolicy):
+    model_types = ("llama", "mistral")
+    class_name_hints = ("Llama", "Mistral")
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        tie = getattr(hf_config, "tie_word_embeddings", False)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                                 hf_config.num_attention_heads),
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="rotary",
+            rope_base=getattr(hf_config, "rope_theta", 10000.0),
+            norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation="silu", gated_mlp=True,
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=getattr(hf_config, "mlp_bias", False),
+            tie_embeddings=tie,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "model." if any(k.startswith("model.") for k in sd) else ""
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}embed_tokens.weight"])},
+            "ln_f": ln_(sd, f"{p}norm"),
+        }
+        if "lm_head.weight" in sd and not getattr(hf_config,
+                                                  "tie_word_embeddings", False):
+            params["lm_head"] = dense_(sd, "lm_head")
+        for i in range(hf_config.num_hidden_layers):
+            b = f"{p}layers.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.input_layernorm"),
+                "ln_2": ln_(sd, f"{b}.post_attention_layernorm"),
+                "attn": {"q_proj": dense_(sd, f"{b}.self_attn.q_proj"),
+                         "k_proj": dense_(sd, f"{b}.self_attn.k_proj"),
+                         "v_proj": dense_(sd, f"{b}.self_attn.v_proj"),
+                         "o_proj": dense_(sd, f"{b}.self_attn.o_proj")},
+                "mlp": {"gate_proj": dense_(sd, f"{b}.mlp.gate_proj"),
+                        "up_proj": dense_(sd, f"{b}.mlp.up_proj"),
+                        "down_proj": dense_(sd, f"{b}.mlp.down_proj")},
+            }
+        return params

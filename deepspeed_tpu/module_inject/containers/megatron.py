@@ -1,0 +1,69 @@
+"""Megatron-GPT policy (reference module_inject/containers/megatron_gpt.py).
+
+Megatron GPT-2 checkpoints use NeoX-style naming (``input_layernorm``,
+``attention.query_key_value`` per-head fused, ``dense_h_to_4h``) with learned
+positions and sequential residuals — a hybrid of the GPT-2 topology and the
+NeoX weight layout.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy, split_fused_qkv,
+)
+
+
+@register_policy
+class MegatronLayerPolicy(TransformerPolicy):
+    model_types = ("megatron", "megatron-gpt2")
+    class_name_hints = ("Megatron", "GPT2ModelPipe")
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        get = lambda *names, default=None: next(
+            (getattr(hf_config, n) for n in names if hasattr(hf_config, n)),
+            default)
+        hidden = get("hidden_size", "n_embd")
+        return TransformerConfig(
+            vocab_size=get("vocab_size", "padded_vocab_size"),
+            hidden_size=hidden,
+            num_layers=get("num_layers", "n_layer", "num_hidden_layers"),
+            num_heads=get("num_attention_heads", "n_head"),
+            intermediate_size=get("ffn_hidden_size", default=4 * hidden),
+            max_seq_len=get("max_position_embeddings", "n_positions",
+                            default=1024),
+            pos_emb="learned",
+            norm="layernorm",
+            norm_eps=get("layernorm_epsilon", "layer_norm_epsilon",
+                         default=1e-5),
+            activation="gelu_new",
+            tie_embeddings=True,
+        )
+
+    def convert(self, sd, hf_config):
+        cfg = self.build_config(hf_config)
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # locate the transformer root / embedding root by probing
+        prefix = next(p for p in ("language_model.transformer.", "transformer.",
+                                  "model.", "")
+                      if f"{p}layers.0.input_layernorm.weight" in sd)
+        emb = next(p for p in ("language_model.embedding.", "embedding.",
+                               prefix, "")
+                   if f"{p}word_embeddings.weight" in sd)
+        params = {
+            "wte": {"embedding": _np(sd[f"{emb}word_embeddings.weight"])},
+            "wpe": {"embedding": _np(sd[f"{emb}position_embeddings.weight"])},
+            "ln_f": ln_(sd, f"{prefix}final_layernorm"),
+        }
+        for i in range(cfg.num_layers):
+            b = f"{prefix}layers.{i}"
+            attn = split_fused_qkv(sd[f"{b}.attention.query_key_value.weight"],
+                                   sd.get(f"{b}.attention.query_key_value.bias"),
+                                   cfg.num_heads, head_dim, layout="per_head")
+            attn["o_proj"] = dense_(sd, f"{b}.attention.dense")
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.input_layernorm"),
+                "ln_2": ln_(sd, f"{b}.post_attention_layernorm"),
+                "attn": attn,
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.dense_h_to_4h"),
+                        "c_proj": dense_(sd, f"{b}.mlp.dense_4h_to_h")},
+            }
+        return params

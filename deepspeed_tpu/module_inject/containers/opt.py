@@ -1,0 +1,62 @@
+"""OPT policy (reference module_inject/containers/opt.py — HFOPTLayerPolicy).
+
+OPT: learned positions with a +2 storage offset, ReLU MLP, pre-LN
+(``do_layer_norm_before``), tied embeddings.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFOPTLayerPolicy(TransformerPolicy):
+    model_types = ("opt",)
+    class_name_hints = ("OPT",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        assert hf_config.word_embed_proj_dim == hf_config.hidden_size, \
+            "OPT word_embed_proj_dim != hidden_size (project_in/out) unsupported"
+        # OPT-350m's post-LN variant orders norms differently from the BERT
+        # post-LN topology TransformerLM implements; reject rather than
+        # produce a config whose params the converter doesn't emit.
+        assert hf_config.do_layer_norm_before, \
+            "OPT do_layer_norm_before=False (350m layout) unsupported"
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.ffn_dim,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="learned", pos_offset=2,
+            norm="layernorm",
+            pre_ln=hf_config.do_layer_norm_before,
+            activation={"relu": "relu", "gelu": "gelu"}.get(
+                hf_config.activation_function, "relu"),
+            tie_embeddings=True,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "model.decoder." if any(k.startswith("model.") for k in sd) \
+            else "decoder."
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}embed_tokens.weight"])},
+            "wpe": {"embedding": _np(sd[f"{p}embed_positions.weight"])},
+        }
+        if f"{p}final_layer_norm.weight" in sd:
+            params["ln_f"] = ln_(sd, f"{p}final_layer_norm")
+        for i in range(hf_config.num_hidden_layers):
+            b = f"{p}layers.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.self_attn_layer_norm"),
+                "ln_2": ln_(sd, f"{b}.final_layer_norm"),
+                "attn": {"q_proj": dense_(sd, f"{b}.self_attn.q_proj"),
+                         "k_proj": dense_(sd, f"{b}.self_attn.k_proj"),
+                         "v_proj": dense_(sd, f"{b}.self_attn.v_proj"),
+                         "o_proj": dense_(sd, f"{b}.self_attn.out_proj")},
+                "mlp": {"c_fc": dense_(sd, f"{b}.fc1"),
+                        "c_proj": dense_(sd, f"{b}.fc2")},
+            }
+        return params

@@ -1,0 +1,72 @@
+"""Explicit tensor-parallel linear ops (reference module_inject/layers.py).
+
+``LinearLayer`` (column-parallel, sliced output) and ``LinearAllreduce``
+(row-parallel, psum over the tensor axis) as shard_map functions. Under
+pjit these are normally unnecessary — sharding rules + XLA's SPMD
+partitioner produce the identical program — but they are the explicit form
+for custom models and for tests that pin down collective placement.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import TENSOR_AXIS
+
+
+def linear_layer(x, kernel, bias=None, *, mesh: Mesh, axis: str = TENSOR_AXIS):
+    """Column-parallel linear: kernel sharded on its output dim; result stays
+    sharded on the feature dim (reference LinearLayer, layers.py:32)."""
+
+    def local(x_, w_, b_):
+        y = x_ @ w_
+        if b_ is not None:
+            y = y + b_
+        return y
+
+    if bias is None:
+        bias = jnp.zeros((kernel.shape[1],), dtype=kernel.dtype)
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(P(), P(None, axis), P(axis)),
+                         out_specs=P(None, None, axis))(x, kernel, bias)
+
+
+def linear_allreduce(x, kernel, bias=None, *, mesh: Mesh,
+                     axis: str = TENSOR_AXIS):
+    """Row-parallel linear with psum (reference LinearAllreduce, layers.py:15):
+    input is feature-sharded, kernel sharded on its input dim, partial products
+    are summed over the tensor axis; bias added once after the reduction."""
+
+    def local(x_, w_, b_):
+        y = jax.lax.psum(x_ @ w_, axis)
+        if b_ is not None:
+            y = y + b_
+        return y
+
+    if bias is None:
+        bias = jnp.zeros((kernel.shape[1],), dtype=kernel.dtype)
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(P(None, None, axis), P(axis, None), P()),
+                         out_specs=P())(x, kernel, bias)
+
+
+def embedding_layer(ids, table, *, mesh: Mesh, axis: str = TENSOR_AXIS):
+    """Vocab-sharded embedding lookup: each shard contributes rows it owns,
+    psum combines (reference EmbeddingLayer + vocab-parallel pattern)."""
+
+    vocab = table.shape[0]
+    n = mesh.shape[axis]
+    shard = vocab // n
+
+    def local(ids_, tab_):
+        idx = jax.lax.axis_index(axis)
+        lo = idx * shard
+        local_ids = ids_ - lo
+        ok = (local_ids >= 0) & (local_ids < shard)
+        safe = jnp.clip(local_ids, 0, shard - 1)
+        out = tab_[safe] * ok[..., None].astype(tab_.dtype)
+        return jax.lax.psum(out, axis)
+
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(P(), P(axis, None)),
+                         out_specs=P())(ids, table)

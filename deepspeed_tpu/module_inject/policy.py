@@ -1,0 +1,139 @@
+"""Policy contract + registry (reference module_inject/policy.py:42).
+
+A policy declares, for one HF architecture family:
+- ``match(hf_config)``: does this policy own the config?
+- ``build_config(hf_config)``: HF config → ``TransformerConfig`` for the
+  unified flax model (the role of ``create_ds_model_config``,
+  containers/base.py:83);
+- ``convert(state_dict, hf_config)``: torch weights → flax param pytree
+  (the role of ``set_attention``/``set_mlp``/``copy_data_to_new_module``,
+  containers/base.py:169-256, with split-qkv / transpose handled here the
+  way the feature mixins do);
+- ``tp_rules()``: path-pattern → PartitionSpec rules
+  (``apply_tensor_parallelism``, containers/base.py:202 — realized as
+  sharding specs, not sliced copies).
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.parallel.partition import DEFAULT_TP_RULES, Rule
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor (or array) → float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def t_(t) -> np.ndarray:
+    """torch Linear weight [out, in] → flax kernel [in, out]."""
+    return _np(t).T
+
+
+def ln_(sd: Dict[str, Any], key: str) -> Dict[str, np.ndarray]:
+    """LayerNorm weights → flax {'scale','bias'} (or RMSNorm {'scale'})."""
+    out = {"scale": _np(sd[f"{key}.weight"])}
+    if f"{key}.bias" in sd:
+        out["bias"] = _np(sd[f"{key}.bias"])
+    return out
+
+
+def dense_(sd: Dict[str, Any], key: str, transpose: bool = True) -> Dict[str, np.ndarray]:
+    """Linear/Conv1D weights → flax {'kernel'[, 'bias']}."""
+    w = sd[f"{key}.weight"]
+    out = {"kernel": t_(w) if transpose else _np(w)}
+    if f"{key}.bias" in sd:
+        out["bias"] = _np(sd[f"{key}.bias"])
+    return out
+
+
+def split_fused_qkv(weight, bias, num_heads: int, head_dim: int,
+                    layout: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """Un-fuse a packed QKV projection into q/k/v flax kernels.
+
+    The reference's split-qkv feature mixin
+    (module_inject/containers/features/split_qkv.py). Layouts:
+    - ``"concat"``: [in, 3*H_out] columns are (all-q, all-k, all-v) — GPT-2
+      Conv1D.
+    - ``"per_head"``: [3*H_out, in] rows are per-head (q_h,k_h,v_h) blocks —
+      BLOOM / GPT-NeoX ``query_key_value``.
+    """
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    if layout == "concat":
+        w = _np(weight)  # [in, 3*out] (Conv1D storage)
+        ws = np.split(w, 3, axis=1)
+        bs = np.split(_np(bias), 3) if bias is not None else [None] * 3
+    elif layout == "per_head":
+        w = _np(weight)  # [3*out, in]
+        hidden_in = w.shape[1]
+        wr = w.reshape(num_heads, 3, head_dim, hidden_in)
+        ws = [wr[:, i].reshape(num_heads * head_dim, hidden_in).T for i in range(3)]
+        if bias is not None:
+            br = _np(bias).reshape(num_heads, 3, head_dim)
+            bs = [br[:, i].reshape(-1) for i in range(3)]
+        else:
+            bs = [None] * 3
+    else:
+        raise ValueError(f"unknown fused-qkv layout {layout!r}")
+    for name, w_i, b_i in zip(("q_proj", "k_proj", "v_proj"), ws, bs):
+        out[name] = {"kernel": np.ascontiguousarray(w_i)}
+        if b_i is not None:
+            out[name]["bias"] = b_i
+    return out
+
+
+class TransformerPolicy:
+    """Base policy. Subclasses are auto-registered."""
+
+    # HF ``model_type`` strings this policy owns
+    model_types: tuple = ()
+    # substrings of the HF class name, as a fallback matcher (the reference
+    # matches on ``policy_attn_linear_layer``-style class identity)
+    class_name_hints: tuple = ()
+
+    @classmethod
+    def match(cls, hf_config) -> bool:
+        mt = getattr(hf_config, "model_type", None)
+        if mt in cls.model_types:
+            return True
+        arch = (getattr(hf_config, "architectures", None) or [""])[0]
+        return any(h in arch for h in cls.class_name_hints if h)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        raise NotImplementedError
+
+    def convert(self, sd: Dict[str, Any], hf_config) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def tp_rules(self) -> List[Rule]:
+        # unified param names align with the default rule set by construction
+        return list(DEFAULT_TP_RULES)
+
+
+replace_policies: List[type] = []
+
+
+def register_policy(cls):
+    replace_policies.append(cls)
+    return cls
+
+
+def policy_for(hf_config) -> Optional[TransformerPolicy]:
+    """Find the policy owning an HF config (reference replace_module.py walks
+    ``replace_policies`` the same way). Exact ``model_type`` matches win over
+    class-name-hint matches so e.g. ``GPT2ModelPipe`` Megatron configs are not
+    claimed by the GPT-2 policy's "GPT2" substring hint."""
+    import deepspeed_tpu.module_inject.containers  # noqa: F401  (registers)
+
+    mt = getattr(hf_config, "model_type", None)
+    for cls in replace_policies:
+        if mt in cls.model_types:
+            return cls()
+    for cls in replace_policies:
+        if cls.match(hf_config):
+            return cls()
+    return None

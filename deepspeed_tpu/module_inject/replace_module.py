@@ -1,0 +1,99 @@
+"""HF-model conversion driver (reference module_inject/replace_module.py:282).
+
+``replace_transformer_layer`` in the reference mutates a torch model in
+place, swapping every transformer block for the fused inference module and
+slicing weights per TP rank. The TPU equivalent is a *pure conversion*:
+
+    injected = convert_hf_model(hf_model)            # or (state_dict, config)
+    logits = injected.apply(input_ids)               # flax forward
+    specs  = injected.shardings(mesh)                # TP/ZeRO PartitionSpecs
+
+The policy registry picks the architecture adapter; unknown architectures
+fall back to ``AutoTP`` rule synthesis over an already-JAX parameter tree.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.unified import TransformerConfig, TransformerLM
+from deepspeed_tpu.module_inject.policy import TransformerPolicy, policy_for
+from deepspeed_tpu.parallel.partition import Rule
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class InjectedModel:
+    """A converted model: unified config + flax params + TP rules."""
+
+    cfg: TransformerConfig
+    params: Dict[str, Any]
+    rules: List[Rule]
+    policy: Optional[TransformerPolicy] = None
+    model: Optional[TransformerLM] = None
+
+    def __post_init__(self):
+        if self.model is None:
+            self.model = TransformerLM(self.cfg)
+
+    def apply(self, input_ids, **kwargs):
+        return self.model.apply({"params": self.params}, input_ids, **kwargs)
+
+    def shardings(self, mesh, shard_data: bool = False):
+        """NamedShardings for the param tree under ``mesh`` (TP via rules,
+        optional ZeRO-3-style data-axis sharding)."""
+        from deepspeed_tpu.parallel.partition import tree_shardings
+
+        return tree_shardings(self.params, mesh, rules=self.rules,
+                              shard_data_axis=shard_data)
+
+    def cast(self, dtype):
+        """Cast floating-point params (the reference's fp16/int8 conversion
+        happens at injection time too)."""
+        import jax
+
+        self.params = jax.tree.map(
+            lambda a: a.astype(dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a, self.params)
+        return self
+
+
+def convert_hf_model(model=None, state_dict=None, hf_config=None,
+                     dtype=None, policy: Optional[TransformerPolicy] = None
+                     ) -> InjectedModel:
+    """Convert an HF torch model (or its state_dict + config) to flax.
+
+    The conversion analogue of ``replace_transformer_layer``: policy lookup,
+    weight re-layout (transpose / qkv un-fuse), config mapping.
+    """
+    if model is not None:
+        hf_config = hf_config or model.config
+        state_dict = state_dict if state_dict is not None else model.state_dict()
+    if hf_config is None or state_dict is None:
+        raise ValueError("need an HF model, or state_dict + hf_config")
+
+    policy = policy or policy_for(hf_config)
+    if policy is None:
+        raise ValueError(
+            f"no injection policy for model_type="
+            f"{getattr(hf_config, 'model_type', '?')!r}; supported types are "
+            f"registered in deepspeed_tpu/module_inject/containers/")
+
+    cfg = policy.build_config(hf_config, dtype=dtype)
+    params = policy.convert(dict(state_dict), hf_config)
+    injected = InjectedModel(cfg=cfg, params=params, rules=policy.tp_rules(),
+                             policy=policy)
+    if dtype is not None:
+        injected.cast(dtype)
+    logger.info("converted %s (%d layers, hidden %d) via %s",
+                getattr(hf_config, "model_type", "?"), cfg.num_layers,
+                cfg.hidden_size, type(policy).__name__)
+    return injected
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, config=None,
+                              checkpoint_dict=None, model_config=None):
+    """Name-parity wrapper over :func:`convert_hf_model`."""
+    return convert_hf_model(model=model, hf_config=model_config)

@@ -63,18 +63,18 @@ def make_pipeline_lm_loss(cfg: LlamaConfig, mesh, num_micro: Optional[int] = Non
             embed_tab = rest_rep["embed_tokens"]["embedding"]
             x = embed_tab[input_ids].astype(cfg.dtype)
             mask = make_causal_mask(S)
-            positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B_loc, 0)
 
             assert B_loc % M == 0, (
                 f"local batch {B_loc} must divide into {M} pipeline microbatches")
             micro = x.reshape(M, B_loc // M, S, x.shape[-1])
-            mpos = positions.reshape(M, B_loc // M, S)
+            # positions are the same arange for every full-sequence microbatch;
+            # [1, S] broadcasts over the microbatch dim inside rotary
+            upos = jnp.arange(S, dtype=jnp.int32)[None, :]
 
             def stage_fn(local_blocks, xm):
                 # apply this stage's layer shard sequentially
                 def layer(x, layer_params):
-                    y = block.apply({"params": layer_params}, x, mask,
-                                    mpos[0])
+                    y = block.apply({"params": layer_params}, x, mask, upos)
                     return y, None
 
                 y, _ = lax.scan(layer, xm, local_blocks)

@@ -44,6 +44,7 @@ class TransformerConfig:
 
     pos_emb: str = "learned"                     # learned|rotary|alibi|none
     pos_offset: int = 0                          # OPT stores positions at +2
+    pos_from_mask: bool = False                  # OPT: positions = cumsum(mask)-1
     rope_base: float = 10000.0
     rotary_dim: Optional[int] = None             # partial rotary
     rotary_interleaved: bool = False             # GPT-J pairing
@@ -160,7 +161,13 @@ class TransformerLM(nn.Module):
                        param_dtype=jnp.float32, name="wte")
         x = wte(input_ids)
         if positions is None:
-            positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+            if cfg.pos_from_mask and attention_mask is not None:
+                # HF OPT: positions count real tokens only, so left-padded
+                # batches start at position 0 (OPTLearnedPositionalEmbedding)
+                am = attention_mask.astype(jnp.int32)
+                positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0, None)
+            else:
+                positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
         if cfg.pos_emb == "learned":
             wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
                            dtype=cfg.dtype, param_dtype=jnp.float32, name="wpe")

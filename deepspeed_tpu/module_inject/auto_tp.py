@@ -32,7 +32,11 @@ COL_PARALLEL_MARKERS = (
     "intermediate.dense", "intermediate/dense",
 )
 EMBEDDING_MARKERS = ("wte", "embed_tokens", "word_embeddings", "embedding",
-                     "embed_in", "lm_head", "embed_out")
+                     "embed_in")
+# Output heads are flax kernels [hidden, vocab]: shard the vocab (output)
+# dim, not the contraction dim — matches DEFAULT_TP_RULES' lm_head rule and
+# avoids an all-reduce over full [B, S, vocab] logits.
+LM_HEAD_MARKERS = ("lm_head", "embed_out")
 
 
 class AutoTP:
@@ -52,6 +56,9 @@ class AutoTP:
         for m in COL_PARALLEL_MARKERS:
             if m.replace(".", "/") in p or m in p:
                 return "col"
+        for m in LM_HEAD_MARKERS:
+            if re.search(rf"(^|/){m}(/|$)", p):
+                return "lm_head"
         for m in EMBEDDING_MARKERS:
             if re.search(rf"(^|/){m}(/|$)", p):
                 return "embed"
@@ -74,11 +81,9 @@ class AutoTP:
             base = p[:-len("/kernel")] if p.endswith("/kernel") else p
             kind = AutoTP.kernel_class(base)
             esc = re.escape(p)
-            if kind == "col":
+            if kind in ("col", "lm_head"):
                 rules.append((esc, (None, TENSOR_AXIS)))
-            elif kind == "row":
-                rules.append((esc, (TENSOR_AXIS, None)))
-            elif kind == "embed":
+            elif kind in ("row", "embed"):
                 rules.append((esc, (TENSOR_AXIS, None)))
         return rules
 

@@ -17,7 +17,11 @@ class HFLlamaLayerPolicy(TransformerPolicy):
 
     def build_config(self, hf_config, dtype=None) -> TransformerConfig:
         tie = getattr(hf_config, "tie_word_embeddings", False)
+        # Mistral: per-layer sliding-window attention
+        window = getattr(hf_config, "sliding_window", None)
+        windows = ((window,) * hf_config.num_hidden_layers) if window else None
         return TransformerConfig(
+            attn_windows=windows,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
             num_layers=hf_config.num_hidden_layers,

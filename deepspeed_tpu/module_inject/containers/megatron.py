@@ -41,13 +41,27 @@ class MegatronLayerPolicy(TransformerPolicy):
     def convert(self, sd, hf_config):
         cfg = self.build_config(hf_config)
         head_dim = cfg.hidden_size // cfg.num_heads
+        # checkpoint_version < 2 stores fused QKV rows as (all-q, all-k,
+        # all-v); v2+ interleaves per head (reference MegatronLayerPolicy's
+        # megatron_v2 split)
+        version = getattr(hf_config, "checkpoint_version", None)
+        version = 2 if version is None else version  # unspecified → modern layout
+        qkv_layout = "per_head" if version >= 2 else "concat_rows"
         # locate the transformer root / embedding root by probing
-        prefix = next(p for p in ("language_model.transformer.", "transformer.",
-                                  "model.", "")
-                      if f"{p}layers.0.input_layernorm.weight" in sd)
-        emb = next(p for p in ("language_model.embedding.", "embedding.",
-                               prefix, "")
-                   if f"{p}word_embeddings.weight" in sd)
+        prefix = next((p for p in ("language_model.transformer.", "transformer.",
+                                   "model.", "")
+                       if f"{p}layers.0.input_layernorm.weight" in sd), None)
+        if prefix is None:
+            raise ValueError(
+                "unrecognized Megatron state_dict layout: no "
+                "'<root>layers.0.input_layernorm.weight' under any known root")
+        emb = next((p for p in ("language_model.embedding.", "embedding.",
+                                prefix, "")
+                    if f"{p}word_embeddings.weight" in sd), None)
+        if emb is None:
+            raise ValueError(
+                "unrecognized Megatron state_dict layout: no "
+                "'<root>word_embeddings.weight' under any known root")
         params = {
             "wte": {"embedding": _np(sd[f"{emb}word_embeddings.weight"])},
             "wpe": {"embedding": _np(sd[f"{emb}position_embeddings.weight"])},
@@ -57,7 +71,7 @@ class MegatronLayerPolicy(TransformerPolicy):
             b = f"{prefix}layers.{i}"
             attn = split_fused_qkv(sd[f"{b}.attention.query_key_value.weight"],
                                    sd.get(f"{b}.attention.query_key_value.bias"),
-                                   cfg.num_heads, head_dim, layout="per_head")
+                                   cfg.num_heads, head_dim, layout=qkv_layout)
             attn["o_proj"] = dense_(sd, f"{b}.attention.dense")
             params[f"layer_{i}"] = {
                 "ln_1": ln_(sd, f"{b}.input_layernorm"),

@@ -30,7 +30,7 @@ class HFOPTLayerPolicy(TransformerPolicy):
             num_heads=hf_config.num_attention_heads,
             intermediate_size=hf_config.ffn_dim,
             max_seq_len=hf_config.max_position_embeddings,
-            pos_emb="learned", pos_offset=2,
+            pos_emb="learned", pos_offset=2, pos_from_mask=True,
             norm="layernorm",
             pre_ln=hf_config.do_layer_norm_before,
             activation={"relu": "relu", "gelu": "gelu"}.get(

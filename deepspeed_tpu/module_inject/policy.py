@@ -1,7 +1,8 @@
 """Policy contract + registry (reference module_inject/policy.py:42).
 
 A policy declares, for one HF architecture family:
-- ``match(hf_config)``: does this policy own the config?
+- ``model_types`` / ``class_name_hints``: ownership claims, resolved by
+  ``policy_for`` (exact model_type first, then longest matched hint);
 - ``build_config(hf_config)``: HF config → ``TransformerConfig`` for the
   unified flax model (the role of ``create_ds_model_config``,
   containers/base.py:83);
@@ -60,12 +61,18 @@ def split_fused_qkv(weight, bias, num_heads: int, head_dim: int,
     - ``"concat"``: [in, 3*H_out] columns are (all-q, all-k, all-v) — GPT-2
       Conv1D.
     - ``"per_head"``: [3*H_out, in] rows are per-head (q_h,k_h,v_h) blocks —
-      BLOOM / GPT-NeoX ``query_key_value``.
+      BLOOM / GPT-NeoX ``query_key_value`` (Megatron checkpoint_version ≥ 2).
+    - ``"concat_rows"``: [3*H_out, in] rows are (all-q, all-k, all-v) —
+      Megatron checkpoint_version 0.
     """
     out: Dict[str, Dict[str, np.ndarray]] = {}
     if layout == "concat":
         w = _np(weight)  # [in, 3*out] (Conv1D storage)
         ws = np.split(w, 3, axis=1)
+        bs = np.split(_np(bias), 3) if bias is not None else [None] * 3
+    elif layout == "concat_rows":
+        w = _np(weight)  # [3*out, in]
+        ws = [part.T for part in np.split(w, 3, axis=0)]
         bs = np.split(_np(bias), 3) if bias is not None else [None] * 3
     elif layout == "per_head":
         w = _np(weight)  # [3*out, in]
@@ -94,14 +101,6 @@ class TransformerPolicy:
     # substrings of the HF class name, as a fallback matcher (the reference
     # matches on ``policy_attn_linear_layer``-style class identity)
     class_name_hints: tuple = ()
-
-    @classmethod
-    def match(cls, hf_config) -> bool:
-        mt = getattr(hf_config, "model_type", None)
-        if mt in cls.model_types:
-            return True
-        arch = (getattr(hf_config, "architectures", None) or [""])[0]
-        return any(h in arch for h in cls.class_name_hints if h)
 
     def build_config(self, hf_config, dtype=None) -> TransformerConfig:
         raise NotImplementedError
@@ -133,7 +132,13 @@ def policy_for(hf_config) -> Optional[TransformerPolicy]:
     for cls in replace_policies:
         if mt in cls.model_types:
             return cls()
+    # hint matches: the longest matched hint wins, so "GPT2ModelPipe"
+    # (Megatron) beats the GPT-2 policy's shorter "GPT2" substring even when
+    # the config carries no model_type at all
+    arch = (getattr(hf_config, "architectures", None) or [""])[0]
+    best, best_len = None, 0
     for cls in replace_policies:
-        if cls.match(hf_config):
-            return cls()
-    return None
+        for h in cls.class_name_hints:
+            if h and h in arch and len(h) > best_len:
+                best, best_len = cls, len(h)
+    return best() if best else None

@@ -1,0 +1,528 @@
+"""Block-sparse attention — Pallas TPU kernel + sparsity layout configs.
+
+TPU-native replacement for the reference's sparse-attention stack
+(``deepspeed/ops/sparse_attention/``): the Triton SDD/DSD matmuls + sparse
+softmax (``matmul.py``, ``softmax.py``, ``trsrc/*.tr``) become one blocked
+Pallas kernel that runs online softmax over only the kv blocks present in a
+per-head block layout; the layout-generator classes mirror
+``sparsity_config.py:10-430`` (Dense / Fixed / Variable / BigBird /
+BSLongformer / LocalSlidingWindow).
+
+Design:
+- a layout is an int32 array [num_heads, num_q_blocks, num_kv_blocks] of 0/1,
+  built host-side by a ``SparsityConfig`` subclass (same knobs as the
+  reference classes — local windows, global blocks, random blocks,
+  uni/bidirectional).
+- the kernel reuses the flash-attention scheme (grid (B,H,nq,nk), VMEM
+  running max/sum/acc, fp32 statistics) and skips absent blocks with
+  ``pl.when`` on a scalar-prefetched layout value: skipped blocks cost a DMA
+  but no MXU work. Fully-absent rows produce zeros.
+- backward: recompute VJP through the XLA dense-masked reference — the same
+  layout expanded to an element mask — so gradients agree with the kernel.
+- off-TPU the kernel runs with ``interpret=True`` so the CPU-mesh tests work.
+
+Determinism: random blocks (Variable/BigBird) are drawn from a seeded
+``numpy.random.RandomState`` so layouts are reproducible across hosts.
+"""
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Sparsity layout configs (reference: ops/sparse_attention/sparsity_config.py)
+# ---------------------------------------------------------------------------
+
+
+class SparsityConfig:
+    """Base layout builder (reference ``SparsityConfig`` sparsity_config.py:10).
+
+    ``block`` is the square block edge; ``different_layout_per_head`` controls
+    whether every head gets its own pattern or head 0's pattern is broadcast.
+    """
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+        self.num_layout_heads = num_heads if different_layout_per_head else 1
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block != 0:
+            raise ValueError(
+                f"seq_len {seq_len} must be a multiple of block {self.block}")
+        num_blocks = seq_len // self.block
+        return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int32)
+
+    def propagate_first_head(self, layout: np.ndarray) -> np.ndarray:
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks present — degenerate layout for parity testing
+    (reference ``DenseSparsityConfig``)."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Fixed local windows + periodic global blocks
+    (reference ``FixedSparsityConfig`` sparsity_config.py:95).
+
+    Every run of ``num_local_blocks`` consecutive blocks attends within
+    itself; the last ``num_global_blocks`` block-columns of each window are
+    global (every row attends them). ``num_different_global_patterns`` slides
+    the global column choice per head group (requires
+    ``different_layout_per_head``). ``attention='unidirectional'`` masks the
+    final layout to the lower triangle.
+    """
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_local_blocks: int = 4, num_global_blocks: int = 1,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False,
+                 num_different_global_patterns: int = 1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise ValueError(f"invalid attention {attention!r}")
+        if horizontal_global_attention and attention != "bidirectional":
+            raise ValueError("horizontal global attention requires "
+                             "bidirectional attention")
+        if num_local_blocks % num_global_blocks != 0:
+            raise ValueError("num_local_blocks must be a multiple of "
+                             "num_global_blocks")
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError("num_different_global_patterns > 1 requires "
+                             "different_layout_per_head")
+        if num_different_global_patterns > num_local_blocks // num_global_blocks:
+            raise ValueError("num_different_global_patterns is capped at "
+                             "num_local_blocks // num_global_blocks")
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        for h in range(self.num_layout_heads):
+            # local windows
+            for start in range(0, nb, self.num_local_blocks):
+                end = min(start + self.num_local_blocks, nb)
+                layout[h, start:end, start:end] = 1
+            # global columns: one group of num_global_blocks per window,
+            # group index rotated by head pattern
+            pattern = h % self.num_different_global_patterns
+            first = (self.num_local_blocks
+                     - (pattern + 1) * self.num_global_blocks)
+            for start in range(0, nb, self.num_local_blocks):
+                cols = range(start + first,
+                             min(start + first + self.num_global_blocks, nb))
+                for c in cols:
+                    if c < 0:
+                        continue
+                    layout[h, :, c] = 1
+                    if self.horizontal_global_attention:
+                        layout[h, c, :] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.propagate_first_head(layout)
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Variable-size local windows + explicit global indices + random blocks
+    (reference ``VariableSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_random_blocks: int = 0,
+                 local_window_blocks: Optional[Sequence[int]] = None,
+                 global_block_indices: Optional[Sequence[int]] = None,
+                 global_block_end_indices: Optional[Sequence[int]] = None,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False,
+                 seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise ValueError(f"invalid attention {attention!r}")
+        if horizontal_global_attention and attention != "bidirectional":
+            raise ValueError("horizontal global attention requires "
+                             "bidirectional attention")
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = list(local_window_blocks or [4])
+        self.global_block_indices = list(global_block_indices or [0])
+        if global_block_end_indices is not None:
+            if len(global_block_end_indices) != len(self.global_block_indices):
+                raise ValueError("global_block_end_indices must match "
+                                 "global_block_indices in length")
+            for s, e in zip(self.global_block_indices, global_block_end_indices):
+                if e <= s:
+                    raise ValueError("global block end must exceed start")
+        self.global_block_end_indices = (
+            list(global_block_end_indices) if global_block_end_indices else None)
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.seed = seed
+
+    def _global_cols(self, nb: int) -> List[int]:
+        cols: List[int] = []
+        if self.global_block_end_indices is None:
+            cols = [i for i in self.global_block_indices if i < nb]
+        else:
+            for s, e in zip(self.global_block_indices,
+                            self.global_block_end_indices):
+                cols.extend(range(s, min(e, nb)))
+        return cols
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        rng = np.random.RandomState(self.seed)
+        for h in range(self.num_layout_heads):
+            # local: consecutive windows of the listed sizes; the last size
+            # repeats for the remainder of the sequence
+            start = 0
+            i = 0
+            while start < nb:
+                size = self.local_window_blocks[
+                    min(i, len(self.local_window_blocks) - 1)]
+                end = min(start + size, nb)
+                layout[h, start:end, start:end] = 1
+                start = end
+                i += 1
+            for c in self._global_cols(nb):
+                layout[h, :, c] = 1
+                if self.horizontal_global_attention:
+                    layout[h, c, :] = 1
+            for r in range(nb):
+                for c in rng.choice(nb, size=min(self.num_random_blocks, nb),
+                                    replace=False):
+                    layout[h, r, c] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.propagate_first_head(layout)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """Sliding window + random + global first/last blocks
+    (reference ``BigBirdSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_random_blocks: int = 1,
+                 num_sliding_window_blocks: int = 3,
+                 num_global_blocks: int = 1,
+                 attention: str = "bidirectional", seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise ValueError(f"invalid attention {attention!r}")
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        rng = np.random.RandomState(self.seed)
+        g = min(self.num_global_blocks, nb)
+        for h in range(self.num_layout_heads):
+            for r in range(nb):
+                layout[h, r, max(0, r - w):min(nb, r + w + 1)] = 1
+                cols = rng.choice(nb, size=min(self.num_random_blocks, nb),
+                                  replace=False)
+                layout[h, r, cols] = 1
+            # global: first g block rows/cols; bidirectional adds last g too
+            layout[h, :g, :] = 1
+            layout[h, :, :g] = 1
+            if self.attention == "bidirectional":
+                layout[h, -g:, :] = 1
+                layout[h, :, -g:] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.propagate_first_head(layout)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Sliding window + explicit global rows/cols
+    (reference ``BSLongformerSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_sliding_window_blocks: int = 3,
+                 global_block_indices: Optional[Sequence[int]] = None,
+                 global_block_end_indices: Optional[Sequence[int]] = None,
+                 attention: str = "bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = list(global_block_indices or [0])
+        self.global_block_end_indices = (
+            list(global_block_end_indices) if global_block_end_indices else None)
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        if self.global_block_end_indices is None:
+            globals_ = [i for i in self.global_block_indices if i < nb]
+        else:
+            globals_ = []
+            for s, e in zip(self.global_block_indices,
+                            self.global_block_end_indices):
+                globals_.extend(range(s, min(e, nb)))
+        for h in range(self.num_layout_heads):
+            for r in range(nb):
+                layout[h, r, max(0, r - w):min(nb, r + w + 1)] = 1
+            for i in globals_:
+                layout[h, i, :] = 1
+                layout[h, :, i] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.propagate_first_head(layout)
+
+
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Pure sliding-window band
+    (reference ``LocalSlidingWindowSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 num_sliding_window_blocks: int = 3,
+                 attention: str = "unidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head=False)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        for r in range(nb):
+            lo = max(0, r - w)
+            hi = min(nb, r + w + 1) if self.attention == "bidirectional" \
+                else r + 1
+            layout[0, r, lo:hi] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.propagate_first_head(layout)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _layout_to_element_mask(layout: jnp.ndarray, block: int,
+                            sq: int, sk: int) -> jnp.ndarray:
+    """[H, nq, nk] block layout → [H, sq, sk] boolean element mask."""
+    mask = jnp.repeat(jnp.repeat(layout, block, axis=1), block, axis=2)
+    return mask[:, :sq, :sk].astype(bool)
+
+
+def _reference_sparse_attention(q, k, v, layout, block, sm_scale, kpm):
+    """Dense-masked XLA attention — ground truth for tests and the VJP.
+
+    q,k,v: [B,S,H,D]; layout: [H,nq,nk]; kpm: optional [B,Sk] 1=keep.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    mask = _layout_to_element_mask(layout, block, q.shape[1], k.shape[1])
+    scores = jnp.where(mask[None], scores, NEG_INF)
+    if kpm is not None:
+        scores = jnp.where(kpm[:, None, None, :].astype(bool), scores, NEG_INF)
+    # rows with no visible key (sparse row ∩ padded keys) → zero output
+    any_valid = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF / 2
+    weights = jax.nn.softmax(scores, axis=-1)
+    weights = jnp.where(any_valid, weights, 0.0).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, kpm_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *,
+                       sm_scale: float, block_k: int, kv_len: int,
+                       num_kv_blocks: int):
+    h = pl.program_id(1)
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(layout_ref[h, qi, ki] != 0)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < kv_len
+        valid = jnp.logical_and(valid, kpm_ref[0][None, :] != 0)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])
+        # fully-masked rows: exp(NEG_INF - NEG_INF) = 1 — suppress them
+        p = jnp.where(jnp.broadcast_to(m_next[:, :1] > NEG_INF / 2, p.shape),
+                      p, 0.0)
+        l_next = corr * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * corr[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = m_next
+        l_scr[...] = l_next
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
+        o_ref[0, 0, ...] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+def _sparse_fwd(q, k, v, layout, kpm, block, sm_scale, interpret):
+    """q,k,v: [B,H,S,D]; layout: [H,nq,nk]; kpm: [B,Sk] int32 1=keep."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    pad_q = (-S) % block
+    pad_k = (-Sk) % block
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        kpm = jnp.pad(kpm, ((0, 0), (0, pad_k)))
+    nq, nk = (S + pad_q) // block, (Sk + pad_k) // block
+
+    kernel = functools.partial(
+        _sparse_fwd_kernel, sm_scale=sm_scale, block_k=block,
+        kv_len=Sk, num_kv_blocks=nk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, qi, 0)),
+                pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, ki, 0)),
+                pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, ki, 0)),
+                pl.BlockSpec((1, block), lambda b, h, qi, ki, L: (b, ki)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block, D),
+                                   lambda b, h, qi, ki, L: (b, h, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block, 128), jnp.float32),
+                pltpu.VMEM((block, 128), jnp.float32),
+                pltpu.VMEM((block, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S + pad_q, D), q.dtype),
+        interpret=interpret,
+    )(layout, q, k, v, kpm)
+    if pad_q:
+        out = out[:, :, :S, :]
+    return out
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _sparse_attention(q, k, v, layout, kpm, block, sm_scale):
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _sparse_fwd(qt, kt, vt, layout, kpm, block, sm_scale,
+                      interpret=_use_interpret())
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _fwd_rule(q, k, v, layout, kpm, block, sm_scale):
+    return (_sparse_attention(q, k, v, layout, kpm, block, sm_scale),
+            (q, k, v, layout, kpm))
+
+
+def _bwd_rule(block, sm_scale, residuals, do):
+    q, k, v, layout, kpm = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_sparse_attention(
+            q_, k_, v_, layout, block, sm_scale, kpm), q, k, v)
+    dq, dk, dv = vjp(do)
+    return dq, dk, dv, None, None
+
+
+_sparse_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+def sparse_attention(q, k, v, layout, block: int,
+                     sm_scale: Optional[float] = None,
+                     key_padding_mask=None):
+    """Block-sparse attention over [B, S, H, D] tensors.
+
+    ``layout`` is a [H, nq, nk] 0/1 array (numpy or jax) from a
+    ``SparsityConfig``; ``key_padding_mask`` is an optional [B, Sk] array,
+    nonzero = attend. Differentiable (recompute VJP against the dense-masked
+    reference).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    layout = jnp.asarray(layout, dtype=jnp.int32)
+    if key_padding_mask is None:
+        key_padding_mask = jnp.ones((q.shape[0], k.shape[1]), dtype=jnp.int32)
+    else:
+        key_padding_mask = jnp.asarray(key_padding_mask, dtype=jnp.int32)
+    return _sparse_attention(q, k, v, layout, key_padding_mask,
+                             int(block), float(sm_scale))
+
+
+class SparseSelfAttention:
+    """Config-driven sparse attention callable
+    (reference ``SparseSelfAttention`` sparse_self_attention.py:12).
+
+    Builds (and caches) the block layout per sequence length and applies the
+    Pallas kernel. Use as the attention core inside a transformer block.
+    """
+
+    def __init__(self, sparsity_config: SparsityConfig):
+        self.sparsity_config = sparsity_config
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int) -> jnp.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = jnp.asarray(
+                self.sparsity_config.make_layout(seq_len), dtype=jnp.int32)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v, key_padding_mask=None,
+                 sm_scale: Optional[float] = None):
+        layout = self.get_layout(q.shape[1])
+        return sparse_attention(q, k, v, layout, self.sparsity_config.block,
+                                sm_scale=sm_scale,
+                                key_padding_mask=key_padding_mask)

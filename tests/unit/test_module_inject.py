@@ -169,3 +169,86 @@ def test_auto_tp_rules_cover_converted_tree(dp4_tp2_mesh):
     assert flat["layer_0/attn/q_proj/kernel"] == (None, "tensor")
     assert flat["layer_0/attn/o_proj/kernel"] == ("tensor", None)
     assert flat["layer_0/mlp/c_fc/kernel"] == (None, "tensor")
+
+
+def test_split_fused_qkv_layouts_agree():
+    """concat_rows (Megatron v0) and per_head (v2) splits of the same q/k/v
+    must recover identical kernels."""
+    from deepspeed_tpu.module_inject.policy import split_fused_qkv
+
+    rng = np.random.default_rng(0)
+    heads, head_dim, hidden = 3, 4, 12
+    q = rng.standard_normal((heads * head_dim, hidden)).astype(np.float32)
+    k = rng.standard_normal((heads * head_dim, hidden)).astype(np.float32)
+    v = rng.standard_normal((heads * head_dim, hidden)).astype(np.float32)
+    bq, bk, bv = (rng.standard_normal(heads * head_dim).astype(np.float32)
+                  for _ in range(3))
+
+    w_rows = np.concatenate([q, k, v], axis=0)                 # [3*out, in]
+    b_rows = np.concatenate([bq, bk, bv])
+    qh = q.reshape(heads, head_dim, hidden)
+    kh = k.reshape(heads, head_dim, hidden)
+    vh = v.reshape(heads, head_dim, hidden)
+    w_ph = np.stack([qh, kh, vh], axis=1).reshape(3 * heads * head_dim, hidden)
+    b_ph = np.stack([bq.reshape(heads, head_dim), bk.reshape(heads, head_dim),
+                     bv.reshape(heads, head_dim)], axis=1).reshape(-1)
+
+    a = split_fused_qkv(torch.from_numpy(w_rows), torch.from_numpy(b_rows),
+                        heads, head_dim, layout="concat_rows")
+    b = split_fused_qkv(torch.from_numpy(w_ph), torch.from_numpy(b_ph),
+                        heads, head_dim, layout="per_head")
+    for name in ("q_proj", "k_proj", "v_proj"):
+        np.testing.assert_allclose(a[name]["kernel"], b[name]["kernel"])
+        np.testing.assert_allclose(a[name]["bias"], b[name]["bias"])
+
+
+def test_policy_for_longest_hint_wins():
+    """architectures=['GPT2ModelPipe'] with no model_type must resolve to the
+    Megatron policy, not GPT-2's shorter 'GPT2' substring hint."""
+    from deepspeed_tpu.module_inject.containers.megatron import (
+        MegatronLayerPolicy,
+    )
+
+    class FakeCfg:
+        architectures = ["GPT2ModelPipe"]
+
+    assert isinstance(policy_for(FakeCfg()), MegatronLayerPolicy)
+
+
+def test_opt_left_padded_positions_match_hf():
+    """Left-padded OPT batches: HF derives positions from the attention-mask
+    cumsum; the converted model must agree on real (unpadded) tokens."""
+    from transformers import OPTConfig, OPTForCausalLM
+
+    torch.manual_seed(0)
+    m = OPTForCausalLM(OPTConfig(vocab_size=128, hidden_size=32,
+                                 num_attention_heads=4, num_hidden_layers=2,
+                                 ffn_dim=64, max_position_embeddings=64))
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 128, (2, 10)).astype(np.int64)
+    mask = np.ones((2, 10), np.int64)
+    mask[0, :4] = 0  # left padding on row 0
+
+    m.eval()
+    with torch.no_grad():
+        expected = m(input_ids=torch.from_numpy(ids),
+                     attention_mask=torch.from_numpy(mask)).logits.numpy()
+    injected = convert_hf_model(m)
+    got = np.asarray(injected.apply(ids.astype(np.int32),
+                                    attention_mask=mask.astype(np.int32)))
+    real = mask.astype(bool)
+    np.testing.assert_allclose(got[real], expected[real], atol=2e-4, rtol=1e-3)
+
+
+def test_mistral_sliding_window_parity():
+    """Mistral's sliding-window attention must be wired into attn_windows;
+    seq_len > window exercises the truncation."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(0)
+    m = MistralForCausalLM(MistralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=4))
+    ids = np.random.default_rng(2).integers(0, 128, (2, 12)).astype(np.int64)
+    _check(m, ids=ids, atol=5e-4)

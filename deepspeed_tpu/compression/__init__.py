@@ -1,0 +1,7 @@
+from deepspeed_tpu.compression.compress import (
+    Compressor, init_compression, redundancy_clean, STEP_KEY,
+)
+from deepspeed_tpu.compression.config import (
+    CompressionConfig, get_compression_config,
+)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler

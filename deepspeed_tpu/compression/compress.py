@@ -1,0 +1,384 @@
+"""Compression engine — functional param-tree transforms.
+
+TPU-native replacement for the reference compression stack
+(``deepspeed/compression/compress.py`` — ``init_compression`` /
+``redundancy_clean`` — and the ``*_Compress`` replacement layers in
+``basic_layer.py:65-600``). Where the reference swaps ``nn.Linear`` for
+``LinearLayer_Compress`` modules that mutate their weights, here compression
+is a *pure function* ``params, step → params`` applied inside the jitted
+train step:
+
+- quantization-aware training: straight-through fake-quant of matched
+  kernels, with the reference's start→target bit schedule (bits halve every
+  ``quantization_period`` steps) and optional fp16-mixed blending;
+- sparse / row / head / channel pruning: magnitude masks recomputed from the
+  live weights each step once the schedule offset passes — functionally
+  identical to the reference's mask reapplication in forward;
+- step gating uses ``jnp.where`` on a traced step scalar, so one compiled
+  program serves the whole schedule;
+- activation quantization: a flax interceptor fake-quantizing the outputs of
+  matched modules (the role of ``activation_quantization`` hooks);
+- ``redundancy_clean``: physically slices pruned structures out of the
+  pytree (row/head/layer), shrinking the model like the reference's clean-up
+  pass.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.config import (
+    CompressionConfig, get_compression_config,
+)
+from deepspeed_tpu.utils.logging import logger
+
+STEP_KEY = "_compression_step"
+
+
+def _match(pattern: str, path: str) -> bool:
+    """Regex search against the slash path AND its dotted spelling, so both
+    reference-style dotted module names ("attention.self") and regexes
+    ("layer_0.*c_fc") work unmangled."""
+    if pattern == "*":
+        return True
+    return (re.search(pattern, path) is not None
+            or re.search(pattern, path.replace("/", ".")) is not None)
+
+
+def _matched_group(cfg, path: str):
+    """First different_groups entry whose modules match this param path."""
+    for name, group in cfg.different_groups.items():
+        if any(_match(m, path) for m in group.modules):
+            return name, group
+    return None, None
+
+
+def _is_kernel(path: str, leaf) -> bool:
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2 and "kernel" in path
+
+
+# --- primitive transforms (all straight-through for gradients) --------------
+
+
+def _ste(original: jnp.ndarray, transformed: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through: forward sees `transformed`, backward sees identity."""
+    return original + jax.lax.stop_gradient(transformed - original)
+
+
+def _fake_quant(w, bits, shared, step):
+    """Symmetric/asymmetric per-group fake quantization with traced bits."""
+    groups = min(shared.quantize_groups, w.shape[0])
+    while w.size % groups:     # largest divisor ≤ quantize_groups
+        groups -= 1
+    flat = w.reshape(groups, -1)
+    if shared.rounding == "stochastic":
+        key = jax.random.fold_in(jax.random.PRNGKey(0), step)
+        noise = jax.random.uniform(key, flat.shape) - 0.5
+    else:
+        noise = 0.0
+    if shared.quantization_type == "asymmetric":
+        qmax = 2.0 ** bits - 1.0
+        mn = jnp.min(flat, axis=1, keepdims=True)
+        mx = jnp.max(flat, axis=1, keepdims=True)
+        scale = jnp.where(mx > mn, (mx - mn) / qmax, 1.0)
+        zp = jnp.round(-mn / scale)
+        q = jnp.clip(jnp.round(flat / scale + noise) + zp, 0, qmax)
+        deq = (q - zp) * scale
+    else:
+        qmax = 2.0 ** (bits - 1.0) - 1.0
+        absmax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = jnp.clip(jnp.round(flat / scale + noise), -qmax - 1, qmax)
+        deq = q * scale
+    return deq.reshape(w.shape)
+
+
+def _bits_at(step, group, offset):
+    """start→target bit schedule: halve every quantization_period steps after
+    the offset (reference basic_layer bit-reduction schedule)."""
+    active = jnp.maximum(step - offset, 0)
+    halvings = active // group.quantization_period
+    bits = jnp.maximum(
+        jnp.asarray(group.target_bits, jnp.float32),
+        group.start_bits / (2.0 ** jnp.minimum(halvings, 8).astype(jnp.float32)))
+    return jnp.floor(bits)
+
+
+def _topk_mask(scores: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """1.0 for the top `dense_ratio` fraction of scores (>=1 kept)."""
+    n = scores.size
+    k = max(1, int(round(n * dense_ratio)))
+    flat = scores.reshape(-1)
+    kth = jnp.sort(flat)[n - k]
+    return (flat >= kth).astype(jnp.float32).reshape(scores.shape)
+
+
+def _sparse_mask(w, ratio, method):
+    if method == "topk":
+        # structured per output unit (flax kernel: out = last axis)
+        scores = jnp.abs(w)
+        k = max(1, int(round(w.shape[0] * ratio)))
+        kth = jnp.sort(scores, axis=0)[w.shape[0] - k]
+        return (scores >= kth[None]).astype(jnp.float32)
+    return _topk_mask(jnp.abs(w), ratio)               # unstructured l1
+
+
+def _row_mask(w, ratio):
+    """Prune output units: flax kernel [in, out] → score columns by L1."""
+    scores = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    return _topk_mask(scores, ratio)[None, :] if w.ndim == 2 else \
+        _topk_mask(scores, ratio).reshape((1,) * (w.ndim - 1) + (-1,))
+
+
+def _head_mask(w, ratio, num_heads):
+    """Prune attention heads on the output projection: flax o_proj kernel
+    [hidden(=heads*hd), out] → score head slabs along axis 0 by L1."""
+    hd = w.shape[0] // num_heads
+    slabs = w.reshape(num_heads, hd, -1)
+    scores = jnp.sum(jnp.abs(slabs), axis=(1, 2))
+    mask = _topk_mask(scores, ratio)
+    return jnp.repeat(mask, hd)[:, None]
+
+
+def _channel_mask(w, ratio):
+    """Conv kernel [..., in, out]: prune output channels by filter L1."""
+    scores = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    shape = (1,) * (w.ndim - 1) + (w.shape[-1],)
+    return _topk_mask(scores, ratio).reshape(shape)
+
+
+# --- compressor -------------------------------------------------------------
+
+
+class Compressor:
+    """Per-parameter compression plan + the traced transform.
+
+    Built once from (config, params); ``compress(params, step)`` is pure and
+    jit-safe. ``wrap_loss`` injects it in front of any engine loss function.
+    """
+
+    def __init__(self, config: CompressionConfig, params: Any):
+        self.config = config
+        hp = config.head_pruning.shared_parameters
+        if hp.enabled and not hp.num_heads:
+            raise ValueError(
+                "head_pruning.shared_parameters.num_heads is required: "
+                "without it the kernel is one slab and nothing is pruned")
+        self._plan: Dict[str, List[Tuple[str, Any]]] = {}
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in flat:
+            p = _path_str(path)
+            if not _is_kernel(p, leaf):
+                continue
+            methods: List[Tuple[str, Any]] = []
+            for method in ("sparse_pruning", "row_pruning", "head_pruning",
+                           "channel_pruning", "weight_quantization"):
+                mcfg = getattr(config, method)
+                if not mcfg.shared_parameters.enabled:
+                    continue
+                _, group = _matched_group(mcfg, p)
+                if group is not None:
+                    methods.append((method, group))
+            if methods:
+                self._plan[p] = methods
+        if self._plan:
+            logger.info(f"compression plan covers {len(self._plan)} kernels")
+
+    # -- traced transform ---------------------------------------------------
+
+    def compress(self, params: Any, step) -> Any:
+        step = jnp.asarray(step, jnp.int32)
+
+        def visit(path, leaf):
+            p = _path_str(path)
+            methods = self._plan.get(p)
+            if not methods:
+                return leaf
+            w = leaf
+            out = w.astype(jnp.float32)
+            for method, group in methods:
+                shared = getattr(self.config, method).shared_parameters
+                gate = (step >= shared.schedule_offset).astype(jnp.float32)
+                if method == "sparse_pruning":
+                    mask = _sparse_mask(out, group.dense_ratio, shared.method)
+                elif method == "row_pruning":
+                    mask = _row_mask(out, group.dense_ratio)
+                elif method == "head_pruning":
+                    mask = _head_mask(out, group.dense_ratio, shared.num_heads)
+                elif method == "channel_pruning":
+                    mask = _channel_mask(out, group.dense_ratio)
+                else:  # weight_quantization
+                    bits = _bits_at(step, group, shared.schedule_offset)
+                    q = _fake_quant(out, bits, shared, step)
+                    if shared.fp16_mixed_quantize:
+                        ratio = jnp.clip(
+                            (step - shared.schedule_offset)
+                            * shared.quantize_change_ratio, 0.0, 1.0)
+                        q = ratio * q + (1.0 - ratio) * out
+                    out = out * (1 - gate) + gate * q
+                    continue
+                # pruning: masked weights once the schedule activates
+                out = out * ((1 - gate) + gate * mask)
+            return _ste(w, out.astype(w.dtype))
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    # -- engine integration -------------------------------------------------
+
+    def wrap_loss(self, loss_fn: Callable) -> Callable:
+        act_on = self.config.activation_quantization.shared_parameters.enabled
+
+        def wrapped(params, batch, rngs=None):
+            step = batch.get(STEP_KEY)
+            if step is None:
+                return loss_fn(params, batch)
+            params = self.compress(params, step)
+            batch = {k: v for k, v in batch.items() if k != STEP_KEY}
+            if act_on:
+                import flax.linen as nn
+                with nn.intercept_methods(self.activation_interceptor(step)):
+                    return loss_fn(params, batch)
+            return loss_fn(params, batch)
+        return wrapped
+
+    def activation_interceptor(self, step):
+        """flax ``nn.intercept_methods`` interceptor fake-quantizing outputs
+        of matched modules (activation_quantization); gated on the traced
+        step so one compiled program serves the whole schedule. Fires only on
+        flax module calls, i.e. when the loss function runs a flax model."""
+        from deepspeed_tpu.ops.quantizer import fake_quantize
+
+        cfg = self.config.activation_quantization
+        offset = cfg.shared_parameters.schedule_offset
+        step = jnp.asarray(step, jnp.int32)
+
+        def interceptor(next_fun, args, kwargs, context):
+            out = next_fun(*args, **kwargs)
+            if context.method_name != "__call__":
+                return out
+            path = (context.module.path and "/".join(context.module.path)) or ""
+            _, group = _matched_group(cfg, path)
+            if group is None or not isinstance(out, jnp.ndarray):
+                return out
+            gate = (step >= offset).astype(out.dtype)
+            return out * (1 - gate) + gate * fake_quantize(
+                out.astype(jnp.float32), group.bits, 1).astype(out.dtype)
+
+        return interceptor
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+# --- public API (reference compress.py) -------------------------------------
+
+
+def init_compression(params: Any, config: Any) -> Tuple[Any, Compressor]:
+    """Build the compression plan (reference ``init_compression``): applies
+    layer reduction immediately (a structural edit, like the reference's
+    student re-init) and returns (params, compressor)."""
+    ccfg = config if isinstance(config, CompressionConfig) \
+        else get_compression_config(
+            config.get("compression_training", config) if isinstance(config, dict)
+            else getattr(config, "compression_config", {}))
+    if ccfg.layer_reduction.enabled:
+        params = _apply_layer_reduction(params, ccfg.layer_reduction)
+    return params, Compressor(ccfg, params)
+
+
+def _apply_layer_reduction(params: Any, lr_cfg) -> Any:
+    """Keep only ``teacher_layer``-indexed layers, renumbered consecutively
+    (reference compression/helper.py student initialization)."""
+    prefix = lr_cfg.module_name_prefix
+    layer_re = re.compile(rf"^{re.escape(prefix)}(\d+)$")  # not e.g. layer_norm
+    keep = list(lr_cfg.teacher_layer)
+    if not keep and lr_cfg.keep_number_layer:
+        n_layers = len([k for k in params if layer_re.match(str(k))])
+        stride = max(1, n_layers // lr_cfg.keep_number_layer)
+        keep = list(range(0, n_layers, stride))[:lr_cfg.keep_number_layer]
+    out = {}
+    for key, sub in params.items():
+        name = str(key)
+        if layer_re.match(name):
+            continue
+        out[name] = sub
+    for new_idx, teacher_idx in enumerate(keep):
+        src = f"{prefix}{teacher_idx}"
+        if src not in params:
+            raise ValueError(f"layer_reduction: teacher layer {src} not found")
+        out[f"{prefix}{new_idx}"] = params[src]
+    logger.info(f"layer_reduction: kept {len(keep)} layers {keep}")
+    return out
+
+
+def redundancy_clean(params: Any, config: Any,
+                     num_heads: Optional[int] = None) -> Any:
+    """Physically remove pruned structures (reference ``redundancy_clean``):
+    row-pruned output units are sliced out of the kernel **and** out of the
+    consumer's input dim; head-pruned slabs likewise. Works on the unified
+    transformer naming (``mlp/c_fc``→``mlp/c_proj``, ``attn/o_proj``)."""
+    ccfg = config if isinstance(config, CompressionConfig) \
+        else get_compression_config(
+            config.get("compression_training", config) if isinstance(config, dict)
+            else getattr(config, "compression_config", {}))
+
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    def layer_dicts(tree, path=()):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                yield from layer_dicts(v, path + (k,))
+            if "c_fc" in tree and "c_proj" in tree:
+                yield path, tree
+        return
+
+    rcfg = ccfg.row_pruning
+    if rcfg.shared_parameters.enabled:
+        for path, mlp in list(layer_dicts(params)):
+            p = "/".join(path) + "/c_fc/kernel"
+            _, group = _matched_group(rcfg, p)
+            if group is None:
+                continue
+            k = jnp.asarray(mlp["c_fc"]["kernel"])
+            keep = jnp.where(_row_mask(k, group.dense_ratio)[0] > 0)[0]
+            mlp["c_fc"]["kernel"] = k[:, keep]
+            if "bias" in mlp["c_fc"]:
+                mlp["c_fc"]["bias"] = jnp.asarray(mlp["c_fc"]["bias"])[keep]
+            mlp["c_proj"]["kernel"] = jnp.asarray(mlp["c_proj"]["kernel"])[keep, :]
+
+    hcfg = ccfg.head_pruning
+    if hcfg.shared_parameters.enabled:
+        nh = num_heads or hcfg.shared_parameters.num_heads
+        if not nh:
+            raise ValueError("head pruning clean needs num_heads")
+
+        def clean_attn(tree, path=()):
+            if not isinstance(tree, dict):
+                return
+            for k, v in tree.items():
+                clean_attn(v, path + (k,))
+            if "o_proj" in tree:
+                p = "/".join(path) + "/o_proj/kernel"
+                _, group = _matched_group(hcfg, p)
+                if group is None:
+                    return
+                w = jnp.asarray(tree["o_proj"]["kernel"])
+                mask = _head_mask(w, group.dense_ratio, nh)[:, 0]
+                keep = jnp.where(mask > 0)[0]
+                tree["o_proj"]["kernel"] = w[keep, :]
+                for proj in ("q_proj", "k_proj", "v_proj"):
+                    if proj in tree:
+                        kw = jnp.asarray(tree[proj]["kernel"])
+                        tree[proj]["kernel"] = kw[:, keep]
+                        if "bias" in tree[proj]:
+                            tree[proj]["bias"] = \
+                                jnp.asarray(tree[proj]["bias"])[keep]
+
+        clean_attn(params)
+    return params

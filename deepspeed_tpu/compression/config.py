@@ -1,0 +1,125 @@
+"""Compression configuration.
+
+Mirrors the reference's ``"compression_training"`` JSON section
+(``deepspeed/compression/config.py`` + ``constants.py``): each method has
+``shared_parameters`` plus named ``different_groups`` whose ``modules`` lists
+select the parameters the group covers. Module patterns are matched against
+*parameter paths* of the JAX pytree (``layer_0/attn/q_proj/kernel``) — the
+pytree analogue of the reference's module-name matching; ``.`` in a pattern
+matches ``/`` and ``"*"`` matches everything.
+"""
+
+from typing import Dict, List, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class WeightQuantGroup(DeepSpeedConfigModel):
+    """One ``different_groups`` entry for weight quantization."""
+
+    start_bits: int = Field(8, ge=1)
+    target_bits: int = Field(8, ge=1)
+    quantization_period: int = Field(1, ge=1)   # steps between bit halvings
+    modules: List[str] = Field(default_factory=lambda: ["*"])
+
+
+class WeightQuantShared(DeepSpeedConfigModel):
+    enabled: bool = False
+    quantizer_kernel: bool = False              # accepted for parity; Pallas
+    schedule_offset: int = Field(0, ge=0)       # enable from this global step
+    quantize_groups: int = Field(1, ge=1)
+    quantize_verbose: bool = False
+    quantization_type: str = "symmetric"        # symmetric|asymmetric
+    rounding: str = "nearest"                   # nearest|stochastic
+    quantize_weight_in_forward: bool = True     # always true here (functional)
+    fp16_mixed_quantize: bool = False
+    quantize_change_ratio: float = Field(0.001, ge=0)
+
+
+class ActQuantGroup(DeepSpeedConfigModel):
+    bits: int = Field(8, ge=1)
+    modules: List[str] = Field(default_factory=lambda: ["*"])
+
+
+class ActQuantShared(DeepSpeedConfigModel):
+    enabled: bool = False
+    quantization_type: str = "symmetric"
+    range_calibration: str = "dynamic"          # dynamic|static (static≈dynamic here)
+    schedule_offset: int = Field(0, ge=0)
+
+
+class PruneGroup(DeepSpeedConfigModel):
+    dense_ratio: float = Field(0.5, gt=0, le=1)
+    modules: List[str] = Field(default_factory=lambda: ["*"])
+    # head pruning: modules the pruned heads also gate (reference
+    # ``related_modules``); informational for redundancy_clean
+    related_modules: Optional[List[List[str]]] = None
+
+
+class PruneShared(DeepSpeedConfigModel):
+    enabled: bool = False
+    schedule_offset: int = Field(0, ge=0)
+    method: str = "l1"                          # l1|topk
+    num_heads: Optional[int] = None             # head pruning only
+
+
+class MethodConfig(DeepSpeedConfigModel):
+    shared_parameters: DeepSpeedConfigModel
+    different_groups: Dict[str, DeepSpeedConfigModel] = Field(default_factory=dict)
+
+
+class WeightQuantConfig(MethodConfig):
+    shared_parameters: WeightQuantShared = Field(default_factory=WeightQuantShared)
+    different_groups: Dict[str, WeightQuantGroup] = Field(default_factory=dict)
+
+
+class ActQuantConfig(MethodConfig):
+    shared_parameters: ActQuantShared = Field(default_factory=ActQuantShared)
+    different_groups: Dict[str, ActQuantGroup] = Field(default_factory=dict)
+
+
+class PruneConfig(MethodConfig):
+    shared_parameters: PruneShared = Field(default_factory=PruneShared)
+    different_groups: Dict[str, PruneGroup] = Field(default_factory=dict)
+
+
+class LayerReductionConfig(DeepSpeedConfigModel):
+    """Distillation-style depth reduction (reference layer_reduction):
+    the student keeps ``keep_number_layer`` layers initialized from the
+    teacher layers listed in ``teacher_layer``."""
+
+    enabled: bool = False
+    keep_number_layer: Optional[int] = None
+    module_name_prefix: str = "layer_"
+    teacher_layer: List[int] = Field(default_factory=list)
+    other_module_name: List[str] = Field(default_factory=list)
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    """The full ``"compression_training"`` section."""
+
+    weight_quantization: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
+    activation_quantization: ActQuantConfig = Field(default_factory=ActQuantConfig)
+    sparse_pruning: PruneConfig = Field(default_factory=PruneConfig)
+    row_pruning: PruneConfig = Field(default_factory=PruneConfig)
+    head_pruning: PruneConfig = Field(default_factory=PruneConfig)
+    channel_pruning: PruneConfig = Field(default_factory=PruneConfig)
+    layer_reduction: LayerReductionConfig = Field(default_factory=LayerReductionConfig)
+
+    @property
+    def any_enabled(self) -> bool:
+        return any([
+            self.weight_quantization.shared_parameters.enabled,
+            self.activation_quantization.shared_parameters.enabled,
+            self.sparse_pruning.shared_parameters.enabled,
+            self.row_pruning.shared_parameters.enabled,
+            self.head_pruning.shared_parameters.enabled,
+            self.channel_pruning.shared_parameters.enabled,
+            self.layer_reduction.enabled,
+        ])
+
+
+def get_compression_config(param_dict: dict) -> CompressionConfig:
+    return CompressionConfig(**(param_dict or {}))

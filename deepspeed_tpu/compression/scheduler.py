@@ -1,0 +1,42 @@
+"""Compression scheduler (reference ``compression/scheduler.py``).
+
+The reference scheduler flips each method on at its ``schedule_offset`` by
+mutating the replacement layers; here the gating itself is traced into the
+compressed forward (``Compressor.compress`` gates on the step scalar), so
+this class only tracks/report transitions and answers "what is active at
+step N" for logging and tests.
+"""
+
+from typing import Dict, List
+
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.utils.logging import logger
+
+METHODS = ("weight_quantization", "activation_quantization", "sparse_pruning",
+           "row_pruning", "head_pruning", "channel_pruning")
+
+
+class CompressionScheduler:
+    def __init__(self, config: CompressionConfig, verbose: bool = False):
+        self.config = config
+        self.verbose = verbose
+        self._announced: Dict[str, bool] = {m: False for m in METHODS}
+
+    def active_methods(self, step: int) -> List[str]:
+        out = []
+        for m in METHODS:
+            shared = getattr(self.config, m).shared_parameters
+            if shared.enabled and step >= shared.schedule_offset:
+                out.append(m)
+        return out
+
+    def step(self, global_step: int) -> List[str]:
+        """Report newly activated methods at this step."""
+        newly = []
+        for m in self.active_methods(global_step):
+            if not self._announced[m]:
+                self._announced[m] = True
+                newly.append(m)
+                if self.verbose:
+                    logger.info(f"compression: {m} active from step {global_step}")
+        return newly

@@ -42,6 +42,9 @@ from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.zero.stages import (
     ZeroShardingPlan, opt_state_shardings, plan_zero_shardings,
 )
+from deepspeed_tpu.compression import (
+    Compressor, CompressionScheduler, STEP_KEY, get_compression_config,
+)
 from deepspeed_tpu.ops.optimizers import build_optimizer
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -116,6 +119,22 @@ class DeepSpeedEngine:
             params, self.mesh, self._config.zero_config, sharding_rules)
         self.params = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, s), params, self.zero_plan.param_shardings)
+
+        # compression (reference compression/compress.py) ----------------------
+        self._compressor = None
+        self.compression_scheduler = None
+        _ccfg = get_compression_config(self._config.compression_config)
+        if _ccfg.any_enabled:
+            if _ccfg.layer_reduction.enabled:
+                log_dist("layer_reduction is a structural edit: apply "
+                         "init_compression(params, config) BEFORE engine "
+                         "construction; the engine only applies QAT/pruning",
+                         ranks=[0])
+            self._compressor = Compressor(_ccfg, self.params)
+            self.loss_fn = self._compressor.wrap_loss(self.loss_fn)
+            self.compression_scheduler = CompressionScheduler(
+                _ccfg, verbose=_ccfg.weight_quantization
+                .shared_parameters.quantize_verbose)
 
         # optimizer -----------------------------------------------------------
         self.optimizer, self._lr_schedule = self._configure_optimizer()
@@ -375,6 +394,10 @@ class DeepSpeedEngine:
 
         batch = {k: to_gas_layout(v) for k, v in batch.items()}
         batch = self._shard_batch(batch, leading_gas=True)
+        if self._compressor is not None:
+            batch[STEP_KEY] = jax.device_put(
+                jnp.full((gas,), self.global_steps, jnp.int32),
+                NamedSharding(self.mesh, PartitionSpec()))
 
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
@@ -396,6 +419,8 @@ class DeepSpeedEngine:
         """Compute loss (and grads — fused reverse AD) for one micro-batch."""
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self._compressor is not None:
+            batch = {**batch, STEP_KEY: jnp.asarray(self.global_steps, jnp.int32)}
         batch = self._shard_batch(batch)
         with self._ctx():
             loss, grads = self._jit_grad(self.params, batch, self.scaler_state.scale)
@@ -444,6 +469,8 @@ class DeepSpeedEngine:
     def _after_step(self, finite):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if self.compression_scheduler is not None:
+            self.compression_scheduler.step(self.global_steps)
         if self.fp16_enabled:
             if not bool(finite):
                 self.skipped_steps += 1
@@ -458,6 +485,8 @@ class DeepSpeedEngine:
 
     def eval_loss(self, batch: Dict[str, Any]):
         """Forward-only loss (no gradient program)."""
+        if self._compressor is not None:
+            batch = {**batch, STEP_KEY: jnp.asarray(self.global_steps, jnp.int32)}
         batch = self._shard_batch(batch)
         with self._ctx():
             return self._jit_loss(self.params, batch)

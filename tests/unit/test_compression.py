@@ -1,0 +1,293 @@
+"""Compression subsystem: QAT fake-quant schedule, pruning masks, layer
+reduction, redundancy_clean, scheduler, and engine integration (reference
+tests/unit/compression)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (
+    CompressionScheduler, Compressor, get_compression_config,
+    init_compression, redundancy_clean,
+)
+
+
+def make_params(rng, layers=2, hidden=8, inter=16):
+    params = {}
+    for i in range(layers):
+        params[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": jnp.asarray(
+                    rng.standard_normal((hidden, hidden)), jnp.float32)},
+                "o_proj": {"kernel": jnp.asarray(
+                    rng.standard_normal((hidden, hidden)), jnp.float32)},
+            },
+            "mlp": {
+                "c_fc": {"kernel": jnp.asarray(
+                    rng.standard_normal((hidden, inter)), jnp.float32),
+                    "bias": jnp.zeros((inter,), jnp.float32)},
+                "c_proj": {"kernel": jnp.asarray(
+                    rng.standard_normal((inter, hidden)), jnp.float32)},
+            },
+        }
+    return params
+
+
+def test_weight_quantization_gates_on_offset(rng):
+    cfg = get_compression_config({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5,
+                                  "quantize_groups": 2},
+            "different_groups": {
+                "wq1": {"target_bits": 4, "start_bits": 4,
+                        "modules": ["attn.q_proj"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+    before = comp.compress(params, 0)
+    after = comp.compress(params, 10)
+    q = params["layer_0"]["attn"]["q_proj"]["kernel"]
+    np.testing.assert_allclose(np.asarray(before["layer_0"]["attn"]["q_proj"]["kernel"]),
+                               np.asarray(q))  # inactive before offset
+    qw = np.asarray(after["layer_0"]["attn"]["q_proj"]["kernel"])
+    assert not np.allclose(qw, np.asarray(q))
+    # 4-bit symmetric → at most 16 distinct values per group (2 groups)
+    assert len(np.unique(qw)) <= 2 * 16
+    # unmatched params untouched
+    np.testing.assert_allclose(
+        np.asarray(after["layer_0"]["mlp"]["c_fc"]["kernel"]),
+        np.asarray(params["layer_0"]["mlp"]["c_fc"]["kernel"]))
+
+
+def test_bit_schedule_halves_to_target(rng):
+    cfg = get_compression_config({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "wq1": {"start_bits": 8, "target_bits": 2,
+                        "quantization_period": 10, "modules": ["q_proj"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+    late = comp.compress(params, 100)  # many halvings → 2 bits
+    qw = np.asarray(late["layer_0"]["attn"]["q_proj"]["kernel"])
+    assert len(np.unique(qw)) <= 4  # 2-bit symmetric: {-2,-1,0,1}·scale
+
+
+def test_quantization_straight_through_grads(rng):
+    cfg = get_compression_config({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"wq1": {"target_bits": 8,
+                                         "modules": ["*"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+
+    def loss(p):
+        cp = comp.compress(p, 10)
+        return sum(jnp.sum(leaf ** 2) for leaf in jax.tree_util.tree_leaves(cp))
+
+    grads = jax.grad(loss)(params)
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        assert np.isfinite(np.asarray(g)).all()
+        if any(getattr(k, "key", None) == "kernel" for k in path):
+            assert np.abs(np.asarray(g)).max() > 0  # STE: gradient flows
+
+
+def test_sparse_pruning_mask_ratio(rng):
+    cfg = get_compression_config({
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "method": "l1"},
+            "different_groups": {"sp1": {"dense_ratio": 0.25,
+                                         "modules": ["c_fc"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+    out = comp.compress(params, 1)
+    w = np.asarray(out["layer_0"]["mlp"]["c_fc"]["kernel"])
+    nnz = (w != 0).mean()
+    assert abs(nnz - 0.25) < 0.05
+
+
+def test_row_pruning_zeroes_columns(rng):
+    cfg = get_compression_config({
+        "row_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"rp1": {"dense_ratio": 0.5,
+                                         "modules": ["c_fc"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+    w = np.asarray(comp.compress(params, 1)["layer_0"]["mlp"]["c_fc"]["kernel"])
+    col_zero = (w == 0).all(axis=0)
+    assert col_zero.sum() == w.shape[1] // 2
+
+
+def test_head_pruning_zeroes_head_slabs(rng):
+    cfg = get_compression_config({
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "num_heads": 4},
+            "different_groups": {"hp1": {"dense_ratio": 0.5,
+                                         "modules": ["o_proj"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+    w = np.asarray(comp.compress(params, 1)["layer_0"]["attn"]["o_proj"]["kernel"])
+    hd = w.shape[0] // 4
+    slab_zero = [bool((w[i * hd:(i + 1) * hd] == 0).all()) for i in range(4)]
+    assert sum(slab_zero) == 2
+
+
+def test_layer_reduction_selects_teacher_layers(rng):
+    params = make_params(rng, layers=4)
+    params["wte"] = {"embedding": jnp.zeros((16, 8))}
+    new_params, _ = init_compression(params, {
+        "compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                                "teacher_layer": [1, 3]}}})
+    assert sorted(k for k in new_params if k.startswith("layer_")) == \
+        ["layer_0", "layer_1"]
+    np.testing.assert_allclose(
+        np.asarray(new_params["layer_0"]["attn"]["q_proj"]["kernel"]),
+        np.asarray(params["layer_1"]["attn"]["q_proj"]["kernel"]))
+    assert "wte" in new_params
+
+
+def test_redundancy_clean_physically_shrinks(rng):
+    cfg = {"compression_training": {
+        "row_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"rp1": {"dense_ratio": 0.5,
+                                         "modules": ["c_fc"]}}},
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "num_heads": 4},
+            "different_groups": {"hp1": {"dense_ratio": 0.5,
+                                         "modules": ["o_proj"]}}}}}
+    params = make_params(rng, hidden=8, inter=16)
+    out = redundancy_clean(params, cfg)
+    mlp = out["layer_0"]["mlp"]
+    assert mlp["c_fc"]["kernel"].shape == (8, 8)       # 16 → 8 units
+    assert mlp["c_fc"]["bias"].shape == (8,)
+    assert mlp["c_proj"]["kernel"].shape == (8, 8)
+    attn = out["layer_0"]["attn"]
+    assert attn["o_proj"]["kernel"].shape == (4, 8)    # 2 of 4 heads, hd=2
+    assert attn["q_proj"]["kernel"].shape == (8, 4)
+
+
+def test_scheduler_reports_activation():
+    cfg = get_compression_config({
+        "sparse_pruning": {"shared_parameters": {"enabled": True,
+                                                 "schedule_offset": 3}},
+        "weight_quantization": {"shared_parameters": {"enabled": True,
+                                                      "schedule_offset": 0}}})
+    sched = CompressionScheduler(cfg)
+    assert sched.step(1) == ["weight_quantization"]
+    assert sched.step(2) == []
+    assert sched.step(3) == ["sparse_pruning"]
+
+
+def test_engine_compression_integration(rng):
+    """QAT inside the jitted train step: engine trains and the loss stays
+    finite with compression active from step 0."""
+    import deepspeed_tpu
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                     max_seq_len=32, dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    ids = np.asarray(
+        np.random.default_rng(0).integers(0, 64, (8, 16)), np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    engine = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "compression_training": {
+                    "weight_quantization": {
+                        "shared_parameters": {"enabled": True,
+                                              "schedule_offset": 0},
+                        "different_groups": {
+                            "wq1": {"target_bits": 8,
+                                    "modules": ["attn", "mlp"]}}}}},
+        sample_batch=batch)
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_regex_patterns_unmangled(rng):
+    """Reference-style regex module patterns ('layer_0.*c_fc') must match."""
+    cfg = get_compression_config({
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"sp1": {"dense_ratio": 0.5,
+                                         "modules": ["layer_0.*c_fc"]}}}})
+    params = make_params(rng)
+    comp = Compressor(cfg, params)
+    assert "layer_0/mlp/c_fc/kernel" in comp._plan
+    assert "layer_1/mlp/c_fc/kernel" not in comp._plan
+
+
+def test_head_pruning_requires_num_heads(rng):
+    cfg = get_compression_config({
+        "head_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"hp1": {"dense_ratio": 0.5,
+                                         "modules": ["o_proj"]}}}})
+    with pytest.raises(ValueError, match="num_heads"):
+        Compressor(cfg, make_params(rng))
+
+
+def test_quantize_groups_non_divisor(rng):
+    """quantize_groups that doesn't divide the element count must fall back
+    to the largest divisor, not crash inside jit."""
+    cfg = get_compression_config({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "quantize_groups": 4},
+            "different_groups": {"wq1": {"target_bits": 8,
+                                         "modules": ["*"]}}}})
+    params = {"w": {"kernel": jnp.asarray(
+        np.random.default_rng(0).standard_normal((10, 7)), jnp.float32)}}
+    comp = Compressor(cfg, params)
+    out = jax.jit(lambda p: comp.compress(p, 1))(params)
+    assert np.isfinite(np.asarray(out["w"]["kernel"])).all()
+
+
+def test_layer_reduction_preserves_layer_norm_keys(rng):
+    params = make_params(rng, layers=4)
+    params["layer_norm"] = {"scale": jnp.ones((8,))}
+    new_params, _ = init_compression(params, {
+        "compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2}}})
+    assert "layer_norm" in new_params
+    assert sorted(k for k in new_params if k.startswith("layer_") and
+                  k[6:].isdigit()) == ["layer_0", "layer_1"]
+
+
+def test_activation_quantization_intercepts(rng):
+    """Activation fake-quant must actually change module outputs once the
+    schedule offset passes."""
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8, name="fc")(x)
+
+    model = Tiny()
+    x = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    cfg = get_compression_config({
+        "activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"aq1": {"bits": 4, "modules": ["fc"]}}}})
+    comp = Compressor(cfg, params)
+
+    def run(step):
+        def loss_fn(p, batch):
+            with nn.intercept_methods(comp.activation_interceptor(step)):
+                return model.apply({"params": p}, batch["x"])
+        return np.asarray(loss_fn(params, {"x": x}))
+
+    plain = np.asarray(model.apply({"params": params}, x))
+    np.testing.assert_allclose(run(0), plain)          # before offset
+    after = run(10)
+    assert not np.allclose(after, plain)               # quantized after
+    assert len(np.unique(after.round(6))) <= plain.size

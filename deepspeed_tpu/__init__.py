@@ -16,6 +16,8 @@ Public entry points mirror the reference (``deepspeed/__init__.py:58,260``):
     infer = deepspeed_tpu.init_inference(model=..., config={...})
 """
 
+import os
+
 from deepspeed_tpu import comm  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
@@ -52,6 +54,26 @@ def initialize(model=None,
         config = config_params
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
+    # `dst --autotuning run` exports the tuned config (launcher/runner.py)
+    override = os.environ.get("DS_TPU_CONFIG_OVERRIDE")
+    if override and not isinstance(config, DeepSpeedConfig):
+        import json as _json
+
+        def _deep_merge(base, over):
+            out = dict(base)
+            for k, v in over.items():
+                if isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k] = _deep_merge(out[k], v)
+                else:
+                    out[k] = v
+            return out
+
+        if isinstance(config, str):          # config given as a file path
+            with open(config) as f:
+                config = _json.load(f)
+        with open(override) as f:
+            tuned = _json.load(f)
+        config = _deep_merge(config or {}, tuned)
 
     # engine dispatch (reference deepspeed/__init__.py:150-190): hybrid
     # engine when hybrid_engine.enabled, else the core engine (the pipeline

@@ -1,0 +1,7 @@
+from deepspeed_tpu.autotuning.autotuner import (
+    Autotuner, Candidate, ModelInfo, estimate_memory_per_device,
+    profile_model_info,
+)
+from deepspeed_tpu.autotuning.config import (
+    AutotuningConfig, get_autotuning_config,
+)

@@ -1,0 +1,284 @@
+"""Autotuner — searches ZeRO stage × micro-batch space with real timed steps.
+
+TPU-native replacement for the reference autotuner
+(``deepspeed/autotuning/autotuner.py:404`` ``Autotuner.tune``, tuners under
+``autotuning/tuner/``, experiment scheduler ``scheduler.py``). The reference
+launches short ssh jobs per candidate config and reads back metric files;
+under jit there is no process boundary to manage — each experiment builds an
+engine for the candidate config in-process, times a few steps, and tears it
+down. The three tuner strategies survive:
+
+- gridsearch: every feasible candidate, memory-cheapest first;
+- random: uniform sample of ``tuner_num_trials`` candidates;
+- model_based: explore half the budget randomly, fit a quadratic
+  throughput model over (stage, log2 mbs), exploit its argmax (the role of
+  the reference's XGBoost cost model without the xgboost dependency).
+
+Feasibility pruning uses the same memory model the reference derives from
+its profile run: per-device bytes = params + grads + optimizer states
+(sharded per ZeRO stage over the dp axis) + activation estimate scaled by
+micro-batch size.
+"""
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.autotuning.config import AutotuningConfig, get_autotuning_config
+from deepspeed_tpu.profiling.flops_profiler import cost_analysis, count_params
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16)
+DEFAULT_ZERO_STAGES = (0, 1, 2, 3)
+# fp32 master + adam m/v per param on top of bf16 params+grads
+OPTIMIZER_BYTES_PER_PARAM = 12
+PARAM_BYTES = 2
+GRAD_BYTES = 2
+
+
+class ModelInfo:
+    """The reference's model-info profile run (autotuner.py:664) distilled:
+    param count + activation bytes per micro-batch element, measured from a
+    single traced forward instead of a launched job."""
+
+    def __init__(self, num_params: int, activation_mem_per_sample: int,
+                 flops_per_sample: float):
+        self.num_params = num_params
+        self.activation_mem_per_sample = activation_mem_per_sample
+        self.flops_per_sample = flops_per_sample
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"num_params": self.num_params,
+                "activation_mem_per_gpu": self.activation_mem_per_sample,
+                "flops_per_sample": self.flops_per_sample}
+
+
+def profile_model_info(loss_fn: Callable, params: Any,
+                       sample_batch: Dict[str, Any]) -> ModelInfo:
+    import jax
+    import jax.numpy as jnp
+
+    batch = {k: jnp.asarray(v) for k, v in sample_batch.items()}
+    bs = next(iter(batch.values())).shape[0]
+    costs = cost_analysis(lambda p, b: loss_fn(p, b), params, batch)
+    n = count_params(params)
+    # temp bytes from XLA's own estimate when present; else transformer
+    # rule-of-thumb (~2 bytes × 12 × hidden-ish) falls back to output bytes
+    act = int(costs.get("bytes accessed", 0)) // max(bs, 1)
+    return ModelInfo(n, max(act, 1), float(costs.get("flops", 0)) / max(bs, 1))
+
+
+class Candidate:
+    def __init__(self, zero_stage: int, micro_batch: int, gas: int = 1):
+        self.zero_stage = zero_stage
+        self.micro_batch = micro_batch
+        self.gas = gas
+
+    def key(self) -> str:
+        return f"z{self.zero_stage}_mbs{self.micro_batch}_gas{self.gas}"
+
+    def ds_config(self, base: Dict[str, Any], dp: int) -> Dict[str, Any]:
+        cfg = json.loads(json.dumps(base))  # deep copy
+        cfg["train_micro_batch_size_per_gpu"] = self.micro_batch
+        cfg["gradient_accumulation_steps"] = self.gas
+        cfg["train_batch_size"] = self.micro_batch * self.gas * dp
+        cfg.setdefault("zero_optimization", {})["stage"] = self.zero_stage
+        cfg.pop("autotuning", None)
+        return cfg
+
+
+def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
+                               dp_size: int) -> int:
+    """Reference memory model: ZeRO stage decides which of the three state
+    classes shard over dp."""
+    n = info.num_params
+    params = n * PARAM_BYTES
+    grads = n * GRAD_BYTES
+    opt = n * OPTIMIZER_BYTES_PER_PARAM
+    if cand.zero_stage >= 1:
+        opt //= dp_size
+    if cand.zero_stage >= 2:
+        grads //= dp_size
+    if cand.zero_stage >= 3:
+        params //= dp_size
+    act = info.activation_mem_per_sample * cand.micro_batch
+    return params + grads + opt + act
+
+
+class Autotuner:
+    """In-process config search (reference ``Autotuner``).
+
+    ``engine_factory(config_dict) -> engine`` builds a fresh engine for one
+    candidate; ``batch_factory(micro_batch, gas) -> batch`` produces a global
+    batch matching the candidate's triangle.
+    """
+
+    def __init__(self,
+                 engine_factory: Callable[[Dict[str, Any]], Any],
+                 batch_factory: Callable[[int, int], Dict[str, Any]],
+                 base_config: Dict[str, Any],
+                 model_info: ModelInfo,
+                 dp_size: int,
+                 hbm_bytes_per_device: Optional[int] = None,
+                 config: Optional[AutotuningConfig] = None):
+        self.engine_factory = engine_factory
+        self.batch_factory = batch_factory
+        self.base_config = base_config
+        self.model_info = model_info
+        self.dp_size = dp_size
+        self.hbm = hbm_bytes_per_device
+        self.cfg = config or get_autotuning_config(base_config)
+        self.results: Dict[str, Dict[str, float]] = {}
+
+    # -- search space --------------------------------------------------------
+
+    def candidates(self) -> List[Candidate]:
+        stages = self.cfg.zero_stages or list(DEFAULT_ZERO_STAGES)
+        mbs_list = self.cfg.micro_batch_sizes or list(DEFAULT_MICRO_BATCHES)
+        out = []
+        for stage in stages:
+            for mbs in mbs_list:
+                tbs = mbs * self.dp_size
+                if tbs < self.cfg.min_train_batch_size:
+                    continue
+                if (self.cfg.max_train_batch_size
+                        and tbs > self.cfg.max_train_batch_size):
+                    continue
+                cand = Candidate(stage, mbs)
+                if self.hbm is not None and estimate_memory_per_device(
+                        self.model_info, cand, self.dp_size) > self.hbm:
+                    continue
+                out.append(cand)
+        # memory-cheapest first: smaller mbs, higher stage
+        out.sort(key=lambda c: (c.micro_batch, -c.zero_stage))
+        return out
+
+    # -- experiment runner ---------------------------------------------------
+
+    def run_experiment(self, cand: Candidate) -> Dict[str, float]:
+        """Build the candidate engine, time steps in
+        [start_profile_step, end_profile_step), report samples/s."""
+        cfg = cand.ds_config(self.base_config, self.dp_size)
+        engine = self.engine_factory(cfg)
+        batch = self.batch_factory(cand.micro_batch, cand.gas)
+        steps = max(self.cfg.end_profile_step, self.cfg.start_profile_step + 1)
+        t0 = None
+        timed_steps = 0
+        for i in range(steps):
+            if i == self.cfg.start_profile_step:
+                t0 = time.perf_counter()
+            loss = engine.train_batch(batch)
+            _ = float(loss)                     # host sync: honest timing
+            if t0 is not None:
+                timed_steps += 1
+        elapsed = time.perf_counter() - t0
+        tbs = cand.micro_batch * cand.gas * self.dp_size
+        throughput = tbs * timed_steps / max(elapsed, 1e-9)
+        result = {
+            "throughput": throughput,
+            "latency": elapsed / max(timed_steps, 1),
+            "flops": throughput * self.model_info.flops_per_sample,
+        }
+        self.results[cand.key()] = result
+        return result
+
+    def _metric(self, result: Dict[str, float]) -> float:
+        v = result[self.cfg.metric]
+        return -v if self.cfg.metric == "latency" else v
+
+    # -- tuners --------------------------------------------------------------
+
+    def _tune_over(self, cands: List[Candidate]) -> Tuple[Optional[Candidate], float]:
+        best, best_m = None, -np.inf
+        stale = 0
+        for cand in cands[:self.cfg.tuner_num_trials]:
+            try:
+                result = self.run_experiment(cand)
+            except Exception as e:  # OOM / compile failure = infeasible
+                logger.warning(f"autotuning: {cand.key()} failed: {e}")
+                self.results[cand.key()] = {"error": str(e)}
+                continue
+            m = self._metric(result)
+            if m > best_m:
+                best, best_m, stale = cand, m, 0
+            else:
+                stale += 1
+                if stale >= self.cfg.tuner_early_stopping:
+                    logger.info("autotuning: early stopping "
+                                f"after {stale} stale trials")
+                    break
+        return best, best_m
+
+    def tune(self) -> Optional[Dict[str, Any]]:
+        """Run the search; returns the best candidate's full ds_config."""
+        cands = self.candidates()
+        if not cands:
+            logger.warning("autotuning: no feasible candidates")
+            return None
+        rng = np.random.RandomState(0)
+        if self.cfg.tuner_type == "random":
+            order = list(cands)
+            rng.shuffle(order)
+            best, best_m = self._tune_over(order)
+        elif self.cfg.tuner_type == "model_based":
+            order = list(cands)
+            rng.shuffle(order)
+            explore = order[:max(2, self.cfg.tuner_num_trials // 2)]
+            best, best_m = self._tune_over(explore)
+            predict = self._fit_cost_model()
+            if predict is not None:
+                remaining = [c for c in cands
+                             if c.key() not in self.results]
+                remaining.sort(key=predict, reverse=True)
+                budget_left = max(1, self.cfg.tuner_num_trials
+                                  - len(self.results))
+                b2, m2 = self._tune_over(remaining[:budget_left])
+                if m2 > best_m:
+                    best, best_m = b2, m2
+        else:  # gridsearch
+            best, best_m = self._tune_over(cands)
+
+        if best is None:
+            return None
+        self._write_results(best)
+        logger.info(f"autotuning: best config {best.key()} "
+                    f"{self.cfg.metric}={abs(best_m):.2f}")
+        return best.ds_config(self.base_config, self.dp_size)
+
+    def _fit_cost_model(self) -> Optional[Callable[[Candidate], float]]:
+        """Quadratic regression over (stage, log2 mbs) → metric."""
+        xs, ys = [], []
+        for key, res in self.results.items():
+            if "error" in res:
+                continue
+            stage = int(key.split("_")[0][1:])
+            mbs = int(key.split("_")[1][3:])
+            xs.append((stage, np.log2(mbs)))
+            ys.append(self._metric(res))
+        if len(xs) < 3:
+            return None
+        X = np.array([[1, s, m, s * m, m * m] for s, m in xs])
+        w, *_ = np.linalg.lstsq(X, np.array(ys), rcond=None)
+
+        def predict(c: Candidate) -> float:
+            s, m = c.zero_stage, np.log2(c.micro_batch)
+            return float(np.dot([1, s, m, s * m, m * m], w))
+
+        return predict
+
+    def _write_results(self, best: Candidate) -> None:
+        os.makedirs(self.cfg.results_dir, exist_ok=True)
+        with open(os.path.join(self.cfg.results_dir, "profile_model_info.json"),
+                  "w") as f:
+            json.dump(self.model_info.as_dict(), f, indent=2)
+        with open(os.path.join(self.cfg.results_dir, "autotuning_results.json"),
+                  "w") as f:
+            json.dump({"best": best.key(), "metric": self.cfg.metric,
+                       "results": self.results}, f, indent=2)
+        with open(os.path.join(self.cfg.results_dir, "ds_config_optimal.json"),
+                  "w") as f:
+            json.dump(best.ds_config(self.base_config, self.dp_size), f,
+                      indent=2)

@@ -1,0 +1,34 @@
+"""Autotuning configuration (reference ``autotuning/config.py``)."""
+
+from typing import List, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    """``"autotuning": {...}`` section. Same knobs as the reference's
+    ``DeepSpeedAutotuningConfig``; the experiment runner is in-process
+    (jit + timed steps) instead of ssh jobs, so no exps launcher paths."""
+
+    enabled: bool = False
+    fast: bool = True                        # stop at first good enough cfg
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"               # throughput|latency|flops
+    start_profile_step: int = Field(3, ge=0)     # warmup steps to discard
+    end_profile_step: int = Field(6, ge=1)
+    tuner_type: str = "gridsearch"           # gridsearch|random|model_based
+    tuner_early_stopping: int = Field(5, ge=1)   # trials without improvement
+    tuner_num_trials: int = Field(50, ge=1)
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = Field(1, ge=1)
+    micro_batch_sizes: Optional[List[int]] = None    # candidate micro sizes
+    zero_stages: Optional[List[int]] = None          # candidate zero stages
+    mp_size: int = Field(1, ge=1)
+
+
+def get_autotuning_config(param_dict: dict) -> AutotuningConfig:
+    return AutotuningConfig(**(param_dict.get("autotuning", {}) or {}))

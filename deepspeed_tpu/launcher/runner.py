@@ -53,6 +53,16 @@ def parse_args(args=None):
     parser.add_argument("--print_env", action="store_true",
                         help="print the env block each host receives")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning_results_dir", type=str,
+                        default="autotuning_results",
+                        help="where the Autotuner wrote its results "
+                        "(AutotuningConfig.results_dir)")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="tune: user script should run the Autotuner "
+                        "(exported as DS_TPU_AUTOTUNING); run: launch with "
+                        "the tuned autotuning_results/ds_config_optimal.json "
+                        "(exported as DS_TPU_CONFIG_OVERRIDE)")
     parser.add_argument("--save_pid", action="store_true")
     parser.add_argument("user_script", type=str, help="training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -155,8 +165,21 @@ def build_commands(args, active: "OrderedDict[str, List[int]]"
     hosts = list(active.keys())
     coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
     cmds = []
+    autotune_env: Dict[str, str] = {}
+    if getattr(args, "autotuning", ""):
+        autotune_env["DS_TPU_AUTOTUNING"] = args.autotuning
+        if args.autotuning == "run":
+            optimal = os.path.join(
+                getattr(args, "autotuning_results_dir", "autotuning_results"),
+                "ds_config_optimal.json")
+            if not os.path.isfile(optimal):
+                raise FileNotFoundError(
+                    f"--autotuning run: {optimal} not found; run "
+                    "--autotuning tune first")
+            autotune_env["DS_TPU_CONFIG_OVERRIDE"] = os.path.abspath(optimal)
     for idx, host in enumerate(hosts):
-        env = build_host_env(idx, len(hosts), coordinator)
+        env = build_host_env(idx, len(hosts), coordinator,
+                             extra_env=autotune_env)
         payload = [sys.executable, args.user_script] + list(args.user_args)
         if args.launcher == "ssh" and (len(hosts) > 1 or args.force_multi):
             env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())

@@ -2,7 +2,16 @@ from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
     CurriculumScheduler,
     truncate_to_difficulty,
 )
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer,
+    load_analysis,
+)
 from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_builder,
+)
 from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
     RandomLTDScheduler,
     gather_tokens,

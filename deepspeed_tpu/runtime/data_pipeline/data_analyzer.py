@@ -1,0 +1,132 @@
+"""Offline data analyzer (reference
+``runtime/data_pipeline/data_sampling/data_analyzer.py``).
+
+Walks a dataset once, computes per-sample difficulty metrics with
+user-supplied functions, and writes, per metric:
+
+- ``{metric}/index_to_metric`` — metric value per sample index;
+- ``{metric}/index_to_sample`` — sample indices grouped by metric value
+  (one "document" per distinct value, ascending) — the structure the
+  curriculum sampler reads to form difficulty clusters;
+- ``{metric}/metric_values.json`` — {min, max, count}.
+
+Sharding across workers mirrors the reference (``worker_id``/``num_workers``
+split + ``merge_file_``), but runs in-process — no launched jobs.
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, make_builder,
+)
+from deepspeed_tpu.utils.logging import logger
+
+MetricFn = Callable[[Any, int], float]
+
+
+class DataAnalyzer:
+    def __init__(self, dataset: Sequence[Any], metric_names: List[str],
+                 metric_functions: List[MetricFn], save_path: str,
+                 worker_id: int = 0, num_workers: int = 1,
+                 metric_dtype=np.float32):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = metric_names
+        self.metric_functions = metric_functions
+        self.save_path = save_path
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        self.metric_dtype = np.dtype(metric_dtype)
+
+    def _shard_range(self) -> range:
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return range(lo, min(lo + per, n))
+
+    def _metric_dir(self, name: str) -> str:
+        d = os.path.join(self.save_path, name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run_map(self) -> Dict[str, str]:
+        """Compute this worker's metric shard → ``index_to_metric`` files."""
+        out = {}
+        shard = self._shard_range()
+        values: Dict[str, List[float]] = {n: [] for n in self.metric_names}
+        for i in shard:
+            sample = self.dataset[i]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                values[name].append(float(fn(sample, i)))
+        for name in self.metric_names:
+            prefix = os.path.join(self._metric_dir(name),
+                                  f"index_to_metric_worker{self.worker_id}")
+            b = make_builder(prefix, dtype=self.metric_dtype)
+            for v in values[name]:
+                b.add_item(np.asarray([v]))
+            b.finalize()
+            out[name] = prefix
+        return out
+
+    def run_reduce(self) -> None:
+        """Merge worker shards, build metric→samples clusters."""
+        for name in self.metric_names:
+            d = self._metric_dir(name)
+            merged = os.path.join(d, "index_to_metric")
+            b = make_builder(merged, dtype=self.metric_dtype)
+            for w in range(self.num_workers):
+                shard_prefix = os.path.join(d, f"index_to_metric_worker{w}")
+                if not MMapIndexedDataset.exists(shard_prefix):
+                    raise FileNotFoundError(
+                        f"missing analyzer shard {shard_prefix}; run "
+                        f"run_map on worker {w} first")
+                b.merge_file_(shard_prefix)
+            b.finalize()
+
+            metric_ds = MMapIndexedDataset(merged)
+            vals = metric_ds.as_array().astype(np.float64)
+            if not len(vals):
+                raise ValueError(f"data analysis '{name}': empty dataset")
+            order = np.argsort(vals, kind="stable")
+            sorted_vals = vals[order]
+            # one document per distinct metric value, ascending — the
+            # difficulty clusters the curriculum sampler consumes
+            s_prefix = os.path.join(d, "index_to_sample")
+            sb = make_builder(s_prefix, dtype=np.int64)
+            uniq = np.unique(sorted_vals)
+            bounds = np.searchsorted(sorted_vals, uniq)
+            for cluster in np.split(order, bounds[1:]):
+                sb.add_item(cluster)
+                sb.end_document()
+            sb.finalize()
+            with open(os.path.join(d, "metric_values.json"), "w") as f:
+                json.dump({"min": float(vals.min()), "max": float(vals.max()),
+                           "count": int(len(vals)),
+                           "num_distinct": int(len(uniq))}, f)
+            logger.info(f"data analysis '{name}': {len(vals)} samples, "
+                        f"{len(uniq)} distinct values")
+
+    def run(self) -> None:
+        """Single-process convenience: map all shards then reduce."""
+        for w in range(self.num_workers):
+            DataAnalyzer(self.dataset, self.metric_names,
+                         self.metric_functions, self.save_path,
+                         worker_id=w, num_workers=self.num_workers,
+                         metric_dtype=self.metric_dtype).run_map()
+        self.run_reduce()
+
+
+def load_analysis(save_path: str, metric_name: str):
+    """(values per sample, clusters list, summary dict) for one metric."""
+    d = os.path.join(save_path, metric_name)
+    metric_ds = MMapIndexedDataset(os.path.join(d, "index_to_metric"))
+    sample_ds = MMapIndexedDataset(os.path.join(d, "index_to_sample"))
+    values = metric_ds.as_array().astype(np.float64)
+    clusters = [np.asarray(sample_ds[i]) for i in range(len(sample_ds))]
+    with open(os.path.join(d, "metric_values.json")) as f:
+        summary = json.load(f)
+    return values, clusters, summary

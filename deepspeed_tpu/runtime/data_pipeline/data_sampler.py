@@ -52,6 +52,19 @@ class DeepSpeedDataSampler:
             self.global_step += 1
             yield idx.tolist()
 
+    @classmethod
+    def from_analysis(cls, save_path: str, metric_name: str, batch_size: int,
+                      curriculum: Optional[CurriculumScheduler] = None,
+                      seed: int = 0) -> "DeepSpeedDataSampler":
+        """Build from a ``DataAnalyzer`` output directory: sample
+        difficulties come from the metric's ``index_to_metric`` file."""
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            load_analysis,
+        )
+
+        values, _, _ = load_analysis(save_path, metric_name)
+        return cls(values, batch_size, curriculum=curriculum, seed=seed)
+
     def state_dict(self) -> Dict:
         state = {"global_step": self.global_step}
         if self.curriculum is not None:

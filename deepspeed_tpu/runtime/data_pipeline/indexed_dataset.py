@@ -1,0 +1,152 @@
+"""Memory-mapped indexed dataset (reference
+``runtime/data_pipeline/data_sampling/indexed_dataset.py`` — the
+Megatron-LM binary format).
+
+On-disk layout (binary-compatible with Megatron's ``MMapIndexedDataset`` so
+existing corpora import unchanged):
+
+- ``{path}.bin`` — the concatenated sample arrays;
+- ``{path}.idx`` — header ``MMIDIDX\\x00\\x00`` magic, uint64 version=1,
+  uint8 dtype code, uint64 sequence count, uint64 document count, then
+  int32 sizes[count], int64 pointers[count] (byte offsets), int64
+  doc_idx[doc_count].
+
+Reads are ``np.memmap`` views — no host copy until sliced, which keeps the
+input pipeline off the training hot path.
+"""
+
+import os
+import struct
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00\x00"
+# Megatron dtype codes
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float64, 7: np.float32, 8: np.uint16}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, out_path_prefix: str, dtype=np.int32):
+        self._prefix = out_path_prefix
+        self._dtype = np.dtype(dtype)
+        self._bin = open(data_file_path(out_path_prefix), "wb")
+        self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
+
+    def add_item(self, array) -> None:
+        arr = np.asarray(array, dtype=self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another dataset's samples (reference multi-worker merge)."""
+        other = MMapIndexedDataset(other_prefix)
+        assert other.dtype == self._dtype
+        offset = len(self._sizes)
+        with open(data_file_path(other_prefix), "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                self._bin.write(chunk)
+        self._sizes.extend(int(s) for s in other.sizes)
+        self._doc_idx.extend(offset + int(d) for d in other.doc_idx[1:])
+
+    def finalize(self) -> None:
+        self._bin.close()
+        sizes = np.asarray(self._sizes, dtype=np.int32)
+        pointers = np.zeros(len(sizes), dtype=np.int64)
+        if len(sizes):
+            np.cumsum(sizes[:-1] * self._dtype.itemsize, out=pointers[1:])
+        doc_idx = np.asarray(self._doc_idx, dtype=np.int64)
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(doc_idx.tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Reader (reference ``MMapIndexedDataset``): ``ds[i]`` → np array."""
+
+    def __init__(self, path_prefix: str):
+        self._prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_file_path(path_prefix)}: bad magic "
+                                 f"{magic!r} (not an MMapIndexedDataset)")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            (count,) = struct.unpack("<Q", f.read(8))
+            (doc_count,) = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx_mm = np.memmap(index_file_path(path_prefix), mode="r",
+                           dtype=np.uint8)
+        self.sizes = idx_mm[offset:offset + 4 * count].view(np.int32)
+        p0 = offset + 4 * count
+        self.pointers = idx_mm[p0:p0 + 8 * count].view(np.int64)
+        d0 = p0 + 8 * count
+        self.doc_idx = idx_mm[d0:d0 + 8 * doc_count].view(np.int64)
+        # np.memmap rejects empty files; a finalized-but-empty dataset (e.g.
+        # an analyzer worker whose shard was empty) reads as zero samples
+        if os.path.getsize(data_file_path(path_prefix)) == 0:
+            self._data = np.zeros((0,), dtype=np.uint8)
+        else:
+            self._data = np.memmap(data_file_path(path_prefix), mode="r",
+                                   dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        ptr = int(self.pointers[i])
+        size = int(self.sizes[i])
+        return self._data[ptr:ptr + size * self.dtype.itemsize].view(self.dtype)
+
+    def get(self, i: int, offset: int = 0, length: Optional[int] = None):
+        """Sub-range of sample i without materializing the rest."""
+        full = self[i]
+        end = len(full) if length is None else offset + length
+        return full[offset:end]
+
+    def as_array(self) -> np.ndarray:
+        """The whole dataset as one flat array (vectorized read) — only
+        meaningful when every sample has the same element count, e.g. the
+        analyzer's one-scalar-per-sample metric files."""
+        return np.asarray(self._data.view(self.dtype) if len(self._data)
+                          else np.zeros((0,), self.dtype))
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(data_file_path(path_prefix))
+                and os.path.exists(index_file_path(path_prefix)))
+
+
+def make_builder(out_prefix: str, dtype=np.int32) -> MMapIndexedDatasetBuilder:
+    return MMapIndexedDatasetBuilder(out_prefix, dtype=dtype)

@@ -125,3 +125,111 @@ def test_data_sampler_curriculum():
         DeepSpeedDataSampler(difficulties, 4, seed=0)))
     batch = next(iter(dl))
     assert batch["x"].shape == (4, 2)
+
+
+# --- indexed dataset + data analyzer (data-efficiency v2) -------------------
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import (
+        MMapIndexedDataset, make_builder,
+    )
+
+    prefix = str(tmp_path / "corpus")
+    b = make_builder(prefix, dtype=np.int32)
+    samples = [np.arange(5), np.asarray([7, 8]), np.arange(100)]
+    for s in samples:
+        b.add_item(s)
+    b.end_document()
+    b.finalize()
+
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3
+    for got, want in zip(ds[0:3], samples):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds.get(2, offset=10, length=5),
+                                  np.arange(10, 15))
+    assert list(ds.doc_idx) == [0, 3]
+    assert MMapIndexedDataset.exists(prefix)
+    assert not MMapIndexedDataset.exists(prefix + "_nope")
+
+
+def test_indexed_dataset_merge(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import (
+        MMapIndexedDataset, make_builder,
+    )
+
+    a, bfx = str(tmp_path / "a"), str(tmp_path / "b")
+    for prefix, vals in ((a, [[1, 2]]), (bfx, [[3], [4, 5, 6]])):
+        bl = make_builder(prefix, dtype=np.int32)
+        for v in vals:
+            bl.add_item(np.asarray(v))
+        bl.end_document()
+        bl.finalize()
+    merged = str(tmp_path / "m")
+    mb = make_builder(merged, dtype=np.int32)
+    mb.merge_file_(a)
+    mb.merge_file_(bfx)
+    mb.finalize()
+    ds = MMapIndexedDataset(merged)
+    assert [list(ds[i]) for i in range(3)] == [[1, 2], [3], [4, 5, 6]]
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import MMapIndexedDataset
+
+    (tmp_path / "x.idx").write_bytes(b"NOTANIDX00" + b"\0" * 64)
+    (tmp_path / "x.bin").write_bytes(b"")
+    with pytest.raises(ValueError, match="magic"):
+        MMapIndexedDataset(str(tmp_path / "x"))
+
+
+def test_data_analyzer_end_to_end(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer, load_analysis
+
+    # dataset of variable-length "token" arrays; metric = sequence length
+    data = [np.arange(n) for n in (5, 3, 9, 3, 7, 1)]
+    analyzer = DataAnalyzer(
+        data, ["seqlen"], [lambda s, i: len(s)],
+        save_path=str(tmp_path / "analysis"), num_workers=2)
+    analyzer.run()
+
+    values, clusters, summary = load_analysis(str(tmp_path / "analysis"),
+                                              "seqlen")
+    np.testing.assert_allclose(values, [5, 3, 9, 3, 7, 1])
+    assert summary == {"min": 1.0, "max": 9.0, "count": 6, "num_distinct": 5}
+    # clusters ascend by metric; the 3-length cluster holds samples 1 and 3
+    assert [sorted(c.tolist()) for c in clusters] == \
+        [[5], [1, 3], [0], [4], [2]]
+
+
+def test_sampler_from_analysis(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import (
+        CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    )
+
+    data = [np.arange(n) for n in (5, 3, 9, 3, 7, 1)]
+    DataAnalyzer(data, ["seqlen"], [lambda s, i: len(s)],
+                 save_path=str(tmp_path / "a")).run()
+    curriculum = CurriculumScheduler({
+        "curriculum_type": "fixed_linear", "min_difficulty": 3,
+        "max_difficulty": 9,
+        "schedule_config": {"total_curriculum_step": 4,
+                            "difficulty_step": 1}})
+    sampler = DeepSpeedDataSampler.from_analysis(
+        str(tmp_path / "a"), "seqlen", batch_size=2, curriculum=curriculum)
+    batch0 = next(iter(sampler))
+    # at min difficulty 3 only samples with len<=3 are eligible: {1, 3, 5}
+    assert set(batch0) <= {1, 3, 5}
+
+
+def test_analyzer_more_workers_than_samples(tmp_path):
+    """Workers with empty shards finalize empty datasets; reduce survives."""
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer, load_analysis
+
+    data = [np.arange(2), np.arange(4)]
+    DataAnalyzer(data, ["seqlen"], [lambda s, i: len(s)],
+                 save_path=str(tmp_path / "a"), num_workers=4).run()
+    values, clusters, summary = load_analysis(str(tmp_path / "a"), "seqlen")
+    np.testing.assert_allclose(values, [2, 4])
+    assert summary["count"] == 2

@@ -289,6 +289,14 @@ class DeepSpeedConfig:
         self.elasticity = ElasticityConfig(**p.get("elasticity", {}))
         self.compression_config = p.get("compression_training", {})
         self.data_efficiency_config = p.get("data_efficiency", {})
+        # misc runtime features (reference config.py eigenvalue/pld/quantize)
+        self.eigenvalue_config = p.get("eigenvalue", {})
+        self.eigenvalue_enabled: bool = self.eigenvalue_config.get("enabled", False)
+        self.pld_config = p.get("progressive_layer_drop", {})
+        self.pld_enabled: bool = self.pld_config.get("enabled", False)
+        self.quantize_training_config = p.get("quantize_training", {})
+        self.quantize_training_enabled: bool = \
+            self.quantize_training_config.get("enabled", False)
         self.curriculum_learning_legacy = p.get("curriculum_learning", {})
         self.monitor_config_enabled = (
             self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled

@@ -38,7 +38,10 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (
     grads_finite, make_dynamic_scaler_state, make_static_scaler_state,
     update_scaler,
 )
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.quantize import Quantizer
 from deepspeed_tpu.runtime.zero.stages import (
     ZeroShardingPlan, opt_state_shardings, plan_zero_shardings,
 )
@@ -135,6 +138,44 @@ class DeepSpeedEngine:
             self.compression_scheduler = CompressionScheduler(
                 _ccfg, verbose=_ccfg.weight_quantization
                 .shared_parameters.quantize_verbose)
+
+        # misc runtime features (reference eigenvalue/PLD/MoQ wiring) ----------
+        self.eigenvalue = None
+        self._last_eigenvalues = None
+        self._last_micro_batch = None
+        if self._config.eigenvalue_enabled:
+            ec = self._config.eigenvalue_config
+            self.eigenvalue = Eigenvalue(
+                verbose=ec.get("verbose", False),
+                max_iter=ec.get("max_iter", 100),
+                tol=ec.get("tol", 1e-2),
+                stability=ec.get("stability", 1e-6),
+                gas_boundary_resolution=ec.get("gas_boundary_resolution", 1),
+                layer_name=ec.get("layer_name", "layer_"),
+                layer_num=ec.get("layer_num", 0))
+        self.progressive_layer_drop = None
+        if self._config.pld_enabled:
+            pc = self._config.pld_config
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pc.get("theta", 0.5), gamma=pc.get("gamma", 0.001))
+        self.quantizer = None
+        if self._config.quantize_training_enabled:
+            qc = self._config.quantize_training_config
+            self.quantizer = Quantizer(
+                q_start_bits=qc.get("quantize_bits", {}).get("start_bits", 16),
+                q_target_bits=qc.get("quantize_bits", {}).get("target_bits", 8),
+                q_period=qc.get("quantize_schedule", {}).get(
+                    "quantize_period", 100),
+                q_rounding=qc.get("quantize_algo", {}).get(
+                    "rounding", "nearest"),
+                q_type=qc.get("quantize_algo", {}).get(
+                    "q_type", "symmetric"),
+                q_groups=qc.get("quantize_groups", 1),
+                q_verbose=qc.get("quantize_verbose", False),
+                layer_name=qc.get(
+                    "layer_name",
+                    self.eigenvalue.layer_name if self.eigenvalue is not None
+                    else "layer_"))
 
         # optimizer -----------------------------------------------------------
         self.optimizer, self._lr_schedule = self._configure_optimizer()
@@ -406,6 +447,10 @@ class DeepSpeedEngine:
             self.params, self.opt_state, self.scaler_state, loss, finite = \
                 self._jit_train_batch(self.params, self.opt_state,
                                       self.scaler_state, batch)
+        if self.eigenvalue is not None or self.quantizer is not None:
+            mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
+                  for k, v in batch.items() if k != STEP_KEY}
+            self._misc_runtime_step(mb, finite)
         self._after_step(finite)
         self.micro_steps += gas
         if self.wall_clock_breakdown:
@@ -425,6 +470,9 @@ class DeepSpeedEngine:
         with self._ctx():
             loss, grads = self._jit_grad(self.params, batch, self.scaler_state.scale)
         self._cached_grads = grads
+        # eigenvalue/MoQ at the next step() boundary need a batch
+        self._last_micro_batch = {k: v for k, v in batch.items()
+                                  if k != STEP_KEY}
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).stop(synchronize=True)
         return loss
@@ -462,15 +510,47 @@ class DeepSpeedEngine:
             self.params, self.opt_state, self.scaler_state, finite = self._jit_apply(
                 self.params, self.opt_state, self._grad_acc, self.scaler_state)
         self._grad_acc = None
+        self._misc_runtime_step(self._last_micro_batch, finite)
         self._after_step(finite)
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).stop(synchronize=True)
+
+    def _misc_runtime_step(self, micro_batch, finite):
+        """Eigenvalue / MoQ hooks at the GAS boundary (reference
+        engine.py:1984,2058-2066). ``micro_batch``: one micro-batch dict."""
+        if (self.eigenvalue is not None and micro_batch is not None
+                and self.global_steps % max(
+                    self.eigenvalue.gas_boundary_resolution, 1) == 0):
+            mb = micro_batch
+            with self._ctx():
+                self._last_eigenvalues = self.eigenvalue.compute_eigenvalue(
+                    self.loss_fn, self.params, mb)
+            if self.quantizer is not None:
+                from deepspeed_tpu.runtime.eigenvalue import block_paths
+                self.quantizer.update_eigenvalues(
+                    self._last_eigenvalues,
+                    block_paths(self.params, self.eigenvalue.layer_name))
+            if self.monitor is not None:
+                self.monitor.write_events([
+                    (f"Train/Eigenvalues/ModelBlockParam_{i}", ev,
+                     self.global_samples)
+                    for i, ev in enumerate(self._last_eigenvalues)])
+        if self.quantizer is not None:
+            with self._ctx():
+                self.params = self.quantizer.quantize(
+                    self.params, overflow=not bool(finite))
 
     def _after_step(self, finite):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         if self.compression_scheduler is not None:
             self.compression_scheduler.step(self.global_steps)
+        if self.progressive_layer_drop is not None:
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            if (self.monitor is not None
+                    and self.global_steps % self._config.steps_per_print == 0):
+                self.monitor.write_events([
+                    ("Train/Samples/pld_theta", theta, self.global_samples)])
         if self.fp16_enabled:
             if not bool(finite):
                 self.skipped_steps += 1

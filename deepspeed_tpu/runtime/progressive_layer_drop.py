@@ -1,0 +1,59 @@
+"""Progressive layer drop (reference ``runtime/progressive_layer_drop.py:10``).
+
+PLD anneals a keep-probability theta(t) = (1-theta)·exp(-gamma·t) + theta
+toward ``theta`` as training progresses; layer l of L is then dropped with
+probability (l/L)·(1-theta(t)) (the PLD paper's depth-weighted schedule).
+The engine tracks theta and exposes ``get_state()``; models consume it via
+``layer_keep_probs`` + a ``pld`` rng (stochastic-depth residual gating —
+under XLA the skipped block's FLOPs are still scheduled, so PLD here is an
+accuracy/regularization feature, not a wall-clock one; a ``lax.cond``
+variant is the wall-clock optimization.)
+"""
+
+import math
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+    def get_state(self) -> Dict[str, float]:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def layer_keep_probs(self, num_layers: int) -> List[float]:
+        """Keep prob per layer: deeper layers drop more (PLD paper eq. 6)."""
+        th = self.get_theta()
+        return [1.0 - (l / num_layers) * (1.0 - th)
+                for l in range(1, num_layers + 1)]
+
+
+def stochastic_depth_residual(x, sublayer_out, keep_prob: float, rng):
+    """Residual gated by a Bernoulli keep draw: x + keep·f(x).
+
+    Training-time stochastic depth (no 1/keep_prob rescale — PLD keeps the
+    identity path unscaled like the reference implementation)."""
+    keep = jax.random.bernoulli(rng, keep_prob).astype(sublayer_out.dtype)
+    return x + keep * sublayer_out
+
+
+def apply_layer_drop(block_fn, x, keep_prob, rng):
+    """Whole-block PLD gate: with prob (1-keep_prob) the block is skipped
+    entirely (identity). ``jnp.where`` keeps both sides traced."""
+    keep = jax.random.bernoulli(rng, keep_prob)
+    out = block_fn(x)
+    return jnp.where(keep, out, x)

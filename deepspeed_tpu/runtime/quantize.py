@@ -1,0 +1,99 @@
+"""MoQ — Mixture-of-Quantization training quantizer
+(reference ``runtime/quantize.py:14``).
+
+MoQ reduces weight precision during training on a period schedule, with an
+optional eigenvalue signal: when provided, a layer's quantization period
+stretches by its Hessian eigenvalue relative to the max (sensitive layers —
+large curvature — keep precision longer). Quantization itself reuses the
+compression fake-quant kernels (symmetric/asymmetric, per-group).
+"""
+
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Quantizer:
+    """reference ``Quantizer`` (runtime/quantize.py:14). Knobs mirror the
+    ``quantize_training`` config section: q_start_bits/q_target_bits,
+    q_period (steps between bit reductions), q_rounding, q_type,
+    q_groups, use_quantizer_kernel (accepted; XLA path always)."""
+
+    def __init__(self, q_start_bits: int = 16, q_target_bits: int = 8,
+                 q_period: int = 100, q_rounding: str = "nearest",
+                 q_type: str = "symmetric", q_groups: int = 1,
+                 q_verbose: bool = False, use_quantizer_kernel: bool = False,
+                 layer_name: str = "layer_"):
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_period = q_period
+        self.q_rounding = q_rounding
+        self.q_type = q_type
+        self.q_groups = q_groups
+        self.q_verbose = q_verbose
+        self.layer_name = layer_name
+        self.qsteps = 0
+        # per-layer current bits, lazily sized on first quantize()
+        self.bits: Dict[str, int] = {}
+        self.periods: Dict[str, int] = {}
+
+    def _layer_of(self, path: str) -> Optional[str]:
+        for part in path.split("/"):
+            if part.startswith(self.layer_name):
+                return part
+        return None
+
+    def update_eigenvalues(self, eigenvalues: List[float],
+                           layer_names: List[str]) -> None:
+        """Stretch each layer's period by its relative eigenvalue
+        (reference: period[i] *= eigenvalue[i]/max)."""
+        if not eigenvalues:
+            return
+        mx = max(eigenvalues)
+        for name, ev in zip(layer_names, eigenvalues):
+            self.periods[name] = max(
+                self.q_period, int(round(self.q_period * (1 + ev / mx))))
+
+    def _bits_for(self, layer: Optional[str]) -> int:
+        key = layer or "__global__"
+        if key not in self.bits:
+            self.bits[key] = self.q_start_bits
+        period = self.periods.get(key, self.q_period)
+        reductions = self.qsteps // period
+        bits = max(self.q_target_bits, self.q_start_bits - reductions)
+        if bits != self.bits[key] and self.q_verbose:
+            logger.info(f"MoQ: {key} precision → {bits} bits "
+                        f"(step {self.qsteps})")
+        self.bits[key] = bits
+        return bits
+
+    def quantize(self, params: Any, overflow: bool = False,
+                 eigenvalue_enabled: bool = False) -> Any:
+        """Fake-quantize 2D+ kernels at each layer's current bit-width
+        (straight-through; the engine calls this at GAS boundaries —
+        reference engine.py:1984). Skipped on fp16 overflow steps."""
+        if overflow:
+            return params
+        self.qsteps += 1
+
+        from deepspeed_tpu.compression.compress import _fake_quant
+
+        def visit(path, leaf):
+            p = "/".join(str(getattr(k, "key", k)) for k in path)
+            if not hasattr(leaf, "ndim") or leaf.ndim < 2 or "kernel" not in p:
+                return leaf
+            bits = self._bits_for(self._layer_of(p))
+            if bits >= 16:
+                return leaf
+            shared = SimpleNamespace(quantize_groups=self.q_groups,
+                                     rounding=self.q_rounding,
+                                     quantization_type=self.q_type)
+            q = _fake_quant(leaf.astype(jnp.float32), float(bits), shared,
+                            self.qsteps)
+            return q.astype(leaf.dtype)
+
+        return jax.tree_util.tree_map_with_path(visit, params)

@@ -4,3 +4,14 @@ from deepspeed_tpu.checkpoint.consolidate import (
     load_state_dict_from_consolidated,
     restore_with_shardings,
 )
+from deepspeed_tpu.checkpoint.megatron import (
+    MegatronCheckpoint,
+    cat_dim_for,
+    import_to_native,
+    merge_qkv,
+    merge_tp,
+    partition_data,
+    reshape_meg_2d,
+    split_qkv,
+    split_tp,
+)

@@ -1,0 +1,270 @@
+"""Megatron-DeepSpeed checkpoint interop: inspect, reshape, import.
+
+TPU-native analogue of the reference's offline checkpoint tools
+(``deepspeed/checkpoint/deepspeed_checkpoint.py:33`` ``DeepSpeedCheckpoint``,
+``reshape_meg_2d.py`` TP/PP re-layout, ``reshape_utils.py`` partition_data)
+plus the TP fragment merge/split semantics of ``MegatronSDLoader``
+(``deepspeed/runtime/state_dict_factory.py:190``).
+
+The reference reshapes *torch* checkpoints rank-file by rank-file. Here the
+target layout is mesh shardings, so the pipeline is:
+
+    Megatron-DS dir (layer_XX-model_YY.pt / mp_rank_XX_model_states.pt)
+      → logical (merged) numpy state dict                 [merge_tp]
+      → re-split for a new tp/pp grid                      [reshape_tp_pp]
+      → or exported to the native format where any mesh
+        can load it with metadata-only resharding          [import_to_native]
+
+Q/K/V fusion layouts follow the three historical Megatron checkpoint
+versions handled by ``merge_query_key_value``
+(state_dict_factory.py:220): version 0 stores [3*np*hn, h] (q-block,
+k-block, v-block per rank), versions 1.0/2.0 store per-rank interleaved
+rows that concatenate directly.
+"""
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+MODEL_FILE_PREFIX = "mp_rank_"
+LAYER_FILE_PREFIX = "layer_"
+MODEL_FILE_SUFFIX = "_model_states.pt"   # mp_rank_<TT>_model_states.pt
+LAYER_FILE_SUFFIX = "-model_states.pt"   # layer_<LL>-model_<TT>-model_states.pt
+ZERO_FILE_PREFIX = "zero_pp_rank_"
+
+# Parameters that are never TP-sharded (reference SEQUENTIAL_LAYERS,
+# deepspeed_checkpoint.py:25).
+REPLICATED_PATTERNS = [
+    r"layernorm", r"layer_norm", r"\.norm\.", r"position_embeddings",
+    r"\.attention\.dense\.bias", r"\.mlp\.dense_4h_to_h\.bias",
+]
+# Row-parallel weights concatenate on dim 1 (reference LAYER_CONCAT_DIM,
+# deepspeed_checkpoint.py:30); everything else sharded concatenates on dim 0.
+DIM1_PATTERNS = [r"attention\.dense\.weight", r"mlp\.dense_4h_to_h\.weight",
+                 r"\.o_proj\.", r"\.down_proj\."]
+QKV_PATTERNS = [r"query_key_value"]
+
+
+def _matches(key: str, patterns: Sequence[str]) -> bool:
+    return any(re.search(p, key) for p in patterns)
+
+
+def cat_dim_for(key: str) -> Optional[int]:
+    """None → replicated; else the TP concat dimension for this param."""
+    if _matches(key, REPLICATED_PATTERNS):
+        return None
+    return 1 if _matches(key, DIM1_PATTERNS) else 0
+
+
+def _to_numpy(x) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    try:  # torch tensors from .pt files
+        return x.detach().cpu().numpy()
+    except AttributeError:
+        return np.asarray(x)
+
+
+def merge_qkv(fragments: List[np.ndarray], version: float = 2.0) -> np.ndarray:
+    """Merge per-TP-rank fused-QKV fragments into the logical array
+    (reference merge_query_key_value, state_dict_factory.py:220)."""
+    if version == 0:
+        # each fragment is [q-block; k-block; v-block] — regroup so the
+        # merged array is [all-q; all-k; all-v]
+        parts = [np.split(f, 3, axis=0) for f in fragments]
+        return np.concatenate(
+            [np.concatenate([p[i] for p in parts], axis=0) for i in range(3)],
+            axis=0)
+    return np.concatenate(fragments, axis=0)
+
+
+def split_qkv(param: np.ndarray, num: int, index: int,
+              version: float = 2.0) -> np.ndarray:
+    """Inverse of merge_qkv (reference split_query_key_value,
+    state_dict_factory.py:258)."""
+    if version == 0:
+        q, k, v = np.split(param, 3, axis=0)
+        return np.concatenate([np.split(q, num, axis=0)[index],
+                               np.split(k, num, axis=0)[index],
+                               np.split(v, num, axis=0)[index]], axis=0)
+    return np.split(param, num, axis=0)[index]
+
+
+def merge_tp(state_dicts: List[Dict[str, Any]],
+             version: float = 2.0) -> Dict[str, np.ndarray]:
+    """TP-rank state dicts → one logical state dict."""
+    if len(state_dicts) == 1:
+        return {k: _to_numpy(v) for k, v in state_dicts[0].items()}
+    merged: Dict[str, np.ndarray] = {}
+    for key in state_dicts[0]:
+        frags = [_to_numpy(sd[key]) for sd in state_dicts]
+        if _matches(key, QKV_PATTERNS) and frags[0].ndim >= 1:
+            merged[key] = merge_qkv(frags, version)
+            continue
+        dim = cat_dim_for(key)
+        if dim is None or frags[0].ndim <= dim:
+            merged[key] = frags[0]
+        else:
+            merged[key] = np.concatenate(frags, axis=dim)
+    return merged
+
+
+def split_tp(state_dict: Dict[str, Any], tp_degree: int,
+             version: float = 2.0) -> List[Dict[str, np.ndarray]]:
+    """Logical state dict → tp_degree shard dicts (MegatronSDLoader
+    split_state_dict semantics, state_dict_factory.py:350)."""
+    shards: List[Dict[str, np.ndarray]] = [{} for _ in range(tp_degree)]
+    for key, value in state_dict.items():
+        arr = _to_numpy(value)
+        for r in range(tp_degree):
+            if _matches(key, QKV_PATTERNS) and arr.ndim >= 1:
+                shards[r][key] = split_qkv(arr, tp_degree, r, version)
+                continue
+            dim = cat_dim_for(key)
+            if dim is None or arr.ndim <= dim:
+                shards[r][key] = arr
+            else:
+                shards[r][key] = np.split(arr, tp_degree, axis=dim)[r]
+    return shards
+
+
+def _load_pt(path: str) -> Dict[str, Any]:
+    import torch
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _save_pt(obj: Dict[str, Any], path: str) -> None:
+    import torch
+
+    def conv(x):
+        return torch.from_numpy(np.ascontiguousarray(x)) \
+            if isinstance(x, np.ndarray) else x
+
+    torch.save({k: conv(v) for k, v in obj.items()}, path)
+
+
+class MegatronCheckpoint:
+    """Inspect a Megatron-DeepSpeed checkpoint folder
+    (reference ``DeepSpeedCheckpoint``, checkpoint/deepspeed_checkpoint.py:33).
+
+    Recognizes the reference's file naming: per-pipeline-layer files
+    ``layer_<LL>-model_<TT>-model_states.pt`` and monolithic per-TP-rank
+    files ``mp_rank_<TT>_model_states.pt``; ZeRO optimizer shards
+    ``zero_pp_rank_<D>_mp_rank_<TT>_optim_states.pt``.
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        files = sorted(os.listdir(directory))
+        self.layer_files = [f for f in files if f.startswith(LAYER_FILE_PREFIX)]
+        self.mp_rank_files = [
+            f for f in files
+            if f.startswith(MODEL_FILE_PREFIX) and f.endswith(MODEL_FILE_SUFFIX)]
+        self.zero_files = [f for f in files if f.startswith(ZERO_FILE_PREFIX)]
+
+        self.layer_keys = sorted({f.split("-")[0] for f in self.layer_files})
+        self.pp_degree = self._infer_pp_degree()
+        if self.layer_files:
+            tps = {int(re.search(r"model_(\d+)", f).group(1))
+                   for f in self.layer_files}
+            self.tp_degree = len(tps)
+        elif self.pp_degree > 1:
+            # monolithic mp_rank_<TT>_<PP> files: tp = distinct first indices
+            tps = {f[len(MODEL_FILE_PREFIX):-len(MODEL_FILE_SUFFIX)].split("_")[0]
+                   for f in self.mp_rank_files}
+            self.tp_degree = len(tps) or 1
+        else:
+            self.tp_degree = len(self.mp_rank_files) or 1
+        dp = {int(re.search(r"zero_pp_rank_(\d+)", f).group(1))
+              for f in self.zero_files} if self.zero_files else set()
+        self.dp_degree = len(dp) or 1
+
+    def _infer_pp_degree(self) -> int:
+        # mp_rank files are per (tp) only when pp==1; with pp>1 Megatron-DS
+        # writes mp_rank_<TT>_<PP> — treat extra groups as pp.
+        multi = [f for f in self.mp_rank_files
+                 if len(f[len(MODEL_FILE_PREFIX):-len(MODEL_FILE_SUFFIX)].split("_")) > 1]
+        if multi:
+            pps = {int(f[len(MODEL_FILE_PREFIX):-len(MODEL_FILE_SUFFIX)].split("_")[1])
+                   for f in multi}
+            return len(pps)
+        return 1
+
+    # --- per-component state access (get_embedding_state / transformer /
+    # final-norm accessors, deepspeed_checkpoint.py:134-191) ---------------
+    def layer_state(self, layer_key: str, tp_index: Optional[int] = None
+                    ) -> Dict[str, np.ndarray]:
+        """Merged (or single-TP-rank) state for one pipeline layer."""
+        files = [f for f in self.layer_files if f.startswith(layer_key + "-")]
+        files.sort(key=lambda f: int(re.search(r"model_(\d+)", f).group(1)))
+        if tp_index is not None:
+            files = [files[tp_index]]
+        sds = [_load_pt(os.path.join(self.dir, f)) for f in files]
+        sds = [sd.get("module", sd) for sd in sds]
+        return merge_tp(sds) if tp_index is None else \
+            {k: _to_numpy(v) for k, v in sds[0].items()}
+
+    def full_state(self) -> Dict[str, np.ndarray]:
+        """All layers merged into one logical state dict, keys prefixed by
+        their layer id (the universal-checkpoint flattening)."""
+        out: Dict[str, np.ndarray] = {}
+        if self.layer_files:
+            for lk in self.layer_keys:
+                for k, v in self.layer_state(lk).items():
+                    out[f"{lk}.{k}"] = v
+            return out
+        if self.pp_degree > 1:
+            raise NotImplementedError(
+                "monolithic mp_rank files with pp>1: merge per-stage "
+                "layer files instead (Megatron-DS writes layer_* files "
+                "whenever pp>1)")
+        sds = []
+        for f in sorted(self.mp_rank_files):
+            sd = _load_pt(os.path.join(self.dir, f))
+            sds.append(sd.get("module", sd))
+        return merge_tp(sds)
+
+
+def reshape_meg_2d(ckpt: MegatronCheckpoint, out_dir: str, new_tp: int,
+                   version: float = 2.0) -> None:
+    """Write a new Megatron-style layer checkpoint at a different TP degree
+    (reference reshape_meg_2d.py — the TP dimension reshape; PP re-layout
+    is re-binning layer files, which the layer naming already encodes)."""
+    os.makedirs(out_dir, exist_ok=True)
+    for lk in ckpt.layer_keys:
+        logical = ckpt.layer_state(lk)
+        for r, shard in enumerate(split_tp(logical, new_tp, version)):
+            _save_pt(shard, os.path.join(
+                out_dir, f"{lk}-model_{r:02d}{LAYER_FILE_SUFFIX}"))
+    logger.info(f"reshaped {ckpt.dir} (tp={ckpt.tp_degree}) -> "
+                f"{out_dir} (tp={new_tp})")
+
+
+def import_to_native(ckpt: MegatronCheckpoint, out_dir: str) -> str:
+    """Convert a Megatron-DS checkpoint into the native logical-array format
+    (npz + meta.json). Any engine mesh can then load it; resharding is
+    metadata-only (the universal-checkpoint promise,
+    checkpoint/universal_checkpoint.py, without per-fragment re-chunk code)."""
+    os.makedirs(out_dir, exist_ok=True)
+    state = ckpt.full_state()
+    np.savez(os.path.join(out_dir, "state.npz"), **state)
+    meta = {"source": ckpt.dir, "tp_degree": ckpt.tp_degree,
+            "pp_degree": ckpt.pp_degree, "dp_degree": ckpt.dp_degree,
+            "params": {k: list(v.shape) for k, v in state.items()}}
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return os.path.join(out_dir, "state.npz")
+
+
+def partition_data(data: Sequence[Any], num_partitions: int) -> List[List[Any]]:
+    """Evenly partition a list (reference reshape_utils.py partition_data)."""
+    if len(data) % num_partitions:
+        raise ValueError(
+            f"cannot partition {len(data)} items into {num_partitions}")
+    n = len(data) // num_partitions
+    return [list(data[i * n:(i + 1) * n]) for i in range(num_partitions)]

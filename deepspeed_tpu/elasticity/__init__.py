@@ -5,3 +5,4 @@ from deepspeed_tpu.elasticity.elasticity import (
     compute_elastic_config,
     get_valid_gpus,
 )
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent

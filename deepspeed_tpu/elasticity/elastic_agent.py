@@ -1,0 +1,160 @@
+"""Elastic worker agent — membership-change supervision for TPU jobs.
+
+Analogue of the reference's ``DSElasticAgent``
+(deepspeed/elasticity/elastic_agent.py:28) and the torch-elastic restart
+loop it rides on. The reference patches torch-elastic's worker env and lets
+rendezvous restart ranks when membership changes; on TPU the natural design
+is a host-side supervisor:
+
+  resolve world → compute the compatible elastic config
+  (``compute_elastic_config``, elasticity/elasticity.py) → export env →
+  run the training process → on failure or membership change, re-resolve
+  and restart; recovery state comes from the latest checkpoint (the
+  reference's actual recovery story too — SURVEY.md §5).
+
+``resolve_world`` defaults to local device count but accepts any callable
+(TPU pod metadata, GKE downward API, a hostfile watcher), which is the
+rendezvous-backend plug point.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityIncompatibleWorldSize, compute_elastic_config,
+)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _default_resolve_world() -> int:
+    try:
+        import jax
+        return jax.device_count()
+    except Exception:
+        return 1
+
+
+class DSElasticAgent:
+    """Supervise a training command under an elastic world-size contract.
+
+    Parameters
+    ----------
+    cmd : the training command (list of argv strings).
+    ds_config : DeepSpeed-style config dict with an ``elasticity`` section.
+    resolve_world : callable returning the currently available chip count.
+    max_restarts : restarts allowed before giving up (torch-elastic
+        ``max_restarts`` analogue).
+    env : extra env vars for the worker (reference ``ds_env``).
+    """
+
+    def __init__(self, cmd: List[str], ds_config: Dict,
+                 resolve_world: Optional[Callable[[], int]] = None,
+                 max_restarts: int = 3, env: Optional[Dict[str, str]] = None,
+                 restart_backoff_s: float = 1.0):
+        self.cmd = list(cmd)
+        self.ds_config = ds_config
+        self.resolve_world = resolve_world or _default_resolve_world
+        self.max_restarts = max_restarts
+        self.extra_env = dict(env or {})
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_count = 0
+        self._proc: Optional[subprocess.Popen] = None
+
+    def _worker_env(self, world_size: int) -> Dict[str, str]:
+        final_batch, valid_world_sizes, micro_batch = compute_elastic_config(
+            self.ds_config, world_size=world_size, return_microbatch=True)
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update({
+            "WORLD_SIZE": str(world_size),
+            "DST_ELASTIC_WORLD_SIZE": str(world_size),
+            "DST_ELASTIC_TRAIN_BATCH": str(final_batch),
+            "DST_ELASTIC_MICRO_BATCH": str(micro_batch),
+            "DST_ELASTIC_RESTART_COUNT": str(self.restart_count),
+        })
+        return env
+
+    def _spawn(self, env: Dict[str, str]) -> subprocess.Popen:
+        return subprocess.Popen(self.cmd, env=env)
+
+    def stop(self):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+    def run(self) -> int:
+        """Supervision loop; returns the final exit code."""
+        while True:
+            world = self.resolve_world()
+            try:
+                env = self._worker_env(world)
+            except ElasticityIncompatibleWorldSize as e:
+                logger.error(f"world size {world} incompatible: {e}")
+                return 1
+            logger.info(
+                f"elastic agent: starting worker, world={world}, "
+                f"restart={self.restart_count}/{self.max_restarts}")
+            self._proc = self._spawn(env)
+            rc = self._proc.wait()
+            if rc == 0:
+                return 0
+            if self.restart_count >= self.max_restarts:
+                logger.error(f"worker failed (rc={rc}); restart budget "
+                             f"exhausted ({self.max_restarts})")
+                return rc
+            self.restart_count += 1
+            new_world = self.resolve_world()
+            logger.warning(
+                f"worker failed (rc={rc}); re-resolving membership "
+                f"({world} -> {new_world}) and restarting from checkpoint")
+            time.sleep(self.restart_backoff_s)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``dst_elastic`` CLI (reference ``bin/ds_elastic``): print the elastic
+    config and, with ``--world-size``, the resolved batch/micro-batch; with
+    ``--run``, supervise a training command elastically."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="dst_elastic")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed-style config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--run", nargs=argparse.REMAINDER, default=None,
+                        help="training command to supervise elastically")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    print(json.dumps(ds_config.get("elasticity", {}), indent=4,
+                     sort_keys=True))
+
+    if args.run:
+        agent = DSElasticAgent(args.run, ds_config,
+                               max_restarts=args.max_restarts)
+        return agent.run()
+
+    if args.world_size > 0:
+        batch, valid, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size, return_microbatch=True)
+        print(f"final_batch_size .... {batch}")
+        print(f"valid_gpus .......... {valid}")
+        print(f"micro_batch_size .... {micro}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"final_batch_size .... {batch}")
+        print(f"valid_gpus .......... {valid}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,8 +6,9 @@ block; here Hessian-vector products are a single ``jax.jvp`` through
 ``jax.grad`` (forward-over-reverse), jitted once and reused across
 iterations. Eigenvalues are computed per "block" — a sub-tree of the param
 pytree selected by path prefix (the analogue of the reference's per-layer
-module walk) — and post-processed the same way: nan→max, then scaled to
-[ratio·max, max] so downstream MoQ schedules see stable relative magnitudes.
+module walk) — and post-processed the same way: |ev| normalized to [0, 1]
+by the block max, with nan/zero mapped to 1.0 (most-sensitive), so
+downstream MoQ schedules see stable relative magnitudes.
 """
 
 from typing import Any, Callable, Dict, List, Optional
@@ -119,14 +120,15 @@ class Eigenvalue:
         return self.post_process(eigenvalues)
 
     def post_process(self, eigenvalues: List[float]) -> List[float]:
-        """nan → max, then scale into [ratio·max, max]
-        (reference eigenvalue.py nan/scale handling)."""
+        """|ev| / blockwise-max → [0, 1]; nan and exact zeros map to 1.0
+        (treated as maximally sensitive — reference eigenvalue.py:147)."""
         arr = np.asarray(eigenvalues, dtype=np.float64)
         if not len(arr):
             return []
         finite = arr[np.isfinite(arr)]
-        mx = float(np.abs(finite).max()) if len(finite) else self.stability
-        mx = max(mx, self.stability)
-        arr = np.where(np.isfinite(arr), np.abs(arr), mx)
-        arr = np.maximum(arr, self.stability)
-        return [float(x) for x in arr]
+        mx = float(np.abs(finite).max()) if len(finite) else 0.0
+        if mx <= 0.0:
+            return [1.0] * len(arr)
+        out = np.where(np.isfinite(arr), np.abs(arr) / mx, 1.0)
+        out = np.where(out == 0.0, 1.0, out)
+        return [float(x) for x in out]

@@ -448,8 +448,10 @@ class DeepSpeedEngine:
                 self._jit_train_batch(self.params, self.opt_state,
                                       self.scaler_state, batch)
         if self.eigenvalue is not None or self.quantizer is not None:
-            mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
-                  for k, v in batch.items() if k != STEP_KEY}
+            mb = None
+            if self.eigenvalue is not None:  # only the eigenvalue path reads it
+                mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
+                      for k, v in batch.items() if k != STEP_KEY}
             self._misc_runtime_step(mb, finite)
         self._after_step(finite)
         self.micro_steps += gas

@@ -40,6 +40,7 @@ class Quantizer:
         # per-layer current bits, lazily sized on first quantize()
         self.bits: Dict[str, int] = {}
         self.periods: Dict[str, int] = {}
+        self._jit_cache: Dict[Any, Any] = {}
 
     def _layer_of(self, path: str) -> Optional[str]:
         for part in path.split("/"):
@@ -71,8 +72,37 @@ class Quantizer:
         self.bits[key] = bits
         return bits
 
-    def quantize(self, params: Any, overflow: bool = False,
-                 eigenvalue_enabled: bool = False) -> Any:
+    def _apply_fn(self, bits_sig):
+        """One jitted whole-tree quantize program per distinct per-layer
+        bit layout (bit widths are compile-time constants; the step index
+        stays traced so stochastic rounding doesn't recompile)."""
+        if bits_sig in self._jit_cache:
+            return self._jit_cache[bits_sig]
+
+        from deepspeed_tpu.compression.compress import _fake_quant
+
+        mapping = dict(bits_sig)
+        shared = SimpleNamespace(quantize_groups=self.q_groups,
+                                 rounding=self.q_rounding,
+                                 quantization_type=self.q_type)
+
+        def apply(params, step):
+            def visit(path, leaf):
+                p = "/".join(str(getattr(k, "key", k)) for k in path)
+                bits = mapping.get(p)
+                if bits is None:
+                    return leaf
+                q = _fake_quant(leaf.astype(jnp.float32), float(bits),
+                                shared, step)
+                return q.astype(leaf.dtype)
+
+            return jax.tree_util.tree_map_with_path(visit, params)
+
+        fn = jax.jit(apply)
+        self._jit_cache[bits_sig] = fn
+        return fn
+
+    def quantize(self, params: Any, overflow: bool = False) -> Any:
         """Fake-quantize 2D+ kernels at each layer's current bit-width
         (straight-through; the engine calls this at GAS boundaries —
         reference engine.py:1984). Skipped on fp16 overflow steps."""
@@ -80,20 +110,15 @@ class Quantizer:
             return params
         self.qsteps += 1
 
-        from deepspeed_tpu.compression.compress import _fake_quant
-
-        def visit(path, leaf):
+        sig = []
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params):
             p = "/".join(str(getattr(k, "key", k)) for k in path)
             if not hasattr(leaf, "ndim") or leaf.ndim < 2 or "kernel" not in p:
-                return leaf
+                continue
             bits = self._bits_for(self._layer_of(p))
-            if bits >= 16:
-                return leaf
-            shared = SimpleNamespace(quantize_groups=self.q_groups,
-                                     rounding=self.q_rounding,
-                                     quantization_type=self.q_type)
-            q = _fake_quant(leaf.astype(jnp.float32), float(bits), shared,
-                            self.qsteps)
-            return q.astype(leaf.dtype)
-
-        return jax.tree_util.tree_map_with_path(visit, params)
+            if bits < 16:
+                sig.append((p, bits))
+        if not sig:
+            return params
+        fn = self._apply_fn(tuple(sig))
+        return fn(params, jnp.asarray(self.qsteps, jnp.int32))

@@ -8,3 +8,9 @@ from deepspeed_tpu.runtime.zero.stages import (
     opt_state_shardings,
     plan_zero_shardings,
 )
+from deepspeed_tpu.runtime.zero.tiling import (
+    TiledLinear,
+    dense_to_tiles,
+    tiled_matmul,
+    tiles_to_dense,
+)

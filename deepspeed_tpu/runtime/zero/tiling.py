@@ -1,0 +1,123 @@
+"""TiledLinear — memory-bounded huge linear layers.
+
+TPU-native analogue of the reference's ``TiledLinear``
+(deepspeed/runtime/zero/tiling.py:32): a linear layer whose weight is stored
+as an ``in_splits x out_splits`` grid of tiles so that (a) under ZeRO-3
+sharding only one tile needs to be resident/gathered at a time, and (b) the
+peak activation memory of the matmul is bounded by one tile-row of the
+output. The reference walks the tile grid with Python loops over
+``torch.nn.Linear`` children; here the walk is a ``lax.scan`` over stacked
+tile arrays so the whole layer stays one XLA program, each scan step touches
+exactly one [in_tile, out_tile-row] slice, and ``jax.checkpoint`` on the
+scan body gives the inactive-tile memory behavior ZeRO-3 provides in the
+reference (tiles outside the active step are never live in HBM when the
+params are sharded).
+
+``TiledLinearReturnBias`` (reference tiling.py:259, used by Megatron-style
+rows that defer the bias add) is the ``apply_bias=False`` mode: the bias is
+returned alongside the product instead of added.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+def tiled_matmul(x: jnp.ndarray, tiles: jnp.ndarray, *,
+                 remat: bool = True) -> jnp.ndarray:
+    """y = x @ W where W is given as stacked tiles.
+
+    ``tiles``: [in_splits, out_splits, in_tile, out_tile] — the logical
+    weight is the block matrix W[i*in_tile:(i+1)*in_tile,
+    j*out_tile:(j+1)*out_tile] = tiles[i, j].
+
+    Scans over the input splits, accumulating partial products into the full
+    output row; each step reads one tile-row, so at most
+    ``in_tile x out_features`` weight elements are live per step.
+    """
+    in_splits, out_splits, in_tile, out_tile = tiles.shape
+    x_split = x.reshape(x.shape[:-1] + (in_splits, in_tile))
+    x_split = jnp.moveaxis(x_split, -2, 0)  # [in_splits, ..., in_tile]
+
+    def body(acc, xw):
+        xi, wi = xw  # xi: [..., in_tile]; wi: [out_splits, in_tile, out_tile]
+        w_row = jnp.transpose(wi, (1, 0, 2)).reshape(in_tile,
+                                                     out_splits * out_tile)
+        return acc + xi @ w_row, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    out_shape = x.shape[:-1] + (out_splits * out_tile,)
+    acc0 = jnp.zeros(out_shape, dtype=x.dtype)
+    y, _ = jax.lax.scan(body, acc0, (x_split, tiles))
+    return y
+
+
+class TiledLinear(nn.Module):
+    """Drop-in linear with a tiled weight grid (reference tiling.py:32).
+
+    Attributes mirror the reference's constructor: ``in_splits``/``out_splits``
+    control the grid; ``apply_bias=False`` returns ``(y, bias)`` instead of
+    adding it (the ``TiledLinearReturnBias`` behavior, tiling.py:259).
+    """
+
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    apply_bias: bool = True
+    dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    remat: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        if in_features % self.in_splits or self.features % self.out_splits:
+            raise ValueError(
+                f"in_features {in_features} / features {self.features} must "
+                f"divide in_splits {self.in_splits} / out_splits "
+                f"{self.out_splits}")
+        in_tile = in_features // self.in_splits
+        out_tile = self.features // self.out_splits
+
+        def init(key, shape, dtype):
+            # Initialize as one dense kernel so numerics match an untiled
+            # nn.Dense with the same init, then carve into the tile grid.
+            full = self.kernel_init(key, (in_features, self.features), dtype)
+            grid = full.reshape(self.in_splits, in_tile,
+                                self.out_splits, out_tile)
+            return jnp.transpose(grid, (0, 2, 1, 3))
+
+        tiles = self.param("tiles", init,
+                           (self.in_splits, self.out_splits, in_tile, out_tile),
+                           self.dtype)
+        y = tiled_matmul(x.astype(self.dtype), tiles, remat=self.remat)
+        if not self.use_bias:
+            return y
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.dtype)
+        if self.apply_bias:
+            return y + bias
+        return y, bias
+
+
+def tiles_to_dense(tiles: jnp.ndarray) -> jnp.ndarray:
+    """Reassemble the logical [in_features, out_features] kernel."""
+    in_splits, out_splits, in_tile, out_tile = tiles.shape
+    return jnp.transpose(tiles, (0, 2, 1, 3)).reshape(
+        in_splits * in_tile, out_splits * out_tile)
+
+
+def dense_to_tiles(kernel: jnp.ndarray, in_splits: int,
+                   out_splits: int) -> jnp.ndarray:
+    """Carve an existing dense kernel into the tile grid (the reference's
+    ``copy_params_from`` path, tiling.py:222)."""
+    in_features, out_features = kernel.shape
+    grid = kernel.reshape(in_splits, in_features // in_splits,
+                          out_splits, out_features // out_splits)
+    return jnp.transpose(grid, (0, 2, 1, 3))

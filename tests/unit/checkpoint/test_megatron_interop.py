@@ -1,0 +1,154 @@
+"""Megatron-DS checkpoint interop: merge/split/reshape/import round trips
+(reference tests/unit/checkpoint/test_reshape_checkpoint.py pattern on
+synthetic checkpoints)."""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint.megatron import (
+    MegatronCheckpoint, import_to_native, merge_qkv, merge_tp,
+    partition_data, reshape_meg_2d, split_qkv, split_tp,
+)
+from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+
+H = 8  # hidden
+HEADS = 4
+
+
+def _layer_sd(rng, tp, rank):
+    """One TP-rank fragment of a transformer layer state dict."""
+    full_qkv = rng.standard_normal((3 * H, H)).astype(np.float32)
+    return {
+        "attention.query_key_value.weight":
+            np.split(full_qkv, tp, axis=0)[rank],  # v2.0: direct rows
+        "attention.dense.weight":
+            rng.standard_normal((H, H // tp)).astype(np.float32),
+        "mlp.dense_h_to_4h.weight":
+            rng.standard_normal((4 * H // tp, H)).astype(np.float32),
+        "mlp.dense_4h_to_h.weight":
+            rng.standard_normal((H, 4 * H // tp)).astype(np.float32),
+        "input_layernorm.weight": np.ones((H,), np.float32),
+    }
+
+
+def test_qkv_merge_split_roundtrip_v0(rng):
+    full = rng.standard_normal((3 * H, H)).astype(np.float32)
+    frags = [split_qkv(full, 2, i, version=0) for i in range(2)]
+    np.testing.assert_array_equal(merge_qkv(frags, version=0), full)
+
+
+def test_qkv_merge_split_roundtrip_v2(rng):
+    full = rng.standard_normal((3 * H, H)).astype(np.float32)
+    frags = [split_qkv(full, 4, i, version=2.0) for i in range(4)]
+    np.testing.assert_array_equal(merge_qkv(frags, version=2.0), full)
+
+
+def test_merge_split_tp_roundtrip(rng):
+    logical = {
+        "attention.query_key_value.weight":
+            rng.standard_normal((3 * H, H)).astype(np.float32),
+        "attention.dense.weight":
+            rng.standard_normal((H, H)).astype(np.float32),
+        "mlp.dense_h_to_4h.weight":
+            rng.standard_normal((4 * H, H)).astype(np.float32),
+        "input_layernorm.weight": np.ones((H,), np.float32),
+    }
+    shards = split_tp(logical, 2)
+    # row-parallel weight split on dim 1, column-parallel on dim 0
+    assert shards[0]["attention.dense.weight"].shape == (H, H // 2)
+    assert shards[0]["mlp.dense_h_to_4h.weight"].shape == (2 * H, H)
+    assert shards[0]["input_layernorm.weight"].shape == (H,)
+    merged = merge_tp(shards)
+    for k in logical:
+        np.testing.assert_array_equal(merged[k], logical[k])
+
+
+def _write_meg_ckpt(d, rng, tp=2, layers=2):
+    for layer in range(layers):
+        lid = f"layer_{layer:02d}"
+        fulls = _layer_sd(rng, 1, 0)
+        shards = split_tp(fulls, tp)
+        for r in range(tp):
+            torch.save(
+                {k: torch.from_numpy(v) for k, v in shards[r].items()},
+                os.path.join(d, f"{lid}-model_{r:02d}-model_states.pt"))
+    return d
+
+
+def test_megatron_checkpoint_inspect_and_merge(tmp_path, rng):
+    d = _write_meg_ckpt(str(tmp_path), rng, tp=2, layers=2)
+    ckpt = MegatronCheckpoint(d)
+    assert ckpt.tp_degree == 2
+    assert ckpt.layer_keys == ["layer_00", "layer_01"]
+    state = ckpt.layer_state("layer_00")
+    assert state["attention.query_key_value.weight"].shape == (3 * H, H)
+    assert state["attention.dense.weight"].shape == (H, H)
+
+
+def test_reshape_and_import(tmp_path, rng):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    _write_meg_ckpt(src, rng, tp=2, layers=1)
+    dst = str(tmp_path / "tp4")
+    ckpt = MegatronCheckpoint(src)
+    logical_before = ckpt.layer_state("layer_00")
+
+    reshape_meg_2d(ckpt, dst, new_tp=4)
+    re = MegatronCheckpoint(dst)
+    assert re.tp_degree == 4
+    logical_after = re.layer_state("layer_00")
+    for k in logical_before:
+        np.testing.assert_array_equal(logical_after[k], logical_before[k])
+
+    out = import_to_native(ckpt, str(tmp_path / "native"))
+    loaded = dict(np.load(out))
+    np.testing.assert_array_equal(
+        loaded["layer_00.attention.dense.weight"],
+        logical_before["attention.dense.weight"])
+
+
+def test_sd_loader_merge_and_split(tmp_path, rng):
+    logical = _layer_sd(rng, 1, 0)
+    shards = split_tp(logical, 2)
+    paths = []
+    for r in range(2):
+        p = str(tmp_path / f"ckpt_{r}.pt")
+        torch.save({k: torch.from_numpy(v) for k, v in shards[r].items()}, p)
+        paths.append(p)
+
+    loader = SDLoaderFactory.get_sd_loader(paths, version=2.0)
+
+    # direct
+    _, sd = loader.load(mp_world_size=2, mp_rank=1)
+    np.testing.assert_array_equal(sd["input_layernorm.weight"],
+                                  logical["input_layernorm.weight"])
+    # merge 2 → 1
+    _, sd = loader.load(mp_world_size=1, mp_rank=0)
+    for k in logical:
+        np.testing.assert_array_equal(sd[k], logical[k])
+    # split 2 → 4: stitching all four target ranks back must equal logical
+    quarters = [loader.load(4, r)[1] for r in range(4)]
+    restitched = merge_tp(quarters)
+    for k in logical:
+        np.testing.assert_array_equal(restitched[k], logical[k])
+
+
+def test_sd_loader_json(tmp_path, rng):
+    logical = _layer_sd(rng, 1, 0)
+    p = str(tmp_path / "ckpt_0.pt")
+    torch.save({k: torch.from_numpy(v) for k, v in logical.items()}, p)
+    loader = SDLoaderFactory.get_sd_loader_json(
+        {"type": "Megatron", "checkpoints": ["ckpt_0.pt"],
+         "base_dir": str(tmp_path), "version": 2.0})
+    _, sd = loader.load(1, 0)
+    assert set(sd) == set(logical)
+
+
+def test_partition_data():
+    assert partition_data(list(range(6)), 3) == [[0, 1], [2, 3], [4, 5]]
+    with pytest.raises(ValueError):
+        partition_data([1, 2, 3], 2)

@@ -1,0 +1,67 @@
+"""Elastic agent: restart-on-failure with membership re-resolution
+(reference tests/unit/elasticity pattern, agent behavior from
+elasticity/elastic_agent.py:28)."""
+
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, main
+
+ELASTIC_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 64,
+        "micro_batch_sizes": [1, 2, 4],
+        "min_gpus": 1,
+        "max_gpus": 8,
+        "version": 0.1,
+    }
+}
+
+
+def _agent(tmp_path, fail_times: int, worlds):
+    """Worker succeeds only after `fail_times` failures (state on disk)."""
+    marker = tmp_path / "fails"
+    marker.write_text("0")
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import sys, os\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read())\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        f"sys.exit(1 if n < {fail_times} else 0)\n")
+    remaining = list(worlds)
+
+    def resolve():
+        return remaining.pop(0) if len(remaining) > 1 else remaining[0]
+
+    return DSElasticAgent(
+        [sys.executable, str(script)], ELASTIC_CONFIG,
+        resolve_world=resolve, max_restarts=3, restart_backoff_s=0.0)
+
+
+def test_agent_restarts_until_success(tmp_path):
+    agent = _agent(tmp_path, fail_times=2, worlds=[4, 4, 2, 2])
+    assert agent.run() == 0
+    assert agent.restart_count == 2
+
+
+def test_agent_gives_up_after_budget(tmp_path):
+    agent = _agent(tmp_path, fail_times=99, worlds=[4] * 10)
+    agent.max_restarts = 1
+    assert agent.run() != 0
+
+
+def test_agent_rejects_incompatible_world(tmp_path):
+    agent = _agent(tmp_path, fail_times=0, worlds=[7])  # 7 not a valid world
+    assert agent.run() == 1
+
+
+def test_cli_prints_config(tmp_path, capsys):
+    import json
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(json.dumps(ELASTIC_CONFIG))
+    assert main(["-c", str(cfg), "-w", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "final_batch_size" in out and "micro_batch_size" in out

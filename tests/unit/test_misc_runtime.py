@@ -36,15 +36,18 @@ def test_eigenvalue_quadratic_exact():
 
     ev = Eigenvalue(max_iter=50, tol=1e-4).compute_eigenvalue(
         loss_fn, params, batch=None)
-    np.testing.assert_allclose(ev, [5.0, 9.0], rtol=1e-2)
+    # post-processed to [0, 1] relative to the max block (reference
+    # eigenvalue.py:147): raw values are 5.0 and 9.0
+    np.testing.assert_allclose(ev, [5.0 / 9.0, 1.0], rtol=1e-2)
 
 
 def test_eigenvalue_post_process_nan_and_scale():
     e = Eigenvalue(stability=1e-6)
     out = e.post_process([float("nan"), -4.0, 2.0])
-    assert out[0] == 4.0          # nan → max |ev|
-    assert out[1] == 4.0          # abs
-    assert out[2] == 2.0
+    assert out[0] == 1.0          # nan → 1.0 (most sensitive)
+    assert out[1] == 1.0          # |−4| / max = 1
+    assert out[2] == 0.5          # 2 / 4
+    assert e.post_process([0.0, 2.0]) == [1.0, 1.0]  # zero → 1.0
     assert e.post_process([]) == []
 
 

@@ -158,8 +158,11 @@ class MegatronCheckpoint:
     ``zero_pp_rank_<D>_mp_rank_<TT>_optim_states.pt``.
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, version: float = 2.0):
         self.dir = directory
+        # QKV layout version of the source checkpoint (state_dict_factory.py
+        # merge semantics); plumbed into every merge_tp this object performs
+        self.version = version
         files = sorted(os.listdir(directory))
         self.layer_files = [f for f in files if f.startswith(LAYER_FILE_PREFIX)]
         self.mp_rank_files = [
@@ -206,7 +209,7 @@ class MegatronCheckpoint:
             files = [files[tp_index]]
         sds = [_load_pt(os.path.join(self.dir, f)) for f in files]
         sds = [sd.get("module", sd) for sd in sds]
-        return merge_tp(sds) if tp_index is None else \
+        return merge_tp(sds, self.version) if tp_index is None else \
             {k: _to_numpy(v) for k, v in sds[0].items()}
 
     def full_state(self) -> Dict[str, np.ndarray]:
@@ -227,14 +230,18 @@ class MegatronCheckpoint:
         for f in sorted(self.mp_rank_files):
             sd = _load_pt(os.path.join(self.dir, f))
             sds.append(sd.get("module", sd))
-        return merge_tp(sds)
+        return merge_tp(sds, self.version)
 
 
 def reshape_meg_2d(ckpt: MegatronCheckpoint, out_dir: str, new_tp: int,
-                   version: float = 2.0) -> None:
+                   version: Optional[float] = None) -> None:
     """Write a new Megatron-style layer checkpoint at a different TP degree
     (reference reshape_meg_2d.py — the TP dimension reshape; PP re-layout
-    is re-binning layer files, which the layer naming already encodes)."""
+    is re-binning layer files, which the layer naming already encodes).
+    ``version`` is the QKV layout of the *output*; defaults to the source
+    checkpoint's version (the merge side always uses ``ckpt.version``)."""
+    if version is None:
+        version = ckpt.version
     os.makedirs(out_dir, exist_ok=True)
     for lk in ckpt.layer_keys:
         logical = ckpt.layer_state(lk)

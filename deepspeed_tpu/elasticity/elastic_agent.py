@@ -110,10 +110,9 @@ class DSElasticAgent:
                              f"exhausted ({self.max_restarts})")
                 return rc
             self.restart_count += 1
-            new_world = self.resolve_world()
             logger.warning(
-                f"worker failed (rc={rc}); re-resolving membership "
-                f"({world} -> {new_world}) and restarting from checkpoint")
+                f"worker failed (rc={rc}); re-resolving membership and "
+                f"restarting from checkpoint (was world={world})")
             time.sleep(self.restart_backoff_s)
 
 

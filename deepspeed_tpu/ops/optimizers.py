@@ -75,9 +75,33 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
         return optax.lion(learning_rate, b1=betas[0], b2=betas[1],
                           weight_decay=params.get("weight_decay", 0.0))
     if name in ("onebitadam", "zerooneadam", "onebitlamb"):
-        from deepspeed_tpu.ops.onebit import onebit_wrap
+        from deepspeed_tpu.ops import onebit
 
-        base = "lamb" if "lamb" in name else "adam"
-        inner = build_optimizer(base, params, lr)
-        return onebit_wrap(inner, freeze_steps=params.get("freeze_step", 100))
+        a = _adam_args(params)
+        common = dict(
+            learning_rate=learning_rate, b1=a["b1"], b2=a["b2"], eps=a["eps"],
+            weight_decay=a["weight_decay"],
+            exp_avg_mask=params.get("exp_avg_mask"),
+            axis_name=params.get("axis_name"),
+            world_size=params.get("world_size", 1),
+        )
+        if name == "onebitadam":
+            return onebit.onebit_adam(
+                freeze_step=params.get("freeze_step", 100000), **common)
+        if name == "zerooneadam":
+            return onebit.zero_one_adam(
+                var_freeze_step=params.get("var_freeze_step", 100000),
+                var_update_scaler=params.get("var_update_scaler", 16),
+                local_step_scaler=params.get("local_step_scaler", 32678),
+                local_step_clipper=params.get("local_step_clipper", 16),
+                **common)
+        return onebit.onebit_lamb(
+            freeze_step=params.get("freeze_step", 100000),
+            max_coeff=params.get("max_coeff", 10.0),
+            min_coeff=params.get("min_coeff", 0.01),
+            coeff_beta=params.get("coeff_beta", 0.9),
+            factor_max=params.get("factor_max", 4.0),
+            factor_min=params.get("factor_min", 0.5),
+            factor_threshold=params.get("factor_threshold", 0.1),
+            **common)
     raise ValueError(f"Unknown optimizer type: {type_name}")

@@ -29,8 +29,8 @@ def _tree_norm(a) -> jnp.ndarray:
     return jnp.sqrt(_tree_dot(a, a))
 
 
-def _normalize(a):
-    n = _tree_norm(a) + 1e-12
+def _normalize(a, eps: float = 1e-12):
+    n = _tree_norm(a) + eps
     return jax.tree_util.tree_map(lambda x: x / n, a)
 
 
@@ -49,7 +49,7 @@ def block_paths(params: Any, prefix: str = "layer_") -> List[str]:
 
 class Eigenvalue:
     """reference ``Eigenvalue`` (eigenvalue.py:12). Same knobs:
-    verbose, max_iter, tol, stability (nan replacement epsilon),
+    verbose, max_iter, tol, stability (power-iteration normalization epsilon),
     gas_boundary_resolution (how often the engine calls this),
     layer_name/layer_num select the blocks."""
 
@@ -99,7 +99,7 @@ class Eigenvalue:
             v = jax.tree_util.tree_unflatten(treedef, [
                 jax.random.normal(k, l.shape, jnp.float32)
                 for k, l in zip(ks, leaves)])
-            v = _normalize(v)
+            v = _normalize(v, self.stability)
 
             ev = 0.0
             for it in range(self.max_iter):
@@ -108,7 +108,7 @@ class Eigenvalue:
                 hv = jax.tree_util.tree_map(
                     lambda x: x.astype(jnp.float32), hvp(params, batch, vb))
                 new_ev = float(_tree_dot(v, hv))
-                v = _normalize(hv)
+                v = _normalize(hv, self.stability)
                 if it > 0 and abs(new_ev - ev) <= self.tol * abs(new_ev):
                     ev = new_ev
                     break

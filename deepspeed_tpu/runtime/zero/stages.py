@@ -113,10 +113,14 @@ def opt_state_shardings(opt_state: Any, params: Any, plan: ZeroShardingPlan,
         return NamedSharding(mesh, PartitionSpec())
 
     def map_subtree(subtree):
-        # If this subtree has the same structure as params, map spec-wise.
+        # If this subtree has the same structure AND leaf shapes as params,
+        # map spec-wise. (Structure alone is not enough: e.g. the 1-bit
+        # optimizers carry flat error buffers in a params-shaped tree.)
         try:
             sub_flat, sub_def = jax.tree_util.tree_flatten(subtree)
-            if sub_def == params_treedef:
+            if sub_def == params_treedef and all(
+                    getattr(l, "shape", None) == p.shape
+                    for l, p in zip(sub_flat, flat_params)):
                 return jax.tree_util.tree_unflatten(
                     sub_def, [plan.opt_sharding_fn(s) for s in flat_specs])
         except Exception:

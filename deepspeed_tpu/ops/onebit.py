@@ -39,11 +39,26 @@ from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 Schedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
-_BIT_WEIGHTS = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+# numpy, not jnp: a module-level jnp array would initialize the JAX backend
+# at import time (breaks multi-host init ordering)
+_BIT_WEIGHTS = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], np.uint8)
+
+
+def _tree_unzip(tree_of_tuples, template, arity: int):
+    """Split a pytree whose *leaves* (w.r.t. ``template``'s structure) are
+    arity-tuples into ``arity`` separate trees. Anchored on ``template``'s
+    treedef rather than ``isinstance(x, tuple)`` so params pytrees that
+    themselves contain tuple nodes cannot be mis-split."""
+    treedef = jax.tree_util.tree_structure(template)
+    tuples = treedef.flatten_up_to(tree_of_tuples)
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [t[i] for t in tuples])
+        for i in range(arity))
 
 
 def _lr_at(lr: Schedule, step: jnp.ndarray) -> jnp.ndarray:
@@ -147,15 +162,10 @@ class _ErrorState(NamedTuple):
 def _init_errors(params, axis_name: Optional[str], world_hint: int) -> _ErrorState:
     world = world_hint if axis_name is not None else 1
 
-    def mk(p):
-        return error_buffers(p.size, world)
-
-    pairs = jax.tree_util.tree_map(mk, params)
-    is_pair = lambda x: isinstance(x, tuple)
-    return _ErrorState(
-        worker=jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair),
-        server=jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair),
-    )
+    pairs = jax.tree_util.tree_map(lambda p: error_buffers(p.size, world),
+                                   params)
+    worker, server = _tree_unzip(pairs, params, 2)
+    return _ErrorState(worker=worker, server=server)
 
 
 def _compress_tree(tree, errors: _ErrorState, axis_name: Optional[str]):
@@ -165,9 +175,8 @@ def _compress_tree(tree, errors: _ErrorState, axis_name: Optional[str]):
         return out.reshape(x.shape).astype(x.dtype), nwe, nse
 
     triples = jax.tree_util.tree_map(one, tree, errors.worker, errors.server)
-    is_triple = lambda x: isinstance(x, tuple)
-    pick = lambda i: jax.tree_util.tree_map(lambda t: t[i], triples, is_leaf=is_triple)
-    return pick(0), _ErrorState(worker=pick(1), server=pick(2))
+    out, worker, server = _tree_unzip(triples, tree, 3)
+    return out, _ErrorState(worker=worker, server=server)
 
 
 def _apply_mask(tree, mask):
@@ -515,16 +524,19 @@ def onebit_lamb(learning_rate: Schedule = 1e-3,
                 return c, new_lcf
 
             pairs = tm(coeff, params, upd, lcf)
-            is_pair = lambda x: isinstance(x, tuple)
-            cs = tm(lambda t: t[0], pairs, is_leaf=is_pair)
-            lcf = tm(lambda t: t[1], pairs, is_leaf=is_pair)
-            # scaling_coeff computed at the freeze boundary (lamb.py:172-184)
-            scales = tm(lambda m: _norm(m) / jnp.sqrt(
-                jnp.asarray(m.size, jnp.float32)), exp_avg)
-            leaves = jax.tree_util.tree_leaves(scales)
-            united = sum(leaves) / len(leaves)
-            sc = tm(lambda s, old: jnp.where(
-                at_freeze, united / jnp.maximum(s, 1e-20), old), scales, sc)
+            cs, lcf = _tree_unzip(pairs, params, 2)
+
+            # scaling_coeff computed once, at the freeze boundary
+            # (lamb.py:172-184) — guarded by cond so warmup steps don't pay
+            # the per-leaf norm reductions
+            def compute_sc(old_sc):
+                scales = tm(lambda m: _norm(m) / jnp.sqrt(
+                    jnp.asarray(m.size, jnp.float32)), exp_avg)
+                leaves = jax.tree_util.tree_leaves(scales)
+                united = sum(leaves) / len(leaves)
+                return tm(lambda s: united / jnp.maximum(s, 1e-20), scales)
+
+            sc = jax.lax.cond(at_freeze, compute_sc, lambda old: old, sc)
             delta = tm(lambda c, u: -lr * c * u, cs, upd)
             return delta, exp_avg, exp_avg_sq, v_fresh, sc, lcf, lf, errs
 
@@ -608,9 +620,7 @@ def onebit_wrap(inner: optax.GradientTransformation,
     def update_fn(grads, state, params=None):
         frozen = state.count >= freeze_steps
         pairs = jax.tree_util.tree_map(_compress, grads, state.error)
-        is_pair = lambda x: isinstance(x, tuple)
-        comp = jax.tree_util.tree_map(lambda p: p[0], pairs, is_leaf=is_pair)
-        new_err = jax.tree_util.tree_map(lambda p: p[1], pairs, is_leaf=is_pair)
+        comp, new_err = _tree_unzip(pairs, grads, 2)
         used = jax.tree_util.tree_map(
             lambda c, g: jnp.where(frozen, c, g), comp, grads)
         err = jax.tree_util.tree_map(

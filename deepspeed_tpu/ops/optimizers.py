@@ -6,8 +6,10 @@ TPU-native replacement for the reference's optimizer zoo:
   runs this same update against pinned-host shards.
 - FusedLamb (csrc/lamb/*) → optax lamb (per-tensor trust ratio).
 - OnebitAdam / ZeroOneAdam / OnebitLamb (deepspeed/runtime/fp16/onebit/) →
-  error-feedback sign-compressed gradient transform
-  (deepspeed_tpu/ops/onebit.py) chained before adam/lamb.
+  faithful standalone reimplementations in deepspeed_tpu/ops/onebit.py:
+  error-feedback 1-bit momentum compression with frozen variance (1-bit
+  Adam), variance-interval + local-step policies (0/1 Adam), and frozen
+  trust-ratio scaling (1-bit LAMB).
 
 Names accepted mirror ``_configure_basic_optimizer``
 (deepspeed/runtime/engine.py:1193-1265).

@@ -168,6 +168,26 @@ def test_compressed_phase_still_optimizes(rng, factory):
     assert np.isfinite(losses[-1])
 
 
+def test_onebit_adam_tuple_params_pytree(rng):
+    """params pytrees containing tuple nodes must not confuse the error
+    buffer bookkeeping (tuple leaves vs the internal pair/triple unzip)."""
+    params = (jnp.asarray(rng.standard_normal(8), jnp.float32),
+              jnp.asarray(rng.standard_normal(8), jnp.float32))
+    opt = onebit_adam(learning_rate=0.05, freeze_step=2)
+    state = opt.init(params)
+    # worker buffers must exist per-leaf, not be a mis-split tuple pair
+    assert isinstance(state.errors.worker, tuple)
+    assert state.errors.worker[0].shape == (8,)
+    assert state.errors.server[0].shape == (8,)
+    for _ in range(5):
+        g = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+            params)
+        upd, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, upd)
+    assert all(np.all(np.isfinite(np.asarray(p))) for p in params)
+
+
 def test_zero_one_adam_var_interval_doubles(rng):
     params = {"w": jnp.ones(8)}
     opt = zero_one_adam(learning_rate=0.01, var_freeze_step=1000,

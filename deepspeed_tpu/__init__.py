@@ -19,6 +19,7 @@ Public entry points mirror the reference (``deepspeed/__init__.py:58,260``):
 import os
 
 from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.runtime import zero  # noqa: F401  (deepspeed.zero parity)
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.utils.logging import logger  # noqa: F401

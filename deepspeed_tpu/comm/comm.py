@@ -224,6 +224,46 @@ def broadcast(tensor, src: int = 0, group: AxisName = "data"):
     return lax.psum(masked, group)
 
 
+def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM,
+           group: AxisName = "data"):
+    """reference comm.py reduce: result valid on every member (SPMD has no
+    cheaper single-destination form; dst kept for signature parity)."""
+    return all_reduce(tensor, op, group)
+
+
+def reduce_scatter_tensor(output_unused, tensor, op: ReduceOp = ReduceOp.SUM,
+                          group: AxisName = "data"):
+    """reference comm.py reduce_scatter_tensor (torch.py:118)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise NotImplementedError("reduce_scatter supports SUM/AVG")
+    out = reduce_scatter(tensor, group, axis=0)
+    if op == ReduceOp.AVG:
+        out = out / lax.axis_size(group)
+    return out
+
+
+def all_gather_coalesced(tensor_list, group: AxisName = "data"):
+    """reference all_gather_coalesced (comm/torch.py:135): one launch for
+    many tensors. Under XLA the per-tensor gathers fuse into batched
+    collectives, so this is the list-map — kept for API parity."""
+    return [all_gather(t, group, axis=0, tiled=True) for t in tensor_list]
+
+
+def reduce_scatter_coalesced(tensor_list, group: AxisName = "data"):
+    """reference runtime/comm/coalesced_collectives.py:29: reduce-scatter a
+    batch of tensors in one launch. Each flat tensor is padded to the group
+    size and scattered; XLA coalesces the launches."""
+    size = lax.axis_size(group)
+    outs = []
+    for t in tensor_list:
+        flat = t.reshape(-1)
+        pad = (-flat.size) % size
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        outs.append(reduce_scatter(flat, group, axis=0))
+    return outs
+
+
 def ppermute(tensor, perm, group: AxisName = "pipe"):
     """Ring/point-to-point transfer — the pipeline p2p primitive
     (reference runtime/pipe/p2p.py send/recv become a single collective

@@ -12,7 +12,8 @@ one program; where it injects fused kernels, XLA fuses — with the Pallas
 flash-attention path available for long prefills.
 """
 
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -20,12 +21,62 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.models.llama import (
     LlamaDecoderModel, LlamaModel, init_kv_caches,
 )
 from deepspeed_tpu.parallel.mesh import make_mesh
 from deepspeed_tpu.parallel.partition import tree_shardings
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int):
+    """One XLA program for a whole generation: prefill, a while_loop of
+    KV-cached decode steps with in-graph sampling, early exit when every row
+    hit EOS. The TPU analogue of the reference's CUDA-graph'd decode
+    (engine.py:526) with zero per-token host round-trips. Sampling knobs
+    (temperature/top_k/top_p/eos) are traced, so they never recompile.
+
+    ``apply_fn(params, tokens, caches, cache_index) -> (logits, caches)``.
+    Used by both InferenceEngine and the RLHF hybrid engine.
+    """
+
+    def gen(params, input_ids, caches, rng, temperature, top_k, top_p,
+            eos_id):
+        logits, caches = apply_fn(params, input_ids, caches,
+                                  jnp.asarray(0, jnp.int32))
+        rng, key = jax.random.split(rng)
+        nxt = sample_logits(logits[:, -1, :], key, temperature, top_k, top_p)
+        finished = nxt == eos_id
+        # pre-fill with eos so slots skipped by the early exit read as
+        # padding (with eos_id=-1 the loop always runs to max_new_tokens
+        # and overwrites every slot)
+        out = jnp.full((B, max_new_tokens), eos_id, jnp.int32)
+        out = out.at[:, 0].set(nxt)
+
+        def cond(carry):
+            i, _, _, _, finished, _ = carry
+            return jnp.logical_and(i < max_new_tokens,
+                                   jnp.logical_not(finished.all()))
+
+        def body(carry):
+            i, tok, caches, rng, finished, out = carry
+            logits, caches = apply_fn(params, tok[:, None], caches,
+                                      (T + i - 1).astype(jnp.int32))
+            rng, key = jax.random.split(rng)
+            nxt = sample_logits(logits[:, 0, :], key, temperature, top_k,
+                                top_p)
+            nxt = jnp.where(finished, eos_id, nxt)
+            finished = jnp.logical_or(finished, nxt == eos_id)
+            out = out.at[:, i].set(nxt)
+            return i + 1, nxt, caches, rng, finished, out
+
+        i0 = jnp.asarray(1, jnp.int32)
+        _, _, caches, _, _, out = jax.lax.while_loop(
+            cond, body, (i0, nxt, caches, rng, finished, out))
+        return jnp.concatenate([input_ids, out], axis=1), caches
+
+    return jax.jit(gen, donate_argnums=(2,))
 
 
 class InferenceEngine:
@@ -76,14 +127,89 @@ class InferenceEngine:
         self._kv_caches = None
         self._decode_fn = None
         self._prefill_fn = None
-        log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}",
-                 ranks=[0])
+        self._gen_cache: Dict[Any, Any] = {}
+        # int8 weight-only storage (reference quant config,
+        # inference/config.py:126 + csrc/quantization): decode reads half the
+        # HBM bytes per step; dequant fuses into the consuming matmul
+        self._quantized = None
+        if self._config.quant.enabled:
+            self._quantize_params()
+        self._model_times: List[float] = []
+        self._profile_model_time = False
+        log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}"
+                 f"{', int8 weights' if self._quantized else ''}", ranks=[0])
+
+    # --- int8 weight-only quantization ---------------------------------------
+    def _quantize_params(self):
+        """Replace large matmul kernels in ``self.params`` with
+        {q: int8, scale} groups — decode is weight-bandwidth-bound, so
+        halving the bytes read per step is the win; the dequant runs inside
+        the jitted step and XLA fuses it into the consuming matmul."""
+        from deepspeed_tpu.ops.quantizer import quantize_symmetric
+
+        bits = self._config.quant.bits
+        group_size = max(self._config.quant.group_size, 1)
+
+        def quant(path, p):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if p.ndim >= 2 and name == "kernel" and p.size > 1 << 16:
+                n_groups = max(1, p.size // group_size)
+                while p.size % n_groups:
+                    n_groups -= 1
+                q, scale = quantize_symmetric(p, num_bits=bits,
+                                              num_groups=n_groups)
+                return {"q": q, "scale": scale}
+            return p
+
+        self.params = jax.tree_util.tree_map_with_path(quant, self.params)
+        self._quantized = True
+
+    @staticmethod
+    def _is_qleaf(x) -> bool:
+        return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+    def _effective_params(self, params):
+        """Dequantize q-leaves (traced — call inside jit; group count is the
+        static leading dim of the scale array)."""
+        if not self._quantized:
+            return params
+        from deepspeed_tpu.ops.quantizer import dequantize_symmetric
+
+        def deq(x):
+            if self._is_qleaf(x):
+                return dequantize_symmetric(
+                    x["q"], x["scale"], x["scale"].shape[0]).astype(self.dtype)
+            return x
+
+        return jax.tree_util.tree_map(deq, params, is_leaf=self._is_qleaf)
 
     # --- plain forward --------------------------------------------------------
     def _ctx(self):
         return jax.set_mesh(self.mesh)
 
+    def profile_model_time(self, use_cuda_events: bool = False):
+        """Record per-forward model latencies (reference engine.py:213
+        ``profile_model_time``; timing is host wall clock around the blocked
+        device call — CUDA events have no tunnel-visible analogue)."""
+        self._profile_model_time = True
+
+    def model_times(self) -> List[float]:
+        """Return and clear recorded forward latencies (reference
+        engine.py:587)."""
+        assert self._profile_model_time, \
+            "call profile_model_time() before reading model_times()"
+        t = self._model_times
+        self._model_times = []
+        return t
+
     def forward(self, *args, **kwargs):
+        if self._profile_model_time:
+            t0 = time.time()
+            with self._ctx():
+                out = self._fwd(self.params, *args, **kwargs)
+            jax.block_until_ready(out)
+            self._model_times.append(time.time() - t0)
+            return out
         with self._ctx():
             return self._fwd(self.params, *args, **kwargs)
 
@@ -93,7 +219,8 @@ class InferenceEngine:
             module = self.module
 
             def fwd(params, *a, **kw):
-                return module.apply({"params": params}, *a, **kw)
+                return module.apply(
+                    {"params": self._effective_params(params)}, *a, **kw)
 
             self._fwd_jit = jax.jit(fwd)
         return self._fwd_jit
@@ -101,8 +228,11 @@ class InferenceEngine:
     def __call__(self, *args, **kwargs):
         return self.forward(*args, **kwargs)
 
-    # --- generation (KV-cached incremental decode) ---------------------------
+    # --- generation (fused prefill + decode-loop program) ---------------------
     def _ensure_decode(self, batch_size: int, max_len: int):
+        """Preallocate the KV workspace (reference inference_context.h
+        allocates one arena from max_out_tokens) and the single-token decode
+        step (kept for API parity and step-wise use)."""
         cfg = self.model_config
         assert cfg is not None, "generate() requires a model with .cfg (LlamaConfig)"
         if self._kv_caches is not None and \
@@ -110,11 +240,14 @@ class InferenceEngine:
                 self._kv_caches[0].shape[2] >= max_len:
             return
         decoder = LlamaDecoderModel(cfg)
+        self._decoder = decoder
         self._kv_caches = init_kv_caches(cfg, batch_size, max_len, self.dtype)
+        self._gen_cache = {}
 
         def step(params, tokens, caches, index):
-            logits, new_caches = decoder.apply({"params": params}, tokens,
-                                               caches, index)
+            logits, new_caches = decoder.apply(
+                {"params": self._effective_params(params)}, tokens,
+                caches, index)
             return logits, new_caches
 
         self._decode_fn = jax.jit(step, donate_argnums=(2,))
@@ -128,46 +261,46 @@ class InferenceEngine:
     def release_workspace(self):
         self._kv_caches = None
         self._decode_fn = None
+        self._gen_cache = {}
+
+    def _build_generate(self, B: int, T: int, max_new_tokens: int):
+        decoder = self._decoder
+
+        def apply_fn(params, tokens, caches, index):
+            return decoder.apply(
+                {"params": self._effective_params(params)}, tokens, caches,
+                index)
+
+        return build_generate_fn(apply_fn, B, T, max_new_tokens)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0,
-                 rng: Optional[jax.Array] = None, eos_token_id: Optional[int] = None):
-        """Greedy/temperature sampling with KV cache. input_ids: [B, T]."""
-        input_ids = jnp.asarray(input_ids)
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 rng: Optional[jax.Array] = None,
+                 eos_token_id: Optional[int] = None):
+        """Sampled/greedy generation with KV cache. input_ids: [B, T].
+
+        Returns [B, T + max_new_tokens]; rows that hit ``eos_token_id`` are
+        padded with it. The full loop runs as one compiled program.
+        """
+        input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        max_len = T + max_new_tokens
-        self._ensure_decode(B, max_len)
+        self._ensure_decode(B, T + max_new_tokens)
+        key = (B, T, max_new_tokens)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._build_generate(B, T, max_new_tokens)
+        gen_fn = self._gen_cache[key]
         if rng is None:
             rng = jax.random.PRNGKey(0)
-
-        # prefill: run the whole prompt once, cache K/V
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        t0 = time.time() if self._profile_model_time else None
         with self._ctx():
-            logits, caches = self._decode_fn(
-                self.params, input_ids, self._kv_caches, jnp.asarray(0, jnp.int32))
-        next_logits = logits[:, -1, :]
-
-        out_tokens = [input_ids]
-        finished = jnp.zeros((B,), bool)
-        for i in range(max_new_tokens):
-            if temperature > 0.0:
-                rng, key = jax.random.split(rng)
-                scaled = next_logits / temperature
-                if top_k > 0:
-                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-                nxt = jax.random.categorical(key, scaled, axis=-1)
-            else:
-                nxt = jnp.argmax(next_logits, axis=-1)
-            if eos_token_id is not None:
-                nxt = jnp.where(finished, eos_token_id, nxt)
-                finished = finished | (nxt == eos_token_id)
-            out_tokens.append(nxt[:, None])
-            if i == max_new_tokens - 1:
-                break
-            with self._ctx():
-                logits, caches = self._decode_fn(
-                    self.params, nxt[:, None], caches,
-                    jnp.asarray(T + i, jnp.int32))
-            next_logits = logits[:, 0, :]
-        self._kv_caches = caches
-        return jnp.concatenate(out_tokens, axis=1)
+            tokens, self._kv_caches = gen_fn(
+                self.params, input_ids, self._kv_caches, rng,
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_k, jnp.int32),
+                jnp.asarray(top_p, jnp.float32),
+                jnp.asarray(eos, jnp.int32))
+        if t0 is not None:
+            jax.block_until_ready(tokens)
+            self._model_times.append(time.time() - t0)
+        return tokens

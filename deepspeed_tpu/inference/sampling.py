@@ -1,0 +1,70 @@
+"""Token sampling for the generation loop.
+
+The reference's sampling lives in HF ``generate()`` (the engine wraps it,
+inference/engine.py:614); here sampling is a jit-traced function so the whole
+generation loop — prefill, decode steps, sampling, EOS handling — compiles
+into ONE XLA program (no per-token host round-trips, the TPU analogue of the
+reference's CUDA-graph capture of the decode step, engine.py:526).
+
+All knobs are traced values, so changing temperature/top_k/top_p/eos does not
+recompile: greedy is ``temperature == 0``, ``top_k == 0`` and ``top_p >= 1``
+disable their filters, ``eos_id < 0`` disables EOS stopping.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_mask(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Keep the k highest logits per row; k==0 disables. k is traced."""
+    vocab = logits.shape[-1]
+    sorted_l = jnp.sort(logits, axis=-1)                      # ascending
+    idx = jnp.clip(vocab - k, 0, vocab - 1).astype(jnp.int32)
+    kth = jax.lax.dynamic_index_in_dim(sorted_l, idx, axis=-1,
+                                       keepdims=True)         # [B, 1]
+    masked = jnp.where(logits < kth, -jnp.inf, logits)
+    return jnp.where(k > 0, masked, logits)
+
+
+def top_p_mask(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability exceeds p; p>=1 disables. p is traced."""
+    sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]           # descending
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a sorted position is kept while the mass *before* it is < p; the
+    # argmax column has zero mass before it, so (HF semantics) at least one
+    # token survives even at p == 0
+    keep_sorted = (cum - probs) < jnp.maximum(p, 1e-9)
+    # threshold = smallest kept logit
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf),
+                     axis=-1, keepdims=True)
+    masked = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jnp.where(p < 1.0, masked, logits)
+
+
+def sample_logits(logits: jnp.ndarray, rng: jax.Array,
+                  temperature: jnp.ndarray,
+                  top_k: jnp.ndarray,
+                  top_p: jnp.ndarray) -> jnp.ndarray:
+    """[B, V] logits → [B] token ids. temperature==0 → greedy argmax.
+
+    The sampling pipeline (two full-vocab sorts + categorical) runs under
+    ``lax.cond`` so greedy decode — the common serving default — pays only
+    the argmax."""
+
+    def greedy(op):
+        logits, _ = op
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled(op):
+        logits, rng = op
+        safe_t = jnp.maximum(temperature, 1e-6)
+        scaled = logits.astype(jnp.float32) / safe_t
+        scaled = top_k_mask(scaled, top_k)
+        scaled = top_p_mask(scaled, top_p)
+        return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+    return jax.lax.cond(temperature > 0.0, sampled, greedy, (logits, rng))

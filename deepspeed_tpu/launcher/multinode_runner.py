@@ -1,0 +1,134 @@
+"""Multi-node runners (reference ``deepspeed/launcher/multinode_runner.py``:
+PDSH/OpenMPI/MPICH/SLURM/MVAPICH classes with ``backend_exists`` +
+``get_cmd``).
+
+TPU pods are driven the same way the reference drives GPU clusters — one
+agent process per host — so the runner contract ports directly: each runner
+renders the command that starts every host's worker with the JAX
+coordinator env (``build_host_env``). PDSH fans out over the hostfile,
+SLURM delegates fan-out to ``srun`` (GKE/XPK-style allocations), MPI
+runners use ``mpirun`` rank placement with env forwarded per rank.
+"""
+
+import os
+import shlex
+import shutil
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class MultiNodeRunner:
+    name = "base"
+
+    def __init__(self, args, world_info: "OrderedDict[str, int]"):
+        self.args = args
+        self.world_info = world_info          # host -> slots
+        self.user_script = args.user_script
+        self.user_args = list(args.user_args)
+        self.exports: Dict[str, str] = {}
+
+    def backend_exists(self) -> bool:
+        raise NotImplementedError
+
+    def get_cmd(self, environment: Dict[str, str],
+                active_resources: "OrderedDict[str, List[int]]") -> List[str]:
+        raise NotImplementedError
+
+    def add_export(self, key: str, val: str) -> None:
+        self.exports[key.strip()] = val.strip()
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.world_info)
+
+    def _payload(self) -> List[str]:
+        return [sys.executable, self.user_script] + self.user_args
+
+
+class PDSHRunner(MultiNodeRunner):
+    """reference multinode_runner.py:51 — pdsh fan-out over the hostfile."""
+
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        environment = dict(environment)
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active_resources.keys())
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in {**environment, **self.exports}.items())
+        remote = (f"{exports}cd {shlex.quote(os.getcwd())}; "
+                  + " ".join(shlex.quote(p) for p in self._payload()))
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, remote]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """reference multinode_runner.py:231 — srun-delegated placement."""
+
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        # host filtering already happened upstream (parse_inclusion_exclusion)
+        # — place srun exactly on the surviving hosts via --nodelist
+        cmd = ["srun", "-n", str(self.num_nodes), "--ntasks-per-node", "1",
+               "--nodelist", ",".join(active_resources.keys())]
+        exports = ["--export=ALL"
+                   + "".join(f",{k}={v}"
+                             for k, v in {**environment,
+                                          **self.exports}.items())]
+        return cmd + exports + self._payload()
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """reference multinode_runner.py:107 — mpirun with per-rank env."""
+
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        hosts = ",".join(f"{h}:1" for h in active_resources)
+        cmd = ["mpirun", "-n", str(self.num_nodes), "--host", hosts,
+               "--allow-run-as-root"]
+        for k, v in {**environment, **self.exports}.items():
+            cmd += ["-x", f"{k}={v}"]
+        return cmd + self._payload()
+
+
+class MPICHRunner(OpenMPIRunner):
+    """reference multinode_runner.py:160 — mpiexec variant."""
+
+    name = "mpich"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpiexec") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        hosts = ",".join(active_resources)
+        cmd = ["mpiexec", "-n", str(self.num_nodes), "-hosts", hosts]
+        for k, v in {**environment, **self.exports}.items():
+            cmd += ["-genv", k, v]
+        return cmd + self._payload()
+
+
+RUNNERS = {r.name: r for r in
+           (PDSHRunner, SlurmRunner, OpenMPIRunner, MPICHRunner)}
+
+
+def get_runner(name: str, args, world_info) -> Optional[MultiNodeRunner]:
+    cls = RUNNERS.get(name)
+    if cls is None:
+        return None
+    runner = cls(args, world_info)
+    if not runner.backend_exists():
+        logger.warning(f"launcher backend {name!r} not found on PATH")
+    return runner

@@ -47,9 +47,12 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "local", "print"],
-                        help="ssh fan-out, local single-host, or print the "
-                             "per-host commands without running")
+                        choices=["ssh", "local", "print", "pdsh", "slurm",
+                                 "openmpi", "mpich"],
+                        help="ssh fan-out, local single-host, print the "
+                             "per-host commands without running, or a "
+                             "scheduler backend (pdsh/slurm/openmpi/mpich — "
+                             "reference multinode_runner.py)")
     parser.add_argument("--print_env", action="store_true",
                         help="print the env block each host receives")
     parser.add_argument("--force_multi", action="store_true")
@@ -160,11 +163,8 @@ def build_host_env(host_index: int, num_hosts: int, coordinator: str,
     return env
 
 
-def build_commands(args, active: "OrderedDict[str, List[int]]"
-                   ) -> List[Tuple[str, List[str], Dict[str, str]]]:
-    hosts = list(active.keys())
-    coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
-    cmds = []
+def build_autotune_env(args) -> Dict[str, str]:
+    """--autotuning exports (shared by the ssh and scheduler launch paths)."""
     autotune_env: Dict[str, str] = {}
     if getattr(args, "autotuning", ""):
         autotune_env["DS_TPU_AUTOTUNING"] = args.autotuning
@@ -177,6 +177,15 @@ def build_commands(args, active: "OrderedDict[str, List[int]]"
                     f"--autotuning run: {optimal} not found; run "
                     "--autotuning tune first")
             autotune_env["DS_TPU_CONFIG_OVERRIDE"] = os.path.abspath(optimal)
+    return autotune_env
+
+
+def build_commands(args, active: "OrderedDict[str, List[int]]"
+                   ) -> List[Tuple[str, List[str], Dict[str, str]]]:
+    hosts = list(active.keys())
+    coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
+    cmds = []
+    autotune_env = build_autotune_env(args)
     for idx, host in enumerate(hosts):
         env = build_host_env(idx, len(hosts), coordinator,
                              extra_env=autotune_env)
@@ -204,6 +213,32 @@ def main(args=None) -> int:
         active = OrderedDict(list(active.items())[: args.num_nodes])
     if not active:
         raise ValueError("no hosts remain after include/exclude filtering")
+
+    # scheduler-delegated fan-out (reference multinode_runner.py backends):
+    # one local command whose backend starts every host's worker; node ranks
+    # come from the scheduler (SLURM_NODEID / OMPI_COMM_WORLD_RANK) or the
+    # hostfile order for pdsh
+    from deepspeed_tpu.launcher.multinode_runner import RUNNERS, get_runner
+
+    if args.launcher in RUNNERS:
+        world_info = OrderedDict((h, len(s)) for h, s in active.items())
+        runner = get_runner(args.launcher, args, world_info)
+        hosts = list(active.keys())
+        coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
+        env = build_host_env(0, len(hosts), coordinator,
+                             extra_env=build_autotune_env(args))
+        env.pop("DS_TPU_PROCESS_ID", None)   # per-host rank set by backend
+        cmd = runner.get_cmd(env, active)
+        if args.print_env:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            return 0
+        if not runner.backend_exists():
+            logger.error(f"launcher backend {args.launcher!r} not found on "
+                         f"PATH; command would be: "
+                         f"{' '.join(shlex.quote(c) for c in cmd)}")
+            return 1
+        logger.info(f"{args.launcher} launch: {' '.join(cmd[:6])}...")
+        return subprocess.call(cmd)
 
     cmds = build_commands(args, active)
     if args.print_env or args.launcher == "print":

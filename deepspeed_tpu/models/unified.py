@@ -88,6 +88,7 @@ class TransformerConfig:
 def _act(name: str):
     return {"gelu": lambda x: nn.gelu(x, approximate=False),
             "gelu_new": lambda x: nn.gelu(x, approximate=True),
+            "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x),  # CLIP
             "relu": nn.relu,
             "silu": nn.silu}[name]
 

@@ -5,5 +5,6 @@ Importing this package registers every policy with
 """
 
 from deepspeed_tpu.module_inject.containers import (  # noqa: F401
-    bert, bloom, distilbert, gpt2, gptj, gptneo, gptneox, llama, megatron, opt,
+    bert, bloom, clip, distilbert, gpt2, gptj, gptneo, gptneox, llama,
+    megatron, opt,
 )

@@ -1,0 +1,70 @@
+"""CLIP text-encoder policy (reference module_inject/containers/clip.py —
+``HFCLIPLayerPolicy``, the text tower injected for stable-diffusion serving).
+
+CLIP's text model is a pre-LN causal transformer with learned positions and
+quick-gelu MLPs; it maps onto the unified transformer directly. The vision
+tower / diffusers UNet+VAE path is the reference's ``generic_injection``
+spatial pillar (csrc/spatial) — conv models are out of scope for the unified
+target and handled by XLA fusion when the user brings a flax diffusion model.
+"""
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFCLIPLayerPolicy(TransformerPolicy):
+    model_types = ("clip", "clip_text_model")
+    class_name_hints = ("CLIPText",)
+
+    @staticmethod
+    def _text_config(hf_config):
+        return getattr(hf_config, "text_config", hf_config)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        tc = self._text_config(hf_config)
+        return TransformerConfig(
+            vocab_size=tc.vocab_size,
+            hidden_size=tc.hidden_size,
+            num_layers=tc.num_hidden_layers,
+            num_heads=tc.num_attention_heads,
+            intermediate_size=tc.intermediate_size,
+            max_seq_len=tc.max_position_embeddings,
+            pos_emb="learned",
+            norm="layernorm",
+            norm_eps=getattr(tc, "layer_norm_eps", 1e-5),
+            pre_ln=True, final_norm=True,
+            activation={"quick_gelu": "quick_gelu", "gelu": "gelu",
+                        "gelu_new": "gelu_new"}.get(
+                getattr(tc, "hidden_act", "quick_gelu"), "quick_gelu"),
+            causal=True, lm_head=False,
+            tie_embeddings=False,
+        )
+
+    def convert(self, sd, hf_config):
+        tc = self._text_config(hf_config)
+        # accept CLIPModel ("text_model.…") or bare CLIPTextModel dumps
+        p = "text_model." if any(k.startswith("text_model.") for k in sd) \
+            else ""
+        params = {
+            "wte": {"embedding":
+                    _np(sd[f"{p}embeddings.token_embedding.weight"])},
+            "wpe": {"embedding":
+                    _np(sd[f"{p}embeddings.position_embedding.weight"])},
+            "ln_f": ln_(sd, f"{p}final_layer_norm"),
+        }
+        for i in range(tc.num_hidden_layers):
+            b = f"{p}encoder.layers.{i}"
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.layer_norm1"),
+                "ln_2": ln_(sd, f"{b}.layer_norm2"),
+                "attn": {"q_proj": dense_(sd, f"{b}.self_attn.q_proj"),
+                         "k_proj": dense_(sd, f"{b}.self_attn.k_proj"),
+                         "v_proj": dense_(sd, f"{b}.self_attn.v_proj"),
+                         "o_proj": dense_(sd, f"{b}.self_attn.out_proj")},
+                "mlp": {"c_fc": dense_(sd, f"{b}.mlp.fc1"),
+                        "c_proj": dense_(sd, f"{b}.mlp.fc2")},
+            }
+        return params

@@ -1,0 +1,67 @@
+"""MoE parameter grouping (reference ``deepspeed/moe/utils.py``:
+``is_moe_param`` / ``split_params_into_different_moe_groups_for_optimizer``).
+
+The reference tags expert tensors with ``allreduce=False`` + a ``group_name``
+so ZeRO reduces them over the *expert-data* group instead of the full DP
+group. Under SPMD the collective routing falls out of shardings, but the
+*optimizer grouping* is still needed — e.g. distinct weight decay or lr for
+expert weights, and correct grad-norm partitioning. Here groups are optax
+masks over the param pytree, keyed by path.
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from deepspeed_tpu.parallel.partition import path_str
+
+MOE_PATH_MARKERS = ("experts", "expert_", "moe")
+
+
+def is_moe_param_path(path: str) -> bool:
+    parts = path.lower().split("/")
+    return any(m in p for p in parts for m in MOE_PATH_MARKERS)
+
+
+def is_moe_param(tree_path) -> bool:
+    """True for param paths living under an expert stack
+    (reference moe/utils.py:is_moe_param checks the ``allreduce`` tag)."""
+    if isinstance(tree_path, str):
+        return is_moe_param_path(tree_path)
+    return is_moe_param_path(path_str(tree_path))
+
+
+def moe_param_mask(params: Any) -> Any:
+    """Pytree of bools: True at expert params. Feed to ``optax.masked``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: is_moe_param(p), params)
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+        param_groups: Any, max_group_size: Optional[int] = None
+        ) -> List[Dict[str, Any]]:
+    """Split param 'groups' into MoE and non-MoE groups (reference
+    moe/utils.py:split_params_into_different_moe_groups_for_optimizer).
+
+    Input: a params pytree, or a list of dicts ``{"params": pytree, ...}``
+    (torch param-group style). Output: a list of group dicts where expert
+    params live in their own groups tagged ``moe=True`` — the shape the
+    reference's ZeRO optimizer consumes for per-group reduction.
+    """
+    if not isinstance(param_groups, (list, tuple)):
+        param_groups = [{"params": param_groups}]
+
+    out: List[Dict[str, Any]] = []
+    for group in param_groups:
+        tree = group["params"]
+        mask = moe_param_mask(tree)
+        dense = jax.tree_util.tree_map(
+            lambda p, m: None if m else p, tree, mask)
+        moe = jax.tree_util.tree_map(
+            lambda p, m: p if m else None, tree, mask)
+        base = {k: v for k, v in group.items() if k != "params"}
+        out.append({**base, "params": dense, "moe": False})
+        if len(jax.tree_util.tree_leaves(moe)) > 0:
+            out.append({**base, "params": moe, "moe": True,
+                        "name": base.get("name", "moe_group")})
+    return out

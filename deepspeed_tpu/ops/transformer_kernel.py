@@ -1,0 +1,133 @@
+"""Training transformer layer — the fused BERT-era kernel's API surface.
+
+TPU-native stand-in for the reference's training transformer kernel
+(``deepspeed/ops/transformer/transformer.py`` ``DeepSpeedTransformerLayer``
+over ``csrc/transformer/*``: fused LN + QKV GEMM + softmax + dropout + GeLU
++ strided-batch GEMMs, fwd AND bwd hand-written in CUDA). Under XLA every
+one of those fusions falls out of the compiler, so the layer here is a flax
+module with the same config knobs; the hand-scheduled backward is jax AD.
+
+Config-knob mapping (reference transformer.py:34-133):
+- batch_size/num_hidden_layers/initializer_range/local_rank/seed: carried
+  for parity; XLA needs no static batch registration.
+- fp16 → bf16/fp16 compute dtype.
+- pre_layer_norm: Pre-LN vs Post-LN block topology.
+- normalize_invertible / gelu_checkpoint / attn_dropout_checkpoint →
+  ``jax.checkpoint`` (rematerialize everything inside the layer): the
+  reference drops specific activations to save memory; remat is the TPU
+  superset of that.
+- stochastic_mode → accepted; XLA kernels are deterministic, so this is a
+  no-op flag (the reference trades ~2% speed for run-to-run variance).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    batch_size: int = 1
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None     # default 4*hidden
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = 12
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    huggingface: bool = False
+    training: bool = True
+    return_tuple: bool = False
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.fp16 else jnp.float32
+
+
+class _TransformerBlock(nn.Module):
+    config: DeepSpeedTransformerConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None):
+        cfg = self.config
+        deterministic = self.deterministic
+        dt = cfg.dtype
+        H, F = cfg.hidden_size, cfg.ffn_size
+        heads = cfg.heads
+        head_dim = H // heads
+        init = nn.initializers.normal(cfg.initializer_range)
+        dense = lambda n, name: nn.Dense(
+            n, dtype=dt, param_dtype=jnp.float32, kernel_init=init, name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
+                                       name=name)
+
+        def dropout(x, rate):
+            if rate > 0 and not deterministic:
+                return nn.Dropout(rate)(x, deterministic=False,
+                                        rng=self.make_rng("dropout"))
+            return x
+
+        def attention(x):
+            B, S, _ = x.shape
+            q = dense(H, "q_proj")(x).reshape(B, S, heads, head_dim)
+            k = dense(H, "k_proj")(x).reshape(B, S, heads, head_dim)
+            v = dense(H, "v_proj")(x).reshape(B, S, heads, head_dim)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+            if attention_mask is not None:
+                scores = scores + attention_mask
+            w = jax.nn.softmax(scores, axis=-1).astype(dt)
+            w = dropout(w, cfg.attn_dropout_ratio)
+            o = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(B, S, H)
+            return dropout(dense(H, "o_proj")(o), cfg.hidden_dropout_ratio)
+
+        def mlp(x):
+            h = dense(F, "c_fc")(x)
+            h = nn.gelu(h, approximate=False)
+            return dropout(dense(H, "c_proj")(h), cfg.hidden_dropout_ratio)
+
+        x = hidden_states.astype(dt)
+        if cfg.pre_layer_norm:
+            x = x + attention(ln("attn_ln")(x))
+            return x + mlp(ln("mlp_ln")(x))
+        x = ln("attn_ln")(x + attention(x))
+        return ln("mlp_ln")(x + mlp(x))
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """One BERT-style encoder layer with the reference kernel's topology.
+
+    ``__call__(hidden_states, attention_mask=None, deterministic=True)``
+    — mask is additive [B, 1, 1, S] or [B, 1, S, S].
+    """
+
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        remat = (cfg.normalize_invertible or cfg.gelu_checkpoint
+                 or cfg.attn_dropout_checkpoint)
+        block_cls = nn.remat(_TransformerBlock) if remat else _TransformerBlock
+        out = block_cls(cfg, deterministic=deterministic, name="block")(
+            hidden_states, attention_mask)
+        return (out,) if cfg.return_tuple else out

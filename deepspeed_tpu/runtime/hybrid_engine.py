@@ -30,6 +30,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._lora_fused = False
         self._decode_fn = None
         self._kv_caches = None
+        self._gen_cache: Dict[Any, Any] = {}
         self._in_eval = False
         self.generate_time = 0.0
         self.latency_timer = Timer("generate")
@@ -64,8 +65,10 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 self._kv_caches[0].shape[2] >= max_len:
             return
         decoder = LlamaDecoderModel(self.model_cfg)
+        self._decoder = decoder
         self._kv_caches = init_kv_caches(self.model_cfg, batch_size, max_len,
                                          self.compute_dtype)
+        self._gen_cache = {}
         self._decode_fn = jax.jit(
             lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
             donate_argnums=(2,))
@@ -76,6 +79,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def release_inference_cache(self):
         self._kv_caches = None
         self._decode_fn = None
+        self._gen_cache = {}
 
     def reset_inference_cache(self):
         if self._kv_caches is not None:
@@ -84,52 +88,42 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     # --- generation (reference :178-282) ----------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  rng: Optional[jax.Array] = None,
                  eos_token_id: Optional[int] = None):
+        """Rollout generation against the live (sharded, LoRA-fused) training
+        params — one fused prefill+decode program shared with the inference
+        engine (inference/engine.py build_generate_fn)."""
+        from deepspeed_tpu.inference.engine import build_generate_fn
+
         was_training = not self._in_eval
         if was_training:
             self.eval()
         self.latency_timer.start()
 
-        input_ids = jnp.asarray(input_ids)
+        input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
         self._ensure_decode(B, T + max_new_tokens)
+        key = (B, T, max_new_tokens)
+        if key not in self._gen_cache:
+            decoder = self._decoder
+            self._gen_cache[key] = build_generate_fn(
+                lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
+                B, T, max_new_tokens)
         if rng is None:
             rng = jax.random.PRNGKey(self.global_steps)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
 
         with self._ctx():
-            logits, caches = self._decode_fn(
-                self.params, input_ids, self._kv_caches,
-                jnp.asarray(0, jnp.int32))
-        next_logits = logits[:, -1, :]
-        out = [input_ids]
-        finished = jnp.zeros((B,), bool)
-        for i in range(max_new_tokens):
-            if temperature > 0.0:
-                rng, key = jax.random.split(rng)
-                scaled = next_logits / temperature
-                if top_k > 0:
-                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-                nxt = jax.random.categorical(key, scaled, axis=-1)
-            else:
-                nxt = jnp.argmax(next_logits, axis=-1)
-            if eos_token_id is not None:
-                nxt = jnp.where(finished, eos_token_id, nxt)
-                finished = finished | (nxt == eos_token_id)
-            out.append(nxt[:, None])
-            if i == max_new_tokens - 1:
-                break
-            with self._ctx():
-                logits, caches = self._decode_fn(
-                    self.params, nxt[:, None], caches,
-                    jnp.asarray(T + i, jnp.int32))
-            next_logits = logits[:, 0, :]
-        self._kv_caches = caches
+            tokens, self._kv_caches = self._gen_cache[key](
+                self.params, input_ids, self._kv_caches, rng,
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_k, jnp.int32),
+                jnp.asarray(top_p, jnp.float32),
+                jnp.asarray(eos, jnp.int32))
 
         self.latency_timer.stop(synchronize=True)
         self.generate_time = self.latency_timer.elapsed(reset=True)
         if was_training:
             self.train()
-        return jnp.concatenate(out, axis=1)
+        return tokens

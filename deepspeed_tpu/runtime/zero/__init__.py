@@ -14,3 +14,8 @@ from deepspeed_tpu.runtime.zero.tiling import (
     tiled_matmul,
     tiles_to_dense,
 )
+from deepspeed_tpu.runtime.zero.partition_parameters import (
+    GatheredParameters,
+    Init,
+    shutdown_init_context,
+)

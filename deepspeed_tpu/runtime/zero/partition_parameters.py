@@ -1,0 +1,142 @@
+"""zero.Init / GatheredParameters — construction-time partitioning API.
+
+Reference surface: ``deepspeed.zero.Init`` (partition_parameters.py:603)
+monkey-patches ``nn.Module.__init__`` so every parameter materializes
+pre-sharded, and ``GatheredParameters`` (partition_parameters.py:1304 file)
+temporarily re-assembles full params inside a context. On TPU neither needs
+module surgery: params are a pytree whose placement is a sharding, so
+
+- ``Init`` wraps a flax ``init`` call and materializes the tree *directly
+  into* its ZeRO-3 (data-axis) sharding — no full replica ever exists on any
+  chip (``jax.jit`` with ``out_shardings`` streams shards from the sharded
+  initializer program);
+- ``gathered_parameters`` / ``GatheredParameters`` device_puts a replicated
+  view for host-side surgery (weight loading, eyeballing), then re-shards
+  when the context exits (``modifier_rank`` semantics: mutation inside the
+  context wins).
+"""
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.zero.stages import plan_zero_shardings
+from deepspeed_tpu.utils.logging import logger
+
+
+class _ZeroConfigView:
+    """Minimal zero-config shim for plan_zero_shardings."""
+
+    def __init__(self, stage: int):
+        self.stage = stage
+        self.mics_shard_size = -1
+        self.offload_optimizer_device = "none"
+
+
+class Init:
+    """Construction-time ZeRO-3 partitioning (reference zero.Init).
+
+    Usage::
+
+        with zero.Init(mesh=mesh):
+            params = zero.Init.materialize(model.init, rng, sample)
+
+    or functionally::
+
+        params = Init(mesh=mesh).init(model.init, rng, sample)
+
+    Params come out sharded over the data axis; nothing full-size is ever
+    resident. (The reference's module-patching has no analogue to perform —
+    flax modules are pure, so wrapping the init call is the whole job.)
+    """
+
+    _active: Optional["Init"] = None
+
+    def __init__(self, mesh: Optional[Mesh] = None, config_dict_or_path=None,
+                 mem_efficient_linear: bool = True, remote_device=None,
+                 pin_memory: bool = False, dtype=None, enabled: bool = True,
+                 sharding_rules=None):
+        if mesh is None:
+            import numpy as np
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+        self.mesh = mesh
+        self.enabled = enabled
+        self.dtype = dtype
+        self.rules = sharding_rules
+
+    def __enter__(self):
+        Init._active = self
+        return self
+
+    def __exit__(self, *exc):
+        Init._active = None
+        return False
+
+    def init(self, init_fn: Callable, *args, **kwargs):
+        """Run ``init_fn(*args)`` with outputs materialized pre-sharded
+        (floating leaves cast to ``dtype`` when given, like the reference's
+        ``zero.Init(dtype=…)``)."""
+        if not self.enabled:
+            return init_fn(*args, **kwargs)
+
+        if self.dtype is None:
+            fn = init_fn
+        else:
+            import jax.numpy as jnp
+
+            def fn(*a, **kw):
+                return jax.tree_util.tree_map(
+                    lambda x: x.astype(self.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    init_fn(*a, **kw))
+
+        abstract = jax.eval_shape(fn, *args, **kwargs)
+        plan = plan_zero_shardings(abstract, self.mesh, _ZeroConfigView(3),
+                                   self.rules)
+        with jax.set_mesh(self.mesh):
+            return jax.jit(fn,
+                           out_shardings=plan.param_shardings)(*args, **kwargs)
+
+    @staticmethod
+    def materialize(init_fn: Callable, *args, **kwargs):
+        ctx = Init._active
+        if ctx is None:
+            return init_fn(*args, **kwargs)
+        return ctx.init(init_fn, *args, **kwargs)
+
+
+def shutdown_init_context():
+    """reference partition_parameters.py:515 — deactivate a live Init."""
+    Init._active = None
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank: Optional[int] = None,
+                       fwd_module=None, enabled: bool = True,
+                       mesh: Optional[Mesh] = None):
+    """Temporarily replicate sharded params (reference GatheredParameters).
+
+    Yields a dict ``{"params": replicated_tree}``; assign back into
+    ``view["params"]`` inside the context to mutate (modifier semantics) —
+    on exit the (possibly modified) tree is re-sharded to the original
+    shardings and written into ``view["resharded"]``.
+    """
+    if not enabled:
+        yield {"params": params, "resharded": params}
+        return
+    shardings = jax.tree_util.tree_map(lambda p: p.sharding, params)
+    if mesh is None:
+        first = jax.tree_util.tree_leaves(params)[0]
+        mesh = first.sharding.mesh
+    rep = NamedSharding(mesh, PartitionSpec())
+    gathered = jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, rep), params)
+    view = {"params": gathered, "resharded": None}
+    try:
+        yield view
+    finally:
+        view["resharded"] = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(jax.numpy.asarray(p), s),
+            view["params"], shardings)

@@ -1,5 +1,15 @@
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.init_on_device import OnDevice
+from deepspeed_tpu.utils.tensor_fragment import (
+    safe_get_full_fp32_param,
+    safe_get_full_grad,
+    safe_get_full_optimizer_state,
+    safe_set_full_fp32_param,
+)
 
-__all__ = ["logger", "log_dist", "SynchronizedWallClockTimer", "ThroughputTimer", "groups"]
+__all__ = ["logger", "log_dist", "SynchronizedWallClockTimer",
+           "ThroughputTimer", "groups", "OnDevice",
+           "safe_get_full_fp32_param", "safe_get_full_grad",
+           "safe_get_full_optimizer_state", "safe_set_full_fp32_param"]

@@ -1,0 +1,66 @@
+"""OnDevice meta-initialization (reference ``deepspeed/utils/init_on_device.py``).
+
+The reference patches ``torch.nn`` so modules construct their tensors on a
+chosen device — including the ``meta`` device for shape-only construction.
+In JAX, shape-only construction IS ``jax.eval_shape``, and device-targeted
+construction is ``jax.jit(..., out_shardings=...)``/``default_device`` — so
+``OnDevice`` is a thin context that routes an init function accordingly:
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        abstract = OnDevice.init(model.init, rng, sample)   # ShapeDtypeStructs
+
+    with OnDevice(dtype=jnp.bfloat16, device=jax.devices()[0]):
+        params = OnDevice.init(model.init, rng, sample)     # on that device
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    _active: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device="meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        OnDevice._active = self
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = None
+        return False
+
+    def _cast(self, tree):
+        if self.dtype is None:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, tree)
+
+    def run(self, init_fn: Callable, *args, **kwargs):
+        if not self.enabled:
+            return init_fn(*args, **kwargs)
+        if self.device == "meta":
+            out = jax.eval_shape(init_fn, *args, **kwargs)
+            if self.dtype is not None:
+                out = jax.tree_util.tree_map(
+                    lambda s: jax.ShapeDtypeStruct(
+                        s.shape, self.dtype
+                        if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+                    out)
+            return out
+        with jax.default_device(self.device):
+            return self._cast(init_fn(*args, **kwargs))
+
+    @staticmethod
+    def init(init_fn: Callable, *args, **kwargs):
+        ctx = OnDevice._active
+        if ctx is None:
+            return init_fn(*args, **kwargs)
+        return ctx.run(init_fn, *args, **kwargs)

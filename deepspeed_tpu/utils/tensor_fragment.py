@@ -1,0 +1,137 @@
+"""Debug accessors for sharded training state
+(reference ``deepspeed/utils/tensor_fragment.py:91-142``:
+``safe_get_full_fp32_param`` / ``safe_get_full_grad`` /
+``safe_get_full_optimizer_state`` and the ``safe_set_*`` writers).
+
+The reference reassembles a full tensor from per-rank flat fp32 fragments
+via each param's ``_hp_mapping``. Here the "mapping" is the param's sharding,
+so gather = device_put to a replicated sharding and set = device_put back —
+metadata-only bookkeeping, one collective each way.
+
+Paths are ``/``-joined key paths into the engine's param pytree, e.g.
+``"blocks/block/attn/q_proj/kernel"`` or a bare top-level key.
+"""
+
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.partition import path_str
+from deepspeed_tpu.utils.logging import logger
+
+
+def _matches(leaf_path: str, query: str) -> bool:
+    query = query.strip("/")
+    return leaf_path == query or leaf_path.endswith("/" + query)
+
+
+def _find_leaf(tree: Any, path: str):
+    hits = []
+
+    def visit(p, leaf):
+        if _matches(path_str(p), path):
+            hits.append(leaf)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return hits
+
+
+def _replicate(x, dtype=None):
+    mesh = x.sharding.mesh if isinstance(x.sharding, NamedSharding) else None
+    if mesh is not None:
+        x = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+    out = np.asarray(x)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def safe_get_full_fp32_param(engine, path: str) -> Optional[np.ndarray]:
+    """Full fp32 value of one parameter, gathered from its shards
+    (reference tensor_fragment.py:91)."""
+    hits = _find_leaf(engine.params, path)
+    if not hits:
+        logger.warning(f"safe_get_full_fp32_param: no param at {path!r}")
+        return None
+    return _replicate(hits[0], np.float32)
+
+
+def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
+    """Full gradient from the engine's accumulation buffer (only available
+    between backward() and step(); reference tensor_fragment.py:104)."""
+    acc = getattr(engine, "_grad_acc", None) or getattr(
+        engine, "_cached_grads", None)
+    if acc is None:
+        logger.warning("safe_get_full_grad: no accumulated gradients "
+                       "(call between backward() and step())")
+        return None
+    hits = _find_leaf(acc, path)
+    if not hits:
+        return None
+    return _replicate(hits[0], np.float32)
+
+
+def safe_get_full_optimizer_state(engine, path: str,
+                                  state_name: str) -> Optional[np.ndarray]:
+    """Full optimizer-state tensor for a param: ``state_name`` is the optax
+    field (``mu``/``nu``/``trace`` — the reference's ``exp_avg``/
+    ``exp_avg_sq`` names are mapped; tensor_fragment.py:117)."""
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu", "momentum": "trace"}
+    state_name = alias.get(state_name, state_name)
+    hits: List[Any] = []
+
+    def walk(node):
+        if hasattr(node, "_fields"):
+            for f in node._fields:
+                if f == state_name:
+                    hits.extend(_find_leaf(getattr(node, f), path))
+                else:
+                    walk(getattr(node, f))
+        elif isinstance(node, (tuple, list)):
+            for x in node:
+                walk(x)
+
+    walk(engine.opt_state)
+    if not hits:
+        logger.warning(f"safe_get_full_optimizer_state: no {state_name!r} "
+                       f"state for {path!r}")
+        return None
+    return _replicate(hits[0], np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: str, value) -> bool:
+    """Write a full tensor back into one (sharded) parameter
+    (reference tensor_fragment.py:134 safe_set_full_fp32_param).
+
+    Like the getters, this addresses exactly ONE parameter: an ambiguous
+    suffix that matches several leaves (e.g. ``attn/q_proj/kernel`` in a
+    multi-layer tree) is an error, not a broadcast write."""
+    value = np.asarray(value)
+    matched: List[str] = []
+
+    def scan(p, leaf):
+        if _matches(path_str(p), path):
+            matched.append(path_str(p))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(scan, engine.params)
+    if not matched:
+        logger.warning(f"safe_set_full_fp32_param: no param at {path!r}")
+        return False
+    if len(matched) > 1:
+        raise ValueError(
+            f"safe_set_full_fp32_param: path {path!r} is ambiguous — matches "
+            f"{matched[:4]}{'…' if len(matched) > 4 else ''}")
+    target = matched[0]
+
+    def visit(p, leaf):
+        if path_str(p) == target:
+            if leaf.shape != value.shape:
+                raise ValueError(
+                    f"shape mismatch at {path!r}: {leaf.shape} vs {value.shape}")
+            return jax.device_put(value.astype(leaf.dtype), leaf.sharding)
+        return leaf
+
+    engine.params = jax.tree_util.tree_map_with_path(visit, engine.params)
+    return True

@@ -105,3 +105,110 @@ def test_inference_tp_sharded(tiny, dp4_tp2_mesh):
     prompt = jnp.asarray([[1, 2, 3]])
     out = engine.generate(prompt, max_new_tokens=3)
     assert out.shape == (1, 6)
+
+
+def test_generate_eos_pads_and_stops(tiny):
+    """Rows that emit EOS are padded with it; the fused loop's early exit
+    must not change results."""
+    cfg, model, params = tiny
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params, model_config=cfg)
+    prompt = jnp.asarray([[1, 2, 3]])
+    # force "EOS" = whatever greedy emits first → all subsequent are EOS
+    first = int(np.asarray(engine.generate(prompt, max_new_tokens=1))[0, -1])
+    engine.reset_cache()
+    out = np.asarray(engine.generate(prompt, max_new_tokens=6,
+                                     eos_token_id=first))
+    assert np.all(out[0, 3:] == first)
+
+
+def test_generate_top_p_top_k_sampling(tiny):
+    """Sampling with temperature/top_k/top_p stays in the allowed support and
+    changing knobs does not recompile into wrong shapes."""
+    cfg, model, params = tiny
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params, model_config=cfg)
+    prompt = jnp.asarray([[4, 5, 6, 7]])
+    a = engine.generate(prompt, max_new_tokens=4, temperature=0.8, top_k=5,
+                        rng=jax.random.PRNGKey(1))
+    engine.reset_cache()
+    b = engine.generate(prompt, max_new_tokens=4, temperature=0.8, top_p=0.9,
+                        rng=jax.random.PRNGKey(1))
+    assert a.shape == b.shape == (1, 8)
+    assert np.all(np.asarray(a) >= 0) and np.all(np.asarray(a) < cfg.vocab_size)
+
+
+def test_top_k_top_p_masks():
+    from deepspeed_tpu.inference.sampling import top_k_mask, top_p_mask
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    m = np.asarray(top_k_mask(logits, jnp.asarray(2)))
+    assert np.isneginf(m[0, [0, 2, 3]]).all()
+    assert m[0, 1] == 5.0 and m[0, 4] == 4.0
+    # top_k=0 disables
+    m0 = np.asarray(top_k_mask(logits, jnp.asarray(0)))
+    np.testing.assert_array_equal(m0, np.asarray(logits))
+
+    # peaked distribution: top_p small keeps only the argmax
+    peaked = jnp.asarray([[0.0, 10.0, 0.0, 0.0, 0.0]])
+    mp = np.asarray(top_p_mask(peaked, jnp.asarray(0.5)))
+    assert mp[0, 1] == 10.0
+    assert np.isneginf(mp[0, [0, 2, 3, 4]]).all()
+    # top_p=1 disables
+    mp1 = np.asarray(top_p_mask(peaked, jnp.asarray(1.0)))
+    np.testing.assert_array_equal(mp1, np.asarray(peaked))
+
+
+def test_int8_weight_only_inference():
+    """Quantized engine: q-leaves replace large kernels and the forward stays
+    close to the fp path (reference quant config, inference/config.py).
+    Uses a config whose kernels exceed the quantization size threshold."""
+    cfg = LlamaConfig.tiny(hidden_size=256, intermediate_size=512,
+                           dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0)["params"]
+    fp = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params, model_config=cfg)
+    q = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "group_size": 64}},
+        params=params, model_config=cfg)
+    assert any(x.dtype == jnp.int8
+               for x in jax.tree_util.tree_leaves(q.params)), \
+        "quantization must actually fire for this config"
+    ids = jnp.asarray([[1, 2, 3, 4, 5]])
+    out_fp = np.asarray(fp(ids))
+    out_q = np.asarray(q(ids))
+    assert not np.array_equal(out_q, out_fp)   # int8 path really differs
+    np.testing.assert_allclose(out_q, out_fp, rtol=0.1, atol=0.5)
+
+
+def test_int8_quantizes_large_kernels():
+    cfg = LlamaConfig.tiny(hidden_size=256, intermediate_size=512,
+                           dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    eng = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "float32", "quant": {"enabled": True}},
+        params=params, model_config=cfg)
+    qleaves = [x for x in jax.tree_util.tree_leaves(eng.params)
+               if x.dtype == jnp.int8]
+    assert qleaves, "expected at least one int8 kernel"
+    out = eng.generate(jnp.asarray([[1, 2, 3]]), max_new_tokens=3)
+    assert out.shape == (1, 6)
+
+
+def test_profile_model_time(tiny):
+    cfg, model, params = tiny
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params, model_config=cfg)
+    engine.profile_model_time()
+    engine(jnp.asarray([[1, 2, 3]]))
+    engine.generate(jnp.asarray([[1, 2, 3]]), max_new_tokens=2)
+    times = engine.model_times()
+    assert len(times) == 2 and all(t > 0 for t in times)
+    assert engine.model_times() == []

@@ -1,0 +1,169 @@
+"""API-parity utilities: zero.Init / GatheredParameters, OnDevice,
+safe_get_full_* accessors, coalesced collectives
+(reference tests/unit/runtime/zero/test_zero_context*.py and
+tests/unit/runtime/test_ds_initialize.py patterns)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.utils import (
+    OnDevice, safe_get_full_fp32_param, safe_get_full_grad,
+    safe_get_full_optimizer_state, safe_set_full_fp32_param,
+)
+
+
+def _tiny_engine(stage=1):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    return cfg, deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": stage}},
+        sample_batch={"input_ids": np.zeros((8, 16), np.int32)})
+
+
+def test_zero_init_materializes_sharded(dp8_mesh):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, hidden_size=128,
+                           intermediate_size=256)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+
+    with deepspeed_tpu.zero.Init(mesh=dp8_mesh) as ctx:
+        params = deepspeed_tpu.zero.Init.materialize(
+            lambda r: model.init(r, ids)["params"], jax.random.PRNGKey(0))
+    big = [l for l in jax.tree_util.tree_leaves(params) if l.size >= 1024]
+    assert big and any(not l.sharding.is_fully_replicated for l in big), \
+        "zero.Init must materialize large params sharded over data"
+
+
+def test_zero_init_disabled_and_inactive():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    # no active context: materialize is a passthrough
+    params = deepspeed_tpu.zero.Init.materialize(
+        lambda r: model.init(r, ids)["params"], jax.random.PRNGKey(0))
+    assert jax.tree_util.tree_leaves(params)
+
+
+def test_gathered_parameters_roundtrip(dp8_mesh):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, hidden_size=128,
+                           intermediate_size=256)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = deepspeed_tpu.zero.Init(mesh=dp8_mesh).init(
+        lambda r: model.init(r, ids)["params"], jax.random.PRNGKey(0))
+
+    with deepspeed_tpu.zero.GatheredParameters(params) as view:
+        full = view["params"]
+        assert all(l.sharding.is_fully_replicated
+                   for l in jax.tree_util.tree_leaves(full))
+        # modifier semantics: mutate inside the context
+        view["params"] = jax.tree_util.tree_map(lambda x: x * 0.0, full)
+    resharded = view["resharded"]
+    leaves = jax.tree_util.tree_leaves(resharded)
+    assert all(float(jnp.abs(l).max()) == 0.0 for l in leaves)
+    # shardings restored
+    orig_shardings = [l.sharding for l in jax.tree_util.tree_leaves(params)]
+    new_shardings = [l.sharding for l in leaves]
+    assert orig_shardings == new_shardings
+
+
+def test_on_device_meta_and_real():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        abstract = OnDevice.init(
+            lambda r: model.init(r, ids)["params"], jax.random.PRNGKey(0))
+    leaves = jax.tree_util.tree_leaves(abstract)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    assert any(l.dtype == jnp.bfloat16 for l in leaves)
+
+    with OnDevice(device=jax.devices()[0]):
+        real = OnDevice.init(
+            lambda r: model.init(r, ids)["params"], jax.random.PRNGKey(0))
+    assert all(hasattr(l, "addressable_data") or hasattr(l, "device")
+               for l in jax.tree_util.tree_leaves(real))
+
+
+def test_safe_get_set_full_param_and_state():
+    cfg, engine = _tiny_engine(stage=2)
+    # find a real param path
+    paths = []
+
+    def note(p, l):
+        keys = [getattr(k, "key", str(k)) for k in p]
+        paths.append("/".join(map(str, keys)))
+        return l
+
+    jax.tree_util.tree_map_with_path(note, engine.params)
+    kernel_paths = [p for p in paths if p.endswith("kernel")]
+    path = kernel_paths[0]
+
+    full = safe_get_full_fp32_param(engine, path)
+    assert full is not None and full.dtype == np.float32
+
+    mu = safe_get_full_optimizer_state(engine, path, "exp_avg")
+    assert mu is not None and mu.shape == full.shape
+    assert np.all(mu == 0)  # before any step
+
+    # grads only exist between backward and step
+    assert safe_get_full_grad(engine, path) is None
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, cfg.vocab_size, size=(8, 17))
+    engine.forward({"input_ids": t[:, :-1], "labels": t[:, 1:]})
+    engine.backward()
+    g = safe_get_full_grad(engine, path)
+    assert g is not None and g.shape == full.shape
+    engine.step()
+
+    # write-back
+    new_val = np.zeros_like(full)
+    assert safe_set_full_fp32_param(engine, path, new_val)
+    back = safe_get_full_fp32_param(engine, path)
+    assert np.all(back == 0)
+
+    assert safe_get_full_fp32_param(engine, "not/a/param") is None
+
+
+def test_coalesced_collectives(dp8_mesh):
+    from jax.experimental.shard_map import shard_map
+
+    import deepspeed_tpu.comm as dist
+
+    world = 8
+    xs = [jnp.arange(world * 4, dtype=jnp.float32).reshape(world, 4),
+          jnp.ones((world, 6), jnp.float32)]
+
+    def f(a, b):
+        outs = dist.reduce_scatter_coalesced(
+            [a.reshape(-1), b.reshape(-1)], group="data")
+        g = dist.all_gather_coalesced([outs[0]], group="data")
+        return outs[0][None], outs[1][None], g[0][None]
+
+    fn = jax.jit(shard_map(
+        f, mesh=dp8_mesh,
+        in_specs=(PartitionSpec("data"), PartitionSpec("data")),
+        out_specs=(PartitionSpec("data"), PartitionSpec("data"),
+                   PartitionSpec("data")),
+        check_rep=False))
+    o0, o1, g0 = fn(xs[0], xs[1])
+    # xs[0] row r = [4r..4r+3], flat len 4 padded to 8: scatter leaves the
+    # column sums in the first 4 slots, zeros in the padding
+    o0 = np.asarray(o0).reshape(-1)
+    np.testing.assert_allclose(o0[:4], [112.0, 120.0, 128.0, 136.0])
+    np.testing.assert_allclose(o0[4:], 0.0)
+    # xs[1] all-ones [8,6] → first 6 slots sum to world, 2 padding zeros
+    o1 = np.asarray(o1).reshape(-1)
+    np.testing.assert_allclose(o1[:6], float(world))
+    np.testing.assert_allclose(o1[6:], 0.0)
+    # gather of each device's 1-element shard reassembles the scattered flat
+    g0 = np.asarray(g0)
+    np.testing.assert_allclose(g0.reshape(world, -1)[0], o0)

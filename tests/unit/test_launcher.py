@@ -94,3 +94,50 @@ def test_launcher_local_runs_script(tmp_path):
         "print('probe-ok')\n")
     rc = main(["-H", "/nonexistent", "--launcher", "local", str(script)])
     assert rc == 0
+
+
+def test_multinode_runner_commands(tmp_path):
+    """pdsh/slurm/openmpi/mpich runners render fan-out commands
+    (reference tests/unit/launcher/test_multinode_runner.py pattern)."""
+    import argparse
+    from collections import OrderedDict
+
+    from deepspeed_tpu.launcher.multinode_runner import (
+        MPICHRunner, OpenMPIRunner, PDSHRunner, SlurmRunner,
+    )
+
+    args = argparse.Namespace(user_script="train.py", user_args=["--x", "1"],
+                              include="", exclude="")
+    world = OrderedDict([("host-a", 4), ("host-b", 4)])
+    active = OrderedDict([("host-a", [0, 1, 2, 3]), ("host-b", [0, 1, 2, 3])])
+    env = {"DS_TPU_COORDINATOR": "host-a:29500", "DS_TPU_NUM_PROCESSES": "2"}
+
+    pdsh = PDSHRunner(args, world).get_cmd(env, active)
+    assert pdsh[0] == "pdsh" and "host-a,host-b" in pdsh
+    assert any("train.py" in p for p in pdsh)
+    assert any("DS_TPU_COORDINATOR" in p for p in pdsh)
+
+    slurm = SlurmRunner(args, world).get_cmd(env, active)
+    assert slurm[:3] == ["srun", "-n", "2"]
+    assert "--nodelist" in slurm and "host-a,host-b" in slurm
+    assert any(p.startswith("--export=ALL,") for p in slurm)
+
+    ompi = OpenMPIRunner(args, world).get_cmd(env, active)
+    assert ompi[0] == "mpirun" and "host-a:1,host-b:1" in ompi
+    assert "-x" in ompi
+
+    mpich = MPICHRunner(args, world).get_cmd(env, active)
+    assert mpich[0] == "mpiexec" and "-genv" in mpich
+
+
+def test_runner_main_prints_scheduler_cmd(tmp_path, capsys):
+    """`dst --launcher slurm --print_env` renders without srun installed."""
+    from deepspeed_tpu.launcher import runner as R
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("host-a slots=4\nhost-b slots=4\n")
+    rc = R.main(["--hostfile", str(hf), "--launcher", "slurm", "--print_env",
+                 "train.py"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "srun" in out and "train.py" in out

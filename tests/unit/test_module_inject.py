@@ -252,3 +252,20 @@ def test_mistral_sliding_window_parity():
         max_position_embeddings=64, sliding_window=4))
     ids = np.random.default_rng(2).integers(0, 128, (2, 12)).astype(np.int64)
     _check(m, ids=ids, atol=5e-4)
+
+
+def test_clip_text_parity():
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    torch.manual_seed(0)
+    m = CLIPTextModel(CLIPTextConfig(
+        vocab_size=99, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, hidden_act="quick_gelu"))
+    ids = np.random.default_rng(0).integers(0, 99, (2, 10)).astype(np.int64)
+    m.eval()
+    with torch.no_grad():
+        expected = m(torch.from_numpy(ids)).last_hidden_state.float().numpy()
+    injected = convert_hf_model(m)
+    got = np.asarray(injected.apply(ids.astype(np.int32)))
+    np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)

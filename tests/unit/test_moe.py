@@ -95,3 +95,33 @@ def test_moe_gradients_flow():
     assert np.abs(np.asarray(gate_grad)).sum() > 0, "router must receive grads"
     exp_grad = grads["params"]["experts"]["gate_proj"]
     assert np.abs(np.asarray(exp_grad)).sum() > 0
+
+
+def test_moe_param_grouping():
+    """reference moe/utils.py split/is_moe_param semantics."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.moe.utils import (
+        is_moe_param, moe_param_mask,
+        split_params_into_different_moe_groups_for_optimizer,
+    )
+
+    params = {
+        "layer_0": {"attn": {"kernel": jnp.ones((4, 4))},
+                    "experts": {"gate_proj": jnp.ones((8, 4, 16))}},
+        "gate": {"kernel": jnp.ones((4, 8))},
+    }
+    assert is_moe_param("layer_0/experts/gate_proj")
+    assert not is_moe_param("layer_0/attn/kernel")
+
+    mask = moe_param_mask(params)
+    assert mask["layer_0"]["experts"]["gate_proj"] is True
+    assert mask["layer_0"]["attn"]["kernel"] is False
+
+    groups = split_params_into_different_moe_groups_for_optimizer(params)
+    assert len(groups) == 2
+    dense = [g for g in groups if not g["moe"]][0]
+    moe = [g for g in groups if g["moe"]][0]
+    import jax
+    assert len(jax.tree_util.tree_leaves(moe["params"])) == 1
+    assert len(jax.tree_util.tree_leaves(dense["params"])) == 2

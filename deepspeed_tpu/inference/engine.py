@@ -42,21 +42,23 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int):
     """
 
     def gen(params, input_ids, caches, rng, temperature, top_k, top_p,
-            eos_id):
+            eos_id, n_steps):
         logits, caches = apply_fn(params, input_ids, caches,
                                   jnp.asarray(0, jnp.int32))
         rng, key = jax.random.split(rng)
         nxt = sample_logits(logits[:, -1, :], key, temperature, top_k, top_p)
         finished = nxt == eos_id
         # pre-fill with eos so slots skipped by the early exit read as
-        # padding (with eos_id=-1 the loop always runs to max_new_tokens
-        # and overwrites every slot)
+        # padding (with eos_id=-1 the loop always runs to n_steps and
+        # overwrites every requested slot)
         out = jnp.full((B, max_new_tokens), eos_id, jnp.int32)
         out = out.at[:, 0].set(nxt)
 
         def cond(carry):
             i, _, _, _, finished, _ = carry
-            return jnp.logical_and(i < max_new_tokens,
+            # n_steps is traced: asking for fewer tokens reuses the same
+            # compiled program (max_new_tokens is just the buffer capacity)
+            return jnp.logical_and(i < n_steps,
                                    jnp.logical_not(finished.all()))
 
         def body(carry):
@@ -273,21 +275,31 @@ class InferenceEngine:
 
         return build_generate_fn(apply_fn, B, T, max_new_tokens)
 
+    _GEN_CACHE_MAX = 16     # compiled-program LRU bound
+    _GEN_BUCKET = 32        # max_new_tokens rounds up to this capacity
+
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 temperature: float = 0.0, top_k: int = 0,
                  rng: Optional[jax.Array] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None, *,
+                 top_p: float = 1.0):
         """Sampled/greedy generation with KV cache. input_ids: [B, T].
 
         Returns [B, T + max_new_tokens]; rows that hit ``eos_token_id`` are
-        padded with it. The full loop runs as one compiled program.
+        padded with it. The full loop runs as one compiled program; the
+        sampling knobs and the step count are traced, so only a new
+        (batch, prompt_len, capacity-bucket) shape recompiles. Compiled
+        programs are kept in a small LRU.
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        self._ensure_decode(B, T + max_new_tokens)
-        key = (B, T, max_new_tokens)
+        cap = -(-max_new_tokens // self._GEN_BUCKET) * self._GEN_BUCKET
+        self._ensure_decode(B, T + cap)
+        key = (B, T, cap)
         if key not in self._gen_cache:
-            self._gen_cache[key] = self._build_generate(B, T, max_new_tokens)
+            if len(self._gen_cache) >= self._GEN_CACHE_MAX:
+                self._gen_cache.pop(next(iter(self._gen_cache)))
+            self._gen_cache[key] = self._build_generate(B, T, cap)
         gen_fn = self._gen_cache[key]
         if rng is None:
             rng = jax.random.PRNGKey(0)
@@ -299,7 +311,9 @@ class InferenceEngine:
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(top_k, jnp.int32),
                 jnp.asarray(top_p, jnp.float32),
-                jnp.asarray(eos, jnp.int32))
+                jnp.asarray(eos, jnp.int32),
+                jnp.asarray(max_new_tokens, jnp.int32))
+        tokens = tokens[:, : T + max_new_tokens]
         if t0 is not None:
             jax.block_until_ready(tokens)
             self._model_times.append(time.time() - t0)

@@ -61,10 +61,23 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
 
     def sampled(op):
         logits, rng = op
+        vocab = logits.shape[-1]
         safe_t = jnp.maximum(temperature, 1e-6)
         scaled = logits.astype(jnp.float32) / safe_t
-        scaled = top_k_mask(scaled, top_k)
-        scaled = top_p_mask(scaled, top_p)
-        return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        # one descending sort serves both filters (the per-token hot cost)
+        sorted_d = jnp.sort(scaled, axis=-1)[..., ::-1]
+        idx = jnp.clip(top_k - 1, 0, vocab - 1).astype(jnp.int32)
+        kth = jax.lax.dynamic_index_in_dim(sorted_d, idx, axis=-1,
+                                           keepdims=True)
+        k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)
+        probs = jax.nn.softmax(sorted_d, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < jnp.maximum(top_p, 1e-9)
+        p_thresh = jnp.min(jnp.where(keep_sorted, sorted_d, jnp.inf),
+                           axis=-1, keepdims=True)
+        p_thresh = jnp.where(top_p < 1.0, p_thresh, -jnp.inf)
+        thresh = jnp.maximum(k_thresh, p_thresh)
+        masked = jnp.where(scaled < thresh, -jnp.inf, scaled)
+        return jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
 
     return jax.lax.cond(temperature > 0.0, sampled, greedy, (logits, rng))

@@ -180,10 +180,14 @@ def build_autotune_env(args) -> Dict[str, str]:
     return autotune_env
 
 
+def resolve_coordinator(args, hosts: List[str]) -> str:
+    return f"{args.master_addr or hosts[0]}:{args.master_port}"
+
+
 def build_commands(args, active: "OrderedDict[str, List[int]]"
                    ) -> List[Tuple[str, List[str], Dict[str, str]]]:
     hosts = list(active.keys())
-    coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
+    coordinator = resolve_coordinator(args, hosts)
     cmds = []
     autotune_env = build_autotune_env(args)
     for idx, host in enumerate(hosts):
@@ -224,7 +228,7 @@ def main(args=None) -> int:
         world_info = OrderedDict((h, len(s)) for h, s in active.items())
         runner = get_runner(args.launcher, args, world_info)
         hosts = list(active.keys())
-        coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
+        coordinator = resolve_coordinator(args, hosts)
         env = build_host_env(0, len(hosts), coordinator,
                              extra_env=build_autotune_env(args))
         env.pop("DS_TPU_PROCESS_ID", None)   # per-host rank set by backend

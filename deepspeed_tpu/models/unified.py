@@ -23,6 +23,7 @@ import dataclasses
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.transformer import (
@@ -73,12 +74,22 @@ class TransformerConfig:
     lm_head: bool = True                         # False → encoder output only
     lm_head_bias: bool = False                   # GPT-J's untied head has bias
 
+    # MoE blocks (Mixtral-style; reference containers/base_moe.py target)
+    moe_num_experts: int = 0                     # 0 → dense MLP everywhere
+    moe_top_k: int = 2
+    moe_layer_freq: int = 1                      # every Nth layer is MoE
+    moe_norm_topk: bool = True                   # renormalize top-k weights
+
     dtype: Any = jnp.float32
     remat: bool = False
 
     @property
     def ffn_size(self) -> int:
         return self.intermediate_size or 4 * self.hidden_size
+
+    def is_moe_layer(self, layer_idx: int) -> bool:
+        return (self.moe_num_experts > 0
+                and layer_idx % max(self.moe_layer_freq, 1) == 0)
 
     @staticmethod
     def tiny(**kw) -> "TransformerConfig":
@@ -100,6 +111,52 @@ def _norm(cfg: TransformerConfig, name: str):
                         param_dtype=jnp.float32, name=name)
 
 
+class DenseRoutedMoE(nn.Module):
+    """Mixtral-exact top-k routed expert MLP (softmax-over-all → top-k →
+    optional renormalize → weighted sum of selected SwiGLU experts).
+
+    Dense dispatch: every expert runs on every token and non-selected
+    contributions are zero-weighted — exact for inference injection and
+    correctness tests. The capacity-based all_to_all dispatch for efficient
+    expert-parallel training/serving is deepspeed_tpu.moe.layer.MoE; this
+    module exists so converted HF MoE checkpoints reproduce reference
+    logits bit-for-bit in routing.
+    """
+
+    num_experts: int
+    top_k: int
+    intermediate_size: int
+    norm_topk: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):                      # [B, S, D]
+        B, S, D = x.shape
+        E, F, K = self.num_experts, self.intermediate_size, self.top_k
+        t = x.reshape(B * S, D)
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="gate")(
+            t.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        vals, idx = jax.lax.top_k(probs, K)     # [T, K]
+        if self.norm_topk:
+            vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-20)
+        w = (jax.nn.one_hot(idx, E, dtype=jnp.float32)
+             * vals[..., None]).sum(axis=1)     # [T, E]
+
+        init = nn.initializers.lecun_normal()
+        wg = self.param("gate_proj", init, (E, D, F), jnp.float32)
+        wu = self.param("up_proj", init, (E, D, F), jnp.float32)
+        wd = self.param("down_proj", init, (E, F, D), jnp.float32)
+        td = t.astype(self.dtype)
+        g = jnp.einsum("td,edf->tef", td, wg.astype(self.dtype))
+        u = jnp.einsum("td,edf->tef", td, wu.astype(self.dtype))
+        h = nn.silu(g) * u
+        y = jnp.einsum("tef,efd->ted", h, wd.astype(self.dtype))
+        out = jnp.einsum("ted,te->td", y.astype(jnp.float32), w)
+        return out.reshape(B, S, D).astype(x.dtype)
+
+
 class UnifiedBlock(nn.Module):
     cfg: TransformerConfig
     layer_idx: int = 0
@@ -114,7 +171,12 @@ class UnifiedBlock(nn.Module):
             dtype=cfg.dtype, use_bias=cfg.attn_bias,
             out_bias=cfg.attn_out_bias, attn_scale=cfg.attn_scale,
             name="attn")
-        if cfg.gated_mlp:
+        if cfg.is_moe_layer(self.layer_idx):
+            mlp = DenseRoutedMoE(
+                num_experts=cfg.moe_num_experts, top_k=cfg.moe_top_k,
+                intermediate_size=cfg.ffn_size, norm_topk=cfg.moe_norm_topk,
+                dtype=cfg.dtype, name="moe")
+        elif cfg.gated_mlp:
             mlp = GatedMLP(intermediate_size=cfg.ffn_size, dtype=cfg.dtype,
                            use_bias=cfg.mlp_bias, activation=_act(cfg.activation),
                            name="mlp")

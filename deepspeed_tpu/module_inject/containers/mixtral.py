@@ -1,0 +1,83 @@
+"""Mixtral (MoE) policy — the expert-parallel injection target
+(reference module_inject/containers/{base_moe.py,megatron_gpt_moe.py}: the
+reference injects its own DS-MoE megatron models; the open-weights MoE
+family on HF is Mixtral, so that is the concrete architecture this policy
+owns — same contract: gate + per-expert MLPs mapped into a batched expert
+stack that expert-parallel shardings apply to).
+
+Routing parity: softmax over all experts → top-k → renormalize, matched by
+``models/unified.py DenseRoutedMoE``.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    TransformerPolicy, _np, dense_, ln_, register_policy,
+)
+
+
+@register_policy
+class HFMixtralLayerPolicy(TransformerPolicy):
+    model_types = ("mixtral",)
+    class_name_hints = ("Mixtral",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        window = getattr(hf_config, "sliding_window", None)
+        windows = ((window,) * hf_config.num_hidden_layers) if window else None
+        return TransformerConfig(
+            attn_windows=windows,
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                                 hf_config.num_attention_heads),
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_emb="rotary",
+            rope_base=getattr(hf_config, "rope_theta", 10000.0),
+            norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation="silu",
+            attn_bias=False, mlp_bias=False,
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+            moe_num_experts=hf_config.num_local_experts,
+            moe_top_k=hf_config.num_experts_per_tok,
+            moe_norm_topk=True,
+        )
+
+    def convert(self, sd, hf_config):
+        p = "model." if any(k.startswith("model.") for k in sd) else ""
+        params = {
+            "wte": {"embedding": _np(sd[f"{p}embed_tokens.weight"])},
+            "ln_f": ln_(sd, f"{p}norm"),
+        }
+        if "lm_head.weight" in sd and not getattr(hf_config,
+                                                  "tie_word_embeddings", False):
+            params["lm_head"] = dense_(sd, "lm_head")
+        E = hf_config.num_local_experts
+        for i in range(hf_config.num_hidden_layers):
+            b = f"{p}layers.{i}"
+            moe = f"{b}.block_sparse_moe"
+            # HF stores per-expert w1 (gate), w3 (up) as [F, D] and w2
+            # (down) as [D, F]; stack into [E, D, F] / [E, F, D] so every
+            # local expert runs as one batched einsum on the MXU
+            gate_w = np.stack([_np(sd[f"{moe}.experts.{e}.w1.weight"]).T
+                               for e in range(E)])
+            up_w = np.stack([_np(sd[f"{moe}.experts.{e}.w3.weight"]).T
+                             for e in range(E)])
+            down_w = np.stack([_np(sd[f"{moe}.experts.{e}.w2.weight"]).T
+                               for e in range(E)])
+            params[f"layer_{i}"] = {
+                "ln_1": ln_(sd, f"{b}.input_layernorm"),
+                "ln_2": ln_(sd, f"{b}.post_attention_layernorm"),
+                "attn": {"q_proj": dense_(sd, f"{b}.self_attn.q_proj"),
+                         "k_proj": dense_(sd, f"{b}.self_attn.k_proj"),
+                         "v_proj": dense_(sd, f"{b}.self_attn.v_proj"),
+                         "o_proj": dense_(sd, f"{b}.self_attn.o_proj")},
+                "moe": {"gate": dense_(sd, f"{moe}.gate"),
+                        "gate_proj": gate_w,
+                        "up_proj": up_w,
+                        "down_proj": down_w},
+            }
+        return params

@@ -15,12 +15,22 @@ import jax
 
 from deepspeed_tpu.parallel.partition import path_str
 
-MOE_PATH_MARKERS = ("experts", "expert_", "moe")
+# names of stacked expert weights inside a MoE node; the router ("gate")
+# stays in the dense group exactly as the reference keeps the TopKGate out
+# of the expert groups (moe/utils.py is_moe_param → False for the gate)
+EXPERT_STACK_NAMES = ("gate_proj", "up_proj", "down_proj", "w1", "w2", "w3")
+MOE_NODE_NAMES = ("moe", "block_sparse_moe")
 
 
 def is_moe_param_path(path: str) -> bool:
-    parts = path.lower().split("/")
-    return any(m in p for p in parts for m in MOE_PATH_MARKERS)
+    segs = [s for s in path.lower().strip("/").split("/") if s]
+    if "experts" in segs:
+        return True
+    for i, s in enumerate(segs):
+        if s in MOE_NODE_NAMES and i + 1 < len(segs) \
+                and segs[i + 1] in EXPERT_STACK_NAMES:
+            return True
+    return False
 
 
 def is_moe_param(tree_path) -> bool:

@@ -1,0 +1,95 @@
+"""Pallas int8 weight-streaming matmul.
+
+TPU-native counterpart of the reference's int8 inference GEMMs
+(``csrc/transformer/inference/csrc/dequantize.cu`` + the int8 paths in
+``pt_binding.cpp``): weights stay int8 in HBM and are converted in VMEM
+inside the matmul kernel, so the HBM bytes moved per decode step are halved
+versus bf16. XLA alone materializes a converted copy (the convert is not
+fused into the dot), which erases the bandwidth win — this kernel exists
+precisely to keep the int8→f32 convert on-chip.
+
+Quantization layout: per-input-channel (row-wise) symmetric scales
+(``quantize_rowwise``) so the scale folds into the *activation* —
+``y = (x * s) @ q`` — and the kernel itself is a plain int8-weight matmul.
+
+Falls back to ``interpret=True`` off-TPU so tests run on the CPU mesh.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def quantize_rowwise(w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[K, N] float → (q int8 [K, N], scale f32 [K]). Symmetric per row
+    (per input channel), so dequant folds into the activation side."""
+    absmax = jnp.max(jnp.abs(w), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -128, 127).astype(jnp.int8)
+    return q, scale[:, 0].astype(jnp.float32)
+
+
+def _kernel(x_ref, q_ref, o_ref, acc, *, nk: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    w = q_ref[...].astype(jnp.float32)        # int8 → f32 in VMEM
+    x = x_ref[...].astype(jnp.float32)
+    acc[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
+                block_k: int = 512, block_n: int = 512,
+                out_dtype=None) -> jnp.ndarray:
+    """y = (x * scale) @ q  for int8 q.
+
+    x: [B, K] (B small — the decode shape), q: [K, N] int8, scale: [K].
+    """
+    B, K = x.shape
+    Kq, N = q.shape
+    assert K == Kq and scale.shape == (K,), (x.shape, q.shape, scale.shape)
+    out_dtype = out_dtype or x.dtype
+
+    xs = (x.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
+
+    block_k = min(block_k, K)
+    block_n = min(block_n, N)
+    pad_b = (-B) % 8
+    pad_k = (-K) % block_k
+    pad_n = (-N) % block_n
+    if pad_b or pad_k:
+        xs = jnp.pad(xs, ((0, pad_b), (0, pad_k)))
+    if pad_k or pad_n:
+        q = jnp.pad(q, ((0, pad_k), (0, pad_n)))
+    Bp, Kp, Np = B + pad_b, K + pad_k, N + pad_n
+    nk, nn = Kp // block_k, Np // block_n
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk),
+        grid=(nn, nk),
+        in_specs=[
+            pl.BlockSpec((Bp, block_k), lambda n, k: (0, k)),
+            pl.BlockSpec((block_k, block_n), lambda n, k: (k, n)),
+        ],
+        out_specs=pl.BlockSpec((Bp, block_n), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Bp, Np), out_dtype),
+        scratch_shapes=[pltpu.VMEM((Bp, block_n), jnp.float32)],
+        interpret=_use_interpret(),
+    )(xs, q)
+    return out[:B, :N]

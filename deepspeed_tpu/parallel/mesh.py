@@ -79,29 +79,45 @@ def resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
 
 
 def make_mesh(mesh_config=None, devices: Optional[Sequence] = None,
-              dims: Optional[Dict[str, int]] = None) -> Mesh:
+              dims: Optional[Dict[str, int]] = None,
+              mics_shard_size: int = 0) -> Mesh:
     """Build the global Mesh. ``expert`` is NOT a standalone mesh axis —
     expert groups are sub-groups of ``data`` (see moe/). The mesh axes are
-    (pipe, data, sequence, tensor)."""
+    (pipe, data, mics, sequence, tensor); ``mics`` is carved out of the
+    data-parallel group when MiCS sub-group sharding is on
+    (reference runtime/zero/mics.py — shard within groups of
+    ``mics_shard_size`` ranks, replicate across groups; the hierarchical
+    inter-node allgather falls out of XLA reducing over ``data`` while
+    gathering over ``mics``) and is 1 otherwise."""
     if devices is None:
         devices = jax.devices()
     if dims is None:
         assert mesh_config is not None
         dims = resolve_mesh_dims(mesh_config, len(devices))
-    axis_names = ("pipe", "data", "sequence", "tensor")
-    shape = (dims["pipe"], dims["data"], dims["sequence"], dims["tensor"])
+    dims = dict(dims)
+    mics = dims.get("mics", 1)
+    if mics_shard_size and mics_shard_size > 0:
+        if dims["data"] % mics_shard_size != 0:
+            raise ValueError(
+                f"mics_shard_size {mics_shard_size} must divide the data "
+                f"axis ({dims['data']})")
+        mics = mics_shard_size
+        dims["data"] = dims["data"] // mics_shard_size
+    axis_names = ("pipe", "data", "mics", "sequence", "tensor")
+    shape = (dims["pipe"], dims["data"], mics, dims["sequence"],
+             dims["tensor"])
     if int(np.prod(shape)) != len(devices):
         raise ValueError(f"mesh shape {shape} != device count {len(devices)}")
     dev_array = np.asarray(devices).reshape(shape)
     logger.info(f"Created device mesh pipe={shape[0]} data={shape[1]} "
-                f"sequence={shape[2]} tensor={shape[3]}")
+                f"mics={shape[2]} sequence={shape[3]} tensor={shape[4]}")
     return Mesh(dev_array, axis_names)
 
 
 def single_device_mesh() -> Mesh:
     """Trivial mesh over one device (single-chip debugging)."""
-    dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1)
-    return Mesh(dev, ("pipe", "data", "sequence", "tensor"))
+    dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    return Mesh(dev, ("pipe", "data", "mics", "sequence", "tensor"))
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

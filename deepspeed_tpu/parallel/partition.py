@@ -90,15 +90,19 @@ def _match_tp_rule(path: str, shape: Sequence[int], rules: List[Rule],
 
 
 def _maybe_shard_data_axis(spec: List[Optional[str]], shape: Sequence[int],
-                           mesh: Mesh, min_size: int = 2) -> List[Optional[str]]:
+                           mesh: Mesh, min_size: int = 2,
+                           axis: str = DATA_AXIS) -> List[Optional[str]]:
     """ZeRO-3: shard the largest free dim along the data axis when divisible.
 
     Equivalent of partition_parameters.py's flat-partition over the DP group —
     except the partition stays tied to the logical dim so resharding on load
-    is metadata-only.
+    is metadata-only. With MiCS, ``axis="mics"`` shards within the sub-group
+    only (reference zero/mics.py bounded sharding).
     """
-    dp = mesh_axis_size(mesh, DATA_AXIS)
-    if dp <= 1 or DATA_AXIS in spec:  # expert stacks already shard over data
+    dp = mesh_axis_size(mesh, axis)
+    # expert stacks already shard over data — exempt them from the ZeRO axis
+    # whether that axis is "data" or the MiCS sub-axis
+    if dp <= 1 or axis in spec or DATA_AXIS in spec:
         return spec
     # pick the largest dim not already sharded whose size divides by dp
     candidates = [
@@ -109,21 +113,23 @@ def _maybe_shard_data_axis(spec: List[Optional[str]], shape: Sequence[int],
         return spec
     _, dim = max(candidates)
     spec = list(spec)
-    spec[dim] = DATA_AXIS
+    spec[dim] = axis
     return spec
 
 
 def infer_param_spec(path: str, shape: Sequence[int], mesh: Mesh,
                      rules: Optional[List[Rule]] = None,
-                     shard_data_axis: bool = False) -> PartitionSpec:
+                     shard_data_axis: bool = False,
+                     zero_axis: str = DATA_AXIS) -> PartitionSpec:
     """PartitionSpec for one parameter.
 
-    ``shard_data_axis=True`` adds ZeRO-3-style sharding over the data axis.
+    ``shard_data_axis=True`` adds ZeRO-3-style sharding over ``zero_axis``
+    (the data axis; "mics" for MiCS sub-group sharding).
     """
     rules = DEFAULT_TP_RULES if rules is None else rules
     spec = _match_tp_rule(path, shape, rules, mesh)
     if shard_data_axis:
-        spec = _maybe_shard_data_axis(spec, shape, mesh)
+        spec = _maybe_shard_data_axis(spec, shape, mesh, axis=zero_axis)
     return PartitionSpec(*spec)
 
 
@@ -145,11 +151,19 @@ def tree_shardings(params: Any, mesh: Mesh, rules: Optional[List[Rule]] = None,
                                   is_leaf=lambda x: isinstance(x, PartitionSpec))
 
 
+def data_axes(mesh: Mesh):
+    """The batch-sharding axes: ("data", "mics") when a MiCS axis exists —
+    sub-groups are still data-parallel over the batch."""
+    if mesh_axis_size(mesh, "mics") > 1:
+        return (DATA_AXIS, "mics")
+    return DATA_AXIS
+
+
 def batch_spec(mesh: Mesh, sequence_sharded: bool = False) -> PartitionSpec:
     """Inputs: batch dim over data axis; optionally seq dim over sequence axis."""
     if sequence_sharded and mesh_axis_size(mesh, "sequence") > 1:
-        return PartitionSpec(DATA_AXIS, "sequence")
-    return PartitionSpec(DATA_AXIS)
+        return PartitionSpec(data_axes(mesh), "sequence")
+    return PartitionSpec(data_axes(mesh))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

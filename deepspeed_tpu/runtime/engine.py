@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.parallel.mesh import DATA_AXIS, make_mesh, mesh_axis_size
-from deepspeed_tpu.parallel.partition import batch_spec
+from deepspeed_tpu.parallel.partition import batch_spec, data_axes
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     grads_finite, make_dynamic_scaler_state, make_static_scaler_state,
@@ -93,9 +93,13 @@ class DeepSpeedEngine:
         dist.init_distributed()
         dist.configure(self._config)
 
-        self.mesh = mesh if mesh is not None else make_mesh(self._config.mesh)
+        mics = getattr(self._config.zero_config, "mics_shard_size", -1) or -1
+        self.mesh = mesh if mesh is not None else make_mesh(
+            self._config.mesh, mics_shard_size=max(mics, 0))
         groups.initialize_groups(self.mesh)
-        self.dp_world_size = mesh_axis_size(self.mesh, DATA_AXIS)
+        # batch parallelism spans data × mics (MiCS sub-groups are still DP)
+        self.dp_world_size = (mesh_axis_size(self.mesh, DATA_AXIS)
+                              * mesh_axis_size(self.mesh, "mics"))
 
         # precision -----------------------------------------------------------
         self.fp16_enabled = self._config.fp16.enabled
@@ -407,7 +411,7 @@ class DeepSpeedEngine:
                 return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec()))
             axes = [None] * x.ndim
             b_axis = 1 if leading_gas else 0
-            axes[b_axis] = DATA_AXIS
+            axes[b_axis] = data_axes(self.mesh)
             # context parallelism: tokens shard over the sequence axis too
             s_axis = b_axis + 1
             if seq_size > 1 and x.ndim > s_axis and x.shape[s_axis] % seq_size == 0:

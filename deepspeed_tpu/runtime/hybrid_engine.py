@@ -88,13 +88,15 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     # --- generation (reference :178-282) ----------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 temperature: float = 0.0, top_k: int = 0,
                  rng: Optional[jax.Array] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None, *,
+                 top_p: float = 1.0):
         """Rollout generation against the live (sharded, LoRA-fused) training
         params — one fused prefill+decode program shared with the inference
         engine (inference/engine.py build_generate_fn)."""
-        from deepspeed_tpu.inference.engine import build_generate_fn
+        from deepspeed_tpu.inference.engine import InferenceEngine, \
+            build_generate_fn
 
         was_training = not self._in_eval
         if was_training:
@@ -103,13 +105,17 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        self._ensure_decode(B, T + max_new_tokens)
-        key = (B, T, max_new_tokens)
+        bucket = InferenceEngine._GEN_BUCKET
+        cap = -(-max_new_tokens // bucket) * bucket
+        self._ensure_decode(B, T + cap)
+        key = (B, T, cap)
         if key not in self._gen_cache:
+            if len(self._gen_cache) >= InferenceEngine._GEN_CACHE_MAX:
+                self._gen_cache.pop(next(iter(self._gen_cache)))
             decoder = self._decoder
             self._gen_cache[key] = build_generate_fn(
                 lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
-                B, T, max_new_tokens)
+                B, T, cap)
         if rng is None:
             rng = jax.random.PRNGKey(self.global_steps)
         eos = -1 if eos_token_id is None else int(eos_token_id)
@@ -120,7 +126,9 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(top_k, jnp.int32),
                 jnp.asarray(top_p, jnp.float32),
-                jnp.asarray(eos, jnp.int32))
+                jnp.asarray(eos, jnp.int32),
+                jnp.asarray(max_new_tokens, jnp.int32))
+        tokens = tokens[:, : T + max_new_tokens]
 
         self.latency_timer.stop(synchronize=True)
         self.generate_time = self.latency_timer.elapsed(reset=True)

@@ -43,11 +43,13 @@ class ZeroShardingPlan(NamedTuple):
     offload_optimizer: bool
 
 
-def _specs(params: Any, mesh: Mesh, rules, shard_data: bool) -> Any:
+def _specs(params: Any, mesh: Mesh, rules, shard_data: bool,
+           zero_axis: str = "data") -> Any:
     def spec_for(path, leaf):
         if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) == 0:
             return PartitionSpec()
-        return infer_param_spec(path_str(path), leaf.shape, mesh, rules, shard_data)
+        return infer_param_spec(path_str(path), leaf.shape, mesh, rules,
+                                shard_data, zero_axis=zero_axis)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
@@ -64,12 +66,27 @@ def _supports_host_memory(mesh: Mesh) -> bool:
 def plan_zero_shardings(params: Any, mesh: Mesh, zero_config, rules=None) -> ZeroShardingPlan:
     stage = zero_config.stage
     mics = getattr(zero_config, "mics_shard_size", -1)
+    zero_axis = "data"
     if mics and mics > 0:
-        logger.warning("MiCS sub-group sharding is not yet wired; using full data-axis sharding")
+        # MiCS (reference zero/mics.py): partitions are bounded to sub-groups
+        # of mics_shard_size ranks (the "mics" mesh axis carved out of data);
+        # state replicates across sub-groups, so gathers stay inside a group
+        # (intra-node ICI) and only gradient reduction crosses groups —
+        # XLA's psum over ("data","mics") does the hierarchical reduction.
+        if "mics" in mesh.axis_names and mesh.shape["mics"] > 1:
+            zero_axis = "mics"
+        else:
+            logger.warning(
+                "mics_shard_size set but the mesh has no mics axis; build "
+                "the mesh with make_mesh(..., mics_shard_size=N) — falling "
+                "back to full data-axis sharding")
 
-    param_specs = _specs(params, mesh, rules, shard_data=(stage >= 3))
-    grad_specs = _specs(params, mesh, rules, shard_data=(stage >= 2))
-    opt_specs = _specs(params, mesh, rules, shard_data=(stage >= 1))
+    param_specs = _specs(params, mesh, rules, shard_data=(stage >= 3),
+                         zero_axis=zero_axis)
+    grad_specs = _specs(params, mesh, rules, shard_data=(stage >= 2),
+                        zero_axis=zero_axis)
+    opt_specs = _specs(params, mesh, rules, shard_data=(stage >= 1),
+                       zero_axis=zero_axis)
 
     offload = zero_config.offload_optimizer_device == "cpu"
     host_ok = offload and _supports_host_memory(mesh)

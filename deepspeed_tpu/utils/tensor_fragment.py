@@ -27,15 +27,22 @@ def _matches(leaf_path: str, query: str) -> bool:
     return leaf_path == query or leaf_path.endswith("/" + query)
 
 
-def _find_leaf(tree: Any, path: str):
-    hits = []
+def _find_leaf(tree: Any, path: str, what: str = "param"):
+    """All leaves matching the path suffix; raises if the suffix is
+    ambiguous — every accessor here addresses exactly ONE tensor."""
+    hits, where = [], []
 
     def visit(p, leaf):
         if _matches(path_str(p), path):
             hits.append(leaf)
+            where.append(path_str(p))
         return leaf
 
     jax.tree_util.tree_map_with_path(visit, tree)
+    if len(hits) > 1:
+        raise ValueError(
+            f"{what} path {path!r} is ambiguous — matches "
+            f"{where[:4]}{'…' if len(where) > 4 else ''}; use a longer path")
     return hits
 
 

@@ -1,0 +1,41 @@
+"""Pallas int8 weight-streaming matmul vs float reference
+(reference tests/unit/ops quantizer/dequantize pattern)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.int8_matmul import int8_matmul, quantize_rowwise
+
+
+def test_rowwise_quant_roundtrip(rng):
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    q, s = quantize_rowwise(w)
+    assert q.dtype == jnp.int8 and s.shape == (64,)
+    deq = q.astype(jnp.float32) * s[:, None]
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(w),
+                               atol=float(np.abs(np.asarray(w)).max()) / 100)
+
+
+@pytest.mark.parametrize("B,K,N", [(1, 128, 128), (4, 256, 192), (3, 100, 60)])
+def test_int8_matmul_matches_float(rng, B, K, N):
+    x = jnp.asarray(rng.standard_normal((B, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+    q, s = quantize_rowwise(w)
+    got = int8_matmul(x, q, s, block_k=64, block_n=64)
+    want = x @ (q.astype(jnp.float32) * s[:, None])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+    # and close to the UNquantized product (int8 error bound)
+    exact = np.asarray(x @ w)
+    err = np.abs(np.asarray(got) - exact).max()
+    assert err < 0.05 * np.abs(exact).max() + 0.5
+
+
+def test_int8_matmul_zero_rows(rng):
+    """all-zero input channels must not divide by zero."""
+    w = jnp.zeros((32, 16), jnp.float32)
+    q, s = quantize_rowwise(w)
+    x = jnp.asarray(rng.standard_normal((2, 32)), jnp.float32)
+    out = int8_matmul(x, q, s, block_k=32, block_n=16)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)

@@ -13,6 +13,7 @@ flash-attention path available for long prefills.
 """
 
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -28,6 +29,28 @@ from deepspeed_tpu.models.llama import (
 from deepspeed_tpu.parallel.mesh import make_mesh
 from deepspeed_tpu.parallel.partition import tree_shardings
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+GEN_BUCKET = 32         # max_new_tokens rounds up to this program capacity
+GEN_CACHE_MAX = 16      # compiled-program LRU bound
+
+
+def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
+                        max_new_tokens: int):
+    """Shared compiled-generation cache policy (used by InferenceEngine and
+    the RLHF hybrid engine): capacity-bucketed keys, true LRU eviction.
+    Returns ``(gen_fn, cap)``."""
+    cap = -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
+    key = (B, T, cap)
+    if not isinstance(cache, OrderedDict):
+        raise TypeError("gen cache must be an OrderedDict")
+    if key in cache:
+        cache.move_to_end(key)
+    else:
+        if len(cache) >= GEN_CACHE_MAX:
+            cache.popitem(last=False)
+        cache[key] = build_generate_fn(apply_fn, B, T, cap)
+    return cache[key], cap
 
 
 def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int):
@@ -129,7 +152,7 @@ class InferenceEngine:
         self._kv_caches = None
         self._decode_fn = None
         self._prefill_fn = None
-        self._gen_cache: Dict[Any, Any] = {}
+        self._gen_cache: "OrderedDict[Any, Any]" = OrderedDict()
         # int8 weight-only storage (reference quant config,
         # inference/config.py:126 + csrc/quantization): decode reads half the
         # HBM bytes per step; dequant fuses into the consuming matmul
@@ -142,6 +165,11 @@ class InferenceEngine:
                  f"{', int8 weights' if self._quantized else ''}", ranks=[0])
 
     # --- int8 weight-only quantization ---------------------------------------
+    # TODO(perf): _effective_params dequantizes OUTSIDE the decode loop (XLA
+    # hoists the loop-invariant convert), so int8 currently wins HBM
+    # *capacity*, not per-step bandwidth. The Pallas weight-streaming kernel
+    # that keeps weights int8 in HBM exists (ops/int8_matmul.py); wiring it
+    # requires routing the model's Dense matmuls through it.
     def _quantize_params(self):
         """Replace large matmul kernels in ``self.params`` with
         {q: int8, scale} groups — decode is weight-bandwidth-bound, so
@@ -244,7 +272,7 @@ class InferenceEngine:
         decoder = LlamaDecoderModel(cfg)
         self._decoder = decoder
         self._kv_caches = init_kv_caches(cfg, batch_size, max_len, self.dtype)
-        self._gen_cache = {}
+        self._gen_cache = OrderedDict()
 
         def step(params, tokens, caches, index):
             logits, new_caches = decoder.apply(
@@ -263,20 +291,7 @@ class InferenceEngine:
     def release_workspace(self):
         self._kv_caches = None
         self._decode_fn = None
-        self._gen_cache = {}
-
-    def _build_generate(self, B: int, T: int, max_new_tokens: int):
-        decoder = self._decoder
-
-        def apply_fn(params, tokens, caches, index):
-            return decoder.apply(
-                {"params": self._effective_params(params)}, tokens, caches,
-                index)
-
-        return build_generate_fn(apply_fn, B, T, max_new_tokens)
-
-    _GEN_CACHE_MAX = 16     # compiled-program LRU bound
-    _GEN_BUCKET = 32        # max_new_tokens rounds up to this capacity
+        self._gen_cache = OrderedDict()
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
@@ -293,14 +308,17 @@ class InferenceEngine:
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        cap = -(-max_new_tokens // self._GEN_BUCKET) * self._GEN_BUCKET
+        cap = -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
         self._ensure_decode(B, T + cap)
-        key = (B, T, cap)
-        if key not in self._gen_cache:
-            if len(self._gen_cache) >= self._GEN_CACHE_MAX:
-                self._gen_cache.pop(next(iter(self._gen_cache)))
-            self._gen_cache[key] = self._build_generate(B, T, cap)
-        gen_fn = self._gen_cache[key]
+        decoder = self._decoder
+
+        def apply_fn(params, tokens, caches, index):
+            return decoder.apply(
+                {"params": self._effective_params(params)}, tokens, caches,
+                index)
+
+        gen_fn, cap = get_or_build_gen_fn(self._gen_cache, apply_fn, B, T,
+                                          max_new_tokens)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         eos = -1 if eos_token_id is None else int(eos_token_id)

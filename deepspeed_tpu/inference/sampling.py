@@ -64,19 +64,25 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
         vocab = logits.shape[-1]
         safe_t = jnp.maximum(temperature, 1e-6)
         scaled = logits.astype(jnp.float32) / safe_t
-        # one descending sort serves both filters (the per-token hot cost)
+        # one descending sort serves both filters (the per-token hot cost);
+        # top-k applies FIRST and top-p filters the top-k-renormalized
+        # distribution — HF's sequential-filter semantics
         sorted_d = jnp.sort(scaled, axis=-1)[..., ::-1]
+        pos = jnp.arange(vocab)[None, :]
+        keep_k = jnp.logical_or(top_k <= 0, pos < top_k)
         idx = jnp.clip(top_k - 1, 0, vocab - 1).astype(jnp.int32)
         kth = jax.lax.dynamic_index_in_dim(sorted_d, idx, axis=-1,
                                            keepdims=True)
         k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)
-        probs = jax.nn.softmax(sorted_d, axis=-1)
+        sorted_k = jnp.where(keep_k, sorted_d, -jnp.inf)
+        probs = jax.nn.softmax(sorted_k, axis=-1)      # renormalized over k
         cum = jnp.cumsum(probs, axis=-1)
-        keep_sorted = (cum - probs) < jnp.maximum(top_p, 1e-9)
-        p_thresh = jnp.min(jnp.where(keep_sorted, sorted_d, jnp.inf),
+        keep_p = jnp.logical_and((cum - probs) < jnp.maximum(top_p, 1e-9),
+                                 keep_k)
+        p_thresh = jnp.min(jnp.where(keep_p, sorted_d, jnp.inf),
                            axis=-1, keepdims=True)
-        p_thresh = jnp.where(top_p < 1.0, p_thresh, -jnp.inf)
-        thresh = jnp.maximum(k_thresh, p_thresh)
+        # a kept-by-p value is always within the top-k, so p_thresh >= kth
+        thresh = jnp.where(top_p < 1.0, p_thresh, k_thresh)
         masked = jnp.where(scaled < thresh, -jnp.inf, scaled)
         return jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
 

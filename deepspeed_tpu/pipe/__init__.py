@@ -1,0 +1,19 @@
+"""Public pipeline-module surface (reference ``deepspeed/pipe``:
+``PipelineModule`` / ``LayerSpec`` / ``TiedLayerSpec``,
+runtime/pipe/module.py:85,29,76).
+
+The reference's ``PipelineModule`` takes a flat list of layer specs,
+partitions them over pipeline stages (uniform / parameter-count / regex
+class-name match, module.py:353) and owns tied-weight groups. The TPU build
+keeps that exact user surface; execution differs: a stage's layers run
+sequentially inside ONE jitted program whose stage parallelism comes from
+the ``pipe`` mesh axis (runtime/pipe/engine.py + spmd.py), so the module
+here is the *structure* — specs, partitioning, parameter building, tied
+groups — not a torch container.
+"""
+
+from deepspeed_tpu.pipe.module import (  # noqa: F401
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+)

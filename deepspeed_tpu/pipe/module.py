@@ -1,0 +1,208 @@
+"""PipelineModule / LayerSpec / TiedLayerSpec.
+
+Reference contract (runtime/pipe/module.py):
+- ``LayerSpec(cls, *args, **kwargs)`` defers construction so only the
+  owning stage materializes a layer (module.py:29 — there it avoids
+  allocating CUDA memory on other ranks; here it bounds host memory and
+  lets each stage init only its params).
+- ``TiedLayerSpec(name, cls, ...)`` declares layers sharing one weight
+  group (module.py:76); the reference all-reduces tied grads across stages
+  (module.py:406) — under SPMD the tie is the SAME pytree leaf referenced
+  by both layers, so gradient summing falls out of autodiff.
+- ``partition_method``: "uniform" (equal layer counts), "parameters"
+  (balance trainable-parameter counts), or "type:REGEX" (balance layers
+  whose class name matches the regex) — module.py:353-398.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer: ``build()`` constructs the (flax) module."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self, log: bool = False):
+        if log:
+            logger.info(f"building {self.typename.__name__}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer sharing its parameters with every other TiedLayerSpec of the
+    same ``key`` (reference module.py:76; e.g. embedding / lm-head tying
+    across the first and last stage)."""
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+class PipelineModule:
+    """Structure of a pipeline-parallel model: specs + stage partition +
+    tied groups + per-stage parameter building.
+
+    ``forward_fn(module, params, x)`` defaults to flax
+    ``module.apply({"params": params}, x)``.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int,
+                 partition_method: str = "parameters",
+                 loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False, base_seed: int = 1234,
+                 probe_input=None):
+        """``probe_input``: a sample input for the first layer, used to
+        weigh layers by parameter count for ``partition_method=
+        "parameters"`` (layer i+1 is probed with layer i's eval_shape
+        output). Without it the probe falls back to a [1, 8] float input."""
+        assert num_stages >= 1
+        self.layer_specs = list(layers)
+        for i, l in enumerate(self.layer_specs):
+            assert isinstance(l, LayerSpec), \
+                f"layer {i} is not a LayerSpec (got {type(l)})"
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self.base_seed = base_seed
+        self.seed_layers = seed_layers
+        self.probe_input = probe_input
+        self._modules = [spec.build() for spec in self.layer_specs]
+        self.parts = self._partition_layers()
+
+    # --- partitioning (reference module.py:353-398) ------------------------
+    def _layer_param_counts(self) -> List[float]:
+        """Per-layer parameter counts via chained eval_shape: each layer is
+        probed with the previous layer's abstract output, so embeddings
+        (int inputs) and [B, S, D] blocks weigh correctly when
+        ``probe_input`` is given."""
+        x = jnp.zeros((1, 8), jnp.float32) if self.probe_input is None \
+            else jnp.asarray(self.probe_input)
+        weights: List[float] = []
+        for i, mod in enumerate(self._modules):
+            try:
+                shapes, out = jax.eval_shape(
+                    lambda r, x_: (mod.init(r, x_),
+                                   mod.apply(mod.init(r, x_), x_)),
+                    jax.random.PRNGKey(0), x)
+                weights.append(float(sum(
+                    int(np.prod(s.shape)) for s in
+                    jax.tree_util.tree_leaves(shapes))))
+                x = out
+            except Exception as e:
+                logger.warning(
+                    f"PipelineModule: parameter probe failed for layer {i} "
+                    f"({self.layer_specs[i].name}): {type(e).__name__}: {e} "
+                    f"— weighing it as 1 (pass probe_input= for accurate "
+                    f"'parameters' partitioning)")
+                weights.append(1.0)
+        return weights
+
+    def _partition_layers(self) -> List[int]:
+        n = len(self.layer_specs)
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return partition_uniform(n, self.num_stages)
+        if method == "parameters":
+            return partition_balanced(self._layer_param_counts(),
+                                      self.num_stages)
+        if method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            weights = [1.0 if re.search(pat, spec.name, re.IGNORECASE)
+                       else 0.0 for spec in self.layer_specs]
+            if sum(weights) == 0:
+                raise ValueError(
+                    f"partition_method {self.partition_method!r} matched no "
+                    f"layers ({[s.name for s in self.layer_specs]})")
+            return partition_balanced(weights, self.num_stages)
+        raise NotImplementedError(
+            f"partition_method {self.partition_method!r}")
+
+    def stage_owner(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def stage_layers(self, stage_id: int) -> List[Any]:
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return self._modules[lo:hi]
+
+    # --- tied groups -------------------------------------------------------
+    def tied_keys(self) -> List[str]:
+        seen = []
+        for spec in self.layer_specs:
+            if isinstance(spec, TiedLayerSpec) and spec.key not in seen:
+                seen.append(spec.key)
+        return seen
+
+    def tied_stages(self, key: str) -> List[int]:
+        """Stages owning a layer of this tied group (reference
+        tied_comms, module.py:406)."""
+        return sorted({
+            self.stage_owner(i) for i, s in enumerate(self.layer_specs)
+            if isinstance(s, TiedLayerSpec) and s.key == key})
+
+    # --- parameter building ------------------------------------------------
+    def init_params(self, rng: jax.Array, sample_input,
+                    stage_id: Optional[int] = None) -> Dict[str, Any]:
+        """Init params for all layers (or one stage's slice). Tied groups
+        materialize ONE param subtree under ``tied/<key>`` shared by every
+        member layer; member slots hold the string marker ``"tied:<key>"``.
+        """
+        params: Dict[str, Any] = {}
+        tied: Dict[str, Any] = {}
+        x = jnp.asarray(sample_input)
+        lo, hi = (0, len(self._modules)) if stage_id is None else \
+            (self.parts[stage_id], self.parts[stage_id + 1])
+        for i in range(lo, hi):
+            spec, mod = self.layer_specs[i], self._modules[i]
+            if self.seed_layers:
+                rng = jax.random.PRNGKey(self.base_seed + i)
+            rng, sub = jax.random.split(rng)
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = mod.init(sub, x)["params"]
+                params[f"layer_{i}"] = f"tied:{spec.key}"
+            else:
+                params[f"layer_{i}"] = mod.init(sub, x)["params"]
+            x = self._apply_one(i, params, tied, x)
+        if tied:
+            params["tied"] = tied
+        return params
+
+    def _apply_one(self, i: int, params, tied, x):
+        spec, mod = self.layer_specs[i], self._modules[i]
+        p = params[f"layer_{i}"]
+        if isinstance(p, str) and p.startswith("tied:"):
+            p = tied[p.split(":", 1)[1]]
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return spec.forward_fn(mod, p, x)
+        return mod.apply({"params": p}, x)
+
+    def apply(self, params: Dict[str, Any], x,
+              stage_id: Optional[int] = None):
+        """Sequential forward over all layers (or one stage's slice) —
+        correctness surface; pipelined execution is runtime/pipe/."""
+        tied = params.get("tied", {})
+        lo, hi = (0, len(self._modules)) if stage_id is None else \
+            (self.parts[stage_id], self.parts[stage_id + 1])
+        for i in range(lo, hi):
+            x = self._apply_one(i, params, tied, x)
+        return x

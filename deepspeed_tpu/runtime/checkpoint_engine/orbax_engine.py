@@ -22,12 +22,23 @@ LATEST_FILE = "latest"
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
-    def save(self, save_dir: str, tag: str, state: Dict[str, Any],
-             meta: Dict[str, Any], save_latest: bool = True) -> None:
-        path = os.path.abspath(os.path.join(save_dir, tag))
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "state"), state, force=True)
-        ckptr.wait_until_finished()
+    """``async_save=True`` is the Nebula analogue
+    (nebula_checkpoint_engine.py: persist in the background, training
+    continues): ``save`` returns after scheduling the write; call
+    ``wait()`` (or start another save/load) to block until durable. The
+    ``latest`` tag is only written once the snapshot is finished."""
+
+    def __init__(self, async_save: bool = False):
+        self.async_save = async_save
+        self._ckptr = ocp.StandardCheckpointer()
+        self._pending = None      # (save_dir, path, tag, meta, save_latest)
+
+    def _finalize(self):
+        if self._pending is None:
+            return
+        self._ckptr.wait_until_finished()
+        save_dir, path, tag, meta, save_latest = self._pending
+        self._pending = None
         if jax.process_index() == 0:
             with open(os.path.join(path, "meta.json"), "w") as f:
                 json.dump(meta, f)
@@ -35,14 +46,33 @@ class OrbaxCheckpointEngine(CheckpointEngine):
                 with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                     f.write(tag)
 
+    def wait(self) -> None:
+        """Block until the scheduled async save is durable (the reference's
+        commit() barrier, checkpoint_engine.py:9)."""
+        self._finalize()
+
+    def commit(self, tag: str = "") -> bool:
+        self._finalize()
+        return True
+
+    def save(self, save_dir: str, tag: str, state: Dict[str, Any],
+             meta: Dict[str, Any], save_latest: bool = True) -> None:
+        self._finalize()          # at most one in-flight snapshot
+        path = os.path.abspath(os.path.join(save_dir, tag))
+        self._ckptr.save(os.path.join(path, "state"), state, force=True)
+        self._pending = (save_dir, path, tag, meta, save_latest)
+        if not self.async_save:
+            self._finalize()
+
     def load(self, load_dir: str, tag: Optional[str],
              template: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        self._finalize()          # a pending async save must land first
         if tag is None:
             latest_path = os.path.join(load_dir, LATEST_FILE)
             with open(latest_path) as f:
                 tag = f.read().strip()
         path = os.path.abspath(os.path.join(load_dir, tag))
-        ckptr = ocp.StandardCheckpointer()
+        ckptr = self._ckptr
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
             if isinstance(x, jax.Array) else x,

@@ -185,8 +185,11 @@ class CheckpointConfig(DeepSpeedConfigModel):
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = {}
-    # TPU-specific: async orbax-style checkpointing
-    async_save: bool = True
+    # TPU-specific: async orbax-style checkpointing. Opt-in (the reference's
+    # default engine is synchronous; Nebula async is opt-in the same way) —
+    # an async save is only durable after checkpoint_engine.wait() or the
+    # next save/load on the SAME engine.
+    async_save: bool = False
 
 
 class DataTypeConfig(DeepSpeedConfigModel):

@@ -219,6 +219,22 @@ class DeepSpeedEngine:
         self._grad_acc = None
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
 
+        # legacy curriculum learning (reference engine.py:1702-1705 +
+        # data_pipeline/curriculum_scheduler.py): difficulty = seqlen
+        self.curriculum_scheduler = None
+        _cl = self._config.curriculum_learning_legacy
+        if isinstance(_cl, dict) and _cl.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+                import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(_cl)
+
+        # flops profiler (reference profiling/flops_profiler; engine hooks
+        # at engine.py:1692,2070-2081): print a cost-analysis report once at
+        # profile_step
+        self._flops_profiler_cfg = self._config.flops_profiler
+        self._flops_profiled = False
+
         self._build_step_functions()
         log_dist(
             f"DeepSpeedEngine initialized: zero_stage={self.zero_optimization_stage()}, "
@@ -427,6 +443,7 @@ class DeepSpeedEngine:
         batch (micro*gas*dp) or already (gas, micro*dp, ...)."""
         gas = self.gradient_accumulation_steps()
         micro_global = self.train_micro_batch_size_per_gpu() * self.dp_world_size
+        batch = self._apply_curriculum(batch)
 
         def to_gas_layout(x):
             x = np.asarray(x) if not isinstance(x, jax.Array) else x
@@ -447,6 +464,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
+        self._maybe_profile_flops(batch)
         with self._ctx():
             self.params, self.opt_state, self.scaler_state, loss, finite = \
                 self._jit_train_batch(self.params, self.opt_state,
@@ -457,7 +475,7 @@ class DeepSpeedEngine:
                 mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
                       for k, v in batch.items() if k != STEP_KEY}
             self._misc_runtime_step(mb, finite)
-        self._after_step(finite)
+        self._after_step(finite, loss=loss)
         self.micro_steps += gas
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
@@ -546,7 +564,56 @@ class DeepSpeedEngine:
                 self.params = self.quantizer.quantize(
                     self.params, overflow=not bool(finite))
 
-    def _after_step(self, finite):
+    def curriculum_enabled_legacy(self) -> bool:
+        """reference engine.py curriculum_enabled_legacy."""
+        return self.curriculum_scheduler is not None
+
+    @property
+    def curriculum_seqlen(self) -> Optional[int]:
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_current_difficulty()
+
+    def _apply_curriculum(self, batch):
+        """Legacy curriculum learning: truncate sequences to the scheduled
+        difficulty (reference engine.py:1702-1705 — seqlen is the difficulty
+        metric; the reference's Megatron fork does the same truncation)."""
+        if self.curriculum_scheduler is None:
+            return batch
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+
+        def trunc(x):
+            x = jnp.asarray(x) if not isinstance(x, (jax.Array, np.ndarray)) \
+                else x
+            s_axis = x.ndim - 1
+            if x.ndim >= 2 and x.shape[s_axis] > seqlen:
+                return x[..., :seqlen]
+            return x
+
+        return {k: trunc(v) for k, v in batch.items()}
+
+    def _maybe_profile_flops(self, batch):
+        """One-shot flops report at profile_step (reference engine.py:1692)."""
+        cfg = self._flops_profiler_cfg
+        if (not cfg.enabled or self._flops_profiled
+                or self.global_steps + 1 < cfg.profile_step):
+            return
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+        self._flops_profiled = True
+        prof = FlopsProfiler(self.loss_fn, self.params)
+        mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
+              for k, v in batch.items()}
+        report = prof.profile(self.loss_fn, self.params, mb, time_it=False)
+        text = prof.print_model_profile(params=self.params,
+                                        detailed=cfg.detailed)
+        if cfg.output_file:
+            with open(cfg.output_file, "w") as f:
+                f.write(text or "")
+        return report
+
+    def _after_step(self, finite, loss=None):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         if self.compression_scheduler is not None:
@@ -563,11 +630,24 @@ class DeepSpeedEngine:
                 log_dist(f"[loss scaling] overflow, skipping step "
                          f"(scale now {float(self.scaler_state.scale)})", ranks=[0])
         self.tput_timer.stop(global_step=True)
-        if self.monitor is not None and self.global_steps % self._config.steps_per_print == 0:
-            lr = self.get_lr()[0]
-            self.monitor.write_events([
-                ("Train/Samples/lr", lr, self.global_samples),
-            ])
+        if (self.monitor is not None
+                and self.global_steps % self._config.steps_per_print == 0):
+            # the reference's event contract (SURVEY §8.6; engine.py:
+            # 1826-1834, 2045-2067). Emitted at steps_per_print boundaries:
+            # float(loss) is a device sync, and syncing every step would
+            # serialize the async dispatch the fused train program relies on.
+            events = []
+            if loss is not None:
+                self.losses = float(loss)
+                events.append(("Train/Samples/train_loss", self.losses,
+                               self.global_samples))
+            events.append(("Train/Samples/lr", self.get_lr()[0],
+                           self.global_samples))
+            if self.fp16_enabled and self._dynamic_scale:
+                events.append(("Train/Samples/loss_scale",
+                               float(self.scaler_state.scale),
+                               self.global_samples))
+            self.monitor.write_events(events)
 
     def eval_loss(self, batch: Dict[str, Any]):
         """Forward-only loss (no gradient program)."""
@@ -591,13 +671,23 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(gather, self.params)
 
     # --- checkpointing --------------------------------------------------------
+    @property
+    def checkpoint_engine(self):
+        """One engine instance per training engine so async saves
+        (checkpoint.async_save, the Nebula analogue) overlap training and
+        are fenced before the next save/load."""
+        if not hasattr(self, "_ckpt_engine"):
+            from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import (
+                OrbaxCheckpointEngine,
+            )
+
+            self._ckpt_engine = OrbaxCheckpointEngine(
+                async_save=self._config.checkpoint_config.async_save)
+        return self._ckpt_engine
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None, save_latest: bool = True):
-        from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import (
-            OrbaxCheckpointEngine,
-        )
-
-        engine = OrbaxCheckpointEngine()
+        engine = self.checkpoint_engine
         tag = tag or f"global_step{self.global_steps}"
         state = {
             "params": self.params,
@@ -617,11 +707,7 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True):
-        from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import (
-            OrbaxCheckpointEngine,
-        )
-
-        engine = OrbaxCheckpointEngine()
+        engine = self.checkpoint_engine
         template = {
             "params": self.params,
             "opt_state": self.opt_state,

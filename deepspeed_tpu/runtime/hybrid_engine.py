@@ -10,6 +10,7 @@ gathers), with optional LoRA fuse/unfuse around generation and a retained
 KV workspace between rollouts (the reference's ``retake_inference_cache``).
 """
 
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 import jax
@@ -30,7 +31,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._lora_fused = False
         self._decode_fn = None
         self._kv_caches = None
-        self._gen_cache: Dict[Any, Any] = {}
+        self._gen_cache: "OrderedDict[Any, Any]" = OrderedDict()
         self._in_eval = False
         self.generate_time = 0.0
         self.latency_timer = Timer("generate")
@@ -68,7 +69,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._decoder = decoder
         self._kv_caches = init_kv_caches(self.model_cfg, batch_size, max_len,
                                          self.compute_dtype)
-        self._gen_cache = {}
+        self._gen_cache = OrderedDict()
         self._decode_fn = jax.jit(
             lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
             donate_argnums=(2,))
@@ -79,7 +80,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def release_inference_cache(self):
         self._kv_caches = None
         self._decode_fn = None
-        self._gen_cache = {}
+        self._gen_cache = OrderedDict()
 
     def reset_inference_cache(self):
         if self._kv_caches is not None:
@@ -93,10 +94,11 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                  eos_token_id: Optional[int] = None, *,
                  top_p: float = 1.0):
         """Rollout generation against the live (sharded, LoRA-fused) training
-        params — one fused prefill+decode program shared with the inference
-        engine (inference/engine.py build_generate_fn)."""
-        from deepspeed_tpu.inference.engine import InferenceEngine, \
-            build_generate_fn
+        params — one fused prefill+decode program and one compiled-program
+        cache policy shared with the inference engine
+        (inference/engine.py get_or_build_gen_fn)."""
+        from deepspeed_tpu.inference.engine import GEN_BUCKET, \
+            get_or_build_gen_fn
 
         was_training = not self._in_eval
         if was_training:
@@ -105,23 +107,19 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        bucket = InferenceEngine._GEN_BUCKET
-        cap = -(-max_new_tokens // bucket) * bucket
+        cap = -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
         self._ensure_decode(B, T + cap)
-        key = (B, T, cap)
-        if key not in self._gen_cache:
-            if len(self._gen_cache) >= InferenceEngine._GEN_CACHE_MAX:
-                self._gen_cache.pop(next(iter(self._gen_cache)))
-            decoder = self._decoder
-            self._gen_cache[key] = build_generate_fn(
-                lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
-                B, T, cap)
+        decoder = self._decoder
+        gen_fn, cap = get_or_build_gen_fn(
+            self._gen_cache,
+            lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
+            B, T, max_new_tokens)
         if rng is None:
             rng = jax.random.PRNGKey(self.global_steps)
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         with self._ctx():
-            tokens, self._kv_caches = self._gen_cache[key](
+            tokens, self._kv_caches = gen_fn(
                 self.params, input_ids, self._kv_caches, rng,
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(top_k, jnp.int32),

@@ -159,6 +159,23 @@ def test_top_k_top_p_masks():
     np.testing.assert_array_equal(mp1, np.asarray(peaked))
 
 
+def test_combined_top_k_top_p_semantics():
+    """top-p filters the top-k-renormalized distribution (HF sequential
+    semantics): probs [0.4,0.2,0.2,0.1,0.1], k=2, p=0.5 → only the argmax
+    survives (0.4/0.6 = 0.67 >= 0.5 already covers the nucleus)."""
+    from deepspeed_tpu.inference.sampling import sample_logits
+
+    probs = jnp.asarray([[0.4, 0.2, 0.2, 0.1, 0.1]])
+    logits = jnp.log(probs)
+    counts = set()
+    for seed in range(30):
+        tok = int(sample_logits(logits, jax.random.PRNGKey(seed),
+                                jnp.asarray(1.0), jnp.asarray(2),
+                                jnp.asarray(0.5))[0])
+        counts.add(tok)
+    assert counts == {0}, counts
+
+
 def test_int8_weight_only_inference():
     """Quantized engine: q-leaves replace large kernels and the forward stays
     close to the fp path (reference quant config, inference/config.py).

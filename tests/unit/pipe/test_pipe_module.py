@@ -1,0 +1,89 @@
+"""PipelineModule / LayerSpec / TiedLayerSpec surface
+(reference tests/unit/runtime/pipe/test_topology + pipe-module patterns)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.pipe import LayerSpec, PipelineModule, TiedLayerSpec
+
+
+class Dense(nn.Module):
+    features: int = 16
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features, name="d")(x)
+
+
+class Big(nn.Module):
+    features: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.features, name="d1")(x)
+        return nn.Dense(16, name="d2")(x)
+
+
+def _specs(n=4):
+    return [LayerSpec(Dense, 16) for _ in range(n)]
+
+
+def test_uniform_partition():
+    pm = PipelineModule(_specs(6), num_stages=2, partition_method="uniform")
+    assert pm.parts == [0, 3, 6]
+    assert pm.stage_owner(2) == 0 and pm.stage_owner(3) == 1
+    assert len(pm.stage_layers(0)) == 3
+
+
+def test_parameters_partition_balances_big_layers():
+    specs = [LayerSpec(Big), LayerSpec(Dense, 16), LayerSpec(Dense, 16),
+             LayerSpec(Dense, 16)]
+    pm = PipelineModule(specs, num_stages=2, partition_method="parameters")
+    # the Big layer dominates: stage 0 gets few layers, stage 1 the rest
+    assert pm.parts[1] <= 2
+
+
+def test_type_regex_partition():
+    specs = [LayerSpec(Dense, 16), LayerSpec(Big), LayerSpec(Big),
+             LayerSpec(Dense, 16)]
+    pm = PipelineModule(specs, num_stages=2, partition_method="type:Big")
+    # each stage gets one Big layer
+    owners = {pm.stage_owner(1), pm.stage_owner(2)}
+    assert owners == {0, 1}
+    with pytest.raises(ValueError):
+        PipelineModule(specs, num_stages=2, partition_method="type:NoSuch")
+
+
+def test_forward_matches_stagewise():
+    pm = PipelineModule(_specs(4), num_stages=2, partition_method="uniform")
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16)),
+                    jnp.float32)
+    params = pm.init_params(jax.random.PRNGKey(0), x)
+    full = pm.apply(params, x)
+    staged = pm.apply(params, x, stage_id=0)
+    staged = pm.apply(params, staged, stage_id=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(staged),
+                               rtol=1e-6)
+
+
+def test_tied_layers_share_parameters():
+    specs = [TiedLayerSpec("emb", Dense, 16), LayerSpec(Dense, 16),
+             TiedLayerSpec("emb", Dense, 16)]
+    pm = PipelineModule(specs, num_stages=3, partition_method="uniform")
+    assert pm.tied_keys() == ["emb"]
+    assert pm.tied_stages("emb") == [0, 2]
+    x = jnp.ones((2, 16))
+    params = pm.init_params(jax.random.PRNGKey(0), x)
+    assert params["layer_0"] == "tied:emb" and params["layer_2"] == "tied:emb"
+    assert "emb" in params["tied"]
+    out = pm.apply(params, x)
+    assert out.shape == (2, 16)
+    # gradient w.r.t. the tied group accumulates from BOTH member layers
+    def loss(p):
+        return jnp.sum(pm.apply(p, x) ** 2)
+
+    g = jax.grad(lambda tied: loss({**params, "tied": tied}))(params["tied"])
+    assert float(jnp.abs(g["emb"]["d"]["kernel"]).sum()) > 0

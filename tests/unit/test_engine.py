@@ -187,3 +187,98 @@ def test_lr_schedule_wired():
     engine.train_batch(batch)
     engine.train_batch(batch)
     assert engine.get_lr()[0] > lr0
+
+
+def test_curriculum_legacy_truncates_seqlen():
+    """Legacy curriculum learning (reference engine.py:1702): sequences are
+    truncated to the scheduled difficulty, growing over steps."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "curriculum_learning": {
+                    "enabled": True, "curriculum_type": "fixed_linear",
+                    "min_difficulty": 8, "max_difficulty": 16,
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 8}}},
+        sample_batch={"input_ids": np.zeros((8, 16), np.int32)})
+    assert engine.curriculum_enabled_legacy()
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, cfg.vocab_size, size=(8, 17))
+    batch = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+    engine.train_batch(batch)
+    assert engine.curriculum_seqlen == 8          # starts at min
+    for _ in range(4):
+        engine.train_batch(batch)
+    assert engine.curriculum_seqlen == 16         # reached max
+
+
+def test_monitor_train_loss_events(tmp_path):
+    """Engine emits the reference's Train/Samples/* events (SURVEY §8.6)."""
+    import csv
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 1,
+                "csv_monitor": {"enabled": True,
+                                "output_path": str(tmp_path),
+                                "job_name": "job"}},
+        sample_batch={"input_ids": np.zeros((8, 16), np.int32)})
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, cfg.vocab_size, size=(8, 17))
+    for _ in range(2):
+        engine.train_batch({"input_ids": t[:, :-1], "labels": t[:, 1:]})
+    files = list(tmp_path.rglob("*.csv"))
+    names = "".join(str(f) for f in files)
+    assert "train_loss" in names and "lr" in names
+
+
+def test_flops_profiler_engine_wiring(tmp_path, capsys):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    out = tmp_path / "flops.txt"
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1,
+                                   "output_file": str(out)}},
+        sample_batch={"input_ids": np.zeros((8, 16), np.int32)})
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, cfg.vocab_size, size=(8, 17))
+    engine.train_batch({"input_ids": t[:, :-1], "labels": t[:, 1:]})
+    assert out.exists() and "Flops Profiler" in out.read_text()
+
+
+def test_async_checkpoint_save(tmp_path):
+    """checkpoint.async_save (Nebula analogue): save returns before the
+    snapshot is durable; wait()/load fences it."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "checkpoint": {"async_save": True}},
+        sample_batch={"input_ids": np.zeros((8, 16), np.int32)})
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    engine.checkpoint_engine.wait()
+    assert (tmp_path / "t1" / "meta.json").exists()
+    assert (tmp_path / "latest").read_text() == "t1"
+    # roundtrip through load (which fences any pending save)
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+    engine.load_checkpoint(str(tmp_path), tag="t2")

@@ -35,12 +35,17 @@ GEN_BUCKET = 32         # max_new_tokens rounds up to this program capacity
 GEN_CACHE_MAX = 16      # compiled-program LRU bound
 
 
+def gen_capacity(max_new_tokens: int) -> int:
+    """Program/workspace capacity for a requested generation length."""
+    return -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
+
+
 def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
                         max_new_tokens: int):
     """Shared compiled-generation cache policy (used by InferenceEngine and
     the RLHF hybrid engine): capacity-bucketed keys, true LRU eviction.
     Returns ``(gen_fn, cap)``."""
-    cap = -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
+    cap = gen_capacity(max_new_tokens)
     key = (B, T, cap)
     if not isinstance(cache, OrderedDict):
         raise TypeError("gen cache must be an OrderedDict")
@@ -308,8 +313,7 @@ class InferenceEngine:
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        cap = -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
-        self._ensure_decode(B, T + cap)
+        self._ensure_decode(B, T + gen_capacity(max_new_tokens))
         decoder = self._decoder
 
         def apply_fn(params, tokens, caches, index):

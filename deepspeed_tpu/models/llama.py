@@ -39,7 +39,7 @@ class LlamaConfig:
     remat: bool = False
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = True
-    attention_impl: str = "xla"
+    attention_impl: str = "auto"   # flash kicks in at long seqlen
     tie_embeddings: bool = False
 
     @staticmethod
@@ -64,6 +64,10 @@ def _remat_policy(name: str):
         "dots_with_no_batch_dims_saveable":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         "everything_saveable": jax.checkpoint_policies.everything_saveable,
+        # save the per-layer attention outputs only (linear memory); the
+        # attention core is still recomputed for its own input gradients
+        "save_attn_out":
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
     }
     return policies.get(name, jax.checkpoint_policies.nothing_saveable)
 
@@ -78,8 +82,16 @@ class LlamaBlock(nn.Module):
         h = SelfAttention(
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
             use_rope=True, rope_base=cfg.rope_base, dtype=cfg.dtype,
-            attention_impl=cfg.attention_impl, name="attn",
+            attention_impl=cfg.attention_impl,
+            assume_causal_mask=True,   # LlamaModel passes the pure causal mask
+            name="attn",
         )(h, mask=mask, positions=positions)
+        # named so remat policies can target it (e.g. "save_attn_out"
+        # keeps the [B, S, H] attention outputs; note backward still
+        # recomputes attention internals for its own gradients, so this
+        # only spares the residual/MLP path — measure before choosing)
+        from jax.ad_checkpoint import checkpoint_name
+        h = checkpoint_name(h, "attn_out")
         x = x + h
         h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, name="post_attn_norm")(x)
         h = GatedMLP(intermediate_size=cfg.intermediate_size, dtype=cfg.dtype,

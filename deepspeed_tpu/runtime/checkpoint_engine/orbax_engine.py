@@ -32,6 +32,12 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self.async_save = async_save
         self._ckptr = ocp.StandardCheckpointer()
         self._pending = None      # (save_dir, path, tag, meta, save_latest)
+        if async_save:
+            # a process exiting right after its last save must still land
+            # that snapshot (meta.json + latest tag)
+            import atexit
+
+            atexit.register(self._finalize)
 
     def _finalize(self):
         if self._pending is None:

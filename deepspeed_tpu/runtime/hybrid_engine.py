@@ -97,7 +97,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         params — one fused prefill+decode program and one compiled-program
         cache policy shared with the inference engine
         (inference/engine.py get_or_build_gen_fn)."""
-        from deepspeed_tpu.inference.engine import GEN_BUCKET, \
+        from deepspeed_tpu.inference.engine import gen_capacity, \
             get_or_build_gen_fn
 
         was_training = not self._in_eval
@@ -107,8 +107,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
-        cap = -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
-        self._ensure_decode(B, T + cap)
+        self._ensure_decode(B, T + gen_capacity(max_new_tokens))
         decoder = self._decoder
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache,

@@ -96,3 +96,80 @@ class PartitionedOptimizerSwapper:
 
     def close(self):
         self.swapper.close()
+
+
+class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
+    """Double-buffered variant (reference pipelined_optimizer_swapper.py):
+    while sub-group i's update runs on device, sub-group i+1's state is
+    already being read from disk and i-1's updated state is being written —
+    the AIO thread pool overlaps both directions with compute.
+
+    Usage per step over an ordered list of sub-group names::
+
+        sw.prefetch(names[0])
+        for i, name in enumerate(names):
+            state = sw.acquire(name)                    # waits if needed
+            if i + 1 < len(names):
+                sw.prefetch(names[i + 1])               # overlap next read
+            state = update(state)                       # device compute
+            sw.release(name, state)                     # async write-back
+        sw.flush()
+    """
+
+    def __init__(self, swap_dir: str, **aio_kwargs):
+        super().__init__(swap_dir, **aio_kwargs)
+        # reads get their OWN queue: AsyncIOHandle.wait() is wait-ALL, so
+        # sharing one queue would make acquire() block on the previous
+        # release()'s writes (serializing the overlap this class exists for)
+        # and misattribute write failures to reads
+        self._read_aio = AsyncIOHandle(
+            aio_kwargs.get("block_size", 1 << 20),
+            aio_kwargs.get("queue_depth", 8),
+            aio_kwargs.get("thread_count", 4))
+        self._prefetched: Dict[str, Any] = {}
+
+    def prefetch(self, name: str) -> None:
+        """Submit the reads for ``name`` without blocking on them."""
+        if name in self._prefetched:
+            return
+        sw = self.swapper
+        assert name in sw._meta, f"nothing swapped out under {name}"
+        treedef, shapes = sw._meta[name]
+        buffers = [np.empty(shape, dtype) for shape, dtype in shapes]
+        for i, buf in enumerate(buffers):
+            self._read_aio.pread(sw._leaf_path(name, i), buf)
+        self._prefetched[name] = (treedef, buffers)
+
+    def acquire(self, name: str, sharding=None) -> Any:
+        """Finish the prefetched reads (or read synchronously) and return
+        the device-resident state."""
+        if name not in self._prefetched:
+            return self.fetch(name, sharding=sharding)
+        treedef, buffers = self._prefetched.pop(name)
+        failures = self._read_aio.wait()
+        if failures:
+            raise IOError(f"acquire({name}): {failures} read failures")
+        arrs = [jax.device_put(b, sharding) for b in buffers]
+        return jax.tree_util.tree_unflatten(treedef, arrs)
+
+    def release(self, name: str, opt_state: Any) -> None:
+        """Write the updated state back without blocking."""
+        # a new write invalidates any not-yet-acquired prefetch of this name
+        self._prefetched.pop(name, None)
+        self.swapper.swap_out(name, opt_state, blocking=False)
+
+    def offload(self, name: str, opt_state: Any) -> None:
+        self._prefetched.pop(name, None)
+        super().offload(name, opt_state)
+
+    def flush(self) -> None:
+        """Barrier for all outstanding I/O; drops unconsumed prefetches so
+        a later prefetch rereads current on-disk state."""
+        self._prefetched.clear()
+        failures = self.swapper.aio.wait() + self._read_aio.wait()
+        if failures:
+            raise IOError(f"flush: {failures} I/O failures")
+
+    def close(self):
+        self._read_aio.close()
+        super().close()

@@ -1,4 +1,11 @@
+from deepspeed_tpu.models.diffusion import (
+    DiffusersAttention, DiffusersTransformerBlock, Diffusers2DTransformerConfig,
+    DiffusionModelWrapper, DSUNet, DSVAE, SpatialTransformer2D,
+)
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel, loss_fn
 
-__all__ = ["GPT2Config", "GPT2Model", "LlamaConfig", "LlamaModel", "loss_fn"]
+__all__ = ["GPT2Config", "GPT2Model", "LlamaConfig", "LlamaModel", "loss_fn",
+           "DiffusersAttention", "DiffusersTransformerBlock",
+           "Diffusers2DTransformerConfig", "DiffusionModelWrapper",
+           "DSUNet", "DSVAE", "SpatialTransformer2D"]

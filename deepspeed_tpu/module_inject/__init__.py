@@ -16,5 +16,6 @@ from deepspeed_tpu.module_inject.policy import (  # noqa: F401
     TransformerPolicy, policy_for, replace_policies,
 )
 from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
-    InjectedModel, convert_hf_model, replace_transformer_layer,
+    InjectedModel, convert_hf_model, generic_injection,
+    replace_transformer_layer,
 )

@@ -97,3 +97,56 @@ def replace_transformer_layer(orig_layer_impl=None, model=None, config=None,
                               checkpoint_dict=None, model_config=None):
     """Name-parity wrapper over :func:`convert_hf_model`."""
     return convert_hf_model(model=model, hf_config=model_config)
+
+
+def generic_injection(model=None, state_dict=None, apply_fn=None, params=None,
+                      fp16: bool = True, enable_cuda_graph: bool = True,
+                      num_heads: Optional[int] = None, head_dim: int = 64):
+    """Diffusers (stable-diffusion) injection — reference
+    ``generic_injection`` (module_inject/replace_module.py:187-280), which
+    swaps every diffusers ``CrossAttention``/``BasicTransformerBlock`` for
+    the fused CUDA modules and wraps UNet/VAE in CUDA-graph capture.
+
+    TPU forms (conv stacks stay flax; XLA fuses the spatial bias ops):
+
+    - ``generic_injection(apply_fn=..., params=...)`` → a jitted bf16
+      :class:`~deepspeed_tpu.models.diffusion.DiffusionModelWrapper`
+      (jit cache ≈ CUDA-graph cache).
+    - ``generic_injection(model=...)`` / ``(state_dict=...)`` with a torch
+      diffusers UNet (or its state_dict) → scans for every
+      ``BasicTransformerBlock`` subtree and returns
+      ``{prefix: (Diffusers2DTransformerConfig, flax_params)}`` ready to run
+      under :class:`~deepspeed_tpu.models.diffusion.DiffusersTransformerBlock`.
+    """
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.diffusion import (
+        DiffusionModelWrapper, block_config_from_state_dict,
+        convert_diffusers_block,
+    )
+
+    dtype = jnp.bfloat16 if fp16 else jnp.float32
+    if apply_fn is not None:
+        if params is None:
+            raise ValueError("generic_injection(apply_fn=…) needs params=…")
+        return DiffusionModelWrapper(apply_fn, params, dtype=dtype)
+
+    if state_dict is None:
+        if model is None:
+            raise ValueError("need model=, state_dict=, or apply_fn=+params=")
+        state_dict = model.state_dict()
+    state_dict = dict(state_dict)
+    marker = "attn1.to_q.weight"
+    blocks = {}
+    for key in sorted(state_dict):
+        if key.endswith(marker):
+            prefix = key[:-len(marker)]
+            cfg = block_config_from_state_dict(state_dict, prefix,
+                                               num_heads=num_heads,
+                                               head_dim=head_dim, dtype=dtype)
+            blocks[prefix.rstrip(".")] = (
+                cfg, convert_diffusers_block(state_dict, prefix))
+    if not blocks:
+        logger.warning("generic_injection: no BasicTransformerBlock subtrees "
+                       "found in state_dict")
+    return blocks

@@ -1,0 +1,55 @@
+"""Spatial (diffusers) fused bias ops — TPU equivalent of the reference's
+``csrc/spatial`` kernel group (csrc/spatial/csrc/pt_binding.cpp:109-111,
+opt_bias_add.cu) and its python binding ``ops/transformer/inference/bias_add.py``.
+
+The reference ships three CUDA kernels used inside injected UNet/VAE blocks:
+
+- ``nhwc_bias_add(activation, bias)``            → act + bias
+- ``nhwc_bias_add_add(activation, bias, other)`` → act + bias + other
+- ``nhwc_bias_add_bias_add(act, bias, other, other_bias)``
+                                                 → (act + bias) + (other + other_bias)
+
+all over NHWC activations with a per-channel bias. On TPU these are pure
+element-wise ops that XLA fuses into the producing conv/matmul, so the
+"kernel" is the expression itself; the functions exist to keep the op-level
+API (and op-level numeric tests) of the reference. Inputs may be NHWC
+``[B, H, W, C]`` or flattened ``[B, HW, C]`` / ``[B, C]`` — the bias
+broadcasts over all leading dims.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def _check(act, bias):
+    if bias is not None and act.shape[-1] != bias.shape[-1]:
+        raise ValueError(
+            f"channel mismatch: activation C={act.shape[-1]} vs "
+            f"bias C={bias.shape[-1]} (NHWC layout expected)")
+
+
+def nhwc_bias_add(activation: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    """act + bias (reference ``seq_unroll_bias_add``, pt_binding.cpp:109)."""
+    _check(activation, bias)
+    return activation + bias
+
+
+def nhwc_bias_add_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                      other: jnp.ndarray) -> jnp.ndarray:
+    """act + bias + other (reference ``seq_bias_add_add``, pt_binding.cpp:110)."""
+    _check(activation, bias)
+    return activation + bias + other
+
+
+def nhwc_bias_add_bias_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                           other: jnp.ndarray,
+                           other_bias: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """(act + bias) + (other + other_bias) (reference
+    ``seq_bias_add_bias_add``, pt_binding.cpp:111)."""
+    _check(activation, bias)
+    _check(other, other_bias)
+    out = activation + bias + other
+    if other_bias is not None:
+        out = out + other_bias
+    return out

@@ -27,9 +27,20 @@ class AsyncTensorSwapper:
         self.aio = AsyncIOHandle(block_size, queue_depth, thread_count)
         # name -> (treedef, [(shape, dtype), ...])
         self._meta: Dict[str, Tuple] = {}
+        # names with writes submitted but not yet waited on; the AIO thread
+        # pool does not order a queued read after a queued write of the same
+        # file, so reads of these names must drain writes first
+        self._pending_writes: set = set()
 
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.{i}.bin")
+
+    def _drain_writes_for(self, name: str) -> None:
+        if name in self._pending_writes:
+            failures = self.wait()
+            if failures:
+                raise IOError(f"drain before read of {name}: "
+                              f"{failures} write failures")
 
     def swap_out(self, name: str, tree: Any, blocking: bool = True) -> None:
         """Write a pytree to disk (async submit; optional wait)."""
@@ -41,27 +52,40 @@ class AsyncTensorSwapper:
             self.aio.pwrite(self._leaf_path(name, i), arr)
         self._meta[name] = (treedef, shapes)
         if blocking:
-            failures = self.aio.wait()
+            failures = self.wait()
             if failures:
                 raise IOError(f"swap_out({name}): {failures} write failures")
+        else:
+            self._pending_writes.add(name)
+
+    def submit_reads(self, name: str, aio) -> Tuple[Any, list]:
+        """Allocate buffers for ``name`` and submit its preads on ``aio``
+        (shared by blocking swap_in and pipelined prefetch). Drains any
+        in-flight write of the same name first."""
+        assert name in self._meta, f"nothing swapped out under {name}"
+        self._drain_writes_for(name)
+        treedef, shapes = self._meta[name]
+        buffers = [np.empty(shape, dtype) for shape, dtype in shapes]
+        for i, buf in enumerate(buffers):
+            aio.pread(self._leaf_path(name, i), buf)
+        return treedef, buffers
 
     def swap_in(self, name: str, device_put: bool = True,
                 sharding=None) -> Any:
         """Read a previously swapped pytree back (blocking)."""
-        assert name in self._meta, f"nothing swapped out under {name}"
-        treedef, shapes = self._meta[name]
-        buffers = [np.empty(shape, dtype) for shape, dtype in shapes]
-        for i, buf in enumerate(buffers):
-            self.aio.pread(self._leaf_path(name, i), buf)
-        failures = self.aio.wait()
+        treedef, buffers = self.submit_reads(name, self.aio)
+        failures = self.wait()
         if failures:
             raise IOError(f"swap_in({name}): {failures} read failures")
         if device_put:
             buffers = [jax.device_put(b, sharding) for b in buffers]
         return jax.tree_util.tree_unflatten(treedef, buffers)
 
-    def wait(self) -> None:
-        self.aio.wait()
+    def wait(self) -> int:
+        """Wait-all on the queue; returns the failure count."""
+        failures = self.aio.wait()
+        self._pending_writes.clear()
+        return failures
 
     def remove(self, name: str) -> None:
         if name in self._meta:
@@ -129,16 +153,13 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
         self._prefetched: Dict[str, Any] = {}
 
     def prefetch(self, name: str) -> None:
-        """Submit the reads for ``name`` without blocking on them."""
+        """Submit the reads for ``name`` without blocking on them.
+        ``submit_reads`` drains any in-flight ``release()`` write of the same
+        name first, so release→prefetch→acquire returns the new state."""
         if name in self._prefetched:
             return
-        sw = self.swapper
-        assert name in sw._meta, f"nothing swapped out under {name}"
-        treedef, shapes = sw._meta[name]
-        buffers = [np.empty(shape, dtype) for shape, dtype in shapes]
-        for i, buf in enumerate(buffers):
-            self._read_aio.pread(sw._leaf_path(name, i), buf)
-        self._prefetched[name] = (treedef, buffers)
+        self._prefetched[name] = self.swapper.submit_reads(name,
+                                                           self._read_aio)
 
     def acquire(self, name: str, sharding=None) -> Any:
         """Finish the prefetched reads (or read synchronously) and return
@@ -166,7 +187,7 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
         """Barrier for all outstanding I/O; drops unconsumed prefetches so
         a later prefetch rereads current on-disk state."""
         self._prefetched.clear()
-        failures = self.swapper.aio.wait() + self._read_aio.wait()
+        failures = self.swapper.wait() + self._read_aio.wait()
         if failures:
             raise IOError(f"flush: {failures} I/O failures")
 

@@ -155,3 +155,23 @@ def test_pipelined_optimizer_swapper_roundtrip(tmp_path):
         for k in ("mu", "nu"):
             np.testing.assert_allclose(np.asarray(back[k]), updated[name][k])
     sw.close()
+
+
+def test_pipelined_swapper_release_then_prefetch(tmp_path):
+    """release() submits async writes; a prefetch of the SAME name must not
+    race them (the AIO pool does not order reads after queued writes of the
+    same file) — acquire must observe the released state."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import (
+        PipelinedOptimizerSwapper,
+    )
+
+    sw = PipelinedOptimizerSwapper(str(tmp_path))
+    big = jnp.arange(1 << 16, dtype=jnp.float32)
+    sw.offload("g0", {"s": big})
+    state = sw.acquire("g0")
+    state = jax.tree_util.tree_map(lambda x: x + 1.0, state)
+    sw.release("g0", state)          # async write in flight
+    sw.prefetch("g0")                # must drain the write first
+    back = sw.acquire("g0")
+    np.testing.assert_allclose(np.asarray(back["s"]), np.asarray(big) + 1.0)
+    sw.close()

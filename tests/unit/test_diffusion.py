@@ -1,0 +1,149 @@
+"""Diffusers pillar tests (reference tests/unit/ops/spatial/ +
+inference diffusers coverage): spatial bias ops vs expressions, the
+DiffusersTransformerBlock vs a numpy BasicTransformerBlock reference on a
+converted diffusers-style state_dict, and the generic_injection surface."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.diffusion import (
+    DiffusersTransformerBlock, SpatialTransformer2D,
+    block_config_from_state_dict, convert_diffusers_block,
+)
+from deepspeed_tpu.module_inject import generic_injection
+from deepspeed_tpu.ops import spatial
+
+
+def test_spatial_bias_ops():
+    rng = np.random.RandomState(0)
+    act = rng.randn(2, 4, 4, 8).astype(np.float32)
+    bias = rng.randn(8).astype(np.float32)
+    other = rng.randn(2, 4, 4, 8).astype(np.float32)
+    ob = rng.randn(8).astype(np.float32)
+    np.testing.assert_allclose(spatial.nhwc_bias_add(act, bias), act + bias,
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(spatial.nhwc_bias_add_add(act, bias, other),
+                               act + bias + other, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        spatial.nhwc_bias_add_bias_add(act, bias, other, ob),
+        (act + bias) + (other + ob), rtol=1e-6, atol=1e-6)
+    with pytest.raises(ValueError):
+        spatial.nhwc_bias_add(act, np.zeros(4, np.float32))
+
+
+def _make_block_sd(rng, hidden=32, ctx=24):
+    def w(*shape):
+        return (rng.randn(*shape) * 0.05).astype(np.float32)
+
+    sd = {}
+    for n in ("norm1", "norm2", "norm3"):
+        sd[f"{n}.weight"] = 1.0 + 0.1 * w(hidden)
+        sd[f"{n}.bias"] = 0.1 * w(hidden)
+    for proj in ("to_q", "to_k", "to_v"):
+        sd[f"attn1.{proj}.weight"] = w(hidden, hidden)
+    sd["attn1.to_out.0.weight"] = w(hidden, hidden)
+    sd["attn1.to_out.0.bias"] = w(hidden)
+    sd["attn2.to_q.weight"] = w(hidden, hidden)
+    sd["attn2.to_k.weight"] = w(hidden, ctx)
+    sd["attn2.to_v.weight"] = w(hidden, ctx)
+    sd["attn2.to_out.0.weight"] = w(hidden, hidden)
+    sd["attn2.to_out.0.bias"] = w(hidden)
+    sd["ff.net.0.proj.weight"] = w(8 * hidden, hidden)
+    sd["ff.net.0.proj.bias"] = w(8 * hidden)
+    sd["ff.net.2.weight"] = w(hidden, 4 * hidden)
+    sd["ff.net.2.bias"] = w(hidden)
+    return sd
+
+
+def _np_ln(x, g, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * g + b
+
+
+def _np_attn(x, ctx, sd, p, heads):
+    q = x @ sd[f"{p}.to_q.weight"].T
+    k = ctx @ sd[f"{p}.to_k.weight"].T
+    v = ctx @ sd[f"{p}.to_v.weight"].T
+    b, s, d = q.shape
+    hd = d // heads
+
+    def split(t):
+        return t.reshape(b, -1, heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = split(q), split(k), split(v)
+    w = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    w = np.exp(w - w.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    o = (w @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    return o @ sd[f"{p}.to_out.0.weight"].T + sd[f"{p}.to_out.0.bias"]
+
+
+def _np_gelu(x):
+    import math
+
+    erf = np.vectorize(math.erf)
+    return 0.5 * x * (1.0 + erf(x / np.sqrt(2.0)))
+
+
+def _np_block(x, ctx, sd, heads):
+    h = _np_ln(x, sd["norm1.weight"], sd["norm1.bias"])
+    x = x + _np_attn(h, h, sd, "attn1", heads)
+    h = _np_ln(x, sd["norm2.weight"], sd["norm2.bias"])
+    x = x + _np_attn(h, ctx, sd, "attn2", heads)
+    h = _np_ln(x, sd["norm3.weight"], sd["norm3.bias"])
+    hg = h @ sd["ff.net.0.proj.weight"].T + sd["ff.net.0.proj.bias"]
+    hidden, gate = np.split(hg, 2, axis=-1)
+    h = hidden * _np_gelu(gate)
+    return x + h @ sd["ff.net.2.weight"].T + sd["ff.net.2.bias"]
+
+
+def test_transformer_block_matches_reference():
+    rng = np.random.RandomState(1)
+    sd = _make_block_sd(rng)
+    cfg = block_config_from_state_dict(sd, num_heads=4, dtype=jnp.float32)
+    assert cfg.hidden_size == 32 and cfg.context_dim == 24
+    params = convert_diffusers_block(sd)
+    x = rng.randn(2, 10, 32).astype(np.float32)
+    ctx = rng.randn(2, 7, 24).astype(np.float32)
+    got = DiffusersTransformerBlock(cfg).apply({"params": params},
+                                               jnp.asarray(x),
+                                               jnp.asarray(ctx))
+    want = _np_block(x, ctx, sd, heads=4)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-3)
+
+
+def test_generic_injection_scans_state_dict():
+    rng = np.random.RandomState(2)
+    sd = {}
+    for i in range(2):
+        blk = _make_block_sd(rng)
+        sd.update({f"down.{i}.attentions.transformer_blocks.0.{k}": v
+                   for k, v in blk.items()})
+    blocks = generic_injection(state_dict=sd, fp16=False, num_heads=4)
+    assert len(blocks) == 2
+    for _, (cfg, params) in blocks.items():
+        assert cfg.hidden_size == 32
+        assert params["attn1"]["qkv"]["kernel"].shape == (32, 96)
+
+
+def test_spatial_transformer_and_wrapper():
+    rng = np.random.RandomState(3)
+    cfg = block_config_from_state_dict(_make_block_sd(rng), num_heads=4,
+                                       dtype=jnp.float32)
+    model = SpatialTransformer2D(cfg)
+    x = jnp.asarray(rng.randn(1, 4, 4, 16).astype(np.float32))
+    ctx = jnp.asarray(rng.randn(1, 7, 24).astype(np.float32))
+    import jax
+
+    params = model.init(jax.random.PRNGKey(0), x, ctx)["params"]
+    out = model.apply({"params": params}, x, ctx)
+    assert out.shape == x.shape
+
+    from deepspeed_tpu.models.diffusion import DSUNet
+
+    wrapped = DSUNet(lambda p, a, c: model.apply({"params": p}, a, c),
+                     params, dtype=jnp.float32)
+    out2 = wrapped(x, ctx)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out), atol=1e-5)

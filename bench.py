@@ -110,13 +110,18 @@ def main():
     # Size to chip: ~770M params on a single v5e chip (best measured MFU of
     # the 350M/550M/770M/1B ladder — larger matmuls, still fits fp32
     # optimizer states + remat activations); tiny on CPU smoke runs.
+    # Operating point 16x512 over 8x1024: same tokens/step, but the XLA
+    # attention softmax traffic scales with S^2 per sequence — measured
+    # 17.5k tok/s (MFU 0.415) at 16x512 vs 13.1k (0.311) at 8x1024.
+    # 512 matches the reference's RLHF workload seqlen (BASELINE.md,
+    # 256 prompt + 256 gen).
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
             dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
             scan_layers=True)
-        batch, seq, steps = 8, 1024, 10
+        batch, seq, steps = 16, 512, 10
     else:
         cfg = LlamaConfig.tiny(dtype=jnp.float32)
         batch, seq, steps = 4, 128, 3

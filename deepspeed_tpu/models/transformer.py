@@ -274,11 +274,19 @@ class GatedMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from jax.ad_checkpoint import checkpoint_name
+
         features = x.shape[-1]
         dense = functools.partial(nn.Dense, use_bias=self.use_bias,
                                   dtype=self.dtype, param_dtype=jnp.float32)
-        gate = dense(self.intermediate_size, name="gate_proj")(x)
-        up = dense(self.intermediate_size, name="up_proj")(x)
+        # named for remat policies: "save_mlp" keeps gate/up resident so the
+        # backward recomputes only cheap elementwise ops + the attention
+        # path — the two [tokens, intermediate] matmuls are the single
+        # biggest recompute cost of whole-block remat
+        gate = checkpoint_name(
+            dense(self.intermediate_size, name="gate_proj")(x), "mlp_gate")
+        up = checkpoint_name(
+            dense(self.intermediate_size, name="up_proj")(x), "mlp_up")
         return dense(features, name="down_proj")(self.activation(gate) * up)
 
 

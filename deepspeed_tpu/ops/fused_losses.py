@@ -1,0 +1,74 @@
+"""Fused / memory-bounded loss ops.
+
+The reference's training path materializes full fp32 logits for the LM
+cross-entropy (engine forward → loss, runtime/engine.py:1663). At LLM vocab
+sizes that tensor dominates activation memory: [B, S, V] fp32 at B=8,
+S=1024, V=32000 is ~1 GB, and its log-softmax residual + gradient double it.
+
+``chunked_lm_xent`` computes the same masked cross-entropy directly from the
+final hidden states and the LM-head kernel, scanning over sequence chunks
+with a rematerialized body: peak logits memory drops from O(S·V) to
+O(chunk·V), at the cost of recomputing one [chunk, H]x[H, V] matmul per
+chunk in the backward pass (~2% extra FLOPs at 770M/32k-vocab). The
+gradient w.r.t. both hidden states and the kernel flows through the scan
+(kernel grads accumulate across chunks by scan linearity).
+
+This is the TPU-native analogue of fused-softmax-xent CUDA kernels: instead
+of a hand-written kernel, a compiler-friendly loop structure (lax.scan +
+jax.checkpoint) that XLA turns into a streamed matmul+reduction.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lm_xent_reference(logits, labels, ignore_index: int = -100):
+    """Unfused reference: masked CE from full logits — delegates to the
+    canonical ``models.llama.loss_fn`` so the op tests always compare
+    against the semantics the engine actually uses."""
+    from deepspeed_tpu.models.llama import loss_fn
+
+    return loss_fn(logits, labels, ignore_index=ignore_index)
+
+
+def chunked_lm_xent(hidden, kernel, labels, bias=None,
+                    ignore_index: int = -100, chunk_size: int = 256):
+    """Masked LM cross-entropy from hidden states, never materializing the
+    full logits tensor.
+
+    hidden: [B, S, H] (any float dtype; matmul accumulates in fp32)
+    kernel: [H, V] LM-head kernel (tied-embedding callers pass embed.T)
+    labels: [B, S] int; ``ignore_index`` positions excluded from the mean
+    bias:   optional [V]
+    """
+    B, S, H = hidden.shape
+    chunk = min(chunk_size, S)
+    n = -(-S // chunk)
+    pad = n * chunk - S
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                         constant_values=ignore_index)
+    # [n, B, chunk, ...] so scan streams sequence chunks
+    hs = hidden.reshape(B, n, chunk, H).swapaxes(0, 1)
+    ls = labels.reshape(B, n, chunk).swapaxes(0, 1)
+
+    def body(carry, xs):
+        nll_sum, cnt = carry
+        h_c, l_c = xs
+        logits = jnp.dot(h_c, kernel, preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = l_c != ignore_index
+        safe = jnp.where(valid, l_c, 0)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll = jnp.where(valid, -ll, 0.0).sum()
+        return (nll_sum + nll, cnt + valid.sum()), None
+
+    # remat: backward keeps only each chunk's inputs, recomputing its logits
+    body = jax.checkpoint(body)
+    (nll, cnt), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (hs, ls))
+    return nll / jnp.maximum(cnt, 1)

@@ -265,6 +265,15 @@ class DeepSpeedConfig:
         self.wall_clock_breakdown: bool = p.get("wall_clock_breakdown", False)
         self.memory_breakdown: bool = p.get("memory_breakdown", False)
         self.seed: int = p.get("seed", 42)
+        # TPU-specific: stream the LM-head matmul + softmax over sequence
+        # chunks (ops/fused_losses.chunked_lm_xent) instead of materializing
+        # [B, S, V] fp32 logits. Costs a few % step time at small scale;
+        # enables configs whose logits would not otherwise fit HBM.
+        fused = p.get("fused_lm_loss", {})
+        if isinstance(fused, bool):
+            fused = {"enabled": fused}
+        self.fused_lm_loss_enabled: bool = fused.get("enabled", False)
+        self.fused_lm_loss_chunk: int = fused.get("chunk_size", 256)
 
         self.zero_config = DeepSpeedZeroConfig(**p.get("zero_optimization", {}))
         self.fp16 = FP16Config(**p.get("fp16", {}))

@@ -59,9 +59,32 @@ from deepspeed_tpu.utils.timer import (
 TrainLossFn = Callable[[Any, Dict[str, jnp.ndarray], Any], jnp.ndarray]
 
 
-def _default_lm_loss(module) -> TrainLossFn:
-    """batch = {input_ids, labels[, positions]} → causal-LM cross entropy."""
-    from deepspeed_tpu.models.llama import loss_fn as lm_loss
+def _default_lm_loss(module, fused: bool = False,
+                     chunk_size: int = 256) -> TrainLossFn:
+    """batch = {input_ids, labels[, positions]} → causal-LM cross entropy.
+
+    With ``fused`` (config "fused_lm_loss") and a model exposing
+    ``return_hidden`` (LlamaModel), uses the chunked loss
+    (ops/fused_losses.chunked_lm_xent): the lm_head matmul + softmax stream
+    over sequence chunks instead of materializing [B, S, V] fp32 logits —
+    ~2 GB of activation memory at 770M/32k-vocab scale. Off by default:
+    at sizes where full logits fit comfortably it costs a few % step time."""
+    from deepspeed_tpu.models.llama import LlamaModel, loss_fn as lm_loss
+    from deepspeed_tpu.ops.fused_losses import chunked_lm_xent
+
+    if fused and isinstance(module, LlamaModel):
+        tied = module.cfg.tie_embeddings
+
+        def fn(params, batch, rngs=None):
+            h = module.apply({"params": params}, batch["input_ids"],
+                             positions=batch.get("positions"), rngs=rngs,
+                             return_hidden=True)
+            kernel = (params["embed_tokens"]["embedding"].T if tied
+                      else params["lm_head"]["kernel"])
+            return chunked_lm_xent(h, kernel, batch["labels"],
+                                   chunk_size=chunk_size)
+
+        return fn
 
     def fn(params, batch, rngs=None):
         logits = module.apply({"params": params}, batch["input_ids"],
@@ -112,7 +135,9 @@ class DeepSpeedEngine:
         if loss_fn is not None:
             self.loss_fn = loss_fn
         elif model is not None and hasattr(model, "apply"):
-            self.loss_fn = _default_lm_loss(model)
+            self.loss_fn = _default_lm_loss(
+                model, fused=self._config.fused_lm_loss_enabled,
+                chunk_size=self._config.fused_lm_loss_chunk)
         else:
             raise ValueError("Provide a flax module as `model` or an explicit `loss_fn`")
 
@@ -242,6 +267,13 @@ class DeepSpeedEngine:
             f"micro_bs={self.train_micro_batch_size_per_gpu()}, "
             f"gas={self.gradient_accumulation_steps()}, "
             f"train_bs={self.train_batch_size()}", ranks=[0])
+        if self._config.dump_state:
+            # reference `dump_state` config: print the engine's param map
+            # (utils/debug.py name maps → per-param shape/dtype lines)
+            from deepspeed_tpu.utils.debug import debug_rank0, param_summary
+
+            debug_rank0("engine parameter state:\n"
+                        + param_summary(self.params, stats=False))
 
     def _ctx(self):
         """Scoped ambient-mesh context: PartitionSpec-based sharding

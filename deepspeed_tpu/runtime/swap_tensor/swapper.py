@@ -21,16 +21,49 @@ from deepspeed_tpu.utils.logging import logger
 
 class AsyncTensorSwapper:
     def __init__(self, swap_dir: str, block_size: int = 1 << 20,
-                 queue_depth: int = 8, thread_count: int = 4):
+                 queue_depth: int = 8, thread_count: int = 4,
+                 staging_mb: int = 0):
         self.swap_dir = swap_dir
         os.makedirs(swap_dir, exist_ok=True)
         self.aio = AsyncIOHandle(block_size, queue_depth, thread_count)
+        # optional contiguous staging arena for read buffers (reference
+        # swap-buffer pools, runtime/swap_tensor/utils.py, over the
+        # zero/contiguous_memory_allocator.py arena): stable host addresses,
+        # no per-swap allocator churn. Oversized/overflow requests fall back
+        # to plain numpy allocation.
+        self._arena = None
+        if staging_mb > 0:
+            from deepspeed_tpu.runtime.zero.contiguous_memory_allocator \
+                import ContiguousMemoryAllocator
+
+            self._arena = ContiguousMemoryAllocator(staging_mb << 20,
+                                                    np.uint8)
         # name -> (treedef, [(shape, dtype), ...])
         self._meta: Dict[str, Tuple] = {}
         # names with writes submitted but not yet waited on; the AIO thread
         # pool does not order a queued read after a queued write of the same
         # file, so reads of these names must drain writes first
         self._pending_writes: set = set()
+
+    def _alloc_staging(self, shape, dtype):
+        """Return (array, handle|None): an arena view when possible."""
+        if self._arena is None:
+            return np.empty(shape, dtype), None
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        padded = max(64, -(-nbytes // 64) * 64)   # keep offsets 64B-aligned
+        try:
+            # never defrag here: sibling buffers may have reads in flight
+            handle = self._arena.allocate(padded, allow_defrag=False)
+        except MemoryError:
+            return np.empty(shape, dtype), None
+        view = handle.view()[:nbytes].view(dtype).reshape(shape)
+        return view, handle
+
+    def _free_staging(self, handles) -> None:
+        if self._arena is not None:
+            for h in handles:
+                if h is not None:
+                    self._arena.release(h)
 
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.{i}.bin")
@@ -58,27 +91,40 @@ class AsyncTensorSwapper:
         else:
             self._pending_writes.add(name)
 
-    def submit_reads(self, name: str, aio) -> Tuple[Any, list]:
+    def submit_reads(self, name: str, aio) -> Tuple[Any, list, list]:
         """Allocate buffers for ``name`` and submit its preads on ``aio``
         (shared by blocking swap_in and pipelined prefetch). Drains any
-        in-flight write of the same name first."""
+        in-flight write of the same name first. Returns
+        (treedef, buffers, staging_handles) — pass the handles to
+        ``_free_staging`` once the data has been consumed."""
         assert name in self._meta, f"nothing swapped out under {name}"
         self._drain_writes_for(name)
         treedef, shapes = self._meta[name]
-        buffers = [np.empty(shape, dtype) for shape, dtype in shapes]
+        buffers, handles = [], []
+        for shape, dtype in shapes:
+            buf, h = self._alloc_staging(shape, dtype)
+            buffers.append(buf)
+            handles.append(h)
         for i, buf in enumerate(buffers):
             aio.pread(self._leaf_path(name, i), buf)
-        return treedef, buffers
+        return treedef, buffers, handles
 
     def swap_in(self, name: str, device_put: bool = True,
                 sharding=None) -> Any:
         """Read a previously swapped pytree back (blocking)."""
-        treedef, buffers = self.submit_reads(name, self.aio)
+        treedef, buffers, handles = self.submit_reads(name, self.aio)
         failures = self.wait()
         if failures:
+            self._free_staging(handles)
             raise IOError(f"swap_in({name}): {failures} read failures")
         if device_put:
             buffers = [jax.device_put(b, sharding) for b in buffers]
+            self._free_staging(handles)
+        elif self._arena is not None:
+            # hand out copies so arena views don't escape the pool
+            buffers = [np.array(b) if h is not None else b
+                       for b, h in zip(buffers, handles)]
+            self._free_staging(handles)
         return jax.tree_util.tree_unflatten(treedef, buffers)
 
     def wait(self) -> int:
@@ -151,6 +197,7 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
             aio_kwargs.get("queue_depth", 8),
             aio_kwargs.get("thread_count", 4))
         self._prefetched: Dict[str, Any] = {}
+        self._stale_handles: list = []
 
     def prefetch(self, name: str) -> None:
         """Submit the reads for ``name`` without blocking on them.
@@ -166,28 +213,47 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
         the device-resident state."""
         if name not in self._prefetched:
             return self.fetch(name, sharding=sharding)
-        treedef, buffers = self._prefetched.pop(name)
+        treedef, buffers, handles = self._prefetched.pop(name)
         failures = self._read_aio.wait()
+        self._reap_stale()          # discarded prefetches are now quiesced
         if failures:
+            self.swapper._free_staging(handles)
             raise IOError(f"acquire({name}): {failures} read failures")
         arrs = [jax.device_put(b, sharding) for b in buffers]
+        self.swapper._free_staging(handles)
         return jax.tree_util.tree_unflatten(treedef, arrs)
+
+    def _discard_prefetch(self, name: str) -> None:
+        """Invalidate a not-yet-acquired prefetch. Its staging buffers may
+        still be read targets of in-flight I/O, so they are parked and only
+        returned to the arena after the next read-queue barrier."""
+        entry = self._prefetched.pop(name, None)
+        if entry is not None:
+            self._stale_handles.append(entry[2])
+
+    def _reap_stale(self) -> None:
+        for handles in self._stale_handles:
+            self.swapper._free_staging(handles)
+        self._stale_handles.clear()
 
     def release(self, name: str, opt_state: Any) -> None:
         """Write the updated state back without blocking."""
         # a new write invalidates any not-yet-acquired prefetch of this name
-        self._prefetched.pop(name, None)
+        self._discard_prefetch(name)
         self.swapper.swap_out(name, opt_state, blocking=False)
 
     def offload(self, name: str, opt_state: Any) -> None:
-        self._prefetched.pop(name, None)
+        self._discard_prefetch(name)
         super().offload(name, opt_state)
 
     def flush(self) -> None:
         """Barrier for all outstanding I/O; drops unconsumed prefetches so
         a later prefetch rereads current on-disk state."""
-        self._prefetched.clear()
         failures = self.swapper.wait() + self._read_aio.wait()
+        self._reap_stale()
+        for _, _, handles in self._prefetched.values():
+            self.swapper._free_staging(handles)
+        self._prefetched.clear()
         if failures:
             raise IOError(f"flush: {failures} I/O failures")
 

@@ -1,0 +1,155 @@
+"""Contiguous host-memory arena with defragmentation.
+
+Reference: ``deepspeed/runtime/zero/contiguous_memory_allocator.py`` (a
+torch-tensor arena that hands out narrowed views of one flat buffer,
+tracks assignments, and compacts live tensors when fragmentation blocks an
+allocation) and the swap-buffer pools of ``runtime/swap_tensor/utils.py``.
+
+On TPU, device memory belongs to XLA — a user-level device allocator would
+fight the compiler. What still needs explicit contiguous management is the
+*host* side: staging buffers for NVMe swap (AIO wants stable, ideally
+pinned, addresses) and host-RAM offload tiers. This arena provides that:
+
+    arena = ContiguousMemoryAllocator(2 << 30, np.dtype("float32"))
+    h = arena.allocate(numel)        # Allocation handle
+    h.view()[:] = ...                # numpy view into the flat buffer
+    arena.release(h)
+
+``allocate`` compacts live allocations toward offset 0 when free space is
+sufficient but fragmented (the reference's defragmentation pass). Handles
+stay valid across compaction — ``view()`` re-resolves the current offset;
+data is memmove'd by the compactor.
+"""
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Allocation:
+    """A live region of the arena. ``view()`` re-resolves after defrag."""
+
+    __slots__ = ("_arena", "id", "numel")
+
+    def __init__(self, arena: "ContiguousMemoryAllocator", alloc_id: int,
+                 numel: int):
+        self._arena = arena
+        self.id = alloc_id
+        self.numel = numel
+
+    def view(self) -> np.ndarray:
+        return self._arena._view(self.id)
+
+    @property
+    def offset(self) -> int:
+        return self._arena._offset(self.id)
+
+
+class ContiguousMemoryAllocator:
+    def __init__(self, size: int, dtype=np.float32):
+        """size: capacity in elements of ``dtype``."""
+        self.dtype = np.dtype(dtype)
+        self.buffer = np.empty(size, self.dtype)
+        self.size = size
+        self._lock = threading.Lock()
+        self._next_id = 0
+        # id -> (offset, numel), kept sorted by offset on compaction
+        self._live: Dict[int, List[int]] = {}
+        self.total_free = size
+        self.largest_contiguous = size
+        self.max_allocated = 0
+
+    # -- public ----------------------------------------------------------
+
+    def allocate(self, numel: int, allow_defrag: bool = True) -> Allocation:
+        """Reserve ``numel`` elements; defragments if free-but-fragmented
+        (reference ``allocate_tensor`` semantics, incl. the assert that
+        total free space suffices). Callers with async I/O in flight into
+        existing views pass ``allow_defrag=False`` — compaction memmoves
+        live data, which would race the DMA."""
+        with self._lock:
+            if numel > self.total_free:
+                raise MemoryError(
+                    f"arena exhausted: need {numel}, free {self.total_free} "
+                    f"of {self.size}")
+            if self._largest_hole() < numel:
+                if not allow_defrag:
+                    raise MemoryError(
+                        f"arena fragmented: need {numel} contiguous, largest "
+                        f"hole {self._largest_hole()} (defrag disallowed)")
+                self._defragment()
+            off = self._find_hole(numel)
+            assert off is not None, "defragment failed to open a hole"
+            alloc_id = self._next_id
+            self._next_id += 1
+            self._live[alloc_id] = [off, numel]
+            self.total_free -= numel
+            self.max_allocated = max(self.max_allocated,
+                                     self.size - self.total_free)
+            self.largest_contiguous = self._largest_hole()
+            return Allocation(self, alloc_id, numel)
+
+    def release(self, alloc: Allocation) -> None:
+        with self._lock:
+            entry = self._live.pop(alloc.id, None)
+            if entry is None:
+                return
+            self.total_free += entry[1]
+            self.largest_contiguous = self._largest_hole()
+
+    def release_all(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self.total_free = self.size
+            self.largest_contiguous = self.size
+
+    def print_allocation(self, resolution: int = 200) -> str:
+        """Occupancy map string (reference ``print_allocation``)."""
+        cells = ["."] * resolution
+        for off, numel in self._live.values():
+            lo = off * resolution // self.size
+            hi = max(lo + 1, (off + numel) * resolution // self.size)
+            for i in range(lo, min(hi, resolution)):
+                cells[i] = "#"
+        return "".join(cells)
+
+    # -- internals -------------------------------------------------------
+
+    def _view(self, alloc_id: int) -> np.ndarray:
+        off, numel = self._live[alloc_id]
+        return self.buffer[off:off + numel]
+
+    def _offset(self, alloc_id: int) -> int:
+        return self._live[alloc_id][0]
+
+    def _holes(self):
+        """Yield (offset, length) free runs in offset order."""
+        pos = 0
+        for off, numel in sorted(self._live.values()):
+            if off > pos:
+                yield pos, off - pos
+            pos = max(pos, off + numel)
+        if pos < self.size:
+            yield pos, self.size - pos
+
+    def _largest_hole(self) -> int:
+        return max((ln for _, ln in self._holes()), default=0)
+
+    def _find_hole(self, numel: int) -> Optional[int]:
+        for off, ln in self._holes():
+            if ln >= numel:
+                return off
+        return None
+
+    def _defragment(self) -> None:
+        """Compact live regions toward offset 0 (stable order). Handle
+        views re-resolve, so callers are unaffected."""
+        pos = 0
+        for alloc_id, (off, numel) in sorted(self._live.items(),
+                                             key=lambda kv: kv[1][0]):
+            if off != pos:
+                # overlapping-safe: destination is always <= source
+                self.buffer[pos:pos + numel] = self.buffer[off:off + numel]
+                self._live[alloc_id][0] = pos
+            pos += numel

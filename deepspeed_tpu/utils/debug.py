@@ -1,0 +1,67 @@
+"""Debug name maps and rank-gated debug prints.
+
+Reference ``deepspeed/utils/debug.py``: builds fully-qualified
+module/parameter name maps (``debug_extract_module_and_param_names``) so
+hook-driven code can print human-readable identities, plus rank-filtered
+print helpers. In JAX the parameter tree itself carries the names; these
+helpers flatten a pytree into the same "module.sub.param" strings and keep
+the reference's rank-0 print surface.
+"""
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _key_str(k) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def extract_param_names(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    """Flatten a param pytree to {"blocks.block.attn.q_proj.kernel": leaf}
+    (the analogue of ``debug_extract_module_and_param_names``)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names = {}
+    for path, leaf in flat:
+        name = ".".join(_key_str(k) for k in path)
+        names[(prefix + "." + name) if prefix else name] = leaf
+    return names
+
+
+def param_summary(tree: Any, max_rows: int = 0, stats: bool = True) -> str:
+    """One line per param: name, shape, dtype (and |mean| when ``stats``) —
+    the debug dump the reference prints from its name maps. ``stats=False``
+    skips the device_get per leaf (cheap on huge sharded trees)."""
+    names = extract_param_names(tree)
+    rows = []
+    for name, leaf in names.items():
+        if stats:
+            arr = np.asarray(jax.device_get(leaf)) if hasattr(leaf, "dtype") \
+                else np.asarray(leaf)
+            extra = f" |mean|={float(np.abs(arr).mean()):.3e}"
+            shape, dtype = arr.shape, arr.dtype
+        else:
+            extra = ""
+            shape = getattr(leaf, "shape", ())
+            dtype = getattr(leaf, "dtype", "?")
+        rows.append(f"{name:60s} {str(shape):18s} {str(dtype):10s}{extra}")
+        if max_rows and len(rows) >= max_rows:
+            rows.append(f"... ({len(names)} total)")
+            break
+    return "\n".join(rows)
+
+
+def debug_rank0(message: str) -> None:
+    """Print only from process 0 (reference ``printflock``/rank filters)."""
+    if jax.process_index() == 0:
+        logger.info(message)
+
+
+def debug_all_ranks(message: str) -> None:
+    logger.info("[proc %d] %s", jax.process_index(), message)

@@ -86,6 +86,17 @@ def init_distributed(dist_backend: str = "xla-ici",
         world_size = int(os.environ["OMPI_COMM_WORLD_SIZE"])
         rank = int(os.environ["OMPI_COMM_WORLD_RANK"])
         coordinator = f"{os.environ.get('MASTER_ADDR', 'localhost')}:{distributed_port}"
+    if coordinator is None and "TPU_WORKER_HOSTNAMES" in os.environ:
+        # TPU pod metadata (the cloud-environment analogue of the
+        # reference's AzureML/SageMaker env patching, comm.py:682,714):
+        # GCE TPU VMs export the worker list + this worker's index
+        hosts = [h.strip() for h in
+                 os.environ["TPU_WORKER_HOSTNAMES"].split(",") if h.strip()]
+        if len(hosts) > 1:
+            coordinator = f"{hosts[0]}:{distributed_port}"
+            world_size = len(hosts)
+            rank = int(os.environ.get("TPU_WORKER_ID",
+                                      os.environ.get("CLOUD_TPU_TASK_ID", 0)))
     if coordinator is not None and world_size != 1:
         kwargs = {}
         if rank >= 0:

@@ -12,9 +12,12 @@ Design:
 - fp32 running statistics regardless of input dtype (matches the reference
   kernels' fp32 softmax accumulation).
 - causal blocks above the diagonal are skipped entirely via ``pl.when``.
-- backward: recompute-based VJP through the XLA reference implementation —
-  numerically identical, fused by XLA; a Pallas bwd kernel is a later
-  optimization.
+- backward: FlashAttention-2-style Pallas kernels. The forward saves the
+  per-row logsumexp; ``delta = rowsum(do*o)`` is precomputed in XLA; a dq
+  kernel scans kv blocks and a dk/dv kernel scans q blocks, each
+  rebuilding p = exp(s - lse) blockwise — O(S) memory end to end, so long
+  sequences train without the O(S^2) score matrix the recompute-through-
+  XLA fallback would materialize.
 
 Falls back to ``interpret=True`` off-TPU so tests run on the CPU mesh.
 """
@@ -27,8 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -43,7 +46,8 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                      acc_scr, *,
                       sm_scale: float, causal: bool, block_q: int, block_k: int,
                       kv_len: int, num_kv_blocks: int):
     qi = pl.program_id(2)
@@ -99,6 +103,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
         o_ref[0, 0, ...] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        # lane-broadcast layout (block_q, 128), as in the official pallas
+        # kernel — TPU block specs need the last two dims (8, 128)-tileable
+        lse_ref[0, 0, ...] = (m_scr[...]
+                              + jnp.log(jnp.maximum(l_scr[...], 1e-30)))
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
@@ -122,7 +130,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, kv_len=Sk, num_kv_blocks=nk)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
         in_specs=[
@@ -130,8 +138,15 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq_p, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -141,7 +156,175 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
     )(q, k, v)
     if q_pad:
         out = out[:, :, :S, :]
-    return out
+    return out, lse      # lse stays padded (Sq_p) for the bwd kernels
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_scr, *,
+                         sm_scale: float, causal: bool, block_q: int,
+                         block_k: int, kv_len: int, num_kv_blocks: int):
+    """dq for one q block, scanning kv blocks (FlashAttention-2 bwd pass 1):
+    p = exp(s - lse); ds = p * (do.v^T - delta); dq += ds @ k * scale."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    should_run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]                         # (bq, 1)
+        delta = delta_ref[0, 0][:, :1]                     # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = jnp.logical_and(valid, col <= row)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)        # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        acc_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        last_k = jnp.minimum(num_kv_blocks - 1,
+                             (qi * block_q + block_q - 1) // block_k)
+    else:
+        last_k = num_kv_blocks - 1
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        dq_ref[0, 0, ...] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          sm_scale: float, causal: bool, block_q: int,
+                          block_k: int, kv_len: int, q_len: int,
+                          num_q_blocks: int):
+    """dk/dv for one kv block, scanning q blocks (bwd pass 2):
+    dv += p^T @ do;  dk += (p * (do.v^T - delta))^T @ q * scale."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # causal: q block qi sees kv block ki iff its last row >= ki's first col
+    should_run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        valid = jnp.logical_and(col < kv_len, row < q_len)
+        if causal:
+            valid = jnp.logical_and(valid, col <= row)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)        # (bq, bk)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0, ...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, ...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, interpret: bool):
+    """q,k,v,o,do: [B,H,S,D]; lse: [B,H,Sq_p] (padded, compact — one value
+    per row). Returns dq,dk,dv."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    q_pad = (-S) % block_q
+    k_pad = (-Sk) % block_k
+    # delta_i = rowsum(do * o): tiny elementwise op — XLA, not a kernel;
+    # both per-row residuals are lane-broadcast to (…, 128) here so the
+    # kernels get (8,128)-tileable blocks (compact form lives in HBM
+    # between fwd and bwd)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+    if q_pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+    if k_pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+    Sq_p, Sk_p = S + q_pad, Sk + k_pad
+    nq, nk = Sq_p // block_q, Sk_p // block_k
+    assert lse.shape == (B, H, Sq_p), (lse.shape, Sq_p)
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0))
+    r_spec = pl.BlockSpec((1, 1, block_q, 128),
+                          lambda b, h, qi, ki: (b, h, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          kv_len=Sk, num_kv_blocks=nk),
+        grid=(B, H, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # pass 2: kv-major grid, q innermost
+    q2_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0))
+    k2_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0))
+    r2_spec = pl.BlockSpec((1, 1, block_q, 128),
+                           lambda b, h, ki, qi: (b, h, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          kv_len=Sk, q_len=S, num_q_blocks=nq),
+        grid=(B, H, nk, nq),
+        in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, r2_spec, r2_spec],
+        out_specs=[k2_spec, k2_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sk_p, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Sk_p, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if q_pad:
+        dq = dq[:, :, :S, :]
+    if k_pad:
+        dk = dk[:, :, :Sk, :]
+        dv = dv[:, :, :Sk, :]
+    return dq, dk, dv
 
 
 def _use_interpret() -> bool:
@@ -154,21 +337,30 @@ def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash_fwd(qt, kt, vt, causal, sm_scale, block_q, block_k,
-                     interpret=_use_interpret())
+    out, _ = _flash_fwd(qt, kt, vt, causal, sm_scale, block_q, block_k,
+                        interpret=_use_interpret())
     return jnp.swapaxes(out, 1, 2)
 
 
 def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
-    return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k), (q, k, v)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out, lse = _flash_fwd(qt, kt, vt, causal, sm_scale, block_q, block_k,
+                          interpret=_use_interpret())
+    # residuals stay in kernel layout; O(S) extra memory (out + lse).
+    # the kernel emits lse lane-broadcast (…, 128); keep only one column
+    # resident between fwd and bwd (128x smaller), rebroadcast in _flash_bwd
+    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse[..., 0])
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, residuals, do):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, sm_scale),
-        q, k, v)
-    return vjp(do)
+    qt, kt, vt, out, lse = residuals
+    dot = jnp.swapaxes(do, 1, 2)
+    dq, dk, dv = _flash_bwd(qt, kt, vt, out, lse, dot, causal, sm_scale,
+                            block_q, block_k, interpret=_use_interpret())
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -72,6 +72,11 @@ def _default_lm_loss(module, fused: bool = False,
     from deepspeed_tpu.models.llama import LlamaModel, loss_fn as lm_loss
     from deepspeed_tpu.ops.fused_losses import chunked_lm_xent
 
+    if fused and not isinstance(module, LlamaModel):
+        logger.warning(
+            "fused_lm_loss is enabled but %s does not expose return_hidden; "
+            "falling back to the full-logits loss (the [B, S, V] fp32 "
+            "logits WILL be materialized)", type(module).__name__)
     if fused and isinstance(module, LlamaModel):
         tied = module.cfg.tie_embeddings
 

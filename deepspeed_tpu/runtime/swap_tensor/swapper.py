@@ -65,6 +65,20 @@ class AsyncTensorSwapper:
                 if h is not None:
                     self._arena.release(h)
 
+    def _to_device(self, buffers, handles, sharding):
+        """device_put staging buffers safely: the transfer must complete
+        before the arena slots can be reused (block_until_ready), and on
+        CPU backends jax.device_put may zero-copy ALIAS a 64B-aligned host
+        buffer — arena views are exactly that — so those are copied first."""
+        aliasing_backend = jax.default_backend() != "tpu"
+        arrs = []
+        for b, h in zip(buffers, handles):
+            if h is not None and aliasing_backend:
+                b = np.array(b)
+            arrs.append(jax.device_put(b, sharding))
+        jax.block_until_ready(arrs)
+        return arrs
+
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.{i}.bin")
 
@@ -118,7 +132,7 @@ class AsyncTensorSwapper:
             self._free_staging(handles)
             raise IOError(f"swap_in({name}): {failures} read failures")
         if device_put:
-            buffers = [jax.device_put(b, sharding) for b in buffers]
+            buffers = self._to_device(buffers, handles, sharding)
             self._free_staging(handles)
         elif self._arena is not None:
             # hand out copies so arena views don't escape the pool
@@ -219,7 +233,7 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
         if failures:
             self.swapper._free_staging(handles)
             raise IOError(f"acquire({name}): {failures} read failures")
-        arrs = [jax.device_put(b, sharding) for b in buffers]
+        arrs = self.swapper._to_device(buffers, handles, sharding)
         self.swapper._free_staging(handles)
         return jax.tree_util.tree_unflatten(treedef, arrs)
 

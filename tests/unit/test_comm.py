@@ -101,3 +101,23 @@ def test_comms_logger(dp8_mesh, rng):
     summary = dist.log_summary()
     assert "all_reduce" in summary
     dist.comms_logger.enabled = False
+
+
+def test_init_distributed_tpu_pod_discovery(monkeypatch):
+    """TPU_WORKER_HOSTNAMES env (TPU pod metadata) resolves to a coordinator
+    the way the reference discovers AzureML/SageMaker/MPI environments."""
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    calls = {}
+    monkeypatch.setattr(comm_mod, "_INITIALIZED", False)
+    monkeypatch.setattr(
+        comm_mod.jax.distributed, "initialize",
+        lambda coordinator_address=None, **kw: calls.update(
+            {"coord": coordinator_address, **kw}))
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b,host-c")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    comm_mod.init_distributed(verbose=False, distributed_port=12345)
+    assert calls == {"coord": "host-a:12345", "process_id": 2,
+                     "num_processes": 3}
+    # restore module state for other tests
+    monkeypatch.setattr(comm_mod, "_INITIALIZED", False)

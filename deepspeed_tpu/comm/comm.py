@@ -95,8 +95,11 @@ def init_distributed(dist_backend: str = "xla-ici",
         if len(hosts) > 1:
             coordinator = f"{hosts[0]}:{distributed_port}"
             world_size = len(hosts)
+            # -1 = unset: jax.distributed.initialize then infers the rank
+            # itself (defaulting to 0 would make every host claim rank 0)
             rank = int(os.environ.get("TPU_WORKER_ID",
-                                      os.environ.get("CLOUD_TPU_TASK_ID", 0)))
+                                      os.environ.get("CLOUD_TPU_TASK_ID",
+                                                     -1)))
     if coordinator is not None and world_size != 1:
         kwargs = {}
         if rank >= 0:

@@ -131,13 +131,19 @@ def _sequence_parallel_attention(q, k, v, impl: str):
     spec = PartitionSpec(batch_axis, "sequence", None, None)
 
     if impl == "ulysses":
-        from deepspeed_tpu.ops.ulysses import ulysses_attention as inner
+        from deepspeed_tpu.ops.ulysses import ulysses_attention
+        inner = lambda q_, k_, v_: ulysses_attention(q_, k_, v_, causal=True)
+    elif impl == "ring_flash":
+        # flash kernel per ring block (O(block) memory per device even for
+        # huge local shards) — ops/ring_attention.ring_flash_attention
+        from deepspeed_tpu.ops.ring_attention import ring_flash_attention
+        inner = lambda q_, k_, v_: ring_flash_attention(q_, k_, v_, True)
     else:
-        from deepspeed_tpu.ops.ring_attention import ring_attention as inner
+        from deepspeed_tpu.ops.ring_attention import ring_attention
+        inner = lambda q_, k_, v_: ring_attention(q_, k_, v_, causal=True)
 
     return jax.shard_map(
-        lambda q_, k_, v_: inner(q_, k_, v_, causal=True),
-        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
+        inner, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
 
 
 class RMSNorm(nn.Module):
@@ -174,7 +180,7 @@ class SelfAttention(nn.Module):
     rotary_interleaved: bool = False      # GPT-J rotate-every-two pairing
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.bfloat16
-    attention_impl: str = "auto"  # auto | xla | flash | ulysses | ring
+    attention_impl: str = "auto"  # auto | xla | flash | ulysses | ring | ring_flash
     # the caller promises `mask` is exactly the causal mask (no padding /
     # ALiBi / windows) — required before "auto" may route to the flash
     # kernel, which implements causal masking internally and ignores `mask`
@@ -249,7 +255,7 @@ class SelfAttention(nn.Module):
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
-        elif impl in ("ulysses", "ring") and kv_cache is None:
+        elif impl in ("ulysses", "ring", "ring_flash") and kv_cache is None:
             out = _sequence_parallel_attention(q, k, v, impl)
         else:
             dropout_rng = None

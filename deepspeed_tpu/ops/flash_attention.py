@@ -35,6 +35,17 @@ DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct that carries the varying-mesh-axes (vma) of ``like``
+    — required for pallas_call outputs when running inside shard_map with
+    check_vma=True (e.g. ring attention's per-block kernels)."""
+    vma = getattr(jax.typeof(like), "vma", None) if hasattr(jax, "typeof") \
+        else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     """[B,S,H,D] XLA attention — ground truth for tests and the VJP."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
@@ -144,8 +155,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
                          lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq_p, 128), jnp.float32),
+            _out_struct((B, H, Sq_p, D), q.dtype, q),
+            _out_struct((B, H, Sq_p, 128), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -259,29 +270,65 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
                block_q: int, block_k: int, interpret: bool):
     """q,k,v,o,do: [B,H,S,D]; lse: [B,H,Sq_p] (padded, compact — one value
     per row). Returns dq,dk,dv."""
+    # delta_i = rowsum(do * o): tiny elementwise op — XLA, not a kernel
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    q_pad = (-q.shape[2]) % min(block_q, q.shape[2])
+    if q_pad:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad)))
+    return _flash_bwd_core(q, k, v, do, lse, delta, causal, sm_scale,
+                           block_q, block_k, interpret)
+
+
+def _flash_bwd_core(q, k, v, do, lse, delta, causal: bool, sm_scale: float,
+                    block_q: int, block_k: int, interpret: bool,
+                    use_xla: bool = False):
+    """Backward given precomputed per-row residuals: lse and delta, both
+    compact [B,H,Sq_p] fp32 (padded to the q block multiple). Factored out
+    so ring attention can run the same kernels per ring block with the
+    GLOBAL lse/delta (ops/ring_attention.py).
+
+    ``use_xla`` computes the same math with dense XLA ops instead of the
+    pallas kernels — the stand-in ring attention uses off-TPU, where the
+    pallas interpreter trips a shard_map check_vma limitation."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, S)
     block_k = min(block_k, Sk)
     q_pad = (-S) % block_q
     k_pad = (-Sk) % block_k
-    # delta_i = rowsum(do * o): tiny elementwise op — XLA, not a kernel;
-    # both per-row residuals are lane-broadcast to (…, 128) here so the
-    # kernels get (8,128)-tileable blocks (compact form lives in HBM
-    # between fwd and bwd)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
     if q_pad:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
         do = jnp.pad(do, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
-        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
     if k_pad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
     Sq_p, Sk_p = S + q_pad, Sk + k_pad
     nq, nk = Sq_p // block_q, Sk_p // block_k
     assert lse.shape == (B, H, Sq_p), (lse.shape, Sq_p)
+    assert delta.shape == (B, H, Sq_p), (delta.shape, Sq_p)
+    if use_xla:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * sm_scale
+        col = jnp.arange(Sk_p)[None, :]
+        row = jnp.arange(Sq_p)[:, None]
+        valid = jnp.logical_and(col < Sk, row < S)
+        if causal:
+            valid = jnp.logical_and(valid, col <= row)
+        p = jnp.where(valid[None, None], jnp.exp(s - lse[..., None]), 0.0)
+        do32 = do.astype(jnp.float32)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq = jnp.einsum("bhqk,bhkd->bhqd", ds,
+                        k.astype(jnp.float32)).astype(q.dtype)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds,
+                        q.astype(jnp.float32)).astype(k.dtype)
+        return (dq[:, :, :S, :], dk[:, :, :Sk, :],
+                dv.astype(v.dtype)[:, :, :Sk, :])
+    # lane-broadcast the per-row residuals so the kernels get
+    # (8,128)-tileable blocks (compact form lives in HBM between fwd/bwd)
     lse = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0))
@@ -295,7 +342,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
         grid=(B, H, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
+        out_shape=_out_struct((B, H, Sq_p, D), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -312,8 +359,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
         grid=(B, H, nk, nq),
         in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, r2_spec, r2_spec],
         out_specs=[k2_spec, k2_spec],
-        out_shape=[jax.ShapeDtypeStruct((B, H, Sk_p, D), k.dtype),
-                   jax.ShapeDtypeStruct((B, H, Sk_p, D), v.dtype)],
+        out_shape=[_out_struct((B, H, Sk_p, D), k.dtype, k),
+                   _out_struct((B, H, Sk_p, D), v.dtype, v)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,

@@ -16,6 +16,7 @@ fused fwd+bwd is the optimization path).
 Call inside shard_map with q/k/v sequence-sharded: [B, S/P, H, D].
 """
 
+import functools
 from typing import Optional
 
 import jax
@@ -80,3 +81,181 @@ def ring_attention(q, k, v, *, causal: bool = True,
 
     out = acc / jnp.maximum(l_fin[..., None], 1e-30)           # [B,H,S/P,D]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ring + Pallas flash: each ring step runs the flash kernel on the resident
+# KV block instead of a dense [S/P, S/P] einsum — per-device memory stays
+# O(block) even for very large local shards, and the backward reuses the
+# FlashAttention-2 kernels with the GLOBAL logsumexp (each (q, kv-block)
+# pair's gradient only needs the global per-row lse/delta, so the ring bwd
+# rotates KV again and accumulates dk/dv on carries that arrive back at
+# their home device after the full rotation).
+# ---------------------------------------------------------------------------
+
+def _ring_cases(me, src, causal):
+    """0 = diagonal (causal within block), 1 = fully visible, 2 = skip."""
+    if not causal:
+        return jnp.int32(1)
+    return jnp.where(src == me, 0, jnp.where(src < me, 1, 2))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(q, k, v, causal=True, sm_scale=None,
+                         block_size=512, axis_name="sequence"):
+    """[B, S/P, H, D] per device → [B, S/P, H, D]; call inside shard_map
+    with q/k/v sequence-sharded, like :func:`ring_attention`."""
+    out, _ = _ring_flash_fwd_impl(q, k, v, causal, sm_scale, block_size,
+                                  axis_name)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, causal, sm_scale, block_size, axis_name):
+    from deepspeed_tpu.ops.flash_attention import _flash_fwd, _use_interpret
+
+    P = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, S_loc, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    interp = _use_interpret()
+    qt = jnp.swapaxes(q, 1, 2)                                  # [B,H,S/P,D]
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def _block(kv_causal):
+        if interp:
+            # off-TPU stand-in: dense per-block math (the pallas
+            # interpreter miscomposes with switch+scan+shard_map vjp)
+            def f(k_cur, v_cur):
+                kt = jnp.swapaxes(k_cur, 1, 2).astype(jnp.float32)
+                vt = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
+                s = jnp.einsum("bhqd,bhkd->bhqk", qt.astype(jnp.float32),
+                               kt) * sm_scale
+                if kv_causal:
+                    tri = jnp.tril(jnp.ones((S_loc, S_loc), bool))
+                    s = jnp.where(tri[None, None], s, NEG_INF)
+                m = jnp.max(s, axis=-1)
+                p = jnp.exp(s - m[..., None])
+                l = jnp.sum(p, axis=-1)
+                o = jnp.einsum("bhqk,bhkd->bhqd", p, vt) \
+                    / jnp.maximum(l, 1e-30)[..., None]
+                return o, m + jnp.log(jnp.maximum(l, 1e-30))
+            return f
+
+        def f(k_cur, v_cur):
+            o, lse = _flash_fwd(qt, jnp.swapaxes(k_cur, 1, 2),
+                                jnp.swapaxes(v_cur, 1, 2), kv_causal,
+                                sm_scale, block_size, block_size, interp)
+            # lse comes back padded to the q block multiple; o is sliced
+            return o.astype(jnp.float32), lse[:, :, :S_loc, 0]
+        return f
+
+    def _skip(k_cur, v_cur):
+        # derive from qt so the zeros carry the same varying-mesh-axes type
+        # as the flash branches (lax.switch requires matching vma)
+        z = qt.astype(jnp.float32) * 0.0
+        return z, z[..., 0] + NEG_INF
+
+    def step(carry, r):
+        k_cur, v_cur, acc, m_run, l_run = carry
+        src = (me - r) % P
+        o_r, lse_r = lax.switch(_ring_cases(me, src, causal),
+                                [_block(True), _block(False), _skip],
+                                k_cur, v_cur)
+        m_new = jnp.maximum(m_run, lse_r)
+        a_r = jnp.where(lse_r <= NEG_INF / 2, 0.0, jnp.exp(lse_r - m_new))
+        corr = jnp.where(m_run <= NEG_INF / 2, 0.0, jnp.exp(m_run - m_new))
+        acc = acc * corr[..., None] + o_r * a_r[..., None]
+        l_new = l_run * corr + a_r
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m_new, l_new), None
+
+    # carries derive from qt so their varying-axes type matches the step
+    # outputs under shard_map (same trick as ring_attention above)
+    acc0 = qt.astype(jnp.float32) * 0.0
+    m0 = acc0[..., 0] + NEG_INF
+    l0 = acc0[..., 0]
+    (_, _, acc, m_fin, l_fin), _ = lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(P))
+
+    l_safe = jnp.maximum(l_fin, 1e-30)
+    out = (acc / l_safe[..., None]).astype(q.dtype)             # [B,H,S/P,D]
+    lse_tot = m_fin + jnp.log(l_safe)                           # [B,H,S/P]
+    return jnp.swapaxes(out, 1, 2), lse_tot
+
+
+def _ring_flash_fwd_rule(q, k, v, causal, sm_scale, block_size, axis_name):
+    out, lse_tot = _ring_flash_fwd_impl(q, k, v, causal, sm_scale,
+                                        block_size, axis_name)
+    return out, (q, k, v, out, lse_tot)
+
+
+def _ring_flash_bwd_rule(causal, sm_scale, block_size, axis_name,
+                         residuals, do):
+    from deepspeed_tpu.ops.flash_attention import (
+        _flash_bwd_core, _use_interpret,
+    )
+
+    q, k, v, out, lse_tot = residuals
+    P = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, S_loc, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    interp = _use_interpret()
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    qt = jnp.swapaxes(q, 1, 2)
+    dot_ = jnp.swapaxes(do, 1, 2)
+    # global per-row delta; with the global lse this makes every
+    # (q, kv-block) gradient contribution independent
+    delta = jnp.sum(dot_.astype(jnp.float32)
+                    * jnp.swapaxes(out, 1, 2).astype(jnp.float32), axis=-1)
+    # _flash_bwd_core expects per-row residuals padded to the q block
+    q_pad = (-S_loc) % min(block_size, S_loc)
+    if q_pad:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad)))
+        lse_tot = jnp.pad(lse_tot, ((0, 0), (0, 0), (0, q_pad)))
+
+    def _pair(kv_causal):
+        def f(k_cur, v_cur):
+            dq_r, dk_r, dv_r = _flash_bwd_core(
+                qt, jnp.swapaxes(k_cur, 1, 2), jnp.swapaxes(v_cur, 1, 2),
+                dot_, lse_tot, delta, kv_causal, sm_scale,
+                block_size, block_size, interp,
+                use_xla=interp)  # pallas interpret + shard_map vma bug
+            return (dq_r.astype(jnp.float32), dk_r.astype(jnp.float32),
+                    dv_r.astype(jnp.float32))
+        return f
+
+    def _skip(k_cur, v_cur):
+        z = qt.astype(jnp.float32) * 0.0
+        return z, z, z
+
+    def step(carry, r):
+        k_cur, v_cur, dk_cur, dv_cur, dq_acc = carry
+        src = (me - r) % P
+        dq_r, dk_r, dv_r = lax.switch(_ring_cases(me, src, causal),
+                                      [_pair(True), _pair(False), _skip],
+                                      k_cur, v_cur)
+        dq_acc = dq_acc + dq_r
+        dk_cur = dk_cur + dk_r
+        dv_cur = dv_cur + dv_r
+        # dk/dv rotate WITH their kv block: after the full P rotations the
+        # accumulated gradients arrive back at the block's home device
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, dq_acc), None
+
+    z = qt.astype(jnp.float32) * 0.0
+    (_, _, dk_fin, dv_fin, dq_fin), _ = lax.scan(
+        step, (k, v, z, z, z), jnp.arange(P))
+
+    to_public = lambda a, ref: jnp.swapaxes(a, 1, 2).astype(ref.dtype)
+    return (to_public(dq_fin, q), to_public(dk_fin, k), to_public(dv_fin, v))
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)

@@ -72,24 +72,24 @@ def _default_lm_loss(module, fused: bool = False,
     from deepspeed_tpu.models.llama import LlamaModel, loss_fn as lm_loss
     from deepspeed_tpu.ops.fused_losses import chunked_lm_xent
 
-    if fused and not isinstance(module, LlamaModel):
+    if fused:
+        if isinstance(module, LlamaModel):
+            tied = module.cfg.tie_embeddings
+
+            def fn(params, batch, rngs=None):
+                h = module.apply({"params": params}, batch["input_ids"],
+                                 positions=batch.get("positions"), rngs=rngs,
+                                 return_hidden=True)
+                kernel = (params["embed_tokens"]["embedding"].T if tied
+                          else params["lm_head"]["kernel"])
+                return chunked_lm_xent(h, kernel, batch["labels"],
+                                       chunk_size=chunk_size)
+
+            return fn
         logger.warning(
             "fused_lm_loss is enabled but %s does not expose return_hidden; "
             "falling back to the full-logits loss (the [B, S, V] fp32 "
             "logits WILL be materialized)", type(module).__name__)
-    if fused and isinstance(module, LlamaModel):
-        tied = module.cfg.tie_embeddings
-
-        def fn(params, batch, rngs=None):
-            h = module.apply({"params": params}, batch["input_ids"],
-                             positions=batch.get("positions"), rngs=rngs,
-                             return_hidden=True)
-            kernel = (params["embed_tokens"]["embedding"].T if tied
-                      else params["lm_head"]["kernel"])
-            return chunked_lm_xent(h, kernel, batch["labels"],
-                                   chunk_size=chunk_size)
-
-        return fn
 
     def fn(params, batch, rngs=None):
         logits = module.apply({"params": params}, batch["input_ids"],

@@ -70,7 +70,7 @@ class AsyncTensorSwapper:
         before the arena slots can be reused (block_until_ready), and on
         CPU backends jax.device_put may zero-copy ALIAS a 64B-aligned host
         buffer — arena views are exactly that — so those are copied first."""
-        aliasing_backend = jax.default_backend() != "tpu"
+        aliasing_backend = jax.default_backend() == "cpu"
         arrs = []
         for b, h in zip(buffers, handles):
             if h is not None and aliasing_backend:

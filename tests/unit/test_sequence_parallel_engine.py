@@ -35,7 +35,7 @@ def _batches(rng, n, bs=8, seq=16):
     return out
 
 
-@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+@pytest.mark.parametrize("impl", ["ulysses", "ring", "ring_flash"])
 def test_sp_engine_matches_dp(impl):
     ref_engine, rng = _engine("xla", {"pipe": 1, "data": 8, "expert": 1,
                                       "sequence": 1, "tensor": 1})

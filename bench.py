@@ -82,8 +82,10 @@ def inference_main(int8: bool = False):
     n_params = sum(
         x.size for x in jax.tree_util.tree_leaves(engine.params))
     # decode is weight-streaming-bound: ratio = achieved bytes/s over v5e
-    # HBM bandwidth (~819 GB/s) — a 0-1 utilization like main()'s MFU ratio
-    bytes_per_param = 1 if int8 else 2
+    # HBM bandwidth (~819 GB/s) — a 0-1 utilization like main()'s MFU ratio.
+    # int8 storage is dequantized ONCE per generation (capacity win), so the
+    # decode loop streams bf16 copies either way: 2 bytes/param.
+    bytes_per_param = 2
     hbm_util = (n_params * bytes_per_param * best) / 819e9 if on_tpu else 0.0
     print(json.dumps({
         "metric": "llama770m_decode_tokens_per_sec"
@@ -96,6 +98,93 @@ def inference_main(int8: bool = False):
                    "batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "params": int(n_params),
                    "int8": int8, "backend": jax.default_backend()},
+    }))
+
+
+def rlhf_main():
+    """--rlhf: DS-Chat-style actor loop on the hybrid engine — rollout
+    generation (prompt 256 + gen 128, the reference RLHF workload family,
+    BASELINE.md seq 256+256) then a PPO-proxy train step on the rolled-out
+    sequences, against the same sharded weights. Reports e2e tokens/s;
+    vs_baseline is e2e throughput relative to this chip's pure-train
+    throughput (the hybrid flip's efficiency — the reference's DS-Chat
+    claim is precisely that generation need not dominate the loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
+            scan_layers=True)
+        batch, prompt_len, gen_len, iters = 8, 256, 128, 3
+    else:
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        batch, prompt_len, gen_len, iters = 4, 8, 8, 2
+
+    model = LlamaModel(cfg)
+    seq = prompt_len + gen_len
+    ds_config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-5}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": on_tpu},
+        "hybrid_engine": {"enabled": True,
+                          "max_out_tokens": seq + gen_len},
+        "steps_per_print": 1000,
+    }
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1))
+    engine = deepspeed_tpu.initialize(
+        model=model, config=ds_config, model_config=cfg,
+        sample_batch={"input_ids": toks[:1, :-1], "labels": toks[:1, 1:]})
+
+    prompts = rng.integers(0, cfg.vocab_size, size=(batch, prompt_len))
+
+    def one_iter():
+        rolled = engine.generate(prompts, max_new_tokens=gen_len,
+                                 temperature=1.0)
+        batch_t = {"input_ids": rolled[:, :-1], "labels": rolled[:, 1:]}
+        return float(engine.train_batch(batch_t))
+
+    one_iter()                      # compile generate + train programs
+    best = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.time()
+        for _ in range(iters):
+            loss = one_iter()
+        best = min(best, max(time.time() - t0, 1e-6))
+    e2e_tok_s = iters * batch * seq / best
+
+    # pure-train throughput at the SAME shapes/program (warmed by one_iter),
+    # for the overhead ratio
+    rolled0 = engine.generate(prompts, max_new_tokens=gen_len,
+                              temperature=1.0)
+    batch0 = {"input_ids": rolled0[:, :-1], "labels": rolled0[:, 1:]}
+    float(engine.train_batch(batch0))
+    best_t = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.time()
+        for _ in range(iters):
+            _baseline_loss = float(engine.train_batch(batch0))
+        best_t = min(best_t, max(time.time() - t0, 1e-6))
+    train_tok_s = iters * batch * seq / best_t
+
+    print(json.dumps({
+        "metric": "llama770m_rlhf_e2e_tokens_per_sec",
+        "value": round(e2e_tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(e2e_tok_s / max(train_tok_s, 1e-6), 3),
+        "detail": {"batch": batch, "prompt_len": prompt_len,
+                   "gen_len": gen_len, "iters": iters,
+                   "train_only_tokens_per_sec": round(train_tok_s, 1),
+                   "loss": loss, "backend": jax.default_backend()},
     }))
 
 
@@ -196,5 +285,7 @@ def main():
 if __name__ == "__main__":
     if "--inference" in sys.argv:
         inference_main(int8="--int8" in sys.argv)
+    elif "--rlhf" in sys.argv:
+        rlhf_main()
     else:
         main()

@@ -41,7 +41,7 @@ def gen_capacity(max_new_tokens: int) -> int:
 
 
 def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
-                        max_new_tokens: int):
+                        max_new_tokens: int, params_fn=None):
     """Shared compiled-generation cache policy (used by InferenceEngine and
     the RLHF hybrid engine): capacity-bucketed keys, true LRU eviction.
     Returns ``(gen_fn, cap)``."""
@@ -54,11 +54,13 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
     else:
         if len(cache) >= GEN_CACHE_MAX:
             cache.popitem(last=False)
-        cache[key] = build_generate_fn(apply_fn, B, T, cap)
+        cache[key] = build_generate_fn(apply_fn, B, T, cap,
+                                       params_fn=params_fn)
     return cache[key], cap
 
 
-def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int):
+def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int,
+                      params_fn=None):
     """One XLA program for a whole generation: prefill, a while_loop of
     KV-cached decode steps with in-graph sampling, early exit when every row
     hit EOS. The TPU analogue of the reference's CUDA-graph'd decode
@@ -67,10 +69,17 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int):
 
     ``apply_fn(params, tokens, caches, cache_index) -> (logits, caches)``.
     Used by both InferenceEngine and the RLHF hybrid engine.
+
+    ``params_fn`` (e.g. int8 dequantization) runs ONCE at the top of the
+    program — the while_loop body then closes over the transformed weights
+    as loop constants, instead of re-materializing them every decode step
+    (XLA does not reliably hoist a multi-GB loop-invariant dequant).
     """
 
     def gen(params, input_ids, caches, rng, temperature, top_k, top_p,
             eos_id, n_steps):
+        if params_fn is not None:
+            params = params_fn(params)
         logits, caches = apply_fn(params, input_ids, caches,
                                   jnp.asarray(0, jnp.int32))
         rng, key = jax.random.split(rng)
@@ -170,11 +179,12 @@ class InferenceEngine:
                  f"{', int8 weights' if self._quantized else ''}", ranks=[0])
 
     # --- int8 weight-only quantization ---------------------------------------
-    # TODO(perf): _effective_params dequantizes OUTSIDE the decode loop (XLA
-    # hoists the loop-invariant convert), so int8 currently wins HBM
-    # *capacity*, not per-step bandwidth. The Pallas weight-streaming kernel
-    # that keeps weights int8 in HBM exists (ops/int8_matmul.py); wiring it
-    # requires routing the model's Dense matmuls through it.
+    # generate() dequantizes ONCE at the top of the fused program (the
+    # params_fn hook of build_generate_fn), so decode steps run at bf16
+    # speed while HBM holds int8 weights (capacity win). True per-step
+    # bandwidth wins need the Pallas weight-streaming kernel
+    # (ops/int8_matmul.py) routed through the model's matmuls — future work.
+    # The step-wise _decode_fn API still dequantizes per call.
     def _quantize_params(self):
         """Replace large matmul kernels in ``self.params`` with
         {q: int8, scale} groups — decode is weight-bandwidth-bound, so
@@ -317,12 +327,13 @@ class InferenceEngine:
         decoder = self._decoder
 
         def apply_fn(params, tokens, caches, index):
-            return decoder.apply(
-                {"params": self._effective_params(params)}, tokens, caches,
-                index)
+            return decoder.apply({"params": params}, tokens, caches, index)
 
-        gen_fn, cap = get_or_build_gen_fn(self._gen_cache, apply_fn, B, T,
-                                          max_new_tokens)
+        # int8: dequantize once at the program top (params_fn), NOT inside
+        # the decode loop — see build_generate_fn
+        gen_fn, cap = get_or_build_gen_fn(
+            self._gen_cache, apply_fn, B, T, max_new_tokens,
+            params_fn=self._effective_params if self._quantized else None)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         eos = -1 if eos_token_id is None else int(eos_token_id)

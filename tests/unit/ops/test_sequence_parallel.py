@@ -130,10 +130,12 @@ def test_ring_flash_grads_match_full(sp_mesh, rng, causal):
 
 
 def test_ring_flash_unaligned_shard(sp_mesh, rng):
-    """Local shard not a multiple of the flash block (padding path)."""
+    """Local shard larger than but not a multiple of the flash block —
+    exercises the q_pad branches (blocks only shrink when S_loc < block, so
+    S/P must exceed the block size to hit real padding: S/P=10, block 8)."""
     from deepspeed_tpu.ops.ring_attention import ring_flash_attention
 
-    q, k, v = _qkv(rng, B=1, S=24, H=2, D=16)       # S/P = 6, block 8
+    q, k, v = _qkv(rng, B=1, S=40, H=2, D=16)       # S/P = 10, block 8
     ref = _reference_attention(q, k, v, True, 1.0 / 4.0)
 
     def loss(q, k, v):

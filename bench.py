@@ -17,6 +17,19 @@ import time
 import numpy as np
 
 
+def time_best(step_fn, windows: int, iters: int) -> float:
+    """Best-of-N timing windows of ``iters`` calls (the tunnel chip's
+    throughput varies run to run; the minimum measures the hardware, not
+    the noise). ``step_fn`` must block on completion (host transfer)."""
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.time()
+        for _ in range(iters):
+            step_fn()
+        best = min(best, max(time.time() - t0, 1e-6))
+    return best
+
+
 def inference_main(int8: bool = False):
     """--inference [--int8]: fused-generation decode benchmark — TTFT (p50)
     and decode tokens/s on the flagship model (the DS-Inference headline
@@ -153,14 +166,9 @@ def rlhf_main():
         batch_t = {"input_ids": rolled[:, :-1], "labels": rolled[:, 1:]}
         return float(engine.train_batch(batch_t))
 
-    one_iter()                      # compile generate + train programs
-    best = float("inf")
-    for _ in range(3 if on_tpu else 1):
-        t0 = time.time()
-        for _ in range(iters):
-            loss = one_iter()
-        best = min(best, max(time.time() - t0, 1e-6))
-    e2e_tok_s = iters * batch * seq / best
+    loss = one_iter()               # compile generate + train programs
+    windows = 3 if on_tpu else 1
+    e2e_tok_s = iters * batch * seq / time_best(one_iter, windows, iters)
 
     # pure-train throughput at the SAME shapes/program (warmed by one_iter),
     # for the overhead ratio
@@ -168,13 +176,8 @@ def rlhf_main():
                               temperature=1.0)
     batch0 = {"input_ids": rolled0[:, :-1], "labels": rolled0[:, 1:]}
     float(engine.train_batch(batch0))
-    best_t = float("inf")
-    for _ in range(3 if on_tpu else 1):
-        t0 = time.time()
-        for _ in range(iters):
-            _baseline_loss = float(engine.train_batch(batch0))
-        best_t = min(best_t, max(time.time() - t0, 1e-6))
-    train_tok_s = iters * batch * seq / best_t
+    train_tok_s = iters * batch * seq / time_best(
+        lambda: float(engine.train_batch(batch0)), windows, iters)
 
     print(json.dumps({
         "metric": "llama770m_rlhf_e2e_tokens_per_sec",

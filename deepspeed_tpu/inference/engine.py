@@ -46,7 +46,12 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
     the RLHF hybrid engine): capacity-bucketed keys, true LRU eviction.
     Returns ``(gen_fn, cap)``."""
     cap = gen_capacity(max_new_tokens)
-    key = (B, T, cap)
+    # params_fn identity is part of the program: a cached non-dequantizing
+    # fn must not be reused if quantization is toggled between calls.
+    # (unwrap bound methods — each attribute access creates a fresh object)
+    pf_key = (None if params_fn is None
+              else id(getattr(params_fn, "__func__", params_fn)))
+    key = (B, T, cap, pf_key)
     if not isinstance(cache, OrderedDict):
         raise TypeError("gen cache must be an OrderedDict")
     if key in cache:

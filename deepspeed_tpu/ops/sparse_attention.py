@@ -17,8 +17,10 @@ Design:
   running max/sum/acc, fp32 statistics) and skips absent blocks with
   ``pl.when`` on a scalar-prefetched layout value: skipped blocks cost a DMA
   but no MXU work. Fully-absent rows produce zeros.
-- backward: recompute VJP through the XLA dense-masked reference — the same
-  layout expanded to an element mask — so gradients agree with the kernel.
+- backward: FlashAttention-2-style blocked Pallas kernels with the same
+  layout gating — the forward saves per-row logsumexp, a dq pass scans live
+  kv blocks and a dk/dv pass scans live q blocks, so training long
+  sequences never materializes the dense score matrix either.
 - off-TPU the kernel runs with ``interpret=True`` so the CPU-mesh tests work.
 
 Determinism: random blocks (Variable/BigBird) are drawn from a seeded
@@ -358,7 +360,7 @@ def _reference_sparse_attention(q, k, v, layout, block, sm_scale, kpm):
 
 
 def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, kpm_ref, o_ref,
-                       m_scr, l_scr, acc_scr, *,
+                       lse_ref, m_scr, l_scr, acc_scr, *,
                        sm_scale: float, block_k: int, kv_len: int,
                        num_kv_blocks: int):
     h = pl.program_id(1)
@@ -380,7 +382,7 @@ def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, kpm_ref, o_ref,
                                 preferred_element_type=jnp.float32) * sm_scale
         col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         valid = col < kv_len
-        valid = jnp.logical_and(valid, kpm_ref[0][None, :] != 0)
+        valid = jnp.logical_and(valid, kpm_ref[0][:, 0][None, :] != 0)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[...]
@@ -403,6 +405,13 @@ def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, kpm_ref, o_ref,
     def _finalize():
         denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
         o_ref[0, 0, ...] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        # per-row logsumexp residual for the blocked backward (lane-
+        # broadcast layout, as in ops/flash_attention.py); rows with no
+        # visible key keep lse = NEG_INF so the backward re-zeroes them
+        lse = jnp.where(l_scr[...] > 0.0,
+                        m_scr[...] + jnp.log(jnp.maximum(l_scr[...], 1e-30)),
+                        NEG_INF)
+        lse_ref[0, 0, ...] = lse
 
 
 def _sparse_fwd(q, k, v, layout, kpm, block, sm_scale, interpret):
@@ -418,12 +427,15 @@ def _sparse_fwd(q, k, v, layout, kpm, block, sm_scale, interpret):
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         kpm = jnp.pad(kpm, ((0, 0), (0, pad_k)))
     nq, nk = (S + pad_q) // block, (Sk + pad_k) // block
+    # lane-broadcast [B, Sk_p, 128] so the (1, block, 128) block spec is
+    # (8,128)-tileable for any block size
+    kpm = jnp.broadcast_to(kpm[..., None], kpm.shape + (128,))
 
     kernel = functools.partial(
         _sparse_fwd_kernel, sm_scale=sm_scale, block_k=block,
         kv_len=Sk, num_kv_blocks=nk)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -432,22 +444,189 @@ def _sparse_fwd(q, k, v, layout, kpm, block, sm_scale, interpret):
                 pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, qi, 0)),
                 pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, ki, 0)),
                 pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, ki, 0)),
-                pl.BlockSpec((1, block), lambda b, h, qi, ki, L: (b, ki)),
+                pl.BlockSpec((1, block, 128), lambda b, h, qi, ki, L: (b, ki, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, block, D),
-                                   lambda b, h, qi, ki, L: (b, h, qi, 0)),
+            out_specs=[
+                pl.BlockSpec((1, 1, block, D),
+                             lambda b, h, qi, ki, L: (b, h, qi, 0)),
+                pl.BlockSpec((1, 1, block, 128),
+                             lambda b, h, qi, ki, L: (b, h, qi, 0)),
+            ],
             scratch_shapes=[
                 pltpu.VMEM((block, 128), jnp.float32),
                 pltpu.VMEM((block, 128), jnp.float32),
                 pltpu.VMEM((block, D), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, H, S + pad_q, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S + pad_q, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S + pad_q, 128), jnp.float32),
+        ],
         interpret=interpret,
     )(layout, q, k, v, kpm)
     if pad_q:
         out = out[:, :, :S, :]
-    return out
+    return out, lse[..., 0]     # lse stays padded for the bwd kernels
+
+
+def _sparse_bwd_dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, kpm_ref,
+                          lse_ref, delta_ref, dq_ref, acc_scr, *,
+                          sm_scale: float, block_k: int, kv_len: int,
+                          num_kv_blocks: int):
+    """dq for one q block, scanning the layout's live kv blocks
+    (FlashAttention-2 bwd pass 1 with block-sparsity gating)."""
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(layout_ref[h, qi, ki] != 0)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = jnp.logical_and(col < kv_len, kpm_ref[0][:, 0][None, :] != 0)
+        # fully-masked rows keep lse=NEG_INF; exp(s - NEG_INF) would
+        # overflow, so gate on a finite lse too
+        valid = jnp.logical_and(valid, lse > NEG_INF / 2)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        acc_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0, ...] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _sparse_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, kpm_ref,
+                           lse_ref, delta_ref, dk_ref, dv_ref,
+                           dk_scr, dv_scr, *,
+                           sm_scale: float, block_k: int, kv_len: int,
+                           q_len: int, num_q_blocks: int):
+    """dk/dv for one kv block, scanning the layout's live q blocks."""
+    h = pl.program_id(1)
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(layout_ref[h, qi, ki] != 0)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        row = qi * q.shape[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        valid = jnp.logical_and(col < kv_len, row < q_len)
+        valid = jnp.logical_and(valid, kpm_ref[0][:, 0][None, :] != 0)
+        valid = jnp.logical_and(valid, lse > NEG_INF / 2)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0, ...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, ...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _sparse_bwd(q, k, v, o, lse, do, layout, kpm, block, sm_scale, interpret):
+    """q,k,v,o,do: [B,H,S,D]; lse: [B,H,Sq_p] (padded, compact).
+    Returns dq,dk,dv in kernel layout."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    pad_q = (-S) % block
+    pad_k = (-Sk) % block
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        kpm = jnp.pad(kpm, ((0, 0), (0, pad_k)))
+    Sq_p, Sk_p = S + pad_q, Sk + pad_k
+    nq, nk = Sq_p // block, Sk_p // block
+    assert lse.shape == (B, H, Sq_p), (lse.shape, Sq_p)
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+    kpm = jnp.broadcast_to(kpm[..., None], kpm.shape + (128,))
+
+    q_spec = pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, block, D), lambda b, h, qi, ki, L: (b, h, ki, 0))
+    kpm_spec = pl.BlockSpec((1, block, 128), lambda b, h, qi, ki, L: (b, ki, 0))
+    r_spec = pl.BlockSpec((1, 1, block, 128), lambda b, h, qi, ki, L: (b, h, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_sparse_bwd_dq_kernel, sm_scale=sm_scale,
+                          block_k=block, kv_len=Sk, num_kv_blocks=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, nq, nk),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, kpm_spec, r_spec,
+                      r_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
+        interpret=interpret,
+    )(layout, q, k, v, do, kpm, lse, delta)
+
+    # pass 2: kv-major grid, q innermost; the layout index swaps roles
+    q2_spec = pl.BlockSpec((1, 1, block, D), lambda b, h, ki, qi, L: (b, h, qi, 0))
+    k2_spec = pl.BlockSpec((1, 1, block, D), lambda b, h, ki, qi, L: (b, h, ki, 0))
+    kpm2_spec = pl.BlockSpec((1, block, 128), lambda b, h, ki, qi, L: (b, ki, 0))
+    r2_spec = pl.BlockSpec((1, 1, block, 128), lambda b, h, ki, qi, L: (b, h, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_sparse_bwd_dkv_kernel, sm_scale=sm_scale,
+                          block_k=block, kv_len=Sk, q_len=S,
+                          num_q_blocks=nq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, nk, nq),
+            in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, kpm2_spec,
+                      r2_spec, r2_spec],
+            out_specs=[k2_spec, k2_spec],
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32),
+                            pltpu.VMEM((block, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sk_p, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Sk_p, D), v.dtype)],
+        interpret=interpret,
+    )(layout, q, k, v, do, kpm, lse, delta)
+
+    if pad_q:
+        dq = dq[:, :, :S, :]
+    if pad_k:
+        dk = dk[:, :, :Sk, :]
+        dv = dv[:, :, :Sk, :]
+    return dq, dk, dv
 
 
 def _use_interpret() -> bool:
@@ -459,23 +638,27 @@ def _sparse_attention(q, k, v, layout, kpm, block, sm_scale):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _sparse_fwd(qt, kt, vt, layout, kpm, block, sm_scale,
-                      interpret=_use_interpret())
+    out, _ = _sparse_fwd(qt, kt, vt, layout, kpm, block, sm_scale,
+                         interpret=_use_interpret())
     return jnp.swapaxes(out, 1, 2)
 
 
 def _fwd_rule(q, k, v, layout, kpm, block, sm_scale):
-    return (_sparse_attention(q, k, v, layout, kpm, block, sm_scale),
-            (q, k, v, layout, kpm))
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out, lse = _sparse_fwd(qt, kt, vt, layout, kpm, block, sm_scale,
+                           interpret=_use_interpret())
+    return (jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse, layout, kpm))
 
 
 def _bwd_rule(block, sm_scale, residuals, do):
-    q, k, v, layout, kpm = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_sparse_attention(
-            q_, k_, v_, layout, block, sm_scale, kpm), q, k, v)
-    dq, dk, dv = vjp(do)
-    return dq, dk, dv, None, None
+    qt, kt, vt, out, lse, layout, kpm = residuals
+    dot_ = jnp.swapaxes(do, 1, 2)
+    dq, dk, dv = _sparse_bwd(qt, kt, vt, out, lse, dot_, layout, kpm,
+                             block, sm_scale, interpret=_use_interpret())
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2), None, None)
 
 
 _sparse_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -488,8 +671,8 @@ def sparse_attention(q, k, v, layout, block: int,
 
     ``layout`` is a [H, nq, nk] 0/1 array (numpy or jax) from a
     ``SparsityConfig``; ``key_padding_mask`` is an optional [B, Sk] array,
-    nonzero = attend. Differentiable (recompute VJP against the dense-masked
-    reference).
+    nonzero = attend. Differentiable: blocked Pallas backward kernels with
+    the same layout gating (O(S * live-blocks) memory and compute).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)

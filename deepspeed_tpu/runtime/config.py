@@ -274,6 +274,14 @@ class DeepSpeedConfig:
             fused = {"enabled": fused}
         self.fused_lm_loss_enabled: bool = fused.get("enabled", False)
         self.fused_lm_loss_chunk: int = fused.get("chunk_size", 256)
+        # checkify-style numerics guard (SURVEY §5: the TPU build's answer
+        # to the reference's safe_mode/overflow sanitizers): every step also
+        # verifies loss/grad finiteness in-graph; a tripped check skips the
+        # update and raises host-side
+        nchk = p.get("numerics_check", {})
+        if isinstance(nchk, bool):
+            nchk = {"enabled": nchk}
+        self.numerics_check_enabled: bool = nchk.get("enabled", False)
 
         self.zero_config = DeepSpeedZeroConfig(**p.get("zero_optimization", {}))
         self.fp16 = FP16Config(**p.get("fp16", {}))

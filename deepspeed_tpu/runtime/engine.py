@@ -376,6 +376,7 @@ class DeepSpeedEngine:
         fp16 = self.fp16_enabled
         dynamic = self._dynamic_scale
         cfg16 = self._config.fp16
+        numerics = self._config.numerics_check_enabled
         grad_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), plan.grad_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -398,8 +399,11 @@ class DeepSpeedEngine:
             grads = constrain_grads(grads)
             return loss / scale, grads
 
-        def apply_update(params, opt_state, grads, scaler_state):
-            finite = grads_finite(grads) if fp16 else jnp.asarray(True)
+        def apply_update(params, opt_state, grads, scaler_state,
+                         loss_ok=jnp.asarray(True)):
+            finite = (grads_finite(grads) if (fp16 or numerics)
+                      else jnp.asarray(True))
+            finite = jnp.logical_and(finite, loss_ok)
 
             def do_step(operand):
                 params, opt_state, grads = operand
@@ -441,8 +445,12 @@ class DeepSpeedEngine:
                 (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), batch)
                 grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
                 loss = loss_sum / gas
+            # the guard checks the loss too (a finite-grad NaN loss is
+            # possible with masked losses); it feeds the skip gate, so a
+            # tripped check really does leave params/opt_state untouched
+            loss_ok = (jnp.isfinite(loss) if numerics else jnp.asarray(True))
             new_params, new_opt, new_scaler, finite = apply_update(
-                params, opt_state, grads, scaler_state)
+                params, opt_state, grads, scaler_state, loss_ok)
             return new_params, new_opt, new_scaler, loss, finite
 
         with jax.set_mesh(mesh):
@@ -512,6 +520,18 @@ class DeepSpeedEngine:
                 mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
                       for k, v in batch.items() if k != STEP_KEY}
             self._misc_runtime_step(mb, finite)
+        # numerics guard fires BEFORE step bookkeeping (the message must
+        # name the offending step) and only when fp16 loss scaling is not
+        # managing overflow skips itself — a dynamic-scale overflow is a
+        # routine self-recovering event, not a numerics bug
+        if self._config.numerics_check_enabled and not self.fp16_enabled \
+                and not bool(finite):
+            if self.wall_clock_breakdown:
+                self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
+            raise FloatingPointError(
+                f"numerics_check: non-finite loss or gradients at global "
+                f"step {self.global_steps} (update skipped). Inspect the "
+                f"batch/learning rate; disable 'numerics_check' to run on.")
         self._after_step(finite, loss=loss)
         self.micro_steps += gas
         if self.wall_clock_breakdown:

@@ -282,3 +282,40 @@ def test_async_checkpoint_save(tmp_path):
     # roundtrip through load (which fences any pending save)
     engine.save_checkpoint(str(tmp_path), tag="t2")
     engine.load_checkpoint(str(tmp_path), tag="t2")
+
+
+def test_numerics_check_guard():
+    """SURVEY §5 numerics guard: a poisoned batch (NaN injected via inf lr?
+    simplest: params poisoned) trips FloatingPointError and skips the
+    update; clean steps run normally."""
+    import pytest
+
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, size=(32, 17))
+    batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "numerics_check": True,
+                "steps_per_print": 1000},
+        sample_batch=batch)
+    assert np.isfinite(float(engine.train_batch(batch)))   # clean step ok
+
+    # poison one parameter -> grads and loss go non-finite
+    engine.params = jax.tree_util.tree_map(
+        lambda x: x.at[(0,) * x.ndim].set(jnp.nan) if x.ndim else x,
+        engine.params)
+    # host snapshot BEFORE the failing step (the live buffers get donated)
+    before = jax.tree_util.tree_map(lambda x: np.array(x), engine.opt_state)
+    with pytest.raises(FloatingPointError, match="numerics_check"):
+        engine.train_batch(batch)
+    # the update was skipped in-graph: opt_state (incl. step counts and
+    # moments) is bit-identical to the pre-step snapshot
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(engine.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -73,14 +73,28 @@ def inference_main(int8: bool = False):
     run_blocking(gen_len)   # compile long program
     run_blocking(1)         # compile TTFT program
 
-    # TTFT: prefill + first token (p50 of several runs)
+    # TTFT: prefill + first token (p50 of several runs). Through the axon
+    # tunnel every blocking fence pays one client<->chip round trip
+    # (~100 ms measured) that is transport, not model latency — measure it
+    # with a transfer of an already-materialized scalar and report TTFT
+    # net of it (raw + rtt kept in detail).
+    ready = jnp.zeros((), jnp.int32) + 1
+    int(ready)
+    rtts = []
+    for _ in range(5):
+        t0 = time.time()
+        int(ready + 0)          # fresh tiny dispatch + transfer
+        rtts.append(time.time() - t0)
+    rtt_p50 = sorted(rtts)[len(rtts) // 2]
+
     ttfts = []
     for _ in range(5):
         engine.reset_cache()
         t0 = time.time()
         run_blocking(1)
         ttfts.append(time.time() - t0)
-    ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
+    ttft_raw_p50 = sorted(ttfts)[len(ttfts) // 2]
+    ttft_p50 = max(ttft_raw_p50 - rtt_p50, 1e-4)
 
     # decode throughput: long generation minus the separately-measured
     # prefill+first-token time, so the metric really is decode tokens/s
@@ -89,7 +103,9 @@ def inference_main(int8: bool = False):
         engine.reset_cache()
         t0 = time.time()
         run_blocking(gen_len)
-        dt = max(time.time() - t0 - ttft_p50, 1e-6)
+        # subtract the RAW ttft (incl. its round trip) so this window's own
+        # round trip cancels and dt is pure decode time
+        dt = max(time.time() - t0 - ttft_raw_p50, 1e-6)
         best = max(best, batch * (gen_len - 1) / dt)
 
     n_params = sum(
@@ -107,6 +123,8 @@ def inference_main(int8: bool = False):
         "unit": "tokens/s",
         "vs_baseline": round(hbm_util, 3),
         "detail": {"ttft_p50_ms": round(ttft_p50 * 1e3, 1),
+                   "ttft_raw_p50_ms": round(ttft_raw_p50 * 1e3, 1),
+                   "tunnel_rtt_p50_ms": round(rtt_p50 * 1e3, 1),
                    "hbm_streaming_utilization": round(hbm_util, 3),
                    "batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "params": int(n_params),

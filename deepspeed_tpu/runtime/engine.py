@@ -401,9 +401,12 @@ class DeepSpeedEngine:
 
         def apply_update(params, opt_state, grads, scaler_state,
                          loss_ok=jnp.asarray(True)):
-            finite = (grads_finite(grads) if (fp16 or numerics)
-                      else jnp.asarray(True))
-            finite = jnp.logical_and(finite, loss_ok)
+            grads_ok = (grads_finite(grads) if (fp16 or numerics)
+                        else jnp.asarray(True))
+            # loss_ok gates the update but NOT the loss scaler below: a
+            # finite-grad NaN loss is a numerics bug, not a scale overflow —
+            # halving the scale can't fix it and would grind to min_scale
+            finite = jnp.logical_and(grads_ok, loss_ok)
 
             def do_step(operand):
                 params, opt_state, grads = operand
@@ -417,7 +420,7 @@ class DeepSpeedEngine:
             new_params, new_opt = jax.lax.cond(
                 finite, do_step, skip_step, (params, opt_state, grads))
             new_scaler = update_scaler(
-                scaler_state, finite, dynamic,
+                scaler_state, grads_ok, dynamic,
                 scale_window=cfg16.loss_scale_window,
                 min_scale=cfg16.min_loss_scale,
                 hysteresis=cfg16.hysteresis) if fp16 else scaler_state
@@ -520,18 +523,7 @@ class DeepSpeedEngine:
                 mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
                       for k, v in batch.items() if k != STEP_KEY}
             self._misc_runtime_step(mb, finite)
-        # numerics guard fires BEFORE step bookkeeping (the message must
-        # name the offending step) and only when fp16 loss scaling is not
-        # managing overflow skips itself — a dynamic-scale overflow is a
-        # routine self-recovering event, not a numerics bug
-        if self._config.numerics_check_enabled and not self.fp16_enabled \
-                and not bool(finite):
-            if self.wall_clock_breakdown:
-                self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
-            raise FloatingPointError(
-                f"numerics_check: non-finite loss or gradients at global "
-                f"step {self.global_steps} (update skipped). Inspect the "
-                f"batch/learning rate; disable 'numerics_check' to run on.")
+        self._numerics_raise_if_tripped(finite, timer=TRAIN_BATCH_TIMER)
         self._after_step(finite, loss=loss)
         self.micro_steps += gas
         if self.wall_clock_breakdown:
@@ -580,6 +572,23 @@ class DeepSpeedEngine:
         """reference engine.py:1885."""
         return self.micro_steps % self.gradient_accumulation_steps() == 0
 
+    def _numerics_raise_if_tripped(self, finite, timer=None):
+        """numerics_check raise, shared by the fused train_batch and the
+        forward/backward/step path. Fires BEFORE step bookkeeping (the
+        message must name the offending step). fp16 with DYNAMIC loss
+        scaling is exempt — a scale overflow is a routine self-recovering
+        skip; static-scale fp16 has no recovery, so it raises too."""
+        if not self._config.numerics_check_enabled or bool(finite):
+            return
+        if self.fp16_enabled and self._dynamic_scale:
+            return
+        if timer is not None and self.wall_clock_breakdown:
+            self.timers(timer).stop(synchronize=True)
+        raise FloatingPointError(
+            f"numerics_check: non-finite loss or gradients at global "
+            f"step {self.global_steps} (update skipped). Inspect the "
+            f"batch/learning rate; disable 'numerics_check' to run on.")
+
     def step(self):
         """Apply the update at the GAS boundary (reference engine.py:2000)."""
         if not self.is_gradient_accumulation_boundary():
@@ -591,6 +600,7 @@ class DeepSpeedEngine:
             self.params, self.opt_state, self.scaler_state, finite = self._jit_apply(
                 self.params, self.opt_state, self._grad_acc, self.scaler_state)
         self._grad_acc = None
+        self._numerics_raise_if_tripped(finite, timer=STEP_GLOBAL_TIMER)
         self._misc_runtime_step(self._last_micro_batch, finite)
         self._after_step(finite)
         if self.wall_clock_breakdown:

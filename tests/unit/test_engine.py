@@ -319,3 +319,31 @@ def test_numerics_check_guard():
     for a, b in zip(jax.tree_util.tree_leaves(before),
                     jax.tree_util.tree_leaves(engine.opt_state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_numerics_check_guard_step_path():
+    """The guard also covers the forward/backward/step API (not just the
+    fused train_batch)."""
+    import pytest
+
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, size=(32, 17))
+    batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "numerics_check": True,
+                "steps_per_print": 1000},
+        sample_batch=batch)
+    engine.params = jax.tree_util.tree_map(
+        lambda x: x.at[(0,) * x.ndim].set(jnp.nan) if x.ndim else x,
+        engine.params)
+    engine.forward(batch)
+    engine.backward()
+    with pytest.raises(FloatingPointError, match="numerics_check"):
+        engine.step()

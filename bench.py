@@ -30,10 +30,12 @@ def time_best(window_fn, windows: int) -> float:
     return best
 
 
-def inference_main(int8: bool = False):
-    """--inference [--int8]: fused-generation decode benchmark — TTFT (p50)
-    and decode tokens/s on the flagship model (the DS-Inference headline
-    family; reference kernels csrc/transformer/inference/)."""
+def inference_main(int8: bool = False, batch_size: int = 1):
+    """--inference [--int8] [--batch N]: fused-generation decode benchmark —
+    TTFT (p50) and decode tokens/s on the flagship model (the DS-Inference
+    headline family; reference kernels csrc/transformer/inference/).
+    ``--batch N`` measures throughput serving: decode is weight-streaming
+    bound, so tokens/s scales ~linearly with batch until compute binds."""
     import jax
     import jax.numpy as jnp
 
@@ -46,7 +48,7 @@ def inference_main(int8: bool = False):
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
             dtype=jnp.bfloat16, scan_layers=True)
-        batch, prompt_len, gen_len = 1, 512, 128
+        batch, prompt_len, gen_len = batch_size, 512, 128
     else:
         cfg = LlamaConfig.tiny(dtype=jnp.float32)
         batch, prompt_len, gen_len = 1, 16, 8
@@ -110,15 +112,19 @@ def inference_main(int8: bool = False):
 
     n_params = sum(
         x.size for x in jax.tree_util.tree_leaves(engine.params))
-    # decode is weight-streaming-bound: ratio = achieved bytes/s over v5e
-    # HBM bandwidth (~819 GB/s) — a 0-1 utilization like main()'s MFU ratio.
+    # decode is weight-streaming-bound PER STEP: one weight pass serves the
+    # whole batch, so utilization = (decode steps/s) * weight bytes over
+    # v5e HBM bandwidth (~819 GB/s) — a 0-1 ratio like main()'s MFU.
     # int8 storage is dequantized ONCE per generation (capacity win), so the
     # decode loop streams bf16 copies either way: 2 bytes/param.
     bytes_per_param = 2
-    hbm_util = (n_params * bytes_per_param * best) / 819e9 if on_tpu else 0.0
+    steps_per_sec = best / batch
+    hbm_util = (n_params * bytes_per_param * steps_per_sec) / 819e9 \
+        if on_tpu else 0.0
     print(json.dumps({
         "metric": "llama770m_decode_tokens_per_sec"
-                  + ("_int8" if int8 else ""),
+                  + ("_int8" if int8 else "")
+                  + (f"_b{batch}" if batch > 1 else ""),
         "value": round(best, 1),
         "unit": "tokens/s",
         "vs_baseline": round(hbm_util, 3),
@@ -384,7 +390,14 @@ def main():
 
 if __name__ == "__main__":
     if "--inference" in sys.argv:
-        inference_main(int8="--int8" in sys.argv)
+        bs = 1
+        if "--batch" in sys.argv:
+            i = sys.argv.index("--batch") + 1
+            if i >= len(sys.argv) or not sys.argv[i].isdigit():
+                sys.exit("--batch requires a positive integer, e.g. "
+                         "bench.py --inference --batch 8")
+            bs = int(sys.argv[i])
+        inference_main(int8="--int8" in sys.argv, batch_size=bs)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

@@ -247,6 +247,7 @@ class DeepSpeedEngine:
         self.losses = 0.0
         self._cached_grads = None
         self._grad_acc = None
+        self._loss_ok_acc = None
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
 
         # legacy curriculum learning (reference engine.py:1702-1705 +
@@ -543,6 +544,12 @@ class DeepSpeedEngine:
         with self._ctx():
             loss, grads = self._jit_grad(self.params, batch, self.scaler_state.scale)
         self._cached_grads = grads
+        if self._config.numerics_check_enabled:
+            # device-side loss-finiteness accumulator across micro-steps, so
+            # step() can gate the update like the fused path (no host sync)
+            ok = jnp.isfinite(loss)
+            self._loss_ok_acc = ok if self._loss_ok_acc is None \
+                else jnp.logical_and(self._loss_ok_acc, ok)
         # eigenvalue/MoQ at the next step() boundary need a batch
         self._last_micro_batch = {k: v for k, v in batch.items()
                                   if k != STEP_KEY}
@@ -578,9 +585,14 @@ class DeepSpeedEngine:
         message must name the offending step). fp16 with DYNAMIC loss
         scaling is exempt — a scale overflow is a routine self-recovering
         skip; static-scale fp16 has no recovery, so it raises too."""
-        if not self._config.numerics_check_enabled or bool(finite):
+        if not self._config.numerics_check_enabled:
             return
         if self.fp16_enabled and self._dynamic_scale:
+            return
+        # bool(finite) syncs on the step result — only reached when the
+        # guard is active, so the async dispatch pipeline stays intact
+        # for unguarded runs
+        if bool(finite):
             return
         if timer is not None and self.wall_clock_breakdown:
             self.timers(timer).stop(synchronize=True)
@@ -596,10 +608,14 @@ class DeepSpeedEngine:
         assert self._grad_acc is not None, "no accumulated gradients"
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
+        loss_ok = (self._loss_ok_acc if self._loss_ok_acc is not None
+                   else jnp.asarray(True))
         with self._ctx():
             self.params, self.opt_state, self.scaler_state, finite = self._jit_apply(
-                self.params, self.opt_state, self._grad_acc, self.scaler_state)
+                self.params, self.opt_state, self._grad_acc, self.scaler_state,
+                loss_ok)
         self._grad_acc = None
+        self._loss_ok_acc = None
         self._numerics_raise_if_tripped(finite, timer=STEP_GLOBAL_TIMER)
         self._misc_runtime_step(self._last_micro_batch, finite)
         self._after_step(finite)

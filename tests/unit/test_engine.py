@@ -347,3 +347,37 @@ def test_numerics_check_guard_step_path():
     engine.backward()
     with pytest.raises(FloatingPointError, match="numerics_check"):
         engine.step()
+
+
+def test_numerics_check_nan_loss_finite_grads_step_path(monkeypatch):
+    """The step-path guard also trips on a NaN LOSS with finite grads (the
+    masked-loss case): forward() accumulates loss-finiteness on device and
+    step() gates/raises like the fused path."""
+    import pytest
+
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, size=(32, 17))
+    batch = {"input_ids": toks[:, :-1], "labels": toks[:, 1:]}
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "numerics_check": True,
+                "steps_per_print": 1000},
+        sample_batch=batch)
+    # poison the loss only: wrap the loss_fn AFTER grads were built is not
+    # possible (fused jit), so simulate by forcing the accumulated flag —
+    # the contract under test is that step() consumes it
+    engine.forward(batch)
+    engine.backward()
+    engine._loss_ok_acc = jnp.asarray(False)
+    before = jax.tree_util.tree_map(lambda x: np.array(x), engine.opt_state)
+    with pytest.raises(FloatingPointError, match="numerics_check"):
+        engine.step()
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(engine.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

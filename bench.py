@@ -51,6 +51,9 @@ def inference_main(int8: bool = False, batch_size: int = 1):
         batch, prompt_len, gen_len = batch_size, 512, 128
     else:
         cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        if batch_size > 1:
+            print(f"# --batch {batch_size} ignored on the off-TPU smoke path",
+                  file=sys.stderr)
         batch, prompt_len, gen_len = 1, 16, 8
 
     model = LlamaModel(cfg)
@@ -393,7 +396,8 @@ if __name__ == "__main__":
         bs = 1
         if "--batch" in sys.argv:
             i = sys.argv.index("--batch") + 1
-            if i >= len(sys.argv) or not sys.argv[i].isdigit():
+            if i >= len(sys.argv) or not sys.argv[i].isdigit() \
+                    or int(sys.argv[i]) < 1:
                 sys.exit("--batch requires a positive integer, e.g. "
                          "bench.py --inference --batch 8")
             bs = int(sys.argv[i])

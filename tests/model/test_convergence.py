@@ -1,0 +1,101 @@
+"""System-level convergence tests (reference tests/model/{BingBertSquad,
+Megatron_GPT2} + run_sanity_check.py: real training runs that must reach a
+quality bar, used for nightly CI rather than the default suite).
+
+Marked ``nightly``: run with ``pytest -m nightly tests/model``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+pytestmark = pytest.mark.nightly
+
+
+def _copy_task_batches(rng, vocab, batch, seq, n):
+    """A learnable synthetic task: the model must copy the prompt's first
+    half into its second half (tests real sequence modeling, not just
+    memorizing one batch)."""
+    out = []
+    for _ in range(n):
+        half = rng.integers(2, vocab, size=(batch, seq // 2))
+        toks = np.concatenate([half, half], axis=1)
+        out.append({"input_ids": jnp.asarray(toks[:, :-1]),
+                    "labels": jnp.asarray(toks[:, 1:])})
+    return out
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_copy_task_converges(stage):
+    """Loss on the structured half must fall well below the unigram floor,
+    proving end-to-end learning through the engine (optimizer, schedule,
+    remat, sharding)."""
+    cfg = LlamaConfig.tiny(num_layers=2, hidden_size=128,
+                           intermediate_size=256, vocab_size=64,
+                           max_seq_len=64, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    batches = _copy_task_batches(rng, cfg.vocab_size, batch=32, seq=32, n=8)
+    engine = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": stage},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_min_lr": 0.0,
+                                         "warmup_max_lr": 3e-3,
+                                         "warmup_num_steps": 20}},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 1000},
+        sample_batch=batches[0])
+    first = float(engine.train_batch(batches[0]))
+    last = None
+    for epoch in range(30):
+        for b in batches:
+            last = float(engine.train_batch(b))
+    # random-chance CE is log(62) ~ 4.1; the copyable half drags the mean
+    # well under half that once the induction pattern is learned
+    assert last < first * 0.5 and last < 2.0, (first, last)
+
+
+def test_train_then_generate_copies():
+    """After training on the copy task, fused generation must actually copy
+    the prompt — ties the training engine to the inference engine."""
+    cfg = LlamaConfig.tiny(num_layers=2, hidden_size=128,
+                           intermediate_size=256, vocab_size=64,
+                           max_seq_len=64, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(1)
+    batches = _copy_task_batches(rng, cfg.vocab_size, batch=32, seq=32, n=8)
+    engine = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": 0},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 1000},
+        sample_batch=batches[0])
+    for epoch in range(40):
+        for b in batches:
+            engine.train_batch(b)
+
+    infer = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32",
+                             "tensor_parallel": {"tp_size": 1}},
+        params=engine.params, model_config=cfg)
+    # greedy continuation of a TRAINING sequence: the copyable second half
+    # must be reproduced from the first half (at tiny scale the model
+    # memorizes the training distribution; novel-prompt induction needs
+    # more capacity/steps than a system smoke test should spend)
+    train_ids = np.asarray(batches[0]["input_ids"])        # [32, 31]
+    prompt = train_ids[:1, :20]                            # 16 + 4 seed
+    out = np.asarray(infer.generate(jnp.asarray(prompt), max_new_tokens=11,
+                                    temperature=0.0))
+    copied = out[0, 20:31]
+    expected = train_ids[0, 20:31]
+    acc = float((copied == expected).mean())
+    assert acc >= 0.75, (acc, copied, expected)

@@ -349,7 +349,7 @@ def test_numerics_check_guard_step_path():
         engine.step()
 
 
-def test_numerics_check_nan_loss_finite_grads_step_path(monkeypatch):
+def test_numerics_check_nan_loss_finite_grads_step_path():
     """The step-path guard also trips on a NaN LOSS with finite grads (the
     masked-loss case): forward() accumulates loss-finiteness on device and
     step() gates/raises like the fused path."""

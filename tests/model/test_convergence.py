@@ -29,29 +29,38 @@ def _copy_task_batches(rng, vocab, batch, seq, n):
     return out
 
 
+def _tiny_cfg():
+    return LlamaConfig.tiny(num_layers=2, hidden_size=128,
+                            intermediate_size=256, vocab_size=64,
+                            max_seq_len=64, dtype=jnp.float32)
+
+
+def _make_engine(model, sample_batch, stage=0, scheduler=None):
+    config = {"train_micro_batch_size_per_gpu": 4,
+              "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+              "zero_optimization": {"stage": stage},
+              "gradient_clipping": 1.0,
+              "steps_per_print": 1000}
+    if scheduler is not None:
+        config["scheduler"] = scheduler
+    return deepspeed_tpu.initialize(model=model, config=config,
+                                    sample_batch=sample_batch)
+
+
 @pytest.mark.parametrize("stage", [0, 2])
 def test_copy_task_converges(stage):
     """Loss on the structured half must fall well below the unigram floor,
     proving end-to-end learning through the engine (optimizer, schedule,
     remat, sharding)."""
-    cfg = LlamaConfig.tiny(num_layers=2, hidden_size=128,
-                           intermediate_size=256, vocab_size=64,
-                           max_seq_len=64, dtype=jnp.float32)
+    cfg = _tiny_cfg()
     model = LlamaModel(cfg)
     rng = np.random.default_rng(0)
     batches = _copy_task_batches(rng, cfg.vocab_size, batch=32, seq=32, n=8)
-    engine = deepspeed_tpu.initialize(
-        model=model,
-        config={"train_micro_batch_size_per_gpu": 4,
-                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
-                "zero_optimization": {"stage": stage},
-                "scheduler": {"type": "WarmupLR",
-                              "params": {"warmup_min_lr": 0.0,
-                                         "warmup_max_lr": 3e-3,
-                                         "warmup_num_steps": 20}},
-                "gradient_clipping": 1.0,
-                "steps_per_print": 1000},
-        sample_batch=batches[0])
+    engine = _make_engine(model, batches[0], stage=stage,
+                          scheduler={"type": "WarmupLR",
+                                     "params": {"warmup_min_lr": 0.0,
+                                                "warmup_max_lr": 3e-3,
+                                                "warmup_num_steps": 20}})
     first = float(engine.train_batch(batches[0]))
     last = None
     for epoch in range(30):
@@ -65,20 +74,11 @@ def test_copy_task_converges(stage):
 def test_train_then_generate_copies():
     """After training on the copy task, fused generation must actually copy
     the prompt — ties the training engine to the inference engine."""
-    cfg = LlamaConfig.tiny(num_layers=2, hidden_size=128,
-                           intermediate_size=256, vocab_size=64,
-                           max_seq_len=64, dtype=jnp.float32)
+    cfg = _tiny_cfg()
     model = LlamaModel(cfg)
     rng = np.random.default_rng(1)
     batches = _copy_task_batches(rng, cfg.vocab_size, batch=32, seq=32, n=8)
-    engine = deepspeed_tpu.initialize(
-        model=model,
-        config={"train_micro_batch_size_per_gpu": 4,
-                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
-                "zero_optimization": {"stage": 0},
-                "gradient_clipping": 1.0,
-                "steps_per_print": 1000},
-        sample_batch=batches[0])
+    engine = _make_engine(model, batches[0])
     for epoch in range(40):
         for b in batches:
             engine.train_batch(b)

@@ -23,12 +23,53 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.sampling import sample_logits
-from deepspeed_tpu.models.llama import (
-    LlamaDecoderModel, LlamaModel, init_kv_caches,
-)
 from deepspeed_tpu.parallel.mesh import make_mesh
 from deepspeed_tpu.parallel.partition import tree_shardings
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def resolve_decoder(cfg):
+    """(decoder_module, init_kv_caches_fn) for a model config.
+
+    Dispatches LlamaConfig → LlamaDecoderModel and TransformerConfig →
+    TransformerDecoderModel, so ``generate()`` serves every policy-converted
+    architecture — the breadth of the reference's generate()
+    (deepspeed/inference/engine.py:614 over 18 container policies).
+    """
+    from deepspeed_tpu.models.llama import (
+        LlamaConfig, LlamaDecoderModel, init_kv_caches as llama_kv_caches,
+    )
+    from deepspeed_tpu.models.unified import (
+        TransformerConfig, TransformerDecoderModel,
+        init_kv_caches as unified_kv_caches,
+    )
+
+    if isinstance(cfg, LlamaConfig):
+        return LlamaDecoderModel(cfg), llama_kv_caches
+    if isinstance(cfg, TransformerConfig):
+        if not cfg.causal or not cfg.lm_head:
+            raise ValueError(
+                "generate() requires a causal LM; encoder architectures "
+                f"(causal={cfg.causal}, lm_head={cfg.lm_head}) have no "
+                "decode path — use forward() for encoder outputs")
+        return TransformerDecoderModel(cfg), unified_kv_caches
+    raise ValueError(
+        f"generate() needs a LlamaConfig or TransformerConfig model config, "
+        f"got {type(cfg).__name__}")
+
+
+def check_decode_length(cfg, total_len: int) -> None:
+    """Learned-position tables are finite: decoding past ``max_seq_len``
+    would silently clamp the embedding gather (XLA out-of-bounds semantics),
+    degrading output where HF raises — so raise here. Rotary/ALiBi configs
+    have no table and no hard limit."""
+    if getattr(cfg, "pos_emb", None) == "learned":
+        limit = getattr(cfg, "max_seq_len", None)
+        if limit is not None and total_len > limit:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total_len} exceeds the learned "
+                f"position table (max_seq_len={limit}); longer generation "
+                f"needs a rotary/alibi architecture or a larger table")
 
 
 GEN_BUCKET = 32         # max_new_tokens rounds up to this program capacity
@@ -133,6 +174,15 @@ class InferenceEngine:
             merged.update(kwargs)
             self._config = DeepSpeedInferenceConfig(**merged)
 
+        # An InjectedModel (module_inject.convert_hf_model) bundles the flax
+        # module, converted params, and unified config — unpack it so
+        # ``init_inference(model=convert_hf_model(hf_model))`` just works
+        # (reference one-line init_inference on any supported HF model).
+        if (model is not None and hasattr(model, "cfg")
+                and hasattr(model, "params") and hasattr(model, "model")):
+            params = model.params if params is None else params
+            model_config = model_config or model.cfg
+            model = model.model
         self.module = model
         self.model_config = model_config or getattr(model, "cfg", None)
         tp = self._config.tensor_parallel.tp_size
@@ -284,14 +334,15 @@ class InferenceEngine:
         allocates one arena from max_out_tokens) and the single-token decode
         step (kept for API parity and step-wise use)."""
         cfg = self.model_config
-        assert cfg is not None, "generate() requires a model with .cfg (LlamaConfig)"
+        assert cfg is not None, \
+            "generate() requires a model config (LlamaConfig/TransformerConfig)"
         if self._kv_caches is not None and \
                 self._kv_caches[0].shape[1] == batch_size and \
                 self._kv_caches[0].shape[2] >= max_len:
             return
-        decoder = LlamaDecoderModel(cfg)
+        decoder, init_caches = resolve_decoder(cfg)
         self._decoder = decoder
-        self._kv_caches = init_kv_caches(cfg, batch_size, max_len, self.dtype)
+        self._kv_caches = init_caches(cfg, batch_size, max_len, self.dtype)
         self._gen_cache = OrderedDict()
 
         def step(params, tokens, caches, index):
@@ -328,6 +379,7 @@ class InferenceEngine:
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
+        check_decode_length(self.model_config, T + max_new_tokens)
         self._ensure_decode(B, T + gen_capacity(max_new_tokens))
         decoder = self._decoder
 

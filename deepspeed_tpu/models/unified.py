@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.transformer import (
-    MLP, GatedMLP, RMSNorm, SelfAttention, alibi_bias, make_causal_mask,
+    MLP, GatedMLP, RMSNorm, SelfAttention, alibi_bias, alibi_slopes,
+    make_causal_mask,
 )
 
 Dtype = Any
@@ -158,11 +159,20 @@ class DenseRoutedMoE(nn.Module):
 
 
 class UnifiedBlock(nn.Module):
+    """One block spanning the policy zoo's topology space.
+
+    With ``kv_cache``/``cache_index`` the attention appends to a functional
+    KV cache and the block returns ``(out, new_cache)`` — the decode-mode
+    contract mirroring the reference's preallocated inference arena
+    (csrc/transformer/inference/includes/inference_context.h); without, it
+    is the training/prefill forward returning ``out``.
+    """
+
     cfg: TransformerConfig
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, mask, positions):
+    def __call__(self, x, mask, positions, kv_cache=None, cache_index=None):
         cfg = self.cfg
         attn = SelfAttention(
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
@@ -185,18 +195,36 @@ class UnifiedBlock(nn.Module):
                       use_bias=cfg.mlp_bias, activation=_act(cfg.activation),
                       name="mlp")
 
+        def attend(h):
+            # SelfAttention returns (out, cache) iff kv_cache is given
+            return attn(h, mask=mask, positions=positions,
+                        kv_cache=kv_cache, cache_index=cache_index)
+
+        new_cache = None
         if cfg.parallel_attn:
             # x + attn(ln1(x)) + mlp(ln1(x) or ln2(x))  (GPT-J / GPT-NeoX)
             h1 = _norm(cfg, "ln_1")(x)
             h2 = h1 if cfg.parallel_shared_ln else _norm(cfg, "ln_2")(x)
-            return x + attn(h1, mask=mask, positions=positions) + mlp(h2)
-        if cfg.pre_ln:
-            h = attn(_norm(cfg, "ln_1")(x), mask=mask, positions=positions)
-            x = x + h
-            return x + mlp(_norm(cfg, "ln_2")(x))
-        # post-LN (BERT): ln(x + sub(x))
-        x = _norm(cfg, "ln_1")(x + attn(x, mask=mask, positions=positions))
-        return _norm(cfg, "ln_2")(x + mlp(x))
+            a = attend(h1)
+            if kv_cache is not None:
+                a, new_cache = a
+            out = x + a + mlp(h2)
+        elif cfg.pre_ln:
+            a = attend(_norm(cfg, "ln_1")(x))
+            if kv_cache is not None:
+                a, new_cache = a
+            x = x + a
+            out = x + mlp(_norm(cfg, "ln_2")(x))
+        else:
+            # post-LN (BERT): ln(x + sub(x))
+            a = attend(x)
+            if kv_cache is not None:
+                a, new_cache = a
+            x = _norm(cfg, "ln_1")(x + a)
+            out = _norm(cfg, "ln_2")(x + mlp(x))
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 def _window_mask(seq_len: int, window: int) -> jnp.ndarray:
@@ -274,3 +302,93 @@ class TransformerLM(nn.Module):
                               dtype=cfg.dtype, param_dtype=jnp.float32,
                               name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+class TransformerDecoderModel(nn.Module):
+    """Decode-mode twin of :class:`TransformerLM`: same parameter tree, takes
+    and returns preallocated KV caches — this is what makes
+    ``init_inference(...).generate()`` work for every converted architecture
+    (gpt2/gptj/gptneo/gptneox/opt/bloom/mixtral/…), matching the breadth of
+    the reference's ``InferenceEngine.generate()``
+    (deepspeed/inference/engine.py:614) over its 18 injection policies.
+
+    kv_caches: (k, v) arrays of shape [L, B, S_max, n_kv, head_dim].
+    cache_index: int32 scalar — write offset (tokens already in cache).
+    Prompts are assumed unpadded (positions = cache_index + arange), the
+    same contract as generation through the reference's fused kernels.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, kv_caches, cache_index):
+        cfg = self.cfg
+        if not cfg.causal or not cfg.lm_head:
+            raise ValueError(
+                "TransformerDecoderModel requires a causal LM config "
+                "(encoder architectures cannot generate)")
+        B, T = input_ids.shape
+        S_max = kv_caches[0].shape[2]
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wte")
+        x = wte(input_ids)
+        positions = cache_index + jnp.arange(T, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (B, T))
+        if cfg.pos_emb == "learned":
+            wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=jnp.float32, name="wpe")
+            x = x + wpe(positions + cfg.pos_offset)
+        if cfg.token_type_vocab:
+            tte = nn.Embed(cfg.token_type_vocab, cfg.hidden_size, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="wtte")
+            x = x + tte(jnp.zeros_like(input_ids))
+        if cfg.embed_ln or not cfg.pre_ln:
+            x = _norm(cfg, "ln_emb")(x)
+
+        # rows attend to cache slots up to their own absolute position
+        row_pos = cache_index + jnp.arange(T)[:, None]           # [T, 1]
+        col = jnp.arange(S_max)[None, :]                         # [1, S_max]
+        neg = jnp.finfo(jnp.float32).min
+        base_mask = jnp.where(col <= row_pos, 0.0, neg)[None, None, :, :]
+        if cfg.pos_emb == "alibi":
+            slopes = alibi_slopes(cfg.num_heads)
+            rel = (col - row_pos).astype(jnp.float32)            # [T, S_max]
+            base_mask = base_mask + (slopes[None, :, None, None]
+                                     * rel[None, None, :, :])
+
+        new_k, new_v = [], []
+        for i in range(cfg.num_layers):
+            mask = base_mask
+            if cfg.attn_windows is not None and cfg.attn_windows[i]:
+                w = cfg.attn_windows[i]
+                mask = mask + jnp.where(col > row_pos - w, 0.0,
+                                        neg)[None, None, :, :]
+            x, (ck, cv) = UnifiedBlock(cfg, layer_idx=i, name=f"layer_{i}")(
+                x, mask, positions,
+                kv_cache=(kv_caches[0][i], kv_caches[1][i]),
+                cache_index=cache_index)
+            new_k.append(ck)
+            new_v.append(cv)
+        new_caches = (jnp.stack(new_k), jnp.stack(new_v))
+
+        if cfg.final_norm:
+            x = _norm(cfg, "ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              name="lm_head")(x)
+        return logits.astype(jnp.float32), new_caches
+
+
+def init_kv_caches(cfg: TransformerConfig, batch_size: int, max_seq_len: int,
+                   dtype=None):
+    """Preallocated KV workspace for :class:`TransformerDecoderModel` (the
+    reference sizes one arena from max_out_tokens,
+    inference_context.h:129-141)."""
+    n_kv = cfg.num_kv_heads or cfg.num_heads
+    head_dim = cfg.hidden_size // cfg.num_heads
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch_size, max_seq_len, n_kv, head_dim)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))

@@ -16,7 +16,6 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.llama import LlamaDecoderModel, init_kv_caches
 from deepspeed_tpu.ops.lora import fuse_lora, unfuse_lora
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.utils.logging import log_dist
@@ -59,16 +58,19 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     # --- KV workspace mgmt (reference :165-177) ---------------------------
     def _ensure_decode(self, batch_size: int, max_len: int):
+        from deepspeed_tpu.inference.engine import resolve_decoder
+
         assert self.model_cfg is not None, \
-            "hybrid engine generate() needs model_config (LlamaConfig)"
+            "hybrid engine generate() needs model_config " \
+            "(LlamaConfig or TransformerConfig)"
         if self._kv_caches is not None and \
                 self._kv_caches[0].shape[1] == batch_size and \
                 self._kv_caches[0].shape[2] >= max_len:
             return
-        decoder = LlamaDecoderModel(self.model_cfg)
+        decoder, init_caches = resolve_decoder(self.model_cfg)
         self._decoder = decoder
-        self._kv_caches = init_kv_caches(self.model_cfg, batch_size, max_len,
-                                         self.compute_dtype)
+        self._kv_caches = init_caches(self.model_cfg, batch_size, max_len,
+                                      self.compute_dtype)
         self._gen_cache = OrderedDict()
         self._decode_fn = jax.jit(
             lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
@@ -97,8 +99,9 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         params — one fused prefill+decode program and one compiled-program
         cache policy shared with the inference engine
         (inference/engine.py get_or_build_gen_fn)."""
-        from deepspeed_tpu.inference.engine import gen_capacity, \
-            get_or_build_gen_fn
+        from deepspeed_tpu.inference.engine import (
+            check_decode_length, gen_capacity, get_or_build_gen_fn,
+        )
 
         was_training = not self._in_eval
         if was_training:
@@ -107,6 +110,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
+        check_decode_length(self.model_cfg, T + max_new_tokens)
         self._ensure_decode(B, T + gen_capacity(max_new_tokens))
         decoder = self._decoder
         gen_fn, cap = get_or_build_gen_fn(

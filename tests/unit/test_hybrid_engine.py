@@ -123,3 +123,35 @@ def test_hybrid_lora_flip():
     for a, b in zip(jax.tree_util.tree_leaves(base),
                     jax.tree_util.tree_leaves(back)):
         np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_hybrid_engine_non_llama_unified_model():
+    """The RLHF flip must work for any policy architecture, not just LLaMA:
+    a unified-model (GPT-2-shaped) actor trains and generates through the
+    same resolve_decoder path the inference engine uses."""
+    from deepspeed_tpu.models.unified import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                            num_heads=4, intermediate_size=48, max_seq_len=64,
+                            pos_emb="learned", dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(3)
+
+    def batch(bs=8, seq=12):
+        t = rng.integers(0, 96, (bs, seq + 1))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    engine = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False},
+                "hybrid_engine": {"enabled": True, "max_out_tokens": 64}},
+        sample_batch=batch(),
+        model_config=cfg)
+    l1 = float(engine.train_batch(batch()))
+    out = engine.generate(jnp.asarray(rng.integers(0, 96, (2, 6))),
+                          max_new_tokens=5)
+    assert out.shape == (2, 11)
+    l2 = float(engine.train_batch(batch()))
+    assert np.isfinite(l1) and np.isfinite(l2)

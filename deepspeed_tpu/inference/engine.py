@@ -82,17 +82,24 @@ def gen_capacity(max_new_tokens: int) -> int:
 
 
 def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
-                        max_new_tokens: int, params_fn=None):
+                        max_new_tokens: int, params_fn=None,
+                        params_key=None):
     """Shared compiled-generation cache policy (used by InferenceEngine and
     the RLHF hybrid engine): capacity-bucketed keys, true LRU eviction.
-    Returns ``(gen_fn, cap)``."""
+    Returns ``(gen_fn, cap)``.
+
+    ``params_key`` is the stable cache token identifying the ``params_fn``
+    transform (e.g. a quantization tag) — prefer it for ad-hoc callables:
+    the ``id()`` fallback can collide when a garbage-collected function's
+    id is reused, silently serving a stale compiled program."""
     cap = gen_capacity(max_new_tokens)
     # params_fn identity is part of the program: a cached non-dequantizing
     # fn must not be reused if quantization is toggled between calls.
     # (unwrap bound methods — each attribute access creates a fresh object)
-    pf_key = (None if params_fn is None
-              else id(getattr(params_fn, "__func__", params_fn)))
-    key = (B, T, cap, pf_key)
+    if params_key is None:
+        params_key = (None if params_fn is None
+                      else id(getattr(params_fn, "__func__", params_fn)))
+    key = (B, T, cap, params_key)
     if not isinstance(cache, OrderedDict):
         raise TypeError("gen cache must be an OrderedDict")
     if key in cache:
@@ -390,7 +397,9 @@ class InferenceEngine:
         # the decode loop — see build_generate_fn
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache, apply_fn, B, T, max_new_tokens,
-            params_fn=self._effective_params if self._quantized else None)
+            params_fn=self._effective_params if self._quantized else None,
+            params_key=("int8w", self._config.quant.bits)
+            if self._quantized else None)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         eos = -1 if eos_token_id is None else int(eos_token_id)

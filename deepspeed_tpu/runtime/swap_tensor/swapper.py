@@ -82,15 +82,19 @@ class AsyncTensorSwapper:
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.{i}.bin")
 
-    def _drain_writes_for(self, name: str) -> None:
+    def _drain_writes_for(self, name: str, context: str = "read") -> None:
         if name in self._pending_writes:
             failures = self.wait()
             if failures:
-                raise IOError(f"drain before read of {name}: "
+                raise IOError(f"drain before {context} of {name}: "
                               f"{failures} write failures")
 
     def swap_out(self, name: str, tree: Any, blocking: bool = True) -> None:
         """Write a pytree to disk (async submit; optional wait)."""
+        # write-after-write: a still-in-flight non-blocking swap_out of the
+        # same name would race these pwrites into the same files with no
+        # ordering guarantee from the AIO pool — drain it first
+        self._drain_writes_for(name, context="rewrite")
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         shapes = []
         for i, leaf in enumerate(leaves):

@@ -117,11 +117,18 @@ class ContiguousMemoryAllocator:
     # -- internals -------------------------------------------------------
 
     def _view(self, alloc_id: int) -> np.ndarray:
-        off, numel = self._live[alloc_id]
-        return self.buffer[off:off + numel]
+        # under the lock: a concurrent allocate(allow_defrag=True) may
+        # memmove live regions, so the offset must be read atomically with
+        # respect to compaction. NOTE the returned view's base can still be
+        # invalidated by a LATER defrag — threads holding views across
+        # allocate() calls must use allow_defrag=False (the swapper does).
+        with self._lock:
+            off, numel = self._live[alloc_id]
+            return self.buffer[off:off + numel]
 
     def _offset(self, alloc_id: int) -> int:
-        return self._live[alloc_id][0]
+        with self._lock:
+            return self._live[alloc_id][0]
 
     def _holes(self):
         """Yield (offset, length) free runs in offset order."""

@@ -213,7 +213,20 @@ class DeepSpeedEngine:
 
         # optimizer -----------------------------------------------------------
         self.optimizer, self._lr_schedule = self._configure_optimizer()
-        self.opt_state = self._sharded_opt_init()
+        # ZeRO-Infinity (reference stage3.py:1775-1835): optimizer states
+        # live on NVMe; the step swaps them through per sub-group
+        from deepspeed_tpu.runtime.zero.infinity import (
+            NVMeOptimizerStates, validate_nvme_config,
+        )
+
+        validate_nvme_config(self._config)
+        self._nvme = None
+        if self._config.zero_config.offload_optimizer_device == "nvme":
+            self._nvme = NVMeOptimizerStates(self.params, self.zero_plan,
+                                             self.mesh, self._config)
+            self.opt_state = ()     # states are on NVMe, not in the pytree
+        else:
+            self.opt_state = self._sharded_opt_init()
 
         # loss scaler (fp16 only) ---------------------------------------------
         if self.fp16_enabled:
@@ -457,6 +470,34 @@ class DeepSpeedEngine:
                 params, opt_state, grads, scaler_state, loss_ok)
             return new_params, new_opt, new_scaler, loss, finite
 
+        def grads_batch_fn(params, scaler_state, batch):
+            """NVMe path: the fused program minus the update — loss, grads,
+            global norm, and finiteness, all in one compiled program."""
+            scale = scaler_state.scale
+            if gas == 1:
+                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
+                loss, grads = grad_step(params, mb, scale)
+            else:
+                def micro(carry, mb):
+                    acc, loss_sum = carry
+                    loss, g = grad_step(params, mb, scale)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                    return (acc, loss_sum + loss), None
+
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s),
+                    params, grad_shardings)
+                (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0),
+                                                  batch)
+                grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
+                loss = loss_sum / gas
+            gnorm = optax.global_norm(grads)
+            grads_ok = (grads_finite(grads) if (fp16 or numerics)
+                        else jnp.asarray(True))
+            loss_ok = (jnp.isfinite(loss) if numerics else jnp.asarray(True))
+            return loss, grads, gnorm, grads_ok, loss_ok
+
         with jax.set_mesh(mesh):
             self._jit_loss = jax.jit(lambda p, b: loss_fn(p, b))
             self._jit_grad = jax.jit(grad_step)
@@ -465,6 +506,12 @@ class DeepSpeedEngine:
             self._jit_accum = jax.jit(
                 lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g),
                 donate_argnums=(0,))
+            if self._nvme is not None:
+                self._jit_grads_batch = jax.jit(grads_batch_fn)
+                self._jit_gnorm_finite = jax.jit(
+                    lambda g: (optax.global_norm(g),
+                               grads_finite(g) if (fp16 or numerics)
+                               else jnp.asarray(True)))
 
     # --- data placement -------------------------------------------------------
     def _shard_batch(self, batch: Dict[str, Any], leading_gas: bool = False):
@@ -514,10 +561,13 @@ class DeepSpeedEngine:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         self._maybe_profile_flops(batch)
-        with self._ctx():
-            self.params, self.opt_state, self.scaler_state, loss, finite = \
-                self._jit_train_batch(self.params, self.opt_state,
-                                      self.scaler_state, batch)
+        if self._nvme is not None:
+            loss, finite = self._train_batch_nvme(batch)
+        else:
+            with self._ctx():
+                self.params, self.opt_state, self.scaler_state, loss, finite = \
+                    self._jit_train_batch(self.params, self.opt_state,
+                                          self.scaler_state, batch)
         if self.eigenvalue is not None or self.quantizer is not None:
             mb = None
             if self.eigenvalue is not None:  # only the eigenvalue path reads it
@@ -530,6 +580,44 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
         return loss
+
+    def _clip_scale(self, gnorm: float) -> float:
+        clip = self._config.gradient_clipping
+        if clip and clip > 0:
+            return min(1.0, clip / (gnorm + 1e-6))
+        return 1.0
+
+    def _nvme_apply(self, grads, gnorm, grads_ok, loss_ok):
+        """Shared NVMe update epilogue: host-gated sub-group swap step +
+        loss-scaler update (the in-graph lax.cond skip of the fused path
+        becomes a host branch — the step already syncs on disk I/O)."""
+        finite = jnp.logical_and(grads_ok, loss_ok)
+        if bool(finite):
+            # LR from the count of APPLIED updates (the NVMe analogue of
+            # optax's internal count, which the fused path's lax.cond skip
+            # leaves unincremented on overflow) — NOT global_steps, which
+            # advances on skipped steps too
+            lr = (float(self._lr_schedule(self._nvme.count))
+                  if self._lr_schedule else None)
+            self.params = self._nvme.step(
+                self.params, grads, self._clip_scale(float(gnorm)), lr=lr)
+        if self.fp16_enabled:
+            cfg16 = self._config.fp16
+            self.scaler_state = update_scaler(
+                self.scaler_state, grads_ok, self._dynamic_scale,
+                scale_window=cfg16.loss_scale_window,
+                min_scale=cfg16.min_loss_scale,
+                hysteresis=cfg16.hysteresis)
+        return finite
+
+    def _train_batch_nvme(self, batch):
+        """ZeRO-Infinity train step: one jitted grads program, then the
+        pipelined per-sub-group swapped update (reference stage3.py:1775)."""
+        with self._ctx():
+            loss, grads, gnorm, grads_ok, loss_ok = self._jit_grads_batch(
+                self.params, self.scaler_state, batch)
+            finite = self._nvme_apply(grads, gnorm, grads_ok, loss_ok)
+        return loss, finite
 
     def __call__(self, batch: Dict[str, Any]):
         return self.forward(batch)
@@ -611,9 +699,14 @@ class DeepSpeedEngine:
         loss_ok = (self._loss_ok_acc if self._loss_ok_acc is not None
                    else jnp.asarray(True))
         with self._ctx():
-            self.params, self.opt_state, self.scaler_state, finite = self._jit_apply(
-                self.params, self.opt_state, self._grad_acc, self.scaler_state,
-                loss_ok)
+            if self._nvme is not None:
+                gnorm, grads_ok = self._jit_gnorm_finite(self._grad_acc)
+                finite = self._nvme_apply(self._grad_acc, gnorm, grads_ok,
+                                          loss_ok)
+            else:
+                self.params, self.opt_state, self.scaler_state, finite = \
+                    self._jit_apply(self.params, self.opt_state,
+                                    self._grad_acc, self.scaler_state, loss_ok)
         self._grad_acc = None
         self._loss_ok_acc = None
         self._numerics_raise_if_tripped(finite, timer=STEP_GLOBAL_TIMER)
@@ -774,7 +867,8 @@ class DeepSpeedEngine:
         tag = tag or f"global_step{self.global_steps}"
         state = {
             "params": self.params,
-            "opt_state": self.opt_state,
+            "opt_state": (self._nvme.gather_state() if self._nvme is not None
+                          else self.opt_state),
             "scaler": self.scaler_state,
         }
         meta = {
@@ -793,13 +887,17 @@ class DeepSpeedEngine:
         engine = self.checkpoint_engine
         template = {
             "params": self.params,
-            "opt_state": self.opt_state,
+            "opt_state": (self._nvme.state_template()
+                          if self._nvme is not None else self.opt_state),
             "scaler": self.scaler_state,
         }
         state, meta = engine.load(load_dir, tag, template)
         self.params = state["params"]
         if load_optimizer_states:
-            self.opt_state = state["opt_state"]
+            if self._nvme is not None:
+                self._nvme.load_state(state["opt_state"])
+            else:
+                self.opt_state = state["opt_state"]
             self.scaler_state = state["scaler"]
         self.global_steps = meta.get("global_steps", 0)
         self.global_samples = meta.get("global_samples", 0)

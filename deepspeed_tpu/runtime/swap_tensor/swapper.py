@@ -226,18 +226,25 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
         self._prefetched[name] = self.swapper.submit_reads(name,
                                                            self._read_aio)
 
-    def acquire(self, name: str, sharding=None) -> Any:
+    def acquire(self, name: str, sharding=None, device_put: bool = True) -> Any:
         """Finish the prefetched reads (or read synchronously) and return
-        the device-resident state."""
+        the state — device-resident, or host copies with
+        ``device_put=False`` (callers owning per-leaf shardings transfer
+        once themselves instead of staging through the default device)."""
         if name not in self._prefetched:
-            return self.fetch(name, sharding=sharding)
+            return self.swapper.swap_in(name, device_put=device_put,
+                                        sharding=sharding)
         treedef, buffers, handles = self._prefetched.pop(name)
         failures = self._read_aio.wait()
         self._reap_stale()          # discarded prefetches are now quiesced
         if failures:
             self.swapper._free_staging(handles)
             raise IOError(f"acquire({name}): {failures} read failures")
-        arrs = self.swapper._to_device(buffers, handles, sharding)
+        if device_put:
+            arrs = self.swapper._to_device(buffers, handles, sharding)
+        else:
+            arrs = [np.array(b) if h is not None else b
+                    for b, h in zip(buffers, handles)]
         self.swapper._free_staging(handles)
         return jax.tree_util.tree_unflatten(treedef, arrs)
 

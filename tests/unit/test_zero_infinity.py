@@ -1,0 +1,141 @@
+"""ZeRO-Infinity engine wiring: offload_optimizer.device=nvme really swaps.
+
+VERDICT r1 #3: the swappers existed but the engine ignored device=nvme.
+These tests pin (a) training through the engine with NVMe-swapped optimizer
+states matches plain AdamW step-for-step, (b) unsupported combinations
+error loudly, (c) checkpoint save/load round-trips the on-disk states.
+Reference: stage3.py:1775-1835 (per-sub-group swapped step).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def _batch(rng, bs=8, seq=16):
+    t = rng.integers(0, 256, (bs, seq + 1))
+    return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+
+def _config(extra_zero=None, opt_type="adamw"):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": opt_type,
+                      "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": False},
+        "zero_optimization": {"stage": 1},
+    }
+    if extra_zero:
+        cfg["zero_optimization"].update(extra_zero)
+    return cfg
+
+
+def _engine(tmp_path=None, nvme=False, sub_group_size=None, opt_type="adamw",
+            gas=1):
+    extra = {}
+    if nvme:
+        extra = {"offload_optimizer": {"device": "nvme",
+                                       "nvme_path": str(tmp_path)}}
+        if sub_group_size:
+            extra["sub_group_size"] = sub_group_size
+    cfg = _config(extra, opt_type)
+    cfg["gradient_accumulation_steps"] = gas
+    cfg["train_batch_size"] = 8 * gas
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    rng = np.random.default_rng(0)
+    engine = deepspeed_tpu.initialize(model=model, config=cfg,
+                                      sample_batch=_batch(rng))
+    return engine, rng
+
+
+def test_nvme_matches_plain_adamw(tmp_path):
+    """Same seed → the NVMe-swapped per-group AdamW must track optax adamw
+    step-for-step (bias correction, weight decay, global-norm clipping)."""
+    e_ref, rng_a = _engine()
+    e_nvme, rng_b = _engine(tmp_path, nvme=True, sub_group_size=4000)
+    assert e_nvme._nvme is not None
+    assert len(e_nvme._nvme.groups) > 2, "sub_group_size must force >1 group"
+    # on-disk state files exist before the first step
+    assert any(f.startswith("opt_group") for f in os.listdir(tmp_path))
+
+    for i in range(5):
+        b = _batch(np.random.default_rng(100 + i))
+        l_ref = float(e_ref.train_batch(b))
+        l_nvme = float(e_nvme.train_batch(b))
+        np.testing.assert_allclose(l_nvme, l_ref, rtol=2e-4, atol=2e-4)
+
+    pa = jax.tree_util.tree_leaves(e_ref.params)
+    pb = jax.tree_util.tree_leaves(e_nvme.params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_nvme_loss_decreases(tmp_path):
+    e, rng = _engine(tmp_path, nvme=True, sub_group_size=4000)
+    b = _batch(rng)
+    losses = [float(e.train_batch(b)) for _ in range(6)]
+    assert losses[-1] < losses[0], f"no learning through NVMe path: {losses}"
+
+
+def test_nvme_step_path(tmp_path):
+    """forward/backward/step parity path also swaps."""
+    e, rng = _engine(tmp_path, nvme=True, sub_group_size=4000, gas=2)
+    b1, b2 = _batch(rng), _batch(rng)
+    l1 = e.forward(b1)
+    e.backward(l1)
+    l2 = e.forward(b2)
+    e.backward(l2)
+    assert e.is_gradient_accumulation_boundary()
+    e.step()
+    assert e._nvme.count == 1
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+
+
+def test_nvme_checkpoint_roundtrip(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    swap_a, swap_b = tmp_path / "swapA", tmp_path / "swapB"
+    swap_a.mkdir(), swap_b.mkdir()
+    e1, rng = _engine(swap_a, nvme=True, sub_group_size=4000)
+    for i in range(2):
+        e1.train_batch(_batch(np.random.default_rng(i)))
+    e1.save_checkpoint(str(ckpt))
+    cont = [float(e1.train_batch(_batch(np.random.default_rng(10 + i))))
+            for i in range(2)]
+
+    e2, _ = _engine(swap_b, nvme=True, sub_group_size=4000)
+    e2.load_checkpoint(str(ckpt))
+    assert e2._nvme.count == e1._nvme.count - 2
+    resumed = [float(e2.train_batch(_batch(np.random.default_rng(10 + i))))
+               for i in range(2)]
+    np.testing.assert_allclose(resumed, cont, rtol=1e-4, atol=1e-4)
+
+
+def test_nvme_rejects_non_adam(tmp_path):
+    with pytest.raises(ValueError, match="Adam-family"):
+        _engine(tmp_path, nvme=True, opt_type="sgd")
+
+
+def test_nvme_requires_path():
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    cfg = _config({"offload_optimizer": {"device": "nvme"}})
+    with pytest.raises(ValueError, match="nvme_path"):
+        deepspeed_tpu.initialize(model=model, config=cfg,
+                                 sample_batch=_batch(np.random.default_rng(0)))
+
+
+def test_param_nvme_offload_errors_loudly():
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    cfg = _config({"stage": 3,
+                   "offload_param": {"device": "nvme", "nvme_path": "/tmp/x"}})
+    with pytest.raises(NotImplementedError, match="offload_param"):
+        deepspeed_tpu.initialize(model=model, config=cfg,
+                                 sample_batch=_batch(np.random.default_rng(0)))

@@ -88,9 +88,9 @@ def initialize(model=None,
     if resolved.hybrid_engine.enabled:
         from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
 
-        return DeepSpeedHybridEngine(model_config=model_config,
-                                     lora_adapters=lora_adapters, **common)
-    if resolved.mesh.pipe > 1 and loss_fn is None:
+        engine = DeepSpeedHybridEngine(model_config=model_config,
+                                       lora_adapters=lora_adapters, **common)
+    elif resolved.mesh.pipe > 1 and loss_fn is None:
         # pipe axis requested → pipeline engine (analogue of the reference's
         # PipelineModule dispatch, deepspeed/__init__.py:150-190)
         from deepspeed_tpu.parallel.mesh import make_mesh as _mk
@@ -99,14 +99,22 @@ def initialize(model=None,
         if common["mesh"] is None:
             common["mesh"] = _mk(resolved.mesh)
         common.pop("loss_fn")
-        return PipelineEngine(model_config=model_config, **common)
-    return DeepSpeedEngine(**common)
+        engine = PipelineEngine(model_config=model_config, **common)
+    else:
+        engine = DeepSpeedEngine(**common)
+    if training_data is not None:
+        # reference deepspeed_io wiring (engine.py:1571): attach a loader
+        # sized to the global batch; train_batch() with no argument
+        # consumes it
+        engine.deepspeed_io(training_data)
+    return engine
 
 
 def initialize_legacy(*posargs, **kwargs):
     """4-tuple form for reference API parity."""
     engine = initialize(*posargs, **kwargs)
-    return engine, engine.optimizer, None, engine.client_lr_scheduler
+    return (engine, engine.optimizer, engine.training_dataloader,
+            engine.client_lr_scheduler)
 
 
 def init_inference(model=None, config=None, **kwargs):

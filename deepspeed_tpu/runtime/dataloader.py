@@ -14,11 +14,17 @@ import numpy as np
 
 
 class RepeatingLoader:
-    """Wrap an iterator to restart on StopIteration (reference :17)."""
+    """Wrap an iterator to restart on StopIteration (reference :17).
+
+    Each wrap-around advances the wrapped loader's epoch (``set_epoch``)
+    so a shuffling loader reshuffles per epoch instead of replaying the
+    same batch order forever.
+    """
 
     def __init__(self, loader):
         self.loader = loader
         self.data_iter = iter(self.loader)
+        self._epoch = 0
 
     def __iter__(self):
         return self
@@ -27,6 +33,9 @@ class RepeatingLoader:
         try:
             return next(self.data_iter)
         except StopIteration:
+            self._epoch += 1
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(self._epoch)
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
 

@@ -222,8 +222,13 @@ class DeepSpeedEngine:
         validate_nvme_config(self._config)
         self._nvme = None
         if self._config.zero_config.offload_optimizer_device == "nvme":
+            import weakref
+
             self._nvme = NVMeOptimizerStates(self.params, self.zero_plan,
                                              self.mesh, self._config)
+            # AIO thread pools/fds must not outlive the engine (long-lived
+            # processes build many engines — sweeps, test suites)
+            self._nvme_finalizer = weakref.finalize(self, self._nvme.close)
             self.opt_state = ()     # states are on NVMe, not in the pytree
         else:
             self.opt_state = self._sharded_opt_init()
@@ -261,6 +266,8 @@ class DeepSpeedEngine:
         self._cached_grads = None
         self._grad_acc = None
         self._loss_ok_acc = None
+        self.training_dataloader = None
+        self._train_iter = None
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
 
         # legacy curriculum learning (reference engine.py:1702-1705 +
@@ -440,28 +447,32 @@ class DeepSpeedEngine:
                 hysteresis=cfg16.hysteresis) if fp16 else scaler_state
             return new_params, new_opt, new_scaler, finite
 
+        def accumulate_grads(params, scale, batch):
+            """All GAS micro-batches → (mean loss, mean grads); shared by
+            the fused and NVMe step programs so their trajectories cannot
+            desynchronize."""
+            if gas == 1:
+                # no accumulator buffer needed — one fused fwd+bwd
+                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
+                return grad_step(params, mb, scale)
+
+            def micro(carry, mb):
+                acc, loss_sum = carry
+                loss, grads = grad_step(params, mb, scale)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                return (acc, loss_sum + loss), None
+
+            zero_grads = jax.tree_util.tree_map(
+                lambda p, s: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, jnp.float32), s),
+                params, grad_shardings)
+            (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), batch)
+            grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
+            return loss_sum / gas, grads
+
         def train_batch_fn(params, opt_state, scaler_state, batch):
             """(gas, micro_global, ...) batch → scan accumulate → update."""
-            scale = scaler_state.scale
-
-            if gas == 1:
-                # no accumulator buffer needed — one fused fwd+bwd+update
-                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
-                loss, grads = grad_step(params, mb, scale)
-            else:
-                def micro(carry, mb):
-                    acc, loss_sum = carry
-                    loss, grads = grad_step(params, mb, scale)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return (acc, loss_sum + loss), None
-
-                zero_grads = jax.tree_util.tree_map(
-                    lambda p, s: jax.lax.with_sharding_constraint(
-                        jnp.zeros(p.shape, jnp.float32), s),
-                    params, grad_shardings)
-                (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), batch)
-                grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
-                loss = loss_sum / gas
+            loss, grads = accumulate_grads(params, scaler_state.scale, batch)
             # the guard checks the loss too (a finite-grad NaN loss is
             # possible with masked losses); it feeds the skip gate, so a
             # tripped check really does leave params/opt_state untouched
@@ -473,25 +484,7 @@ class DeepSpeedEngine:
         def grads_batch_fn(params, scaler_state, batch):
             """NVMe path: the fused program minus the update — loss, grads,
             global norm, and finiteness, all in one compiled program."""
-            scale = scaler_state.scale
-            if gas == 1:
-                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
-                loss, grads = grad_step(params, mb, scale)
-            else:
-                def micro(carry, mb):
-                    acc, loss_sum = carry
-                    loss, g = grad_step(params, mb, scale)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, g)
-                    return (acc, loss_sum + loss), None
-
-                zero_grads = jax.tree_util.tree_map(
-                    lambda p, s: jax.lax.with_sharding_constraint(
-                        jnp.zeros(p.shape, jnp.float32), s),
-                    params, grad_shardings)
-                (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0),
-                                                  batch)
-                grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
-                loss = loss_sum / gas
+            loss, grads = accumulate_grads(params, scaler_state.scale, batch)
             gnorm = optax.global_norm(grads)
             grads_ok = (grads_finite(grads) if (fp16 or numerics)
                         else jnp.asarray(True))
@@ -532,11 +525,96 @@ class DeepSpeedEngine:
 
         return {k: put(v) for k, v in batch.items()}
 
+    # --- data pipeline (reference deepspeed_io, engine.py:1571) ---------------
+    def deepspeed_io(self, dataset, batch_size: Optional[int] = None,
+                     route: str = "train", data_sampler=None,
+                     collate_fn=None, difficulties=None,
+                     num_local_io_workers=None, pin_memory: bool = False):
+        """Build a :class:`DeepSpeedDataLoader` over ``dataset`` sized to the
+        engine's global train batch. With data-efficiency v2 sampling enabled
+        (``data_efficiency.data_sampling``), wraps a curriculum-aware
+        :class:`DeepSpeedDataSampler` — per-sample ``difficulties`` come from
+        the argument or the configured metric's ``analysis_path`` (a
+        DataAnalyzer output dir). The train-route loader is attached as
+        ``engine.training_dataloader`` and feeds ``train_batch()`` when no
+        batch is passed."""
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        batch_size = batch_size or self.train_batch_size()
+        if len(dataset) < batch_size:
+            raise ValueError(
+                f"dataset has {len(dataset)} samples but the global train "
+                f"batch needs {batch_size} (micro*gas*dp) — not one full "
+                f"batch (drop_last)")
+        de = self._config.data_efficiency_config or {}
+        ds_cfg = de.get("data_sampling", {}) if de else {}
+        if data_sampler is None and ds_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline import (
+                CurriculumScheduler, DeepSpeedDataSampler,
+            )
+
+            curriculum, metric_cfg, metric_name = None, None, None
+            cl = ds_cfg.get("curriculum_learning", {})
+            if cl.get("enabled", False):
+                metrics = cl.get("curriculum_metrics", {})
+                if metrics:
+                    metric_name, metric_cfg = sorted(metrics.items())[0]
+                    if len(metrics) > 1:
+                        logger.warning(
+                            "data_sampling: %d curriculum metrics "
+                            "configured but only one is supported — using "
+                            "%r, ignoring %s", len(metrics), metric_name,
+                            sorted(m for m in metrics if m != metric_name))
+                    curriculum = CurriculumScheduler(metric_cfg)
+            if difficulties is None and metric_cfg is not None and \
+                    metric_cfg.get("analysis_path"):
+                from deepspeed_tpu.runtime.data_pipeline import load_analysis
+
+                difficulties, _, _ = load_analysis(
+                    metric_cfg["analysis_path"], metric_name)
+            if difficulties is None:
+                raise ValueError(
+                    "data_efficiency.data_sampling is enabled but no "
+                    "per-sample difficulties are available — pass "
+                    "deepspeed_io(..., difficulties=...) or set "
+                    "curriculum_metrics.<name>.analysis_path to a "
+                    "DataAnalyzer output directory")
+            data_sampler = DeepSpeedDataSampler(
+                difficulties, batch_size, curriculum=curriculum,
+                seed=self._config.seed)
+        loader = DeepSpeedDataLoader(
+            dataset, batch_size=batch_size,
+            shuffle=(route == "train" and data_sampler is None),
+            seed=self._config.seed, collate_fn=collate_fn,
+            data_sampler=data_sampler)
+        if route == "train":
+            self.training_dataloader = loader
+            self._train_iter = None
+        return loader
+
+    def next_batch(self):
+        """Next global batch from the attached training dataloader
+        (repeating across epochs)."""
+        if self.training_dataloader is None:
+            raise ValueError(
+                "train_batch() without a batch needs a dataloader: pass "
+                "initialize(training_data=...) or call "
+                "engine.deepspeed_io(dataset) first")
+        if self._train_iter is None:
+            from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+            self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+        return next(self._train_iter)
+
     # --- public API -----------------------------------------------------------
-    def train_batch(self, batch: Dict[str, Any]):
+    def train_batch(self, batch: Optional[Dict[str, Any]] = None):
         """Run one full global step (all GAS micro-batches + update) as a
         single jitted program. Batch arrays: leading dim is the global train
-        batch (micro*gas*dp) or already (gas, micro*dp, ...)."""
+        batch (micro*gas*dp) or already (gas, micro*dp, ...). With no batch,
+        pulls the next one from ``training_dataloader`` (reference
+        ``train_batch(data_iter)``, pipe/engine.py:286)."""
+        if batch is None:
+            batch = self.next_batch()
         gas = self.gradient_accumulation_steps()
         micro_global = self.train_micro_batch_size_per_gpu() * self.dp_world_size
         batch = self._apply_curriculum(batch)
@@ -825,6 +903,15 @@ class DeepSpeedEngine:
                                self.global_samples))
             self.monitor.write_events(events)
 
+    def destroy(self):
+        """Release engine-held native resources (AIO thread pools, pending
+        async checkpoint). Idempotent; also runs at GC via finalizers."""
+        if getattr(self, "_nvme", None) is not None:
+            self._nvme_finalizer()      # weakref.finalize: at-most-once
+            self._nvme = None
+        if hasattr(self, "_ckpt_engine"):
+            self._ckpt_engine.wait()
+
     def eval_loss(self, batch: Dict[str, Any]):
         """Forward-only loss (no gradient program)."""
         if self._compressor is not None:
@@ -867,8 +954,10 @@ class DeepSpeedEngine:
         tag = tag or f"global_step{self.global_steps}"
         state = {
             "params": self.params,
-            "opt_state": (self._nvme.gather_state() if self._nvme is not None
-                          else self.opt_state),
+            # NVMe states checkpoint by FILE COPY below (streaming, never
+            # gathered) — the pytree carries only the update count
+            "opt_state": ({"count": np.asarray(self._nvme.count)}
+                          if self._nvme is not None else self.opt_state),
             "scaler": self.scaler_state,
         }
         meta = {
@@ -879,6 +968,10 @@ class DeepSpeedEngine:
             "client_state": client_state or {},
         }
         engine.save(save_dir, tag, state, meta, save_latest=save_latest)
+        if self._nvme is not None:
+            import os as _os
+
+            self._nvme.save_files(_os.path.join(save_dir, tag, "nvme_opt"))
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
 
@@ -887,7 +980,7 @@ class DeepSpeedEngine:
         engine = self.checkpoint_engine
         template = {
             "params": self.params,
-            "opt_state": (self._nvme.state_template()
+            "opt_state": ({"count": np.asarray(0)}
                           if self._nvme is not None else self.opt_state),
             "scaler": self.scaler_state,
         }
@@ -895,7 +988,15 @@ class DeepSpeedEngine:
         self.params = state["params"]
         if load_optimizer_states:
             if self._nvme is not None:
-                self._nvme.load_state(state["opt_state"])
+                import os as _os
+
+                resolved = tag
+                if resolved is None:
+                    with open(_os.path.join(load_dir, "latest")) as f:
+                        resolved = f.read().strip()
+                self._nvme.load_files(
+                    _os.path.join(load_dir, resolved, "nvme_opt"),
+                    int(state["opt_state"]["count"]))
             else:
                 self.opt_state = state["opt_state"]
             self.scaler_state = state["scaler"]

@@ -151,6 +151,42 @@ class AsyncTensorSwapper:
         self._pending_writes.clear()
         return failures
 
+    def copy_files(self, name: str, dst_dir: str) -> None:
+        """File-level copy of ``name``'s swapped leaves into ``dst_dir`` —
+        O(io-buffer) host RAM, never materializing the state (checkpoint
+        save for states too big to gather)."""
+        import shutil
+
+        assert name in self._meta, f"nothing swapped out under {name}"
+        self._drain_writes_for(name, context="copy")
+        os.makedirs(dst_dir, exist_ok=True)
+        _, shapes = self._meta[name]
+        for i in range(len(shapes)):
+            shutil.copyfile(self._leaf_path(name, i),
+                            os.path.join(dst_dir, f"{name}.{i}.bin"))
+
+    def adopt_files(self, name: str, src_dir: str, template: Any) -> None:
+        """Inverse of :meth:`copy_files`: copy leaf files from ``src_dir``
+        into the swap dir and register ``template``'s structure/shapes as
+        ``name``'s metadata (checkpoint load without materializing)."""
+        import shutil
+
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        shapes = [(np.asarray(l).shape if not hasattr(l, "shape")
+                   else tuple(l.shape),
+                   np.dtype(getattr(l, "dtype", np.float32)))
+                  for l in leaves]
+        for i, (shape, dtype) in enumerate(shapes):
+            src = os.path.join(src_dir, f"{name}.{i}.bin")
+            expect = int(np.prod(shape)) * dtype.itemsize
+            got = os.path.getsize(src)
+            if got != expect:
+                raise ValueError(
+                    f"adopt_files({name}): {src} is {got} bytes, template "
+                    f"leaf {i} ({shape}, {dtype}) needs {expect}")
+            shutil.copyfile(src, self._leaf_path(name, i))
+        self._meta[name] = (treedef, shapes)
+
     def remove(self, name: str) -> None:
         if name in self._meta:
             _, shapes = self._meta.pop(name)
@@ -171,16 +207,12 @@ class PartitionedOptimizerSwapper:
 
     def __init__(self, swap_dir: str, **aio_kwargs):
         self.swapper = AsyncTensorSwapper(swap_dir, **aio_kwargs)
-        self._resident: Optional[str] = None
 
     def offload(self, name: str, opt_state: Any) -> None:
         self.swapper.swap_out(name, opt_state, blocking=True)
-        self._resident = None
 
     def fetch(self, name: str, sharding=None) -> Any:
-        state = self.swapper.swap_in(name, device_put=True, sharding=sharding)
-        self._resident = name
-        return state
+        return self.swapper.swap_in(name, device_put=True, sharding=sharding)
 
     def close(self):
         self.swapper.close()

@@ -38,9 +38,7 @@ def validate_nvme_config(config) -> None:
     silently requires these; VERDICT r1 flagged silent no-ops as worse than
     errors)."""
     zc = config.zero_config
-    if zc.offload_param is not None and \
-            getattr(zc.offload_param, "device", None) is not None and \
-            str(getattr(zc.offload_param.device, "value", zc.offload_param.device)) == "nvme":
+    if zc.offload_param_device == "nvme":
         raise NotImplementedError(
             "offload_param.device=nvme (parameter NVMe offload) is not "
             "implemented; optimizer-state NVMe offload "
@@ -201,6 +199,26 @@ class NVMeOptimizerStates:
         return jax.tree_util.tree_unflatten(treedef, flat_p)
 
     # --- checkpoint integration ------------------------------------------
+    def _group_template(self, gi: int) -> Dict[str, Any]:
+        keys = [str(i) for i in self.groups[gi]]
+        z = {k: np.empty(self._shapes[int(k)], np.float32) for k in keys}
+        return {"mu": z, "nu": dict(z)}
+
+    def save_files(self, dst_dir: str) -> None:
+        """Checkpoint the on-disk state by file copy — O(io-buffer) host
+        RAM, never gathering (at the scales NVMe offload targets, a full
+        gather can exhaust host memory)."""
+        self.swapper.flush()
+        for gi in range(len(self.groups)):
+            self.swapper.swapper.copy_files(self._name(gi), dst_dir)
+
+    def load_files(self, src_dir: str, count: int) -> None:
+        self.swapper.flush()      # drop prefetches of the old state
+        for gi in range(len(self.groups)):
+            self.swapper.swapper.adopt_files(self._name(gi), src_dir,
+                                             self._group_template(gi))
+        self.count = int(count)
+
     def state_template(self) -> Dict[str, Any]:
         """Structure/shape template for checkpoint loading WITHOUT touching
         disk (gathering real state just to describe its shape would read

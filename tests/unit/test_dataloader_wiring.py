@@ -1,0 +1,130 @@
+"""training_data / deepspeed_io wiring (VERDICT r1 #9).
+
+Reference ``deepspeed_io`` (engine.py:1571) builds a loader from
+``initialize(training_data=...)``; previously the argument was accepted and
+silently dropped. These tests pin the end-to-end path: dataset → loader →
+``train_batch()`` with no argument, plus the data-efficiency v2 sampler.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def _dataset(n=32, seq=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        t = rng.integers(0, vocab, seq + 1)
+        out.append({"input_ids": t[:-1].astype(np.int32),
+                    "labels": t[1:].astype(np.int32)})
+    return out
+
+
+def _cfg(**over):
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "bf16": {"enabled": False}}
+    cfg.update(over)
+    return cfg
+
+
+def test_initialize_training_data_trains_end_to_end():
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    ds = _dataset(n=16)   # exactly 2 global batches → exercises epoch repeat
+    engine = deepspeed_tpu.initialize(
+        model=model, config=_cfg(), training_data=ds,
+        sample_batch={k: v[None] for k, v in ds[0].items()})
+    assert engine.training_dataloader is not None
+    assert len(engine.training_dataloader) == 2
+    losses = [float(engine.train_batch()) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"no learning from dataset: {losses}"
+
+
+def test_initialize_legacy_returns_dataloader():
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    ds = _dataset()
+    engine, opt, loader, sched = deepspeed_tpu.initialize_legacy(
+        model=model, config=_cfg(), training_data=ds,
+        sample_batch={k: v[None] for k, v in ds[0].items()})
+    assert loader is engine.training_dataloader
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape == (8, 16)
+
+
+def test_train_batch_without_loader_raises():
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    ds = _dataset()
+    engine = deepspeed_tpu.initialize(
+        model=model, config=_cfg(),
+        sample_batch={k: v[None] for k, v in ds[0].items()})
+    with pytest.raises(ValueError, match="deepspeed_io"):
+        engine.train_batch()
+
+
+def test_data_efficiency_sampler_curriculum():
+    """data_sampling.enabled → a DeepSpeedDataSampler drives the loader;
+    early batches draw only below-threshold difficulties (reference
+    data_sampler.py:36 difficulty-clustered sampling)."""
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    ds = _dataset(n=32)
+    cfg = _cfg(data_efficiency={
+        "enabled": True,
+        "data_sampling": {
+            "enabled": True,
+            "curriculum_learning": {
+                "enabled": True,
+                "curriculum_metrics": {
+                    "noise": {
+                        "curriculum_type": "fixed_linear",
+                        "min_difficulty": 2,
+                        "max_difficulty": 32,
+                        "schedule_config": {"total_curriculum_step": 10,
+                                            "difficulty_step": 2},
+                    }}}}})
+    engine = deepspeed_tpu.initialize(
+        model=model, config=cfg,
+        sample_batch={k: v[None] for k, v in ds[0].items()})
+    difficulties = np.arange(32, dtype=np.float64)   # sample i has diff i
+    loader = engine.deepspeed_io(ds, difficulties=difficulties)
+    assert loader.data_sampler is not None
+    first_idx = next(iter(loader.data_sampler))
+    # threshold=2 leaves only 3 eligible samples (<batch), so the sampler
+    # backfills from the lowest-difficulty ranks — the batch must still be
+    # the easiest 8 samples, never a high-difficulty draw
+    assert all(difficulties[i] < 8 for i in first_idx), first_idx
+    # and training through the sampled loader still works
+    loss = float(engine.train_batch())
+    assert np.isfinite(loss)
+
+
+def test_data_efficiency_without_difficulties_raises():
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    ds = _dataset()
+    cfg = _cfg(data_efficiency={"enabled": True,
+                                "data_sampling": {"enabled": True}})
+    engine = deepspeed_tpu.initialize(
+        model=model, config=cfg,
+        sample_batch={k: v[None] for k, v in ds[0].items()})
+    with pytest.raises(ValueError, match="difficulties"):
+        engine.deepspeed_io(ds)
+
+
+def test_repeating_loader_reshuffles_per_epoch():
+    """Wrap-around must advance the epoch so shuffle order changes
+    (otherwise multi-epoch training replays identical batch order)."""
+    from deepspeed_tpu.runtime.dataloader import (
+        DeepSpeedDataLoader, RepeatingLoader,
+    )
+
+    ds = [{"x": np.asarray([i])} for i in range(16)]
+    loader = DeepSpeedDataLoader(ds, batch_size=4, shuffle=True, seed=0)
+    rep = iter(RepeatingLoader(loader))
+    epoch1 = [int(next(rep)["x"][0, 0]) for _ in range(4)]
+    epoch2 = [int(next(rep)["x"][0, 0]) for _ in range(4)]
+    assert sorted(epoch1) != epoch1 or sorted(epoch2) != epoch2  # shuffled
+    assert epoch1 != epoch2, "epoch 2 replayed epoch 1's order"

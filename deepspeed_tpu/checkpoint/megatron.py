@@ -222,10 +222,37 @@ class MegatronCheckpoint:
                     out[f"{lk}.{k}"] = v
             return out
         if self.pp_degree > 1:
-            raise NotImplementedError(
-                "monolithic mp_rank files with pp>1: merge per-stage "
-                "layer files instead (Megatron-DS writes layer_* files "
-                "whenever pp>1)")
+            # monolithic mp_rank_<TT>_<PPP> files: merge TP within each
+            # stage, then renumber each stage's LOCAL layer indices by the
+            # cumulative count (Megatron numbers layers per stage from 0).
+            # Stage-shared keys (embeddings/final norm) keep their first
+            # occurrence.
+            by_pp: Dict[int, List[Tuple[int, str]]] = {}
+            for f in self.mp_rank_files:
+                parts = f[len(MODEL_FILE_PREFIX):
+                          -len(MODEL_FILE_SUFFIX)].split("_")
+                tp = int(parts[0])
+                pp = int(parts[1]) if len(parts) > 1 else 0
+                by_pp.setdefault(pp, []).append((tp, f))
+            layer_re = re.compile(r"(\.layers\.)(\d+)(\.)")
+            offset = 0
+            for pp in sorted(by_pp):
+                sds = []
+                for _, f in sorted(by_pp[pp]):
+                    sd = _load_pt(os.path.join(self.dir, f))
+                    sds.append(sd.get("module", sd))
+                merged = merge_tp(sds, self.version)
+                local_max = -1
+                for k, v in merged.items():
+                    m = layer_re.search(k)
+                    if m:
+                        idx = int(m.group(2))
+                        local_max = max(local_max, idx)
+                        k = (k[:m.start(2)] + str(idx + offset)
+                             + k[m.end(2):])
+                    out.setdefault(k, v)
+                offset += local_max + 1
+            return out
         sds = []
         for f in sorted(self.mp_rank_files):
             sd = _load_pt(os.path.join(self.dir, f))

@@ -70,13 +70,20 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         if not self.async_save:
             self._finalize()
 
+    @staticmethod
+    def resolve_tag(load_dir: str, tag: Optional[str]) -> str:
+        """The single source of tag resolution (callers that need the
+        resolved tag — e.g. for sibling files in the snapshot dir — use
+        this instead of re-reading ``latest`` themselves)."""
+        if tag is not None:
+            return tag
+        with open(os.path.join(load_dir, LATEST_FILE)) as f:
+            return f.read().strip()
+
     def load(self, load_dir: str, tag: Optional[str],
              template: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         self._finalize()          # a pending async save must land first
-        if tag is None:
-            latest_path = os.path.join(load_dir, LATEST_FILE)
-            with open(latest_path) as f:
-                tag = f.read().strip()
+        tag = self.resolve_tag(load_dir, tag)
         path = os.path.abspath(os.path.join(load_dir, tag))
         ckptr = self._ckptr
         abstract = jax.tree_util.tree_map(

@@ -547,7 +547,10 @@ class DeepSpeedEngine:
                 f"batch needs {batch_size} (micro*gas*dp) — not one full "
                 f"batch (drop_last)")
         de = self._config.data_efficiency_config or {}
-        ds_cfg = de.get("data_sampling", {}) if de else {}
+        # both gates, like the reference: the top-level data_efficiency
+        # switch turns the whole feature off regardless of nested flags
+        ds_cfg = de.get("data_sampling", {}) if de.get("enabled", False) \
+            else {}
         if data_sampler is None and ds_cfg.get("enabled", False):
             from deepspeed_tpu.runtime.data_pipeline import (
                 CurriculumScheduler, DeepSpeedDataSampler,
@@ -566,22 +569,21 @@ class DeepSpeedEngine:
                             "%r, ignoring %s", len(metrics), metric_name,
                             sorted(m for m in metrics if m != metric_name))
                     curriculum = CurriculumScheduler(metric_cfg)
-            if difficulties is None and metric_cfg is not None and \
-                    metric_cfg.get("analysis_path"):
-                from deepspeed_tpu.runtime.data_pipeline import load_analysis
-
-                difficulties, _, _ = load_analysis(
-                    metric_cfg["analysis_path"], metric_name)
-            if difficulties is None:
+            if difficulties is not None:
+                data_sampler = DeepSpeedDataSampler(
+                    difficulties, batch_size, curriculum=curriculum,
+                    seed=self._config.seed)
+            elif metric_cfg is not None and metric_cfg.get("analysis_path"):
+                data_sampler = DeepSpeedDataSampler.from_analysis(
+                    metric_cfg["analysis_path"], metric_name, batch_size,
+                    curriculum=curriculum, seed=self._config.seed)
+            else:
                 raise ValueError(
                     "data_efficiency.data_sampling is enabled but no "
                     "per-sample difficulties are available — pass "
                     "deepspeed_io(..., difficulties=...) or set "
                     "curriculum_metrics.<name>.analysis_path to a "
                     "DataAnalyzer output directory")
-            data_sampler = DeepSpeedDataSampler(
-                difficulties, batch_size, curriculum=curriculum,
-                seed=self._config.seed)
         loader = DeepSpeedDataLoader(
             dataset, batch_size=batch_size,
             shuffle=(route == "train" and data_sampler is None),
@@ -977,26 +979,45 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True):
+        import os as _os
+
         engine = self.checkpoint_engine
+        tag = engine.resolve_tag(load_dir, tag)
+        nvme_dir = _os.path.join(load_dir, tag, "nvme_opt")
+        ckpt_is_nvme = _os.path.isdir(nvme_dir)
+        if self._nvme is not None and not ckpt_is_nvme:
+            # dense checkpoint into an NVMe engine: restore the optax
+            # state (host zeros template) and convert to swapped groups
+            abstract = jax.eval_shape(self.optimizer.init, self.params)
+            opt_template = jax.tree_util.tree_map(
+                lambda x: np.zeros(x.shape, x.dtype), abstract)
+        elif ckpt_is_nvme:
+            opt_template = {"count": np.asarray(0)}
+        else:
+            opt_template = self.opt_state
         template = {
             "params": self.params,
-            "opt_state": ({"count": np.asarray(0)}
-                          if self._nvme is not None else self.opt_state),
+            "opt_state": opt_template,
             "scaler": self.scaler_state,
         }
         state, meta = engine.load(load_dir, tag, template)
         self.params = state["params"]
         if load_optimizer_states:
-            if self._nvme is not None:
-                import os as _os
+            from deepspeed_tpu.runtime.zero.infinity import (
+                extract_adam_state, inject_adam_state, read_nvme_opt_dir,
+            )
 
-                resolved = tag
-                if resolved is None:
-                    with open(_os.path.join(load_dir, "latest")) as f:
-                        resolved = f.read().strip()
-                self._nvme.load_files(
-                    _os.path.join(load_dir, resolved, "nvme_opt"),
-                    int(state["opt_state"]["count"]))
+            params_treedef = jax.tree_util.tree_structure(self.params)
+            if self._nvme is not None and ckpt_is_nvme:
+                self._nvme.load_files(nvme_dir,
+                                      int(state["opt_state"]["count"]))
+            elif self._nvme is not None:
+                self._nvme.load_state(
+                    extract_adam_state(state["opt_state"], params_treedef))
+            elif ckpt_is_nvme:
+                self.opt_state = inject_adam_state(
+                    self.opt_state, read_nvme_opt_dir(nvme_dir),
+                    params_treedef)
             else:
                 self.opt_state = state["opt_state"]
             self.scaler_state = state["scaler"]
@@ -1006,3 +1027,22 @@ class DeepSpeedEngine:
         self.skipped_steps = meta.get("skipped_steps", 0)
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag})", ranks=[0])
         return load_dir, meta.get("client_state", {})
+
+    def load_universal_checkpoint(self, load_dir: str,
+                                  tag: Optional[str] = None,
+                                  load_optimizer_states: bool = True):
+        """Cross-topology resume (reference ``load_universal_checkpoint``,
+        engine.py:772 + checkpoint/universal_checkpoint.py:12): load a
+        checkpoint saved on ANY mesh shape into this engine's mesh.
+
+        The reference re-chunks per-param fp32 fragments by recorded
+        ``cat_dim`` to re-layout flat partitions for a new TP/PP/DP world.
+        Here checkpoints store logical (unsharded) arrays + sharding
+        metadata, so resharding happens at restore: the load template
+        carries THIS engine's shardings and orbax re-lays every array out
+        to them — the per-fragment address arithmetic is unnecessary by
+        construction. This method is therefore ``load_checkpoint`` with the
+        contract made explicit (and tested across dp↔tp↔zero-stage
+        changes, tests/unit/checkpoint/test_universal.py)."""
+        return self.load_checkpoint(
+            load_dir, tag, load_optimizer_states=load_optimizer_states)

@@ -176,6 +176,8 @@ class AsyncTensorSwapper:
                    else tuple(l.shape),
                    np.dtype(getattr(l, "dtype", np.float32)))
                   for l in leaves]
+        # validate EVERY file first: a mismatch found mid-copy would leave
+        # the live swap state half-overwritten with checkpoint data
         for i, (shape, dtype) in enumerate(shapes):
             src = os.path.join(src_dir, f"{name}.{i}.bin")
             expect = int(np.prod(shape)) * dtype.itemsize
@@ -184,7 +186,9 @@ class AsyncTensorSwapper:
                 raise ValueError(
                     f"adopt_files({name}): {src} is {got} bytes, template "
                     f"leaf {i} ({shape}, {dtype}) needs {expect}")
-            shutil.copyfile(src, self._leaf_path(name, i))
+        for i in range(len(shapes)):
+            shutil.copyfile(os.path.join(src_dir, f"{name}.{i}.bin"),
+                            self._leaf_path(name, i))
         self._meta[name] = (treedef, shapes)
 
     def remove(self, name: str) -> None:

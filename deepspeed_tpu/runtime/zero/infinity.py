@@ -199,49 +199,54 @@ class NVMeOptimizerStates:
         return jax.tree_util.tree_unflatten(treedef, flat_p)
 
     # --- checkpoint integration ------------------------------------------
-    def _group_template(self, gi: int) -> Dict[str, Any]:
-        keys = [str(i) for i in self.groups[gi]]
-        z = {k: np.empty(self._shapes[int(k)], np.float32) for k in keys}
+    def _group_template(self, groups, gi: int, shapes) -> Dict[str, Any]:
+        keys = [str(i) for i in groups[gi]]
+        z = {k: np.empty(tuple(shapes[int(k)]), np.float32) for k in keys}
         return {"mu": z, "nu": dict(z)}
 
     def save_files(self, dst_dir: str) -> None:
         """Checkpoint the on-disk state by file copy — O(io-buffer) host
         RAM, never gathering (at the scales NVMe offload targets, a full
-        gather can exhaust host memory)."""
+        gather can exhaust host memory). Writes ``nvme_meta.json`` (group
+        layout + shapes + count) so any engine — different sub_group_size,
+        or no NVMe offload at all — can read the checkpoint back."""
+        import json
+        import os
+
         self.swapper.flush()
         for gi in range(len(self.groups)):
             self.swapper.swapper.copy_files(self._name(gi), dst_dir)
+        with open(os.path.join(dst_dir, "nvme_meta.json"), "w") as f:
+            json.dump({"groups": self.groups,
+                       "shapes": [list(s) for s in self._shapes],
+                       "count": self.count}, f)
 
     def load_files(self, src_dir: str, count: int) -> None:
+        import json
+        import os
+
         self.swapper.flush()      # drop prefetches of the old state
-        for gi in range(len(self.groups)):
-            self.swapper.swapper.adopt_files(self._name(gi), src_dir,
-                                             self._group_template(gi))
+        with open(os.path.join(src_dir, "nvme_meta.json")) as f:
+            meta = json.load(f)
+        saved_groups = [list(g) for g in meta["groups"]]
+        if saved_groups == [list(g) for g in self.groups]:
+            # same group layout → pure file adoption, no materialization
+            for gi in range(len(self.groups)):
+                self.swapper.swapper.adopt_files(
+                    self._name(gi), src_dir,
+                    self._group_template(self.groups, gi, self._shapes))
+        else:
+            log_dist(
+                "ZeRO-Infinity resume across a sub_group_size change: "
+                "re-binning optimizer state (materializes the full m/v on "
+                "host once)", ranks=[0])
+            full = read_nvme_opt_dir(src_dir)
+            self.load_state(full)
         self.count = int(count)
 
-    def state_template(self) -> Dict[str, Any]:
-        """Structure/shape template for checkpoint loading WITHOUT touching
-        disk (gathering real state just to describe its shape would read
-        the full 8 bytes/param synchronously and can exhaust host RAM at
-        the model sizes NVMe offload targets)."""
-        mu = {str(i): np.empty(s, np.float32)
-              for i, s in enumerate(self._shapes)}
-        nu = {str(i): np.empty(s, np.float32)
-              for i, s in enumerate(self._shapes)}
-        return {"mu": mu, "nu": nu, "count": np.asarray(self.count)}
-
-    def gather_state(self) -> Dict[str, Any]:
-        """Full host-side optimizer state (for save_checkpoint)."""
-        mu: Dict[str, Any] = {}
-        nu: Dict[str, Any] = {}
-        for gi in range(len(self.groups)):
-            state = self.swapper.swapper.swap_in(self._name(gi),
-                                                 device_put=False)
-            mu.update(state["mu"])
-            nu.update(state["nu"])
-        return {"mu": mu, "nu": nu, "count": np.asarray(self.count)}
-
     def load_state(self, state: Dict[str, Any]) -> None:
+        """Distribute a full {mu, nu, count} host state into this engine's
+        on-disk groups (cross-format / cross-grouping resume path)."""
         self.count = int(state["count"])
         for gi, idxs in enumerate(self.groups):
             keys = [str(i) for i in idxs]
@@ -254,3 +259,107 @@ class NVMeOptimizerStates:
 
     def close(self):
         self.swapper.close()
+
+
+def read_nvme_opt_dir(src_dir: str) -> Dict[str, Any]:
+    """Materialize a saved NVMe optimizer-state dir as {mu, nu, count}
+    host dicts keyed by flat param index — the bridge that lets a
+    non-NVMe engine load an NVMe checkpoint (and vice-versa re-binning)."""
+    import json
+    import os
+
+    with open(os.path.join(src_dir, "nvme_meta.json")) as f:
+        meta = json.load(f)
+    mu: Dict[str, Any] = {}
+    nu: Dict[str, Any] = {}
+    for gi, idxs in enumerate(meta["groups"]):
+        keys = [str(i) for i in idxs]
+        template = {"mu": {k: np.empty(tuple(meta["shapes"][int(k)]),
+                                       np.float32) for k in keys},
+                    "nu": {k: np.empty(tuple(meta["shapes"][int(k)]),
+                                       np.float32) for k in keys}}
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        read = []
+        for i, leaf in enumerate(leaves):
+            path = os.path.join(src_dir, f"opt_group{gi}.{i}.bin")
+            arr = np.fromfile(path, dtype=np.float32)
+            if arr.size != leaf.size:
+                raise ValueError(
+                    f"{path}: {arr.size} elements, expected {leaf.size}")
+            read.append(arr.reshape(leaf.shape))
+        group = jax.tree_util.tree_unflatten(treedef, read)
+        mu.update(group["mu"])
+        nu.update(group["nu"])
+    return {"mu": mu, "nu": nu, "count": meta["count"]}
+
+
+def locate_adam_state(opt_state):
+    """Find the (first) ScaleByAdamState-shaped node in an optax state tree
+    (a namedtuple with mu/nu/count fields)."""
+    if hasattr(opt_state, "_fields") and "mu" in opt_state._fields \
+            and "nu" in opt_state._fields:
+        return opt_state
+    if isinstance(opt_state, (tuple, list)):
+        for x in opt_state:
+            found = locate_adam_state(x)
+            if found is not None:
+                return found
+    return None
+
+
+def extract_adam_state(opt_state, params_treedef) -> Dict[str, Any]:
+    """optax state → the NVMe {mu, nu, count} format (dense checkpoint
+    loaded into an NVMe engine)."""
+    node = locate_adam_state(opt_state)
+    if node is None:
+        raise ValueError(
+            "checkpoint's optimizer state has no Adam moments (mu/nu) — "
+            "cannot convert it for NVMe offload")
+    mu_leaves = jax.tree_util.tree_leaves(node.mu)
+    nu_leaves = jax.tree_util.tree_leaves(node.nu)
+    return {"mu": {str(i): np.asarray(l, np.float32)
+                   for i, l in enumerate(mu_leaves)},
+            "nu": {str(i): np.asarray(l, np.float32)
+                   for i, l in enumerate(nu_leaves)},
+            "count": int(np.asarray(node.count))}
+
+
+def inject_adam_state(opt_state, nvme_state, params_treedef):
+    """NVMe {mu, nu, count} → the engine's existing optax state structure
+    (NVMe checkpoint loaded into a dense engine). Arrays are placed with
+    the current state's shardings."""
+    n = len(nvme_state["mu"])
+    mu_tree = jax.tree_util.tree_unflatten(
+        params_treedef, [nvme_state["mu"][str(i)] for i in range(n)])
+    nu_tree = jax.tree_util.tree_unflatten(
+        params_treedef, [nvme_state["nu"][str(i)] for i in range(n)])
+
+    replaced = [False]
+
+    def walk(node):
+        if not replaced[0] and hasattr(node, "_fields") \
+                and "mu" in node._fields and "nu" in node._fields:
+            replaced[0] = True
+            new_mu = jax.tree_util.tree_map(
+                lambda new, old: jax.device_put(new, old.sharding)
+                if isinstance(old, jax.Array) else new, mu_tree, node.mu)
+            new_nu = jax.tree_util.tree_map(
+                lambda new, old: jax.device_put(new, old.sharding)
+                if isinstance(old, jax.Array) else new, nu_tree, node.nu)
+            count = np.asarray(nvme_state["count"],
+                               np.asarray(node.count).dtype)
+            if isinstance(node.count, jax.Array):
+                count = jax.device_put(count, node.count.sharding)
+            return node._replace(mu=new_mu, nu=new_nu, count=count)
+        if isinstance(node, tuple) and type(node) is not tuple:
+            return type(node)(*[walk(x) for x in node])
+        if isinstance(node, (tuple, list)):
+            return type(node)(walk(x) for x in node)
+        return node
+
+    out = walk(opt_state)
+    if not replaced[0]:
+        raise ValueError(
+            "engine's optimizer state has no Adam moments (mu/nu) — an "
+            "NVMe checkpoint only restores into Adam-family optimizers")
+    return out

@@ -152,3 +152,42 @@ def test_partition_data():
     assert partition_data(list(range(6)), 3) == [[0, 1], [2, 3], [4, 5]]
     with pytest.raises(ValueError):
         partition_data([1, 2, 3], 2)
+
+
+def test_monolithic_pp_merge(tmp_path):
+    """Monolithic mp_rank_<TT>_<PPP> files (pp>1): full_state must merge TP
+    within each stage and renumber local layer indices by stage offset
+    (previously a NotImplementedError branch)."""
+    import torch
+
+    from deepspeed_tpu.checkpoint.megatron import MegatronCheckpoint
+
+    rng = np.random.default_rng(0)
+    tp, pp, layers_per_stage, h = 2, 2, 2, 4
+    full = {}
+    for p in range(pp):
+        shards = [dict() for _ in range(tp)]
+        for li in range(layers_per_stage):
+            w = rng.standard_normal((8, h)).astype(np.float32)
+            gl = p * layers_per_stage + li
+            full[f"model.encoder.layers.{gl}.mlp.dense_h_to_4h.weight"] = w
+            for r in range(tp):
+                shards[r][f"model.encoder.layers.{li}.mlp.dense_h_to_4h"
+                          f".weight"] = torch.from_numpy(
+                              np.split(w, tp, axis=0)[r])
+        if p == 0:
+            emb = rng.standard_normal((6, h)).astype(np.float32)
+            full["model.embedding.word_embeddings.weight"] = emb
+            for r in range(tp):
+                shards[r]["model.embedding.word_embeddings.weight"] = \
+                    torch.from_numpy(np.split(emb, tp, axis=0)[r])
+        for r in range(tp):
+            torch.save({"module": shards[r]},
+                       tmp_path / f"mp_rank_{r:02d}_{p:03d}_model_states.pt")
+
+    ckpt = MegatronCheckpoint(str(tmp_path))
+    assert ckpt.pp_degree == 2 and ckpt.tp_degree == 2
+    state = ckpt.full_state()
+    assert set(state) == set(full), sorted(state)
+    for k in full:
+        np.testing.assert_allclose(state[k], full[k])

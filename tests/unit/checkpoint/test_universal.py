@@ -1,0 +1,107 @@
+"""Universal checkpoint: save on mesh A, resume on mesh B (VERDICT r1 #4).
+
+Reference semantics: ``load_universal_checkpoint`` (engine.py:772) +
+per-param fragment re-layout (checkpoint/universal_checkpoint.py:12-95) +
+elastic ZeRO re-partitioning (stage_1_and_2.py:2014-2193) let training
+resume after changing TP/PP/DP. Here checkpoints hold logical arrays, so
+the resharding happens at restore time; these tests prove the trajectory
+is preserved across mesh changes — including optimizer state — which is
+the property all that reference machinery exists to provide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+
+def _batch(seed, bs=8, seq=16):
+    rng = np.random.default_rng(seed)
+    t = rng.integers(0, 256, (bs, seq + 1))
+    return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+
+def _engine(mesh_dims, zero_stage=1, seed_model=0):
+    mesh = make_mesh(dims={"pipe": 1, "expert": 1, **mesh_dims})
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "gradient_clipping": 1.0,
+           "bf16": {"enabled": False},
+           "zero_optimization": {"stage": zero_stage},
+           "mesh": dict(mesh_dims),
+           "seed": seed_model}
+    return deepspeed_tpu.initialize(model=model, config=cfg, mesh=mesh,
+                                    sample_batch=_batch(0))
+
+
+MESH_CHANGES = [
+    # (save mesh, load mesh, save stage, load stage)
+    pytest.param({"data": 8, "sequence": 1, "tensor": 1}, 1,
+                 {"data": 4, "sequence": 1, "tensor": 2}, 1,
+                 id="dp8_to_dp4tp2"),
+    pytest.param({"data": 4, "sequence": 1, "tensor": 2}, 3,
+                 {"data": 8, "sequence": 1, "tensor": 1}, 3,
+                 id="dp4tp2_to_dp8_zero3"),
+    pytest.param({"data": 8, "sequence": 1, "tensor": 1}, 1,
+                 {"data": 2, "sequence": 2, "tensor": 2}, 3,
+                 id="dp8_z1_to_dp2sp2tp2_z3"),
+]
+
+
+@pytest.mark.parametrize("mesh_a,stage_a,mesh_b,stage_b", MESH_CHANGES)
+def test_cross_topology_resume(tmp_path, mesh_a, stage_a, mesh_b, stage_b):
+    """Train on mesh A, save, resume on mesh B: the continued trajectory
+    must match mesh A continuing uninterrupted (same losses, same params),
+    proving params AND optimizer state survive the re-layout."""
+    e_a = _engine(mesh_a, stage_a)
+    for i in range(2):
+        e_a.train_batch(_batch(i))
+    e_a.save_checkpoint(str(tmp_path))
+    # uninterrupted continuation on mesh A = the ground truth
+    expect = [float(e_a.train_batch(_batch(10 + i))) for i in range(3)]
+
+    e_b = _engine(mesh_b, stage_b)
+    e_b.load_universal_checkpoint(str(tmp_path))
+    got = [float(e_b.train_batch(_batch(10 + i))) for i in range(3)]
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+
+    # params agree leaf-for-leaf after identical continuations
+    for a, b in zip(jax.tree_util.tree_leaves(e_a.params),
+                    jax.tree_util.tree_leaves(e_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_resume_shardings_match_new_mesh(tmp_path):
+    """Restored arrays carry the NEW engine's shardings (not the saved
+    ones): ZeRO-3 on the load mesh must see data-sharded params."""
+    e_a = _engine({"data": 8, "sequence": 1, "tensor": 1}, zero_stage=1)
+    e_a.train_batch(_batch(0))
+    e_a.save_checkpoint(str(tmp_path))
+
+    e_b = _engine({"data": 4, "sequence": 1, "tensor": 2}, zero_stage=3)
+    e_b.load_universal_checkpoint(str(tmp_path))
+    big = [l for l in jax.tree_util.tree_leaves(e_b.params) if l.size > 4000]
+    assert big and all(not l.sharding.is_fully_replicated for l in big), \
+        "restored params must be sharded per the LOAD mesh's ZeRO-3 plan"
+
+
+def test_optimizer_state_actually_restored(tmp_path):
+    """Guard against silently re-initialized optimizer state: second
+    moments after resume must differ from a fresh engine's zeros."""
+    e_a = _engine({"data": 8, "sequence": 1, "tensor": 1})
+    for i in range(3):
+        e_a.train_batch(_batch(i))
+    e_a.save_checkpoint(str(tmp_path))
+
+    e_b = _engine({"data": 4, "sequence": 1, "tensor": 2})
+    e_b.load_universal_checkpoint(str(tmp_path))
+    nu_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(e_b.opt_state)
+                 if hasattr(x, "shape") and x.ndim > 0]
+    assert any(np.abs(l).max() > 0 for l in nu_leaves), \
+        "optimizer moments are all zero after resume — state was dropped"

@@ -139,3 +139,61 @@ def test_param_nvme_offload_errors_loudly():
     with pytest.raises(NotImplementedError, match="offload_param"):
         deepspeed_tpu.initialize(model=model, config=cfg,
                                  sample_batch=_batch(np.random.default_rng(0)))
+
+
+def test_nvme_checkpoint_loads_into_dense_engine(tmp_path):
+    """An NVMe checkpoint must restore into a non-offloaded engine (the
+    universal-checkpoint contract spans offload-format changes too)."""
+    ckpt = tmp_path / "ckpt"
+    e1, _ = _engine(tmp_path / "swap", nvme=True, sub_group_size=4000)
+    (tmp_path / "swap").mkdir(exist_ok=True)
+    for i in range(2):
+        e1.train_batch(_batch(np.random.default_rng(i)))
+    e1.save_checkpoint(str(ckpt))
+    expect = [float(e1.train_batch(_batch(np.random.default_rng(10 + i))))
+              for i in range(2)]
+
+    e2, _ = _engine()          # plain optax adamw engine
+    e2.load_checkpoint(str(ckpt))
+    got = [float(e2.train_batch(_batch(np.random.default_rng(10 + i))))
+           for i in range(2)]
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_dense_checkpoint_loads_into_nvme_engine(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    e1, _ = _engine()
+    for i in range(2):
+        e1.train_batch(_batch(np.random.default_rng(i)))
+    e1.save_checkpoint(str(ckpt))
+    expect = [float(e1.train_batch(_batch(np.random.default_rng(10 + i))))
+              for i in range(2)]
+
+    swap = tmp_path / "swap"
+    swap.mkdir()
+    e2, _ = _engine(swap, nvme=True, sub_group_size=4000)
+    e2.load_checkpoint(str(ckpt))
+    assert e2._nvme.count == 2
+    got = [float(e2.train_batch(_batch(np.random.default_rng(10 + i))))
+           for i in range(2)]
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_nvme_checkpoint_across_sub_group_size(tmp_path):
+    """Resume with a different sub_group_size re-bins the on-disk state."""
+    ckpt = tmp_path / "ckpt"
+    sa, sb = tmp_path / "a", tmp_path / "b"
+    sa.mkdir(), sb.mkdir()
+    e1, _ = _engine(sa, nvme=True, sub_group_size=4000)
+    for i in range(2):
+        e1.train_batch(_batch(np.random.default_rng(i)))
+    e1.save_checkpoint(str(ckpt))
+    expect = [float(e1.train_batch(_batch(np.random.default_rng(10 + i))))
+              for i in range(2)]
+
+    e2, _ = _engine(sb, nvme=True, sub_group_size=100_000)
+    assert len(e2._nvme.groups) != len(e1._nvme.groups)
+    e2.load_checkpoint(str(ckpt))
+    got = [float(e2.train_batch(_batch(np.random.default_rng(10 + i))))
+           for i in range(2)]
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)

@@ -299,6 +299,124 @@ def longseq_main():
     }))
 
 
+def moe_main():
+    """--moe: expert-parallel GPT training throughput (BASELINE.json config
+    #3 — DeepSpeed-MoE alternating dense/MoE layers, reference
+    moe/sharded_moe.py). Single-chip proxy: measures the full capacity-based
+    gating + dispatch/combine + batched-expert path; multi-chip all_to_all
+    rides the same sharding constraints over the expert mesh axis
+    (dry-run-compiled in __graft_entry__ case C). vs_baseline is MFU over
+    ACTIVE FLOPs (top-k experts/token) against the same 49/125 V100 bar."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import loss_fn as lm_loss
+    from deepspeed_tpu.models.transformer import (
+        GatedMLP, RMSNorm, SelfAttention, make_causal_mask,
+    )
+    from deepspeed_tpu.moe.layer import MoE
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        V, D, F, L, H, E, K = 32000, 1024, 4096, 12, 16, 8, 1
+        batch, seq, steps = 8, 512, 10
+        dtype = jnp.bfloat16
+    else:
+        V, D, F, L, H, E, K = 256, 64, 128, 2, 4, 4, 1
+        batch, seq, steps = 4, 64, 3
+        dtype = jnp.float32
+
+    class MoEGPT(nn.Module):
+        """Alternating dense/MoE decoder (DeepSpeed-MoE structure:
+        every other layer's MLP is a capacity-gated expert layer)."""
+
+        @nn.compact
+        def __call__(self, ids):
+            B, S = ids.shape
+            x = nn.Embed(V, D, dtype=dtype, param_dtype=jnp.float32,
+                         name="wte")(ids)
+            mask = make_causal_mask(S)
+            aux_total = 0.0
+            for i in range(L):
+                h = RMSNorm(dtype=dtype, name=f"ln_a{i}")(x)
+                x = x + SelfAttention(num_heads=H, dtype=dtype,
+                                      assume_causal_mask=True,
+                                      name=f"attn{i}")(h, mask=mask)
+                h = RMSNorm(dtype=dtype, name=f"ln_m{i}")(x)
+                if i % 2 == 1:
+                    out, aux = MoE(num_experts=E, hidden_size=D,
+                                   intermediate_size=F, k=K, dtype=dtype,
+                                   name=f"moe{i}")(h)
+                    x = x + out
+                    aux_total = aux_total + aux
+                else:
+                    x = x + GatedMLP(intermediate_size=F, dtype=dtype,
+                                     name=f"mlp{i}")(h)
+            x = RMSNorm(dtype=dtype, name="ln_f")(x)
+            logits = nn.Dense(V, use_bias=False, dtype=dtype,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+            return logits.astype(jnp.float32), aux_total
+
+    model = MoEGPT()
+
+    def loss_fn(params, batch_d, rngs=None):
+        logits, aux = model.apply({"params": params}, batch_d["input_ids"])
+        return lm_loss(logits, batch_d["labels"]) + 0.01 * aux
+
+    rng = np.random.default_rng(0)
+    t0 = rng.integers(0, V, size=(batch, seq + 1))
+    engine = deepspeed_tpu.initialize(
+        model=model, loss_fn=loss_fn,
+        config={"train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 1},
+                "bf16": {"enabled": on_tpu},
+                "gradient_clipping": 1.0, "steps_per_print": 1000},
+        sample_batch={"input_ids": t0[:1, :-1], "labels": t0[:1, 1:]})
+
+    batches = []
+    for _ in range(3):
+        t = rng.integers(0, V, size=(batch, seq + 1))
+        batches.append({"input_ids": t[:, :-1], "labels": t[:, 1:]})
+    float(engine.train_batch(batches[0]))
+
+    state = {}
+
+    def window():
+        for i in range(steps):
+            state["loss"] = engine.train_batch(batches[i % len(batches)])
+        float(state["loss"])
+
+    dt = time_best(window, 4 if on_tpu else 1)
+    n_chips = jax.device_count()
+    tok_s = steps * batch * seq / dt / n_chips
+    # active params: experts contribute K/E of their stack per token
+    from deepspeed_tpu.moe.utils import moe_param_mask
+    mask = moe_param_mask(engine.params)
+    total = expert = 0
+    for leaf, is_moe in zip(jax.tree_util.tree_leaves(engine.params),
+                            jax.tree_util.tree_leaves(mask)):
+        total += leaf.size
+        if is_moe:
+            expert += leaf.size
+    active = total - expert + expert * K // E
+    mfu = 6.0 * active * tok_s / (197e12 if on_tpu else 1e12)
+    print(json.dumps({
+        "metric": "moe_gpt_e8_top1_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / (49.0 / 125.0), 3),
+        "detail": {"total_params": int(total), "active_params": int(active),
+                   "experts": E, "top_k": K, "batch": batch, "seq": seq,
+                   "steps": steps, "wall_s": round(dt, 2), "n_chips": n_chips,
+                   "mfu_active": round(mfu, 4), "loss": float(state["loss"]),
+                   "backend": jax.default_backend()},
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -406,5 +524,7 @@ if __name__ == "__main__":
         rlhf_main()
     elif "--longseq" in sys.argv:
         longseq_main()
+    elif "--moe" in sys.argv:
+        moe_main()
     else:
         main()

@@ -43,7 +43,8 @@ def initialize(model=None,
                dist_init_required=None,
                config_params=None,
                model_config=None,
-               lora_adapters=None):
+               lora_adapters=None,
+               num_micro=None):
     """Create a training engine (reference ``deepspeed.initialize``).
 
     Returns the engine. (The reference returns a 4-tuple
@@ -99,7 +100,8 @@ def initialize(model=None,
         if common["mesh"] is None:
             common["mesh"] = _mk(resolved.mesh)
         common.pop("loss_fn")
-        engine = PipelineEngine(model_config=model_config, **common)
+        engine = PipelineEngine(model_config=model_config,
+                                num_micro=num_micro, **common)
     else:
         engine = DeepSpeedEngine(**common)
     if training_data is not None:

@@ -55,7 +55,8 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     dtype: jnp.dtype = jnp.bfloat16
-    expert_shard_axis: Optional[str] = "data"
+    # "auto": the dedicated "expert" mesh axis when present, else "data"
+    expert_shard_axis: Optional[str] = "auto"
     use_residual: bool = False  # PR-MoE (reference layer.py:99)
 
     @nn.compact

@@ -129,12 +129,23 @@ def moe_dispatch_combine(x: jnp.ndarray, gate_logits: jnp.ndarray,
                          noise_rng: Optional[jax.Array] = None,
                          noisy_gate_policy: Optional[str] = None,
                          drop_tokens: bool = True,
-                         expert_shard_axis: Optional[str] = "data"):
+                         expert_shard_axis: Optional[str] = "auto"):
     """Dispatch tokens → run experts → combine. x: [T, D], logits: [T, E].
 
     ``expert_fn`` maps [E, C, D] → [E, C, D_out] (batched over experts).
-    The [E, C, D] tensors are sharding-constrained over ``expert_shard_axis``
-    on the E dim — the SPMD equivalent of the reference's all_to_all.
+    The [E, C, D] tensors carry a sharding constraint — the SPMD equivalent
+    of the reference's all_to_all (_AllToAll, sharded_moe.py:90):
+
+    - dedicated ``expert`` mesh axis (EP): E shards over ``expert`` and the
+      capacity dim over ``data`` — each (data, expert) device runs its
+      local experts on its slice of slots, with XLA lowering the token
+      movement to all_to_all over ICI. This composes with TP: the expert
+      weights' F dim can shard over ``tensor`` simultaneously.
+    - no expert axis (legacy expert-data parallelism, ep_size == dp): E
+      shards over ``data``.
+
+    ``expert_shard_axis="auto"`` picks "expert" when the ambient mesh has
+    one, else "data".
     """
     if k == 1:
         aux, combine, dispatch = top1_gating(
@@ -146,13 +157,23 @@ def moe_dispatch_combine(x: jnp.ndarray, gate_logits: jnp.ndarray,
     else:
         raise ValueError(f"top-{k} gating not supported (reference supports 1/2)")
 
-    shard_axis = expert_shard_axis if _axis_in_context_mesh(expert_shard_axis) else None
+    if expert_shard_axis == "auto":
+        expert_shard_axis = "expert" if _axis_in_context_mesh("expert") \
+            else "data"
+    spec = None
+    # None stays the documented opt-out: no sharding constraint at all
+    if expert_shard_axis is not None and \
+            _axis_in_context_mesh(expert_shard_axis):
+        if expert_shard_axis == "expert":
+            cap_axis = "data" if _axis_in_context_mesh("data") else None
+            spec = jax.sharding.PartitionSpec("expert", cap_axis)
+        else:
+            spec = jax.sharding.PartitionSpec(expert_shard_axis)
     expert_inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
-    if shard_axis is not None:
-        spec = jax.sharding.PartitionSpec(shard_axis)
+    if spec is not None:
         expert_inputs = jax.lax.with_sharding_constraint(expert_inputs, spec)
     expert_outputs = expert_fn(expert_inputs)                  # [E, C, D']
-    if shard_axis is not None:
+    if spec is not None:
         expert_outputs = jax.lax.with_sharding_constraint(expert_outputs, spec)
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
     return out, aux

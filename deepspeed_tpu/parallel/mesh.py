@@ -81,20 +81,29 @@ def resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
 def make_mesh(mesh_config=None, devices: Optional[Sequence] = None,
               dims: Optional[Dict[str, int]] = None,
               mics_shard_size: int = 0) -> Mesh:
-    """Build the global Mesh. ``expert`` is NOT a standalone mesh axis —
-    expert groups are sub-groups of ``data`` (see moe/). The mesh axes are
-    (pipe, data, mics, sequence, tensor); ``mics`` is carved out of the
-    data-parallel group when MiCS sub-group sharding is on
-    (reference runtime/zero/mics.py — shard within groups of
-    ``mics_shard_size`` ranks, replicate across groups; the hierarchical
-    inter-node allgather falls out of XLA reducing over ``data`` while
-    gathering over ``mics``) and is 1 otherwise."""
+    """Build the global Mesh with axes
+    (pipe, data, expert, mics, sequence, tensor).
+
+    ``expert`` and ``mics`` are both carved OUT OF the data-parallel group
+    (they don't consume extra devices): expert-parallel groups are
+    sub-groups of DP exactly as in the reference (utils/groups.py:108 —
+    ranks [i*ep, (i+1)*ep)), and ``mics`` is the MiCS bounded-sharding
+    sub-group (reference runtime/zero/mics.py; the hierarchical inter-node
+    allgather falls out of XLA reducing over ``data`` while gathering over
+    ``mics``). Both default to 1."""
     if devices is None:
         devices = jax.devices()
     if dims is None:
         assert mesh_config is not None
         dims = resolve_mesh_dims(mesh_config, len(devices))
     dims = dict(dims)
+    expert = dims.get("expert", 1) or 1
+    if expert > 1:
+        if dims["data"] % expert != 0:
+            raise ValueError(
+                f"expert axis ({expert}) must divide the data axis "
+                f"({dims['data']})")
+        dims["data"] = dims["data"] // expert
     mics = dims.get("mics", 1)
     if mics_shard_size and mics_shard_size > 0:
         if dims["data"] % mics_shard_size != 0:
@@ -103,21 +112,22 @@ def make_mesh(mesh_config=None, devices: Optional[Sequence] = None,
                 f"axis ({dims['data']})")
         mics = mics_shard_size
         dims["data"] = dims["data"] // mics_shard_size
-    axis_names = ("pipe", "data", "mics", "sequence", "tensor")
-    shape = (dims["pipe"], dims["data"], mics, dims["sequence"],
+    axis_names = ("pipe", "data", "expert", "mics", "sequence", "tensor")
+    shape = (dims["pipe"], dims["data"], expert, mics, dims["sequence"],
              dims["tensor"])
     if int(np.prod(shape)) != len(devices):
         raise ValueError(f"mesh shape {shape} != device count {len(devices)}")
     dev_array = np.asarray(devices).reshape(shape)
     logger.info(f"Created device mesh pipe={shape[0]} data={shape[1]} "
-                f"mics={shape[2]} sequence={shape[3]} tensor={shape[4]}")
+                f"expert={shape[2]} mics={shape[3]} sequence={shape[4]} "
+                f"tensor={shape[5]}")
     return Mesh(dev_array, axis_names)
 
 
 def single_device_mesh() -> Mesh:
     """Trivial mesh over one device (single-chip debugging)."""
-    dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
-    return Mesh(dev, ("pipe", "data", "mics", "sequence", "tensor"))
+    dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1, 1)
+    return Mesh(dev, ("pipe", "data", "expert", "mics", "sequence", "tensor"))
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

@@ -32,8 +32,11 @@ Rule = Tuple[str, Tuple[Optional[str], ...]]
 # class in the reference (module_inject/containers/base.py:215-242).
 DEFAULT_TP_RULES: List[Rule] = [
     # expert-parallel: leading expert dim of batched expert stacks shards over
-    # the data axis (EP groups ⊂ DP group, reference utils/groups.py:108)
-    (r".*experts/(gate_proj|up_proj|down_proj|kernel).*", (DATA_AXIS, None, None)),
+    # the dedicated expert axis when the mesh has one, else over data
+    # (EP groups ⊂ DP group, reference utils/groups.py:108). "a|b" in a rule
+    # = first listed axis alive on this mesh wins.
+    (r".*experts/(gate_proj|up_proj|down_proj|kernel).*",
+     ("expert|data", None, None)),
     (r".*(wte|embed_tokens|word_embeddings|embedding)\b.*", (TENSOR_AXIS, None)),
     (r".*(q_proj|k_proj|v_proj|qkv|query_key_value|c_attn).*kernel", (None, TENSOR_AXIS)),
     (r".*(o_proj|out_proj|dense(?!_h)|c_proj(?=.*attn)|attn_out).*kernel", (TENSOR_AXIS, None)),
@@ -73,10 +76,13 @@ def _match_tp_rule(path: str, shape: Sequence[int], rules: List[Rule],
             for i, axis in enumerate(rule_spec):
                 if axis is None:
                     continue
-                n = mesh_axis_size(mesh, axis)
-                if n <= 1:
-                    continue  # axis collapsed on this mesh; leave dim unsharded
-                if shape[offset + i] % n != 0:
+                # "a|b": first candidate axis alive on this mesh
+                candidates = axis.split("|") if isinstance(axis, str) else [axis]
+                axis = next((a for a in candidates
+                             if mesh_axis_size(mesh, a) > 1), None)
+                if axis is None:
+                    continue  # all collapsed on this mesh; leave unsharded
+                if shape[offset + i] % mesh_axis_size(mesh, axis) != 0:
                     ok = False
                     break
                 applied[i] = axis
@@ -100,9 +106,9 @@ def _maybe_shard_data_axis(spec: List[Optional[str]], shape: Sequence[int],
     only (reference zero/mics.py bounded sharding).
     """
     dp = mesh_axis_size(mesh, axis)
-    # expert stacks already shard over data — exempt them from the ZeRO axis
-    # whether that axis is "data" or the MiCS sub-axis
-    if dp <= 1 or axis in spec or DATA_AXIS in spec:
+    # expert stacks already shard over expert/data — exempt them from the
+    # ZeRO axis whether that axis is "data" or the MiCS sub-axis
+    if dp <= 1 or axis in spec or DATA_AXIS in spec or "expert" in spec:
         return spec
     # pick the largest dim not already sharded whose size divides by dp
     candidates = [
@@ -152,11 +158,14 @@ def tree_shardings(params: Any, mesh: Mesh, rules: Optional[List[Rule]] = None,
 
 
 def data_axes(mesh: Mesh):
-    """The batch-sharding axes: ("data", "mics") when a MiCS axis exists —
-    sub-groups are still data-parallel over the batch."""
+    """The batch-sharding axes: expert/MiCS sub-axes are carved out of
+    data, and their sub-groups are still data-parallel over the batch."""
+    axes = [DATA_AXIS]
+    if mesh_axis_size(mesh, "expert") > 1:
+        axes.append("expert")
     if mesh_axis_size(mesh, "mics") > 1:
-        return (DATA_AXIS, "mics")
-    return DATA_AXIS
+        axes.append("mics")
+    return tuple(axes) if len(axes) > 1 else DATA_AXIS
 
 
 def batch_spec(mesh: Mesh, sequence_sharded: bool = False) -> PartitionSpec:

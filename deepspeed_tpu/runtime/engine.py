@@ -125,8 +125,10 @@ class DeepSpeedEngine:
         self.mesh = mesh if mesh is not None else make_mesh(
             self._config.mesh, mics_shard_size=max(mics, 0))
         groups.initialize_groups(self.mesh)
-        # batch parallelism spans data × mics (MiCS sub-groups are still DP)
+        # batch parallelism spans data × expert × mics (expert/MiCS
+        # sub-groups are carved out of data and are still DP for the batch)
         self.dp_world_size = (mesh_axis_size(self.mesh, DATA_AXIS)
+                              * mesh_axis_size(self.mesh, "expert")
                               * mesh_axis_size(self.mesh, "mics"))
 
         # precision -----------------------------------------------------------
@@ -982,6 +984,7 @@ class DeepSpeedEngine:
         import os as _os
 
         engine = self.checkpoint_engine
+        engine.wait()   # a pending async save must land before 'latest'
         tag = engine.resolve_tag(load_dir, tag)
         nvme_dir = _os.path.join(load_dir, tag, "nvme_opt")
         ckpt_is_nvme = _os.path.isdir(nvme_dir)
@@ -1013,7 +1016,7 @@ class DeepSpeedEngine:
                                       int(state["opt_state"]["count"]))
             elif self._nvme is not None:
                 self._nvme.load_state(
-                    extract_adam_state(state["opt_state"], params_treedef))
+                    extract_adam_state(state["opt_state"]))
             elif ckpt_is_nvme:
                 self.opt_state = inject_adam_state(
                     self.opt_state, read_nvme_opt_dir(nvme_dir),

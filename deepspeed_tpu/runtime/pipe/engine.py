@@ -139,10 +139,30 @@ class PipelineEngine(DeepSpeedEngine):
         assert mesh is not None, "PipelineEngine needs an explicit mesh"
         assert cfg.num_layers % mesh.shape["pipe"] == 0, (
             f"{cfg.num_layers} layers must divide pipe={mesh.shape['pipe']}")
-        loss_fn = make_pipeline_lm_loss(cfg, mesh, num_micro)
+        ds_cfg = kwargs.get("config")
+        schedule = getattr(getattr(ds_cfg, "pipeline", None), "schedule",
+                           "1f1b")
+        if schedule == "1f1b":
+            # instruction-executing 1F1B (pipe/interpreter.py — reference
+            # _exec_schedule, pipe/engine.py:1293)
+            from deepspeed_tpu.runtime.pipe.interpreter import (
+                make_1f1b_lm_loss,
+            )
+
+            loss_fn = make_1f1b_lm_loss(cfg, mesh, num_micro)
+        elif schedule == "gpipe":
+            # SPMD fill-drain with remat standing in for 1F1B memory
+            loss_fn = make_pipeline_lm_loss(cfg, mesh, num_micro)
+        else:
+            raise ValueError(
+                f"pipeline.schedule={schedule!r}: supported schedules are "
+                f"'1f1b' (instruction interpreter) and 'gpipe' (SPMD "
+                f"fill-drain); 'interleaved' is not implemented")
         if kwargs.get("sharding_rules") is None:
             kwargs["sharding_rules"] = pipeline_sharding_rules()
         super().__init__(model=model, loss_fn=loss_fn, **kwargs)
         self.num_stages = mesh.shape["pipe"]
+        self.pipe_schedule = schedule
         log_dist(f"PipelineEngine: {self.num_stages} stages x "
-                 f"{cfg.num_layers // self.num_stages} layers", ranks=[0])
+                 f"{cfg.num_layers // self.num_stages} layers "
+                 f"({schedule})", ranks=[0])

@@ -226,7 +226,17 @@ class NVMeOptimizerStates:
         import os
 
         self.swapper.flush()      # drop prefetches of the old state
-        with open(os.path.join(src_dir, "nvme_meta.json")) as f:
+        meta_path = os.path.join(src_dir, "nvme_meta.json")
+        if not os.path.exists(meta_path):
+            # checkpoint predates the meta file: only same-layout adoption
+            # is possible (the old format's implicit contract)
+            for gi in range(len(self.groups)):
+                self.swapper.swapper.adopt_files(
+                    self._name(gi), src_dir,
+                    self._group_template(self.groups, gi, self._shapes))
+            self.count = int(count)
+            return
+        with open(meta_path) as f:
             meta = json.load(f)
         saved_groups = [list(g) for g in meta["groups"]]
         if saved_groups == [list(g) for g in self.groups]:
@@ -307,7 +317,7 @@ def locate_adam_state(opt_state):
     return None
 
 
-def extract_adam_state(opt_state, params_treedef) -> Dict[str, Any]:
+def extract_adam_state(opt_state) -> Dict[str, Any]:
     """optax state → the NVMe {mu, nu, count} format (dense checkpoint
     loaded into an NVMe engine)."""
     node = locate_adam_state(opt_state)

@@ -27,7 +27,7 @@ def test_resolve_mismatch_raises():
 def test_make_mesh_axes(dp4_tp2_mesh):
     assert dp4_tp2_mesh.shape["data"] == 4
     assert dp4_tp2_mesh.shape["tensor"] == 2
-    assert dp4_tp2_mesh.axis_names == ("pipe", "data", "mics", "sequence", "tensor")
+    assert dp4_tp2_mesh.axis_names == ("pipe", "data", "expert", "mics", "sequence", "tensor")
 
 
 def test_topology_rank_mapping():

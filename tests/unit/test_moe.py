@@ -125,3 +125,112 @@ def test_moe_param_grouping():
     import jax
     assert len(jax.tree_util.tree_leaves(moe["params"])) == 1
     assert len(jax.tree_util.tree_leaves(dense["params"])) == 2
+
+
+def test_expert_axis_ep(devices):
+    """The dedicated expert mesh axis: expert stacks shard over it and
+    fwd+bwd runs (VERDICT r1 #8 — the axis must not be dead)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    from deepspeed_tpu.parallel.partition import tree_shardings
+
+    mesh = make_mesh(dims={"pipe": 1, "data": 8, "expert": 4,
+                           "sequence": 1, "tensor": 1})
+    assert mesh.shape["expert"] == 4 and mesh.shape["data"] == 2
+
+    moe = MoE(num_experts=8, hidden_size=16, intermediate_size=32, k=2,
+              dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 4, 16)),
+                    jnp.float32)
+    params = moe.init(jax.random.PRNGKey(0), x)
+    shardings = tree_shardings(params["params"], mesh)
+    stack = shardings["experts"]["gate_proj"]
+    assert stack.spec[0] == "expert", stack.spec
+
+    with jax.set_mesh(mesh):
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, s), params["params"], shardings)
+        x_sh = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"))))
+
+        def loss(p, x):
+            out, aux = moe.apply({"params": p}, x)
+            return (out ** 2).mean() + 0.01 * aux
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(params, x_sh)
+    assert np.isfinite(float(val))
+    g = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(x)).all() for x in g)
+
+
+def test_expert_axis_composes_with_tp(devices):
+    """EP x TP: expert stacks shard E over 'expert' AND F over 'tensor'
+    simultaneously (reference EP x TP token gather, moe/mappings.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    from deepspeed_tpu.parallel.partition import Rule, tree_shardings
+
+    mesh = make_mesh(dims={"pipe": 1, "data": 4, "expert": 2,
+                           "sequence": 1, "tensor": 2})
+    assert dict(mesh.shape) == {"pipe": 1, "data": 2, "expert": 2,
+                                "mics": 1, "sequence": 1, "tensor": 2}
+
+    rules = [
+        (r".*experts/(gate_proj|up_proj).*", ("expert|data", None, "tensor")),
+        (r".*experts/down_proj.*", ("expert|data", "tensor", None)),
+    ]
+    moe = MoE(num_experts=4, hidden_size=16, intermediate_size=32, k=1,
+              dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((4, 4, 16)),
+                    jnp.float32)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    shardings = tree_shardings(params, mesh, rules=rules)
+    up = shardings["experts"]["up_proj"]
+    dn = shardings["experts"]["down_proj"]
+    assert up.spec[0] == "expert" and up.spec[2] == "tensor", up.spec
+    assert dn.spec[0] == "expert" and dn.spec[1] == "tensor", dn.spec
+
+    with jax.set_mesh(mesh):
+        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        x_sh = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"))))
+
+        def loss(p, x):
+            out, aux = moe.apply({"params": p}, x)
+            return (out ** 2).mean() + 0.01 * aux
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(params, x_sh)
+    assert np.isfinite(float(val))
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_expert_axis_engine_end_to_end(devices):
+    """A full engine train step with an MoE model over expert=4 (the
+    dryrun-C configuration, now with the axis actually alive)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(dims={"pipe": 1, "data": 8, "expert": 4,
+                           "sequence": 1, "tensor": 1})
+    moe = MoE(num_experts=8, hidden_size=16, intermediate_size=32, k=2,
+              dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+
+    def loss_fn(params, batch, rngs=None):
+        out, aux = moe.apply({"params": params}, batch["x"])
+        return ((out - batch["y"]) ** 2).mean() + 0.01 * aux
+
+    x = rng.standard_normal((8, 4, 16)).astype(np.float32)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    engine = deepspeed_tpu.initialize(
+        model=None, loss_fn=loss_fn, params=params, mesh=mesh,
+        config={"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False},
+                "mesh": {"data": 8, "expert": 4}})
+    losses = []
+    y = rng.standard_normal((8, 4, 16)).astype(np.float32)
+    for _ in range(5):
+        losses.append(float(engine.train_batch({"x": x, "y": y})))
+    assert losses[-1] < losses[0], losses

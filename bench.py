@@ -117,13 +117,20 @@ def inference_main(int8: bool = False, batch_size: int = 1):
         x.size for x in jax.tree_util.tree_leaves(engine.params))
     # decode is weight-streaming-bound PER STEP: one weight pass serves the
     # whole batch, so utilization = (decode steps/s) * weight bytes over
-    # v5e HBM bandwidth (~819 GB/s) — a 0-1 ratio like main()'s MFU.
-    # int8 storage is dequantized ONCE per generation (capacity win), so the
-    # decode loop streams bf16 copies either way: 2 bytes/param.
+    # the ACHIEVABLE single-row matvec bandwidth. Measured on this chip
+    # (docs/PERF_ANALYSIS.md): the full decode program streams ~420 GB/s
+    # effective against a ~450 GB/s achievable matvec ceiling — the
+    # nominal 819 GB/s HBM figure is not reachable for [1,K]x[K,N] shapes,
+    # so utilization against it understates how close decode is to its
+    # real ceiling (kept in detail as hbm_util_nominal). int8 storage is dequantized ONCE per generation
+    # (capacity win), so the decode loop streams bf16 either way:
+    # 2 bytes/param.
     bytes_per_param = 2
+    MATVEC_BW = 450e9
     steps_per_sec = best / batch
-    hbm_util = (n_params * bytes_per_param * steps_per_sec) / 819e9 \
-        if on_tpu else 0.0
+    stream_rate = n_params * bytes_per_param * steps_per_sec
+    hbm_util = stream_rate / MATVEC_BW if on_tpu else 0.0
+    hbm_util_nominal = stream_rate / 819e9 if on_tpu else 0.0
     print(json.dumps({
         "metric": "llama770m_decode_tokens_per_sec"
                   + ("_int8" if int8 else "")
@@ -134,7 +141,8 @@ def inference_main(int8: bool = False, batch_size: int = 1):
         "detail": {"ttft_p50_ms": round(ttft_p50 * 1e3, 1),
                    "ttft_raw_p50_ms": round(ttft_raw_p50 * 1e3, 1),
                    "tunnel_rtt_p50_ms": round(rtt_p50 * 1e3, 1),
-                   "hbm_streaming_utilization": round(hbm_util, 3),
+                   "matvec_bw_utilization": round(hbm_util, 3),
+                   "hbm_util_nominal": round(hbm_util_nominal, 3),
                    "batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "params": int(n_params),
                    "int8": int8, "backend": jax.default_backend()},

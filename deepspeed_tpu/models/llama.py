@@ -252,13 +252,7 @@ class LlamaDecoderModel(nn.Module):
                          param_dtype=jnp.float32, dtype=cfg.dtype,
                          name="embed_tokens")
         x = embed(input_ids)
-        positions = cache_index + jnp.arange(T, dtype=jnp.int32)[None, :]
-        positions = jnp.broadcast_to(positions, (B, T))
-        # rows attend to cache slots up to their own absolute position
-        row_pos = cache_index + jnp.arange(T)[:, None]          # [T, 1]
-        col = jnp.arange(S_max)[None, :]                        # [1, S_max]
-        mask = jnp.where(col <= row_pos, 0.0, jnp.finfo(jnp.float32).min)
-        mask = mask[None, None, :, :]                           # [1,1,T,S_max]
+        positions, mask = decode_positions_and_mask(B, T, S_max, cache_index)
 
         if cfg.scan_layers:
             ScanBlock = nn.scan(
@@ -288,6 +282,133 @@ class LlamaDecoderModel(nn.Module):
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
+        return logits.astype(jnp.float32), new_caches
+
+
+def fuse_decode_params(params: Any, cfg: LlamaConfig) -> Any:
+    """Collapse per-layer q/k/v kernels into one [D, (H+2Kv)·hd] matmul and
+    gate/up into one [D, 2F] (the reference's fused qkv_gemm / mlp_gemm
+    weight layout, csrc/transformer/inference/csrc/pt_binding.cpp): decode
+    is latency-bound per kernel launch, so 7 matvecs/layer become 4.
+
+    All matmul weights are cast to ``cfg.dtype`` HERE (params are stored
+    fp32): the decode loop must stream 2 bytes/param, and relying on XLA to
+    hoist a per-step astype out of the while_loop is not safe. Norm scales
+    stay fp32 (the rms math is fp32). Works on scan-stacked params; call
+    once (jitted) — the fused copies are what the decode program streams."""
+    blocks = params["blocks"]["block"]
+    attn = blocks["attn"]
+    mlp = blocks["mlp"]
+    cast = lambda a: a.astype(cfg.dtype)
+    qkv = jnp.concatenate([cast(attn["q_proj"]["kernel"]),
+                           cast(attn["k_proj"]["kernel"]),
+                           cast(attn["v_proj"]["kernel"])], axis=-1)
+    gateup = jnp.concatenate([cast(mlp["gate_proj"]["kernel"]),
+                              cast(mlp["up_proj"]["kernel"])], axis=-1)
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["embed_tokens"] = {"embedding":
+                           cast(params["embed_tokens"]["embedding"])}
+    if "lm_head" in params:
+        out["lm_head"] = {"kernel": cast(params["lm_head"]["kernel"])}
+    out["blocks"] = {"block": {
+        "input_norm": blocks["input_norm"],
+        "post_attn_norm": blocks["post_attn_norm"],
+        "qkv_proj": qkv,
+        "o_proj": cast(attn["o_proj"]["kernel"]),
+        "gateup_proj": gateup,
+        "down_proj": cast(mlp["down_proj"]["kernel"]),
+    }}
+    return out
+
+
+def decode_positions_and_mask(batch: int, T: int, S_max: int, cache_index):
+    """Decode-step positions [B, T] and additive mask [1, 1, T, S_max]:
+    rows attend to cache slots up to their own absolute position. Shared by
+    the baseline and fused decoders so their masking can never diverge."""
+    positions = cache_index + jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (batch, T))
+    row_pos = cache_index + jnp.arange(T)[:, None]          # [T, 1]
+    col = jnp.arange(S_max)[None, :]                        # [1, S_max]
+    mask = jnp.where(col <= row_pos, 0.0, jnp.finfo(jnp.float32).min)
+    return positions, mask[None, None, :, :]
+
+
+class FusedLlamaDecoderModel:
+    """Decode twin running on :func:`fuse_decode_params` weights — same
+    logits as LlamaDecoderModel, fewer kernels per layer. Scan-stacked
+    configs only (the only shape the engines produce). Plain class (no
+    flax params of its own) with the decoder ``apply`` contract:
+    ``apply({"params": fused_tree}, ids, caches, index)``."""
+
+    def __init__(self, cfg: LlamaConfig):
+        self.cfg = cfg
+
+    def apply(self, variables, input_ids, kv_caches, cache_index):
+        fused_params = variables["params"]
+        cfg = self.cfg
+        assert cfg.scan_layers, "fused decode expects scan-stacked params"
+        B, T = input_ids.shape
+        S_max = kv_caches[0].shape[2]
+        n_kv = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.hidden_size // cfg.num_heads
+        emb = fused_params["embed_tokens"]["embedding"]
+        x = emb[input_ids].astype(cfg.dtype)
+        positions, mask = decode_positions_and_mask(B, T, S_max, cache_index)
+
+        from deepspeed_tpu.models.transformer import (
+            dot_product_attention, rotary_embedding,
+        )
+
+        def rms(x, scale):
+            x32 = x.astype(jnp.float32)
+            var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+            return (x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+                    * scale).astype(cfg.dtype)
+
+        def block(x, layer):
+            h = rms(x, layer["input_norm"]["scale"])
+            qkv = h @ layer["qkv_proj"]
+            q_sz = cfg.num_heads * hd
+            q = qkv[..., :q_sz].reshape(B, T, cfg.num_heads, hd)
+            k = qkv[..., q_sz:q_sz + n_kv * hd].reshape(B, T, n_kv, hd)
+            v = qkv[..., q_sz + n_kv * hd:].reshape(B, T, n_kv, hd)
+            q = rotary_embedding(q, positions, cfg.rope_base)
+            k = rotary_embedding(k, positions, cfg.rope_base)
+            ck, cv = layer["_cache"]
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_index, 0, 0))
+            kk, vv = ck, cv
+            if n_kv != cfg.num_heads:
+                rep = cfg.num_heads // n_kv
+                kk = jnp.repeat(kk, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            a = dot_product_attention(q, kk, vv, mask=mask)
+            a = a.reshape(B, T, q_sz)
+            x = x + (a @ layer["o_proj"])
+            h = rms(x, layer["post_attn_norm"]["scale"])
+            gu = h @ layer["gateup_proj"]
+            g, u = jnp.split(gu, 2, axis=-1)
+            x = x + ((nn.silu(g) * u) @ layer["down_proj"])
+            return x, (ck, cv)
+
+        def scan_body(x, layer_and_cache):
+            layer, ck, cv = layer_and_cache
+            layer = dict(layer, _cache=(ck, cv))
+            x, new_cache = block(x, layer)
+            return x, new_cache
+
+        x, new_caches = jax.lax.scan(
+            scan_body, x,
+            (fused_params["blocks"]["block"], kv_caches[0], kv_caches[1]))
+
+        scale = fused_params["final_norm"]["scale"]
+        x = rms(x, scale)
+        if cfg.tie_embeddings:
+            # matches the baseline's Embed.attend: both operands in
+            # cfg.dtype (fp32 logits would double the vocab-matmul bytes)
+            logits = x @ emb.T.astype(cfg.dtype)
+        else:
+            logits = x @ fused_params["lm_head"]["kernel"]
         return logits.astype(jnp.float32), new_caches
 
 

@@ -379,6 +379,7 @@ class DeepSpeedEngine:
     def _sharded_opt_init(self):
         abstract = jax.eval_shape(self.optimizer.init, self.params)
         shardings = opt_state_shardings(abstract, self.params, self.zero_plan, self.mesh)
+        self._opt_shardings = shardings
         with self._ctx():
             return jax.jit(self.optimizer.init, out_shardings=shardings)(self.params)
 
@@ -433,7 +434,23 @@ class DeepSpeedEngine:
 
             def do_step(operand):
                 params, opt_state, grads = operand
+                if plan.offload_optimizer:
+                    # host-offloaded optimizer states (reference
+                    # ZeRO-Offload, zero/stage_1_and_2.py:1037): explicit
+                    # in-graph host→HBM transfers around the update — XLA
+                    # schedules the reads to overlap the tail of backward,
+                    # and m/v never occupy HBM outside the update window
+                    opt_state = jax.tree_util.tree_map(
+                        lambda x, sh: jax.device_put(
+                            x, sh.with_memory_kind("device"))
+                        if isinstance(sh, NamedSharding) else x,
+                        opt_state, self._opt_shardings)
                 updates, new_opt = optimizer.update(grads, opt_state, params)
+                if plan.offload_optimizer:
+                    new_opt = jax.tree_util.tree_map(
+                        lambda x, sh: jax.device_put(x, sh)
+                        if isinstance(sh, NamedSharding) else x,
+                        new_opt, self._opt_shardings)
                 return optax.apply_updates(params, updates), new_opt
 
             def skip_step(operand):

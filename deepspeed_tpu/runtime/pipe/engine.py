@@ -140,8 +140,10 @@ class PipelineEngine(DeepSpeedEngine):
         assert cfg.num_layers % mesh.shape["pipe"] == 0, (
             f"{cfg.num_layers} layers must divide pipe={mesh.shape['pipe']}")
         ds_cfg = kwargs.get("config")
-        schedule = getattr(getattr(ds_cfg, "pipeline", None), "schedule",
-                           "1f1b")
+        pipe_cfg = getattr(ds_cfg, "pipeline", None)
+        schedule = getattr(pipe_cfg, "schedule", "1f1b")
+        if num_micro is None:
+            num_micro = getattr(pipe_cfg, "num_micro", None)
         if schedule == "1f1b":
             # instruction-executing 1F1B (pipe/interpreter.py — reference
             # _exec_schedule, pipe/engine.py:1293)
@@ -163,6 +165,14 @@ class PipelineEngine(DeepSpeedEngine):
         super().__init__(model=model, loss_fn=loss_fn, **kwargs)
         self.num_stages = mesh.shape["pipe"]
         self.pipe_schedule = schedule
+        self.num_micro = num_micro or self.num_stages
+        # surface the bubble (reference never reports it; with M=P it is
+        # ~50% — raising pipeline.num_micro shrinks it as (P-1)/(M+P-1))
+        from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+
+        self.bubble_fraction = TrainSchedule(
+            self.num_micro, self.num_stages, 0).bubble_fraction()
         log_dist(f"PipelineEngine: {self.num_stages} stages x "
                  f"{cfg.num_layers // self.num_stages} layers "
-                 f"({schedule})", ranks=[0])
+                 f"({schedule}, {self.num_micro} microbatches, "
+                 f"bubble {self.bubble_fraction:.0%})", ranks=[0])

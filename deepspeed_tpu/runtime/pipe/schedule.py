@@ -87,6 +87,18 @@ class PipeSchedule:
     def steps(self) -> Iterator[List[PipeInstruction]]:
         raise NotImplementedError
 
+    def wall_clock_ticks(self) -> int:
+        """Global ticks to drain the schedule (each tick ≈ one stage
+        compute unit)."""
+        raise NotImplementedError
+
+    def bubble_fraction(self) -> float:
+        """Idle fraction per stage: 1 - useful_ticks / wall_clock_ticks().
+        For fill-drain/1F1B this is (P-1)/(M+P-1) — the model the
+        autotuner uses to order num_micro candidates, and the reason
+        micro-batch count M should exceed the stage count P."""
+        raise NotImplementedError
+
     def num_pipe_buffers(self) -> int:
         return self.micro_batches
 
@@ -124,6 +136,12 @@ class InferenceSchedule(PipeSchedule):
                 if not self.is_last_stage:
                     cmds.append(SendActivation(buffer_id=buf))
             yield cmds
+
+    def wall_clock_ticks(self) -> int:
+        return self.micro_batches + self.stages - 1
+
+    def bubble_fraction(self) -> float:
+        return (self.stages - 1) / self.wall_clock_ticks()
 
     def num_pipe_buffers(self) -> int:
         return 2
@@ -171,6 +189,13 @@ class TrainSchedule(PipeSchedule):
             return micro_batch_id, True
         micro_batch_id = (step_id - 2 * (self.stages - 1) + self.stage_id - 1) // 2
         return micro_batch_id, False
+
+    def wall_clock_ticks(self) -> int:
+        return 2 * (self.micro_batches + self.stages - 1)
+
+    def bubble_fraction(self) -> float:
+        # each stage does 2M useful ticks of the total
+        return 1.0 - 2 * self.micro_batches / self.wall_clock_ticks()
 
     def num_pipe_buffers(self) -> int:
         """In-flight activations at this stage (1F1B memory bound)."""

@@ -208,3 +208,71 @@ def test_config_override_deep_merges(tmp_path, monkeypatch):
     # tuned stage applied; user's nested overlap_comm survives the merge
     assert engine.zero_optimization_stage() == 1
     assert engine._config.zero_config.overlap_comm is True
+
+
+def test_schedule_bubble_model():
+    """The schedule wall-clock model: bubble = (P-1)/(M+P-1)."""
+    from deepspeed_tpu.runtime.pipe.schedule import (
+        InferenceSchedule, TrainSchedule,
+    )
+
+    s = TrainSchedule(micro_batches=2, stages=2, stage_id=0)
+    assert s.wall_clock_ticks() == 2 * (2 + 2 - 1)
+    assert abs(s.bubble_fraction() - 1 / 3) < 1e-9
+    s8 = TrainSchedule(micro_batches=8, stages=2, stage_id=0)
+    assert s8.bubble_fraction() < s.bubble_fraction()
+    i = InferenceSchedule(micro_batches=4, stages=4, stage_id=0)
+    assert abs(i.bubble_fraction() - 3 / 7) < 1e-9
+
+
+def test_autotuner_pipeline_candidates_use_schedule_model():
+    """With a pipe axis, candidates carry num_micro ordered by the
+    TrainSchedule bubble model and emit pipeline.num_micro configs."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, Candidate, ModelInfo
+    from deepspeed_tpu.autotuning.config import get_autotuning_config
+
+    base = {"mesh": {"pipe": 2, "data": 4},
+            "autotuning": {"enabled": True, "micro_batch_sizes": [8],
+                           "zero_stages": [1]}}
+    tuner = Autotuner(engine_factory=None, batch_factory=None,
+                      base_config=base,
+                      model_info=ModelInfo(1000, 10, 1000.0),
+                      dp_size=4)
+    cands = tuner.candidates()
+    pms = [c.num_micro for c in cands]
+    assert pms and all(pm is not None for pm in pms)
+    assert set(pms) <= {2, 4, 8}
+    # memory-cheapest ordering puts the LARGEST num_micro (smallest
+    # bubble) first within the stage/mbs group
+    assert pms[0] == max(pms)
+    cfg = cands[0].ds_config(base, dp=4)
+    assert cfg["pipeline"]["num_micro"] == pms[0]
+
+
+def test_autotuner_pipeline_fallback_divisor():
+    """When none of {P,2P,4P} divides the micro batch, the largest divisor
+    is used instead of silently dropping the configuration."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, ModelInfo
+
+    base = {"mesh": {"pipe": 4, "data": 2},
+            "autotuning": {"enabled": True, "micro_batch_sizes": [6],
+                           "zero_stages": [1]}}
+    tuner = Autotuner(engine_factory=None, batch_factory=None,
+                      base_config=base,
+                      model_info=ModelInfo(1000, 10, 1000.0), dp_size=2)
+    cands = tuner.candidates()
+    assert cands, "configuration must not be silently dropped"
+    assert all(6 % c.num_micro == 0 for c in cands)
+    assert cands[0].num_micro == 6   # largest divisor → smallest bubble
+
+
+def test_memory_model_pipe_aware():
+    from deepspeed_tpu.autotuning.autotuner import (
+        Candidate, ModelInfo, estimate_memory_per_device,
+    )
+
+    info = ModelInfo(1_000_000, 10, 1e6)
+    c = Candidate(1, 2)
+    full = estimate_memory_per_device(info, c, dp_size=1)
+    piped = estimate_memory_per_device(info, c, dp_size=1, pipe_size=4)
+    assert piped < full / 2, (piped, full)

@@ -14,6 +14,7 @@
 #include <deque>
 #include <fcntl.h>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <unistd.h>
@@ -64,6 +65,7 @@ class AioHandle {
     int64_t id = next_id_++;
     queue_.push_back(Request{id, is_write, path, buffer, nbytes, offset});
     ++inflight_;
+    inflight_ids_.insert(id);
     cv_.notify_one();
     return id;
   }
@@ -78,6 +80,28 @@ class AioHandle {
       if (c.result < 0) ++failures;
     }
     completions_.clear();
+    return failures;
+  }
+
+  // Blocks until every request with id <= max_id completes (ids are
+  // submission-ordered, so this drains one caller's earlier batch without
+  // serializing unrelated later submissions). Returns failures among the
+  // drained completions, which are consumed.
+  int64_t wait_upto(int64_t max_id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this, max_id] {
+      return inflight_ids_.empty() || *inflight_ids_.begin() > max_id;
+    });
+    int64_t failures = 0;
+    auto it = completions_.begin();
+    while (it != completions_.end()) {
+      if (it->id <= max_id) {
+        if (it->result < 0) ++failures;
+        it = completions_.erase(it);
+      } else {
+        ++it;
+      }
+    }
     return failures;
   }
 
@@ -102,7 +126,8 @@ class AioHandle {
         std::unique_lock<std::mutex> lk(mu_);
         completions_.push_back(Completion{req.id, result});
         --inflight_;
-        if (inflight_ == 0) done_cv_.notify_all();
+        inflight_ids_.erase(req.id);
+        done_cv_.notify_all();
       }
     }
   }
@@ -136,6 +161,7 @@ class AioHandle {
   bool stop_;
   int64_t next_id_;
   int64_t inflight_;
+  std::set<int64_t> inflight_ids_;
   std::deque<Request> queue_;
   std::vector<Completion> completions_;
   std::vector<std::thread> workers_;
@@ -170,6 +196,10 @@ long long dstpu_aio_pread(void* handle, const char* path, void* buffer,
 
 long long dstpu_aio_wait(void* handle) {
   return static_cast<AioHandle*>(handle)->wait_all();
+}
+
+long long dstpu_aio_wait_upto(void* handle, long long max_id) {
+  return static_cast<AioHandle*>(handle)->wait_upto(max_id);
 }
 
 long long dstpu_aio_pending(void* handle) {

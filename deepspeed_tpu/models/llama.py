@@ -244,7 +244,7 @@ class LlamaDecoderModel(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, kv_caches, cache_index):
+    def __call__(self, input_ids, kv_caches, cache_index, attn_start=0):
         cfg = self.cfg
         B, T = input_ids.shape
         S_max = kv_caches[0].shape[2]
@@ -252,7 +252,8 @@ class LlamaDecoderModel(nn.Module):
                          param_dtype=jnp.float32, dtype=cfg.dtype,
                          name="embed_tokens")
         x = embed(input_ids)
-        positions, mask = decode_positions_and_mask(B, T, S_max, cache_index)
+        positions, mask = decode_positions_and_mask(B, T, S_max, cache_index,
+                                                    attn_start)
 
         if cfg.scan_layers:
             ScanBlock = nn.scan(
@@ -283,6 +284,103 @@ class LlamaDecoderModel(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
         return logits.astype(jnp.float32), new_caches
+
+
+class StreamedLlamaModel:
+    """Apply-twin of :class:`LlamaModel` that streams host-resident parameters
+    into device memory layer-by-layer — the compute path of ZeRO-3 parameter
+    offload (reference ``runtime/zero/parameter_offload.py:201`` streams
+    partitioned params per-submodule with fetch/release hooks; here the
+    fetch is an explicit ``jax.device_put`` inside a manual ``lax.scan`` over
+    the stacked block weights, and the release is XLA freeing the slice when
+    its last use ends).
+
+    The master params live in ``pinned_host`` memory (stages.py
+    ``offload_param``); XLA cannot compute on host-space operands, so every
+    weight is copied to device at its point of use: per-layer for the scanned
+    blocks (HBM holds ONE layer's weights at a time), once for
+    embed/final-norm/lm-head. The backward pass reverses the copies — grads
+    of host-resident inputs land back in host memory when the caller asks
+    (engine out_shardings), and the per-layer weight re-fetch in backward is
+    scheduled by XLA alongside recompute.
+
+    Math parity: every sub-module is applied through the REAL flax modules
+    (``LlamaBlock.apply``, ``nn.Embed``, ``RMSNorm``, ``nn.Dense``) on the
+    streamed slices, so logits are bit-identical to ``LlamaModel.apply`` on
+    the same weights (pinned by tests/unit/test_param_offload.py).
+
+    Plain class with the flax ``apply`` contract the engine's loss builders
+    expect (same pattern as :class:`FusedLlamaDecoderModel`).
+    """
+
+    def __init__(self, cfg: LlamaConfig, stream_shardings: Any):
+        """``stream_shardings``: pytree shaped like the param tree whose
+        ``blocks/block`` leaves carry the DEVICE sharding of one layer
+        *slice* (stacked spec minus the leading layer axis) and whose other
+        leaves carry their full device sharding — built by the engine from
+        its ZeRO plan."""
+        assert cfg.scan_layers, \
+            "parameter streaming requires scan_layers=True (stacked blocks)"
+        self.cfg = cfg
+        self._shardings = stream_shardings
+
+    def _stream(self, subtree, shardings):
+        return jax.tree_util.tree_map(
+            lambda w, sh: jax.device_put(w, sh), subtree, shardings)
+
+    def apply(self, variables, input_ids, positions=None, return_hidden=False,
+              rngs=None):
+        params = variables["params"]
+        cfg = self.cfg
+        B, S = input_ids.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         param_dtype=jnp.float32, dtype=cfg.dtype,
+                         name="embed_tokens")
+        emb_p = self._stream(params["embed_tokens"],
+                             self._shardings["embed_tokens"])
+        x = embed.apply({"params": emb_p}, input_ids)
+        mask = make_causal_mask(S)
+        if positions is None:
+            positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+
+        block = LlamaBlock(cfg, name="block")
+        block_shardings = self._shardings["blocks"]["block"]
+
+        def body(x, wslice):
+            w = self._stream(wslice, block_shardings)
+            return block.apply({"params": w}, x, mask, positions,
+                               rngs=rngs), None
+
+        if cfg.remat and cfg.remat_scope == "block":
+            body = jax.checkpoint(body, policy=_remat_policy(cfg.remat_policy))
+        x, _ = jax.lax.scan(body, x, params["blocks"]["block"])
+
+        final = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype,
+                        name="final_norm")
+        x = final.apply({"params": self._stream(
+            params["final_norm"], self._shardings["final_norm"])}, x)
+        if return_hidden:
+            return x
+        if cfg.tie_embeddings:
+            logits = embed.apply({"params": emb_p}, x.astype(jnp.float32),
+                                 method="attend")
+        else:
+            head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                            param_dtype=jnp.float32, name="lm_head")
+            logits = head.apply({"params": self._stream(
+                params["lm_head"], self._shardings["lm_head"])}, x)
+        return logits.astype(jnp.float32)
+
+    def lm_kernel(self, params):
+        """Device-resident [H, V] head kernel for the chunked LM loss
+        (engine fused_lm_loss path) — streams the tied embedding or lm_head
+        once; the chunked loss then re-reads the device copy per chunk."""
+        if self.cfg.tie_embeddings:
+            emb = self._stream(params["embed_tokens"],
+                               self._shardings["embed_tokens"])
+            return emb["embedding"].T
+        head = self._stream(params["lm_head"], self._shardings["lm_head"])
+        return head["kernel"]
 
 
 def fuse_decode_params(params: Any, cfg: LlamaConfig) -> Any:
@@ -321,15 +419,23 @@ def fuse_decode_params(params: Any, cfg: LlamaConfig) -> Any:
     return out
 
 
-def decode_positions_and_mask(batch: int, T: int, S_max: int, cache_index):
+def decode_positions_and_mask(batch: int, T: int, S_max: int, cache_index,
+                              attn_start=0):
     """Decode-step positions [B, T] and additive mask [1, 1, T, S_max]:
     rows attend to cache slots up to their own absolute position. Shared by
-    the baseline and fused decoders so their masking can never diverge."""
+    the baseline and fused decoders so their masking can never diverge.
+
+    ``attn_start`` (traced scalar): first valid cache slot — slots below it
+    are LEFT-PADDING and masked out. Rotary/ALiBi attention is invariant to
+    a uniform position shift, so left-padded prompts decode identically to
+    unpadded ones; this is what lets generate() bucket prompt lengths into
+    one compiled program (reference inference_context.h workspace reuse)."""
     positions = cache_index + jnp.arange(T, dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (batch, T))
     row_pos = cache_index + jnp.arange(T)[:, None]          # [T, 1]
     col = jnp.arange(S_max)[None, :]                        # [1, S_max]
-    mask = jnp.where(col <= row_pos, 0.0, jnp.finfo(jnp.float32).min)
+    valid = jnp.logical_and(col <= row_pos, col >= attn_start)
+    mask = jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
     return positions, mask[None, None, :, :]
 
 
@@ -343,7 +449,8 @@ class FusedLlamaDecoderModel:
     def __init__(self, cfg: LlamaConfig):
         self.cfg = cfg
 
-    def apply(self, variables, input_ids, kv_caches, cache_index):
+    def apply(self, variables, input_ids, kv_caches, cache_index,
+              attn_start=0):
         fused_params = variables["params"]
         cfg = self.cfg
         assert cfg.scan_layers, "fused decode expects scan-stacked params"
@@ -353,7 +460,8 @@ class FusedLlamaDecoderModel:
         hd = cfg.hidden_size // cfg.num_heads
         emb = fused_params["embed_tokens"]["embedding"]
         x = emb[input_ids].astype(cfg.dtype)
-        positions, mask = decode_positions_and_mask(B, T, S_max, cache_index)
+        positions, mask = decode_positions_and_mask(B, T, S_max, cache_index,
+                                                    attn_start)
 
         from deepspeed_tpu.models.transformer import (
             dot_product_attention, rotary_embedding,

@@ -54,30 +54,45 @@ class AsyncIOHandle:
                            ctypes.c_longlong, ctypes.c_longlong]
         self._lib.dstpu_aio_wait.restype = ctypes.c_longlong
         self._lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p]
+        self._lib.dstpu_aio_wait_upto.restype = ctypes.c_longlong
+        self._lib.dstpu_aio_wait_upto.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_longlong]
         self._lib.dstpu_aio_pending.restype = ctypes.c_longlong
         self._lib.dstpu_aio_pending.argtypes = [ctypes.c_void_p]
         self._handle = self._lib.dstpu_aio_create(block_size, queue_depth,
                                                   thread_count)
-        # keep buffers alive until wait() — the C++ side reads them directly
+        # keep buffers alive until their request completes — the C++ side
+        # reads them directly; (request_id, array) pairs pruned on waits
         self._live_buffers = []
 
     def pwrite(self, path: str, array: np.ndarray, offset: int = 0) -> int:
         arr = np.ascontiguousarray(array)
-        self._live_buffers.append(arr)
-        return self._lib.dstpu_aio_pwrite(
+        rid = self._lib.dstpu_aio_pwrite(
             self._handle, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
             arr.nbytes, offset)
+        self._live_buffers.append((rid, arr))
+        return rid
 
     def pread(self, path: str, array: np.ndarray, offset: int = 0) -> int:
         assert array.flags["C_CONTIGUOUS"], "pread target must be contiguous"
-        self._live_buffers.append(array)
-        return self._lib.dstpu_aio_pread(
+        rid = self._lib.dstpu_aio_pread(
             self._handle, path.encode(), array.ctypes.data_as(ctypes.c_void_p),
             array.nbytes, offset)
+        self._live_buffers.append((rid, array))
+        return rid
 
     def wait(self) -> int:
         failures = self._lib.dstpu_aio_wait(self._handle)
         self._live_buffers.clear()
+        return int(failures)
+
+    def wait_upto(self, request_id: int) -> int:
+        """Wait only for requests submitted up to (and including)
+        ``request_id`` — later submissions keep flowing (the per-name drain
+        the pipelined swapper needs to avoid serializing unrelated I/O)."""
+        failures = self._lib.dstpu_aio_wait_upto(self._handle, request_id)
+        self._live_buffers = [(rid, a) for rid, a in self._live_buffers
+                              if rid > request_id]
         return int(failures)
 
     def pending(self) -> int:

@@ -69,19 +69,26 @@ def _default_lm_loss(module, fused: bool = False,
     over sequence chunks instead of materializing [B, S, V] fp32 logits —
     ~2 GB of activation memory at 770M/32k-vocab scale. Off by default:
     at sizes where full logits fit comfortably it costs a few % step time."""
-    from deepspeed_tpu.models.llama import LlamaModel, loss_fn as lm_loss
+    from deepspeed_tpu.models.llama import (
+        LlamaModel, StreamedLlamaModel, loss_fn as lm_loss,
+    )
     from deepspeed_tpu.ops.fused_losses import chunked_lm_xent
 
     if fused:
-        if isinstance(module, LlamaModel):
+        if isinstance(module, (LlamaModel, StreamedLlamaModel)):
             tied = module.cfg.tie_embeddings
 
             def fn(params, batch, rngs=None):
                 h = module.apply({"params": params}, batch["input_ids"],
                                  positions=batch.get("positions"), rngs=rngs,
                                  return_hidden=True)
-                kernel = (params["embed_tokens"]["embedding"].T if tied
-                          else params["lm_head"]["kernel"])
+                if isinstance(module, StreamedLlamaModel):
+                    # host-resident weights: the head kernel must be
+                    # fetched to device before the chunked matmul
+                    kernel = module.lm_kernel(params)
+                else:
+                    kernel = (params["embed_tokens"]["embedding"].T if tied
+                              else params["lm_head"]["kernel"])
                 return chunked_lm_xent(h, kernel, batch["labels"],
                                        chunk_size=chunk_size)
 
@@ -156,8 +163,21 @@ class DeepSpeedEngine:
             params = self._sharded_init(model, sample_batch, sharding_rules)
         self.zero_plan: ZeroShardingPlan = plan_zero_shardings(
             params, self.mesh, self._config.zero_config, sharding_rules)
+
+        def _adopt(p, s):
+            # arrays from _sharded_init are already globally placed; only
+            # host-provided params need (process-aware) placement
+            if isinstance(p, jax.Array) and p.sharding.is_equivalent_to(
+                    s, p.ndim):
+                return p
+            if jax.process_count() > 1:
+                return self._place_global(p, s)
+            return jax.device_put(p, s)
+
         self.params = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(p, s), params, self.zero_plan.param_shardings)
+            _adopt, params, self.zero_plan.param_shardings)
+        if self.zero_plan.offload_param:
+            self._setup_param_streaming(model, loss_fn)
 
         # compression (reference compression/compress.py) ----------------------
         self._compressor = None
@@ -215,19 +235,21 @@ class DeepSpeedEngine:
 
         # optimizer -----------------------------------------------------------
         self.optimizer, self._lr_schedule = self._configure_optimizer()
-        # ZeRO-Infinity (reference stage3.py:1775-1835): optimizer states
-        # live on NVMe; the step swaps them through per sub-group
+        # ZeRO-Offload/Infinity (reference stage3.py:1775-1835): optimizer
+        # states live on NVMe (or in host RAM when offload_param pins params
+        # to the host too); the step swaps them through per sub-group
         from deepspeed_tpu.runtime.zero.infinity import (
-            NVMeOptimizerStates, validate_nvme_config,
+            OffloadedOptimizerStates, validate_offload_config,
         )
 
-        validate_nvme_config(self._config)
+        validate_offload_config(self._config)
         self._nvme = None
-        if self._config.zero_config.offload_optimizer_device == "nvme":
+        if (self._config.zero_config.offload_optimizer_device == "nvme"
+                or self.zero_plan.offload_param):
             import weakref
 
-            self._nvme = NVMeOptimizerStates(self.params, self.zero_plan,
-                                             self.mesh, self._config)
+            self._nvme = OffloadedOptimizerStates(self.params, self.zero_plan,
+                                                  self.mesh, self._config)
             # AIO thread pools/fds must not outlive the engine (long-lived
             # processes build many engines — sweeps, test suites)
             self._nvme_finalizer = weakref.finalize(self, self._nvme.close)
@@ -251,7 +273,7 @@ class DeepSpeedEngine:
         # returns them with a mesh-wide sharding compatible with jit args
         rep = NamedSharding(self.mesh, PartitionSpec())
         self.scaler_state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, rep), self.scaler_state)
+            lambda x: self._place_global(x, rep), self.scaler_state)
 
         # counters / timers / monitor -----------------------------------------
         self.micro_steps = 0
@@ -334,6 +356,66 @@ class DeepSpeedEngine:
                   if self._config.optimizer else 0.0]
 
     # --- init helpers ---------------------------------------------------------
+    def _offload_stream_shardings(self):
+        """Device-side shardings the streamed forward fetches host params
+        into (models/llama.StreamedLlamaModel): scanned-block leaves get
+        their one-layer slice spec — the stacked spec minus the leading
+        layers axis — everything else its full spec."""
+        specs = self.zero_plan.param_specs
+        mesh = self.mesh
+        is_spec = lambda x: isinstance(x, PartitionSpec)
+
+        def sliced(spec):
+            if len(spec) and spec[0] is not None:
+                logger.warning(
+                    "offload_param: stacked block spec %s shards the layer "
+                    "axis; the streamed slice re-shards on every fetch",
+                    spec)
+            return NamedSharding(mesh, PartitionSpec(*spec[1:]))
+
+        out = {}
+        for key, sub in specs.items():
+            mapper = sliced if key == "blocks" else \
+                (lambda s: NamedSharding(mesh, s))
+            out[key] = jax.tree_util.tree_map(mapper, sub, is_leaf=is_spec)
+        return out
+
+    def _setup_param_streaming(self, model, user_loss_fn):
+        """ZeRO-3 parameter offload compute path (reference
+        parameter_offload.py:201 fetch/release hooks → explicit per-layer
+        device_put inside the scan): scan-layers LlamaModel streams one
+        layer's weights at a time; any other model/loss falls back to one
+        whole-tree fetch at program entry (params stay out of HBM *between*
+        steps only)."""
+        from deepspeed_tpu.models.llama import LlamaModel, StreamedLlamaModel
+
+        if (user_loss_fn is None and isinstance(model, LlamaModel)
+                and model.cfg.scan_layers):
+            streamed = StreamedLlamaModel(model.cfg,
+                                          self._offload_stream_shardings())
+            self._streamed_module = streamed
+            self.loss_fn = _default_lm_loss(
+                streamed, fused=self._config.fused_lm_loss_enabled,
+                chunk_size=self._config.fused_lm_loss_chunk)
+            return
+        logger.warning(
+            "offload_param: %s with a %s loss is not the scanned-Llama "
+            "path — parameters stream as ONE block per step, so HBM "
+            "transiently holds the full parameter set during fwd/bwd",
+            type(model).__name__,
+            "custom" if user_loss_fn is not None else "default")
+        base = self.loss_fn
+        dev_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), self.zero_plan.param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+        def fetched_loss(params, batch, rngs=None):
+            pd = jax.tree_util.tree_map(lambda p, sh: jax.device_put(p, sh),
+                                        params, dev_shardings)
+            return base(pd, batch, rngs=rngs)
+
+        self.loss_fn = fetched_loss
+
     def _sharded_init(self, model, sample_batch, rules):
         """Initialize params already sharded (never materialize full replicas).
 
@@ -343,15 +425,30 @@ class DeepSpeedEngine:
         then run the real init jitted with those out_shardings.
         """
         init_rng, self._rng = jax.random.split(self._rng)
-        input_ids = jnp.asarray(sample_batch["input_ids"])[:1]
+        if jax.process_count() > 1:
+            # a committed single-device key cannot feed a global-mesh jit;
+            # a host array is treated as replicated (same seed everywhere)
+            init_rng = np.asarray(init_rng)
+        # numpy closure constant: safe to embed in a global-mesh program
+        input_ids = np.asarray(sample_batch["input_ids"])[:1]
 
         def init_fn(rng):
             return model.init(rng, input_ids)["params"]
 
         abstract = jax.eval_shape(init_fn, init_rng)
         plan = plan_zero_shardings(abstract, self.mesh, self._config.zero_config, rules)
+        out_sh = plan.param_shardings
+        if plan.offload_param and \
+                self.mesh.devices.flat[0].platform == "cpu":
+            # the virtual CPU backend cannot annotate host placement on jit
+            # OUTPUTS (works fine on TPU); initialize to device memory and
+            # let the engine's eager device_put move the tree to host —
+            # on CPU both are the same RAM
+            out_sh = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("device"), out_sh,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
         with self._ctx():
-            params = jax.jit(init_fn, out_shardings=plan.param_shardings)(init_rng)
+            params = jax.jit(init_fn, out_shardings=out_sh)(init_rng)
         return params
 
     def _configure_optimizer(self):
@@ -519,20 +616,51 @@ class DeepSpeedEngine:
                 lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g),
                 donate_argnums=(0,))
             if self._nvme is not None:
-                self._jit_grads_batch = jax.jit(grads_batch_fn)
+                grads_out_sh = None
+                if plan.offload_param and \
+                        mesh.devices.flat[0].platform != "cpu":
+                    # param offload at capacity scale: the full grad tree
+                    # must not sit in HBM through the sub-group update loop
+                    # — land it in pinned host memory as backward produces
+                    # it; the update fetches one group's grads at a time.
+                    # (CPU backend cannot annotate host jit outputs; there
+                    # device memory IS host RAM, so nothing is lost.)
+                    ghost = jax.tree_util.tree_map(
+                        lambda s: NamedSharding(mesh, s,
+                                                memory_kind="pinned_host"),
+                        plan.grad_specs,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec))
+                    grads_out_sh = (None, ghost, None, None, None)
+                self._jit_grads_batch = jax.jit(grads_batch_fn,
+                                                out_shardings=grads_out_sh)
                 self._jit_gnorm_finite = jax.jit(
                     lambda g: (optax.global_norm(g),
                                grads_finite(g) if (fp16 or numerics)
                                else jnp.asarray(True)))
 
     # --- data placement -------------------------------------------------------
+    def _place_global(self, x, sharding: NamedSharding):
+        """Place a host array onto the (possibly multi-process) mesh. In a
+        multi-controller run ``jax.device_put`` cannot address other
+        processes' devices; every process holds the same global batch (the
+        dataloader is seed-deterministic) and materializes only its
+        addressable shards via ``make_array_from_callback`` — the reference
+        feeds each rank its slice of the global batch the same way
+        (engine.py deepspeed_io + DistributedSampler)."""
+        if jax.process_count() > 1:
+            xnp = np.asarray(x)
+            return jax.make_array_from_callback(
+                xnp.shape, sharding, lambda idx: xnp[idx])
+        return jax.device_put(jnp.asarray(x), sharding)
+
     def _shard_batch(self, batch: Dict[str, Any], leading_gas: bool = False):
         seq_size = mesh_axis_size(self.mesh, "sequence")
 
         def put(x):
-            x = jnp.asarray(x)
+            x = jnp.asarray(x) if not isinstance(x, np.ndarray) else x
             if x.ndim == 0:
-                return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec()))
+                return self._place_global(
+                    x, NamedSharding(self.mesh, PartitionSpec()))
             axes = [None] * x.ndim
             b_axis = 1 if leading_gas else 0
             axes[b_axis] = data_axes(self.mesh)
@@ -540,7 +668,8 @@ class DeepSpeedEngine:
             s_axis = b_axis + 1
             if seq_size > 1 and x.ndim > s_axis and x.shape[s_axis] % seq_size == 0:
                 axes[s_axis] = "sequence"
-            return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec(*axes)))
+            return self._place_global(
+                x, NamedSharding(self.mesh, PartitionSpec(*axes)))
 
         return {k: put(v) for k, v in batch.items()}
 
@@ -652,7 +781,7 @@ class DeepSpeedEngine:
         batch = {k: to_gas_layout(v) for k, v in batch.items()}
         batch = self._shard_batch(batch, leading_gas=True)
         if self._compressor is not None:
-            batch[STEP_KEY] = jax.device_put(
+            batch[STEP_KEY] = self._place_global(
                 jnp.full((gas,), self.global_steps, jnp.int32),
                 NamedSharding(self.mesh, PartitionSpec()))
 
@@ -881,8 +1010,15 @@ class DeepSpeedEngine:
         mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
               for k, v in batch.items()}
         report = prof.profile(self.loss_fn, self.params, mb, time_it=False)
+        if cfg.detailed:
+            try:
+                prof.profile_modules(self.loss_fn, self.params, mb)
+            except Exception as e:   # profiling must never kill training
+                logger.warning("per-module flops attribution failed: %s", e)
         text = prof.print_model_profile(params=self.params,
-                                        detailed=cfg.detailed)
+                                        detailed=cfg.detailed,
+                                        module_depth=cfg.module_depth,
+                                        top_modules=cfg.top_modules)
         if cfg.output_file:
             with open(cfg.output_file, "w") as f:
                 f.write(text or "")

@@ -40,10 +40,11 @@ class AsyncTensorSwapper:
                                                     np.uint8)
         # name -> (treedef, [(shape, dtype), ...])
         self._meta: Dict[str, Tuple] = {}
-        # names with writes submitted but not yet waited on; the AIO thread
-        # pool does not order a queued read after a queued write of the same
-        # file, so reads of these names must drain writes first
-        self._pending_writes: set = set()
+        # name -> last submitted write request id; the AIO thread pool does
+        # not order a queued read after a queued write of the same file, so
+        # reads of these names drain THEIR writes first (wait_upto — other
+        # names' in-flight I/O keeps overlapping)
+        self._pending_writes: Dict[str, int] = {}
 
     def _alloc_staging(self, shape, dtype):
         """Return (array, handle|None): an arena view when possible."""
@@ -83,8 +84,13 @@ class AsyncTensorSwapper:
         return os.path.join(self.swap_dir, f"{name}.{i}.bin")
 
     def _drain_writes_for(self, name: str, context: str = "read") -> None:
-        if name in self._pending_writes:
-            failures = self.wait()
+        last_id = self._pending_writes.pop(name, None)
+        if last_id is not None:
+            failures = self.aio.wait_upto(last_id)
+            # every pending write submitted at-or-before last_id is drained
+            self._pending_writes = {n: i for n, i in
+                                    self._pending_writes.items()
+                                    if i > last_id}
             if failures:
                 raise IOError(f"drain before {context} of {name}: "
                               f"{failures} write failures")
@@ -97,17 +103,18 @@ class AsyncTensorSwapper:
         self._drain_writes_for(name, context="rewrite")
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         shapes = []
+        last_id = 0
         for i, leaf in enumerate(leaves):
             arr = np.asarray(leaf)
             shapes.append((arr.shape, arr.dtype))
-            self.aio.pwrite(self._leaf_path(name, i), arr)
+            last_id = self.aio.pwrite(self._leaf_path(name, i), arr)
         self._meta[name] = (treedef, shapes)
         if blocking:
-            failures = self.wait()
+            failures = self.aio.wait_upto(last_id)
             if failures:
                 raise IOError(f"swap_out({name}): {failures} write failures")
         else:
-            self._pending_writes.add(name)
+            self._pending_writes[name] = last_id
 
     def submit_reads(self, name: str, aio) -> Tuple[Any, list, list]:
         """Allocate buffers for ``name`` and submit its preads on ``aio``

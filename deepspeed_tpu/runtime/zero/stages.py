@@ -38,9 +38,10 @@ class ZeroShardingPlan(NamedTuple):
     param_specs: Any        # pytree of PartitionSpec for model params
     grad_specs: Any         # pytree of PartitionSpec gradients are constrained to
     opt_specs: Any          # pytree-spec applied to each optimizer-state leaf
-    param_shardings: Any    # NamedShardings (device memory)
+    param_shardings: Any    # NamedShardings (host memory when offload_param)
     opt_sharding_fn: Any    # leaf-path -> NamedSharding for optimizer state
     offload_optimizer: bool
+    offload_param: bool = False
 
 
 def _specs(params: Any, mesh: Mesh, rules, shard_data: bool,
@@ -94,8 +95,29 @@ def plan_zero_shardings(params: Any, mesh: Mesh, zero_config, rules=None) -> Zer
         logger.warning("offload_optimizer=cpu requested but this backend lacks "
                        "pinned_host memory; keeping optimizer states in HBM")
 
+    # ZeRO-3 parameter offload (reference partition_parameters.py:603 Init
+    # with remote_device='cpu' + parameter_offload.py:201): the master param
+    # pytree is RESIDENT in pinned host memory; the train step streams each
+    # scan-block's weights into HBM inside the layer loop (models/llama.py
+    # StreamedLlamaModel) so HBM never holds the full parameter set.
+    offp = zero_config.offload_param_device == "cpu"
+    if offp and stage < 3:
+        raise ValueError(
+            f"offload_param.device=cpu requires zero_optimization.stage=3 "
+            f"(got stage={stage}) — parameter offload partitions parameters, "
+            f"which only stage 3 does (reference zero/config.py contract)")
+    param_host_ok = offp and _supports_host_memory(mesh)
+    if offp and not param_host_ok:
+        logger.warning("offload_param=cpu requested but this backend lacks "
+                       "pinned_host memory; keeping parameters in HBM")
+
+    def param_sharding(spec: PartitionSpec) -> NamedSharding:
+        if param_host_ok:
+            return NamedSharding(mesh, spec, memory_kind="pinned_host")
+        return NamedSharding(mesh, spec)
+
     param_shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), param_specs,
+        param_sharding, param_specs,
         is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def opt_sharding(spec: PartitionSpec) -> NamedSharding:
@@ -110,6 +132,7 @@ def plan_zero_shardings(params: Any, mesh: Mesh, zero_config, rules=None) -> Zer
         param_shardings=param_shardings,
         opt_sharding_fn=opt_sharding,
         offload_optimizer=host_ok,
+        offload_param=param_host_ok,
     )
 
 

@@ -100,12 +100,31 @@ def init_distributed(dist_backend: str = "xla-ici",
             rank = int(os.environ.get("TPU_WORKER_ID",
                                       os.environ.get("CLOUD_TPU_TASK_ID",
                                                      -1)))
+    # the dst launcher's rendezvous contract (launcher/runner.py:148-150)
+    if coordinator is not None:
+        if world_size <= 0 and "DS_TPU_NUM_PROCESSES" in os.environ:
+            world_size = int(os.environ["DS_TPU_NUM_PROCESSES"])
+        if rank < 0 and "DS_TPU_PROCESS_ID" in os.environ:
+            rank = int(os.environ["DS_TPU_PROCESS_ID"])
     if coordinator is not None and world_size != 1:
         kwargs = {}
         if rank >= 0:
             kwargs["process_id"] = rank
         if world_size > 0:
             kwargs["num_processes"] = world_size
+        # NOTE: must not touch jax.default_backend()/devices here —
+        # distributed.initialize requires an uninitialized XLA backend
+        plat = (os.environ.get("JAX_PLATFORMS")
+                or str(getattr(jax.config, "jax_platforms", None) or ""))
+        if plat.startswith("cpu"):
+            # multi-process CPU ranks need a real collectives transport
+            # (the virtual test rig; TPU uses ICI/DCN natively)
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                logger.warning("no gloo CPU collectives in this jax build; "
+                               "multi-process CPU collectives may hang")
         if verbose:
             logger.info(f"Initializing JAX distributed: coordinator={coordinator} {kwargs}")
         jax.distributed.initialize(coordinator_address=coordinator, **kwargs)

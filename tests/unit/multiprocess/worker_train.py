@@ -1,0 +1,89 @@
+"""Multi-process rank worker: init→train→save→resume on a real
+``jax.distributed`` runtime (the executable half of the DistributedTest
+analogue — reference tests/unit/common.py:277 forks ranked CUDA processes;
+here ranked CPU processes rendezvous through the dst launcher's env
+contract: DS_TPU_COORDINATOR / DS_TPU_NUM_PROCESSES / DS_TPU_PROCESS_ID).
+
+Writes a JSON result file per rank; the parent test asserts cross-rank
+agreement. Invoked as:
+    python worker_train.py <result.json>
+with the rendezvous env already set.
+"""
+
+import json
+import os
+import sys
+
+# virtual CPU devices BEFORE backends initialize (sitecustomize may have
+# imported jax already — same dance as tests/conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count="
+                           + os.environ.get("MP_LOCAL_DEVICES", "2")).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge  # noqa: E402
+
+if xla_bridge._backends:
+    xla_bridge._clear_backends()
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main(result_path: str) -> None:
+    import deepspeed_tpu
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    dist.init_distributed()         # the comm.py rendezvous branch
+    assert jax.process_count() == int(os.environ["DS_TPU_NUM_PROCESSES"]), \
+        f"rendezvous failed: {jax.process_count()} processes"
+
+    ckpt_dir = os.environ["MP_CKPT_DIR"]
+    B, S = 8, 16
+
+    def build():
+        model = LlamaModel(LlamaConfig.tiny(dtype=jax.numpy.float32))
+        cfg = {
+            "train_batch_size": B,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "gradient_clipping": 1.0,
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 1000,
+        }
+        rng = np.random.default_rng(0)
+        t = rng.integers(0, 256, (B, S + 1))
+        return deepspeed_tpu.initialize(
+            model=model, config=cfg,
+            sample_batch={"input_ids": t[:, :-1], "labels": t[:, 1:]})
+
+    def batch(i):
+        rng = np.random.default_rng(100 + i)
+        t = rng.integers(0, 256, (B, S + 1))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    engine = build()
+    # same batch for the first steps: the loss must strictly decrease
+    losses = [float(engine.train_batch(batch(0))) for _ in range(3)]
+    engine.save_checkpoint(ckpt_dir)
+    cont = [float(engine.train_batch(batch(10 + i))) for i in range(2)]
+
+    engine2 = build()
+    engine2.load_checkpoint(ckpt_dir)
+    resumed = [float(engine2.train_batch(batch(10 + i))) for i in range(2)]
+
+    with open(result_path, "w") as f:
+        json.dump({
+            "rank": jax.process_index(),
+            "process_count": jax.process_count(),
+            "global_devices": jax.device_count(),
+            "local_devices": jax.local_device_count(),
+            "losses": losses,
+            "continued": cont,
+            "resumed": resumed,
+        }, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

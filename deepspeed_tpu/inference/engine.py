@@ -81,12 +81,26 @@ def check_decode_length(cfg, total_len: int) -> None:
 
 
 GEN_BUCKET = 32         # max_new_tokens rounds up to this program capacity
+PROMPT_BUCKET = 32      # prompt length rounds up to this (left-padded)
 GEN_CACHE_MAX = 16      # compiled-program LRU bound
 
 
 def gen_capacity(max_new_tokens: int) -> int:
     """Program/workspace capacity for a requested generation length."""
     return -(-max_new_tokens // GEN_BUCKET) * GEN_BUCKET
+
+
+def prompt_capacity(T: int, cfg=None) -> int:
+    """Prompt-slot capacity: rounds up to PROMPT_BUCKET so varying prompt
+    lengths reuse ONE compiled program + KV arena (the reference sizes one
+    workspace from max_out_tokens, inference_context.h:129-178, instead of
+    re-allocating per shape). Prompts are LEFT-padded to capacity and the
+    pad slots masked via ``attn_start`` — sound for rotary/ALiBi (attention
+    is invariant to the uniform position shift), so learned-position
+    configs keep exact-length programs."""
+    if cfg is not None and getattr(cfg, "pos_emb", "rotary") == "learned":
+        return T
+    return -(-T // PROMPT_BUCKET) * PROMPT_BUCKET
 
 
 def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
@@ -128,8 +142,10 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int,
     (engine.py:526) with zero per-token host round-trips. Sampling knobs
     (temperature/top_k/top_p/eos) are traced, so they never recompile.
 
-    ``apply_fn(params, tokens, caches, cache_index) -> (logits, caches)``.
-    Used by both InferenceEngine and the RLHF hybrid engine.
+    ``apply_fn(params, tokens, caches, cache_index, attn_start) ->
+    (logits, caches)``. Used by both InferenceEngine and the RLHF hybrid
+    engine. ``attn_start`` is the traced count of left-pad slots (prompt
+    bucketing) — 0 for exact-length prompts.
 
     ``params_fn`` (e.g. int8 dequantization) runs ONCE at the top of the
     program — the while_loop body then closes over the transformed weights
@@ -138,11 +154,11 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int,
     """
 
     def gen(params, input_ids, caches, rng, temperature, top_k, top_p,
-            eos_id, n_steps):
+            eos_id, n_steps, attn_start):
         if params_fn is not None:
             params = params_fn(params)
         logits, caches = apply_fn(params, input_ids, caches,
-                                  jnp.asarray(0, jnp.int32))
+                                  jnp.asarray(0, jnp.int32), attn_start)
         rng, key = jax.random.split(rng)
         nxt = sample_logits(logits[:, -1, :], key, temperature, top_k, top_p)
         finished = nxt == eos_id
@@ -162,7 +178,8 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int,
         def body(carry):
             i, tok, caches, rng, finished, out = carry
             logits, caches = apply_fn(params, tok[:, None], caches,
-                                      (T + i - 1).astype(jnp.int32))
+                                      (T + i - 1).astype(jnp.int32),
+                                      attn_start)
             rng, key = jax.random.split(rng)
             nxt = sample_logits(logits[:, 0, :], key, temperature, top_k,
                                 top_p)
@@ -189,6 +206,15 @@ class InferenceEngine:
             merged.update(kwargs)
             self._config = DeepSpeedInferenceConfig(**merged)
 
+        # A string model is a local HF checkpoint directory: stream-convert
+        # it (safetensors shards load tensor-by-tensor — the reference's
+        # meta-tensor + SDLoader path, inference/engine.py:331-443)
+        if isinstance(model, str):
+            from deepspeed_tpu.module_inject.replace_module import (
+                convert_hf_model,
+            )
+
+            model = convert_hf_model(checkpoint_dir=model)
         # An InjectedModel (module_inject.convert_hf_model) bundles the flax
         # module, converted params, and unified config — unpack it so
         # ``init_inference(model=convert_hf_model(hf_model))`` just works
@@ -358,15 +384,18 @@ class InferenceEngine:
         decoder, init_caches, transform = resolve_decoder(cfg)
         self._decoder = decoder
         self._decode_transform = transform
-        self._kv_caches = init_caches(cfg, batch_size, max_len, self.dtype)
+        # K/V are written in the model config's compute dtype — caches must
+        # match it (config "dtype" only steers conversion/casting upstream)
+        cache_dtype = getattr(cfg, "dtype", None) or self.dtype
+        self._kv_caches = init_caches(cfg, batch_size, max_len, cache_dtype)
         self._gen_cache = OrderedDict()
 
-        def step(params, tokens, caches, index):
+        def step(params, tokens, caches, index, attn_start=0):
             p = self._effective_params(params)
             if transform is not None:
                 p = transform(p)
             logits, new_caches = decoder.apply({"params": p}, tokens,
-                                               caches, index)
+                                               caches, index, attn_start)
             return logits, new_caches
 
         self._decode_fn = jax.jit(step, donate_argnums=(2,))
@@ -391,18 +420,24 @@ class InferenceEngine:
 
         Returns [B, T + max_new_tokens]; rows that hit ``eos_token_id`` are
         padded with it. The full loop runs as one compiled program; the
-        sampling knobs and the step count are traced, so only a new
-        (batch, prompt_len, capacity-bucket) shape recompiles. Compiled
+        sampling knobs, the step count, AND the prompt length (left-padded
+        to PROMPT_BUCKET, masked via attn_start) are traced — only a new
+        (batch, prompt-bucket, capacity-bucket) recompiles. Compiled
         programs are kept in a small LRU.
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
         check_decode_length(self.model_config, T + max_new_tokens)
-        self._ensure_decode(B, T + gen_capacity(max_new_tokens))
+        T_cap = prompt_capacity(T, self.model_config)
+        pad = T_cap - T
+        if pad:
+            input_ids = jnp.pad(input_ids, ((0, 0), (pad, 0)))
+        self._ensure_decode(B, T_cap + gen_capacity(max_new_tokens))
         decoder = self._decoder
 
-        def apply_fn(params, tokens, caches, index):
-            return decoder.apply({"params": params}, tokens, caches, index)
+        def apply_fn(params, tokens, caches, index, attn_start):
+            return decoder.apply({"params": params}, tokens, caches, index,
+                                 attn_start)
 
         # int8 dequant and/or the decoder's weight-layout transform (fused
         # qkv/gateup) run once at the program top (params_fn), NOT inside
@@ -415,7 +450,7 @@ class InferenceEngine:
         else:
             params_fn = transform
         gen_fn, cap = get_or_build_gen_fn(
-            self._gen_cache, apply_fn, B, T, max_new_tokens,
+            self._gen_cache, apply_fn, B, T_cap, max_new_tokens,
             params_fn=params_fn,
             params_key=("int8w" if self._quantized else "",
                         "fused" if transform is not None else "",
@@ -431,8 +466,9 @@ class InferenceEngine:
                 jnp.asarray(top_k, jnp.int32),
                 jnp.asarray(top_p, jnp.float32),
                 jnp.asarray(eos, jnp.int32),
-                jnp.asarray(max_new_tokens, jnp.int32))
-        tokens = tokens[:, : T + max_new_tokens]
+                jnp.asarray(max_new_tokens, jnp.int32),
+                jnp.asarray(pad, jnp.int32))
+        tokens = tokens[:, pad: T_cap + max_new_tokens]
         if t0 is not None:
             jax.block_until_ready(tokens)
             self._model_times.append(time.time() - t0)

@@ -321,7 +321,7 @@ class TransformerDecoderModel(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, kv_caches, cache_index):
+    def __call__(self, input_ids, kv_caches, cache_index, attn_start=0):
         cfg = self.cfg
         if not cfg.causal or not cfg.lm_head:
             raise ValueError(
@@ -345,11 +345,15 @@ class TransformerDecoderModel(nn.Module):
         if cfg.embed_ln or not cfg.pre_ln:
             x = _norm(cfg, "ln_emb")(x)
 
-        # rows attend to cache slots up to their own absolute position
+        # rows attend to cache slots up to their own absolute position;
+        # slots below attn_start are left-padding (prompt bucketing —
+        # rotary/alibi are shift-invariant; learned positions never pad)
         row_pos = cache_index + jnp.arange(T)[:, None]           # [T, 1]
         col = jnp.arange(S_max)[None, :]                         # [1, S_max]
         neg = jnp.finfo(jnp.float32).min
-        base_mask = jnp.where(col <= row_pos, 0.0, neg)[None, None, :, :]
+        base_mask = jnp.where(
+            jnp.logical_and(col <= row_pos, col >= attn_start), 0.0,
+            neg)[None, None, :, :]
         if cfg.pos_emb == "alibi":
             slopes = alibi_slopes(cfg.num_heads)
             rel = (col - row_pos).astype(jnp.float32)            # [T, S_max]

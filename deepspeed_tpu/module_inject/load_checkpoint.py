@@ -1,0 +1,101 @@
+"""Streaming HF-checkpoint loading: peak host memory O(one tensor), not
+O(model).
+
+TPU-native analogue of the reference's sharded/meta-tensor checkpoint path
+(``module_inject/load_checkpoint.py``, ``inference/engine.py:331-443``
+``_load_checkpoint`` with SDLoader, ``runtime/state_dict_factory.py:21``):
+the reference builds the module on meta tensors and materializes weights
+shard-by-shard; here the conversion policies read from a LAZY mapping that
+opens safetensors shards on demand and loads each tensor only at its point
+of use — the full torch state_dict never exists in host memory alongside
+the converted flax params.
+"""
+
+import json
+import os
+from typing import Any, Dict, Iterator, Mapping, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ShardedStateDict(Mapping):
+    """Read-only lazy state_dict over a local HF checkpoint directory.
+
+    Supports single-file ``model.safetensors``, sharded
+    ``model.safetensors.index.json`` checkpoints, and (fallback)
+    ``pytorch_model.bin`` — the last loads eagerly with a warning, since
+    torch pickles cannot be read tensor-by-tensor safely.
+
+    ``__getitem__`` returns a numpy array loaded from disk at call time; at
+    most ONE shard file is open at once (``max_open_shards`` is tracked for
+    tests). Nothing is cached: the conversion policy's working set IS the
+    peak, giving O(largest tensor) overhead on top of the converted output.
+    """
+
+    def __init__(self, ckpt_dir: str):
+        self.ckpt_dir = ckpt_dir
+        self._key_to_shard: Dict[str, str] = {}
+        self.max_open_shards = 0
+        self._eager: Optional[Dict[str, Any]] = None
+
+        index = os.path.join(ckpt_dir, "model.safetensors.index.json")
+        single = os.path.join(ckpt_dir, "model.safetensors")
+        torch_bin = os.path.join(ckpt_dir, "pytorch_model.bin")
+        if os.path.exists(index):
+            with open(index) as f:
+                self._key_to_shard = dict(json.load(f)["weight_map"])
+        elif os.path.exists(single):
+            from safetensors import safe_open
+
+            with safe_open(single, framework="np") as f:
+                self._key_to_shard = {k: "model.safetensors"
+                                      for k in f.keys()}
+        elif os.path.exists(torch_bin):
+            import torch
+
+            logger.warning(
+                "%s has no safetensors checkpoint; falling back to EAGER "
+                "pytorch_model.bin load (torch pickles cannot stream "
+                "tensor-by-tensor) — save with safetensors for O(one-shard) "
+                "conversion memory", ckpt_dir)
+            self._eager = {k: v for k, v in
+                           torch.load(torch_bin, map_location="cpu",
+                                      weights_only=True).items()}
+            self._key_to_shard = {k: "" for k in self._eager}
+        else:
+            raise FileNotFoundError(
+                f"{ckpt_dir}: no model.safetensors[.index.json] or "
+                f"pytorch_model.bin")
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        if self._eager is not None:
+            return self._eager[key]
+        shard = self._key_to_shard[key]     # KeyError propagates
+        from safetensors import safe_open
+
+        self.max_open_shards = max(self.max_open_shards, 1)
+        with safe_open(os.path.join(self.ckpt_dir, shard),
+                       framework="np") as f:
+            t = f.get_tensor(key)
+        # policies expect float()-able values; bf16 numpy views convert fine
+        return t
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._key_to_shard)
+
+    def __len__(self) -> int:
+        return len(self._key_to_shard)
+
+    def __contains__(self, key) -> bool:
+        return key in self._key_to_shard
+
+
+def load_hf_checkpoint(ckpt_dir: str):
+    """(lazy state_dict, hf_config) for a local HF checkpoint directory —
+    the entry point ``init_inference(model="/path/to/ckpt")`` uses."""
+    from transformers import AutoConfig
+
+    cfg = AutoConfig.from_pretrained(ckpt_dir)
+    return ShardedStateDict(ckpt_dir), cfg

@@ -61,18 +61,36 @@ class InjectedModel:
 
 
 def convert_hf_model(model=None, state_dict=None, hf_config=None,
-                     dtype=None, policy: Optional[TransformerPolicy] = None
-                     ) -> InjectedModel:
-    """Convert an HF torch model (or its state_dict + config) to flax.
+                     dtype=None, policy: Optional[TransformerPolicy] = None,
+                     checkpoint_dir: Optional[str] = None) -> InjectedModel:
+    """Convert an HF torch model (or its state_dict + config, or a local
+    checkpoint directory) to flax.
 
     The conversion analogue of ``replace_transformer_layer``: policy lookup,
     weight re-layout (transpose / qkv un-fuse), config mapping.
+
+    ``checkpoint_dir`` (or ``model="/path"``) streams: tensors load from
+    safetensors shards at their point of use, so peak host memory is the
+    converted params + O(one tensor) — the reference's meta-tensor/SDLoader
+    path (inference/engine.py:331-443, module_inject/load_checkpoint.py)
+    without ever materializing the torch state_dict.
     """
+    if isinstance(model, str) and checkpoint_dir is None:
+        checkpoint_dir, model = model, None
+    if checkpoint_dir is not None:
+        from deepspeed_tpu.module_inject.load_checkpoint import (
+            load_hf_checkpoint,
+        )
+
+        lazy_sd, lazy_cfg = load_hf_checkpoint(checkpoint_dir)
+        state_dict = lazy_sd if state_dict is None else state_dict
+        hf_config = hf_config or lazy_cfg
     if model is not None:
         hf_config = hf_config or model.config
         state_dict = state_dict if state_dict is not None else model.state_dict()
     if hf_config is None or state_dict is None:
-        raise ValueError("need an HF model, or state_dict + hf_config")
+        raise ValueError(
+            "need an HF model, a checkpoint_dir, or state_dict + hf_config")
 
     policy = policy or policy_for(hf_config)
     if policy is None:
@@ -82,7 +100,10 @@ def convert_hf_model(model=None, state_dict=None, hf_config=None,
             f"registered in deepspeed_tpu/module_inject/containers/")
 
     cfg = policy.build_config(hf_config, dtype=dtype)
-    params = policy.convert(dict(state_dict), hf_config)
+    # plain dicts are copied (policies may pop); lazy mappings pass through
+    # so each tensor loads from its shard at the point of use
+    sd = dict(state_dict) if isinstance(state_dict, dict) else state_dict
+    params = policy.convert(sd, hf_config)
     injected = InjectedModel(cfg=cfg, params=params, rules=policy.tp_rules(),
                              policy=policy)
     if dtype is not None:

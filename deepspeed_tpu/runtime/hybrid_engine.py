@@ -70,8 +70,14 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         decoder, init_caches, transform = resolve_decoder(self.model_cfg)
         self._decoder = decoder
         self._decode_transform = transform
+        # the decoder writes K/V in the MODEL config's dtype — caches must
+        # match it, not the training precision (an fp32 model under the
+        # default-bf16 engine config would hit a dtype mismatch in the
+        # cache update)
+        cache_dtype = getattr(self.model_cfg, "dtype", None) \
+            or self.compute_dtype
         self._kv_caches = init_caches(self.model_cfg, batch_size, max_len,
-                                      self.compute_dtype)
+                                      cache_dtype)
         self._gen_cache = OrderedDict()
 
         def step(p, t, c, i):
@@ -106,6 +112,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         (inference/engine.py get_or_build_gen_fn)."""
         from deepspeed_tpu.inference.engine import (
             check_decode_length, gen_capacity, get_or_build_gen_fn,
+            prompt_capacity,
         )
 
         was_training = not self._in_eval
@@ -116,13 +123,17 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
         check_decode_length(self.model_cfg, T + max_new_tokens)
-        self._ensure_decode(B, T + gen_capacity(max_new_tokens))
+        T_cap = prompt_capacity(T, self.model_cfg)
+        pad = T_cap - T
+        if pad:
+            input_ids = jnp.pad(input_ids, ((0, 0), (pad, 0)))
+        self._ensure_decode(B, T_cap + gen_capacity(max_new_tokens))
         decoder = self._decoder
         transform = self._decode_transform
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache,
-            lambda p, t, c, i: decoder.apply({"params": p}, t, c, i),
-            B, T, max_new_tokens, params_fn=transform,
+            lambda p, t, c, i, s: decoder.apply({"params": p}, t, c, i, s),
+            B, T_cap, max_new_tokens, params_fn=transform,
             params_key="fused" if transform is not None else None)
         if rng is None:
             rng = jax.random.PRNGKey(self.global_steps)
@@ -135,8 +146,9 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 jnp.asarray(top_k, jnp.int32),
                 jnp.asarray(top_p, jnp.float32),
                 jnp.asarray(eos, jnp.int32),
-                jnp.asarray(max_new_tokens, jnp.int32))
-        tokens = tokens[:, : T + max_new_tokens]
+                jnp.asarray(max_new_tokens, jnp.int32),
+                jnp.asarray(pad, jnp.int32))
+        tokens = tokens[:, pad: T_cap + max_new_tokens]
 
         self.latency_timer.stop(synchronize=True)
         self.generate_time = self.latency_timer.elapsed(reset=True)

@@ -1,0 +1,95 @@
+"""Streaming HF checkpoint conversion (VERDICT r2 #5): peak host memory is
+O(converted params + one tensor), not O(torch state_dict + params).
+
+Reference analogue: meta-tensor + SDLoader sharded loading
+(``inference/engine.py:331-443``, ``module_inject/load_checkpoint.py``,
+``runtime/state_dict_factory.py:21``)."""
+
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.module_inject.load_checkpoint import (  # noqa: E402
+    ShardedStateDict, load_hf_checkpoint,
+)
+from deepspeed_tpu.module_inject.replace_module import (  # noqa: E402
+    convert_hf_model,
+)
+
+
+@pytest.fixture(scope="module")
+def sharded_ckpt(tmp_path_factory):
+    """A tiny GPT-2 checkpoint saved as MULTIPLE safetensors shards."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=3, n_head=2)
+    model = transformers.GPT2LMHeadModel(cfg)
+    d = tmp_path_factory.mktemp("ckpt")
+    model.save_pretrained(d, safe_serialization=True, max_shard_size="50KB")
+    assert os.path.exists(d / "model.safetensors.index.json"), \
+        "checkpoint must be sharded for this test"
+    return model, d
+
+
+def test_lazy_mapping_contract(sharded_ckpt):
+    model, d = sharded_ckpt
+    sd = ShardedStateDict(str(d))
+    eager = model.state_dict()
+    # keys match (modulo HF's tied/aliased weights that safetensors drops)
+    assert set(sd).issubset(set(eager))
+    k = "transformer.h.0.attn.c_attn.weight"
+    np.testing.assert_allclose(np.asarray(sd[k]),
+                               eager[k].float().numpy(), rtol=0, atol=0)
+    with pytest.raises(KeyError):
+        sd["nonexistent.weight"]
+
+
+def test_streaming_conversion_matches_eager(sharded_ckpt):
+    model, d = sharded_ckpt
+    streamed = convert_hf_model(checkpoint_dir=str(d))
+    eager = convert_hf_model(model)
+    import jax
+
+    flat_s = jax.tree_util.tree_leaves_with_path(streamed.params)
+    flat_e = dict(jax.tree_util.tree_leaves_with_path(eager.params))
+    assert len(flat_s) == len(flat_e)
+    for path, leaf in flat_s:
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat_e[path]),
+                                   rtol=1e-6, atol=1e-6, err_msg=str(path))
+
+
+def test_streaming_conversion_bounded_memory(sharded_ckpt):
+    """Python-level peak during streamed conversion stays within a small
+    multiple of the converted output — the full state_dict is never
+    materialized beside it (the dict() path would add a full extra copy)."""
+    _, d = sharded_ckpt
+    sd, cfg = load_hf_checkpoint(str(d))
+    total_bytes = 0
+    for k in sd:
+        t = sd[k]
+        total_bytes += t.nbytes
+    tracemalloc.start()
+    injected = convert_hf_model(state_dict=sd, hf_config=cfg)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert sd.max_open_shards <= 1
+    assert peak < 3.0 * total_bytes, (
+        f"conversion peaked at {peak} bytes for a {total_bytes}-byte "
+        f"checkpoint — streaming should stay under ~3x (output + one "
+        f"tensor + transposes)")
+    assert injected.params is not None
+
+
+def test_init_inference_accepts_checkpoint_dir(sharded_ckpt):
+    import deepspeed_tpu
+
+    _, d = sharded_ckpt
+    eng = deepspeed_tpu.init_inference(model=str(d),
+                                       config={"dtype": "float32"})
+    ids = np.random.default_rng(0).integers(1, 120, (1, 8))
+    out = eng.generate(np.asarray(ids, np.int32), max_new_tokens=4)
+    assert out.shape == (1, 12)

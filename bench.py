@@ -150,18 +150,24 @@ def inference_main(int8: bool = False, batch_size: int = 1):
 
 
 def rlhf_main():
-    """--rlhf: DS-Chat-style actor loop on the hybrid engine — rollout
-    generation (prompt 256 + gen 128, the reference RLHF workload family,
-    BASELINE.md seq 256+256) then a PPO-proxy train step on the rolled-out
-    sequences, against the same sharded weights. Reports e2e tokens/s;
-    vs_baseline is e2e throughput relative to this chip's pure-train
-    throughput (the hybrid flip's efficiency — the reference's DS-Chat
-    claim is precisely that generation need not dominate the loop)."""
+    """--rlhf: the DS-Chat-shaped three-model PPO loop — 770M actor on the
+    hybrid engine (rollout prompt 256 + gen 128, the reference RLHF
+    workload family, BASELINE.md seq 256+256), a critic engine, and a
+    frozen reward model, through DeepSpeedPPOTrainer.generate_experience →
+    train_rlhf. Reports e2e tokens/s with the generate/actor-step/
+    critic-step wall split; vs_baseline is e2e throughput relative to the
+    actor's pure-train throughput (the hybrid flip's efficiency — the
+    reference's DS-Chat claim is precisely that generation need not
+    dominate the loop)."""
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    from deepspeed_tpu.runtime.ppo_trainer import (
+        DeepSpeedPPOTrainer, LlamaCriticModel, make_actor_ppo_loss,
+        make_critic_value_loss,
+    )
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -170,59 +176,87 @@ def rlhf_main():
             num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
             dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
             scan_layers=True)
+        # DS-Chat pairs a big actor with a smaller critic/reward model
+        critic_cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=512, intermediate_size=1408,
+            num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, scan_layers=True)
         batch, prompt_len, gen_len, iters = 8, 256, 128, 3
     else:
         cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        critic_cfg = LlamaConfig.tiny(dtype=jnp.float32, num_layers=1)
         batch, prompt_len, gen_len, iters = 4, 8, 8, 2
 
-    model = LlamaModel(cfg)
+    actor_model = LlamaModel(cfg)
+    critic_model = LlamaCriticModel(critic_cfg)
+    reward_model = LlamaCriticModel(critic_cfg)
     seq = prompt_len + gen_len
-    ds_config = {
-        "train_micro_batch_size_per_gpu": batch,
-        "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-5}},
-        "zero_optimization": {"stage": 1},
-        "bf16": {"enabled": on_tpu},
-        "hybrid_engine": {"enabled": True,
-                          "max_out_tokens": seq + gen_len},
-        "steps_per_print": 1000,
-    }
     rng = np.random.default_rng(0)
-    toks = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1))
-    engine = deepspeed_tpu.initialize(
-        model=model, config=ds_config, model_config=cfg,
-        sample_batch={"input_ids": toks[:1, :-1], "labels": toks[:1, 1:]})
+    toks = rng.integers(0, cfg.vocab_size, size=(batch, seq))
+    sample = {"input_ids": toks, "labels": toks}
+
+    def ds_cfg(extra=None):
+        c = {"train_micro_batch_size_per_gpu": batch,
+             "gradient_accumulation_steps": 1,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-5}},
+             "zero_optimization": {"stage": 1},
+             "bf16": {"enabled": on_tpu},
+             "steps_per_print": 1000}
+        c.update(extra or {})
+        return c
+
+    actor = deepspeed_tpu.initialize(
+        model=actor_model, model_config=cfg,
+        config=ds_cfg({"hybrid_engine": {"enabled": True,
+                                         "max_out_tokens": seq + gen_len}}),
+        loss_fn=make_actor_ppo_loss(actor_model), sample_batch=sample)
+    critic = deepspeed_tpu.initialize(
+        model=critic_model, config=ds_cfg(),
+        loss_fn=make_critic_value_loss(critic_model), sample_batch=sample)
+    reward_params = reward_model.init(
+        jax.random.PRNGKey(7), jnp.asarray(toks[:1]))["params"]
+    reward_fn = DeepSpeedPPOTrainer.reward_from_params(reward_model,
+                                                       reward_params)
+    trainer = DeepSpeedPPOTrainer(actor, critic, reward_fn)
 
     prompts = rng.integers(0, cfg.vocab_size, size=(batch, prompt_len))
 
-    def one_iter():
-        rolled = engine.generate(prompts, max_new_tokens=gen_len,
-                                 temperature=1.0)
-        batch_t = {"input_ids": rolled[:, :-1], "labels": rolled[:, 1:]}
-        return float(engine.train_batch(batch_t))
+    def one_iter(i):
+        return trainer.step(prompts, gen_len, rng=jax.random.PRNGKey(i))
 
-    loss = one_iter()               # compile generate + train programs
+    stats = one_iter(0)             # compile all programs
     windows = 3 if on_tpu else 1
+    split = {"generate_s": [], "actor_step_s": [], "critic_step_s": []}
 
     def e2e_window():
-        for _ in range(iters):
-            one_iter()
+        for i in range(iters):
+            one_iter(i + 1)
+            split["generate_s"].append(trainer.generate_time)
+            split["actor_step_s"].append(trainer.actor_step_time)
+            split["critic_step_s"].append(trainer.critic_step_time)
 
     e2e_tok_s = iters * batch * seq / time_best(e2e_window, windows)
 
-    # pure-train throughput at the SAME shapes/program (warmed by one_iter),
-    # for the overhead ratio
-    rolled0 = engine.generate(prompts, max_new_tokens=gen_len,
-                              temperature=1.0)
-    batch0 = {"input_ids": rolled0[:, :-1], "labels": rolled0[:, 1:]}
-    float(engine.train_batch(batch0))
+    # ACTOR pure-train throughput at the same shapes for the overhead
+    # ratio (the hybrid-flip efficiency claim is about the actor; timing
+    # train_rlhf here would fold in the critic step + host GAE loop and
+    # overstate the ratio)
+    exp0 = trainer.generate_experience(prompts, gen_len,
+                                       rng=jax.random.PRNGKey(99))
+    adv0, ret0 = trainer._advantages(exp0)
+    seq0 = exp0["seq"]
+    actor_batch0 = {"input_ids": seq0[:, :-1], "labels": seq0[:, 1:],
+                    "old_logp": exp0["old_logp"], "advantages": adv0,
+                    "loss_mask": exp0["loss_mask"]}
+    float(actor.train_batch(actor_batch0))
 
     def train_window():
         for _ in range(iters):
-            float(engine.train_batch(batch0))
+            float(actor.train_batch(actor_batch0))
 
     train_tok_s = iters * batch * seq / time_best(train_window, windows)
 
+    med = lambda xs: round(float(np.median(xs)), 3) if xs else 0.0
     print(json.dumps({
         "metric": "llama770m_rlhf_e2e_tokens_per_sec",
         "value": round(e2e_tok_s, 1),
@@ -230,8 +264,13 @@ def rlhf_main():
         "vs_baseline": round(e2e_tok_s / max(train_tok_s, 1e-6), 3),
         "detail": {"batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "iters": iters,
+                   "generate_s_p50": med(split["generate_s"]),
+                   "actor_step_s_p50": med(split["actor_step_s"]),
+                   "critic_step_s_p50": med(split["critic_step_s"]),
                    "train_only_tokens_per_sec": round(train_tok_s, 1),
-                   "loss": loss, "backend": jax.default_backend()},
+                   "actor_loss": stats["actor_loss"],
+                   "critic_loss": stats["critic_loss"],
+                   "backend": jax.default_backend()},
     }))
 
 
@@ -425,6 +464,205 @@ def moe_main():
     }))
 
 
+def aio_main():
+    """--aio: measure the C++ AIO threadpool (VERDICT r2 #7 — the AIO layer
+    needed performance evidence; reference csrc/aio + tests/perf).
+    Sequential/random read+write MB/s through the swap path, plus the
+    projected ZeRO-Infinity step overhead at 770M against README's
+    16 bytes/param/step budget."""
+    import os
+    import tempfile
+
+    from deepspeed_tpu.ops.native import AsyncIOHandle
+
+    chunk_mb = 64
+    n_chunks = 8
+    total = chunk_mb * n_chunks * (1 << 20)
+    bufs = [np.random.default_rng(i).integers(
+        0, 255, chunk_mb << 20, dtype=np.uint8) for i in range(n_chunks)]
+    out = {}
+    with tempfile.TemporaryDirectory(dir="/tmp") as d:
+        aio = AsyncIOHandle(block_size=1 << 20, queue_depth=16,
+                            thread_count=4)
+        paths = [os.path.join(d, f"blk{i}.bin") for i in range(n_chunks)]
+
+        t0 = time.time()
+        for p, b in zip(paths, bufs):
+            aio.pwrite(p, b)
+        assert aio.wait() == 0
+        out["seq_write_MBps"] = total / (time.time() - t0) / 1e6
+
+        # evict the just-written pages so preads hit storage, not the page
+        # cache (sync flushes but does NOT evict)
+        for p in paths:
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+            finally:
+                os.close(fd)
+        reads = [np.empty(chunk_mb << 20, np.uint8) for _ in range(n_chunks)]
+        t0 = time.time()
+        for p, b in zip(paths, reads):
+            aio.pread(p, b)
+        assert aio.wait() == 0
+        out["seq_read_MBps"] = total / (time.time() - t0) / 1e6
+
+        # random 1MB reads at random offsets within the written files
+        for p in paths:
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+            finally:
+                os.close(fd)
+        rng = np.random.default_rng(0)
+        small = [np.empty(1 << 20, np.uint8) for _ in range(64)]
+        t0 = time.time()
+        for b in small:
+            p = paths[rng.integers(n_chunks)]
+            off = int(rng.integers(chunk_mb - 1)) << 20
+            aio.pread(p, b, offset=off)
+        assert aio.wait() == 0
+        out["rand_read_1M_MBps"] = 64 * (1 << 20) / (time.time() - t0) / 1e6
+        aio.close()
+
+    # ZeRO-Infinity budget: each step reads AND writes fp32 m+v → 16 B/param
+    p770 = 777_856_512
+    rw_mbps = 2 / (1 / out["seq_read_MBps"] + 1 / out["seq_write_MBps"])
+    out["projected_770m_step_overhead_s"] = 16 * p770 / (rw_mbps * 1e6)
+    print(json.dumps({
+        "metric": "aio_seq_rw_MBps",
+        "value": round(rw_mbps, 1),
+        "unit": "MB/s",
+        "vs_baseline": 0,
+        "detail": {k: round(v, 2) for k, v in out.items()},
+    }))
+
+
+def autotune_main():
+    """--autotune: close the loop between the autotuner and the shipping
+    bench (VERDICT r2 #4) — the tuner searches zero-stage × micro-batch ×
+    remat-policy × fused_lm_loss over REAL timed trials on this chip and
+    must reproduce-or-beat the hand-picked 16×512 / whole-block-remat
+    operating point. Prints the BENCH JSON line measured with the TUNER'S
+    chosen config (plus the search trace in detail)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, ModelInfo
+    from deepspeed_tpu.autotuning.config import get_autotuning_config
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        base_model_cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
+            scan_layers=True)
+        seq, steps = 512, 6
+        search = {"zero_stages": [1], "micro_batch_sizes": [8, 16, 24],
+                  "remat_policies": ["block:nothing_saveable",
+                                     "mlp:save_mlp", "none"],
+                  "fused_lm_loss_options": [False, True],
+                  "start_profile_step": 2, "end_profile_step": 5}
+        hbm = 15.75e9
+    else:   # CPU smoke: tiny model, tiny search
+        base_model_cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        seq, steps = 64, 3
+        search = {"zero_stages": [1], "micro_batch_sizes": [2, 4],
+                  "remat_policies": ["block:nothing_saveable", "none"],
+                  "start_profile_step": 1, "end_profile_step": 2}
+        hbm = None
+
+    base_config = {
+        "train_micro_batch_size_per_gpu": 16 if on_tpu else 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": on_tpu},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+        "autotuning": {"enabled": True, "tuner_type": "gridsearch",
+                       "metric": "throughput", **search},
+    }
+    rng = np.random.default_rng(0)
+    vocab = base_model_cfg.vocab_size
+
+    def batch_factory(mbs, gas):
+        t = rng.integers(0, vocab, size=(mbs * gas, seq + 1))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    def engine_factory(cfg_dict):
+        cfg_dict = dict(cfg_dict)
+        overrides = cfg_dict.pop("_model_overrides", None) or {}
+        mcfg = dataclasses.replace(base_model_cfg, **overrides)
+        model = LlamaModel(mcfg)
+        mbs = cfg_dict.get("train_micro_batch_size_per_gpu", 1)
+        return deepspeed_tpu.initialize(
+            model=model, config=cfg_dict,
+            sample_batch=batch_factory(min(mbs, 2), 1))
+
+    # model info from a cheap traced forward of the base model
+    probe_engine = engine_factory({k: v for k, v in base_config.items()
+                                   if k != "autotuning"})
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(probe_engine.params))
+    act_per_sample = int(
+        (2 if on_tpu else 4) * seq * base_model_cfg.hidden_size
+        * base_model_cfg.num_layers * 2)       # residual-pair rule of thumb
+    info = ModelInfo(n_params, act_per_sample, 6.0 * n_params * seq)
+    del probe_engine
+
+    tuner = Autotuner(engine_factory, batch_factory, base_config, info,
+                      dp_size=1, hbm_bytes_per_device=hbm,
+                      config=get_autotuning_config(base_config))
+    best_cfg = tuner.tune()
+    assert best_cfg is not None, "autotuner found no feasible config"
+
+    # measure the BENCH metric with the tuner's chosen config
+    overrides = best_cfg.pop("_model_overrides", None) or {}
+    mcfg = dataclasses.replace(base_model_cfg, **overrides)
+    model = LlamaModel(mcfg)
+    mbs = best_cfg["train_micro_batch_size_per_gpu"]
+    engine = deepspeed_tpu.initialize(model=model, config=best_cfg,
+                                      sample_batch=batch_factory(mbs, 1))
+    batches = [batch_factory(mbs, 1) for _ in range(4)]
+    float(engine.train_batch(batches[0]))
+    state = {}
+
+    def window():
+        for i in range(steps):
+            state["loss"] = engine.train_batch(batches[i % len(batches)])
+        float(state["loss"])
+
+    dt = time_best(window, 3 if on_tpu else 1)
+    tok = steps * mbs * seq / dt
+    flops_per_sec = 6.0 * n_params * tok
+    peak = 197e12 if on_tpu else 1e12
+    mfu = flops_per_sec / peak
+    trials = {k: (round(v.get("throughput", 0), 1)
+                  if "error" not in v else "infeasible")
+              for k, v in tuner.results.items()}
+    best_key = max((k for k, v in tuner.results.items() if "error" not in v),
+                   key=lambda k: tuner.results[k].get("throughput", 0),
+                   default="?")
+    print(json.dumps({
+        "metric": "llama770m_autotuned_train_tokens_per_sec_per_chip",
+        "value": round(tok, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / (49.0 / 125.0), 3),
+        "detail": {"chosen": best_key, "micro_batch": mbs, "seq": seq,
+                   "model_overrides": overrides,
+                   "fused_lm_loss": best_cfg.get("fused_lm_loss", {}),
+                   "mfu": round(mfu, 4), "trials": trials,
+                   "backend": jax.default_backend()},
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -534,5 +772,9 @@ if __name__ == "__main__":
         longseq_main()
     elif "--moe" in sys.argv:
         moe_main()
+    elif "--autotune" in sys.argv:
+        autotune_main()
+    elif "--aio" in sys.argv:
+        aio_main()
     else:
         main()

@@ -1,0 +1,253 @@
+"""DS-Chat-shaped RLHF: actor (hybrid engine) + critic + frozen reward
+model in one PPO loop.
+
+TPU-native analogue of DeepSpeed-Chat's ``DeepSpeedPPOTrainer`` (the loop
+the hybrid engine exists for — reference ``runtime/hybrid_engine.py:178-282``
+serves its rollout phase; the trainer shape follows DeepSpeedExamples
+step3 ``ppo_trainer.py``): generate_experience → compute advantages →
+actor PPO-clip step + critic value step, each through its own
+DeepSpeedEngine so every ZeRO/offload/LoRA feature composes per model.
+
+All three forward paths (rollout logprobs, values, reward) are single
+jitted programs; the PPO losses run through the engines' fused
+``train_batch`` with the extra per-token arrays riding in the batch dict.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class LlamaCriticModel(nn.Module):
+    """Value model: LlamaModel backbone + scalar value head per token (the
+    DS-Chat critic/reward architecture — an LM with ``v_head``)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        h = LlamaModel(self.cfg, name="base")(
+            input_ids, positions=positions, return_hidden=True)
+        v = nn.Dense(1, use_bias=False, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="v_head")(
+            h.astype(jnp.float32))
+        return v[..., 0]                      # [B, T]
+
+
+def _gather_logp(logits, actions):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+
+def make_actor_ppo_loss(model, clip_eps: float = 0.2):
+    """PPO-clip policy loss over the generated span. Batch keys:
+    input_ids [B,T], labels (= next-token actions) [B,T], old_logp [B,T],
+    advantages [B,T], loss_mask [B,T] (1 on generated positions)."""
+
+    def loss_fn(params, batch, rngs=None):
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             rngs=rngs)
+        logp = _gather_logp(logits, batch["labels"])
+        ratio = jnp.exp(logp - batch["old_logp"])
+        adv = batch["advantages"]
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv)
+        mask = batch["loss_mask"].astype(jnp.float32)
+        return -(surr * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    return loss_fn
+
+
+def make_critic_value_loss(model, clip_eps: float = 0.2):
+    """Clipped value loss (DS-Chat critic_loss_fn). Batch keys: input_ids,
+    returns [B,T], old_values [B,T], loss_mask [B,T]."""
+
+    def loss_fn(params, batch, rngs=None):
+        v = model.apply({"params": params}, batch["input_ids"], rngs=rngs)
+        old_v = batch["old_values"]
+        clipped = old_v + jnp.clip(v - old_v, -clip_eps, clip_eps)
+        err = jnp.maximum(jnp.square(v - batch["returns"]),
+                          jnp.square(clipped - batch["returns"]))
+        mask = batch["loss_mask"].astype(jnp.float32)
+        return 0.5 * (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    return loss_fn
+
+
+class DeepSpeedPPOTrainer:
+    """Owns the three models of the DS-Chat loop.
+
+    actor_engine:  DeepSpeedHybridEngine over the policy LM (train +
+                   generate on one sharded pytree).
+    critic_engine: DeepSpeedEngine over :class:`LlamaCriticModel`.
+    reward_fn:     frozen scorer ``(seq_ids) -> [B] rewards`` — built from
+                   a reward-model params pytree via :meth:`reward_from_params`,
+                   or any callable (rule-based shaping in tests).
+    ref_logp_fn:   optional frozen REFERENCE policy ``(seq) -> [B, T-1]``
+                   per-token logprobs (:meth:`ref_logp_from_params`); with
+                   it, per-token rewards carry the DS-Chat KL penalty
+                   ``-kl_ctl * (logp - ref_logp)`` (compute_rewards).
+    PPO/value clip epsilons live on the loss factories
+    (:func:`make_actor_ppo_loss` / :func:`make_critic_value_loss`) that the
+    engines were built with.
+    """
+
+    def __init__(self, actor_engine, critic_engine,
+                 reward_fn: Callable[[Any], Any],
+                 gamma: float = 1.0, lam: float = 0.95,
+                 kl_ctl: float = 0.1,
+                 ref_logp_fn: Optional[Callable[[Any], Any]] = None):
+        self.actor = actor_engine
+        self.critic = critic_engine
+        self.reward_fn = reward_fn
+        self.ref_logp_fn = ref_logp_fn
+        self.gamma = gamma
+        self.lam = lam
+        self.kl_ctl = kl_ctl if ref_logp_fn is not None else 0.0
+        actor_model = self.actor.module
+        critic_model = self.critic.module
+
+        @jax.jit
+        def rollout_stats(actor_params, critic_params, seq):
+            inputs, actions = seq[:, :-1], seq[:, 1:]
+            logits = actor_model.apply({"params": actor_params}, inputs)
+            logp = _gather_logp(logits, actions)
+            values = critic_model.apply({"params": critic_params}, inputs)
+            return logp, values
+
+        self._rollout_stats = rollout_stats
+        self.generate_time = 0.0
+        self.actor_step_time = 0.0
+        self.critic_step_time = 0.0
+
+    @staticmethod
+    def ref_logp_from_params(ref_model, ref_params):
+        """Frozen reference-policy logprob scorer from an actor-architecture
+        params pytree (the DS-Chat actor-ref model)."""
+
+        @jax.jit
+        def ref_logp(seq):
+            logits = ref_model.apply({"params": ref_params}, seq[:, :-1])
+            return _gather_logp(logits, seq[:, 1:])
+
+        return ref_logp
+
+    @staticmethod
+    def reward_from_params(reward_model, reward_params):
+        """Frozen reward scorer from a critic-architecture params pytree:
+        the value at the final token is the sequence reward (DS-Chat
+        reward_model forward_value(..., return_value_only=False))."""
+
+        @jax.jit
+        def score(seq):
+            v = reward_model.apply({"params": reward_params}, seq)
+            return v[:, -1]
+
+        return score
+
+    # --- experience ------------------------------------------------------
+    def generate_experience(self, prompts, max_new_tokens: int,
+                            rng: Optional[jax.Array] = None,
+                            temperature: float = 1.0) -> Dict[str, Any]:
+        """Rollout + per-token stats (reference ppo loop phase 1)."""
+        import time
+
+        t0 = time.time()
+        seq = self.actor.generate(prompts, max_new_tokens=max_new_tokens,
+                                  temperature=temperature, rng=rng)
+        seq = jax.block_until_ready(seq)
+        self.generate_time = time.time() - t0
+        logp, values = self._rollout_stats(self.actor.params,
+                                           self.critic.params, seq)
+        rewards = self.reward_fn(seq)
+        B, Tm1 = logp.shape
+        prompt_len = prompts.shape[1]
+        # mask: positions whose ACTION (next token) was generated
+        pos = jnp.arange(Tm1)[None, :]
+        mask = jnp.broadcast_to(pos >= prompt_len - 1,
+                                (B, Tm1)).astype(jnp.float32)
+        ref_logp = (self.ref_logp_fn(seq)
+                    if self.ref_logp_fn is not None else None)
+        return {"seq": seq, "old_logp": logp, "old_values": values,
+                "rewards": rewards, "loss_mask": mask,
+                "ref_logp": ref_logp, "prompt_len": prompt_len}
+
+    def _advantages(self, exp):
+        """GAE over the generated span; the sequence reward lands on the
+        final step, per-token KL penalty against the reference policy when
+        one is attached (DS-Chat compute_rewards +
+        get_advantages_and_returns)."""
+        values = np.asarray(exp["old_values"], np.float32)
+        mask = np.asarray(exp["loss_mask"], np.float32)
+        B, T = values.shape
+        rewards = np.zeros((B, T), np.float32)
+        if self.kl_ctl and exp.get("ref_logp") is not None:
+            kl = (np.asarray(exp["old_logp"], np.float32)
+                  - np.asarray(exp["ref_logp"], np.float32))
+            rewards -= self.kl_ctl * kl * mask
+        last = mask.cumsum(1).argmax(1)               # final generated pos
+        rewards[np.arange(B), last] += np.asarray(exp["rewards"], np.float32)
+        adv = np.zeros((B, T), np.float32)
+        gae = np.zeros((B,), np.float32)
+        for t in range(T - 1, -1, -1):
+            next_v = values[:, t + 1] if t + 1 < T else 0.0
+            delta = rewards[:, t] + self.gamma * next_v - values[:, t]
+            gae = delta + self.gamma * self.lam * gae * mask[:, t]
+            adv[:, t] = gae
+        returns = adv + values
+        # per-batch advantage whitening over generated positions
+        m = mask.sum() or 1.0
+        mean = (adv * mask).sum() / m
+        std = np.sqrt((np.square(adv - mean) * mask).sum() / m) + 1e-6
+        adv = (adv - mean) / std
+        return adv, returns
+
+    # --- one PPO step -----------------------------------------------------
+    def train_rlhf(self, exp: Dict[str, Any]) -> Dict[str, float]:
+        """One actor step + one critic step from an experience batch
+        (reference DeepSpeedPPOTrainer.train_rlhf)."""
+        import time
+
+        adv, returns = self._advantages(exp)
+        seq = exp["seq"]
+        inputs, actions = seq[:, :-1], seq[:, 1:]
+        actor_batch = {"input_ids": inputs, "labels": actions,
+                       "old_logp": exp["old_logp"], "advantages": adv,
+                       "loss_mask": exp["loss_mask"]}
+        critic_batch = {"input_ids": inputs, "returns": returns,
+                        "old_values": exp["old_values"],
+                        "loss_mask": exp["loss_mask"]}
+        t0 = time.time()
+        actor_loss = float(self.actor.train_batch(actor_batch))
+        self.actor_step_time = time.time() - t0
+        t0 = time.time()
+        critic_loss = float(self.critic.train_batch(critic_batch))
+        self.critic_step_time = time.time() - t0
+        return {"actor_loss": actor_loss, "critic_loss": critic_loss,
+                "reward_mean": float(np.asarray(exp["rewards"]).mean())}
+
+    def step(self, prompts, max_new_tokens: int,
+             rng: Optional[jax.Array] = None) -> Dict[str, float]:
+        exp = self.generate_experience(prompts, max_new_tokens, rng=rng)
+        return self.train_rlhf(exp)
+
+    # --- checkpointing (both models — reference DS-Chat save_model) -------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None):
+        import os
+
+        self.actor.save_checkpoint(os.path.join(save_dir, "actor"), tag)
+        self.critic.save_checkpoint(os.path.join(save_dir, "critic"), tag)
+        log_dist(f"PPO checkpoint saved to {save_dir}", ranks=[0])
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+        import os
+
+        self.actor.load_checkpoint(os.path.join(load_dir, "actor"), tag)
+        self.critic.load_checkpoint(os.path.join(load_dir, "critic"), tag)

@@ -1,0 +1,108 @@
+"""DS-Chat-shaped RLHF loop (VERDICT r2 #8): actor (hybrid engine) +
+critic (plain engine) + frozen reward model in one PPO step, both models
+checkpointed. Reference: runtime/hybrid_engine.py:178-282 (the rollout
+phase this loop exists for) + DeepSpeedExamples step3 ppo_trainer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.runtime.ppo_trainer import (
+    DeepSpeedPPOTrainer, LlamaCriticModel, make_actor_ppo_loss,
+    make_critic_value_loss,
+)
+
+B, PROMPT, GEN = 8, 6, 8
+TARGET_SET = 64   # reward pays for tokens < 64 (dense enough to learn on)
+
+
+def _trainer(tmp_path=None, lr=5e-3):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    actor_model = LlamaModel(cfg)
+    critic_model = LlamaCriticModel(LlamaConfig.tiny(dtype=jnp.float32,
+                                                     num_layers=1))
+    rng = np.random.default_rng(0)
+    sample = {"input_ids": rng.integers(0, 256, (B, PROMPT + GEN)),
+              "labels": rng.integers(0, 256, (B, PROMPT + GEN))}
+
+    def ds_cfg(extra=None):
+        c = {"train_batch_size": B,
+             "optimizer": {"type": "adamw", "params": {"lr": lr}},
+             "zero_optimization": {"stage": 1},
+             "steps_per_print": 1000}
+        c.update(extra or {})
+        return c
+
+    actor = deepspeed_tpu.initialize(
+        model=actor_model, model_config=cfg,
+        config=ds_cfg({"hybrid_engine": {"enabled": True}}),
+        loss_fn=make_actor_ppo_loss(actor_model),
+        sample_batch=sample)
+    critic = deepspeed_tpu.initialize(
+        model=critic_model, config=ds_cfg(),
+        loss_fn=make_critic_value_loss(critic_model),
+        sample_batch=sample)
+
+    @jax.jit
+    def reward_fn(seq):
+        gen = seq[:, PROMPT:]
+        return (gen < TARGET_SET).mean(axis=1).astype(jnp.float32)
+
+    return DeepSpeedPPOTrainer(actor, critic, reward_fn)
+
+
+def test_ppo_step_runs_and_reports():
+    tr = _trainer()
+    prompts = np.random.default_rng(1).integers(1, 250, (B, PROMPT))
+    stats = tr.step(prompts, GEN, rng=jax.random.PRNGKey(0))
+    assert set(stats) == {"actor_loss", "critic_loss", "reward_mean"}
+    assert np.isfinite(stats["actor_loss"])
+    assert np.isfinite(stats["critic_loss"])
+    assert tr.generate_time > 0 and tr.actor_step_time > 0 \
+        and tr.critic_step_time > 0
+
+
+def test_ppo_improves_reward():
+    """The actor must learn to emit the rewarded token: mean reward over
+    the last iterations exceeds the first (tiny model, shaped reward)."""
+    tr = _trainer(lr=1e-2)
+    prompts = np.random.default_rng(1).integers(1, 250, (B, PROMPT))
+    rewards = []
+    for i in range(15):
+        stats = tr.step(prompts, GEN, rng=jax.random.PRNGKey(i))
+        rewards.append(stats["reward_mean"])
+    early = np.mean(rewards[:3])
+    late = np.mean(rewards[-3:])
+    assert late > early + 0.08, f"no reward improvement: {rewards}"
+
+
+def test_ppo_checkpoint_roundtrip(tmp_path):
+    tr = _trainer()
+    prompts = np.random.default_rng(1).integers(1, 250, (B, PROMPT))
+    tr.step(prompts, GEN, rng=jax.random.PRNGKey(0))
+    tr.save_checkpoint(str(tmp_path))
+
+    tr2 = _trainer()
+    tr2.load_checkpoint(str(tmp_path))
+    for a, b in zip(jax.tree_util.tree_leaves(tr.actor.params),
+                    jax.tree_util.tree_leaves(tr2.actor.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(tr.critic.params),
+                    jax.tree_util.tree_leaves(tr2.critic.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # resumed trainer keeps stepping
+    stats = tr2.step(prompts, GEN, rng=jax.random.PRNGKey(5))
+    assert np.isfinite(stats["actor_loss"])
+
+
+def test_critic_values_shape():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, num_layers=1)
+    m = LlamaCriticModel(cfg)
+    ids = jnp.zeros((2, 10), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), ids)["params"]
+    v = m.apply({"params": p}, ids)
+    assert v.shape == (2, 10)
+    assert "v_head" in p and "base" in p

@@ -73,15 +73,37 @@ def profile_model_info(loss_fn: Callable, params: Any,
 
 class Candidate:
     def __init__(self, zero_stage: int, micro_batch: int, gas: int = 1,
-                 num_micro: Optional[int] = None):
+                 num_micro: Optional[int] = None,
+                 remat: Optional[str] = None,
+                 fused_loss: Optional[bool] = None):
         self.zero_stage = zero_stage
         self.micro_batch = micro_batch
         self.gas = gas
         self.num_micro = num_micro   # pipeline microbatches (pipe > 1)
+        # remat axis: None = inherit model, "none" = no remat,
+        # "<scope>:<policy>" = rematerialize <scope> under <policy>
+        self.remat = remat
+        self.fused_loss = fused_loss
 
     def key(self) -> str:
         k = f"z{self.zero_stage}_mbs{self.micro_batch}_gas{self.gas}"
-        return k + (f"_pm{self.num_micro}" if self.num_micro else "")
+        k += f"_pm{self.num_micro}" if self.num_micro else ""
+        k += f"_r[{self.remat}]" if self.remat is not None else ""
+        k += f"_fl{int(self.fused_loss)}" if self.fused_loss is not None \
+            else ""
+        return k
+
+    def model_overrides(self) -> Optional[Dict[str, Any]]:
+        """LlamaConfig-field overrides implied by the remat axis (the
+        engine factory rebuilds the model with these — remat lives in the
+        model config, not the ds_config)."""
+        if self.remat is None:
+            return None
+        if self.remat == "none":
+            return {"remat": False}
+        scope, _, policy = self.remat.partition(":")
+        return {"remat": True, "remat_scope": scope,
+                "remat_policy": policy or "nothing_saveable"}
 
     def ds_config(self, base: Dict[str, Any], dp: int) -> Dict[str, Any]:
         cfg = json.loads(json.dumps(base))  # deep copy
@@ -91,6 +113,13 @@ class Candidate:
         cfg.setdefault("zero_optimization", {})["stage"] = self.zero_stage
         if self.num_micro:
             cfg.setdefault("pipeline", {})["num_micro"] = self.num_micro
+        if self.fused_loss is not None:
+            cfg["fused_lm_loss"] = {"enabled": bool(self.fused_loss)}
+        ov = self.model_overrides()
+        if ov is not None:
+            # consumed (popped) by the caller's engine_factory; harmless to
+            # DeepSpeedConfig, which ignores unknown top-level keys
+            cfg["_model_overrides"] = ov
         cfg.pop("autotuning", None)
         return cfg
 
@@ -111,11 +140,30 @@ def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
         grads //= dp_size
     if cand.zero_stage >= 3:
         params //= dp_size
+    act = info.activation_mem_per_sample * cand.micro_batch
+    # remat axis: coarse live-activation scale relative to the profiled
+    # model (whole-block remat keeps ~1 residual/layer; partial scopes keep
+    # roughly half; no-remat everything). A filter heuristic only — timed
+    # trials decide; OOMs during a trial are caught as infeasible.
+    if cand.remat is not None:
+        if cand.remat == "none":
+            act = int(act * 3)
+        elif cand.remat.startswith("block"):
+            act = int(act * 0.5)
+    if cand.fused_loss:
+        act = int(act * 0.8)     # the [B,S,V] fp32 logits never materialize
     if pipe_size > 1:
         params //= pipe_size
         grads //= pipe_size
         opt //= pipe_size
-    act = info.activation_mem_per_sample * cand.micro_batch
+        # per-stage working set (layers split over pipe) + the 1F1B
+        # residual buffers: min(num_micro, pipe) in-flight microbatches,
+        # each 1/num_micro of the batch — without this term large-num_micro
+        # candidates pass the HBM filter while being infeasible for exactly
+        # that buffer (candidates() filters per num_micro choice)
+        nm = max(cand.num_micro or pipe_size, 1)
+        in_flight = min(nm, pipe_size)
+        act = act // pipe_size + (act * in_flight) // (nm * pipe_size)
     return params + grads + opt + act
 
 
@@ -143,41 +191,50 @@ class Autotuner:
         self.hbm = hbm_bytes_per_device
         self.cfg = config or get_autotuning_config(base_config)
         self.results: Dict[str, Dict[str, float]] = {}
+        self._cand_by_key: Dict[str, Candidate] = {}
 
     # -- search space --------------------------------------------------------
 
     def candidates(self) -> List[Candidate]:
         stages = self.cfg.zero_stages or list(DEFAULT_ZERO_STAGES)
         mbs_list = self.cfg.micro_batch_sizes or list(DEFAULT_MICRO_BATCHES)
+        remats = self.cfg.remat_policies or [None]
+        fused_opts = self.cfg.fused_lm_loss_options or [None]
         pipe = int((self.base_config.get("mesh") or {}).get("pipe", 1) or 1)
         out = []
         for stage in stages:
             for mbs in mbs_list:
-                tbs = mbs * self.dp_size
-                if tbs < self.cfg.min_train_batch_size:
-                    continue
-                if (self.cfg.max_train_batch_size
-                        and tbs > self.cfg.max_train_batch_size):
-                    continue
-                cand = Candidate(stage, mbs)
-                if self.hbm is not None and estimate_memory_per_device(
-                        self.model_info, cand, self.dp_size,
-                        pipe_size=pipe) > self.hbm:
-                    continue
-                if pipe > 1:
-                    # pipeline microbatch axis: num_micro must divide the
-                    # per-shard batch (the interpreter's B_loc % M
-                    # contract); fall back to the largest divisor when
-                    # none of {P, 2P, 4P} does
-                    pm_opts = [m for m in (pipe, 2 * pipe, 4 * pipe)
-                               if mbs % m == 0]
-                    if not pm_opts:
-                        pm_opts = [max(d for d in range(1, mbs + 1)
-                                       if mbs % d == 0)]
-                    for pm in pm_opts:
-                        out.append(Candidate(stage, mbs, num_micro=pm))
-                else:
-                    out.append(cand)
+              for remat in remats:
+                for fl in fused_opts:
+                    tbs = mbs * self.dp_size
+                    if tbs < self.cfg.min_train_batch_size:
+                        continue
+                    if (self.cfg.max_train_batch_size
+                            and tbs > self.cfg.max_train_batch_size):
+                        continue
+                    if pipe > 1:
+                        # pipeline microbatch axis: num_micro must divide
+                        # the per-shard batch (the interpreter's B_loc % M
+                        # contract); fall back to the largest divisor when
+                        # none of {P, 2P, 4P} does
+                        pm_opts = [m for m in (pipe, 2 * pipe, 4 * pipe)
+                                   if mbs % m == 0]
+                        if not pm_opts:
+                            pm_opts = [max(d for d in range(1, mbs + 1)
+                                           if mbs % d == 0)]
+                        cands = [Candidate(stage, mbs, num_micro=pm,
+                                           remat=remat, fused_loss=fl)
+                                 for pm in pm_opts]
+                    else:
+                        cands = [Candidate(stage, mbs, remat=remat,
+                                           fused_loss=fl)]
+                    for cand in cands:
+                        if self.hbm is not None and \
+                                estimate_memory_per_device(
+                                    self.model_info, cand, self.dp_size,
+                                    pipe_size=pipe) > self.hbm:
+                            continue
+                        out.append(cand)
 
         def bubble(c: Candidate) -> float:
             if not c.num_micro:
@@ -219,6 +276,7 @@ class Autotuner:
             "flops": throughput * self.model_info.flops_per_sample,
         }
         self.results[cand.key()] = result
+        self._cand_by_key[cand.key()] = cand
         return result
 
     def _metric(self, result: Dict[str, float]) -> float:
@@ -284,24 +342,35 @@ class Autotuner:
                     f"{self.cfg.metric}={abs(best_m):.2f}")
         return best.ds_config(self.base_config, self.dp_size)
 
+    @staticmethod
+    def _featurize(c: "Candidate") -> list:
+        """Surrogate features spanning EVERY search axis (stage, mbs, plus
+        the remat/fused_loss axes — invisible axes would make the guided
+        phase rank their candidates arbitrarily)."""
+        s, m = c.zero_stage, float(np.log2(c.micro_batch))
+        remat = {"none": 0.0}.get(c.remat, 0.5) if c.remat is not None \
+            else 1.0
+        if c.remat is not None and c.remat.startswith("block"):
+            remat = 1.0
+        fused = 1.0 if c.fused_loss else 0.0
+        return [1.0, s, m, s * m, m * m, remat, fused]
+
     def _fit_cost_model(self) -> Optional[Callable[[Candidate], float]]:
-        """Quadratic regression over (stage, log2 mbs) → metric."""
+        """Quadratic regression over (stage, log2 mbs) + linear terms for
+        the remat/fused axes → metric."""
         xs, ys = [], []
         for key, res in self.results.items():
-            if "error" in res:
+            if "error" in res or key not in self._cand_by_key:
                 continue
-            stage = int(key.split("_")[0][1:])
-            mbs = int(key.split("_")[1][3:])
-            xs.append((stage, np.log2(mbs)))
+            xs.append(self._featurize(self._cand_by_key[key]))
             ys.append(self._metric(res))
         if len(xs) < 3:
             return None
-        X = np.array([[1, s, m, s * m, m * m] for s, m in xs])
+        X = np.array(xs)
         w, *_ = np.linalg.lstsq(X, np.array(ys), rcond=None)
 
         def predict(c: Candidate) -> float:
-            s, m = c.zero_stage, np.log2(c.micro_batch)
-            return float(np.dot([1, s, m, s * m, m * m], w))
+            return float(np.dot(self._featurize(c), w))
 
         return predict
 

@@ -28,6 +28,12 @@ class AutotuningConfig(DeepSpeedConfigModel):
     micro_batch_sizes: Optional[List[int]] = None    # candidate micro sizes
     zero_stages: Optional[List[int]] = None          # candidate zero stages
     mp_size: int = Field(1, ge=1)
+    # TPU-specific search axes (reference tunes kernel knobs instead):
+    # remat candidates — "none" (no remat) or "<scope>:<policy>", e.g.
+    # "block:nothing_saveable", "mlp:save_mlp"; None → inherit the model's
+    remat_policies: Optional[List[str]] = None
+    # chunked-LM-loss on/off (trades ~2 GB of logits memory for ~4% step)
+    fused_lm_loss_options: Optional[List[bool]] = None
 
 
 def get_autotuning_config(param_dict: dict) -> AutotuningConfig:

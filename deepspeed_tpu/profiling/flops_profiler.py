@@ -54,7 +54,18 @@ class FlopsProfiler:
         self.macs = 0.0
         self.bytes_accessed = 0.0
         self.duration = 0.0
+        self.module_tree = None
         self._started = False
+
+    def profile_modules(self, fn: Callable, params: Any, *args, **kwargs):
+        """Per-module flops tree (reference profiler.py:23 per-module
+        report): jaxpr traversal attributing each op to its flax scope —
+        see profiling/module_profiler.py. Stored for print_model_profile's
+        detailed view; returns the ModuleTree."""
+        from deepspeed_tpu.profiling.module_profiler import profile_modules
+
+        self.module_tree = profile_modules(fn, params, *args, **kwargs)
+        return self.module_tree
 
     def start_profile(self) -> None:
         self._started = True
@@ -96,7 +107,10 @@ class FlopsProfiler:
         return f"{self.duration * 1e3:.2f} ms" if as_string else self.duration
 
     def print_model_profile(self, params: Optional[Any] = None,
-                            detailed: bool = True) -> str:
+                            detailed: bool = True, module_depth: int = -1,
+                            top_modules: int = 0) -> str:
+        """Summary + (``detailed``) the per-module tree with the reference's
+        depth/top-k controls (profile.module_depth / top_modules)."""
         lines = ["", "-------------------------- Flops Profiler --------------------------"]
         if params is not None:
             lines.append(f"params:              {_fmt(count_params(params))}")
@@ -106,6 +120,10 @@ class FlopsProfiler:
         if self.duration:
             lines.append(f"latency:             {self.duration * 1e3:.2f} ms")
             lines.append(f"achieved:            {_fmt(self.flops / self.duration, 'FLOPS')}")
+        if detailed and self.module_tree is not None:
+            lines.append("-------------------- per-module (traced, pre-fusion) ----------------")
+            lines.append(self.module_tree.format(depth=module_depth,
+                                                 top=top_modules))
         lines.append("---------------------------------------------------------------------")
         report = "\n".join(lines)
         logger.info(report)

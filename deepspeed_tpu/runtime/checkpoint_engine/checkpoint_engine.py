@@ -18,3 +18,23 @@ class CheckpointEngine(abc.ABC):
 
     def commit(self, tag: str) -> bool:
         return True
+
+    def wait(self) -> None:
+        """Fence any pending async save. Engines without async saving
+        inherit this no-op (the training engine calls wait() before every
+        load and at destroy())."""
+
+    def resolve_tag(self, load_dir: str, tag: Optional[str]) -> str:
+        """Resolve the tag to load: explicit tag wins, else the ``latest``
+        file written beside the checkpoints (reference engine.py
+        ``_get_ckpt_name`` latest-tag convention)."""
+        if tag is not None:
+            return tag
+        import os
+
+        latest = os.path.join(load_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                return f.read().strip()
+        raise FileNotFoundError(
+            f"no tag given and no 'latest' file in {load_dir}")

@@ -151,8 +151,11 @@ class PipelineConfig(DeepSpeedConfigModel):
     partition: str = "best"
     seed_layers: bool = False
     activation_checkpoint_interval: int = 0
-    # TPU-specific: microbatch schedule; "1f1b" | "gpipe" | "interleaved"
-    schedule: str = "1f1b"
+    # TPU-specific: microbatch schedule; "auto" | "1f1b" | "gpipe".
+    # auto → 1f1b, except meshes with tensor/sequence parallelism where the
+    # SPMD-gpipe path preserves intra-stage TP sharding (the 1F1B
+    # interpreter's shard_map replicates stage weights over tensor ranks)
+    schedule: str = "auto"
     # pipeline microbatches per step; None → one per stage (bubble ~50% —
     # raise it to shrink the bubble, (P-1)/(M+P-1))
     num_micro: Optional[int] = None

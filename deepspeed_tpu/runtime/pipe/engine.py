@@ -28,7 +28,7 @@ from deepspeed_tpu.models.llama import LlamaConfig
 from deepspeed_tpu.models.transformer import make_causal_mask
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.pipe.spmd import spmd_pipeline
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 def _pipe_block_specs(mesh) -> Dict[str, Any]:
@@ -141,9 +141,30 @@ class PipelineEngine(DeepSpeedEngine):
             f"{cfg.num_layers} layers must divide pipe={mesh.shape['pipe']}")
         ds_cfg = kwargs.get("config")
         pipe_cfg = getattr(ds_cfg, "pipeline", None)
-        schedule = getattr(pipe_cfg, "schedule", "1f1b")
+        schedule = getattr(pipe_cfg, "schedule", "auto")
         if num_micro is None:
             num_micro = getattr(pipe_cfg, "num_micro", None)
+        tp_like = max(mesh.shape.get("tensor", 1),
+                      mesh.shape.get("sequence", 1))
+        if schedule == "auto":
+            # the 1F1B interpreter enters shard_map with stage weights
+            # replicated over tensor ranks (collectives can't live inside
+            # its cond branches), so TP/SP meshes keep their partitioning
+            # only under the SPMD-gpipe path
+            schedule = "gpipe" if tp_like > 1 else "1f1b"
+            if tp_like > 1:
+                log_dist("pipeline.schedule=auto → gpipe: mesh has "
+                         f"tensor/sequence={tp_like} and the 1F1B "
+                         "interpreter would replicate stage weights across "
+                         "those ranks", ranks=[0])
+        elif schedule == "1f1b" and tp_like > 1:
+            logger.warning(
+                "pipeline.schedule=1f1b on a tensor/sequence=%d mesh: the "
+                "interpreter all-gathers stage weights over those ranks at "
+                "shard_map entry — numerically correct, but TP's "
+                "memory/compute partitioning is lost inside the pipeline; "
+                "set pipeline.schedule=gpipe (or 'auto') to keep it",
+                tp_like)
         if schedule == "1f1b":
             # instruction-executing 1F1B (pipe/interpreter.py — reference
             # _exec_schedule, pipe/engine.py:1293)

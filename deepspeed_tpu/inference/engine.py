@@ -415,7 +415,8 @@ class InferenceEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  rng: Optional[jax.Array] = None,
                  eos_token_id: Optional[int] = None, *,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, speculative: Optional[str] = None,
+                 draft_len: int = 8, prompt_lookup_ngram: int = 2):
         """Sampled/greedy generation with KV cache. input_ids: [B, T].
 
         Returns [B, T + max_new_tokens]; rows that hit ``eos_token_id`` are
@@ -428,11 +429,23 @@ class InferenceEngine:
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
         check_decode_length(self.model_config, T + max_new_tokens)
+        if speculative not in (None, "prompt_lookup"):
+            raise ValueError(
+                f"speculative={speculative!r}: only 'prompt_lookup' "
+                f"(self-drafting) is implemented")
+        if speculative and (temperature != 0.0 or B != 1):
+            raise ValueError(
+                "prompt-lookup speculative decoding is greedy batch-1 only "
+                f"(got temperature={temperature}, batch={B}) — greedy "
+                "acceptance is what makes the output exactly the plain "
+                "greedy continuation")
         T_cap = prompt_capacity(T, self.model_config)
         pad = T_cap - T
         if pad:
             input_ids = jnp.pad(input_ids, ((0, 0), (pad, 0)))
-        self._ensure_decode(B, T_cap + gen_capacity(max_new_tokens))
+        arena_slack = draft_len if speculative else 0
+        self._ensure_decode(B, T_cap + gen_capacity(max_new_tokens)
+                            + arena_slack)
         decoder = self._decoder
 
         def apply_fn(params, tokens, caches, index, attn_start):
@@ -449,15 +462,44 @@ class InferenceEngine:
             params_fn = self._effective_params
         else:
             params_fn = transform
+        base_key = ("int8w" if self._quantized else "",
+                    "fused" if transform is not None else "",
+                    self._config.quant.bits if self._quantized else 0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        if speculative:
+            from deepspeed_tpu.inference.speculative import (
+                build_pld_generate_fn,
+            )
+
+            cap = gen_capacity(max_new_tokens)
+            key = (B, T_cap, cap, base_key,
+                   ("pld", draft_len, prompt_lookup_ngram))
+            if key not in self._gen_cache:
+                if len(self._gen_cache) >= GEN_CACHE_MAX:
+                    self._gen_cache.popitem(last=False)
+                self._gen_cache[key] = build_pld_generate_fn(
+                    apply_fn, B, T_cap, cap, draft_len=draft_len,
+                    ngram=prompt_lookup_ngram, params_fn=params_fn)
+            else:
+                self._gen_cache.move_to_end(key)
+            t0 = time.time() if self._profile_model_time else None
+            with self._ctx():
+                tokens, self._kv_caches, mean_acc = self._gen_cache[key](
+                    self.params, input_ids, self._kv_caches,
+                    jnp.asarray(eos, jnp.int32),
+                    jnp.asarray(max_new_tokens, jnp.int32),
+                    jnp.asarray(pad, jnp.int32))
+            tokens = tokens[:, pad: T_cap + max_new_tokens]
+            self.last_acceptance = float(mean_acc)
+            if t0 is not None:
+                jax.block_until_ready(tokens)
+                self._model_times.append(time.time() - t0)
+            return tokens
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache, apply_fn, B, T_cap, max_new_tokens,
-            params_fn=params_fn,
-            params_key=("int8w" if self._quantized else "",
-                        "fused" if transform is not None else "",
-                        self._config.quant.bits if self._quantized else 0))
+            params_fn=params_fn, params_key=base_key)
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        eos = -1 if eos_token_id is None else int(eos_token_id)
         t0 = time.time() if self._profile_model_time else None
         with self._ctx():
             tokens, self._kv_caches = gen_fn(

@@ -1,0 +1,127 @@
+"""Prompt-lookup (self-drafting) speculative decoding.
+
+Batch-1 greedy decode emits ONE token per weight-streaming pass — the
+measured ~450 GB/s matvec ceiling caps it (~294 tok/s at 770M,
+docs/PERF_ANALYSIS.md). Speculative decoding verifies K drafted tokens in
+one pass; with greedy acceptance the output is EXACTLY the plain greedy
+continuation, so every accepted draft token is a free multiple of the
+bandwidth ceiling.
+
+This implements the SELF-drafting variant (no draft model): the draft for
+position n is the continuation of the latest earlier occurrence of the
+last ``ngram`` tokens in the sequence so far — "prompt lookup". On
+structured inputs (summarization, code edits, RAG with quoted context)
+generated text repeats prompt spans and acceptance is high; on
+incompressible prompts acceptance ~0 and throughput degrades toward
+1/(K·step) — this is a *structured-prompt* lever, reported as such.
+
+The reference (DeepSpeed v0.9.3) has no speculative path; this is
+beyond-parity. The whole loop — lookup, K-wide verify, longest-prefix
+accept, KV bookkeeping — runs in ONE jitted program (lax.while_loop);
+stale KV slots beyond the accepted prefix are masked by the
+``col <= row_pos`` decode mask and overwritten by the next write, the
+same invariant the prompt-bucketing left-pad relies on.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def build_pld_generate_fn(apply_fn: Callable, B: int, T: int,
+                          max_new_tokens: int, draft_len: int = 8,
+                          ngram: int = 2, params_fn=None):
+    """Compile greedy prompt-lookup generation.
+
+    ``apply_fn(params, tokens, caches, cache_index, attn_start)`` — the
+    same contract as build_generate_fn. Batch-1 only (per-row acceptance
+    lengths would desynchronize the shared cache index). Returns
+    ``gen(params, input_ids, caches, eos_id, n_steps, attn_start) ->
+    (tokens [1, T+max_new], caches, mean_accepted)``.
+    """
+    assert B == 1, "prompt-lookup decode is a batch-1 latency feature"
+    K = draft_len
+    # K slots of slack so the K-wide verify window never clips at the end
+    # (the KV arena must cover T + max_new + K too — engine sizes it)
+    BUF = T + max_new_tokens + K
+
+    def lookup_draft(buf, count):
+        """Latest earlier occurrence of the trailing ``ngram`` + its
+        continuation. buf: [BUF] int32, count: valid length."""
+        tail = jax.lax.dynamic_slice(buf, (count - ngram,), (ngram,))
+        idx = jnp.arange(BUF)
+        # window match at j: buf[j:j+ngram] == tail, ending before the tail
+        hits = jnp.ones((BUF,), bool)
+        for d in range(ngram):
+            rolled = jnp.roll(buf, -d)
+            hits = jnp.logical_and(hits, rolled == tail[d])
+        valid = idx < jnp.maximum(count - ngram, 0)   # strictly earlier
+        hits = jnp.logical_and(hits, valid)
+        j = jnp.max(jnp.where(hits, idx, -1))
+        found = j >= 0
+        start = jnp.clip(j + ngram, 0, BUF - K)
+        draft = jax.lax.dynamic_slice(buf, (start,), (K,))
+        return found, draft
+
+    def gen(params, input_ids, caches, eos_id, n_steps, attn_start):
+        if params_fn is not None:
+            params = params_fn(params)
+        # prefill
+        logits, caches = apply_fn(params, input_ids, caches,
+                                  jnp.asarray(0, jnp.int32), attn_start)
+        first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+        buf = jnp.zeros((BUF,), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, input_ids[0], (0,))
+        buf = buf.at[T].set(first[0])
+        count0 = jnp.asarray(T + 1, jnp.int32)        # tokens known so far
+        finished0 = first[0] == eos_id
+
+        def cond(c):
+            count, _, finished, rounds, _, _ = c
+            return jnp.logical_and(count - T < n_steps,
+                                   jnp.logical_not(finished))
+
+        def body(c):
+            count, caches, finished, rounds, accepted_sum, buf = c
+            t_cur = buf[count - 1]
+            _, draft = lookup_draft(buf, count)
+            # verify window: current token + first K-1 draft tokens
+            window = jnp.concatenate([t_cur[None], draft[:K - 1]])[None, :]
+            cache_idx = count - 1                     # t_cur's KV slot
+            logits, caches = apply_fn(params, window, caches,
+                                      cache_idx.astype(jnp.int32),
+                                      attn_start)
+            m = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)   # [K]
+            # longest draft prefix the model agrees with
+            agree = jnp.cumprod(
+                (draft[:K - 1] == m[:K - 1]).astype(jnp.int32))
+            a = jnp.sum(agree)                        # 0..K-1 accepted
+            emit_n = jnp.minimum(a + 1, n_steps - (count - T))
+            # write all K model tokens; only the first emit_n advance count
+            # (stale tail slots are masked/overwritten — bucketing invariant)
+            tail_keep = jax.lax.dynamic_slice(buf, (count,), (K,))
+            keep_mask = jnp.arange(K) < emit_n
+            merged = jnp.where(keep_mask, m, tail_keep)
+            # truncate emission at EOS
+            is_eos = jnp.logical_and(merged == eos_id, keep_mask)
+            eos_at = jnp.min(jnp.where(is_eos, jnp.arange(K), K))
+            emit_n = jnp.minimum(emit_n, eos_at + 1)
+            finished = jnp.logical_or(finished, eos_at < K)
+            buf = jax.lax.dynamic_update_slice(buf, merged, (count,))
+            return (count + emit_n, caches, finished, rounds + 1,
+                    accepted_sum + a, buf)
+
+        count, caches, _, rounds, accepted_sum, buf = jax.lax.while_loop(
+            cond, body,
+            (count0, caches, finished0, jnp.asarray(0, jnp.int32),
+             jnp.asarray(0, jnp.int32), buf))
+        # pad unreached slots with eos (match build_generate_fn's contract)
+        pos = jnp.arange(BUF)
+        buf = jnp.where(jnp.logical_and(pos >= count, pos >= T),
+                        jnp.where(eos_id >= 0, eos_id, buf), buf)
+        mean_acc = accepted_sum / jnp.maximum(rounds, 1)
+        return buf[None, :], caches, mean_acc
+
+    return jax.jit(gen, donate_argnums=(2,))

@@ -33,6 +33,7 @@ class _ZeroConfigView:
         self.stage = stage
         self.mics_shard_size = -1
         self.offload_optimizer_device = "none"
+        self.offload_param_device = "none"
 
 
 class Init:

@@ -1,0 +1,67 @@
+"""Prompt-lookup (self-drafting) speculative decoding: greedy acceptance
+makes the output EXACTLY the plain greedy continuation — that invariant is
+the whole test surface (any acceptance bug shows up as a token mismatch).
+Beyond-parity feature (reference v0.9.3 has no speculative path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import init_inference
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return init_inference(model=model, model_config=cfg, params=params,
+                          config={"dtype": "float32"})
+
+
+def _gen(engine, ids, n, **kw):
+    return np.asarray(engine.generate(np.asarray(ids, np.int32),
+                                      max_new_tokens=n, temperature=0.0,
+                                      **kw))
+
+
+def test_pld_matches_plain_greedy_structured(engine):
+    """Repetitive prompt (the favorable case) — tokens must be identical."""
+    unit = np.array([[5, 9, 17, 3, 11, 42, 7, 19]])
+    ids = np.tile(unit, (1, 4))                      # [1, 32] repeated
+    plain = _gen(engine, ids, 16)
+    pld = _gen(engine, ids, 16, speculative="prompt_lookup", draft_len=6)
+    np.testing.assert_array_equal(plain, pld)
+    assert engine.last_acceptance >= 0.0
+
+
+def test_pld_matches_plain_greedy_random(engine):
+    """Incompressible prompt (the unfavorable case) — still identical."""
+    ids = np.random.default_rng(3).integers(1, 250, (1, 19))
+    plain = _gen(engine, ids, 12)
+    pld = _gen(engine, ids, 12, speculative="prompt_lookup", draft_len=4)
+    np.testing.assert_array_equal(plain, pld)
+
+
+def test_pld_eos_padding_matches(engine):
+    """EOS truncation + padding behavior must match the plain path."""
+    ids = np.random.default_rng(5).integers(1, 250, (1, 10))
+    plain = _gen(engine, ids, 12, eos_token_id=7)
+    pld = _gen(engine, ids, 12, speculative="prompt_lookup", draft_len=4,
+               eos_token_id=7)
+    np.testing.assert_array_equal(plain, pld)
+
+
+def test_pld_rejects_sampling_and_batch(engine):
+    ids = np.zeros((1, 8), np.int32)
+    with pytest.raises(ValueError, match="greedy batch-1"):
+        engine.generate(ids, max_new_tokens=4, temperature=1.0,
+                        speculative="prompt_lookup")
+    with pytest.raises(ValueError, match="greedy batch-1"):
+        engine.generate(np.zeros((2, 8), np.int32), max_new_tokens=4,
+                        speculative="prompt_lookup")
+    with pytest.raises(ValueError, match="prompt_lookup"):
+        engine.generate(ids, max_new_tokens=4, speculative="medusa")

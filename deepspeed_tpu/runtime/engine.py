@@ -157,6 +157,14 @@ class DeepSpeedEngine:
 
         # params --------------------------------------------------------------
         self._rng = jax.random.PRNGKey(self._config.seed)
+        # ZeRO-Infinity parameter offload (reference partitioned_param_
+        # swapper.py:36): params+states live on NVMe; the step is a host
+        # interpreter over per-layer programs (zero/param_nvme.py), so the
+        # fused-program machinery below is not built at all
+        self._pnvme = None
+        if self._config.zero_config.offload_param_device == "nvme":
+            self._init_param_nvme(model, params, loss_fn)
+            return
         if params is None:
             assert sample_batch is not None and hasattr(model, "init"), \
                 "Need sample_batch (+ flax model) to initialize parameters"
@@ -257,6 +265,72 @@ class DeepSpeedEngine:
         else:
             self.opt_state = self._sharded_opt_init()
 
+        self._init_runtime_state()
+
+        self._build_step_functions()
+        log_dist(
+            f"DeepSpeedEngine initialized: zero_stage={self.zero_optimization_stage()}, "
+            f"dtype={self._config.precision_dtype}, mesh={dict(self.mesh.shape)}, "
+            f"micro_bs={self.train_micro_batch_size_per_gpu()}, "
+            f"gas={self.gradient_accumulation_steps()}, "
+            f"train_bs={self.train_batch_size()}", ranks=[0])
+        if self._config.dump_state:
+            # reference `dump_state` config: print the engine's param map
+            # (utils/debug.py name maps → per-param shape/dtype lines)
+            from deepspeed_tpu.utils.debug import debug_rank0, param_summary
+
+            debug_rank0("engine parameter state:\n"
+                        + param_summary(self.params, stats=False))
+
+    def _init_param_nvme(self, model, params, loss_fn):
+        """Alternate engine init for ``offload_param.device=nvme`` — builds
+        the host-interpreter trainer (zero/param_nvme.py) instead of the
+        fused jitted step. Unsupported feature combinations raise loudly in
+        ``validate_param_nvme_config``."""
+        from deepspeed_tpu.runtime.zero.param_nvme import (
+            NVMeParamTrainer, validate_param_nvme_config,
+        )
+
+        validate_param_nvme_config(self._config, self.mesh)
+        if loss_fn is not None:
+            raise NotImplementedError(
+                "offload_param.device=nvme streams the built-in causal-LM "
+                "loss layer-by-layer; a custom loss_fn cannot be decomposed "
+                "— drop it or use offload_param.device=cpu")
+        cfg = getattr(model, "cfg", None)
+        init_rng, self._rng = jax.random.split(self._rng)
+        self._pnvme = NVMeParamTrainer(cfg, self._config, self.mesh, init_rng)
+        import weakref
+
+        # finalizer BEFORE ingest: a mismatched params tree must not leak
+        # the AIO thread pools / partially-written swap files
+        self._pnvme_finalizer = weakref.finalize(self, self._pnvme.close)
+        if params is not None:
+            self._pnvme.ingest(params)
+        # API-parity attributes the shared code paths read
+        self.params = {}
+        self.opt_state = ()
+        self.zero_plan = None
+        self._nvme = None
+        self._compressor = None
+        self.compression_scheduler = None
+        self.eigenvalue = None
+        self.progressive_layer_drop = None
+        self.quantizer = None
+        self._last_eigenvalues = None
+        self._last_micro_batch = None
+        self.optimizer, self._lr_schedule = self._configure_optimizer()
+        self._init_runtime_state()
+        log_dist(
+            f"DeepSpeedEngine initialized (param-NVMe interpreter): "
+            f"zero_stage=3, dtype={self._config.precision_dtype}, "
+            f"mesh={dict(self.mesh.shape)}, "
+            f"micro_bs={self.train_micro_batch_size_per_gpu()}, "
+            f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+
+    def _init_runtime_state(self):
+        """Scaler + counters + timers + monitor + curriculum + flops-profiler
+        state shared by the fused-program and param-NVMe init paths."""
         # loss scaler (fp16 only) ---------------------------------------------
         if self.fp16_enabled:
             if self._config.fp16.loss_scale > 0:
@@ -309,21 +383,6 @@ class DeepSpeedEngine:
         # profile_step
         self._flops_profiler_cfg = self._config.flops_profiler
         self._flops_profiled = False
-
-        self._build_step_functions()
-        log_dist(
-            f"DeepSpeedEngine initialized: zero_stage={self.zero_optimization_stage()}, "
-            f"dtype={self._config.precision_dtype}, mesh={dict(self.mesh.shape)}, "
-            f"micro_bs={self.train_micro_batch_size_per_gpu()}, "
-            f"gas={self.gradient_accumulation_steps()}, "
-            f"train_bs={self.train_batch_size()}", ranks=[0])
-        if self._config.dump_state:
-            # reference `dump_state` config: print the engine's param map
-            # (utils/debug.py name maps → per-param shape/dtype lines)
-            from deepspeed_tpu.utils.debug import debug_rank0, param_summary
-
-            debug_rank0("engine parameter state:\n"
-                        + param_summary(self.params, stats=False))
 
     def _ctx(self):
         """Scoped ambient-mesh context: PartitionSpec-based sharding
@@ -820,7 +879,14 @@ class DeepSpeedEngine:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         self._maybe_profile_flops(batch)
-        if self._nvme is not None:
+        if self._pnvme is not None:
+            # param-NVMe interpreter (zero/param_nvme.py): LR from applied-
+            # update count, like the optimizer-NVMe path (_nvme_apply)
+            lr = (float(self._lr_schedule(self._pnvme.count))
+                  if self._lr_schedule else None)
+            with self._ctx():
+                loss, finite = self._pnvme.train_batch(batch, lr=lr)
+        elif self._nvme is not None:
             loss, finite = self._train_batch_nvme(batch)
         else:
             with self._ctx():
@@ -883,6 +949,11 @@ class DeepSpeedEngine:
 
     def forward(self, batch: Dict[str, Any]):
         """Compute loss (and grads — fused reverse AD) for one micro-batch."""
+        if self._pnvme is not None:
+            raise NotImplementedError(
+                "offload_param.device=nvme supports only train_batch() — "
+                "the forward/backward/step split would re-stream every "
+                "layer from NVMe per phase")
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         if self._compressor is not None:
@@ -1097,6 +1168,9 @@ class DeepSpeedEngine:
         if getattr(self, "_nvme", None) is not None:
             self._nvme_finalizer()      # weakref.finalize: at-most-once
             self._nvme = None
+        if getattr(self, "_pnvme", None) is not None:
+            self._pnvme_finalizer()
+            self._pnvme = None
         if hasattr(self, "_ckpt_engine"):
             self._ckpt_engine.wait()
 
@@ -1106,12 +1180,18 @@ class DeepSpeedEngine:
             batch = {**batch, STEP_KEY: jnp.asarray(self.global_steps, jnp.int32)}
         batch = self._shard_batch(batch)
         with self._ctx():
+            if self._pnvme is not None:
+                return self._pnvme.loss_eval(batch)
             return self._jit_loss(self.params, batch)
 
     def consolidated_state_dict(self, dtype=None):
         """Full (replicated) parameter pytree as numpy — the live analogue of
         the reference's ``_zero3_consolidated_16bit_state_dict``
         (engine.py:3230): gathers every ZeRO shard."""
+        if self._pnvme is not None:
+            tree = self._pnvme.materialize()
+            return (jax.tree_util.tree_map(lambda a: a.astype(dtype), tree)
+                    if dtype is not None else tree)
         rep = NamedSharding(self.mesh, PartitionSpec())
 
         def gather(p):
@@ -1140,12 +1220,16 @@ class DeepSpeedEngine:
                         client_state: Optional[Dict] = None, save_latest: bool = True):
         engine = self.checkpoint_engine
         tag = tag or f"global_step{self.global_steps}"
+        nvme_count = (self._pnvme.count if self._pnvme is not None
+                      else self._nvme.count if self._nvme is not None
+                      else None)
         state = {
-            "params": self.params,
+            # param-NVMe: params checkpoint by FILE COPY below too
+            "params": {} if self._pnvme is not None else self.params,
             # NVMe states checkpoint by FILE COPY below (streaming, never
             # gathered) — the pytree carries only the update count
-            "opt_state": ({"count": np.asarray(self._nvme.count)}
-                          if self._nvme is not None else self.opt_state),
+            "opt_state": ({"count": np.asarray(nvme_count)}
+                          if nvme_count is not None else self.opt_state),
             "scaler": self.scaler_state,
         }
         meta = {
@@ -1160,6 +1244,11 @@ class DeepSpeedEngine:
             import os as _os
 
             self._nvme.save_files(_os.path.join(save_dir, tag, "nvme_opt"))
+        if self._pnvme is not None:
+            import os as _os
+
+            self._pnvme.save_files(
+                _os.path.join(save_dir, tag, "nvme_params"))
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
 
@@ -1170,6 +1259,30 @@ class DeepSpeedEngine:
         engine = self.checkpoint_engine
         engine.wait()   # a pending async save must land before 'latest'
         tag = engine.resolve_tag(load_dir, tag)
+        if self._pnvme is not None:
+            pdir = _os.path.join(load_dir, tag, "nvme_params")
+            if not _os.path.isdir(pdir):
+                raise NotImplementedError(
+                    f"{load_dir}/{tag} is a dense checkpoint; restoring it "
+                    "into a param-NVMe engine requires materializing the "
+                    "full tree — load it with a dense engine and pass "
+                    "engine.consolidated_state_dict() as initialize("
+                    "params=...) instead")
+            template = {"params": {},
+                        "opt_state": {"count": np.asarray(0)},
+                        "scaler": self.scaler_state}
+            state, meta = engine.load(load_dir, tag, template)
+            self._pnvme.load_files(
+                pdir, load_optimizer_states=load_optimizer_states)
+            if load_optimizer_states:
+                self.scaler_state = state["scaler"]
+            self.global_steps = meta.get("global_steps", 0)
+            self.global_samples = meta.get("global_samples", 0)
+            self.micro_steps = meta.get("micro_steps", 0)
+            self.skipped_steps = meta.get("skipped_steps", 0)
+            log_dist(f"loaded param-NVMe checkpoint from {load_dir} "
+                     f"(tag={tag})", ranks=[0])
+            return load_dir, meta.get("client_state", {})
         nvme_dir = _os.path.join(load_dir, tag, "nvme_opt")
         ckpt_is_nvme = _os.path.isdir(nvme_dir)
         if self._nvme is not None and not ckpt_is_nvme:

@@ -51,10 +51,15 @@ def validate_offload_config(config) -> None:
             f"(jax.process_count()={jax.process_count()}); shard-local swap "
             "files are the multi-host extension")
     if zc.offload_param_device == "nvme":
-        raise NotImplementedError(
-            "offload_param.device=nvme (parameter NVMe offload) is not "
-            "implemented; offload_param.device=cpu and optimizer-state NVMe "
-            "offload (offload_optimizer.device=nvme) are")
+        # handled by the host-interpreter trainer (zero/param_nvme.py); the
+        # engine branches to it before reaching this validator, but direct
+        # callers get the same loud checks
+        from deepspeed_tpu.runtime.zero.param_nvme import (
+            validate_param_nvme_config,
+        )
+
+        validate_param_nvme_config(config, mesh=None)
+        return
     if zc.offload_param_device == "cpu":
         # stage-3 requirement raises in stages.plan_zero_shardings; here the
         # cross-feature contracts

@@ -1,0 +1,624 @@
+"""ZeRO-Infinity **parameter** offload: weights live on NVMe between uses.
+
+TPU-native analogue of the reference's NVMe parameter swapper + hook-driven
+per-submodule fetch/release (``runtime/swap_tensor/partitioned_param_swapper
+.py:36`` ``AsyncPartitionedParameterSwapper``, ``runtime/zero/
+parameter_offload.py:201`` ``DeepSpeedZeRoOffload``, ``partition_parameters
+.py:603`` ``Init(remote_device='nvme')``). The reference streams partitioned
+torch params NVMe→pinned buffer→GPU around each submodule under eager
+execution; a jitted TPU program cannot read disk mid-graph, so the step is
+an explicit host-driven interpreter over per-layer compiled programs:
+
+- **fwd** (per micro-batch): ``embed`` program, then one ``layer_fwd``
+  program per transformer layer whose weights arrive NVMe→host→HBM just
+  before use (the AIO pool prefetches layer l+1 while l computes — the
+  param-coordinator prefetch, partitioned_param_coordinator.py:262) and are
+  dropped after (release = XLA frees the buffer; reads need no write-back).
+  Boundary activations are stashed in pinned host memory.
+- **bwd**: the mirrored loop — each layer re-fetches its weights, recomputes
+  its forward from the stashed input (activation-checkpoint style) and runs
+  the VJP; weight gradients accumulate in host-RAM fp32 buffers (the
+  reference's pinned grad partitions, stage_1_and_2.py:1037).
+- **update**: per-group swapped AdamW exactly like the optimizer-state NVMe
+  path (stage3.py:1775-1835): params + m/v stream NVMe→HBM→NVMe one layer
+  at a time, so HBM never holds more than one layer of params+grads+states
+  and host RAM holds grads + an LRU window of param groups.
+
+The ``max_in_cpu`` window (reference zero/offload_config.py ``max_in_cpu``)
+is a host-RAM LRU cache of param groups: at ``max_in_cpu >= total params``
+this degenerates to CPU-offload behavior (disk touched only by the update's
+write-back); at 0 every fetch hits NVMe.
+
+Scope (all loudly validated): scanned-Llama models, Adam-family optimizers,
+bf16/fp32 (no fp16 loss scaling), single process. Tied embeddings supported.
+"""
+
+import json
+import os
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.swap_tensor.swapper import PipelinedOptimizerSwapper
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+ADAM_FAMILY = ("adam", "adamw", "fusedadam")
+
+
+def validate_param_nvme_config(config, mesh) -> None:
+    """Loud errors for unsupported offload_param=nvme combinations (the
+    round-1 standard: never silently ignore the config the framework is
+    named for)."""
+    zc = config.zero_config
+    opt = config.optimizer
+    opt_name = (opt.type if opt is not None else "adamw").lower()
+    if zc.stage < 3:
+        raise ValueError(
+            f"offload_param.device=nvme requires zero_optimization.stage=3 "
+            f"(got stage={zc.stage}) — parameter offload partitions "
+            f"parameters, which only stage 3 does")
+    if zc.offload_param.nvme_path is None:
+        raise ValueError(
+            "offload_param.device=nvme requires offload_param.nvme_path "
+            "(the swap directory)")
+    if zc.offload_optimizer_device not in ("cpu", "nvme"):
+        raise ValueError(
+            "offload_param.device=nvme requires offload_optimizer.device "
+            "cpu or nvme: with the optimizer in HBM the update would "
+            "re-materialize the full parameter+state set on device, "
+            "undoing the offload")
+    if (zc.offload_optimizer_device == "nvme"
+            and zc.offload_optimizer.nvme_path is None):
+        raise ValueError(
+            "offload_optimizer.device=nvme requires "
+            "offload_optimizer.nvme_path")
+    if opt_name not in ADAM_FAMILY:
+        raise ValueError(
+            f"offload_param.device=nvme uses the per-group swapped Adam "
+            f"step and supports Adam-family optimizers only "
+            f"({'/'.join(ADAM_FAMILY)}); got {opt_name!r}")
+    if config.fp16.enabled:
+        raise NotImplementedError(
+            "offload_param.device=nvme does not support fp16 loss scaling; "
+            "use bf16 (TPU-native) or fp32")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "offload_param.device=nvme is single-host only: the swap files "
+            "hold gathered state per process "
+            f"(jax.process_count()={jax.process_count()})")
+    if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+        raise NotImplementedError(
+            "offload_param.device=nvme does not compose with pipeline "
+            "parallelism (the pipeline loss owns the layer loop)")
+    for feature, enabled in (
+            ("compression", get_any_compression(config)),
+            ("eigenvalue", config.eigenvalue_enabled),
+            ("progressive_layer_drop", config.pld_enabled),
+            ("flops_profiler", config.flops_profiler.enabled),
+            ("quantize_training", config.quantize_training_enabled)):
+        if enabled:
+            raise NotImplementedError(
+                f"offload_param.device=nvme does not compose with "
+                f"{feature} (both rewrite the loss/step)")
+
+
+def get_any_compression(config) -> bool:
+    from deepspeed_tpu.compression import get_compression_config
+
+    return get_compression_config(config.compression_config).any_enabled
+
+
+class _HostParamCache:
+    """LRU host-RAM window over param groups (reference ``max_in_cpu``,
+    zero/offload_config.py:21): groups fetched from NVMe stay in host RAM
+    until the element budget forces eviction."""
+
+    def __init__(self, max_elements: int):
+        self.max_elements = int(max_elements)
+        self._items: "OrderedDict[str, Any]" = OrderedDict()
+        self._sizes: Dict[str, int] = {}
+        self._used = 0
+
+    def get(self, name: str):
+        if name not in self._items:
+            return None
+        self._items.move_to_end(name)
+        return self._items[name]
+
+    def put(self, name: str, tree: Any) -> None:
+        n = sum(int(np.prod(np.shape(l)))
+                for l in jax.tree_util.tree_leaves(tree))
+        if n > self.max_elements:
+            self.pop(name)
+            return
+        if name in self._items:
+            self._used -= self._sizes[name]
+        self._items[name] = tree
+        self._items.move_to_end(name)
+        self._sizes[name] = n
+        self._used += n
+        while self._used > self.max_elements and len(self._items) > 1:
+            old, _ = self._items.popitem(last=False)
+            self._used -= self._sizes.pop(old)
+
+    def pop(self, name: str) -> None:
+        if name in self._items:
+            del self._items[name]
+            self._used -= self._sizes.pop(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._items
+
+
+class NVMeParamTrainer:
+    """Owns NVMe-resident parameters + optimizer states and the streamed
+    train step. Construct via the engine (``offload_param.device=nvme``)."""
+
+    def __init__(self, cfg, config, mesh, rng):
+        from deepspeed_tpu.models.llama import LlamaBlock, LlamaConfig
+
+        assert isinstance(cfg, LlamaConfig), (
+            "offload_param.device=nvme streams the scanned-Llama layer "
+            f"loop; model config must be a LlamaConfig (got {type(cfg)})")
+        assert cfg.scan_layers, (
+            "offload_param.device=nvme requires scan_layers=True (the "
+            "stacked block tree is the swap granularity)")
+        self.cfg = cfg
+        self.mesh = mesh
+        zc = config.zero_config
+        self.L = cfg.num_layers
+        self.gas = config.gradient_accumulation_steps
+        self.grad_clip = float(config.gradient_clipping or 0.0)
+        self.numerics = config.numerics_check_enabled
+
+        opt_cfg = config.optimizer
+        p = dict(opt_cfg.params) if opt_cfg is not None else {}
+        betas = p.get("betas", (p.get("beta1", 0.9), p.get("beta2", 0.999)))
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(p.get("eps", 1e-8))
+        self.weight_decay = float(p.get("weight_decay", 0.0))
+        self.base_lr = float(p.get("lr", 1e-3))
+        self.count = 0      # applied updates (LR schedule input)
+
+        # --- stores -------------------------------------------------------
+        swap_dir = str(zc.offload_param.nvme_path)
+        self._swap = PipelinedOptimizerSwapper(swap_dir)
+        if zc.offload_optimizer_device == "nvme":
+            opt_dir = str(zc.offload_optimizer.nvme_path)
+            self._oswap = (self._swap if os.path.abspath(opt_dir)
+                           == os.path.abspath(swap_dir)
+                           else PipelinedOptimizerSwapper(opt_dir))
+        else:       # optimizer tier = host RAM (offload_optimizer=cpu)
+            from deepspeed_tpu.runtime.zero.infinity import (
+                HostRAMOptimizerStore,
+            )
+
+            self._oswap = HostRAMOptimizerStore()
+        self._cache = _HostParamCache(zc.offload_param.max_in_cpu)
+
+        # --- abstract trees & shardings ----------------------------------
+        self.block = LlamaBlock(cfg)
+        S0 = min(4, cfg.max_seq_len)
+        from deepspeed_tpu.models.transformer import make_causal_mask
+
+        x0 = jnp.zeros((1, S0, cfg.hidden_size), cfg.dtype)
+        pos0 = jnp.arange(S0, dtype=jnp.int32)[None, :]
+        mask0 = make_causal_mask(S0)
+        self._abs_layer = jax.eval_shape(
+            lambda k: self.block.init(k, x0, mask0, pos0)["params"],
+            jax.random.PRNGKey(0))
+        self._abs_rest = self._abstract_rest()
+        self._plan_shardings(zc)
+
+        self._build_programs()
+        self._init_state(rng, zc)
+        where = ("NVMe" if zc.offload_optimizer_device == "nvme"
+                 else "host-RAM")
+        log_dist(
+            f"ZeRO-Infinity param offload: {self.L} layer groups + rest on "
+            f"NVMe at {swap_dir} (optimizer states: {where}; "
+            f"max_in_cpu={zc.offload_param.max_in_cpu:g} elements)",
+            ranks=[0])
+
+    # --- construction helpers --------------------------------------------
+    def _abstract_rest(self):
+        cfg = self.cfg
+        import flax.linen as nn
+
+        def init_rest(k):
+            k1, k2 = jax.random.split(k)
+            embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                             param_dtype=jnp.float32, dtype=cfg.dtype)
+            rest = {
+                "embed_tokens": embed.init(
+                    k1, jnp.zeros((1, 1), jnp.int32))["params"],
+                "final_norm": {"scale": jnp.ones((cfg.hidden_size,),
+                                                 jnp.float32)},
+            }
+            if not cfg.tie_embeddings:
+                head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                dtype=cfg.dtype, param_dtype=jnp.float32)
+                rest["lm_head"] = head.init(
+                    k2, jnp.zeros((1, 1, cfg.hidden_size), cfg.dtype)
+                )["params"]
+            return rest
+
+        self._init_rest_fn = init_rest
+        return jax.eval_shape(init_rest, jax.random.PRNGKey(0))
+
+    def _plan_shardings(self, zc) -> None:
+        """Device shardings for one layer slice / the rest tree, derived
+        from the stage-3 plan over the abstract stacked tree (the same specs
+        the in-HBM engine would use, runtime/zero/stages.py)."""
+        from deepspeed_tpu.runtime.zero.stages import plan_zero_shardings
+
+        stacked = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct((self.L,) + tuple(l.shape),
+                                           l.dtype), self._abs_layer)
+        abstract = dict(self._abs_rest)
+        abstract["blocks"] = {"block": stacked}
+        plan = plan_zero_shardings(abstract, self.mesh, zc)
+        is_spec = lambda x: isinstance(x, PartitionSpec)
+
+        def sliced(spec):
+            return NamedSharding(self.mesh, PartitionSpec(*spec[1:]))
+
+        self._layer_sh = jax.tree_util.tree_map(
+            sliced, plan.param_specs["blocks"]["block"], is_leaf=is_spec)
+        self._rest_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            {k: v for k, v in plan.param_specs.items() if k != "blocks"},
+            is_leaf=is_spec)
+        self._rep = NamedSharding(self.mesh, PartitionSpec())
+
+    def _build_programs(self) -> None:
+        cfg = self.cfg
+        from deepspeed_tpu.models.llama import loss_fn as lm_loss
+        from deepspeed_tpu.models.transformer import RMSNorm, make_causal_mask
+
+        block = self.block
+        norm = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype)
+
+        def emb_fwd(rest, ids):
+            # parity with nn.Embed(dtype=cfg.dtype): cast commutes with take
+            return rest["embed_tokens"]["embedding"][ids].astype(cfg.dtype)
+
+        def layer_fwd(w, x, pos):
+            mask = make_causal_mask(x.shape[-2])
+            return block.apply({"params": w}, x, mask, pos)
+
+        def head_loss(rest, x, labels):
+            xn = norm.apply({"params": rest["final_norm"]}, x)
+            if cfg.tie_embeddings:
+                emb = rest["embed_tokens"]["embedding"].astype(cfg.dtype)
+                logits = jnp.dot(xn.astype(jnp.float32).astype(cfg.dtype),
+                                 emb.T)
+            else:
+                k = rest["lm_head"]["kernel"].astype(cfg.dtype)
+                logits = jnp.dot(xn.astype(cfg.dtype), k)
+            return lm_loss(logits.astype(jnp.float32), labels)
+
+        def head_vjp(rest, x, labels):
+            loss, pull = jax.vjp(
+                lambda r, h: head_loss(r, h, labels), rest, x)
+            drest, dx = pull(jnp.ones((), jnp.float32))
+            return loss, dx, drest
+
+        def layer_vjp(w, x, pos, dy):
+            _, pull = jax.vjp(lambda w_, x_: layer_fwd(w_, x_, pos), w, x)
+            dw, dx = pull(dy)
+            return dx, dw
+
+        def emb_vjp(rest, ids, dx):
+            _, pull = jax.vjp(lambda r: emb_fwd(r, ids), rest)
+            return pull(dx)[0]
+
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+
+        def adam_group(w, mu, nu, g, lr, clip_scale, t):
+            """Same math as the fused engines (infinity.group_update /
+            ops/optimizers.build_optimizer): decoupled weight decay outside
+            the moment estimates, bias correction by applied-update count."""
+
+            def upd(p, m, v, gg):
+                gg = gg.astype(jnp.float32) * clip_scale
+                m = b1 * m + (1 - b1) * gg
+                v = b2 * v + (1 - b2) * jnp.square(gg)
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+                step = mhat / (jnp.sqrt(vhat) + eps)
+                if wd:
+                    step = step + wd * p.astype(jnp.float32)
+                return ((p.astype(jnp.float32) - lr * step).astype(p.dtype),
+                        m, v)
+
+            out = jax.tree_util.tree_map(upd, w, mu, nu, g)
+            pick = lambda i: jax.tree_util.tree_map(
+                lambda t3: t3[i], out, is_leaf=lambda x: isinstance(x, tuple))
+            return pick(0), pick(1), pick(2)
+
+        self._jit_emb_fwd = jax.jit(emb_fwd)
+        self._jit_layer_fwd = jax.jit(layer_fwd)
+        self._jit_head_vjp = jax.jit(head_vjp)
+        self._jit_layer_vjp = jax.jit(layer_vjp)
+        self._jit_emb_vjp = jax.jit(emb_vjp)
+        self._jit_adam = jax.jit(adam_group)
+        self._jit_head_loss = jax.jit(head_loss)
+
+    def _init_state(self, rng, zc) -> None:
+        """Streamed initialization: each layer's params are initialized in
+        their own jitted program and written to NVMe before the next layer
+        exists — the full tree is never materialized (zero.Init with
+        remote_device, partition_parameters.py:603)."""
+        from deepspeed_tpu.models.transformer import make_causal_mask
+
+        cfg = self.cfg
+        S0 = min(4, cfg.max_seq_len)
+        x0 = jnp.zeros((1, S0, cfg.hidden_size), cfg.dtype)
+        pos0 = jnp.arange(S0, dtype=jnp.int32)[None, :]
+        mask0 = make_causal_mask(S0)
+        layer_init = jax.jit(
+            lambda k: self.block.init(k, x0, mask0, pos0)["params"])
+        keys = jax.random.split(rng, self.L + 1)
+        for l in range(self.L):
+            w = jax.tree_util.tree_map(np.asarray, layer_init(keys[l]))
+            self._swap.offload(self._wname(l), w)
+            self._offload_zeros(self._osname(l), w)
+        rest = jax.tree_util.tree_map(
+            np.asarray, jax.jit(self._init_rest_fn)(keys[self.L]))
+        self._swap.offload(self._wname(None), rest)
+        self._offload_zeros(self._osname(None), rest)
+
+    def _offload_zeros(self, name: str, like: Any) -> None:
+        z = jax.tree_util.tree_map(
+            lambda l: np.zeros(np.shape(l), np.float32), like)
+        self._oswap.offload(name, {"mu": z, "nu": jax.tree_util.tree_map(
+            np.copy, z)})
+
+    # --- naming -----------------------------------------------------------
+    def _wname(self, l: Optional[int]) -> str:
+        return "w_rest" if l is None else f"w_l{l:03d}"
+
+    def _osname(self, l: Optional[int]) -> str:
+        return "os_rest" if l is None else f"os_l{l:03d}"
+
+    # --- fetch machinery --------------------------------------------------
+    def _get_host(self, l: Optional[int], prefetch: Optional[int] = -1):
+        """Host tree for group ``l`` (None = rest): LRU cache, else NVMe.
+        ``prefetch`` (−1 = nothing) submits the next group's reads."""
+        name = self._wname(l)
+        tree = self._cache.get(name)
+        if tree is None:
+            tree = self._swap.acquire(name, device_put=False)
+            self._cache.put(name, tree)
+        if prefetch != -1:
+            pname = self._wname(prefetch)
+            if pname not in self._cache:
+                self._swap.prefetch(pname)
+        return tree
+
+    def _put_dev(self, tree, shardings):
+        return jax.tree_util.tree_map(
+            lambda w, sh: jax.device_put(w, sh), tree, shardings)
+
+    def _get_layer_dev(self, l: int, prefetch: Optional[int] = -1):
+        return self._put_dev(self._get_host(l, prefetch), self._layer_sh)
+
+    def _get_rest_dev(self):
+        return self._put_dev(self._get_host(None), self._rest_sh)
+
+    # --- activation stash -------------------------------------------------
+    def _stash(self, x):
+        try:
+            return jax.device_put(
+                x, x.sharding.with_memory_kind("pinned_host"))
+        except Exception:       # backend without host memory space (CPU)
+            return x
+
+    def _unstash(self, x):
+        if getattr(getattr(x, "sharding", None), "memory_kind", None) \
+                == "pinned_host":
+            return jax.device_put(
+                x, x.sharding.with_memory_kind("device"))
+        return x
+
+    # --- the streamed step ------------------------------------------------
+    def train_batch(self, batch: Dict[str, Any], lr: Optional[float] = None):
+        """One global step over a ``(gas, micro_global, S)`` batch. Returns
+        ``(loss, finite)`` with the same semantics as the fused engine:
+        loss/grads averaged over GAS micro-batches, global-norm clipping,
+        numerics-gated update skip."""
+        ids_all, labels_all = batch["input_ids"], batch["labels"]
+        gas = int(ids_all.shape[0])
+        pos_all = batch.get("positions")
+        L = self.L
+
+        g_layers: List[Any] = [None] * L
+        g_rest: Any = None
+        loss_acc = None
+        rest_dev = self._get_rest_dev()
+
+        def acc(a, b):
+            if a is None:
+                # owned writable copies: np.asarray of a jax CPU array can
+                # be a read-only zero-copy view
+                return jax.tree_util.tree_map(
+                    lambda x: np.array(x, np.float32), b)
+            jax.tree_util.tree_map(
+                lambda h, d: np.add(h, np.asarray(d, np.float32), out=h),
+                a, b)
+            return a
+
+        for g in range(gas):
+            ids, labels = ids_all[g], labels_all[g]
+            S = int(ids.shape[-1])
+            pos = (pos_all[g] if pos_all is not None
+                   else jnp.arange(S, dtype=jnp.int32)[None, :])
+            # ForwardPass: fetch layer l (prefetch l+1), stash its input
+            x = self._jit_emb_fwd(rest_dev, ids)
+            stash = []
+            for l in range(L):
+                w = self._get_layer_dev(l, prefetch=l + 1 if l + 1 < L
+                                        else -1)
+                stash.append(self._stash(x))
+                x = self._jit_layer_fwd(w, x, pos)
+            # head + its VJP seed the backward chain
+            loss, dx, drest = self._jit_head_vjp(rest_dev, x, labels)
+            g_rest = acc(g_rest, drest)
+            loss_acc = loss if loss_acc is None else loss_acc + loss
+            # BackwardPass: re-fetch layer l (prefetch l-1), recompute+VJP
+            for l in reversed(range(L)):
+                w = self._get_layer_dev(l, prefetch=l - 1 if l > 0 else -1)
+                dx, dw = self._jit_layer_vjp(w, self._unstash(stash[l]),
+                                             pos, dx)
+                g_layers[l] = acc(g_layers[l], dw)
+            g_rest = acc(g_rest, self._jit_emb_vjp(rest_dev, ids, dx))
+        del rest_dev
+
+        inv = np.float32(1.0 / gas)
+        sq = 0.0
+        finite = True
+        for tree in g_layers + [g_rest]:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                np.multiply(leaf, inv, out=leaf)
+                sq += float(np.sum(np.square(leaf, dtype=np.float64)))
+                if self.numerics and finite:
+                    finite = bool(np.isfinite(leaf).all())
+        gnorm = float(np.sqrt(sq))
+        loss = float(np.asarray(loss_acc)) / gas
+        if self.numerics:
+            finite = finite and bool(np.isfinite(loss)) \
+                and bool(np.isfinite(gnorm))
+        else:
+            finite = True
+        if finite:
+            clip = (min(1.0, self.grad_clip / (gnorm + 1e-6))
+                    if self.grad_clip > 0 else 1.0)
+            self._apply_updates(g_layers, g_rest, clip, lr)
+        return jnp.asarray(loss, jnp.float32), jnp.asarray(finite)
+
+    def _apply_updates(self, g_layers, g_rest, clip_scale, lr) -> None:
+        """Per-group swapped AdamW (reference stage3.py:1799-1815): group
+        l's params+states stream in while l+1's reads are in flight."""
+        self.count += 1
+        t = jnp.asarray(self.count, jnp.float32)
+        lr_v = jnp.asarray(self.base_lr if lr is None else lr, jnp.float32)
+        cs = jnp.asarray(clip_scale, jnp.float32)
+        order = list(range(self.L)) + [None]
+        self._oswap.prefetch(self._osname(order[0]))
+        for i, l in enumerate(order):
+            os_state = self._oswap.acquire(self._osname(l),
+                                           device_put=False)
+            if i + 1 < len(order):
+                self._oswap.prefetch(self._osname(order[i + 1]))
+            sh = self._layer_sh if l is not None else self._rest_sh
+            w = self._put_dev(self._get_host(
+                l, prefetch=order[i + 1] if i + 1 < len(order) else -1), sh)
+            g = self._put_dev(g_layers[l] if l is not None else g_rest, sh)
+            mu = self._put_dev(os_state["mu"], sh)
+            nu = self._put_dev(os_state["nu"], sh)
+            new_w, new_mu, new_nu = self._jit_adam(w, mu, nu, g, lr_v, cs, t)
+            host_w = jax.tree_util.tree_map(np.asarray, new_w)
+            self._swap.release(self._wname(l), host_w)
+            self._cache.put(self._wname(l), host_w)
+            self._oswap.release(
+                self._osname(l),
+                {"mu": jax.tree_util.tree_map(np.asarray, new_mu),
+                 "nu": jax.tree_util.tree_map(np.asarray, new_nu)})
+        self._swap.flush()
+        if self._oswap is not self._swap:
+            self._oswap.flush()
+
+    # --- eval / export ----------------------------------------------------
+    def loss_eval(self, batch: Dict[str, Any]):
+        """Forward-only streamed loss for one ``(B, S)`` micro-batch."""
+        ids, labels = batch["input_ids"], batch["labels"]
+        S = int(ids.shape[-1])
+        pos = batch.get("positions")
+        if pos is None:
+            pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        rest_dev = self._get_rest_dev()
+        x = self._jit_emb_fwd(rest_dev, ids)
+        for l in range(self.L):
+            w = self._get_layer_dev(l, prefetch=l + 1 if l + 1 < self.L
+                                    else -1)
+            x = self._jit_layer_fwd(w, x, pos)
+        return self._jit_head_loss(rest_dev, x, labels)
+
+    def materialize(self) -> Dict[str, Any]:
+        """Full parameter pytree as host numpy, in the engine's stacked
+        layout (``consolidated_state_dict`` analogue — materializes
+        everything; meant for tests/export, not the training loop)."""
+        slices = [self._swap.acquire(self._wname(l), device_put=False)
+                  for l in range(self.L)]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *slices)
+        out = dict(self._swap.acquire(self._wname(None), device_put=False))
+        out["blocks"] = {"block": stacked}
+        return out
+
+    def ingest(self, params: Dict[str, Any]) -> None:
+        """Write a full (host) parameter pytree into the NVMe store —
+        layer-sliced, one group at a time (dense→NVMe checkpoint bridge;
+        also how tests seed identical weights into two engines)."""
+        stacked = params["blocks"]["block"]
+        for l in range(self.L):
+            w = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[l], stacked)
+            self._swap.offload(self._wname(l), w)
+            self._cache.pop(self._wname(l))
+        rest = {k: jax.tree_util.tree_map(np.asarray, v)
+                for k, v in params.items() if k != "blocks"}
+        self._swap.offload(self._wname(None), rest)
+        self._cache.pop(self._wname(None))
+
+    # --- checkpoint -------------------------------------------------------
+    def save_files(self, dst_dir: str) -> None:
+        """Checkpoint by file copy — O(io-buffer) host RAM, params and
+        optimizer states never gathered."""
+        os.makedirs(dst_dir, exist_ok=True)
+        self._swap.flush()
+        if self._oswap is not self._swap:
+            self._oswap.flush()
+        for l in list(range(self.L)) + [None]:
+            self._swap.swapper.copy_files(self._wname(l), dst_dir)
+            self._oswap.swapper.copy_files(self._osname(l), dst_dir)
+        with open(os.path.join(dst_dir, "param_nvme_meta.json"), "w") as f:
+            json.dump({"num_layers": self.L, "count": self.count,
+                       "tie_embeddings": self.cfg.tie_embeddings}, f)
+
+    def load_files(self, src_dir: str,
+                   load_optimizer_states: bool = True) -> None:
+        """Adopt a checkpoint's files. With ``load_optimizer_states=False``
+        only the weights are adopted — m/v keep their current (fresh-zero)
+        contents and the applied-update count stays, matching the dense
+        path's weights-only resume."""
+        with open(os.path.join(src_dir, "param_nvme_meta.json")) as f:
+            meta = json.load(f)
+        if meta["num_layers"] != self.L:
+            raise ValueError(
+                f"param-NVMe checkpoint has {meta['num_layers']} layers, "
+                f"engine has {self.L}")
+        self._swap.flush()
+        if self._oswap is not self._swap:
+            self._oswap.flush()
+        for l in list(range(self.L)) + [None]:
+            like = self._abs_layer if l is not None else self._abs_rest
+            self._swap.swapper.adopt_files(self._wname(l), src_dir, like)
+            self._cache.pop(self._wname(l))
+            if load_optimizer_states:
+                z = jax.tree_util.tree_map(
+                    lambda x: np.empty(tuple(x.shape), np.float32), like)
+                self._oswap.swapper.adopt_files(
+                    self._osname(l), src_dir, {"mu": z, "nu": z})
+        if load_optimizer_states:
+            self.count = int(meta["count"])
+
+    def close(self) -> None:
+        self._swap.close()
+        if self._oswap is not self._swap:
+            self._oswap.close()

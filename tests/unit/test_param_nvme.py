@@ -1,0 +1,236 @@
+"""ZeRO-Infinity parameter offload (``offload_param: {device: nvme}``).
+
+VERDICT r2 #1's second half: parameters resident on NVMe, streamed per-layer
+through host pinned buffers into HBM around fwd/bwd, with the per-group
+swapped AdamW update (reference ``runtime/swap_tensor/partitioned_param_
+swapper.py:36``, ``runtime/zero/parameter_offload.py:201``,
+``stage3.py:1775-1835``). These tests pin:
+
+- train_batch trajectory parity vs the in-HBM stage-3 engine (losses tight;
+  params loose — Adam's normalized update amplifies reduction-order noise
+  at near-zero-gradient elements)
+- loss decreases through the streamed path (pure-NVMe, no host cache)
+- the ``max_in_cpu`` host cache changes nothing numerically
+- checkpoint save→resume round-trips through file copies
+- tied-embeddings models stream correctly (head + embedding grads merge)
+- optimizer-state tier cpu (host RAM) composes with param tier nvme
+- unsupported combinations raise loudly
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def _batches(seed, n, bs=8, seq=16, vocab=256):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        t = rng.integers(0, vocab, (bs, seq + 1))
+        out.append({"input_ids": t[:, :-1], "labels": t[:, 1:]})
+    return out
+
+
+def _dense_config(gas=1, bs=8):
+    return {
+        "train_batch_size": bs * gas,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": False},
+        "zero_optimization": {"stage": 3},
+    }
+
+
+def _nvme_config(tmp, sub="", gas=1, bs=8, max_in_cpu=0, opt_device="nvme"):
+    cfg = _dense_config(gas=gas, bs=bs)
+    opt = {"device": opt_device}
+    if opt_device == "nvme":
+        opt["nvme_path"] = str(tmp / f"opt{sub}")
+    cfg["zero_optimization"] = {
+        "stage": 3,
+        "offload_param": {"device": "nvme",
+                          "nvme_path": str(tmp / f"param{sub}"),
+                          "max_in_cpu": max_in_cpu},
+        "offload_optimizer": opt,
+    }
+    return cfg
+
+
+def _model(tie=False):
+    return LlamaModel(LlamaConfig.tiny(dtype=jnp.float32,
+                                       tie_embeddings=tie))
+
+
+def _max_diff(a, b):
+    leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda x, y: float(np.max(np.abs(
+            np.asarray(x, np.float64) - np.asarray(y, np.float64)))), a, b))
+    return max(leaves)
+
+
+def test_trajectory_parity_vs_dense_stage3(tmp_path):
+    """Same init, same batches: the NVMe-streamed step and the fused in-HBM
+    stage-3 step must follow the same trajectory (gas=2, clipping on)."""
+    model = _model()
+    sb = _batches(0, 1)[0]
+    dense = deepspeed_tpu.initialize(model=model, config=_dense_config(gas=2),
+                                     sample_batch=sb)
+    p0 = dense.consolidated_state_dict()
+    nv = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+        tmp_path, gas=2), params=p0, sample_batch=sb)
+    try:
+        for b in _batches(1, 3, bs=16):
+            l_dense = float(dense.train_batch(dict(b)))
+            l_nvme = float(nv.train_batch(dict(b)))
+            assert abs(l_dense - l_nvme) < 1e-4, (l_dense, l_nvme)
+        assert _max_diff(dense.consolidated_state_dict(),
+                         nv.consolidated_state_dict()) < 3e-3
+    finally:
+        nv.destroy()
+        dense.destroy()
+
+
+def test_loss_decreases_pure_nvme(tmp_path):
+    """max_in_cpu=0: every fetch hits the AIO files; loss still trains."""
+    model = _model()
+    b = _batches(2, 1)[0]
+    nv = deepspeed_tpu.initialize(model=model, config=_nvme_config(tmp_path),
+                                  sample_batch=b)
+    try:
+        losses = [float(nv.train_batch(dict(b))) for _ in range(8)]
+        assert losses[-1] < losses[0] - 0.5, losses
+    finally:
+        nv.destroy()
+
+
+def test_host_cache_is_numerically_transparent(tmp_path):
+    """A large max_in_cpu window (the CPU-offload degenerate case) must
+    produce the identical trajectory to pure NVMe."""
+    model = _model()
+    sb = _batches(0, 1)[0]
+    batches = _batches(3, 3)
+    cold = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+        tmp_path, sub="c", max_in_cpu=0), sample_batch=sb)
+    p0 = cold._pnvme.materialize()
+    warm = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+        tmp_path, sub="w", max_in_cpu=10**9), sample_batch=sb)
+    warm._pnvme.ingest(p0)
+    try:
+        for b in batches:
+            lc = float(cold.train_batch(dict(b)))
+            lw = float(warm.train_batch(dict(b)))
+            assert lc == pytest.approx(lw, abs=1e-6)
+        assert _max_diff(cold.consolidated_state_dict(),
+                         warm.consolidated_state_dict()) < 1e-6
+    finally:
+        cold.destroy()
+        warm.destroy()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """save → fresh engine (own swap dir) → load → identical next step."""
+    model = _model()
+    sb = _batches(0, 1)[0]
+    a = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+        tmp_path, sub="a"), sample_batch=sb)
+    try:
+        for b in _batches(4, 2):
+            a.train_batch(dict(b))
+        ck = tmp_path / "ck"
+        a.save_checkpoint(str(ck))
+        b_eng = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+            tmp_path, sub="b"), sample_batch=sb)
+        try:
+            b_eng.load_checkpoint(str(ck))
+            assert b_eng.global_steps == a.global_steps
+            assert b_eng._pnvme.count == a._pnvme.count
+            nxt = _batches(5, 1)[0]
+            la = float(a.train_batch(dict(nxt)))
+            lb = float(b_eng.train_batch(dict(nxt)))
+            assert la == pytest.approx(lb, abs=1e-6)
+        finally:
+            b_eng.destroy()
+    finally:
+        a.destroy()
+
+
+def test_tied_embeddings_parity(tmp_path):
+    """tie_embeddings: the head's embedding grad and the lookup grad both
+    land on the one embedding table — trajectory must match dense."""
+    model = _model(tie=True)
+    sb = _batches(0, 1)[0]
+    dense = deepspeed_tpu.initialize(model=model, config=_dense_config(),
+                                     sample_batch=sb)
+    p0 = dense.consolidated_state_dict()
+    nv = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+        tmp_path, sub="t"), params=p0, sample_batch=sb)
+    try:
+        for b in _batches(6, 3):
+            l_dense = float(dense.train_batch(dict(b)))
+            l_nvme = float(nv.train_batch(dict(b)))
+            assert abs(l_dense - l_nvme) < 1e-4
+    finally:
+        nv.destroy()
+        dense.destroy()
+
+
+def test_optimizer_tier_cpu_composes(tmp_path):
+    """offload_param=nvme + offload_optimizer=cpu: m/v in host RAM."""
+    model = _model()
+    batches = _batches(7, 5)
+    nv = deepspeed_tpu.initialize(
+        model=model, config=_nvme_config(tmp_path, opt_device="cpu"),
+        sample_batch=batches[0])
+    try:
+        losses = [float(nv.train_batch(dict(b))) for b in batches]
+        assert losses[-1] < losses[0]
+    finally:
+        nv.destroy()
+
+
+def test_eval_loss_streams(tmp_path):
+    model = _model()
+    sb = _batches(0, 1)[0]
+    nv = deepspeed_tpu.initialize(model=model, config=_nvme_config(
+        tmp_path, sub="e"), sample_batch=sb)
+    try:
+        el = float(nv.eval_loss(dict(sb)))
+        assert np.isfinite(el)
+        with pytest.raises(NotImplementedError):
+            nv.forward(dict(sb))
+    finally:
+        nv.destroy()
+
+
+@pytest.mark.parametrize("mutate,err", [
+    (lambda z: z["offload_param"].pop("nvme_path"), "nvme_path"),
+    (lambda z: z.update(stage=2), "stage=3"),
+    (lambda z: z.update(offload_optimizer={"device": "none"}), "offload_optimizer"),
+])
+def test_loud_config_errors(tmp_path, mutate, err):
+    cfg = _nvme_config(tmp_path)
+    mutate(cfg["zero_optimization"])
+    with pytest.raises((ValueError, NotImplementedError), match=err):
+        deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                 sample_batch=_batches(0, 1)[0])
+
+
+def test_fp16_and_custom_loss_raise(tmp_path):
+    cfg = _nvme_config(tmp_path)
+    cfg["fp16"] = {"enabled": True}
+    with pytest.raises(NotImplementedError, match="fp16"):
+        deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                 sample_batch=_batches(0, 1)[0])
+    cfg2 = _nvme_config(tmp_path, sub="x")
+    with pytest.raises(NotImplementedError, match="loss_fn"):
+        deepspeed_tpu.initialize(
+            model=_model(), config=cfg2,
+            loss_fn=lambda p, b, rngs=None: jnp.zeros(()),
+            sample_batch=_batches(0, 1)[0])

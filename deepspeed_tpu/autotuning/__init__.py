@@ -5,3 +5,6 @@ from deepspeed_tpu.autotuning.autotuner import (
 from deepspeed_tpu.autotuning.config import (
     AutotuningConfig, get_autotuning_config,
 )
+from deepspeed_tpu.autotuning.scheduler import (
+    Node, Reservation, ResourceManager, tune_with_scheduler, write_metrics,
+)

@@ -1,0 +1,296 @@
+"""Autotuning experiment scheduler + resource manager.
+
+TPU-native analogue of the reference's multi-node experiment scheduler
+(``deepspeed/autotuning/scheduler.py``: ``ResourceManager`` with per-node
+slot reservations, a dispatch loop that launches each experiment as its own
+job the moment resources free up, metric files parsed to pick the best
+config, skip-already-finished resume). Differences by design:
+
+- "slots" are TPU chips/hosts rather than GPUs; reservations map to the
+  launcher's ``--include host:slots`` syntax (launcher/runner.py).
+- each experiment runs through a pluggable ``exec_fn(exp, reservations)``.
+  The default launches the user script in its own subprocess with
+  ``DS_TPU_CONFIG_OVERRIDE`` pointing at the experiment's ds_config (the
+  same override ``dst --autotuning run`` uses) and
+  ``DST_INCLUDE=host:slots@...`` describing the reservation — process
+  isolation is what lets a crashing candidate (OOM, compile-service
+  failure) not poison the search.
+- results land in ``<result_dir>/metrics.json`` (written by the trial via
+  ``autotuning.metric_path``, the reference's contract) and errors are
+  detected from the exit code + stderr.log.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+POLL_S = 0.5
+
+
+class Node:
+    """A host with ``slots`` reservable chips (reference scheduler.py Node)."""
+
+    def __init__(self, host: str, max_slots: int):
+        self.host = host
+        self.max_slots = max_slots
+        self.idle_slots = list(range(max_slots))
+        self._lock = threading.Lock()
+
+    def reserve_slots(self, slot_request: int) -> Optional[List[int]]:
+        with self._lock:
+            if len(self.idle_slots) >= slot_request:
+                return [self.idle_slots.pop(0) for _ in range(slot_request)]
+        return None
+
+    def restore_slots(self, slots: List[int]) -> None:
+        with self._lock:
+            self.idle_slots += slots
+            self.idle_slots.sort()
+
+
+class Reservation:
+    def __init__(self, node: Node, slots: List[int]):
+        self.node = node
+        self.slots = slots
+
+    def restore_slots(self) -> None:
+        self.node.restore_slots(self.slots)
+
+    @property
+    def desc(self) -> str:
+        return f"{self.node.host}:{','.join(map(str, sorted(self.slots)))}"
+
+
+def _default_exec_fn(exp: Dict[str, Any],
+                     reservations: List[Reservation]) -> None:
+    """Run one experiment as a subprocess of the user script. Blocking —
+    the scheduler calls it from the experiment's own thread."""
+    result_dir = exp["result_dir"]
+    os.makedirs(result_dir, exist_ok=True)
+    cfg_path = os.path.join(result_dir, "ds_config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(exp["ds_config"], f)
+    env = dict(os.environ)
+    env["DS_TPU_CONFIG_OVERRIDE"] = cfg_path
+    env["DST_INCLUDE"] = "@".join(r.desc for r in reservations)
+    env["DST_EXPERIMENT_DIR"] = result_dir
+    cmd = exp.get("cmd") or [sys.executable, exp["user_script"],
+                             *exp.get("user_args", [])]
+    with open(os.path.join(result_dir, "stdout.log"), "wb") as out, \
+            open(os.path.join(result_dir, "stderr.log"), "wb") as err:
+        rc = subprocess.call(cmd, stdout=out, stderr=err, env=env,
+                             timeout=exp.get("timeout"))
+    if rc != 0:
+        raise RuntimeError(f"experiment {exp['name']} exited with {rc} "
+                           f"(stderr: {result_dir}/stderr.log)")
+
+
+class ResourceManager:
+    """Schedules experiments onto reservable node slots, running as many in
+    parallel as resources allow (reference scheduler.py:33 ResourceManager).
+
+    ``hosts``: {hostname: slots} — e.g. ``fetch_hostfile()`` output
+    (launcher/runner.py) or ``{"localhost": jax.device_count()}``.
+    """
+
+    def __init__(self, hosts: Dict[str, int], results_dir: str,
+                 exec_fn: Optional[Callable] = None):
+        self.nodes = [Node(h, s) for h, s in hosts.items()]
+        self.results_dir = results_dir
+        self.exec_fn = exec_fn or _default_exec_fn
+        self.experiment_queue: List[Dict[str, Any]] = []
+        self.running: Dict[int, tuple] = {}
+        self.finished_experiments: Dict[int, tuple] = {}
+        self.experiment_count = 0
+        self._seen = set()
+
+    # -- queueing ----------------------------------------------------------
+    def schedule_experiments(self, exps: List[Dict[str, Any]]) -> None:
+        """Queue experiments: each needs ``name`` and ``ds_config``, plus
+        optional ``num_nodes``/``num_slots_per_node`` (default 1×1) and
+        either ``cmd`` or ``user_script``/``user_args`` for the default
+        exec_fn. Experiments whose result dir already holds a metrics.json
+        or a recorded error are skipped (resume semantics)."""
+        for exp in exps:
+            if exp["name"] in self._seen:
+                continue
+            self._seen.add(exp["name"])
+            exp = dict(exp)
+            exp["exp_id"] = self.experiment_count
+            self.experiment_count += 1
+            exp.setdefault("num_nodes", 1)
+            exp.setdefault("num_slots_per_node", 1)
+            result_dir = exp["result_dir"] = os.path.join(
+                self.results_dir, exp["name"])
+            metric_file = os.path.join(result_dir, "metrics.json")
+            exp["ds_config"] = dict(exp.get("ds_config", {}))
+            at = dict(exp["ds_config"].get("autotuning", {}))
+            at["metric_path"] = metric_file
+            exp["ds_config"]["autotuning"] = at
+            if os.path.exists(metric_file):
+                logger.info(f"autotuning scheduler: skipping {exp['name']} "
+                            f"(results exist)")
+                self.finished_experiments[exp["exp_id"]] = (exp, None)
+                continue
+            self.experiment_queue.append(exp)
+
+    # -- resources ---------------------------------------------------------
+    def resource_request(self, exp) -> Optional[List[Reservation]]:
+        need_nodes = exp["num_nodes"]
+        reservations = []
+        for node in self.nodes:
+            if need_nodes == 0:
+                break
+            slots = node.reserve_slots(exp["num_slots_per_node"])
+            if slots is not None:
+                reservations.append(Reservation(node, slots))
+                need_nodes -= 1
+        if need_nodes == 0:
+            return reservations
+        for r in reservations:     # partial grant — give it back
+            r.restore_slots()
+        return None
+
+    def status(self) -> str:
+        return ", ".join(f"{n.host} ({len(n.idle_slots)} idle)"
+                         for n in self.nodes)
+
+    # -- dispatch loop -----------------------------------------------------
+    def _run_one(self, exp, reservations):
+        try:
+            self.exec_fn(exp, reservations)
+            err = None
+        except Exception as e:      # noqa: BLE001 — any failure is a result
+            err = str(e)
+            logger.warning(f"autotuning scheduler: {exp['name']} failed: {e}")
+        self.finished_experiments[exp["exp_id"]] = (exp, err)
+
+    def _reap(self) -> None:
+        done = [eid for eid, (t, _, _) in self.running.items()
+                if not t.is_alive()]
+        for eid in done:
+            t, exp, reservations = self.running.pop(eid)
+            t.join()
+            for r in reservations:
+                r.restore_slots()
+
+    def run(self) -> None:
+        """Dispatch until the queue drains and every experiment finishes.
+        Experiments run concurrently whenever reservations allow — the
+        search over a pod is bounded by chips, not by one-at-a-time."""
+        while self.experiment_queue:
+            exp = self.experiment_queue.pop(0)
+            reservations = self.resource_request(exp)
+            if reservations is None:
+                self.experiment_queue.insert(0, exp)
+                self._reap()
+                time.sleep(POLL_S)
+                continue
+            logger.info(
+                f"autotuning scheduler: {exp['name']} on "
+                f"{'@'.join(r.desc for r in reservations)} "
+                f"[{self.status()}]")
+            t = threading.Thread(target=self._run_one,
+                                 args=(exp, reservations), daemon=True)
+            t.start()
+            self.running[exp["exp_id"]] = (t, exp, reservations)
+        while self.running:
+            self._reap()
+            time.sleep(POLL_S)
+
+    # -- results -----------------------------------------------------------
+    def parse_results(self, metric: str = "throughput"):
+        """Best (exp, value) over finished experiments' metric files
+        (reference scheduler.py parse_results)."""
+        best, best_v = None, float("-inf")
+        for exp, err in self.finished_experiments.values():
+            if err:
+                continue
+            mf = exp["ds_config"]["autotuning"]["metric_path"]
+            if not os.path.exists(mf):
+                continue
+            with open(mf) as f:
+                results = json.load(f)
+            v = results.get(metric)
+            if v is None:
+                continue
+            exp["results"] = results
+            if v > best_v:
+                best, best_v = exp, v
+        return best, (best_v if best is not None else None)
+
+    def clear(self) -> None:
+        self.experiment_queue = []
+        for eid, (t, exp, reservations) in list(self.running.items()):
+            t.join(timeout=1.0)
+            for r in reservations:
+                r.restore_slots()
+        self.running = {}
+        self.finished_experiments = {}
+        self._seen = set()
+
+
+def write_metrics(path_or_config, metrics: Dict[str, Any]) -> None:
+    """Trial-side helper: write the metrics file the scheduler parses.
+    Accepts the metric path or a ds_config dict carrying
+    ``autotuning.metric_path`` (set by ``schedule_experiments``)."""
+    path = path_or_config
+    if isinstance(path_or_config, dict):
+        path = path_or_config.get("autotuning", {}).get("metric_path")
+        if not path:
+            return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(metrics, f)
+
+
+def tune_with_scheduler(autotuner, resource_manager: ResourceManager,
+                        user_script: Optional[str] = None,
+                        user_args: Optional[List[str]] = None,
+                        num_slots_per_node: int = 1,
+                        metric: Optional[str] = None):
+    """Drive an :class:`~deepspeed_tpu.autotuning.autotuner.Autotuner`'s
+    candidate space through the scheduler: every candidate becomes a
+    scheduled experiment (its own job on reserved slots), results are read
+    back from metric files, and the best candidate's full ds_config is
+    written like ``Autotuner.tune`` (reference autotuner.py:404 running its
+    tuner through the scheduler)."""
+    cands = autotuner.candidates()
+    exps = []
+    by_name = {}
+    for cand in cands[:autotuner.cfg.tuner_num_trials]:
+        name = cand.key().replace("/", "_")
+        by_name[name] = cand
+        exps.append({
+            "name": name,
+            "ds_config": cand.ds_config(autotuner.base_config,
+                                        autotuner.dp_size),
+            "num_slots_per_node": num_slots_per_node,
+            "user_script": user_script,
+            "user_args": list(user_args or []),
+        })
+    resource_manager.schedule_experiments(exps)
+    resource_manager.run()
+    metric = metric or autotuner.cfg.metric
+    best_exp, best_v = resource_manager.parse_results(metric)
+    if best_exp is None:
+        logger.warning("autotuning scheduler: no successful experiments")
+        return None
+    for exp, err in resource_manager.finished_experiments.values():
+        cand = by_name.get(exp["name"])
+        if cand is None:
+            continue
+        autotuner.results[cand.key()] = (
+            exp.get("results") if not err else {"error": err}) or {}
+        autotuner._cand_by_key[cand.key()] = cand
+    best_cand = by_name[best_exp["name"]]
+    autotuner._write_results(best_cand)
+    logger.info(f"autotuning scheduler: best = {best_cand.key()} "
+                f"({metric}={best_v})")
+    return best_cand.ds_config(autotuner.base_config, autotuner.dp_size)

@@ -1,0 +1,163 @@
+"""Experiment scheduler + resource manager (reference
+``deepspeed/autotuning/scheduler.py``): slot reservations, concurrent
+dispatch, subprocess experiment execution with metric-file results,
+skip-finished resume, and the Autotuner→scheduler bridge."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.autotuning import (
+    Autotuner, AutotuningConfig, ModelInfo, ResourceManager,
+    tune_with_scheduler, write_metrics,
+)
+
+INFO = ModelInfo(num_params=1_000_000, activation_mem_per_sample=1_000_000,
+                 flops_per_sample=1e9)
+
+
+def _recording_exec(log, lock, duration=0.3, fail=()):
+    def exec_fn(exp, reservations):
+        with lock:
+            log.append(("start", exp["name"], time.monotonic(),
+                        [r.desc for r in reservations]))
+        time.sleep(duration)
+        with lock:
+            log.append(("end", exp["name"], time.monotonic(), None))
+        if exp["name"] in fail:
+            raise RuntimeError("boom")
+        write_metrics(exp["ds_config"],
+                      {"throughput": float(exp["name"].split("_")[-1])})
+    return exec_fn
+
+
+def test_concurrent_dispatch_bounded_by_slots(tmp_path):
+    """4 single-slot experiments on 2 nodes x 1 slot: exactly 2 run at a
+    time, all 4 finish, every slot is restored."""
+    log, lock = [], threading.Lock()
+    rm = ResourceManager({"hostA": 1, "hostB": 1}, str(tmp_path),
+                         exec_fn=_recording_exec(log, lock))
+    rm.schedule_experiments([
+        {"name": f"exp_{i}", "ds_config": {}} for i in range(4)])
+    rm.run()
+    assert len(rm.finished_experiments) == 4
+    # reconstruct max concurrency from the event log
+    events = sorted(log, key=lambda e: e[2])
+    live = peak = 0
+    for kind, *_ in events:
+        live += 1 if kind == "start" else -1
+        peak = max(peak, live)
+    assert peak == 2, events
+    assert all(len(n.idle_slots) == n.max_slots for n in rm.nodes)
+
+
+def test_multinode_reservation_and_partial_grant(tmp_path):
+    """A 2-node experiment must reserve both nodes (and a partial grant is
+    returned when only one node has free slots)."""
+    log, lock = [], threading.Lock()
+    rm = ResourceManager({"hostA": 2, "hostB": 2}, str(tmp_path),
+                         exec_fn=_recording_exec(log, lock, duration=0.1))
+    rm.schedule_experiments([
+        {"name": "big_9", "ds_config": {}, "num_nodes": 2,
+         "num_slots_per_node": 2},
+        {"name": "small_1", "ds_config": {}},
+    ])
+    rm.run()
+    assert len(rm.finished_experiments) == 2
+    starts = {e[1]: e[3] for e in log if e[0] == "start"}
+    assert sorted(starts["big_9"]) == ["hostA:0,1", "hostB:0,1"]
+    assert all(len(n.idle_slots) == 2 for n in rm.nodes)
+
+
+def test_failure_recorded_not_fatal(tmp_path):
+    log, lock = [], threading.Lock()
+    rm = ResourceManager({"localhost": 1}, str(tmp_path),
+                         exec_fn=_recording_exec(log, lock, duration=0.05,
+                                                 fail=("bad_7",)))
+    rm.schedule_experiments([{"name": "bad_7", "ds_config": {}},
+                             {"name": "ok_5", "ds_config": {}}])
+    rm.run()
+    errs = {exp["name"]: err
+            for exp, err in rm.finished_experiments.values()}
+    assert errs["bad_7"] == "boom" and errs["ok_5"] is None
+    best, v = rm.parse_results("throughput")
+    assert best["name"] == "ok_5" and v == 5.0
+
+
+def test_skip_finished_resume(tmp_path):
+    """Re-scheduling after completion skips experiments whose metrics
+    exist (the reference's interrupted-search resume)."""
+    log, lock = [], threading.Lock()
+    rm = ResourceManager({"localhost": 1}, str(tmp_path),
+                         exec_fn=_recording_exec(log, lock, duration=0.05))
+    exps = [{"name": "exp_3", "ds_config": {}}]
+    rm.schedule_experiments(exps)
+    rm.run()
+    rm2 = ResourceManager({"localhost": 1}, str(tmp_path),
+                          exec_fn=_recording_exec(log, lock))
+    rm2.schedule_experiments(exps)
+    assert rm2.experiment_queue == []          # nothing left to run
+    assert len(rm2.finished_experiments) == 1
+    best, v = rm2.parse_results("throughput")
+    assert v == 3.0
+
+
+def test_default_exec_runs_subprocess(tmp_path):
+    """The default exec_fn launches the experiment as its own process with
+    DS_TPU_CONFIG_OVERRIDE + DST_EXPERIMENT_DIR set, captures logs, and a
+    non-zero exit becomes a recorded error."""
+    script = tmp_path / "trial.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "d = os.environ['DST_EXPERIMENT_DIR']\n"
+        "cfg = json.load(open(os.environ['DS_TPU_CONFIG_OVERRIDE']))\n"
+        "assert os.environ['DST_INCLUDE']\n"
+        "mbs = cfg.get('train_micro_batch_size_per_gpu', 1)\n"
+        "if mbs > 2: sys.exit(3)\n"
+        "json.dump({'throughput': 10.0 * mbs},"
+        " open(os.path.join(d, 'metrics.json'), 'w'))\n")
+    rm = ResourceManager({"localhost": 2}, str(tmp_path / "res"))
+    rm.schedule_experiments([
+        {"name": "mbs1", "ds_config": {"train_micro_batch_size_per_gpu": 1},
+         "user_script": str(script)},
+        {"name": "mbs2", "ds_config": {"train_micro_batch_size_per_gpu": 2},
+         "user_script": str(script)},
+        {"name": "mbs4", "ds_config": {"train_micro_batch_size_per_gpu": 4},
+         "user_script": str(script)},
+    ])
+    rm.run()
+    errs = {exp["name"]: err for exp, err in rm.finished_experiments.values()}
+    assert errs["mbs1"] is None and errs["mbs2"] is None
+    assert "exited with 3" in errs["mbs4"]
+    assert (tmp_path / "res" / "mbs4" / "stderr.log").exists()
+    best, v = rm.parse_results("throughput")
+    assert best["name"] == "mbs2" and v == 20.0
+
+
+def test_tune_with_scheduler_bridge(tmp_path):
+    """Autotuner candidates → scheduled experiments → best ds_config
+    written, with per-candidate results folded back into the tuner."""
+    cfg = AutotuningConfig(results_dir=str(tmp_path / "out"),
+                           tuner_num_trials=100, tuner_early_stopping=100)
+    tuner = Autotuner(engine_factory=None, batch_factory=None,
+                      base_config={"train_batch_size": 4},
+                      model_info=INFO, dp_size=4, config=cfg)
+
+    def exec_fn(exp, reservations):
+        c = exp["ds_config"]
+        score = (10 * c["zero_optimization"]["stage"]
+                 + c["train_micro_batch_size_per_gpu"])
+        write_metrics(exp["ds_config"], {"throughput": float(score)})
+
+    rm = ResourceManager({"localhost": 4}, str(tmp_path / "exps"),
+                         exec_fn=exec_fn)
+    best_cfg = tune_with_scheduler(tuner, rm)
+    assert best_cfg["zero_optimization"]["stage"] == 3
+    assert best_cfg["train_micro_batch_size_per_gpu"] == 16
+    saved = json.load(open(tmp_path / "out" / "autotuning_results.json"))
+    assert saved["best"] == "z3_mbs16_gas1"
+    assert os.path.exists(tmp_path / "out" / "ds_config_optimal.json")

@@ -132,11 +132,14 @@ def test_nvme_requires_path():
                                  sample_batch=_batch(np.random.default_rng(0)))
 
 
-def test_param_nvme_offload_errors_loudly():
+def test_param_nvme_requires_offloaded_optimizer():
+    """offload_param=nvme is implemented (zero/param_nvme.py,
+    tests/unit/test_param_nvme.py); invalid pairings still raise loudly —
+    here: parameters on NVMe with the optimizer kept in HBM."""
     model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
     cfg = _config({"stage": 3,
                    "offload_param": {"device": "nvme", "nvme_path": "/tmp/x"}})
-    with pytest.raises(NotImplementedError, match="offload_param"):
+    with pytest.raises(ValueError, match="offload_optimizer"):
         deepspeed_tpu.initialize(model=model, config=cfg,
                                  sample_batch=_batch(np.random.default_rng(0)))
 

@@ -1,0 +1,130 @@
+"""Round-3 perf sweep: partial-remat policies x (micro, gas) splits.
+
+PERF_ANALYSIS round 2 closed the no-remat/partial-remat door at micro=16
+(OOM or compile-helper crash). Untested: keeping the global batch at 16x512
+but splitting it micro=8 gas=2 / micro=4 gas=4 — per-microbatch activations
+shrink proportionally (the GAS lax.scan reuses one microbatch's activation
+buffers across steps) while fp32 states stay fixed at 12.4 GB, so
+save_mlp-class policies may fit where micro=16 could not.
+
+Each trial runs in its own subprocess (a candidate that crashes the remote
+compile helper must not poison later trials). Run on the real chip:
+
+    python tools/perf_sweep_remat_gas.py            # all trials
+    python tools/perf_sweep_remat_gas.py --trial '{...}'   # one (internal)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+TRIALS = [
+    # label, micro, gas, remat, policy, scope, fused_loss
+    ("baseline_b16_block", 16, 1, True, "nothing_saveable", "block", False),
+    ("b8g2_save_mlp", 8, 2, True, "save_mlp", "block", False),
+    ("b4g4_save_mlp", 4, 4, True, "save_mlp", "block", False),
+    ("b8g2_save_mlp_attn", 8, 2, True, "save_mlp_attn", "block", False),
+    ("b4g4_save_mlp_attn", 4, 4, True, "save_mlp_attn", "block", False),
+    ("b8g2_save_attn_out_fused", 8, 2, True, "save_attn_out", "block", True),
+    ("b8g2_mlp_scope", 8, 2, True, "nothing_saveable", "mlp", False),
+    ("b4g4_noremat_fused", 4, 4, False, "nothing_saveable", "block", True),
+    ("b2g8_noremat_fused", 2, 8, False, "nothing_saveable", "block", True),
+]
+
+
+def run_trial(spec):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    label, micro, gas, remat, policy, scope, fused = spec
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+        num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
+        dtype=jnp.bfloat16, remat=remat, remat_policy=policy,
+        remat_scope=scope, scan_layers=True)
+    seq, steps = 512, 10
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10**9,
+    }
+    if fused:
+        ds_config["fused_lm_loss"] = {"enabled": True, "chunk_size": 128}
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    tbs = micro * gas
+    sample = {"input_ids": rng.integers(0, cfg.vocab_size, (1, seq)),
+              "labels": rng.integers(0, cfg.vocab_size, (1, seq))}
+    engine = deepspeed_tpu.initialize(model=model, config=ds_config,
+                                      sample_batch=sample)
+    batches = []
+    for _ in range(4):
+        t = rng.integers(0, cfg.vocab_size, (tbs, seq + 1))
+        batches.append({"input_ids": t[:, :-1], "labels": t[:, 1:]})
+    float(engine.train_batch(batches[0]))    # compile
+    state = {}
+
+    def window():
+        for i in range(steps):
+            state["loss"] = engine.train_batch(batches[i % len(batches)])
+        float(state["loss"])
+
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.time()
+        window()
+        best = min(best, max(time.time() - t0, 1e-6))
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(engine.params))
+    tok_s = steps * tbs * seq / best
+    mfu = 6.0 * n_params * tok_s / 197e12
+    print(json.dumps({"label": label, "tokens_per_sec": round(tok_s, 1),
+                      "mfu": round(mfu, 4), "wall_s": round(best, 2),
+                      "micro": micro, "gas": gas, "policy": policy,
+                      "scope": scope, "fused": fused}))
+
+
+def main():
+    results = []
+    for spec in TRIALS:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--trial", json.dumps(spec)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = "/root/repo" + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        print(f"# {spec[0]} ...", file=sys.stderr, flush=True)
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=1200, cwd="/root/repo", env=env)
+        except subprocess.TimeoutExpired:
+            results.append({"label": spec[0], "error": "timeout"})
+            continue
+        line = [l for l in out.stdout.splitlines()
+                if l.startswith("{")]
+        if out.returncode != 0 or not line:
+            tail = (out.stderr or "")[-400:].replace("\n", " | ")
+            results.append({"label": spec[0],
+                            "error": f"rc={out.returncode}: {tail}"})
+        else:
+            results.append(json.loads(line[-1]))
+        print(json.dumps(results[-1]), flush=True)
+    with open("/root/repo/tools/perf_sweep_remat_gas.json", "w") as f:
+        json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    if "--trial" in sys.argv:
+        run_trial(json.loads(sys.argv[sys.argv.index("--trial") + 1]))
+    else:
+        main()

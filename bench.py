@@ -31,7 +31,8 @@ def time_best(window_fn, windows: int) -> float:
     return best
 
 
-def inference_main(int8: bool = False, batch_size: int = 1):
+def inference_main(int8: bool = False, batch_size: int = 1,
+                   stream: bool = False):
     """--inference [--int8] [--batch N]: fused-generation decode benchmark —
     TTFT (p50) and decode tokens/s on the flagship model (the DS-Inference
     headline family; reference kernels csrc/transformer/inference/).
@@ -66,7 +67,8 @@ def inference_main(int8: bool = False, batch_size: int = 1):
     config = {"dtype": "bfloat16" if on_tpu else "float32",
               "tensor_parallel": {"tp_size": 1}}
     if int8:
-        config["quant"] = {"enabled": True, "bits": 8, "group_size": 128}
+        config["quant"] = {"enabled": True, "bits": 8, "group_size": 128,
+                           "streaming": stream}
     engine = deepspeed_tpu.init_inference(model=model, config=config,
                                           params=params, model_config=cfg)
 
@@ -123,10 +125,11 @@ def inference_main(int8: bool = False, batch_size: int = 1):
     # effective against a ~450 GB/s achievable matvec ceiling — the
     # nominal 819 GB/s HBM figure is not reachable for [1,K]x[K,N] shapes,
     # so utilization against it understates how close decode is to its
-    # real ceiling (kept in detail as hbm_util_nominal). int8 storage is dequantized ONCE per generation
-    # (capacity win), so the decode loop streams bf16 either way:
-    # 2 bytes/param.
-    bytes_per_param = 2
+    # real ceiling (kept in detail as hbm_util_nominal). Plain int8
+    # storage is dequantized ONCE per generation (capacity win), so that
+    # decode loop still streams bf16: 2 bytes/param. With quant.streaming
+    # the decode matmuls read int8 through the Pallas kernel: 1 byte/param.
+    bytes_per_param = 1 if (int8 and stream) else 2
     MATVEC_BW = 450e9
     steps_per_sec = best / batch
     stream_rate = n_params * bytes_per_param * steps_per_sec
@@ -135,6 +138,7 @@ def inference_main(int8: bool = False, batch_size: int = 1):
     print(json.dumps({
         "metric": "llama770m_decode_tokens_per_sec"
                   + ("_int8" if int8 else "")
+                  + ("_stream" if (int8 and stream) else "")
                   + (f"_b{batch}" if batch > 1 else ""),
         "value": round(best, 1),
         "unit": "tokens/s",
@@ -146,7 +150,8 @@ def inference_main(int8: bool = False, batch_size: int = 1):
                    "hbm_util_nominal": round(hbm_util_nominal, 3),
                    "batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "params": int(n_params),
-                   "int8": int8, "backend": jax.default_backend()},
+                   "int8": int8, "int8_streaming": bool(int8 and stream),
+                   "backend": jax.default_backend()},
     }))
 
 
@@ -919,7 +924,8 @@ if __name__ == "__main__":
                 sys.exit("--batch requires a positive integer, e.g. "
                          "bench.py --inference --batch 8")
             bs = int(sys.argv[i])
-        inference_main(int8="--int8" in sys.argv, batch_size=bs)
+        inference_main(int8="--int8" in sys.argv, batch_size=bs,
+                       stream="--stream" in sys.argv)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

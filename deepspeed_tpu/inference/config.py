@@ -22,6 +22,12 @@ class QuantizationConfig(DeepSpeedConfigModel):
     enabled: bool = False
     bits: int = 8
     group_size: int = 64
+    # weight-STREAMING decode: generate() rebuilds the fused decode tree as
+    # rowwise int8 and every decode matmul runs the Pallas kernel that
+    # converts int8→f32 in VMEM — halving HBM bytes/step (decode is
+    # bandwidth-bound, so ~2x tokens/s is the ceiling). Llama-family
+    # scan-stacked models, bits=8 only.
+    streaming: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -105,15 +105,20 @@ def prompt_capacity(T: int, cfg=None) -> int:
 
 def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
                         max_new_tokens: int, params_fn=None,
-                        params_key=None):
-    """Shared compiled-generation cache policy (used by InferenceEngine and
-    the RLHF hybrid engine): capacity-bucketed keys, true LRU eviction.
-    Returns ``(gen_fn, cap)``.
+                        params_key=None, extra_key=(), builder=None):
+    """Shared compiled-generation cache policy (used by InferenceEngine —
+    plain and speculative variants — and the RLHF hybrid engine):
+    capacity-bucketed keys, true LRU eviction. Returns ``(gen_fn, cap)``.
 
     ``params_key`` is the stable cache token identifying the ``params_fn``
     transform (e.g. a quantization tag) — prefer it for ad-hoc callables:
     the ``id()`` fallback can collide when a garbage-collected function's
-    id is reused, silently serving a stale compiled program."""
+    id is reused, silently serving a stale compiled program.
+
+    ``builder`` (default ``build_generate_fn``) constructs the program on a
+    cache miss as ``builder(cap)``; ``extra_key`` tags variant programs
+    (e.g. speculative decode knobs) so they never collide with the plain
+    generator at the same shapes."""
     cap = gen_capacity(max_new_tokens)
     # params_fn identity is part of the program: a cached non-dequantizing
     # fn must not be reused if quantization is toggled between calls.
@@ -121,7 +126,7 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
     if params_key is None:
         params_key = (None if params_fn is None
                       else id(getattr(params_fn, "__func__", params_fn)))
-    key = (B, T, cap, params_key)
+    key = (B, T, cap, params_key) + tuple(extra_key)
     if not isinstance(cache, OrderedDict):
         raise TypeError("gen cache must be an OrderedDict")
     if key in cache:
@@ -129,8 +134,9 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
     else:
         if len(cache) >= GEN_CACHE_MAX:
             cache.popitem(last=False)
-        cache[key] = build_generate_fn(apply_fn, B, T, cap,
-                                       params_fn=params_fn)
+        cache[key] = (builder(cap) if builder is not None
+                      else build_generate_fn(apply_fn, B, T, cap,
+                                             params_fn=params_fn))
     return cache[key], cap
 
 
@@ -267,8 +273,23 @@ class InferenceEngine:
         # inference/config.py:126 + csrc/quantization): decode reads half the
         # HBM bytes per step; dequant fuses into the consuming matmul
         self._quantized = None
+        self._quant_streaming = False
         if self._config.quant.enabled:
             self._quantize_params()
+            if self._config.quant.streaming:
+                from deepspeed_tpu.models.llama import LlamaConfig
+
+                if self._config.quant.bits != 8:
+                    raise ValueError(
+                        "quant.streaming uses the int8 Pallas kernel; "
+                        f"bits={self._config.quant.bits} is not supported")
+                if not (isinstance(self.model_config, LlamaConfig)
+                        and self.model_config.scan_layers):
+                    raise ValueError(
+                        "quant.streaming requires the fused Llama decode "
+                        "path (a scan-stacked LlamaConfig model); "
+                        f"got {type(self.model_config).__name__}")
+                self._quant_streaming = True
         self._model_times: List[float] = []
         self._profile_model_time = False
         log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}"
@@ -456,13 +477,24 @@ class InferenceEngine:
         # qkv/gateup) run once at the program top (params_fn), NOT inside
         # the decode loop — see build_generate_fn
         transform = self._decode_transform
-        if self._quantized and transform is not None:
+        if self._quant_streaming:
+            # fused tree rebuilt as rowwise int8 at the program top; every
+            # decode matmul then streams int8 through the Pallas kernel
+            # (models/llama.quantize_fused_rowwise + FusedLlamaDecoderModel
+            # mm dispatch)
+            from deepspeed_tpu.models.llama import quantize_fused_rowwise
+
+            mcfg = self.model_config
+            params_fn = lambda p: quantize_fused_rowwise(
+                transform(self._effective_params(p)), mcfg)
+        elif self._quantized and transform is not None:
             params_fn = lambda p: transform(self._effective_params(p))
         elif self._quantized:
             params_fn = self._effective_params
         else:
             params_fn = transform
         base_key = ("int8w" if self._quantized else "",
+                    "stream" if self._quant_streaming else "",
                     "fused" if transform is not None else "",
                     self._config.quant.bits if self._quantized else 0)
         eos = -1 if eos_token_id is None else int(eos_token_id)
@@ -471,20 +503,16 @@ class InferenceEngine:
                 build_pld_generate_fn,
             )
 
-            cap = gen_capacity(max_new_tokens)
-            key = (B, T_cap, cap, base_key,
-                   ("pld", draft_len, prompt_lookup_ngram))
-            if key not in self._gen_cache:
-                if len(self._gen_cache) >= GEN_CACHE_MAX:
-                    self._gen_cache.popitem(last=False)
-                self._gen_cache[key] = build_pld_generate_fn(
+            pld_fn, _ = get_or_build_gen_fn(
+                self._gen_cache, apply_fn, B, T_cap, max_new_tokens,
+                params_fn=params_fn, params_key=base_key,
+                extra_key=(("pld", draft_len, prompt_lookup_ngram),),
+                builder=lambda cap: build_pld_generate_fn(
                     apply_fn, B, T_cap, cap, draft_len=draft_len,
-                    ngram=prompt_lookup_ngram, params_fn=params_fn)
-            else:
-                self._gen_cache.move_to_end(key)
+                    ngram=prompt_lookup_ngram, params_fn=params_fn))
             t0 = time.time() if self._profile_model_time else None
             with self._ctx():
-                tokens, self._kv_caches, mean_acc = self._gen_cache[key](
+                tokens, self._kv_caches, mean_acc = pld_fn(
                     self.params, input_ids, self._kv_caches,
                     jnp.asarray(eos, jnp.int32),
                     jnp.asarray(max_new_tokens, jnp.int32),

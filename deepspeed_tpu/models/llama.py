@@ -419,6 +419,48 @@ def fuse_decode_params(params: Any, cfg: LlamaConfig) -> Any:
     return out
 
 
+def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig) -> Any:
+    """int8 weight-streaming layout for a :func:`fuse_decode_params` tree.
+
+    Every decode matmul weight becomes ``{"q": int8, "scale": f32 rows}``
+    (per-input-channel symmetric — ops/int8_matmul.quantize_rowwise;
+    stacked block leaves are vmapped over the layer axis). The fused
+    decoder dispatches these leaves through the Pallas weight-streaming
+    kernel, so each decode step reads HALF the HBM bytes of bf16 — the
+    bandwidth (not just capacity) half of the reference's int8 inference
+    path (csrc/transformer/inference/csrc/dequantize.cu + pt_binding int8
+    GEMMs). Tied-embeddings models get an int8 ``attend_head`` built from
+    emb.T for the vocab matmul; the embedding table itself stays dense for
+    the lookup."""
+    from deepspeed_tpu.ops.int8_matmul import quantize_rowwise
+
+    def q2(w):
+        q, s = quantize_rowwise(w.astype(jnp.float32))
+        return {"q": q, "scale": s}
+
+    qstack = jax.vmap(lambda w: quantize_rowwise(w.astype(jnp.float32)))
+
+    def qlayers(w):
+        q, s = qstack(w)
+        return {"q": q, "scale": s}
+
+    blk = fused["blocks"]["block"]
+    out = {k: v for k, v in fused.items() if k not in ("blocks", "lm_head")}
+    out["blocks"] = {"block": {
+        "input_norm": blk["input_norm"],
+        "post_attn_norm": blk["post_attn_norm"],
+        "qkv_proj": qlayers(blk["qkv_proj"]),
+        "o_proj": qlayers(blk["o_proj"]),
+        "gateup_proj": qlayers(blk["gateup_proj"]),
+        "down_proj": qlayers(blk["down_proj"]),
+    }}
+    if "lm_head" in fused:
+        out["lm_head"] = {"kernel": q2(fused["lm_head"]["kernel"])}
+    elif cfg.tie_embeddings:
+        out["attend_head"] = q2(fused["embed_tokens"]["embedding"].T)
+    return out
+
+
 def decode_positions_and_mask(batch: int, T: int, S_max: int, cache_index,
                               attn_start=0):
     """Decode-step positions [B, T] and additive mask [1, 1, T, S_max]:
@@ -473,9 +515,23 @@ class FusedLlamaDecoderModel:
             return (x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
                     * scale).astype(cfg.dtype)
 
+        def mm(x, w):
+            """Matmul dispatch: dense kernels use the MXU dot; int8
+            weight-streaming leaves (quantize_fused_rowwise) go through the
+            Pallas kernel that converts int8→f32 in VMEM, halving the HBM
+            bytes per decode step."""
+            if isinstance(w, dict) and "q" in w:
+                from deepspeed_tpu.ops.int8_matmul import int8_matmul
+
+                Bm, Tm, Km = x.shape
+                y = int8_matmul(x.reshape(Bm * Tm, Km), w["q"], w["scale"],
+                                out_dtype=cfg.dtype)
+                return y.reshape(Bm, Tm, -1)
+            return x @ w
+
         def block(x, layer):
             h = rms(x, layer["input_norm"]["scale"])
-            qkv = h @ layer["qkv_proj"]
+            qkv = mm(h, layer["qkv_proj"])
             q_sz = cfg.num_heads * hd
             q = qkv[..., :q_sz].reshape(B, T, cfg.num_heads, hd)
             k = qkv[..., q_sz:q_sz + n_kv * hd].reshape(B, T, n_kv, hd)
@@ -492,11 +548,11 @@ class FusedLlamaDecoderModel:
                 vv = jnp.repeat(vv, rep, axis=2)
             a = dot_product_attention(q, kk, vv, mask=mask)
             a = a.reshape(B, T, q_sz)
-            x = x + (a @ layer["o_proj"])
+            x = x + mm(a, layer["o_proj"])
             h = rms(x, layer["post_attn_norm"]["scale"])
-            gu = h @ layer["gateup_proj"]
+            gu = mm(h, layer["gateup_proj"])
             g, u = jnp.split(gu, 2, axis=-1)
-            x = x + ((nn.silu(g) * u) @ layer["down_proj"])
+            x = x + mm(nn.silu(g) * u, layer["down_proj"])
             return x, (ck, cv)
 
         def scan_body(x, layer_and_cache):
@@ -511,12 +567,14 @@ class FusedLlamaDecoderModel:
 
         scale = fused_params["final_norm"]["scale"]
         x = rms(x, scale)
-        if cfg.tie_embeddings:
+        if "attend_head" in fused_params:    # int8-streaming tied head
+            logits = mm(x, fused_params["attend_head"])
+        elif cfg.tie_embeddings:
             # matches the baseline's Embed.attend: both operands in
             # cfg.dtype (fp32 logits would double the vocab-matmul bytes)
             logits = x @ emb.T.astype(cfg.dtype)
         else:
-            logits = x @ fused_params["lm_head"]["kernel"]
+            logits = mm(x, fused_params["lm_head"]["kernel"])
         return logits.astype(jnp.float32), new_caches
 
 

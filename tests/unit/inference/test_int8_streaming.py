@@ -1,0 +1,134 @@
+"""int8 weight-STREAMING decode (``quant: {streaming: true}``): the fused
+decode tree rebuilt as rowwise int8, every decode matmul through the Pallas
+VMEM-dequant kernel (ops/int8_matmul.py) — the bandwidth half of the
+reference's int8 inference path (csrc/.../dequantize.cu + pt_binding int8
+GEMMs), vs the capacity-only dequantize-once path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import (
+    FusedLlamaDecoderModel, LlamaConfig, LlamaModel, fuse_decode_params,
+    init_kv_caches, quantize_fused_rowwise,
+)
+
+
+def _setup(tie=False, seed=0):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, tie_embeddings=tie)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, 256, (2, 12)))
+    params = model.init(jax.random.PRNGKey(seed), ids)["params"]
+    return cfg, model, params, ids
+
+
+def test_quantize_fused_rowwise_layout():
+    cfg, model, params, ids = _setup()
+    fused = fuse_decode_params(params, cfg)
+    q = quantize_fused_rowwise(fused, cfg)
+    blk = q["blocks"]["block"]
+    for name in ("qkv_proj", "o_proj", "gateup_proj", "down_proj"):
+        leaf = blk[name]
+        dense = fused["blocks"]["block"][name]
+        assert leaf["q"].dtype == jnp.int8
+        assert leaf["q"].shape == dense.shape
+        assert leaf["scale"].shape == dense.shape[:2]   # [L, K] rows
+    assert q["lm_head"]["kernel"]["q"].dtype == jnp.int8
+    # embedding stays dense for the lookup
+    assert q["embed_tokens"]["embedding"].dtype != jnp.int8
+
+
+def test_tied_head_becomes_attend_head():
+    cfg, model, params, ids = _setup(tie=True)
+    q = quantize_fused_rowwise(fuse_decode_params(params, cfg), cfg)
+    assert "attend_head" in q
+    assert q["attend_head"]["q"].shape == (cfg.hidden_size, cfg.vocab_size)
+    assert "lm_head" not in q
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_int8_decoder_logits_close_to_dense(tie):
+    """The int8-streaming decoder's logits must track the dense fused
+    decoder within quantization error on the same weights."""
+    cfg, model, params, ids = _setup(tie=tie)
+    fused = fuse_decode_params(params, cfg)
+    qtree = quantize_fused_rowwise(fused, cfg)
+    dec = FusedLlamaDecoderModel(cfg)
+    caches = init_kv_caches(cfg, int(ids.shape[0]), 24)
+    dense_logits, _ = dec.apply({"params": fused}, ids, caches, 0)
+    q_logits, _ = dec.apply({"params": qtree}, ids, caches, 0)
+    d = np.asarray(dense_logits, np.float64)
+    qq = np.asarray(q_logits, np.float64)
+    rel = np.abs(d - qq).max() / (np.abs(d).max() + 1e-9)
+    assert rel < 0.08, rel                      # int8 weight-only error
+    # and the ranking should mostly agree at the last position
+    agree = (d[:, -1].argmax(-1) == qq[:, -1].argmax(-1)).mean()
+    assert agree >= 0.5
+
+
+def test_engine_streaming_generate_runs_and_is_deterministic():
+    cfg, model, params, ids = _setup()
+    eng = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "group_size": 32,
+                          "streaming": True}})
+    t1 = np.asarray(eng.generate(ids, max_new_tokens=6))
+    t2 = np.asarray(eng.generate(ids, max_new_tokens=6))
+    np.testing.assert_array_equal(t1, t2)
+    assert t1.shape[1] == ids.shape[1] + 6
+    # the streaming program must not collide with a plain int8 program in
+    # the gen cache
+    eng2 = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "group_size": 32}})
+    t3 = np.asarray(eng2.generate(ids, max_new_tokens=6))
+    assert t3.shape == t1.shape
+
+
+def test_streaming_tokens_track_dequantize_once():
+    """Streaming vs dequantize-once differ only by rowwise requantization;
+    greedy tokens at tiny scale should overwhelmingly agree."""
+    cfg, model, params, ids = _setup(seed=3)
+    base = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "group_size": 32}})
+    stream = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "group_size": 32,
+                          "streaming": True}})
+    a = np.asarray(base.generate(ids, max_new_tokens=8))
+    b = np.asarray(stream.generate(ids, max_new_tokens=8))
+    agree = (a == b).mean()
+    assert agree > 0.7, (agree, a, b)
+
+
+def test_streaming_validation_errors():
+    cfg, model, params, ids = _setup()
+    with pytest.raises(ValueError, match="bits"):
+        deepspeed_tpu.init_inference(
+            model=model, model_config=cfg, params=params,
+            config={"dtype": "float32",
+                    "quant": {"enabled": True, "bits": 4,
+                              "streaming": True}})
+    from deepspeed_tpu.models.unified import TransformerConfig, TransformerLM
+
+    ucfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                             intermediate_size=64, num_layers=2,
+                             num_heads=4, max_seq_len=64)
+    um = TransformerLM(ucfg)
+    uparams = um.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="fused Llama"):
+        deepspeed_tpu.init_inference(
+            model=um, model_config=ucfg, params=uparams,
+            config={"dtype": "float32",
+                    "quant": {"enabled": True, "bits": 8,
+                              "streaming": True}})

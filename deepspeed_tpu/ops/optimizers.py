@@ -39,6 +39,77 @@ def _adam_args(params: Dict[str, Any]):
     )
 
 
+def _moment_dtypes(params: Dict[str, Any]):
+    """(mu_dtype, nu_dtype) from config — ``moment_dtype`` sets both,
+    ``mu_dtype``/``nu_dtype`` override individually; None = fp32."""
+    import jax.numpy as jnp
+
+    names = {"float32": jnp.float32, "fp32": jnp.float32,
+             "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}
+
+    def resolve(key):
+        v = params.get(key, params.get("moment_dtype"))
+        if v is None:
+            return None
+        if str(v).lower() not in names:
+            raise ValueError(
+                f"optimizer.params.{key}={v!r}: supported moment dtypes "
+                f"are float32/bfloat16")
+        dt = names[str(v).lower()]
+        return None if dt == jnp.float32 else dt
+
+    return resolve("mu_dtype"), resolve("nu_dtype")
+
+
+def scale_by_adam_typed(b1: float, b2: float, eps: float,
+                        mu_dtype=None, nu_dtype=None):
+    """``optax.scale_by_adam`` with independently typed moments.
+
+    Moment storage in bf16 halves optimizer-state memory per moment
+    (8 bytes/param fp32 → 4) — the knob that frees HBM on a single chip
+    where fp32 m+v alone are 8 bytes/param (docs/PERF_ANALYSIS.md memory
+    wall). Update math stays fp32: moments are upcast, updated, and cast
+    back, so the only loss is storage rounding. ``nu`` in bf16 is the
+    riskier half (squared gradients span a wide exponent range — bf16
+    keeps the exponent but only 8 mantissa bits); keep it fp32 when
+    convergence is borderline. State is an ``optax.ScaleByAdamState`` so
+    checkpoint/NVMe bridges (zero/infinity.locate_adam_state) see the
+    standard mu/nu fields."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or jnp.float32),
+            params)
+        nu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or jnp.float32),
+            params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32),
+                                      mu=mu, nu=nu)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+
+        def upd(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1 - b1) * g32
+            v32 = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+            mhat = m32 / (1 - b1 ** c)
+            vhat = v32 / (1 - b2 ** c)
+            step = mhat / (jnp.sqrt(vhat) + eps)
+            return (step, m32.astype(m.dtype), v32.astype(v.dtype))
+
+        out = jax.tree_util.tree_map(upd, grads, state.mu, state.nu)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], out, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), optax.ScaleByAdamState(count=count, mu=pick(1),
+                                               nu=pick(2))
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(type_name: str, params: Dict[str, Any],
                     lr: Optional[ScheduleOrFloat] = None) -> optax.GradientTransformation:
     """Build the base gradient transformation (no clipping — the engine owns
@@ -49,9 +120,25 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
     if name in _REGISTRY:
         return _REGISTRY[name](params, learning_rate)
 
-    if name in ("adam", "fusedadam"):
+    if name in ("adam", "fusedadam", "adamw"):
         a = _adam_args(params)
-        if params.get("adam_w_mode", True) or a["weight_decay"] == 0.0:
+        mu_dt, nu_dt = _moment_dtypes(params)
+        decoupled = (name == "adamw" or params.get("adam_w_mode", True)
+                     or a["weight_decay"] == 0.0)
+        if mu_dt is not None or nu_dt is not None:
+            # typed-moment variant (bf16 m/v storage, fp32 update math)
+            chain = [scale_by_adam_typed(a["b1"], a["b2"], a["eps"],
+                                         mu_dtype=mu_dt, nu_dtype=nu_dt)]
+            if a["weight_decay"]:
+                if not decoupled:
+                    raise ValueError(
+                        "moment_dtype with adam_w_mode=false (L2-coupled "
+                        "weight decay) is not supported; use decoupled "
+                        "decay (adamw)")
+                chain.append(optax.add_decayed_weights(a["weight_decay"]))
+            chain.append(optax.scale_by_learning_rate(learning_rate))
+            return optax.chain(*chain)
+        if decoupled:
             return optax.adamw(learning_rate, b1=a["b1"], b2=a["b2"], eps=a["eps"],
                                weight_decay=a["weight_decay"])
         return optax.chain(
@@ -59,10 +146,6 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
             optax.add_decayed_weights(a["weight_decay"]),
             optax.scale_by_learning_rate(learning_rate),
         )
-    if name == "adamw":
-        a = _adam_args(params)
-        return optax.adamw(learning_rate, b1=a["b1"], b2=a["b2"], eps=a["eps"],
-                           weight_decay=a["weight_decay"])
     if name in ("lamb", "fusedlamb"):
         a = _adam_args(params)
         return optax.lamb(learning_rate, b1=a["b1"], b2=a["b2"], eps=a["eps"],

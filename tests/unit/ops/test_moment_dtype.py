@@ -1,0 +1,110 @@
+"""Typed-moment Adam (``optimizer.params.moment_dtype: bfloat16``): bf16
+moment STORAGE with fp32 update math — the optimizer-memory knob for the
+single-chip HBM wall (docs/PERF_ANALYSIS.md). Checks: fp32-typed variant is
+exactly optax, bf16 moments halve state bytes and track the fp32 trajectory,
+and the engine wires the knob end-to-end."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.ops.optimizers import build_optimizer, scale_by_adam_typed
+
+
+def _tree(rng):
+    return {"a": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((32,)), jnp.float32)}
+
+
+def test_fp32_typed_matches_optax_exactly():
+    rng = np.random.default_rng(0)
+    params = _tree(rng)
+    ref = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
+    got = scale_by_adam_typed(0.9, 0.999, 1e-8)
+    sr, sg = ref.init(params), got.init(params)
+    for i in range(5):
+        g = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+            params)
+        ur, sr = ref.update(g, sr, params)
+        ug, sg = got.update(g, sg, params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), ur, ug)
+
+
+def test_bf16_moments_halve_state_and_track_fp32():
+    rng = np.random.default_rng(1)
+    params = _tree(rng)
+    f32 = scale_by_adam_typed(0.9, 0.999, 1e-8)
+    b16 = scale_by_adam_typed(0.9, 0.999, 1e-8,
+                              mu_dtype=jnp.bfloat16, nu_dtype=jnp.bfloat16)
+    s32, s16 = f32.init(params), b16.init(params)
+    assert all(m.dtype == jnp.bfloat16
+               for m in jax.tree_util.tree_leaves(s16.mu))
+    bytes32 = sum(m.nbytes for m in jax.tree_util.tree_leaves(
+        (s32.mu, s32.nu)))
+    bytes16 = sum(m.nbytes for m in jax.tree_util.tree_leaves(
+        (s16.mu, s16.nu)))
+    assert bytes16 * 2 == bytes32
+    for i in range(10):
+        g = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+            params)
+        u32, s32 = f32.update(g, s32, params)
+        u16, s16 = b16.update(g, s16, params)
+        # bf16 storage rounding: ~3 decimal digits of moment precision
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=0.05,
+                                                    atol=0.05), u32, u16)
+
+
+def test_build_optimizer_moment_dtype_knob():
+    opt = build_optimizer("adamw", {"lr": 1e-3, "weight_decay": 0.01,
+                                    "moment_dtype": "bfloat16"})
+    params = _tree(np.random.default_rng(2))
+    state = opt.init(params)
+    from deepspeed_tpu.runtime.zero.infinity import locate_adam_state
+
+    node = locate_adam_state(state)
+    assert node is not None      # checkpoint/NVMe bridges still find mu/nu
+    assert all(m.dtype == jnp.bfloat16
+               for m in jax.tree_util.tree_leaves(node.mu))
+    # nu-only override
+    opt2 = build_optimizer("adam", {"lr": 1e-3, "mu_dtype": "bfloat16"})
+    node2 = locate_adam_state(opt2.init(params))
+    assert all(m.dtype == jnp.bfloat16
+               for m in jax.tree_util.tree_leaves(node2.mu))
+    assert all(v.dtype == jnp.float32
+               for v in jax.tree_util.tree_leaves(node2.nu))
+    with pytest.raises(ValueError, match="moment dtypes"):
+        build_optimizer("adamw", {"lr": 1e-3, "moment_dtype": "float16"})
+
+
+def test_engine_trains_with_bf16_moments():
+    rng = np.random.default_rng(3)
+    t = rng.integers(0, 256, (8, 17))
+    batch = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.01,
+                                 "moment_dtype": "bfloat16"}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": False},
+    }
+    eng = deepspeed_tpu.initialize(
+        model=LlamaModel(LlamaConfig.tiny(dtype=jnp.float32)), config=cfg,
+        sample_batch=batch)
+    losses = [float(eng.train_batch(dict(batch))) for _ in range(6)]
+    assert losses[-1] < losses[0] - 0.3, losses
+    from deepspeed_tpu.runtime.zero.infinity import locate_adam_state
+
+    node = locate_adam_state(eng.opt_state)
+    assert all(m.dtype == jnp.bfloat16
+               for m in jax.tree_util.tree_leaves(node.mu))

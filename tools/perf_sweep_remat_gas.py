@@ -21,7 +21,7 @@ import sys
 import time
 
 TRIALS = [
-    # label, micro, gas, remat, policy, scope, fused_loss
+    # label, micro, gas, remat, policy, scope, fused_loss[, moment_dtype]
     ("baseline_b16_block", 16, 1, True, "nothing_saveable", "block", False),
     ("b8g2_save_mlp", 8, 2, True, "save_mlp", "block", False),
     ("b4g4_save_mlp", 4, 4, True, "save_mlp", "block", False),
@@ -33,6 +33,24 @@ TRIALS = [
     ("b2g8_noremat_fused", 2, 8, False, "nothing_saveable", "block", True),
 ]
 
+# bf16-moment variants (optimizer.params.moment_dtype): m+v storage drops
+# 12.4 -> 9.3 GB, possibly opening the partial-remat doors the fp32-state
+# sweep above found closed
+MOMENT_TRIALS = [
+    ("m16_block_bf16mom", 16, 1, True, "nothing_saveable", "block", False,
+     "bfloat16"),
+    ("m16_save_mlp_bf16mom", 16, 1, True, "save_mlp", "block", False,
+     "bfloat16"),
+    ("m16_save_mlp_bf16mom_fused", 16, 1, True, "save_mlp", "block", True,
+     "bfloat16"),
+    ("m8g2_save_mlp_bf16mom", 8, 2, True, "save_mlp", "block", False,
+     "bfloat16"),
+    ("m8g2_save_mlp_attn_bf16mom", 8, 2, True, "save_mlp_attn", "block",
+     False, "bfloat16"),
+    ("m8g2_attn_scope_bf16mom", 8, 2, True, "nothing_saveable", "attn",
+     False, "bfloat16"),
+]
+
 
 def run_trial(spec):
     import jax
@@ -42,7 +60,8 @@ def run_trial(spec):
     import deepspeed_tpu
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
 
-    label, micro, gas, remat, policy, scope, fused = spec
+    label, micro, gas, remat, policy, scope, fused = spec[:7]
+    moment_dtype = spec[7] if len(spec) > 7 else None
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=1536, intermediate_size=4096,
         num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
@@ -53,7 +72,9 @@ def run_trial(spec):
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adamw",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+                      "params": {"lr": 1e-4, "weight_decay": 0.01,
+                                 **({"moment_dtype": moment_dtype}
+                                    if moment_dtype else {})}},
         "zero_optimization": {"stage": 1},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
@@ -92,12 +113,16 @@ def run_trial(spec):
     print(json.dumps({"label": label, "tokens_per_sec": round(tok_s, 1),
                       "mfu": round(mfu, 4), "wall_s": round(best, 2),
                       "micro": micro, "gas": gas, "policy": policy,
-                      "scope": scope, "fused": fused}))
+                      "scope": scope, "fused": fused,
+                      "moment_dtype": moment_dtype}))
 
 
 def main():
+    trials = list(TRIALS)
+    if "--moments" in sys.argv:
+        trials = MOMENT_TRIALS
     results = []
-    for spec in TRIALS:
+    for spec in trials:
         cmd = [sys.executable, os.path.abspath(__file__),
                "--trial", json.dumps(spec)]
         env = dict(os.environ)
@@ -119,7 +144,9 @@ def main():
         else:
             results.append(json.loads(line[-1]))
         print(json.dumps(results[-1]), flush=True)
-    with open("/root/repo/tools/perf_sweep_remat_gas.json", "w") as f:
+    suffix = "_moments" if "--moments" in sys.argv else ""
+    with open(f"/root/repo/tools/perf_sweep_remat_gas{suffix}.json",
+              "w") as f:
         json.dump(results, f, indent=2)
 
 

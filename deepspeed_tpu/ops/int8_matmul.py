@@ -59,18 +59,19 @@ def _use_interpret() -> bool:
 
 
 def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
-                block_k: int = 2048, block_n: int = 1024,
+                block_k: int = 2048, block_n: int = 512,
                 out_dtype=None) -> jnp.ndarray:
     """y = (x * scale) @ q  for int8 q.
 
     x: [B, K] (B small — the decode shape), q: [K, N] int8, scale: [K].
 
-    Default blocking: the whole K dimension per grid step when it fits
-    (each K-split pays an f32 accumulator round-trip per N block — at
-    decode shapes that overhead erased most of the int8 bandwidth win;
-    measured on v5e, K-split 512 ran 1.04x bf16 while full-K runs ~1.6x).
-    VMEM per grid step ≈ block_k·block_n·(1B int8 + 2B bf16 convert),
-    double-buffered — 2048x1024 stays ~6 MB.
+    Default blocking, measured on v5e decode (770M, in-situ A/B): the
+    whole K dimension per grid step (each K-split pays an f32 accumulator
+    round-trip per N panel — K-split 512 ran 1.04x bf16) and NARROW N
+    panels (full-K x 512 → 479 tok/s vs x1024 → 327, x2048 → 357: smaller
+    panels mean more outstanding DMAs for the pipeline to overlap). VMEM
+    per grid step ≈ block_k·block_n·(1B int8 + 2B convert), double-
+    buffered — 2048x512 stays ~3 MB.
     """
     B, K = x.shape
     Kq, N = q.shape

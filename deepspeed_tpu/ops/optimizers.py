@@ -117,6 +117,13 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
     name = type_name.lower()
     learning_rate = lr if lr is not None else params.get("lr", 1e-3)
 
+    if name not in ("adam", "fusedadam", "adamw") and any(
+            k in params for k in ("moment_dtype", "mu_dtype", "nu_dtype")):
+        raise ValueError(
+            f"optimizer.params moment dtypes (moment_dtype/mu_dtype/"
+            f"nu_dtype) are implemented for Adam-family optimizers only; "
+            f"{type_name!r} would silently keep fp32 state")
+
     if name in _REGISTRY:
         return _REGISTRY[name](params, learning_rate)
 

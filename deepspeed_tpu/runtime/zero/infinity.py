@@ -519,12 +519,18 @@ def inject_adam_state(opt_state, nvme_state, params_treedef):
         if not replaced[0] and hasattr(node, "_fields") \
                 and "mu" in node._fields and "nu" in node._fields:
             replaced[0] = True
-            new_mu = jax.tree_util.tree_map(
-                lambda new, old: jax.device_put(new, old.sharding)
-                if isinstance(old, jax.Array) else new, mu_tree, node.mu)
-            new_nu = jax.tree_util.tree_map(
-                lambda new, old: jax.device_put(new, old.sharding)
-                if isinstance(old, jax.Array) else new, nu_tree, node.nu)
+            def place(new, old):
+                # honor the live state's dtype too (typed bf16 moments,
+                # ops/optimizers.scale_by_adam_typed): NVMe files are
+                # always fp32, and restoring them as fp32 would silently
+                # double moment memory and retrace the step
+                new = np.asarray(new, getattr(old, "dtype", np.float32))
+                if isinstance(old, jax.Array):
+                    return jax.device_put(new, old.sharding)
+                return new
+
+            new_mu = jax.tree_util.tree_map(place, mu_tree, node.mu)
+            new_nu = jax.tree_util.tree_map(place, nu_tree, node.nu)
             count = np.asarray(nvme_state["count"],
                                np.asarray(node.count).dtype)
             if isinstance(node.count, jax.Array):

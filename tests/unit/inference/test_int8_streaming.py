@@ -110,6 +110,26 @@ def test_streaming_tokens_track_dequantize_once():
     assert agree > 0.7, (agree, a, b)
 
 
+def test_streaming_composes_with_speculative():
+    """quant.streaming + prompt-lookup speculation: the drafted verify
+    forward runs the int8 kernel and greedy-exactness must hold — the
+    speculative output equals the engine's own plain greedy continuation."""
+    cfg, model, params, _ = _setup(seed=5)
+    rng = np.random.default_rng(5)
+    # a structured (repetitive) prompt so lookup drafting actually fires
+    pattern = rng.integers(0, 64, 6)
+    ids = jnp.asarray(np.tile(pattern, 4)[None, :])
+    eng = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "group_size": 32,
+                          "streaming": True}})
+    plain = np.asarray(eng.generate(ids, max_new_tokens=8))
+    spec = np.asarray(eng.generate(ids, max_new_tokens=8,
+                                   speculative="prompt_lookup"))
+    np.testing.assert_array_equal(plain, spec)
+
+
 def test_streaming_validation_errors():
     cfg, model, params, ids = _setup()
     with pytest.raises(ValueError, match="bits"):

@@ -74,15 +74,24 @@ def test_build_optimizer_moment_dtype_knob():
     assert node is not None      # checkpoint/NVMe bridges still find mu/nu
     assert all(m.dtype == jnp.bfloat16
                for m in jax.tree_util.tree_leaves(node.mu))
-    # nu-only override
+    # mu-only override: nu stays fp32
     opt2 = build_optimizer("adam", {"lr": 1e-3, "mu_dtype": "bfloat16"})
     node2 = locate_adam_state(opt2.init(params))
     assert all(m.dtype == jnp.bfloat16
                for m in jax.tree_util.tree_leaves(node2.mu))
     assert all(v.dtype == jnp.float32
                for v in jax.tree_util.tree_leaves(node2.nu))
+    # nu-only override: mu stays fp32
+    opt3 = build_optimizer("adam", {"lr": 1e-3, "nu_dtype": "bfloat16"})
+    node3 = locate_adam_state(opt3.init(params))
+    assert all(m.dtype == jnp.float32
+               for m in jax.tree_util.tree_leaves(node3.mu))
+    assert all(v.dtype == jnp.bfloat16
+               for v in jax.tree_util.tree_leaves(node3.nu))
     with pytest.raises(ValueError, match="moment dtypes"):
         build_optimizer("adamw", {"lr": 1e-3, "moment_dtype": "float16"})
+    with pytest.raises(ValueError, match="Adam-family"):
+        build_optimizer("lamb", {"lr": 1e-3, "moment_dtype": "bfloat16"})
 
 
 def test_engine_trains_with_bf16_moments():

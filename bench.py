@@ -836,11 +836,16 @@ def main():
     # 17.5k tok/s (MFU 0.415) at 16x512 vs 13.1k (0.311) at 8x1024.
     # 512 matches the reference's RLHF workload seqlen (BASELINE.md,
     # 256 prompt + 256 gen).
+    # Round-3 operating point (tools/perf_sweep_remat_gas_moments.json):
+    # bf16 Adam moments (moment_dtype — m/v storage 12.4 -> 9.3 GB) free
+    # enough HBM for the save_mlp partial-remat policy, which every fp32-
+    # moment config OOMed on. Same-session ladder: fp32+block 17.6k ->
+    # bf16mom+block 17.9k -> bf16mom+save_mlp 18.5k tok/s.
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
-            dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
+            dtype=jnp.bfloat16, remat=True, remat_policy="save_mlp",
             scan_layers=True)
         batch, seq, steps = 16, 512, 10
     else:
@@ -851,7 +856,9 @@ def main():
     ds_config = {
         "train_micro_batch_size_per_gpu": batch,
         "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01,
+                                 "moment_dtype": "bfloat16"}},
         "zero_optimization": {"stage": 1},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
@@ -907,6 +914,8 @@ def main():
             "steps": steps, "wall_s": round(dt, 2),
             "model_tflops_per_chip": round(flops_per_sec / 1e12, 2),
             "mfu": round(our_mfu, 4), "backend": jax.default_backend(),
+            "remat_policy": cfg.remat_policy,
+            "moment_dtype": "bfloat16",
             "loss": float(loss),
         },
     }))

@@ -1,0 +1,117 @@
+"""ZeRO-Infinity parameter-NVMe on-TPU functional proof + topology note.
+
+The param-NVMe interpreter (zero/param_nvme.py) stages params
+NVMe → host RAM → HBM. On a TPU VM those tiers are colocated (disk and
+host RAM sit on the chip's PCIe) and the design scales like the
+reference's. **This environment's chip is behind the axon network
+tunnel**: the interpreter's host tier is the CLIENT VM, so every
+per-layer fetch/grad-spill crosses the network at ~2 orders of magnitude
+below PCIe — measured: a 0.65B-param config could not finish a step in
+25 min, while the IN-GRAPH cpu-offload path (remote-host pinned memory,
+tools/zero_offload_capacity.py) trains 2.7B at 9.1 s/step. Capacity-scale
+param-NVMe numbers are therefore not obtainable through the tunnel; this
+script instead proves the path END-TO-END on the real chip at a small
+size, and the CPU-mesh suite (tests/unit/test_param_nvme.py) pins its
+semantics.
+
+Run on the real chip:  python tools/param_nvme_capacity.py [--layers N]
+Writes tools/param_nvme_capacity.json.
+"""
+
+import json
+import os
+import resource
+import shutil
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    layers = 4
+    if "--layers" in sys.argv:
+        layers = int(sys.argv[sys.argv.index("--layers") + 1])
+    cfg = LlamaConfig(
+        vocab_size=8192, hidden_size=512, intermediate_size=1408,
+        num_layers=layers, num_heads=8, num_kv_heads=8, max_seq_len=256,
+        dtype=jnp.bfloat16, scan_layers=True)
+    B, S = 1, 128
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, cfg.vocab_size, (B, S + 1))
+    batch = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    n_params = (cfg.vocab_size * cfg.hidden_size * 2
+                + layers * (4 * cfg.hidden_size * cfg.hidden_size
+                            + 3 * cfg.hidden_size * cfg.intermediate_size
+                            + 2 * cfg.hidden_size) + cfg.hidden_size)
+    state_gb = n_params * 12 / 1e9
+    print(f"# ~{n_params/1e9:.2f}B params, on-disk state ~{state_gb:.0f} GB",
+          file=sys.stderr)
+
+    if "--no-offload" in sys.argv:
+        ds = {"train_micro_batch_size_per_gpu": B,
+              "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+              "zero_optimization": {"stage": 1},
+              "bf16": {"enabled": False}}
+        eng = deepspeed_tpu.initialize(model=LlamaModel(cfg), config=ds,
+                                       sample_batch=batch)
+        print(float(eng.train_batch(batch)))
+        return
+
+    swap = os.path.abspath("param_nvme_capacity_swap")
+    shutil.rmtree(swap, ignore_errors=True)
+    ds = {
+        "train_micro_batch_size_per_gpu": B,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": False},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "nvme", "nvme_path": swap + "/p",
+                              "max_in_cpu": 0},
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": swap + "/o"},
+        },
+    }
+    t0 = time.time()
+    eng = deepspeed_tpu.initialize(model=LlamaModel(cfg), config=ds,
+                                   sample_batch=batch)
+    t_init = time.time() - t0
+    du = sum(os.path.getsize(os.path.join(r, f))
+             for r, _, fs in os.walk(swap) for f in fs)
+    steps = []
+    losses = []
+    for i in range(3):
+        t0 = time.time()
+        loss = eng.train_batch(dict(batch))
+        losses.append(float(loss))
+        steps.append(round(time.time() - t0, 1))
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    out = {
+        "params_b": round(n_params / 1e9, 3),
+        "on_disk_state_gb": round(du / 1e9, 1),
+        "hbm_gb": 15.75,
+        "init_s": round(t_init, 1),
+        "step_s": steps,
+        "losses": losses,
+        "peak_host_rss_gb": round(peak_rss_gb, 1),
+        "loss_decreasing": losses[-1] < losses[0],
+    }
+    print(json.dumps(out))
+    with open("/root/repo/tools/param_nvme_capacity.json", "w") as f:
+        json.dump(out, f, indent=2)
+    eng.destroy()
+    shutil.rmtree(swap, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,7 +75,8 @@ class Candidate:
     def __init__(self, zero_stage: int, micro_batch: int, gas: int = 1,
                  num_micro: Optional[int] = None,
                  remat: Optional[str] = None,
-                 fused_loss: Optional[bool] = None):
+                 fused_loss: Optional[bool] = None,
+                 moment_dtype: Optional[str] = None):
         self.zero_stage = zero_stage
         self.micro_batch = micro_batch
         self.gas = gas
@@ -84,6 +85,10 @@ class Candidate:
         # "<scope>:<policy>" = rematerialize <scope> under <policy>
         self.remat = remat
         self.fused_loss = fused_loss
+        # Adam moment storage dtype (None = inherit; "bfloat16" halves
+        # optimizer-state memory — the knob that opened save_mlp on the
+        # single chip, docs/PERF_ANALYSIS.md round 3)
+        self.moment_dtype = moment_dtype
 
     def key(self) -> str:
         k = f"z{self.zero_stage}_mbs{self.micro_batch}_gas{self.gas}"
@@ -91,6 +96,7 @@ class Candidate:
         k += f"_r[{self.remat}]" if self.remat is not None else ""
         k += f"_fl{int(self.fused_loss)}" if self.fused_loss is not None \
             else ""
+        k += f"_m[{self.moment_dtype}]" if self.moment_dtype else ""
         return k
 
     def model_overrides(self) -> Optional[Dict[str, Any]]:
@@ -115,6 +121,9 @@ class Candidate:
             cfg.setdefault("pipeline", {})["num_micro"] = self.num_micro
         if self.fused_loss is not None:
             cfg["fused_lm_loss"] = {"enabled": bool(self.fused_loss)}
+        if self.moment_dtype:
+            cfg.setdefault("optimizer", {"type": "adamw", "params": {}}) \
+               .setdefault("params", {})["moment_dtype"] = self.moment_dtype
         ov = self.model_overrides()
         if ov is not None:
             # consumed (popped) by the caller's engine_factory; harmless to
@@ -134,6 +143,9 @@ def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
     params = n * PARAM_BYTES
     grads = n * GRAD_BYTES
     opt = n * OPTIMIZER_BYTES_PER_PARAM
+    if cand.moment_dtype in ("bfloat16", "bf16"):
+        # bf16 m/v storage: 8 B/param of moments become 4
+        opt -= n * 4
     if cand.zero_stage >= 1:
         opt //= dp_size
     if cand.zero_stage >= 2:
@@ -207,12 +219,14 @@ class Autotuner:
         mbs_list = self.cfg.micro_batch_sizes or list(DEFAULT_MICRO_BATCHES)
         remats = self.cfg.remat_policies or [None]
         fused_opts = self.cfg.fused_lm_loss_options or [None]
+        moments = self.cfg.moment_dtypes or [None]
         pipe = int((self.base_config.get("mesh") or {}).get("pipe", 1) or 1)
         out = []
         for stage in stages:
             for mbs in mbs_list:
               for remat in remats:
                 for fl in fused_opts:
+                  for md in moments:
                     tbs = mbs * self.dp_size
                     if tbs < self.cfg.min_train_batch_size:
                         continue
@@ -230,11 +244,13 @@ class Autotuner:
                             pm_opts = [max(d for d in range(1, mbs + 1)
                                            if mbs % d == 0)]
                         cands = [Candidate(stage, mbs, num_micro=pm,
-                                           remat=remat, fused_loss=fl)
+                                           remat=remat, fused_loss=fl,
+                                           moment_dtype=md)
                                  for pm in pm_opts]
                     else:
                         cands = [Candidate(stage, mbs, remat=remat,
-                                           fused_loss=fl)]
+                                           fused_loss=fl,
+                                           moment_dtype=md)]
                     for cand in cands:
                         if self.hbm is not None and \
                                 estimate_memory_per_device(

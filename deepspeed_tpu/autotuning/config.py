@@ -34,6 +34,9 @@ class AutotuningConfig(DeepSpeedConfigModel):
     remat_policies: Optional[List[str]] = None
     # chunked-LM-loss on/off (trades ~2 GB of logits memory for ~4% step)
     fused_lm_loss_options: Optional[List[bool]] = None
+    # Adam moment storage dtypes, e.g. [None, "bfloat16"] — bf16 halves
+    # optimizer-state memory (ops/optimizers.scale_by_adam_typed)
+    moment_dtypes: Optional[List[Optional[str]]] = None
 
 
 def get_autotuning_config(param_dict: dict) -> AutotuningConfig:

@@ -276,3 +276,31 @@ def test_memory_model_pipe_aware():
     full = estimate_memory_per_device(info, c, dp_size=1)
     piped = estimate_memory_per_device(info, c, dp_size=1, pipe_size=4)
     assert piped < full / 2, (piped, full)
+
+
+def test_moment_dtype_axis():
+    """moment_dtypes search axis: candidates carry the knob into ds_config
+    (optimizer.params.moment_dtype) and the memory model prices the 4
+    B/param moment saving — the knob that opened save_mlp on one chip
+    (docs/PERF_ANALYSIS.md round 3)."""
+    from deepspeed_tpu.autotuning import AutotuningConfig, Autotuner
+
+    cfg = AutotuningConfig(moment_dtypes=[None, "bfloat16"],
+                           zero_stages=[1], micro_batch_sizes=[4])
+    tuner = Autotuner(engine_factory=None, batch_factory=None,
+                      base_config={"train_batch_size": 4,
+                                   "optimizer": {"type": "adamw",
+                                                 "params": {"lr": 1e-3}}},
+                      model_info=INFO, dp_size=1, config=cfg)
+    cands = tuner.candidates()
+    keys = {c.key() for c in cands}
+    assert "z1_mbs4_gas1" in keys and "z1_mbs4_gas1_m[bfloat16]" in keys
+    bf = next(c for c in cands if c.moment_dtype == "bfloat16")
+    ds = bf.ds_config(tuner.base_config, 1)
+    assert ds["optimizer"]["params"]["moment_dtype"] == "bfloat16"
+    fp = next(c for c in cands if c.moment_dtype is None)
+    assert "moment_dtype" not in fp.ds_config(tuner.base_config, 1)[
+        "optimizer"]["params"]
+    assert (estimate_memory_per_device(INFO, bf, 1)
+            == estimate_memory_per_device(INFO, fp, 1)
+            - INFO.num_params * 4)

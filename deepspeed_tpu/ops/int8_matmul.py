@@ -59,19 +59,20 @@ def _use_interpret() -> bool:
 
 
 def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
-                block_k: int = 2048, block_n: int = 512,
+                block_k: int = 2048, block_n: int = 256,
                 out_dtype=None) -> jnp.ndarray:
     """y = (x * scale) @ q  for int8 q.
 
     x: [B, K] (B small — the decode shape), q: [K, N] int8, scale: [K].
 
-    Default blocking, measured on v5e decode (770M, in-situ A/B): the
+    Default blocking, measured on v5e decode (770M, in-situ A/Bs): the
     whole K dimension per grid step (each K-split pays an f32 accumulator
-    round-trip per N panel — K-split 512 ran 1.04x bf16) and NARROW N
-    panels (full-K x 512 → 479 tok/s vs x1024 → 327, x2048 → 357: smaller
-    panels mean more outstanding DMAs for the pipeline to overlap). VMEM
-    per grid step ≈ block_k·block_n·(1B int8 + 2B convert), double-
-    buffered — 2048x512 stays ~3 MB.
+    round-trip per N panel — K-split 512 ran 1.04x bf16) and NARROW
+    power-of-two N panels (same-session pairs: 256 beat 512 twice — 437
+    vs 415 and 318 vs 254 tok/s; 512 beat 1024/2048, and non-power
+    panels 384/640 regressed). Narrow panels give the Mosaic pipeline
+    more outstanding DMAs to overlap. VMEM per grid step ≈
+    block_k·block_n·(1B int8 + 2B convert), double-buffered.
     """
     B, K = x.shape
     Kq, N = q.shape

@@ -686,10 +686,16 @@ def autotune_main():
     if on_tpu:
         base_model_cfg = LlamaConfig(dtype=jnp.bfloat16, **BASE_770M_KWARGS)
         seq, steps = 512, 6
-        search = {"zero_stages": [1], "micro_batch_sizes": [8, 16, 24],
+        # exhaustive over the axes that matter (early stopping with the
+        # memory-cheapest-first candidate order would otherwise stop
+        # inside the small-batch tier before ever timing mbs=16 — the
+        # round-3 expanded grid hit exactly that)
+        search = {"zero_stages": [1], "micro_batch_sizes": [16, 24],
                   "remat_policies": ["block:nothing_saveable",
-                                     "mlp:save_mlp", "none"],
-                  "fused_lm_loss_options": [False, True],
+                                     "block:save_mlp", "none"],
+                  "fused_lm_loss_options": [False],
+                  "moment_dtypes": [None, "bfloat16"],
+                  "tuner_early_stopping": 100,
                   "start_profile_step": 2, "end_profile_step": 5}
         hbm = 15.75e9
     else:   # CPU smoke: tiny model, tiny search

@@ -184,6 +184,14 @@ class HybridEngineConfig(DeepSpeedConfigModel):
     release_inference_cache: bool = False
     pin_parameters: bool = True
     tp_gather_partition_size: int = 8
+    # TPU extension: rollout generation through the int8 weight-streaming
+    # decode kernel (inference quant.streaming) — the live training weights
+    # are rowwise-quantized INSIDE each compiled generate program, so the
+    # rollout policy is the int8-rounded actor (decode reads half the HBM
+    # bytes; the train path is untouched). Opt-in: rollouts then sample
+    # from a slightly perturbed policy — PPO's ratio clipping absorbs it,
+    # but measure before enabling for small models.
+    int8_streaming_rollout: bool = False
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

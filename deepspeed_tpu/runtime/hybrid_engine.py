@@ -130,11 +130,27 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._ensure_decode(B, T_cap + gen_capacity(max_new_tokens))
         decoder = self._decoder
         transform = self._decode_transform
+        params_fn, params_key = transform, \
+            "fused" if transform is not None else None
+        if self._config.hybrid_engine.int8_streaming_rollout:
+            # rollouts through the int8 weight-streaming kernel: the LIVE
+            # training weights are rowwise-quantized at the program top,
+            # so every decode matmul reads half the HBM bytes (inference
+            # quant.streaming; models/llama.quantize_fused_rowwise)
+            if transform is None:
+                raise NotImplementedError(
+                    "hybrid_engine.int8_streaming_rollout requires the "
+                    "fused Llama decode path (scan-stacked LlamaConfig)")
+            from deepspeed_tpu.models.llama import quantize_fused_rowwise
+
+            mcfg = self.model_cfg
+            params_fn = lambda p: quantize_fused_rowwise(transform(p), mcfg)
+            params_key = "fused-int8stream"
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache,
             lambda p, t, c, i, s: decoder.apply({"params": p}, t, c, i, s),
-            B, T_cap, max_new_tokens, params_fn=transform,
-            params_key="fused" if transform is not None else None)
+            B, T_cap, max_new_tokens, params_fn=params_fn,
+            params_key=params_key)
         if rng is None:
             rng = jax.random.PRNGKey(self.global_steps)
         eos = -1 if eos_token_id is None else int(eos_token_id)

@@ -276,10 +276,12 @@ def rlhf_main():
         c.update(extra or {})
         return c
 
+    int8_rollout = "--int8-rollout" in sys.argv
     actor = deepspeed_tpu.initialize(
         model=actor_model, model_config=cfg,
-        config=ds_cfg({"hybrid_engine": {"enabled": True,
-                                         "max_out_tokens": seq + gen_len}}),
+        config=ds_cfg({"hybrid_engine": {
+            "enabled": True, "max_out_tokens": seq + gen_len,
+            "int8_streaming_rollout": int8_rollout}}),
         loss_fn=make_actor_ppo_loss(actor_model), sample_batch=sample)
     critic = deepspeed_tpu.initialize(
         model=critic_model, config=ds_cfg(),
@@ -329,7 +331,8 @@ def rlhf_main():
 
     med = lambda xs: round(float(np.median(xs)), 3) if xs else 0.0
     print(json.dumps({
-        "metric": "llama770m_rlhf_e2e_tokens_per_sec",
+        "metric": "llama770m_rlhf_e2e_tokens_per_sec"
+                  + ("_int8roll" if int8_rollout else ""),
         "value": round(e2e_tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(e2e_tok_s / max(train_tok_s, 1e-6), 3),

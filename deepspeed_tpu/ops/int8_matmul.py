@@ -34,7 +34,7 @@ def quantize_rowwise(w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _kernel(x_ref, q_ref, o_ref, acc, *, nk: int):
-    ki = pl.program_id(1)
+    ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -81,9 +81,12 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
 
     xs = (x.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
 
+    # M-blocking keeps prefill shapes (batch x prompt rows) inside VMEM —
+    # decode (M<=8 after padding) stays one block
+    block_m = min(max(8, -(-B // 8) * 8), 512)
     block_k = min(block_k, K)
     block_n = min(block_n, N)
-    pad_b = (-B) % 8
+    pad_b = (-B) % block_m
     pad_k = (-K) % block_k
     pad_n = (-N) % block_n
     if pad_b or pad_k:
@@ -91,18 +94,19 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     if pad_k or pad_n:
         q = jnp.pad(q, ((0, pad_k), (0, pad_n)))
     Bp, Kp, Np = B + pad_b, K + pad_k, N + pad_n
-    nk, nn = Kp // block_k, Np // block_n
+    nm, nk, nn = Bp // block_m, Kp // block_k, Np // block_n
 
     out = pl.pallas_call(
         functools.partial(_kernel, nk=nk),
-        grid=(nn, nk),
+        grid=(nm, nn, nk),
         in_specs=[
-            pl.BlockSpec((Bp, block_k), lambda n, k: (0, k)),
-            pl.BlockSpec((block_k, block_n), lambda n, k: (k, n)),
+            pl.BlockSpec((block_m, block_k), lambda m, n, k: (m, k)),
+            pl.BlockSpec((block_k, block_n), lambda m, n, k: (k, n)),
         ],
-        out_specs=pl.BlockSpec((Bp, block_n), lambda n, k: (0, n)),
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda m, n, k: (m, n)),
         out_shape=jax.ShapeDtypeStruct((Bp, Np), out_dtype),
-        scratch_shapes=[pltpu.VMEM((Bp, block_n), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         interpret=_use_interpret(),
     )(xs, q)
     return out[:B, :N]

@@ -17,7 +17,7 @@ def test_rowwise_quant_roundtrip(rng):
                                atol=float(np.abs(np.asarray(w)).max()) / 100)
 
 
-@pytest.mark.parametrize("B,K,N", [(1, 128, 128), (4, 256, 192), (3, 100, 60)])
+@pytest.mark.parametrize("B,K,N", [(1, 128, 128), (4, 256, 192), (3, 100, 60), (1536, 256, 192)])
 def test_int8_matmul_matches_float(rng, B, K, N):
     x = jnp.asarray(rng.standard_normal((B, K)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)

@@ -126,6 +126,24 @@ class ResourceManager:
             self.experiment_count += 1
             exp.setdefault("num_nodes", 1)
             exp.setdefault("num_slots_per_node", 1)
+            # an unsatisfiable request would head-of-line-block run()
+            # forever at POLL_S — record it as failed instead of queueing.
+            # Feasibility is per node: enough nodes that can each grant
+            # the full per-node slot request (pools can be heterogeneous)
+            capable = sum(1 for n in self.nodes
+                          if n.max_slots >= exp["num_slots_per_node"])
+            if exp["num_nodes"] > capable:
+                logger.warning(
+                    f"autotuning scheduler: {exp['name']} requests "
+                    f"{exp['num_nodes']} node(s) x "
+                    f"{exp['num_slots_per_node']} slots but only {capable} "
+                    f"of {len(self.nodes)} node(s) have that many slots — "
+                    f"recording as failed")
+                exp["result_dir"] = os.path.join(self.results_dir,
+                                                 exp["name"])
+                self.finished_experiments[exp["exp_id"]] = (
+                    exp, "infeasible resource request for this pool")
+                continue
             result_dir = exp["result_dir"] = os.path.join(
                 self.results_dir, exp["name"])
             metric_file = os.path.join(result_dir, "metrics.json")

@@ -86,6 +86,17 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     block_m = min(max(8, -(-B // 8) * 8), 512)
     block_k = min(block_k, K)
     block_n = min(block_n, N)
+    if K % block_k:
+        # A K that the default cap doesn't divide (e.g. Llama-7B's 11008
+        # under block_k=2048) would force a jnp.pad of the int8 weight —
+        # traced into the decode loop, a fresh padded copy every step,
+        # exactly the HBM traffic the kernel exists to avoid. Prefer the
+        # largest 256-multiple divisor of K within the cap; only a K not
+        # divisible by 256 at all falls back to the pad.
+        for cand in range(block_k - block_k % 256, 0, -256):
+            if K % cand == 0:
+                block_k = cand
+                break
     pad_b = (-B) % block_m
     pad_k = (-K) % block_k
     pad_n = (-N) % block_n

@@ -61,6 +61,17 @@ def _moment_dtypes(params: Dict[str, Any]):
     return resolve("mu_dtype"), resolve("nu_dtype")
 
 
+def split3(outer_tree, out):
+    """Split a tree of (a, b, c) leaf tuples into three trees by treedef
+    transpose — structural, so param pytrees that legally contain tuple
+    containers are not mistaken for the leaf tuples."""
+    import jax
+
+    return jax.tree_util.tree_transpose(
+        jax.tree_util.tree_structure(outer_tree),
+        jax.tree_util.tree_structure((0, 0, 0)), out)
+
+
 def scale_by_adam_typed(b1: float, b2: float, eps: float,
                         mu_dtype=None, nu_dtype=None):
     """``optax.scale_by_adam`` with independently typed moments.
@@ -102,10 +113,8 @@ def scale_by_adam_typed(b1: float, b2: float, eps: float,
             return (step, m32.astype(m.dtype), v32.astype(v.dtype))
 
         out = jax.tree_util.tree_map(upd, grads, state.mu, state.nu)
-        pick = lambda i: jax.tree_util.tree_map(
-            lambda t: t[i], out, is_leaf=lambda x: isinstance(x, tuple))
-        return pick(0), optax.ScaleByAdamState(count=count, mu=pick(1),
-                                               nu=pick(2))
+        step, mu, nu = split3(grads, out)
+        return step, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init, update)
 

@@ -81,6 +81,16 @@ def validate_param_nvme_config(config, mesh) -> None:
             f"offload_param.device=nvme uses the per-group swapped Adam "
             f"step and supports Adam-family optimizers only "
             f"({'/'.join(ADAM_FAMILY)}); got {opt_name!r}")
+    opt_params = dict(opt.params) if opt is not None else {}
+    typed = [k for k in ("moment_dtype", "mu_dtype", "nu_dtype")
+             if opt_params.get(k) is not None
+             and str(opt_params[k]).lower() not in ("float32", "fp32")]
+    if typed:
+        raise NotImplementedError(
+            f"offload_param.device=nvme stores optimizer moments as fp32 "
+            f"swap files; optimizer.params {typed} would be silently "
+            f"ignored — unset them (moment precision is an HBM-residency "
+            f"knob; NVMe-tier moments never occupy HBM between steps)")
     if config.fp16.enabled:
         raise NotImplementedError(
             "offload_param.device=nvme does not support fp16 loss scaling; "
@@ -336,10 +346,10 @@ class NVMeParamTrainer:
                 return ((p.astype(jnp.float32) - lr * step).astype(p.dtype),
                         m, v)
 
+            from deepspeed_tpu.ops.optimizers import split3
+
             out = jax.tree_util.tree_map(upd, w, mu, nu, g)
-            pick = lambda i: jax.tree_util.tree_map(
-                lambda t3: t3[i], out, is_leaf=lambda x: isinstance(x, tuple))
-            return pick(0), pick(1), pick(2)
+            return split3(w, out)
 
         self._jit_emb_fwd = jax.jit(emb_fwd)
         self._jit_layer_fwd = jax.jit(layer_fwd)

@@ -1,6 +1,7 @@
 """Pallas int8 weight-streaming matmul vs float reference
 (reference tests/unit/ops quantizer/dequantize pattern)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -39,3 +40,24 @@ def test_int8_matmul_zero_rows(rng):
     x = jnp.asarray(rng.standard_normal((2, 32)), jnp.float32)
     out = int8_matmul(x, q, s, block_k=32, block_n=16)
     np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_block_k_divisor_avoids_traced_weight_pad(rng):
+    """ADVICE r3: a K the default block_k cap doesn't divide (Llama-7B's
+    11008 under 2048) must not trace a jnp.pad of the int8 weight into the
+    decode program — block_k drops to the largest 256-multiple divisor."""
+    K, N = 1280, 128        # 1280 % 512 != 0, 1280 % 256 == 0
+    x = jnp.asarray(rng.standard_normal((1, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+    q, s = quantize_rowwise(w)
+    jaxpr = jax.make_jaxpr(
+        lambda x_, q_, s_: int8_matmul(x_, q_, s_, block_k=512, block_n=128)
+    )(x, q, s)
+    int8_pads = [e for e in jaxpr.jaxpr.eqns
+                 if e.primitive.name == "pad"
+                 and e.outvars[0].aval.dtype == jnp.int8]
+    assert not int8_pads, int8_pads
+    got = int8_matmul(x, q, s, block_k=512, block_n=128)
+    want = x @ (q.astype(jnp.float32) * s[:, None])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)

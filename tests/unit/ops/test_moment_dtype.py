@@ -117,3 +117,24 @@ def test_engine_trains_with_bf16_moments():
     node = locate_adam_state(eng.opt_state)
     assert all(m.dtype == jnp.bfloat16
                for m in jax.tree_util.tree_leaves(node.mu))
+
+
+def test_typed_moments_tuple_container_pytree():
+    """ADVICE r3: param pytrees legally containing tuple CONTAINERS must not
+    be mistaken for the (step, mu, nu) leaf tuples (structural transpose,
+    not is_leaf sniffing)."""
+    params = {"pair": (jnp.ones((3,)), jnp.full((2,), 2.0)),
+              "solo": jnp.full((4,), 3.0)}
+    grads = jax.tree_util.tree_map(lambda p: 0.1 * jnp.ones_like(p), params)
+    opt = build_optimizer("adamw", {"lr": 1e-2, "moment_dtype": "bfloat16"})
+    state = opt.init(params)
+    updates, state = opt.update(grads, state, params)
+    assert jax.tree_util.tree_structure(updates) \
+        == jax.tree_util.tree_structure(params)
+    import optax
+
+    new_params = optax.apply_updates(params, updates)
+    # uniform grads on uniform params: every element strictly decreases
+    for leaf, old in zip(jax.tree_util.tree_leaves(new_params),
+                         jax.tree_util.tree_leaves(params)):
+        assert np.all(np.asarray(leaf) < np.asarray(old))

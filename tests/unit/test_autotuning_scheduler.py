@@ -161,3 +161,41 @@ def test_tune_with_scheduler_bridge(tmp_path):
     saved = json.load(open(tmp_path / "out" / "autotuning_results.json"))
     assert saved["best"] == "z3_mbs16_gas1"
     assert os.path.exists(tmp_path / "out" / "ds_config_optimal.json")
+
+
+def test_infeasible_request_recorded_not_queued(tmp_path):
+    """ADVICE r3: a request larger than the pool (more nodes than exist, or
+    more slots than any node has) must be recorded as failed at enqueue —
+    not head-of-line-block run() forever."""
+    log, lock = [], threading.Lock()
+    rm = ResourceManager({"a": 2, "b": 2}, str(tmp_path),
+                         exec_fn=_recording_exec(log, lock, duration=0.01))
+    rm.schedule_experiments([
+        {"name": "too_many_nodes", "num_nodes": 3, "ds_config": {}},
+        {"name": "too_many_slots", "num_slots_per_node": 4, "ds_config": {}},
+        {"name": "fits_7", "num_nodes": 2, "num_slots_per_node": 2,
+         "ds_config": {}},
+    ])
+    rm.run()    # must terminate
+    errs = {exp["name"]: err
+            for exp, err in rm.finished_experiments.values()}
+    assert errs["too_many_nodes"] and "infeasible" in errs["too_many_nodes"]
+    assert errs["too_many_slots"] and "infeasible" in errs["too_many_slots"]
+    assert errs["fits_7"] is None
+    with lock:
+        assert sorted({e[1] for e in log}) == ["fits_7"]
+
+
+def test_heterogeneous_pool_per_node_feasibility(tmp_path):
+    """2 slots exist on node a but node b only has 1: a 2-node x 2-slot
+    request can never be granted and must be recorded as failed."""
+    log, lock = [], threading.Lock()
+    rm = ResourceManager({"a": 4, "b": 1}, str(tmp_path),
+                         exec_fn=_recording_exec(log, lock, duration=0.01))
+    rm.schedule_experiments([
+        {"name": "hetero_0", "num_nodes": 2, "num_slots_per_node": 2,
+         "ds_config": {}},
+    ])
+    rm.run()    # must terminate
+    (_, err), = rm.finished_experiments.values()
+    assert err and "infeasible" in err

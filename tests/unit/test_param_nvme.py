@@ -234,3 +234,13 @@ def test_fp16_and_custom_loss_raise(tmp_path):
             model=_model(), config=cfg2,
             loss_fn=lambda p, b, rngs=None: jnp.zeros(()),
             sample_batch=_batches(0, 1)[0])
+
+
+def test_moment_dtype_raises_under_nvme(tmp_path):
+    """ADVICE r3: NVMe-tier moments are fp32 swap files; a configured
+    moment_dtype must raise instead of being silently ignored."""
+    cfg = _nvme_config(tmp_path)
+    cfg["optimizer"]["params"]["moment_dtype"] = "bfloat16"
+    with pytest.raises(NotImplementedError, match="moment"):
+        deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                 sample_batch=_batches(0, 1)[0])

@@ -126,6 +126,20 @@ class ResourceManager:
             self.experiment_count += 1
             exp.setdefault("num_nodes", 1)
             exp.setdefault("num_slots_per_node", 1)
+            result_dir = exp["result_dir"] = os.path.join(
+                self.results_dir, exp["name"])
+            metric_file = os.path.join(result_dir, "metrics.json")
+            exp["ds_config"] = dict(exp.get("ds_config", {}))
+            at = dict(exp["ds_config"].get("autotuning", {}))
+            at["metric_path"] = metric_file
+            exp["ds_config"]["autotuning"] = at
+            if os.path.exists(metric_file):
+                # resume wins over feasibility: results recorded on a larger
+                # pool stay valid when the search resumes on a smaller one
+                logger.info(f"autotuning scheduler: skipping {exp['name']} "
+                            f"(results exist)")
+                self.finished_experiments[exp["exp_id"]] = (exp, None)
+                continue
             # an unsatisfiable request would head-of-line-block run()
             # forever at POLL_S — record it as failed instead of queueing.
             # Feasibility is per node: enough nodes that can each grant
@@ -139,22 +153,8 @@ class ResourceManager:
                     f"{exp['num_slots_per_node']} slots but only {capable} "
                     f"of {len(self.nodes)} node(s) have that many slots — "
                     f"recording as failed")
-                exp["result_dir"] = os.path.join(self.results_dir,
-                                                 exp["name"])
                 self.finished_experiments[exp["exp_id"]] = (
                     exp, "infeasible resource request for this pool")
-                continue
-            result_dir = exp["result_dir"] = os.path.join(
-                self.results_dir, exp["name"])
-            metric_file = os.path.join(result_dir, "metrics.json")
-            exp["ds_config"] = dict(exp.get("ds_config", {}))
-            at = dict(exp["ds_config"].get("autotuning", {}))
-            at["metric_path"] = metric_file
-            exp["ds_config"]["autotuning"] = at
-            if os.path.exists(metric_file):
-                logger.info(f"autotuning scheduler: skipping {exp['name']} "
-                            f"(results exist)")
-                self.finished_experiments[exp["exp_id"]] = (exp, None)
                 continue
             self.experiment_queue.append(exp)
 

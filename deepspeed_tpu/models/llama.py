@@ -231,6 +231,15 @@ class LlamaModel(nn.Module):
                               param_dtype=jnp.float32, name="lm_head")(x)
         return logits.astype(jnp.float32)
 
+    def streamed_twin(self, stream_shardings):
+        """Scanned-model streaming protocol (engine
+        ``_setup_param_streaming``): the stacked-scan streamed apply-twin,
+        or None when the model is not scanned (per-layer named params have
+        no stacked tree to stream — use scan_layers=True)."""
+        if not self.cfg.scan_layers:
+            return None
+        return StreamedLlamaModel(self.cfg, stream_shardings)
+
 
 class LlamaDecoderModel(nn.Module):
     """Decode-mode twin of LlamaModel: same parameter tree, takes and returns

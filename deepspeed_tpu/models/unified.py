@@ -227,6 +227,127 @@ class UnifiedBlock(nn.Module):
         return out
 
 
+class StreamedTransformerLM:
+    """Apply-twin of :class:`TransformerLM` that streams host-resident
+    parameters into device memory at each submodule's point of use — the
+    MODEL-AGNOSTIC ZeRO-3 parameter-offload compute path (reference
+    ``runtime/zero/parameter_offload.py:201``'s fetch/release hooks work on
+    any ``nn.Module``; this twin gives the same generality to every
+    architecture the 13 injection policies produce, including MoE layers).
+
+    Unlike :class:`~deepspeed_tpu.models.llama.StreamedLlamaModel` (stacked
+    ``lax.scan`` over homogeneous blocks), the unified model's layers are
+    heterogeneous (per-layer attention windows, interleaved MoE), so the
+    fetch is an explicit per-layer ``jax.device_put`` of ``layer_{i}``'s
+    subtree inside an unrolled loop: each layer's weights become device-
+    resident at their first use and XLA frees them after their last, so
+    peak HBM holds ONE layer's weights (+ activations), never the tree.
+
+    Math parity: every submodule is applied through the REAL flax modules
+    (``UnifiedBlock.apply``, ``nn.Embed``, ``_norm``, ``nn.Dense``) on the
+    streamed subtrees, so outputs are bit-identical to
+    ``TransformerLM.apply`` on the same weights
+    (tests/unit/test_param_offload.py).
+    """
+
+    def __init__(self, cfg: TransformerConfig, stream_shardings: Any):
+        self.cfg = cfg
+        self._shardings = stream_shardings
+
+    def _stream(self, params, key):
+        return jax.tree_util.tree_map(
+            lambda w, sh: jax.device_put(w, sh),
+            params[key], self._shardings[key])
+
+    def apply(self, variables, input_ids, positions=None,
+              attention_mask=None, token_type_ids=None, rngs=None,
+              return_hidden=False):
+        params = variables["params"]
+        cfg = self.cfg
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wte")
+        wte_p = self._stream(params, "wte")
+        x = wte.apply({"params": wte_p}, input_ids)
+        if positions is None:
+            if cfg.pos_from_mask and attention_mask is not None:
+                am = attention_mask.astype(jnp.int32)
+                positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0, None)
+            else:
+                positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(
+                    B, axis=0)
+        if cfg.pos_emb == "learned":
+            wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=jnp.float32,
+                           name="wpe")
+            x = x + wpe.apply({"params": self._stream(params, "wpe")},
+                              positions + cfg.pos_offset)
+        if cfg.token_type_vocab:
+            tte = nn.Embed(cfg.token_type_vocab, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=jnp.float32,
+                           name="wtte")
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + tte.apply({"params": self._stream(params, "wtte")},
+                              token_type_ids)
+        if cfg.embed_ln or not cfg.pre_ln:
+            x = _norm(cfg, "ln_emb").apply(
+                {"params": self._stream(params, "ln_emb")}, x)
+
+        if cfg.causal:
+            base_mask = make_causal_mask(S)
+        else:
+            base_mask = jnp.zeros((1, 1, S, S), dtype=jnp.float32)
+        if attention_mask is not None:
+            pad = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                            0.0, jnp.finfo(jnp.float32).min)
+            base_mask = base_mask + pad
+        if cfg.pos_emb == "alibi":
+            base_mask = base_mask + alibi_bias(cfg.num_heads, S, S)
+
+        for i in range(cfg.num_layers):
+            mask = base_mask
+            if cfg.attn_windows is not None and cfg.attn_windows[i]:
+                mask = mask + _window_mask(S, cfg.attn_windows[i])
+            block = UnifiedBlock(cfg, layer_idx=i)
+            sh = self._shardings[f"layer_{i}"]
+
+            def body(h, w_host, block=block, mask=mask, sh=sh):
+                # fetch INSIDE the (possibly rematerialized) body: the host
+                # tree is the saved residual, and backward re-fetches the
+                # device copy instead of keeping every layer HBM-resident
+                w = jax.tree_util.tree_map(
+                    lambda a, s: jax.device_put(a, s), w_host, sh)
+                return block.apply({"params": w}, h, mask, positions,
+                                   rngs=rngs)
+
+            if cfg.remat:
+                body = jax.checkpoint(body)
+            x = body(x, params[f"layer_{i}"])
+
+        if cfg.final_norm:
+            x = _norm(cfg, "ln_f").apply(
+                {"params": self._stream(params, "ln_f")}, x)
+        if return_hidden or not cfg.lm_head:
+            return x if return_hidden else x.astype(jnp.float32)
+        if cfg.tie_embeddings:
+            logits = wte.apply({"params": wte_p}, x.astype(jnp.float32),
+                               method="attend")
+        else:
+            head = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                            dtype=cfg.dtype, param_dtype=jnp.float32,
+                            name="lm_head")
+            logits = head.apply(
+                {"params": self._stream(params, "lm_head")}, x)
+        return logits.astype(jnp.float32)
+
+    def lm_kernel(self, params):
+        """Device-resident [H, V] head kernel for the chunked LM loss."""
+        if self.cfg.tie_embeddings:
+            return self._stream(params, "wte")["embedding"].T
+        return self._stream(params, "lm_head")["kernel"]
+
+
 def _window_mask(seq_len: int, window: int) -> jnp.ndarray:
     """Additive causal mask restricted to a local window (GPT-Neo local attn)."""
     i = jnp.arange(seq_len)[:, None]
@@ -302,6 +423,13 @@ class TransformerLM(nn.Module):
                               dtype=cfg.dtype, param_dtype=jnp.float32,
                               name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+    def streamed_twin(self, stream_shardings):
+        """Scanned-model streaming protocol (engine
+        ``_setup_param_streaming``): an apply-twin that fetches host-
+        resident params per submodule — ZeRO-3 parameter offload for every
+        policy architecture, MoE layers included."""
+        return StreamedTransformerLM(self.cfg, stream_shardings)
 
 
 class TransformerDecoderModel(nn.Module):

@@ -75,14 +75,22 @@ def _default_lm_loss(module, fused: bool = False,
     from deepspeed_tpu.ops.fused_losses import chunked_lm_xent
 
     if fused:
-        if isinstance(module, (LlamaModel, StreamedLlamaModel)):
+        mcfg = getattr(module, "cfg", None)
+        # any module exposing return_hidden + lm_kernel qualifies (both
+        # streamed twins); plain LlamaModel derives the kernel from params.
+        # A biased or absent head cannot ride the bias-free chunked matmul.
+        chunkable = isinstance(module, (LlamaModel, StreamedLlamaModel)) or (
+            hasattr(module, "lm_kernel")
+            and getattr(mcfg, "lm_head", True)
+            and not getattr(mcfg, "lm_head_bias", False))
+        if chunkable:
             tied = module.cfg.tie_embeddings
 
             def fn(params, batch, rngs=None):
                 h = module.apply({"params": params}, batch["input_ids"],
                                  positions=batch.get("positions"), rngs=rngs,
                                  return_hidden=True)
-                if isinstance(module, StreamedLlamaModel):
+                if hasattr(module, "lm_kernel"):
                     # host-resident weights: the head kernel must be
                     # fetched to device before the chunked matmul
                     kernel = module.lm_kernel(params)
@@ -93,10 +101,14 @@ def _default_lm_loss(module, fused: bool = False,
                                        chunk_size=chunk_size)
 
             return fn
+        why = ("its lm_head carries a bias the chunked matmul would drop"
+               if getattr(mcfg, "lm_head_bias", False)
+               else "it has no LM head" if not getattr(mcfg, "lm_head", True)
+               else "it does not expose return_hidden/lm_kernel")
         logger.warning(
-            "fused_lm_loss is enabled but %s does not expose return_hidden; "
-            "falling back to the full-logits loss (the [B, S, V] fp32 "
-            "logits WILL be materialized)", type(module).__name__)
+            "fused_lm_loss is enabled but %s cannot use the chunked loss "
+            "(%s); falling back to the full-logits loss (the [B, S, V] "
+            "fp32 logits WILL be materialized)", type(module).__name__, why)
 
     def fn(params, batch, rngs=None):
         logits = module.apply({"params": params}, batch["input_ids"],
@@ -441,28 +453,41 @@ class DeepSpeedEngine:
 
     def _setup_param_streaming(self, model, user_loss_fn):
         """ZeRO-3 parameter offload compute path (reference
-        parameter_offload.py:201 fetch/release hooks → explicit per-layer
-        device_put inside the scan): scan-layers LlamaModel streams one
-        layer's weights at a time; any other model/loss falls back to one
-        whole-tree fetch at program entry (params stay out of HBM *between*
-        steps only)."""
-        from deepspeed_tpu.models.llama import LlamaModel, StreamedLlamaModel
-
-        if (user_loss_fn is None and isinstance(model, LlamaModel)
-                and model.cfg.scan_layers):
-            streamed = StreamedLlamaModel(model.cfg,
-                                          self._offload_stream_shardings())
+        parameter_offload.py:201 fetch/release hooks work on ANY nn.Module
+        → here the model-side ``streamed_twin`` protocol): a model exposing
+        ``streamed_twin(stream_shardings)`` (scan-layers LlamaModel, the
+        unified TransformerLM across all policy archs incl. MoE layers)
+        streams one layer's weights at a time. Models without a twin (or a
+        custom loss) RAISE — the whole-tree fallback re-materializes the
+        full parameter set in HBM each step, forfeiting exactly the
+        capacity the feature exists for — unless the user opts in with
+        ``offload_param.fallback_whole_tree: true``."""
+        twin_fn = getattr(model, "streamed_twin", None)
+        streamed = (twin_fn(self._offload_stream_shardings())
+                    if user_loss_fn is None and twin_fn is not None else None)
+        if streamed is not None:
             self._streamed_module = streamed
             self.loss_fn = _default_lm_loss(
                 streamed, fused=self._config.fused_lm_loss_enabled,
                 chunk_size=self._config.fused_lm_loss_chunk)
             return
+        why = ("a custom loss_fn owns the forward" if user_loss_fn is not None
+               else f"{type(model).__name__} exposes no streamed_twin"
+               + ("" if twin_fn is None else
+                  " for this config (scan_layers=False?)"))
+        if not self._config.zero_config.offload_param.fallback_whole_tree:
+            raise NotImplementedError(
+                f"offload_param.device=cpu cannot stream per-layer: {why}. "
+                f"Streaming needs the scanned-model protocol "
+                f"(model.streamed_twin + the engine's default LM loss). "
+                f"Set zero_optimization.offload_param.fallback_whole_tree: "
+                f"true to accept the degraded whole-tree fetch, where HBM "
+                f"transiently holds the FULL parameter set during fwd/bwd "
+                f"(params stay host-resident between steps only)")
         logger.warning(
-            "offload_param: %s with a %s loss is not the scanned-Llama "
-            "path — parameters stream as ONE block per step, so HBM "
-            "transiently holds the full parameter set during fwd/bwd",
-            type(model).__name__,
-            "custom" if user_loss_fn is not None else "default")
+            "offload_param: %s — parameters stream as ONE block per step "
+            "(fallback_whole_tree), so HBM transiently holds the full "
+            "parameter set during fwd/bwd", why)
         base = self.loss_fn
         dev_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), self.zero_plan.param_specs,

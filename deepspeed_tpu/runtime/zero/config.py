@@ -38,6 +38,11 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     buffer_size: int = Field(100_000_000, ge=0)
     max_in_cpu: int = Field(1_000_000_000, ge=0)
     pin_memory: bool = False
+    # accept the whole-tree fetch for models without a streamed twin (the
+    # full parameter set transiently re-materializes in HBM each step,
+    # forfeiting the capacity the offload exists for) — without this flag
+    # such models RAISE instead of silently degrading
+    fallback_whole_tree: bool = False
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

@@ -199,3 +199,26 @@ def test_heterogeneous_pool_per_node_feasibility(tmp_path):
     rm.run()    # must terminate
     (_, err), = rm.finished_experiments.values()
     assert err and "infeasible" in err
+
+
+def test_resume_wins_over_feasibility(tmp_path):
+    """Results recorded on a larger pool stay valid when the search resumes
+    on a smaller pool: the finished experiment is adopted, not re-recorded
+    as infeasible."""
+    log, lock = [], threading.Lock()
+    exps = [{"name": "big_9", "num_nodes": 4, "ds_config": {}}]
+    rm1 = ResourceManager({f"n{i}": 1 for i in range(4)}, str(tmp_path),
+                          exec_fn=_recording_exec(log, lock, duration=0.01))
+    rm1.schedule_experiments([dict(e) for e in exps])
+    rm1.run()
+    (_, err), = rm1.finished_experiments.values()
+    assert err is None
+
+    rm2 = ResourceManager({"n0": 1, "n1": 1}, str(tmp_path),
+                          exec_fn=_recording_exec(log, lock, duration=0.01))
+    rm2.schedule_experiments([dict(e) for e in exps])
+    rm2.run()
+    (exp, err), = rm2.finished_experiments.values()
+    assert err is None, err
+    best, v = rm2.parse_results()
+    assert best is not None and v == 9.0

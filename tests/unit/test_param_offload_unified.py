@@ -1,0 +1,203 @@
+"""Model-agnostic ZeRO-3 parameter offload (VERDICT r3 #4).
+
+The reference's fetch/release hooks work on ANY ``nn.Module``
+(``runtime/zero/parameter_offload.py:201``); round 3's streaming was
+isinstance-gated to scanned-Llama. These tests pin the generalization:
+
+- ``StreamedTransformerLM.apply`` is bit-identical to ``TransformerLM.apply``
+  across the policy architecture space (rotary/alibi/learned positions,
+  pre/post-LN, parallel attention, GQA, local windows, MoE layers)
+- the engine streams a unified model under ``offload_param: cpu`` (params
+  pinned-host, per-layer fetch, trajectory parity vs the in-HBM stage-3
+  engine), MoE included
+- models with no streamed twin RAISE unless ``fallback_whole_tree: true``
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.unified import (
+    StreamedTransformerLM, TransformerConfig, TransformerLM,
+)
+
+ARCHS = {
+    "gpt2ish": dict(pos_emb="learned", activation="gelu_new",
+                    tie_embeddings=True),
+    "llamaish": dict(pos_emb="rotary", norm="rmsnorm", gated_mlp=True,
+                     activation="silu", attn_bias=False, mlp_bias=False,
+                     tie_embeddings=False, num_kv_heads=2),
+    "bloomish": dict(pos_emb="alibi", embed_ln=True),
+    "gptjish": dict(pos_emb="rotary", rotary_dim=8, rotary_interleaved=True,
+                    parallel_attn=True, tie_embeddings=False,
+                    lm_head_bias=True),
+    "neoxish": dict(pos_emb="rotary", parallel_attn=True,
+                    parallel_shared_ln=False),
+    "bertish": dict(pos_emb="learned", pre_ln=False, causal=False,
+                    token_type_vocab=2, lm_head=False),
+    "neoish": dict(pos_emb="learned", attn_windows=(None, 8), attn_scale=1.0),
+    "moe": dict(pos_emb="rotary", norm="rmsnorm", gated_mlp=True,
+                activation="silu", moe_num_experts=4, moe_top_k=2,
+                moe_layer_freq=2, tie_embeddings=False),
+    "remat": dict(pos_emb="rotary", gated_mlp=True, activation="silu",
+                  remat=True, tie_embeddings=False),
+}
+
+
+def _cfg(name):
+    return TransformerConfig.tiny(vocab_size=64, hidden_size=32,
+                                  num_layers=2, num_heads=4, max_seq_len=32,
+                                  **ARCHS[name])
+
+
+def _replicated_shardings(params):
+    from jax.sharding import NamedSharding, PartitionSpec
+    from deepspeed_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(dims={"pipe": 1, "data": 8, "expert": 1,
+                           "sequence": 1, "tensor": 1})
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(lambda _: rep, params)
+
+
+@pytest.mark.parametrize("arch", sorted(ARCHS))
+def test_streamed_unified_matches_plain(arch):
+    cfg = _cfg(arch)
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    streamed = model.streamed_twin(_replicated_shardings(params))
+    assert isinstance(streamed, StreamedTransformerLM)
+    ref = model.apply({"params": params}, ids)
+    # same flax modules applied in the same order: eager output is
+    # bit-identical; under jit XLA may reorder float ops, so compare tight
+    got = streamed.apply({"params": params}, ids)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    jitted = jax.jit(lambda p, i: streamed.apply({"params": p}, i))(params, ids)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_streamed_unified_attention_mask_and_token_types():
+    """The twin reproduces the mask/token-type paths (OPT positions from
+    mask, BERT token types) bit-for-bit too."""
+    cfg = TransformerConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                                 num_heads=4, max_seq_len=32,
+                                 pos_emb="learned", pos_from_mask=True,
+                                 pos_offset=2, token_type_vocab=2)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 64, (2, 12)))
+    am = jnp.asarray((rng.random((2, 12)) > 0.3).astype(np.int32))
+    tt = jnp.asarray(rng.integers(0, 2, (2, 12)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    streamed = model.streamed_twin(_replicated_shardings(params))
+    ref = model.apply({"params": params}, ids, attention_mask=am,
+                      token_type_ids=tt)
+    got = streamed.apply({"params": params}, ids, attention_mask=am,
+                         token_type_ids=tt)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def _batch(rng, bs=8, seq=16, vocab=64):
+    t = rng.integers(0, vocab, (bs, seq + 1))
+    return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+
+def _offload_config(stage=3, fallback=False):
+    zero = {"stage": stage, "sub_group_size": 4000,
+            "offload_param": {"device": "cpu"},
+            "offload_optimizer": {"device": "cpu"}}
+    if fallback:
+        zero["offload_param"]["fallback_whole_tree"] = True
+    return {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": False},
+        "zero_optimization": zero,
+    }
+
+
+@pytest.mark.parametrize("arch", ["gpt2ish", "moe"])
+def test_engine_streams_unified_model(arch):
+    """offload_param=cpu on a unified model (incl. MoE layers): params live
+    pinned-host, the per-layer streamed loss is in effect, training follows
+    the in-HBM stage-3 engine's trajectory."""
+    model = TransformerLM(_cfg(arch))
+    sb = _batch(np.random.default_rng(0))
+    e_off = deepspeed_tpu.initialize(model=model,
+                                     config=_offload_config(),
+                                     sample_batch=sb)
+    assert isinstance(e_off._streamed_module, StreamedTransformerLM)
+    assert e_off.loss_fn.__name__ != "fetched_loss"
+    kinds = {l.sharding.memory_kind
+             for l in jax.tree_util.tree_leaves(e_off.params)}
+    assert kinds == {"pinned_host"}, kinds
+
+    cfg_ref = _offload_config()
+    cfg_ref["zero_optimization"] = {"stage": 3}
+    e_ref = deepspeed_tpu.initialize(model=model, config=cfg_ref,
+                                     sample_batch=sb)
+    for i in range(4):
+        b = _batch(np.random.default_rng(100 + i))
+        l_off = float(e_off.train_batch(b))
+        l_ref = float(e_ref.train_batch(b))
+        np.testing.assert_allclose(l_off, l_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_streams_unified_remat():
+    """remat composes: the host tree is the saved residual and backward
+    re-fetches per layer (loss still decreases)."""
+    model = TransformerLM(_cfg("remat"))
+    e = deepspeed_tpu.initialize(model=model, config=_offload_config(),
+                                 sample_batch=_batch(np.random.default_rng(0)))
+    b = _batch(np.random.default_rng(0))
+    losses = [float(e.train_batch(b)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_unscanned_llama_raises_without_flag():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32,
+                                        scan_layers=False))
+    sb = _batch(np.random.default_rng(0), vocab=256)
+    with pytest.raises(NotImplementedError, match="fallback_whole_tree"):
+        deepspeed_tpu.initialize(model=model, config=_offload_config(),
+                                 sample_batch=sb)
+    e = deepspeed_tpu.initialize(model=model,
+                                 config=_offload_config(fallback=True),
+                                 sample_batch=sb)
+    losses = [float(e.train_batch(_batch(np.random.default_rng(0),
+                                         vocab=256))) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_fused_loss_chunks_for_streamed_unified():
+    """fused_lm_loss engages for the streamed unified twin (return_hidden +
+    lm_kernel protocol) and training converges; a biased head correctly
+    falls back to the full-logits loss (the chunked matmul is bias-free)."""
+    cfg = _offload_config()
+    cfg["fused_lm_loss"] = {"enabled": True, "chunk_size": 8}
+    model = TransformerLM(_cfg("llamaish"))
+    e = deepspeed_tpu.initialize(
+        model=model, config=cfg,
+        sample_batch=_batch(np.random.default_rng(0)))
+    names = (e.loss_fn.__code__.co_names
+             + e.loss_fn.__code__.co_freevars)
+    assert "chunked_lm_xent" in names and "lm_kernel" in names, names
+    b = _batch(np.random.default_rng(0))
+    losses = [float(e.train_batch(b)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+    biased = TransformerLM(_cfg("gptjish"))     # lm_head_bias=True
+    e2 = deepspeed_tpu.initialize(
+        model=biased, config=cfg,
+        sample_batch=_batch(np.random.default_rng(0)))
+    assert "chunked_lm_xent" not in (e2.loss_fn.__code__.co_names
+                                     + e2.loss_fn.__code__.co_freevars)
+    assert float(e2.train_batch(_batch(np.random.default_rng(0)))) > 0

@@ -1,0 +1,104 @@
+"""Generate an HF-Llama-shaped safetensors checkpoint with random weights.
+
+The 7B-scale artifacts (BENCH p50 TTFT / tok/s at the BASELINE.json metric
+scale) need a real ~13 GB sharded checkpoint to stream-convert; this
+environment has no network egress, so the weights are random — decode and
+conversion throughput do not depend on the values, only on shapes/dtypes.
+Layout matches `meta-llama/Llama-2-7b-hf`: sharded `model-XXXXX-of-XXXXX.
+safetensors` + `model.safetensors.index.json` + `config.json`, bf16.
+
+Usage: python tools/make_hf_llama_ckpt.py OUT_DIR [--size 7b|tiny]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import ml_dtypes
+import numpy as np
+
+SIZES = {
+    # hidden, intermediate, layers, heads, kv_heads, vocab
+    "7b": (4096, 11008, 32, 32, 32, 32000),
+    "1b3": (2048, 5504, 24, 16, 16, 32000),
+    "tiny": (64, 176, 2, 4, 4, 256),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--size", default="7b", choices=sorted(SIZES))
+    ap.add_argument("--layers-per-shard", type=int, default=4)
+    args = ap.parse_args()
+    H, F, L, NH, NKV, V = SIZES[args.size]
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    rng = np.random.default_rng(7)
+
+    def tensor(*shape, scale=0.02):
+        a = rng.standard_normal(int(np.prod(shape)), dtype=np.float32)
+        return (a.reshape(shape) * scale).astype(ml_dtypes.bfloat16)
+
+    def layer_tensors(i):
+        b = f"model.layers.{i}"
+        kvh = H * NKV // NH
+        return {
+            f"{b}.self_attn.q_proj.weight": tensor(H, H),
+            f"{b}.self_attn.k_proj.weight": tensor(kvh, H),
+            f"{b}.self_attn.v_proj.weight": tensor(kvh, H),
+            f"{b}.self_attn.o_proj.weight": tensor(H, H),
+            f"{b}.mlp.gate_proj.weight": tensor(F, H),
+            f"{b}.mlp.up_proj.weight": tensor(F, H),
+            f"{b}.mlp.down_proj.weight": tensor(H, F),
+            f"{b}.input_layernorm.weight": np.ones(H, ml_dtypes.bfloat16),
+            f"{b}.post_attention_layernorm.weight":
+                np.ones(H, ml_dtypes.bfloat16),
+        }
+
+    from safetensors.numpy import save_file
+
+    groups = []                       # list of dicts of key -> tensor fn
+    groups.append(lambda: {"model.embed_tokens.weight": tensor(V, H)})
+    for lo in range(0, L, args.layers_per_shard):
+        hi = min(lo + args.layers_per_shard, L)
+        groups.append(lambda lo=lo, hi=hi: {
+            k: v for i in range(lo, hi) for k, v in layer_tensors(i).items()})
+    groups.append(lambda: {"model.norm.weight": np.ones(H, ml_dtypes.bfloat16),
+                           "lm_head.weight": tensor(V, H)})
+
+    n = len(groups)
+    weight_map, total = {}, 0
+    for gi, make in enumerate(groups):
+        tensors = make()
+        fname = f"model-{gi + 1:05d}-of-{n:05d}.safetensors"
+        save_file(tensors, os.path.join(args.out_dir, fname))
+        for k, v in tensors.items():
+            weight_map[k] = fname
+            total += v.nbytes
+        del tensors
+        print(f"  shard {gi + 1}/{n} written", file=sys.stderr, flush=True)
+
+    with open(os.path.join(args.out_dir,
+                           "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {"total_size": total},
+                   "weight_map": weight_map}, f)
+    with open(os.path.join(args.out_dir, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "hidden_size": H, "intermediate_size": F,
+            "num_hidden_layers": L, "num_attention_heads": NH,
+            "num_key_value_heads": NKV, "vocab_size": V,
+            "max_position_embeddings": 4096, "rms_norm_eps": 1e-5,
+            "rope_theta": 10000.0, "tie_word_embeddings": False,
+            "torch_dtype": "bfloat16",
+            "bos_token_id": 1, "eos_token_id": 2,
+        }, f, indent=1)
+    print(json.dumps({"out_dir": args.out_dir, "bytes": total,
+                      "params": total // 2, "shards": n}))
+
+
+if __name__ == "__main__":
+    main()

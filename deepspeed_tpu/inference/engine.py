@@ -216,11 +216,31 @@ class InferenceEngine:
         # it (safetensors shards load tensor-by-tensor — the reference's
         # meta-tensor + SDLoader path, inference/engine.py:331-443)
         if isinstance(model, str):
-            from deepspeed_tpu.module_inject.replace_module import (
-                convert_hf_model,
-            )
+            if params is not None:
+                # explicit params win; don't silently convert (and possibly
+                # quantize) a multi-GB checkpoint just to discard the result
+                raise ValueError(
+                    "init_inference got BOTH a checkpoint directory and an "
+                    "explicit params tree — pass one or the other")
+            if self._config.quant.enabled and self._config.quant.streaming:
+                # int8-streaming serving of a Llama checkpoint: quantize
+                # offline on the host (bounded RSS) so the device only ever
+                # holds the int8 tree — at 7B the bf16 tree and its int8
+                # copy cannot coexist in HBM
+                from deepspeed_tpu.inference.offline_quant import (
+                    quantize_hf_llama_checkpoint,
+                )
 
-            model = convert_hf_model(checkpoint_dir=model)
+                mcfg, qparams = quantize_hf_llama_checkpoint(model)
+                model_config = model_config or mcfg
+                params = qparams if params is None else params
+                model = None
+            else:
+                from deepspeed_tpu.module_inject.replace_module import (
+                    convert_hf_model,
+                )
+
+                model = convert_hf_model(checkpoint_dir=model)
         # An InjectedModel (module_inject.convert_hf_model) bundles the flax
         # module, converted params, and unified config — unpack it so
         # ``init_inference(model=convert_hf_model(hf_model))`` just works
@@ -274,8 +294,17 @@ class InferenceEngine:
         # HBM bytes per step; dequant fuses into the consuming matmul
         self._quantized = None
         self._quant_streaming = False
+        self._pre_quantized = self._is_prequantized_stream(self.params)
+        self._pre_fused = self._is_prefused(self.params)
+        if self._pre_quantized and not (self._config.quant.enabled
+                                        and self._config.quant.streaming):
+            raise ValueError(
+                "params are a pre-quantized fused int8 tree "
+                "(inference/offline_quant.py) but the config does not set "
+                "quant: {enabled: true, streaming: true} — refusing to "
+                "guess; the tree only runs through the int8 streaming "
+                "decode path")
         if self._config.quant.enabled:
-            self._quantize_params()
             if self._config.quant.streaming:
                 from deepspeed_tpu.models.llama import LlamaConfig
 
@@ -290,6 +319,21 @@ class InferenceEngine:
                         "path (a scan-stacked LlamaConfig model); "
                         f"got {type(self.model_config).__name__}")
                 self._quant_streaming = True
+            if self._pre_quantized:
+                # offline-quantized checkpoint: weights arrive int8; there
+                # is nothing to (re)quantize and the generation program
+                # must not fuse/dequantize at its top either
+                self._quantized = True
+            elif self._pre_fused and self._config.quant.streaming:
+                # pre-fused dense tree + streaming: the rowwise in-graph
+                # quantization at the program top consumes the fused tree
+                # directly (the group quantizer would mangle its layout).
+                # Note both copies transiently coexist on device — at
+                # scales where that cannot fit, quantize offline instead
+                # (inference/offline_quant.quantize_hf_llama_checkpoint)
+                self._quantized = True
+            else:
+                self._quantize_params()
         self._model_times: List[float] = []
         self._profile_model_time = False
         log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}"
@@ -312,9 +356,14 @@ class InferenceEngine:
         bits = self._config.quant.bits
         group_size = max(self._config.quant.group_size, 1)
 
+        # matmul weights by leaf name: flax "kernel" plus the pre-fused
+        # decode layout's stacked matmul leaves (fuse_decode_params)
+        matmul_names = {"kernel", "qkv_proj", "o_proj", "gateup_proj",
+                        "down_proj"}
+
         def quant(path, p):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-            if p.ndim >= 2 and name == "kernel" and p.size > 1 << 16:
+            if p.ndim >= 2 and name in matmul_names and p.size > 1 << 16:
                 n_groups = max(1, p.size // group_size)
                 while p.size % n_groups:
                     n_groups -= 1
@@ -329,6 +378,27 @@ class InferenceEngine:
     @staticmethod
     def _is_qleaf(x) -> bool:
         return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+    @staticmethod
+    def _is_prequantized_stream(params) -> bool:
+        """True for trees already in the quantize_fused_rowwise layout
+        (offline int8 checkpoints, inference/offline_quant.py)."""
+        try:
+            w = params["blocks"]["block"]["qkv_proj"]
+        except (KeyError, TypeError):
+            return False
+        return isinstance(w, dict) and "q" in w
+
+    @staticmethod
+    def _is_prefused(params) -> bool:
+        """True for dense trees already in the fuse_decode_params layout
+        (offline_quant.fuse_hf_llama_checkpoint — the large-model bf16
+        path, where the in-graph fuse would double HBM)."""
+        try:
+            w = params["blocks"]["block"]["qkv_proj"]
+        except (KeyError, TypeError):
+            return False
+        return not isinstance(w, dict)
 
     def _effective_params(self, params):
         """Dequantize q-leaves (traced — call inside jit; group count is the
@@ -403,6 +473,10 @@ class InferenceEngine:
                 self._kv_caches[0].shape[2] >= max_len:
             return
         decoder, init_caches, transform = resolve_decoder(cfg)
+        if self._pre_quantized or self._pre_fused:
+            # offline-quantized/fused trees are ALREADY in the fused
+            # decoder's weight layout; the per-program transform must not run
+            transform = None
         self._decoder = decoder
         self._decode_transform = transform
         # K/V are written in the model config's compute dtype — caches must
@@ -411,8 +485,10 @@ class InferenceEngine:
         self._kv_caches = init_caches(cfg, batch_size, max_len, cache_dtype)
         self._gen_cache = OrderedDict()
 
+        pre_q = self._pre_quantized
+
         def step(params, tokens, caches, index, attn_start=0):
-            p = self._effective_params(params)
+            p = params if pre_q else self._effective_params(params)
             if transform is not None:
                 p = transform(p)
             logits, new_caches = decoder.apply({"params": p}, tokens,
@@ -477,7 +553,18 @@ class InferenceEngine:
         # qkv/gateup) run once at the program top (params_fn), NOT inside
         # the decode loop — see build_generate_fn
         transform = self._decode_transform
-        if self._quant_streaming:
+        if self._pre_quantized:
+            # offline int8 checkpoint: weights are already the fused
+            # quantized tree — the program consumes them as-is
+            params_fn = None
+        elif self._quant_streaming and self._pre_fused:
+            # pre-fused dense tree: rowwise-quantize it at the program top
+            # (no fuse transform — it already happened on the host)
+            from deepspeed_tpu.models.llama import quantize_fused_rowwise
+
+            mcfg = self.model_config
+            params_fn = lambda p: quantize_fused_rowwise(p, mcfg)
+        elif self._quant_streaming:
             # fused tree rebuilt as rowwise int8 at the program top; every
             # decode matmul then streams int8 through the Pallas kernel
             # (models/llama.quantize_fused_rowwise + FusedLlamaDecoderModel

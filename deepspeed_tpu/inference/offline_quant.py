@@ -1,0 +1,302 @@
+"""Offline int8 weight-streaming quantization of HF Llama checkpoints.
+
+At 7B scale the in-graph quantization path cannot work on one chip: the
+bf16 tree (13.5 GB) and its int8 copy cannot coexist in 15.75 GB of HBM.
+This module produces the **pre-quantized fused tree** on the HOST, streamed
+straight from the safetensors shards with bounded RSS (output int8 tree +
+one layer of fp32 staging), so the device only ever holds the ~7 GB int8
+weights. The output layout is exactly what the fused decoder's matmul
+dispatch consumes (``models/llama.FusedLlamaDecoderModel`` q/scale leaves,
+``quantize_fused_rowwise`` contract) — bit-identical to running
+``quantize_fused_rowwise(fuse_decode_params(params))`` on the same weights
+(pinned by tests/unit/inference/test_offline_quant.py).
+
+Reference analogue: the int8 checkpoint loading of DS-Inference
+(``deepspeed/inference/engine.py:294`` quantization setup +
+``csrc/quantization`` kernels); the reference also quantizes ahead of the
+serving loop so the device never sees fp16 weights.
+
+K-padding: weights whose input dimension K is not a multiple of 2048 and
+exceeds it (Llama-7B's down_proj K=11008) are padded ONCE here to the next
+2048 multiple (zero rows, scale 1) so the Pallas kernel keeps wide K
+blocks instead of degrading to the largest 256-divisor (ADVICE r3) or
+re-padding the weight every decode step.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+try:
+    import ml_dtypes
+
+    _BF16 = ml_dtypes.bfloat16
+except ImportError:                                   # pragma: no cover
+    _BF16 = None
+
+
+def _quantize_rowwise_np(w32: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """numpy twin of ``ops.int8_matmul.quantize_rowwise`` (bit-identical:
+    round-half-to-even, same scale derivation)."""
+    absmax = np.max(np.abs(w32), axis=1, keepdims=True)
+    scale = np.where(absmax > 0, absmax / 127.0, np.float32(1.0))
+    q = np.clip(np.rint(w32 / scale), -128, 127).astype(np.int8)
+    return q, scale[:, 0].astype(np.float32)
+
+
+def _pad_k(q: np.ndarray, s: np.ndarray, multiple: int = 2048):
+    K = q.shape[0]
+    if K <= multiple or K % multiple == 0:
+        return q, s
+    Kp = -(-K // multiple) * multiple
+    q = np.pad(q, ((0, Kp - K), (0, 0)))
+    s = np.pad(s, (0, Kp - K), constant_values=np.float32(1.0))
+    return q, s
+
+
+def _qfuse(dtype, *weights_t: np.ndarray):
+    """Concatenate transposed [out,in] weights along out, cast through the
+    compute dtype (parity with fuse_decode_params' cast), quantize."""
+    cols = [np.ascontiguousarray(np.asarray(w).T) for w in weights_t]
+    w = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    if _BF16 is not None and dtype == "bfloat16":
+        w = w.astype(_BF16)
+    w32 = w.astype(np.float32)
+    return _pad_k(*_quantize_rowwise_np(w32))
+
+
+def llama_config_from_hf(hf_config, dtype=None):
+    """HF llama config (object or dict) → native :class:`LlamaConfig`."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if get("model_type") != "llama":
+        raise ValueError(
+            f"offline int8 streaming quantization targets the native fused "
+            f"Llama decoder; model_type={get('model_type')!r} converts "
+            f"through the unified policy path instead")
+    return LlamaConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads", get("num_attention_heads")),
+        max_seq_len=get("max_position_embeddings", 4096),
+        rope_base=float(get("rope_theta", 10000.0)),
+        rms_norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        dtype=jnp.bfloat16 if dtype is None else dtype,
+        scan_layers=True,
+    )
+
+
+def quantize_hf_llama_checkpoint(ckpt_dir: str,
+                                 hf_config=None) -> Tuple[Any, Dict]:
+    """Stream an HF Llama checkpoint into the pre-quantized fused int8 tree.
+
+    Returns ``(LlamaConfig, params)`` where params is host numpy in the
+    ``quantize_fused_rowwise`` layout: stacked ``blocks/block`` q/scale
+    groups, bf16 embedding, fp32 norm scales, int8 lm_head. Peak host RSS =
+    the int8 output (+ scales) + one layer of staging — the torch
+    state_dict never materializes (``ShardedStateDict`` streaming loader).
+    """
+    from deepspeed_tpu.module_inject.load_checkpoint import load_hf_checkpoint
+
+    sd, cfg_json = load_hf_checkpoint(ckpt_dir)
+    if hf_config is None:
+        hf_config = cfg_json
+    cfg = llama_config_from_hf(hf_config)
+    L = cfg.num_layers
+    dt = "bfloat16"
+
+    p = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def stack(name_fn):
+        """Quantize layer 0 to learn shapes, preallocate [L, ...], fill."""
+        q0, s0 = name_fn(0)
+        q = np.empty((L,) + q0.shape, np.int8)
+        s = np.empty((L,) + s0.shape, np.float32)
+        q[0], s[0] = q0, s0
+        for l in range(1, L):
+            q[l], s[l] = name_fn(l)
+        return {"q": q, "scale": s}
+
+    def b(l):
+        return f"{p}layers.{l}"
+
+    logger.info("offline int8 quantization: %d layers from %s", L, ckpt_dir)
+    qkv = stack(lambda l: _qfuse(
+        dt, sd[f"{b(l)}.self_attn.q_proj.weight"],
+        sd[f"{b(l)}.self_attn.k_proj.weight"],
+        sd[f"{b(l)}.self_attn.v_proj.weight"]))
+    o = stack(lambda l: _qfuse(dt, sd[f"{b(l)}.self_attn.o_proj.weight"]))
+    gateup = stack(lambda l: _qfuse(
+        dt, sd[f"{b(l)}.mlp.gate_proj.weight"],
+        sd[f"{b(l)}.mlp.up_proj.weight"]))
+    down = stack(lambda l: _qfuse(dt, sd[f"{b(l)}.mlp.down_proj.weight"]))
+
+    def norm_stack(suffix):
+        return {"scale": np.stack(
+            [np.asarray(sd[f"{b(l)}.{suffix}.weight"], np.float32)
+             for l in range(L)])}
+
+    params: Dict[str, Any] = {
+        "blocks": {"block": {
+            "qkv_proj": qkv, "o_proj": o,
+            "gateup_proj": gateup, "down_proj": down,
+            "input_norm": norm_stack("input_layernorm"),
+            "post_attn_norm": norm_stack("post_attention_layernorm"),
+        }},
+        "embed_tokens": {"embedding": _cast_bf16(
+            np.asarray(sd[f"{p}embed_tokens.weight"]))},
+        "final_norm": {"scale": np.asarray(sd[f"{p}norm.weight"],
+                                           np.float32)},
+    }
+    if cfg.tie_embeddings:
+        emb = params["embed_tokens"]["embedding"].astype(np.float32)
+        q, s = _pad_k(*_quantize_rowwise_np(np.ascontiguousarray(emb.T)))
+        params["attend_head"] = {"q": q, "scale": s}
+    else:
+        params["lm_head"] = {"kernel": dict(zip(
+            ("q", "scale"), _qfuse(dt, sd["lm_head.weight"])))}
+    return cfg, params
+
+
+def fuse_hf_llama_checkpoint(ckpt_dir: str,
+                             hf_config=None) -> Tuple[Any, Dict]:
+    """Stream an HF Llama checkpoint into the PRE-FUSED dense bf16 tree
+    (``fuse_decode_params`` layout, no quantization).
+
+    The bf16 arm of a large-model A/B: at 7B the in-graph fuse transform
+    would hold the unfused AND fused trees in HBM at once (2 x 13.5 GB);
+    fusing on the host means the device only ever sees the fused copy.
+    """
+    from deepspeed_tpu.module_inject.load_checkpoint import load_hf_checkpoint
+
+    sd, cfg_json = load_hf_checkpoint(ckpt_dir)
+    if hf_config is None:
+        hf_config = cfg_json
+    cfg = llama_config_from_hf(hf_config)
+    L = cfg.num_layers
+    p = "model." if any(k.startswith("model.") for k in sd) else ""
+    b = lambda l: f"{p}layers.{l}"
+
+    def fuse_stack(names_fn):
+        first = names_fn(0)
+        out = np.empty((L,) + first.shape, first.dtype)
+        out[0] = first
+        for l in range(1, L):
+            out[l] = names_fn(l)
+        return out
+
+    def cat_t(*keys, l):
+        cols = [np.ascontiguousarray(np.asarray(sd[k.format(b(l))]).T)
+                for k in keys]
+        w = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+        return _cast_bf16(w)
+
+    logger.info("offline bf16 fuse: %d layers from %s", L, ckpt_dir)
+    params: Dict[str, Any] = {
+        "blocks": {"block": {
+            "qkv_proj": fuse_stack(lambda l: cat_t(
+                "{}.self_attn.q_proj.weight", "{}.self_attn.k_proj.weight",
+                "{}.self_attn.v_proj.weight", l=l)),
+            "o_proj": fuse_stack(lambda l: cat_t(
+                "{}.self_attn.o_proj.weight", l=l)),
+            "gateup_proj": fuse_stack(lambda l: cat_t(
+                "{}.mlp.gate_proj.weight", "{}.mlp.up_proj.weight", l=l)),
+            "down_proj": fuse_stack(lambda l: cat_t(
+                "{}.mlp.down_proj.weight", l=l)),
+            "input_norm": {"scale": np.stack(
+                [np.asarray(sd[f"{b(l)}.input_layernorm.weight"],
+                            np.float32) for l in range(L)])},
+            "post_attn_norm": {"scale": np.stack(
+                [np.asarray(sd[f"{b(l)}.post_attention_layernorm.weight"],
+                            np.float32) for l in range(L)])},
+        }},
+        "embed_tokens": {"embedding": _cast_bf16(
+            np.asarray(sd[f"{p}embed_tokens.weight"]))},
+        "final_norm": {"scale": np.asarray(sd[f"{p}norm.weight"],
+                                           np.float32)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _cast_bf16(np.ascontiguousarray(
+            np.asarray(sd["lm_head.weight"]).T))}
+    return cfg, params
+
+
+def _cast_bf16(a: np.ndarray) -> np.ndarray:
+    if _BF16 is not None:
+        return a.astype(_BF16)
+    return a.astype(np.float32)
+
+
+def save_quantized(out_dir: str, cfg, params: Dict) -> None:
+    """Persist the pre-quantized tree (one .npy per leaf + meta) so serving
+    restarts skip the quantization pass."""
+    import dataclasses
+
+    import jax
+
+    os.makedirs(out_dir, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    dtypes = []
+    for i, leaf in enumerate(leaves):
+        a = np.asarray(leaf)
+        dtypes.append(str(a.dtype))
+        if _BF16 is not None and a.dtype == _BF16:
+            # np.save round-trips ml_dtypes bfloat16 as raw void bytes;
+            # store the uint16 bit pattern and re-view on load
+            a = a.view(np.uint16)
+        np.save(os.path.join(out_dir, f"leaf{i:04d}.npy"), a,
+                allow_pickle=False)
+    meta = {k: (str(v) if k == "dtype" else v)
+            for k, v in dataclasses.asdict(cfg).items()}
+    with open(os.path.join(out_dir, "quantized_meta.json"), "w") as f:
+        json.dump({"config": meta, "n_leaves": len(leaves),
+                   "leaf_dtypes": dtypes}, f)
+    # structure file: rebuildable from an eval-shape of the same checkpoint;
+    # simplest robust form is a paths list
+    paths = [jax.tree_util.keystr(kp)
+             for kp, _ in jax.tree_util.tree_leaves_with_path(params)]
+    with open(os.path.join(out_dir, "quantized_paths.json"), "w") as f:
+        json.dump(paths, f)
+
+
+def load_quantized(out_dir: str):
+    """Inverse of :func:`save_quantized`."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    with open(os.path.join(out_dir, "quantized_meta.json")) as f:
+        meta = json.load(f)
+    ccfg = dict(meta["config"])
+    ccfg["dtype"] = jnp.bfloat16
+    cfg = LlamaConfig(**ccfg)
+    with open(os.path.join(out_dir, "quantized_paths.json")) as f:
+        paths = json.load(f)
+    dtypes = meta.get("leaf_dtypes") or [None] * meta["n_leaves"]
+    leaves = []
+    for i in range(meta["n_leaves"]):
+        a = np.load(os.path.join(out_dir, f"leaf{i:04d}.npy"))
+        if dtypes[i] == "bfloat16" and _BF16 is not None:
+            a = a.view(_BF16)
+        leaves.append(a)
+    params: Dict[str, Any] = {}
+    for path, leaf in zip(paths, leaves):
+        keys = [k for k in path.replace("]", "").split("[") if k]
+        keys = [k.strip("'\"") for k in keys]
+        node = params
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+    return cfg, params

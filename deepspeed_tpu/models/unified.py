@@ -158,6 +158,37 @@ class DenseRoutedMoE(nn.Module):
         return out.reshape(B, S, D).astype(x.dtype)
 
 
+def _derive_positions(cfg: TransformerConfig, input_ids, positions,
+                      attention_mask):
+    """Position ids for the LM forward — shared by :class:`TransformerLM`
+    and its streamed twin so the two can never drift."""
+    if positions is not None:
+        return positions
+    B, S = input_ids.shape
+    if cfg.pos_from_mask and attention_mask is not None:
+        # HF OPT: positions count real tokens only, so left-padded
+        # batches start at position 0 (OPTLearnedPositionalEmbedding)
+        am = attention_mask.astype(jnp.int32)
+        return jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0, None)
+    return jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+
+
+def _derive_base_mask(cfg: TransformerConfig, S: int, attention_mask):
+    """Additive attention mask before per-layer windows — shared by
+    :class:`TransformerLM` and its streamed twin."""
+    if cfg.causal:
+        base_mask = make_causal_mask(S)
+    else:
+        base_mask = jnp.zeros((1, 1, S, S), dtype=jnp.float32)
+    if attention_mask is not None:
+        pad = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                        0.0, jnp.finfo(jnp.float32).min)
+        base_mask = base_mask + pad
+    if cfg.pos_emb == "alibi":
+        base_mask = base_mask + alibi_bias(cfg.num_heads, S, S)
+    return base_mask
+
+
 class UnifiedBlock(nn.Module):
     """One block spanning the policy zoo's topology space.
 
@@ -269,13 +300,8 @@ class StreamedTransformerLM:
                        param_dtype=jnp.float32, name="wte")
         wte_p = self._stream(params, "wte")
         x = wte.apply({"params": wte_p}, input_ids)
-        if positions is None:
-            if cfg.pos_from_mask and attention_mask is not None:
-                am = attention_mask.astype(jnp.int32)
-                positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0, None)
-            else:
-                positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(
-                    B, axis=0)
+        positions = _derive_positions(cfg, input_ids, positions,
+                                      attention_mask)
         if cfg.pos_emb == "learned":
             wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
                            dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -294,16 +320,7 @@ class StreamedTransformerLM:
             x = _norm(cfg, "ln_emb").apply(
                 {"params": self._stream(params, "ln_emb")}, x)
 
-        if cfg.causal:
-            base_mask = make_causal_mask(S)
-        else:
-            base_mask = jnp.zeros((1, 1, S, S), dtype=jnp.float32)
-        if attention_mask is not None:
-            pad = jnp.where(attention_mask[:, None, None, :].astype(bool),
-                            0.0, jnp.finfo(jnp.float32).min)
-            base_mask = base_mask + pad
-        if cfg.pos_emb == "alibi":
-            base_mask = base_mask + alibi_bias(cfg.num_heads, S, S)
+        base_mask = _derive_base_mask(cfg, S, attention_mask)
 
         for i in range(cfg.num_layers):
             mask = base_mask
@@ -372,14 +389,8 @@ class TransformerLM(nn.Module):
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="wte")
         x = wte(input_ids)
-        if positions is None:
-            if cfg.pos_from_mask and attention_mask is not None:
-                # HF OPT: positions count real tokens only, so left-padded
-                # batches start at position 0 (OPTLearnedPositionalEmbedding)
-                am = attention_mask.astype(jnp.int32)
-                positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0, None)
-            else:
-                positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+        positions = _derive_positions(cfg, input_ids, positions,
+                                      attention_mask)
         if cfg.pos_emb == "learned":
             wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
                            dtype=cfg.dtype, param_dtype=jnp.float32, name="wpe")
@@ -394,16 +405,7 @@ class TransformerLM(nn.Module):
             # BLOOM word_embeddings_layernorm / BERT embeddings.LayerNorm
             x = _norm(cfg, "ln_emb")(x)
 
-        if cfg.causal:
-            base_mask = make_causal_mask(S)
-        else:
-            base_mask = jnp.zeros((1, 1, S, S), dtype=jnp.float32)
-        if attention_mask is not None:
-            pad = jnp.where(attention_mask[:, None, None, :].astype(bool),
-                            0.0, jnp.finfo(jnp.float32).min)
-            base_mask = base_mask + pad
-        if cfg.pos_emb == "alibi":
-            base_mask = base_mask + alibi_bias(cfg.num_heads, S, S)
+        base_mask = _derive_base_mask(cfg, S, attention_mask)
 
         block_cls = nn.remat(UnifiedBlock) if cfg.remat else UnifiedBlock
         for i in range(cfg.num_layers):

@@ -76,8 +76,15 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     """
     B, K = x.shape
     Kq, N = q.shape
-    assert K == Kq and scale.shape == (K,), (x.shape, q.shape, scale.shape)
+    assert Kq >= K and scale.shape == (Kq,), (x.shape, q.shape, scale.shape)
     out_dtype = out_dtype or x.dtype
+    if Kq > K:
+        # weight pre-padded along K at quantization time (offline int8
+        # checkpoints pad K to a 2048 multiple so the kernel keeps wide
+        # panels without re-padding the weight per step — the padded rows
+        # are zero, so padding the activation with zeros is exact)
+        x = jnp.pad(x, ((0, 0), (0, Kq - K)))
+        K = Kq
 
     xs = (x.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
 

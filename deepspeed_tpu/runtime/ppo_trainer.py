@@ -24,9 +24,47 @@ from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
 from deepspeed_tpu.utils.logging import log_dist
 
 
+class CriticModel(nn.Module):
+    """Value model: ANY hidden-state backbone + scalar value head per token
+    (the DS-Chat critic/reward architecture — an LM with ``v_head``).
+
+    The backbone must yield per-token hidden states: modules exposing
+    ``return_hidden`` (LlamaModel) are called with it; others (the unified
+    ``TransformerLM`` with ``lm_head=False`` — OPT/GPT-2/BLOOM-shaped
+    critics, the reference DS-Chat workload is OPT,
+    blogs/deepspeed-chat/README.md:57) must return hidden states directly.
+    A backbone that would return VOCAB LOGITS raises instead of silently
+    fitting a value head over the vocabulary axis."""
+
+    backbone: nn.Module
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        import inspect
+
+        bk = self.backbone
+        bcfg = getattr(bk, "cfg", None)
+        if getattr(bcfg, "lm_head", False):
+            raise ValueError(
+                f"CriticModel backbone {type(bk).__name__} has lm_head=True "
+                f"— it returns vocab logits, not hidden states; build it "
+                f"with lm_head=False (encoder output) for the value head")
+        call = type(bk).__call__
+        if "return_hidden" in inspect.signature(call).parameters:
+            h = bk(input_ids, positions=positions, return_hidden=True)
+        else:
+            h = bk(input_ids, positions=positions)
+        v = nn.Dense(1, use_bias=False, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="v_head")(
+            h.astype(jnp.float32))
+        return v[..., 0]                      # [B, T]
+
+
 class LlamaCriticModel(nn.Module):
-    """Value model: LlamaModel backbone + scalar value head per token (the
-    DS-Chat critic/reward architecture — an LM with ``v_head``)."""
+    """Llama-backbone critic (param tree {"base", "v_head"} — the round-3
+    layout, kept so existing checkpoints and the bench path load
+    unchanged). New code should prefer :class:`CriticModel`, which takes
+    any backbone."""
 
     cfg: LlamaConfig
 

@@ -105,3 +105,109 @@ def test_optimizer_state_actually_restored(tmp_path):
                  if hasattr(x, "shape") and x.ndim > 0]
     assert any(np.abs(l).max() > 0 for l in nu_leaves), \
         "optimizer moments are all zero after resume — state was dropped"
+
+
+# --- expert-axis resharding (VERDICT r3 #7) -------------------------------
+# Reference: per-expert-parallel-rank expert state save/load
+# (deepspeed/runtime/engine.py:2919). Universal checkpoints hold logical
+# arrays, so changing the expert-axis degree at resume must preserve the
+# trajectory — including expert optimizer state.
+
+def _moe_model_and_loss():
+    import flax.linen as nn
+
+    from deepspeed_tpu.models.llama import loss_fn as lm_loss
+    from deepspeed_tpu.models.transformer import (
+        GatedMLP, RMSNorm, SelfAttention, make_causal_mask,
+    )
+    from deepspeed_tpu.moe.layer import MoE
+
+    V, D, F, H, E = 256, 32, 64, 4, 4
+
+    class MoELM(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            B, S = ids.shape
+            x = nn.Embed(V, D, dtype=jnp.float32, name="wte")(ids)
+            mask = make_causal_mask(S)
+            aux_total = 0.0
+            for i in range(2):
+                h = RMSNorm(dtype=jnp.float32, name=f"ln_a{i}")(x)
+                x = x + SelfAttention(num_heads=H, dtype=jnp.float32,
+                                      assume_causal_mask=True,
+                                      name=f"attn{i}")(h, mask=mask)
+                h = RMSNorm(dtype=jnp.float32, name=f"ln_m{i}")(x)
+                if i % 2 == 1:
+                    out, aux = MoE(num_experts=E, hidden_size=D,
+                                   intermediate_size=F, k=1,
+                                   dtype=jnp.float32, name=f"moe{i}")(h)
+                    x = x + out
+                    aux_total = aux_total + aux
+                else:
+                    x = x + GatedMLP(intermediate_size=F,
+                                     dtype=jnp.float32, name=f"mlp{i}")(h)
+            x = RMSNorm(dtype=jnp.float32, name="ln_f")(x)
+            logits = nn.Dense(V, use_bias=False, dtype=jnp.float32,
+                              name="lm_head")(x)
+            return logits.astype(jnp.float32), aux_total
+
+    model = MoELM()
+
+    def loss(params, batch, rngs=None):
+        logits, aux = model.apply({"params": params}, batch["input_ids"])
+        return lm_loss(logits, batch["labels"]) + 0.01 * aux
+
+    return model, loss
+
+
+def _moe_engine(expert, zero_stage=1):
+    model, loss = _moe_model_and_loss()
+    mesh = make_mesh(dims={"pipe": 1, "data": 8, "expert": expert,
+                           "sequence": 1, "tensor": 1})
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "gradient_clipping": 1.0, "bf16": {"enabled": False},
+           "zero_optimization": {"stage": zero_stage},
+           "steps_per_print": 1000}
+    return deepspeed_tpu.initialize(model=model, loss_fn=loss, config=cfg,
+                                    mesh=mesh, sample_batch=_batch(0))
+
+
+@pytest.mark.parametrize("ep_a,ep_b,stage_b", [
+    pytest.param(2, 2, 1, id="ep2_roundtrip"),
+    pytest.param(2, 1, 1, id="ep2_to_ep1"),
+    pytest.param(2, 4, 1, id="ep2_to_ep4"),
+    pytest.param(2, 4, 3, id="ep2_to_ep4_zero3"),
+])
+def test_expert_axis_resume(tmp_path, ep_a, ep_b, stage_b):
+    """Save on expert:ep_a, resume on expert:ep_b: trajectory (losses and
+    params, expert stacks included) must match the uninterrupted run."""
+    e_a = _moe_engine(ep_a)
+    assert e_a.mesh.shape["expert"] == ep_a
+    for i in range(2):
+        e_a.train_batch(_batch(i))
+    e_a.save_checkpoint(str(tmp_path))
+    expect = [float(e_a.train_batch(_batch(10 + i))) for i in range(3)]
+
+    e_b = _moe_engine(ep_b, zero_stage=stage_b)
+    assert e_b.mesh.shape["expert"] == ep_b
+    e_b.load_universal_checkpoint(str(tmp_path))
+    got = [float(e_b.train_batch(_batch(10 + i))) for i in range(3)]
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(e_a.params),
+                    jax.tree_util.tree_leaves(e_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_expert_stacks_ride_expert_axis(tmp_path):
+    """After an expert-axis resume the restored expert stacks carry the NEW
+    mesh's expert-axis sharding (not the saved layout)."""
+    e_a = _moe_engine(2)
+    e_a.train_batch(_batch(0))
+    e_a.save_checkpoint(str(tmp_path))
+    e_b = _moe_engine(4)
+    e_b.load_universal_checkpoint(str(tmp_path))
+    spec = e_b.params["moe1"]["experts"]["gate_proj"].sharding.spec
+    assert spec and spec[0] == "expert", spec
+    assert float(e_b.train_batch(_batch(1))) > 0

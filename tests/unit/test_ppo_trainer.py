@@ -106,3 +106,78 @@ def test_critic_values_shape():
     v = m.apply({"params": p}, ids)
     assert v.shape == (2, 10)
     assert "v_head" in p and "base" in p
+
+
+def _opt_trainer(lr=1e-2):
+    """OPT-shaped DS-Chat loop (the reference workload, BASELINE config #5):
+    unified-arch actor + CriticModel over an OPT-shaped backbone."""
+    from deepspeed_tpu.models.unified import TransformerConfig, TransformerLM
+    from deepspeed_tpu.runtime.ppo_trainer import CriticModel
+
+    opt = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+               max_seq_len=64, pos_emb="learned", pos_offset=2,
+               activation="relu", tie_embeddings=True)
+    actor_cfg = TransformerConfig(**opt)
+    actor_model = TransformerLM(actor_cfg)
+    critic_model = CriticModel(
+        TransformerLM(TransformerConfig(**{**opt, "num_layers": 1,
+                                           "lm_head": False})))
+    rng = np.random.default_rng(0)
+    sample = {"input_ids": rng.integers(0, 256, (B, PROMPT + GEN)),
+              "labels": rng.integers(0, 256, (B, PROMPT + GEN))}
+
+    def ds_cfg(extra=None):
+        c = {"train_batch_size": B,
+             "optimizer": {"type": "adamw", "params": {"lr": lr}},
+             "zero_optimization": {"stage": 1},
+             "steps_per_print": 1000}
+        c.update(extra or {})
+        return c
+
+    actor = deepspeed_tpu.initialize(
+        model=actor_model, model_config=actor_cfg,
+        config=ds_cfg({"hybrid_engine": {"enabled": True}}),
+        loss_fn=make_actor_ppo_loss(actor_model),
+        sample_batch=sample)
+    critic = deepspeed_tpu.initialize(
+        model=critic_model, config=ds_cfg(),
+        loss_fn=make_critic_value_loss(critic_model),
+        sample_batch=sample)
+
+    @jax.jit
+    def reward_fn(seq):
+        gen = seq[:, PROMPT:]
+        return (gen < TARGET_SET).mean(axis=1).astype(jnp.float32)
+
+    return DeepSpeedPPOTrainer(actor, critic, reward_fn)
+
+
+def test_ppo_step_runs_on_opt_shaped_models():
+    """VERDICT r3 #8: the DS-Chat loop runs on non-Llama (OPT-shaped)
+    actor/critic — generic CriticModel backbone, unified-arch actor."""
+    tr = _opt_trainer()
+    prompts = np.random.default_rng(1).integers(1, 250, (B, PROMPT))
+    for i in range(3):
+        stats = tr.step(prompts, GEN, rng=jax.random.PRNGKey(i))
+        assert np.isfinite(stats["actor_loss"])
+        assert np.isfinite(stats["critic_loss"])
+
+
+def test_ppo_improves_reward_opt_shaped():
+    tr = _opt_trainer(lr=1e-2)
+    prompts = np.random.default_rng(1).integers(1, 250, (B, PROMPT))
+    rewards = []
+    for i in range(12):
+        stats = tr.step(prompts, GEN, rng=jax.random.PRNGKey(i))
+        rewards.append(stats["reward_mean"])
+    assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.05, rewards
+
+
+def test_critic_rejects_logits_backbone():
+    from deepspeed_tpu.models.unified import TransformerConfig, TransformerLM
+    from deepspeed_tpu.runtime.ppo_trainer import CriticModel
+
+    m = CriticModel(TransformerLM(TransformerConfig.tiny(lm_head=True)))
+    with pytest.raises(ValueError, match="lm_head"):
+        m.init(jax.random.PRNGKey(0),
+               jnp.zeros((1, 4), jnp.int32))

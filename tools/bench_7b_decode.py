@@ -1,0 +1,165 @@
+"""7B-scale decode benchmark on the real chip (VERDICT r3 #1a).
+
+BASELINE.json names "DS-Inference p50 TTFT" at the 7B scale; this runs the
+offline-quantized int8-streaming decode of a real ~13 GB sharded HF Llama-7B
+checkpoint (~7 GB int8 resident — fits the 15.75 GB chip) and, unless
+--skip-bf16, the pre-fused bf16 arm first (13.5 GB resident, the honest
+same-session A).
+
+Methodology mirrors bench.py --inference: element-transfer fences (tunnel
+block_until_ready lies), tunnel RTT netted out of TTFT, best-of-N decode
+windows, decode rate net of prefill.
+
+Usage:
+    python tools/bench_7b_decode.py --ckpt /root/ckpts/llama7b \
+        [--cache /root/ckpts/llama7b_int8] [--skip-bf16] [--gen 128]
+Writes tools/bench_7b_decode.json.
+"""
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def measure(engine, ids, gen_len, label):
+    import jax
+    import jax.numpy as jnp
+
+    def run_blocking(n):
+        toks = engine.generate(ids, max_new_tokens=n)
+        return int(toks[0, -1])
+
+    t0 = time.time()
+    run_blocking(gen_len)           # compile long program
+    compile_long = time.time() - t0
+    t0 = time.time()
+    run_blocking(1)                 # compile TTFT program
+    compile_short = time.time() - t0
+    print(f"# {label}: compiles {compile_long:.1f}s / {compile_short:.1f}s",
+          file=sys.stderr, flush=True)
+
+    ready = jnp.zeros((), jnp.int32) + 1
+    int(ready)
+    rtts = []
+    for _ in range(5):
+        t0 = time.time()
+        int(ready + 0)
+        rtts.append(time.time() - t0)
+    rtt_p50 = sorted(rtts)[len(rtts) // 2]
+
+    ttfts = []
+    for _ in range(5):
+        engine.reset_cache()
+        t0 = time.time()
+        run_blocking(1)
+        ttfts.append(time.time() - t0)
+    ttft_raw_p50 = sorted(ttfts)[len(ttfts) // 2]
+    ttft_p50 = max(ttft_raw_p50 - rtt_p50, 1e-4)
+
+    best = 0.0
+    for _ in range(3):
+        engine.reset_cache()
+        t0 = time.time()
+        run_blocking(gen_len)
+        dt = max(time.time() - t0 - ttft_raw_p50, 1e-6)
+        best = max(best, (gen_len - 1) / dt)
+    return {"decode_tok_s": round(best, 1),
+            "ttft_p50_ms": round(ttft_p50 * 1e3, 1),
+            "ttft_raw_p50_ms": round(ttft_raw_p50 * 1e3, 1),
+            "tunnel_rtt_p50_ms": round(rtt_p50 * 1e3, 1),
+            "compile_long_s": round(compile_long, 1),
+            "compile_short_s": round(compile_short, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", default="/root/ckpts/llama7b")
+    ap.add_argument("--cache", default="/root/ckpts/llama7b_int8")
+    ap.add_argument("--skip-bf16", action="store_true")
+    ap.add_argument("--skip-int8", action="store_true")
+    ap.add_argument("--prompt", type=int, default=512)
+    ap.add_argument("--gen", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.offline_quant import (
+        fuse_hf_llama_checkpoint, load_quantized,
+        quantize_hf_llama_checkpoint, save_quantized,
+    )
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+    out = {"backend": backend, "ckpt": args.ckpt,
+           "prompt_len": args.prompt, "gen_len": args.gen}
+
+    if not args.skip_bf16:
+        # the bf16 arm is tight (13.5 GB weights + KV on a 15.75 GB chip):
+        # a refusal is a recordable result, not a reason to lose the int8 arm
+        eng = None
+        try:
+            t0 = time.time()
+            cfg, fused = fuse_hf_llama_checkpoint(args.ckpt)
+            out["fuse_host_s"] = round(time.time() - t0, 1)
+            ids = rng.integers(1, cfg.vocab_size, (1, args.prompt))
+            t0 = time.time()
+            eng = deepspeed_tpu.init_inference(
+                model_config=cfg, params=fused, config={"dtype": "bfloat16"})
+            del fused
+            out["bf16_place_s"] = round(time.time() - t0, 1)
+            out["bf16"] = measure(eng, ids, args.gen, "bf16 prefused")
+        except Exception as e:      # noqa: BLE001 — record and move on
+            out["bf16_error"] = f"{type(e).__name__}: {e}"[:500]
+            print(f"# bf16 arm failed: {out['bf16_error']}",
+                  file=sys.stderr, flush=True)
+        finally:
+            if eng is not None:
+                eng.release_workspace()
+                del eng
+            gc.collect()
+
+    if not args.skip_int8:
+        t0 = time.time()
+        if args.cache and os.path.exists(
+                os.path.join(args.cache, "quantized_meta.json")):
+            cfg, qparams = load_quantized(args.cache)
+            out["int8_from_cache"] = True
+        else:
+            cfg, qparams = quantize_hf_llama_checkpoint(args.ckpt)
+            if args.cache:
+                save_quantized(args.cache, cfg, qparams)
+        out["quant_host_s"] = round(time.time() - t0, 1)
+        ids = rng.integers(1, cfg.vocab_size, (1, args.prompt))
+        t0 = time.time()
+        eng = deepspeed_tpu.init_inference(
+            model_config=cfg, params=qparams,
+            config={"dtype": "bfloat16",
+                    "quant": {"enabled": True, "bits": 8,
+                              "streaming": True}})
+        del qparams
+        out["int8_place_s"] = round(time.time() - t0, 1)
+        out["int8_stream"] = measure(eng, ids, args.gen, "int8 stream")
+        eng.release_workspace()
+        del eng
+
+    if "bf16" in out and "int8_stream" in out:
+        out["int8_over_bf16"] = round(
+            out["int8_stream"]["decode_tok_s"] / out["bf16"]["decode_tok_s"],
+            3)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_7b_decode.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -151,6 +151,10 @@ def inference_main(int8: bool = False, batch_size: int = 1,
                    "batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "params": int(n_params),
                    "int8": int8, "int8_streaming": bool(int8 and stream),
+                   "int8_panel": getattr(engine._decoder, "int8_block_n",
+                                         None) if (int8 and stream) else None,
+                   "int8_panel_trace": getattr(engine,
+                                               "_int8_panel_detail", None),
                    "backend": jax.default_backend()},
     }))
 

@@ -122,8 +122,17 @@ class Candidate:
         if self.fused_loss is not None:
             cfg["fused_lm_loss"] = {"enabled": bool(self.fused_loss)}
         if self.moment_dtype:
-            cfg.setdefault("optimizer", {"type": "adamw", "params": {}}) \
-               .setdefault("params", {})["moment_dtype"] = self.moment_dtype
+            p = cfg.setdefault("optimizer", {"type": "adamw", "params": {}}) \
+                   .setdefault("params", {})
+            # axis values: "bfloat16" (typed m+v), "factored" (rank-1 nu),
+            # "bf16mu+factored" (both levers — the lightest moment tier)
+            if self.moment_dtype == "factored":
+                p["nu_dtype"] = "factored"
+            elif self.moment_dtype == "bf16mu+factored":
+                p["mu_dtype"] = "bfloat16"
+                p["nu_dtype"] = "factored"
+            else:
+                p["moment_dtype"] = self.moment_dtype
         ov = self.model_overrides()
         if ov is not None:
             # consumed (popped) by the caller's engine_factory; harmless to
@@ -146,6 +155,12 @@ def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
     if cand.moment_dtype in ("bfloat16", "bf16"):
         # bf16 m/v storage: 8 B/param of moments become 4
         opt -= n * 4
+    elif cand.moment_dtype == "factored":
+        # rank-1 nu: ~4 B/param of second moment become ~0
+        opt -= n * 4
+    elif cand.moment_dtype == "bf16mu+factored":
+        # bf16 mu (4->2) + factored nu (4->~0)
+        opt -= n * 6
     if cand.zero_stage >= 1:
         opt //= dp_size
     if cand.zero_stage >= 2:

@@ -28,6 +28,13 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # bandwidth-bound, so ~2x tokens/s is the ceiling). Llama-family
     # scan-stacked models, bits=8 only.
     streaming: bool = False
+    # streaming N-panel blocking: None = measure on-chip at engine init
+    # (the 256-vs-512 answer swings with the part/session — docs/
+    # PERF_ANALYSIS.md decode section); an int pins it explicitly
+    block_n: Optional[int] = None
+    # at-init on-chip microbench picking block_n per session (skipped when
+    # block_n is pinned or off-TPU)
+    autotune_panel: bool = True
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -477,6 +477,8 @@ class InferenceEngine:
             # offline-quantized/fused trees are ALREADY in the fused
             # decoder's weight layout; the per-program transform must not run
             transform = None
+        if self._quant_streaming and hasattr(decoder, "int8_block_n"):
+            decoder.int8_block_n = self._pick_int8_panel()
         self._decoder = decoder
         self._decode_transform = transform
         # K/V are written in the model config's compute dtype — caches must
@@ -496,6 +498,66 @@ class InferenceEngine:
             return logits, new_caches
 
         self._decode_fn = jax.jit(step, donate_argnums=(2,))
+
+    def _pick_int8_panel(self) -> int:
+        """Session N-panel width for the int8 streaming kernel.
+
+        The 256-vs-512 answer swung between sessions in round 3 (PERF_
+        ANALYSIS decode notes: 437-vs-415 one day, 318-vs-254 another), so
+        a shipped constant is a coin flip — measure the decode-shaped
+        matmul chain ON THIS CHIP at engine init instead (reference
+        analogue: the inference kernel set ships per-arch tuned GEMM
+        configs; here the tuning is a 3-candidate on-chip microbench).
+        Pin with ``quant.block_n`` or disable via ``quant.autotune_panel:
+        false`` (then the measured round-3 default 256 ships)."""
+        qc = self._config.quant
+        if qc.block_n:
+            return int(qc.block_n)
+        if getattr(self, "_int8_panel_choice", None):
+            return self._int8_panel_choice
+        if not qc.autotune_panel or jax.default_backend() != "tpu":
+            return 256
+        from deepspeed_tpu.ops.int8_matmul import int8_matmul
+
+        cfg = self.model_config
+        D = cfg.hidden_size
+        F2 = 2 * cfg.intermediate_size
+        rng = np.random.default_rng(0)
+        q1 = jnp.asarray(rng.integers(-127, 128, (D, F2), dtype=np.int8))
+        s1 = jnp.full((D,), 1e-2, jnp.float32)
+        q2 = jnp.asarray(rng.integers(-127, 128, (F2, D), dtype=np.int8))
+        s2 = jnp.full((F2,), 1e-2, jnp.float32)
+        x0 = jnp.ones((1, D), jnp.bfloat16)
+        R = 32
+        results = {}
+        for c in (128, 256, 512):
+            def loop(x, c=c):
+                def body(i, x):
+                    y = int8_matmul(x, q1, s1, block_n=c,
+                                    out_dtype=jnp.bfloat16)
+                    z = int8_matmul(y, q2, s2, block_n=c,
+                                    out_dtype=jnp.bfloat16)
+                    # bounded feedback keeps the chain data-dependent
+                    return z / (jnp.max(jnp.abs(z)) + 1.0)
+
+                return jax.lax.fori_loop(0, R, body, x)
+
+            run = jax.jit(loop)
+            float(jnp.sum(run(x0)))          # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.time()
+                float(jnp.sum(run(x0)))      # element fence (tunnel-honest)
+                best = min(best, time.time() - t0)
+            results[c] = best
+        choice = min(results, key=results.get)
+        self._int8_panel_detail = {str(k): round(v * 1e3, 2)
+                                   for k, v in results.items()}
+        self._int8_panel_choice = choice
+        log_dist(f"int8 panel autotune: block_n={choice} "
+                 f"(ms/{R}-layer-pair window: {self._int8_panel_detail})",
+                 ranks=[0])
+        return choice
 
     def reset_cache(self):
         """Zero the KV workspace (reference reset_cache, pt_binding.cpp:1937)."""
@@ -583,7 +645,8 @@ class InferenceEngine:
         base_key = ("int8w" if self._quantized else "",
                     "stream" if self._quant_streaming else "",
                     "fused" if transform is not None else "",
-                    self._config.quant.bits if self._quantized else 0)
+                    self._config.quant.bits if self._quantized else 0,
+                    getattr(self._decoder, "int8_block_n", 0))
         eos = -1 if eos_token_id is None else int(eos_token_id)
         if speculative:
             from deepspeed_tpu.inference.speculative import (

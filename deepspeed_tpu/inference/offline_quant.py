@@ -280,7 +280,10 @@ def load_quantized(out_dir: str):
     with open(os.path.join(out_dir, "quantized_meta.json")) as f:
         meta = json.load(f)
     ccfg = dict(meta["config"])
-    ccfg["dtype"] = jnp.bfloat16
+    names = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}
+    key = str(ccfg.get("dtype", "bfloat16")).split(".")[-1].strip("'>")
+    ccfg["dtype"] = names.get(key, jnp.bfloat16)
     cfg = LlamaConfig(**ccfg)
     with open(os.path.join(out_dir, "quantized_paths.json")) as f:
         paths = json.load(f)

@@ -497,8 +497,11 @@ class FusedLlamaDecoderModel:
     flax params of its own) with the decoder ``apply`` contract:
     ``apply({"params": fused_tree}, ids, caches, index)``."""
 
-    def __init__(self, cfg: LlamaConfig):
+    def __init__(self, cfg: LlamaConfig, int8_block_n: int = 256):
         self.cfg = cfg
+        # int8-streaming N-panel width — session-tunable (the engine's
+        # at-init microbench sets it; docs/PERF_ANALYSIS.md decode notes)
+        self.int8_block_n = int8_block_n
 
     def apply(self, variables, input_ids, kv_caches, cache_index,
               attn_start=0):
@@ -534,6 +537,7 @@ class FusedLlamaDecoderModel:
 
                 Bm, Tm, Km = x.shape
                 y = int8_matmul(x.reshape(Bm * Tm, Km), w["q"], w["scale"],
+                                block_n=self.int8_block_n,
                                 out_dtype=cfg.dtype)
                 return y.reshape(Bm, Tm, -1)
             return x @ w

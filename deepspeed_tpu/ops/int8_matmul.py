@@ -76,7 +76,11 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     """
     B, K = x.shape
     Kq, N = q.shape
-    assert Kq >= K and scale.shape == (Kq,), (x.shape, q.shape, scale.shape)
+    # Kq > K only for offline K-padding to the next 2048 multiple — a
+    # looser bound would let a mismatched weight/activation pair compute
+    # garbage silently instead of asserting
+    assert (Kq == K or (Kq % 2048 == 0 and 0 < Kq - K < 2048)) \
+        and scale.shape == (Kq,), (x.shape, q.shape, scale.shape)
     out_dtype = out_dtype or x.dtype
     if Kq > K:
         # weight pre-padded along K at quantization time (offline int8

@@ -51,10 +51,17 @@ def _moment_dtypes(params: Dict[str, Any]):
         v = params.get(key, params.get("moment_dtype"))
         if v is None:
             return None
+        if str(v).lower() == "factored":
+            if key != "nu_dtype":
+                raise ValueError(
+                    f"optimizer.params.{key}='factored': only the SECOND "
+                    f"moment can be rank-factored (nu_dtype); the first "
+                    f"moment has no nonnegative low-rank structure")
+            return "factored"
         if str(v).lower() not in names:
             raise ValueError(
                 f"optimizer.params.{key}={v!r}: supported moment dtypes "
-                f"are float32/bfloat16")
+                f"are float32/bfloat16 (+ 'factored' for nu_dtype)")
         dt = names[str(v).lower()]
         return None if dt == jnp.float32 else dt
 
@@ -119,6 +126,82 @@ def scale_by_adam_typed(b1: float, b2: float, eps: float,
     return optax.GradientTransformation(init, update)
 
 
+def scale_by_adam_factored_nu(b1: float, b2: float, eps: float,
+                              mu_dtype=None):
+    """Adam with a RANK-1 FACTORED second moment (Adafactor's nonnegative
+    factorization, Shazeer & Stern 2018) for matrix-shaped params.
+
+    For a leaf ``[..., I, J]`` the second moment stores row means ``[..., I]``
+    and column means ``[..., J]`` instead of the full ``[..., I, J]`` —
+    ~4 bytes/param of optimizer state become ~0, the HBM door to
+    lighter-remat policies on a single chip (docs/PERF_ANALYSIS.md names
+    this as the open lever past bf16 moments). First moment ``mu`` stays
+    dense (optionally bf16); vectors/scalars keep a dense ``nu``. Update
+    math fp32, Adam-style bias correction on both moments. State is an
+    ``optax.ScaleByAdamState`` whose ``nu`` leaves for matrices are
+    ``{"r": ..., "c": ...}`` dicts."""
+    import jax
+    import jax.numpy as jnp
+
+    def _factored(p):
+        return getattr(p, "ndim", 0) >= 2
+
+    def init(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or jnp.float32),
+            params)
+
+        def nu0(p):
+            if _factored(p):
+                return {"r": jnp.zeros(p.shape[:-1], jnp.float32),
+                        "c": jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                       jnp.float32)}
+            return jnp.zeros_like(p, dtype=jnp.float32)
+
+        nu = jax.tree_util.tree_map(nu0, params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32),
+                                      mu=mu, nu=nu)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+
+        def upd(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1 - b1) * g32
+            mhat = m32 / (1 - b1 ** c)
+            sq = jnp.square(g32)
+            if isinstance(v, dict):
+                r = b2 * v["r"] + (1 - b2) * jnp.mean(sq, axis=-1)
+                col = b2 * v["c"] + (1 - b2) * jnp.mean(sq, axis=-2)
+                # vhat_ij ≈ r_i * c_j / mean_i(r)  (Adafactor eq. 4)
+                rm = jnp.mean(r, axis=-1, keepdims=True)
+                vhat = (r[..., :, None] * col[..., None, :]
+                        / jnp.maximum(rm, 1e-30)[..., None])
+                v32 = {"r": r, "c": col}
+            else:
+                v32 = b2 * v + (1 - b2) * sq
+                vhat = v32
+            vhat = vhat / (1 - b2 ** c)
+            step = mhat / (jnp.sqrt(vhat) + eps)
+            return (step, m32.astype(m.dtype), v32)
+
+        # nu has {"r","c"} dict leaves where grads has matrix leaves, so
+        # align by flattening (is_leaf on nu's side only)
+        is_nu_leaf = lambda x: isinstance(x, dict) and set(x) == {"r", "c"}
+        g_leaves, tdef = jax.tree_util.tree_flatten(grads)
+        m_leaves = jax.tree_util.tree_leaves(state.mu)
+        n_leaves = jax.tree_util.tree_leaves(state.nu, is_leaf=is_nu_leaf)
+        out = [upd(g, m, v)
+               for g, m, v in zip(g_leaves, m_leaves, n_leaves)]
+        unf = lambda i: jax.tree_util.tree_unflatten(
+            tdef, [o[i] for o in out])
+        return unf(0), optax.ScaleByAdamState(count=count, mu=unf(1),
+                                              nu=unf(2))
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(type_name: str, params: Dict[str, Any],
                     lr: Optional[ScheduleOrFloat] = None) -> optax.GradientTransformation:
     """Build the base gradient transformation (no clipping — the engine owns
@@ -142,9 +225,14 @@ def build_optimizer(type_name: str, params: Dict[str, Any],
         decoupled = (name == "adamw" or params.get("adam_w_mode", True)
                      or a["weight_decay"] == 0.0)
         if mu_dt is not None or nu_dt is not None:
-            # typed-moment variant (bf16 m/v storage, fp32 update math)
-            chain = [scale_by_adam_typed(a["b1"], a["b2"], a["eps"],
-                                         mu_dtype=mu_dt, nu_dtype=nu_dt)]
+            if nu_dt == "factored":
+                # rank-1 second moment (Adafactor factorization)
+                chain = [scale_by_adam_factored_nu(
+                    a["b1"], a["b2"], a["eps"], mu_dtype=mu_dt)]
+            else:
+                # typed-moment variant (bf16 m/v storage, fp32 update math)
+                chain = [scale_by_adam_typed(a["b1"], a["b2"], a["eps"],
+                                             mu_dtype=mu_dt, nu_dtype=nu_dt)]
             if a["weight_decay"]:
                 if not decoupled:
                     raise ValueError(

@@ -177,6 +177,10 @@ class DeepSpeedEngine:
         if self._config.zero_config.offload_param_device == "nvme":
             self._init_param_nvme(model, params, loss_fn)
             return
+        if (self._config.zero_config.offload_param_device == "cpu"
+                and self._config.zero_config.offload_param.grouped_stream):
+            self._init_grouped_stream(model, params, loss_fn)
+            return
         if params is None:
             assert sample_batch is not None and hasattr(model, "init"), \
                 "Need sample_batch (+ flax model) to initialize parameters"
@@ -303,15 +307,41 @@ class DeepSpeedEngine:
             NVMeParamTrainer, validate_param_nvme_config,
         )
 
-        validate_param_nvme_config(self._config, self.mesh)
+        self._init_interpreter_engine(
+            model, params, loss_fn, trainer_cls=NVMeParamTrainer,
+            validator=validate_param_nvme_config,
+            tier="offload_param.device=nvme", label="param-NVMe")
+
+    def _init_grouped_stream(self, model, params, loss_fn):
+        """Alternate engine init for ``offload_param.grouped_stream`` — the
+        grouped host-driven interpreter over pinned-host state
+        (zero/grouped_stream.py). Same duck-typed surface as the param-NVMe
+        trainer, so every ``self._pnvme`` touchpoint (train/eval/export/
+        checkpoint) serves this tier too."""
+        from deepspeed_tpu.runtime.zero.grouped_stream import (
+            GroupedStreamTrainer, validate_grouped_stream_config,
+        )
+
+        self._init_interpreter_engine(
+            model, params, loss_fn, trainer_cls=GroupedStreamTrainer,
+            validator=validate_grouped_stream_config,
+            tier="offload_param.grouped_stream", label="grouped-stream")
+
+    def _init_interpreter_engine(self, model, params, loss_fn, *,
+                                 trainer_cls, validator, tier, label):
+        """Shared init for host-interpreter tiers (param-NVMe and
+        grouped-stream): validate, build the trainer, wire the duck-typed
+        ``self._pnvme`` surface + API-parity attributes."""
+        validator(self._config, self.mesh)
+        self._interpreter_tier = tier
         if loss_fn is not None:
             raise NotImplementedError(
-                "offload_param.device=nvme streams the built-in causal-LM "
-                "loss layer-by-layer; a custom loss_fn cannot be decomposed "
-                "— drop it or use offload_param.device=cpu")
+                f"{tier} streams the built-in causal-LM loss layer-group "
+                f"by layer-group; a custom loss_fn cannot be decomposed — "
+                f"drop it or use plain offload_param.device=cpu")
         cfg = getattr(model, "cfg", None)
         init_rng, self._rng = jax.random.split(self._rng)
-        self._pnvme = NVMeParamTrainer(cfg, self._config, self.mesh, init_rng)
+        self._pnvme = trainer_cls(cfg, self._config, self.mesh, init_rng)
         import weakref
 
         # finalizer BEFORE ingest: a mismatched params tree must not leak
@@ -334,7 +364,7 @@ class DeepSpeedEngine:
         self.optimizer, self._lr_schedule = self._configure_optimizer()
         self._init_runtime_state()
         log_dist(
-            f"DeepSpeedEngine initialized (param-NVMe interpreter): "
+            f"DeepSpeedEngine initialized ({label} interpreter): "
             f"zero_stage=3, dtype={self._config.precision_dtype}, "
             f"mesh={dict(self.mesh.shape)}, "
             f"micro_bs={self.train_micro_batch_size_per_gpu()}, "
@@ -732,7 +762,8 @@ class DeepSpeedEngine:
                 donate_argnums=(0,))
             if self._nvme is not None:
                 grads_out_sh = None
-                if plan.offload_param and \
+                zc_op = self._config.zero_config.offload_param
+                if plan.offload_param and zc_op.grads_to_host and \
                         mesh.devices.flat[0].platform != "cpu":
                     # param offload at capacity scale: the full grad tree
                     # must not sit in HBM through the sub-group update loop
@@ -976,9 +1007,9 @@ class DeepSpeedEngine:
         """Compute loss (and grads — fused reverse AD) for one micro-batch."""
         if self._pnvme is not None:
             raise NotImplementedError(
-                "offload_param.device=nvme supports only train_batch() — "
+                f"{self._interpreter_tier} supports only train_batch() — "
                 "the forward/backward/step split would re-stream every "
-                "layer from NVMe per phase")
+                "layer group per phase")
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         if self._compressor is not None:
@@ -1289,10 +1320,10 @@ class DeepSpeedEngine:
             if not _os.path.isdir(pdir):
                 raise NotImplementedError(
                     f"{load_dir}/{tag} is a dense checkpoint; restoring it "
-                    "into a param-NVMe engine requires materializing the "
-                    "full tree — load it with a dense engine and pass "
-                    "engine.consolidated_state_dict() as initialize("
-                    "params=...) instead")
+                    f"into a {self._interpreter_tier} engine requires "
+                    "materializing the full tree — load it with a dense "
+                    "engine and pass engine.consolidated_state_dict() as "
+                    "initialize(params=...) instead")
             template = {"params": {},
                         "opt_state": {"count": np.asarray(0)},
                         "scaler": self.scaler_state}
@@ -1305,8 +1336,8 @@ class DeepSpeedEngine:
             self.global_samples = meta.get("global_samples", 0)
             self.micro_steps = meta.get("micro_steps", 0)
             self.skipped_steps = meta.get("skipped_steps", 0)
-            log_dist(f"loaded param-NVMe checkpoint from {load_dir} "
-                     f"(tag={tag})", ranks=[0])
+            log_dist(f"loaded {self._interpreter_tier} checkpoint from "
+                     f"{load_dir} (tag={tag})", ranks=[0])
             return load_dir, meta.get("client_state", {})
         nvme_dir = _os.path.join(load_dir, tag, "nvme_opt")
         ckpt_is_nvme = _os.path.isdir(nvme_dir)

@@ -115,13 +115,27 @@ def make_pipeline_lm_loss(cfg: LlamaConfig, mesh, num_micro: Optional[int] = Non
     return loss_fn
 
 
-def pipeline_sharding_rules():
-    """Extra rules: stacked block params shard their layer dim over pipe."""
-    from deepspeed_tpu.parallel.partition import DEFAULT_TP_RULES
+def pipeline_sharding_rules(tp: bool = False):
+    """Extra rules: stacked block params shard their layer dim over pipe.
+    With ``tp``, the non-layer dims additionally ride the tensor axis
+    (matching interpreter.tp_block_specs) so block weights are STORED at
+    1/(pipe*tp) per device — the Megatron PP x TP composition
+    (reference pipe/topology.py:244)."""
+    from deepspeed_tpu.parallel.partition import DEFAULT_TP_RULES, TENSOR_AXIS
 
-    return [(r"blocks/block/.*", ("pipe", None, None)),
+    if tp:
+        block_rules = [
+            (r"blocks/block/.*(q_proj|k_proj|v_proj|gate_proj|up_proj)"
+             r".*kernel", ("pipe", None, TENSOR_AXIS)),
+            (r"blocks/block/.*(o_proj|down_proj).*kernel",
+             ("pipe", TENSOR_AXIS, None)),
+            (r"blocks/block/.*", ("pipe", None, None)),
             (r"blocks/block/.*scale", ("pipe", None)),
-            *DEFAULT_TP_RULES]
+        ]
+    else:
+        block_rules = [(r"blocks/block/.*", ("pipe", None, None)),
+                       (r"blocks/block/.*scale", ("pipe", None))]
+    return [*block_rules, *DEFAULT_TP_RULES]
 
 
 class PipelineEngine(DeepSpeedEngine):
@@ -144,27 +158,45 @@ class PipelineEngine(DeepSpeedEngine):
         schedule = getattr(pipe_cfg, "schedule", "auto")
         if num_micro is None:
             num_micro = getattr(pipe_cfg, "num_micro", None)
-        tp_like = max(mesh.shape.get("tensor", 1),
-                      mesh.shape.get("sequence", 1))
+        tp = mesh.shape.get("tensor", 1)
+        sp = mesh.shape.get("sequence", 1)
+        n_kv = cfg.num_kv_heads or cfg.num_heads
+        # the TP interpreter shards heads: indivisible MQA/GQA configs and
+        # non-XLA attention impls keep the GSPMD-gpipe path (which handles
+        # both), instead of crashing mid-trace
+        tp_interpretable = (tp == 1 or (
+            cfg.num_heads % tp == 0 and n_kv % tp == 0
+            and cfg.attention_impl in ("auto", "xla")))
         if schedule == "auto":
-            # the 1F1B interpreter enters shard_map with stage weights
-            # replicated over tensor ranks (collectives can't live inside
-            # its cond branches), so TP/SP meshes keep their partitioning
-            # only under the SPMD-gpipe path
-            schedule = "gpipe" if tp_like > 1 else "1f1b"
-            if tp_like > 1:
-                log_dist("pipeline.schedule=auto → gpipe: mesh has "
-                         f"tensor/sequence={tp_like} and the 1F1B "
-                         "interpreter would replicate stage weights across "
-                         "those ranks", ranks=[0])
-        elif schedule == "1f1b" and tp_like > 1:
-            logger.warning(
-                "pipeline.schedule=1f1b on a tensor/sequence=%d mesh: the "
-                "interpreter all-gathers stage weights over those ranks at "
-                "shard_map entry — numerically correct, but TP's "
-                "memory/compute partitioning is lost inside the pipeline; "
-                "set pipeline.schedule=gpipe (or 'auto') to keep it",
-                tp_like)
+            # 1F1B keeps tensor sharding inside the pipe loop (the
+            # interpreter's TP block fn, interpreter.make_tp_block_fn);
+            # sequence parallelism, indivisible MQA/GQA head counts, and
+            # non-XLA attention impls keep the SPMD-gpipe path (GSPMD
+            # threads those shardings/kernels; the interpreter's explicit
+            # specs don't)
+            schedule = "gpipe" if (sp > 1 or not tp_interpretable) \
+                else "1f1b"
+            if schedule == "gpipe" and (sp > 1 or tp > 1):
+                log_dist("pipeline.schedule=auto → gpipe: "
+                         + (f"mesh has sequence={sp}" if sp > 1 else
+                            f"tensor={tp} with heads {cfg.num_heads}/"
+                            f"kv {n_kv} or attention_impl="
+                            f"{cfg.attention_impl!r} outside the TP "
+                            f"interpreter's scope"), ranks=[0])
+        elif schedule == "1f1b" and sp > 1:
+            raise ValueError(
+                "pipeline.schedule=1f1b does not compose with "
+                f"sequence={sp}: the interpreter does not thread "
+                "sequence-parallel attention — use schedule=gpipe (or "
+                "'auto')")
+        elif schedule == "1f1b" and not tp_interpretable:
+            raise ValueError(
+                f"pipeline.schedule=1f1b with tensor={tp}: the TP "
+                f"interpreter shards attention heads ({cfg.num_heads} "
+                f"heads / {n_kv} kv heads must both divide tensor) and "
+                f"supports attention_impl auto/xla only (got "
+                f"{cfg.attention_impl!r}) — use schedule=gpipe (or "
+                f"'auto')")
         if schedule == "1f1b":
             # instruction-executing 1F1B (pipe/interpreter.py — reference
             # _exec_schedule, pipe/engine.py:1293)
@@ -182,7 +214,8 @@ class PipelineEngine(DeepSpeedEngine):
                 f"'1f1b' (instruction interpreter) and 'gpipe' (SPMD "
                 f"fill-drain); 'interleaved' is not implemented")
         if kwargs.get("sharding_rules") is None:
-            kwargs["sharding_rules"] = pipeline_sharding_rules()
+            kwargs["sharding_rules"] = pipeline_sharding_rules(
+                tp=schedule == "1f1b" and tp > 1)
         super().__init__(model=model, loss_fn=loss_fn, **kwargs)
         self.num_stages = mesh.shape["pipe"]
         self.pipe_schedule = schedule

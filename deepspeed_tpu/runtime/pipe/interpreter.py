@@ -69,7 +69,8 @@ def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
               blocks_local: Any, rest: Any,
               input_ids: jnp.ndarray, labels: jnp.ndarray,
               num_micro: int, *, axis_name: str = "pipe",
-              data_axis: Optional[str] = "data", dtype=jnp.float32):
+              data_axis: Optional[str] = "data", dtype=jnp.float32,
+              blocks_extra_axes=None):
     """Run the 1F1B schedule; call inside shard_map over (pipe[, data]).
 
     embed_fn(rest, ids[mb, S]) -> activations [mb, S, D]
@@ -96,26 +97,42 @@ def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
     n_buf = max(2, min(M, P))
 
     all_axes = (axis_name,) + ((data_axis,) if data_axis else ())
+    # Individual block leaves may additionally vary over TP-style axes
+    # (``blocks_extra_axes``: per-leaf tuples, e.g. ("tensor",) for the
+    # sharded kernels, () for tensor-replicated norm scales): the weight
+    # shards genuinely differ per rank there. Activations stay INVARIANT
+    # over those axes — a TP block_fn psums its partial outputs, and AD's
+    # pvary/psum transposition then inserts the Megatron-style backward
+    # input-grad reductions automatically (legal inside the cond branches:
+    # the tick predicate varies over pipe only, never over tensor).
+    if blocks_extra_axes is None:
+        blocks_extra_axes = jax.tree_util.tree_map(lambda _: (),
+                                                   blocks_local)
 
-    def _varying(x):
+    def _varying(x, axes=all_axes):
         """Mark ``x`` device-varying over every mapped axis it isn't yet.
 
-        Critical for the cond branches below: if params stayed replicated,
-        AD's vma promotion would transpose to psums INSIDE the branches —
-        collectives under a device-varying predicate deadlock. Pre-varying
-        everything keeps the branches collective-free; the explicit psums
-        after the scan do the reductions once, uniformly.
+        Critical for the cond branches below: if params stayed replicated
+        over pipe/data, AD's vma promotion would transpose to psums INSIDE
+        the branches over THOSE axes — collectives under a device-varying
+        predicate deadlock. Pre-varying keeps the branches free of
+        pipe/data collectives; the explicit psums after the scan do those
+        reductions once, uniformly.
         """
         have = set(getattr(jax.typeof(x), "vma", ()))
-        missing = tuple(a for a in all_axes if a not in have)
+        missing = tuple(a for a in axes if a not in have)
         return lax.pvary(x, missing) if missing else x
 
-    blocks_v = jax.tree_util.tree_map(_varying, blocks_local)
+    blocks_v = jax.tree_util.tree_map(
+        lambda x, ax: _varying(x, all_axes + tuple(ax)),
+        blocks_local, blocks_extra_axes)
     rest_v = jax.tree_util.tree_map(_varying, rest)
     zero_act = _varying(jnp.zeros(act_shape, dtype))
     acts0 = _varying(jnp.zeros((n_buf,) + act_shape, dtype))
     gb0 = jax.tree_util.tree_map(
-        lambda p: _varying(jnp.zeros(p.shape, jnp.float32)), blocks_local)
+        lambda p, ax: _varying(jnp.zeros(p.shape, jnp.float32),
+                               all_axes + tuple(ax)),
+        blocks_local, blocks_extra_axes)
     gr0 = jax.tree_util.tree_map(
         lambda p: _varying(jnp.zeros(p.shape, jnp.float32)), rest)
 
@@ -242,7 +259,8 @@ def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
 
 def make_1f1b_loss(embed_fn, block_fn, head_loss_fn, mesh,
                    num_micro: int, dtype=jnp.float32,
-                   block_key: str = "blocks"):
+                   block_key: str = "blocks", blocks_spec=None,
+                   extra_axes=()):
     """Build an engine-compatible loss whose VJP runs :func:`exec_1f1b`.
 
     ``params[block_key]`` holds the layer-stacked block params (leading dim
@@ -251,8 +269,20 @@ def make_1f1b_loss(embed_fn, block_fn, head_loss_fn, mesh,
     gradients in one 1F1B execution, the backward hands the (cotangent-
     scaled) gradients to ``jax.value_and_grad`` — so DeepSpeedEngine's step
     machinery (fp16 scaling included) consumes it unchanged.
+
+    ``blocks_spec``: optional pytree of PartitionSpecs for the block params
+    (a TP-aware ``block_fn`` keeps its weight shards — dims beyond 'pipe'
+    ride e.g. the 'tensor' axis); default replicates all non-layer dims.
+    ``extra_axes``: the TP-style axes (e.g. ("tensor",)) appearing in
+    blocks_spec — per-leaf vma typing is derived from the specs.
     """
     data_axis = "data" if "data" in mesh.axis_names else None
+    blocks_axes = None
+    if blocks_spec is not None:
+        extra = set(extra_axes)
+        blocks_axes = jax.tree_util.tree_map(
+            lambda spec: tuple(a for a in spec if a in extra),
+            blocks_spec, is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def _run(params, batch):
         blocks = params[block_key]
@@ -262,17 +292,19 @@ def make_1f1b_loss(embed_fn, block_fn, head_loss_fn, mesh,
             loss, gb, gr = exec_1f1b(
                 embed_fn, block_fn, head_loss_fn, blocks_l, rest_r, ids,
                 labels, num_micro, axis_name="pipe", data_axis=data_axis,
-                dtype=dtype)
+                dtype=dtype, blocks_extra_axes=blocks_axes)
             return loss, gb, gr
 
         # batch shards over data only when the mesh has that axis (the
         # executor's data_axis=None handling must be reachable)
         batch_pspec = PartitionSpec(data_axis)
+        b_spec = (PartitionSpec("pipe") if blocks_spec is None
+                  else blocks_spec)
         loss, gb, gr = jax.shard_map(
             inner, mesh=mesh,
-            in_specs=(PartitionSpec("pipe"), PartitionSpec(),
+            in_specs=(b_spec, PartitionSpec(),
                       batch_pspec, batch_pspec),
-            out_specs=(PartitionSpec(), PartitionSpec("pipe"),
+            out_specs=(PartitionSpec(), b_spec,
                        PartitionSpec()),
         )(blocks, rest, batch["input_ids"], batch["labels"])
         grads = dict(gr)
@@ -303,28 +335,121 @@ def make_1f1b_loss(embed_fn, block_fn, head_loss_fn, mesh,
     return loss_fn
 
 
+def tp_block_specs(tp_axis: str = "tensor"):
+    """PartitionSpecs for the stacked LlamaBlock tree under 1F1B x TP:
+    layer dim over pipe, column-parallel kernels' output dim and
+    row-parallel kernels' input dim over the tensor axis (the Megatron
+    partitioning the reference composes with PP,
+    runtime/pipe/topology.py:244)."""
+    col = PartitionSpec("pipe", None, tp_axis)      # q/k/v, gate/up
+    row = PartitionSpec("pipe", tp_axis, None)      # o, down
+    vec = PartitionSpec("pipe", None)               # norm scales
+    return {"block": {
+        "attn": {"q_proj": {"kernel": col}, "k_proj": {"kernel": col},
+                 "v_proj": {"kernel": col}, "o_proj": {"kernel": row}},
+        "mlp": {"gate_proj": {"kernel": col}, "up_proj": {"kernel": col},
+                "down_proj": {"kernel": row}},
+        "input_norm": {"scale": vec},
+        "post_attn_norm": {"scale": vec},
+    }}
+
+
+def make_tp_block_fn(cfg, tp_axis: str = "tensor"):
+    """TP-sharded LlamaBlock chain for the 1F1B interpreter: each tensor
+    rank computes its head/ffn shard and the partial row-parallel outputs
+    are psum'd over ``tp_axis`` — weights stay at 1/tp per device inside
+    the pipe loop (VERDICT r3 #5; the gpipe fallback is retired).
+
+    Same math as LlamaBlock.apply (RMSNorm fp32, rotary, fp32-softmax
+    attention, SwiGLU), restructured Megatron-style.
+    """
+    from deepspeed_tpu.models.transformer import (
+        dot_product_attention, make_causal_mask, rotary_embedding,
+    )
+
+    hd = cfg.hidden_size // cfg.num_heads
+    n_kv = cfg.num_kv_heads or cfg.num_heads
+
+    def rms(x, scale):
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return (x32 * lax.rsqrt(var + cfg.rms_norm_eps)
+                * scale).astype(cfg.dtype)
+
+    def block_fn(blocks_local, x):
+        tp = lax.axis_size(tp_axis)
+        assert cfg.num_heads % tp == 0 and n_kv % tp == 0, (
+            f"heads {cfg.num_heads}/kv {n_kv} must divide tensor={tp}")
+        nh_loc, nkv_loc = cfg.num_heads // tp, n_kv // tp
+        B, S = x.shape[0], x.shape[1]
+        mask = make_causal_mask(S)
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+
+        def layer(h0, w):
+            a, m = w["attn"], w["mlp"]
+            hn = rms(h0, w["input_norm"]["scale"])
+            mm = lambda t, k: t @ k.astype(cfg.dtype)
+            q = mm(hn, a["q_proj"]["kernel"]).reshape(B, S, nh_loc, hd)
+            k = mm(hn, a["k_proj"]["kernel"]).reshape(B, S, nkv_loc, hd)
+            v = mm(hn, a["v_proj"]["kernel"]).reshape(B, S, nkv_loc, hd)
+            q = rotary_embedding(q, pos, cfg.rope_base)
+            k = rotary_embedding(k, pos, cfg.rope_base)
+            if nkv_loc != nh_loc:
+                k = jnp.repeat(k, nh_loc // nkv_loc, axis=2)
+                v = jnp.repeat(v, nh_loc // nkv_loc, axis=2)
+            att = dot_product_attention(q, k, v, mask=mask)
+            att = att.astype(cfg.dtype).reshape(B, S, nh_loc * hd)
+            h1 = h0 + lax.psum(mm(att, a["o_proj"]["kernel"]), tp_axis)
+            hn = rms(h1, w["post_attn_norm"]["scale"])
+            g = mm(hn, m["gate_proj"]["kernel"])
+            u = mm(hn, m["up_proj"]["kernel"])
+            d = mm(jax.nn.silu(g) * u, m["down_proj"]["kernel"])
+            return h1 + lax.psum(d, tp_axis), None
+
+        if cfg.remat:
+            # honor the activation-checkpointing config (all scopes treated
+            # as block-scope here: the interpreter's per-tick VJP recomputes
+            # the stage anyway, so per-layer checkpointing bounds its
+            # internal residuals)
+            from deepspeed_tpu.models.llama import _remat_policy
+
+            layer = jax.checkpoint(layer,
+                                   policy=_remat_policy(cfg.remat_policy))
+        y, _ = lax.scan(layer, x, blocks_local["block"])
+        return y
+
+    return block_fn
+
+
 def make_1f1b_lm_loss(cfg, mesh, num_micro: Optional[int] = None):
     """LLaMA-family 1F1B loss (the interpreter-backed counterpart of
-    pipe/engine.make_pipeline_lm_loss — same parameter tree)."""
+    pipe/engine.make_pipeline_lm_loss — same parameter tree). On meshes
+    with tensor>1 the block weights stay tensor-sharded inside the pipe
+    loop (make_tp_block_fn)."""
     from deepspeed_tpu.models.llama import LlamaBlock
     from deepspeed_tpu.models.transformer import make_causal_mask
 
     M = num_micro or max(mesh.shape["pipe"], 1)
     block = LlamaBlock(cfg)
+    tp = mesh.shape.get("tensor", 1)
 
     def embed_fn(rest, ids):
         return rest["embed_tokens"]["embedding"][ids].astype(cfg.dtype)
 
-    def block_fn(blocks_local, x):
-        S = x.shape[-2]
-        mask = make_causal_mask(S)
-        upos = jnp.arange(S, dtype=jnp.int32)[None, :]
+    if tp > 1:
+        block_fn = make_tp_block_fn(cfg)
+    else:
+        def block_fn(blocks_local, x):
+            S = x.shape[-2]
+            mask = make_causal_mask(S)
+            upos = jnp.arange(S, dtype=jnp.int32)[None, :]
 
-        def layer(h, layer_params):
-            return block.apply({"params": layer_params}, h, mask, upos), None
+            def layer(h, layer_params):
+                return block.apply({"params": layer_params}, h, mask,
+                                   upos), None
 
-        y, _ = lax.scan(layer, x, blocks_local["block"])
-        return y
+            y, _ = lax.scan(layer, x, blocks_local["block"])
+            return y
 
     def head_loss_fn(rest, y, labels):
         scale = rest["final_norm"]["scale"]
@@ -344,5 +469,7 @@ def make_1f1b_lm_loss(cfg, mesh, num_micro: Optional[int] = None):
         ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
         return jnp.sum(jnp.where(valid, -ll, 0.0)), jnp.sum(valid)
 
-    return make_1f1b_loss(embed_fn, block_fn, head_loss_fn, mesh, M,
-                          dtype=cfg.dtype)
+    return make_1f1b_loss(
+        embed_fn, block_fn, head_loss_fn, mesh, M, dtype=cfg.dtype,
+        blocks_spec=tp_block_specs() if tp > 1 else None,
+        extra_axes=("tensor",) if tp > 1 else ())

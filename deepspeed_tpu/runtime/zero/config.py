@@ -43,6 +43,17 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     # forfeiting the capacity the offload exists for) — without this flag
     # such models RAISE instead of silently degrading
     fallback_whole_tree: bool = False
+    # >0: the grouped streaming interpreter (zero/grouped_stream.py) —
+    # N layers per host-driven program, gradients accumulate in pinned
+    # host memory. Needed when the fp32 grad tree alone exceeds HBM
+    # (~3.5B fp32 on v5e), where the single-program streamed step
+    # compile-refuses
+    grouped_stream: int = Field(0, ge=0)
+    # land the grad tree in pinned host memory as backward produces it
+    # (capacity default). At scales where the grads fit HBM comfortably,
+    # false skips the host round-trip — faster steps, params/moments stay
+    # offloaded either way
+    grads_to_host: bool = True
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

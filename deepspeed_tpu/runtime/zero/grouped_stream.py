@@ -1,0 +1,546 @@
+"""Grouped streaming offload: layer-group programs over pinned-host state.
+
+Why this tier exists: the single-program streamed offload
+(``offload_param: cpu`` + StreamedLlamaModel) keeps HBM residency at one
+LAYER of weights — but XLA still accumulates the full fp32 gradient tree
+on device during the backward scan, so the design caps where grads fit
+HBM (~3.5B fp32 on a 15.75 GB v5e; the 7B step compile-refuses at
+25.5 GB, tools/probe_7b_step_memory.py). The reference has no such cap:
+its hook-driven eager backward frees each grad as it is reduced
+(``runtime/zero/stage3.py:1081`` IPG reduce + partition_grads).
+
+This tier restores that scaling: the step becomes a host-driven loop of
+per-GROUP jitted programs (groups of ``grouped_stream`` layers), where
+
+- master params, Adam moments, and gradient accumulators live as
+  PINNED-HOST jax arrays — on a TPU VM that is the accelerator host's
+  RAM, reached over PCIe in-graph; the orchestrating client only ever
+  moves scalars,
+- each group's forward/backward fetches that group's fp32 weights
+  host→HBM inside the program (cast to the compute dtype in-graph),
+  recomputes the group forward (block remat), runs the VJP, and writes
+  the group's fp32 grads straight back to host outputs,
+- boundary activations between groups are stashed in pinned host memory
+  (``param_nvme``'s stash, at group granularity),
+- the update is a per-leaf swapped AdamW: params+m+v+grads make one
+  host→HBM→host round trip per leaf slice, so device residency during
+  the whole step is ONE group's weights + grads + activations.
+
+Same loud scope as the NVMe tier: scanned-Llama models, Adam family,
+bf16/fp32, single process. Reference analogues:
+``runtime/zero/parameter_offload.py:201`` (fetch/release around
+submodules), ``stage_1_and_2.py:1037`` (grads accumulated in pinned CPU
+buffers), ``stage3.py:1775-1835`` (per-sub-group swapped step).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.zero.param_nvme import ADAM_FAMILY
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def validate_grouped_stream_config(config, mesh) -> None:
+    """Loud errors for unsupported grouped_stream combinations."""
+    zc = config.zero_config
+    opt = config.optimizer
+    opt_name = (opt.type if opt is not None else "adamw").lower()
+    if zc.stage < 3:
+        raise ValueError(
+            f"offload_param.grouped_stream requires zero_optimization."
+            f"stage=3 (got stage={zc.stage})")
+    if zc.offload_optimizer_device != "cpu":
+        raise ValueError(
+            "offload_param.grouped_stream requires offload_optimizer."
+            "device=cpu (moments live in pinned host memory; an in-HBM "
+            "optimizer would defeat the tier, and the NVMe tier has its "
+            "own interpreter — zero/param_nvme.py)")
+    if opt_name not in ADAM_FAMILY:
+        raise ValueError(
+            f"offload_param.grouped_stream uses the per-leaf swapped Adam "
+            f"step and supports Adam-family optimizers only "
+            f"({'/'.join(ADAM_FAMILY)}); got {opt_name!r}")
+    if config.fp16.enabled:
+        raise NotImplementedError(
+            "offload_param.grouped_stream does not support fp16 loss "
+            "scaling; use bf16 (TPU-native) or fp32")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "offload_param.grouped_stream is single-host only "
+            f"(jax.process_count()={jax.process_count()})")
+    if mesh is not None and any(
+            mesh.shape.get(ax, 1) > 1
+            for ax in ("pipe", "tensor", "sequence", "expert")):
+        raise NotImplementedError(
+            "offload_param.grouped_stream composes with plain data-parallel "
+            f"meshes only (got {dict(mesh.shape)})")
+    for feature, enabled in (
+            ("compression", _any_compression(config)),
+            ("eigenvalue", config.eigenvalue_enabled),
+            ("progressive_layer_drop", config.pld_enabled),
+            ("flops_profiler", config.flops_profiler.enabled),
+            ("quantize_training", config.quantize_training_enabled)):
+        if enabled:
+            raise NotImplementedError(
+                f"offload_param.grouped_stream does not compose with "
+                f"{feature} (both rewrite the loss/step)")
+
+
+def _any_compression(config) -> bool:
+    from deepspeed_tpu.compression import get_compression_config
+
+    return get_compression_config(config.compression_config).any_enabled
+
+
+class GroupedStreamTrainer:
+    """Owns pinned-host parameters/moments and the grouped streamed step.
+
+    Duck-typed to the engine's interpreter surface (``zero/param_nvme.py``
+    NVMeParamTrainer): train_batch / loss_eval / materialize / ingest /
+    save_files / load_files / count / close.
+    """
+
+    def __init__(self, cfg, config, mesh, rng):
+        from deepspeed_tpu.models.llama import LlamaBlock, LlamaConfig
+
+        assert isinstance(cfg, LlamaConfig), (
+            "offload_param.grouped_stream streams the scanned-Llama layer "
+            f"loop; model config must be a LlamaConfig (got {type(cfg)})")
+        assert cfg.scan_layers, (
+            "offload_param.grouped_stream requires scan_layers=True")
+        self.cfg = cfg
+        self.mesh = mesh
+        zc = config.zero_config
+        self.L = cfg.num_layers
+        self.G = int(zc.offload_param.grouped_stream)
+        assert self.G >= 1, "grouped_stream must be >= 1 layer per group"
+        self.bounds = [(lo, min(lo + self.G, self.L))
+                       for lo in range(0, self.L, self.G)]
+        self.gas = config.gradient_accumulation_steps
+        self.grad_clip = float(config.gradient_clipping or 0.0)
+        self.numerics = config.numerics_check_enabled
+
+        opt_cfg = config.optimizer
+        p = dict(opt_cfg.params) if opt_cfg is not None else {}
+        betas = p.get("betas", (p.get("beta1", 0.9), p.get("beta2", 0.999)))
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(p.get("eps", 1e-8))
+        self.weight_decay = float(p.get("weight_decay", 0.0))
+        self.base_lr = float(p.get("lr", 1e-3))
+        self.count = 0
+        # typed moment STORAGE (update math stays fp32 — the same contract
+        # as ops/optimizers.scale_by_adam_typed); at 7B this is the knob
+        # that brings host state from 108 GB (fp32 m/v) to 81 GB
+        from deepspeed_tpu.ops.optimizers import _moment_dtypes
+
+        mu_dt, nu_dt = _moment_dtypes(p)
+        if nu_dt == "factored":
+            raise NotImplementedError(
+                "offload_param.grouped_stream stores dense per-leaf moment "
+                "files; nu_dtype='factored' is a fused-engine HBM knob — "
+                "host moments are already off-chip (use moment_dtype: "
+                "bfloat16 to halve host state instead)")
+        self.mu_dtype = mu_dt or jnp.float32
+        self.nu_dtype = nu_dt or jnp.float32
+
+        from deepspeed_tpu.runtime.zero.stages import _supports_host_memory
+
+        host_ok = _supports_host_memory(mesh)
+        kind = "pinned_host" if host_ok else "device"
+        self._host = NamedSharding(mesh, PartitionSpec(), memory_kind=kind)
+        self._dev = NamedSharding(mesh, PartitionSpec())
+        # jit with host-annotated OUTPUTS works on TPU; the virtual CPU
+        # backend rejects it (same RAM either way) — mirror _sharded_init
+        self._out_host = self._host if (host_ok and
+                                        mesh.devices.flat[0].platform
+                                        == "tpu") else self._dev
+
+        self.block = LlamaBlock(cfg)
+        self._build_programs()
+        self._init_state(rng)
+        log_dist(
+            f"grouped-stream offload: {self.L} layers in "
+            f"{len(self.bounds)} groups of <= {self.G} "
+            f"(host kind: {kind}; moments "
+            f"{self.mu_dtype.__name__}/{self.nu_dtype.__name__})",
+            ranks=[0])
+
+    # --- programs --------------------------------------------------------
+    def _build_programs(self) -> None:
+        cfg = self.cfg
+        from deepspeed_tpu.models.llama import _remat_policy
+        from deepspeed_tpu.models.llama import loss_fn as lm_loss
+        from deepspeed_tpu.models.transformer import RMSNorm, make_causal_mask
+
+        block = self.block
+        norm = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype)
+        dev = self._dev
+        out_host = self._out_host
+
+        def fetch(tree):
+            return jax.tree_util.tree_map(
+                lambda w: jax.device_put(w, dev), tree)
+
+        def emb_fwd(rest, ids):
+            r = fetch(rest)
+            return r["embed_tokens"]["embedding"][ids].astype(cfg.dtype)
+
+        def group_chain(wg_dev, x, pos):
+            mask = make_causal_mask(x.shape[-2])
+
+            def body(h, wslice):
+                return block.apply({"params": wslice}, h, mask, pos), None
+
+            if cfg.remat:
+                body = jax.checkpoint(
+                    body, policy=_remat_policy(cfg.remat_policy))
+            x, _ = jax.lax.scan(body, x, wg_dev)
+            return x
+
+        def group_fwd(wg, x, pos):
+            return group_chain(fetch(wg), x, pos)
+
+        def head_loss(rest, x, labels):
+            r = fetch(rest)
+            xn = norm.apply({"params": r["final_norm"]}, x)
+            if cfg.tie_embeddings:
+                emb = r["embed_tokens"]["embedding"].astype(cfg.dtype)
+                logits = jnp.dot(xn.astype(jnp.float32).astype(cfg.dtype),
+                                 emb.T)
+            else:
+                k = r["lm_head"]["kernel"].astype(cfg.dtype)
+                logits = jnp.dot(xn.astype(cfg.dtype), k)
+            return lm_loss(logits.astype(jnp.float32), labels)
+
+        def head_vjp(rest, x, labels):
+            loss, pull = jax.vjp(
+                lambda r, h: head_loss(r, h, labels), rest, x)
+            drest, dx = pull(jnp.ones((), jnp.float32))
+            return loss, dx, drest
+
+        def group_vjp(wg, x, pos, dy):
+            _, pull = jax.vjp(
+                lambda w, h: group_chain(fetch(w), h, pos), wg, x)
+            dw, dx = pull(dy)
+            return dx, dw
+
+        def acc_tree(prev, new):
+            # in-graph host fetch + add; result back to host
+            return jax.tree_util.tree_map(
+                lambda a, b: jax.device_put(a, dev) + b, prev, new)
+
+        def group_vjp_acc(wg, x, pos, dy, gprev):
+            dx, dw = group_vjp(wg, x, pos, dy)
+            return dx, acc_tree(gprev, dw)
+
+        def head_vjp_acc(rest, x, labels, gprev):
+            loss, dx, drest = head_vjp(rest, x, labels)
+            return loss, dx, acc_tree(gprev, drest)
+
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+
+        def adam_leaf(pv, m, v, g, lr, clip_scale, t, inv_gas):
+            pv, m, v, g = (jax.device_put(a, dev) for a in (pv, m, v, g))
+            mdt, vdt = m.dtype, v.dtype
+            g = g.astype(jnp.float32) * inv_gas * clip_scale
+            m = b1 * m.astype(jnp.float32) + (1 - b1) * g
+            v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            step = mhat / (jnp.sqrt(vhat) + eps)
+            if wd:
+                step = step + wd * pv.astype(jnp.float32)
+            new_p = (pv.astype(jnp.float32) - lr * step).astype(pv.dtype)
+            return new_p, m.astype(mdt), v.astype(vdt)
+
+        host3 = (out_host, out_host, out_host)
+        self._jit_emb_fwd = jax.jit(emb_fwd)
+        self._jit_group_fwd = jax.jit(group_fwd)
+        self._jit_head_loss = jax.jit(head_loss)
+        self._jit_head_vjp = jax.jit(
+            head_vjp, out_shardings=(dev, dev, out_host))
+        self._jit_group_vjp = jax.jit(
+            group_vjp, out_shardings=(dev, out_host))
+        self._jit_group_vjp_acc = jax.jit(
+            group_vjp_acc, out_shardings=(dev, out_host))
+        self._jit_head_vjp_acc = jax.jit(
+            head_vjp_acc, out_shardings=(dev, dev, out_host))
+        self._jit_adam_leaf = jax.jit(adam_leaf, out_shardings=host3)
+
+        def emb_vjp_acc(rest, ids, dx, gprev):
+            _, pull = jax.vjp(lambda r: emb_fwd(r, ids), rest)
+            (drest,) = pull(dx)
+            return acc_tree(gprev, drest)
+
+        self._jit_emb_vjp_acc = jax.jit(emb_vjp_acc, out_shardings=out_host)
+
+    # --- state -----------------------------------------------------------
+    def _init_state(self, rng) -> None:
+        """Per-group streamed init: each group's params materialize on
+        device ([G, ...] — fits), land pinned-host, and are freed before
+        the next group exists. The full tree never exists in HBM (the
+        single-program init is exactly what OOMs at 7B)."""
+        from deepspeed_tpu.models.transformer import make_causal_mask
+
+        cfg = self.cfg
+        S0 = min(4, cfg.max_seq_len)
+        x0 = jnp.zeros((1, S0, cfg.hidden_size), cfg.dtype)
+        pos0 = jnp.arange(S0, dtype=jnp.int32)[None, :]
+        mask0 = make_causal_mask(S0)
+
+        group_init = jax.jit(
+            lambda ks: jax.vmap(
+                lambda k: self.block.init(k, x0, mask0, pos0)["params"])(ks),
+            out_shardings=self._out_host)
+        keys = jax.random.split(rng, self.L + 1)
+        self._w: List[Any] = []
+        self._mu: List[Any] = []
+        self._nu: List[Any] = []
+        mu_dt, nu_dt = self.mu_dtype, self.nu_dtype
+        zeros_mu = jax.jit(
+            lambda t: jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, mu_dt), t),
+            out_shardings=self._out_host)
+        zeros_nu = jax.jit(
+            lambda t: jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, nu_dt), t),
+            out_shardings=self._out_host)
+        for lo, hi in self.bounds:
+            wg = group_init(keys[lo:hi])
+            self._w.append(wg)
+            self._mu.append(zeros_mu(wg))
+            self._nu.append(zeros_nu(wg))
+
+        def init_rest(k):
+            import flax.linen as nn
+
+            k1, k2 = jax.random.split(k)
+            embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                             param_dtype=jnp.float32, dtype=cfg.dtype)
+            rest = {
+                "embed_tokens": embed.init(
+                    k1, jnp.zeros((1, 1), jnp.int32))["params"],
+                "final_norm": {"scale": jnp.ones((cfg.hidden_size,),
+                                                 jnp.float32)},
+            }
+            if not cfg.tie_embeddings:
+                head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                dtype=cfg.dtype, param_dtype=jnp.float32)
+                rest["lm_head"] = head.init(
+                    k2, jnp.zeros((1, 1, cfg.hidden_size), cfg.dtype)
+                )["params"]
+            return rest
+
+        self._rest = jax.jit(init_rest, out_shardings=self._out_host)(
+            keys[self.L])
+        self._mu_rest = zeros_mu(self._rest)
+        self._nu_rest = zeros_nu(self._rest)
+
+    # --- stash (shared with the NVMe tier) --------------------------------
+    from deepspeed_tpu.runtime.zero.param_nvme import (
+        stash_to_host as _stash_fn, unstash_from_host as _unstash_fn,
+    )
+    _stash = staticmethod(_stash_fn)
+    _unstash = staticmethod(_unstash_fn)
+
+    # --- step ------------------------------------------------------------
+    def train_batch(self, batch: Dict[str, Any], lr: Optional[float] = None):
+        ids_all, labels_all = batch["input_ids"], batch["labels"]
+        gas = int(ids_all.shape[0])
+        pos_all = batch.get("positions")
+        nG = len(self.bounds)
+
+        g_groups: List[Any] = [None] * nG
+        g_rest = None
+        loss_acc = None
+
+        for g in range(gas):
+            ids, labels = jnp.asarray(ids_all[g]), jnp.asarray(labels_all[g])
+            S = int(ids.shape[-1])
+            pos = (jnp.asarray(pos_all[g]) if pos_all is not None
+                   else jnp.arange(S, dtype=jnp.int32)[None, :])
+            x = self._jit_emb_fwd(self._rest, ids)
+            stash = []
+            for gi in range(nG):
+                stash.append(self._stash(x))
+                x = self._jit_group_fwd(self._w[gi], x, pos)
+            if g_rest is None:
+                loss, dx, g_rest = self._jit_head_vjp(self._rest, x, labels)
+            else:
+                loss, dx, g_rest = self._jit_head_vjp_acc(
+                    self._rest, x, labels, g_rest)
+            loss_acc = loss if loss_acc is None else loss_acc + loss
+            for gi in reversed(range(nG)):
+                x_in = self._unstash(stash[gi])
+                if g_groups[gi] is None:
+                    dx, g_groups[gi] = self._jit_group_vjp(
+                        self._w[gi], x_in, pos, dx)
+                else:
+                    dx, g_groups[gi] = self._jit_group_vjp_acc(
+                        self._w[gi], x_in, pos, dx, g_groups[gi])
+            # embedding grads accumulate into the same rest tree the head
+            # already populated (zeros elsewhere from the vjp)
+            g_rest = self._jit_emb_vjp_acc(self._rest, ids, dx, g_rest)
+
+        # global norm over ACCUMULATED grads (scaled by 1/gas to match the
+        # fused engine's mean-over-micro-batches semantics)
+        inv = 1.0 / gas
+        sq_total = 0.0
+        finite = True
+        sqfn = getattr(self, "_jit_sq", None)
+        if sqfn is None:
+            dev = self._dev
+
+            def sq_and_finite(tree):
+                leaves = [jax.device_put(l, dev).astype(jnp.float32)
+                          for l in jax.tree_util.tree_leaves(tree)]
+                sq = sum(jnp.sum(jnp.square(l)) for l in leaves)
+                ok = jnp.asarray(True)
+                for l in leaves:
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(l)))
+                return sq, ok
+
+            sqfn = self._jit_sq = jax.jit(sq_and_finite)
+        for tree in g_groups + [g_rest]:
+            sq, ok = sqfn(tree)
+            sq_total += float(sq)
+            if self.numerics:
+                finite = finite and bool(ok)
+        gnorm = float(np.sqrt(sq_total)) * inv
+        loss = float(np.asarray(loss_acc)) / gas
+        if self.numerics:
+            finite = finite and bool(np.isfinite(loss)) \
+                and bool(np.isfinite(gnorm))
+        else:
+            finite = True
+        if finite:
+            clip = (min(1.0, self.grad_clip / (gnorm + 1e-6))
+                    if self.grad_clip > 0 else 1.0)
+            self._apply_updates(g_groups, g_rest, clip, lr, inv)
+        return jnp.asarray(loss, jnp.float32), jnp.asarray(finite)
+
+    def _apply_updates(self, g_groups, g_rest, clip_scale, lr, inv) -> None:
+        self.count += 1
+        t = jnp.asarray(self.count, jnp.float32)
+        lr_v = jnp.asarray(self.base_lr if lr is None else lr, jnp.float32)
+        cs = jnp.asarray(clip_scale, jnp.float32)
+        inv_v = jnp.asarray(inv, jnp.float32)
+
+        def upd(wtree, mtree, vtree, gtree):
+            wl, tdef = jax.tree_util.tree_flatten(wtree)
+            ml = jax.tree_util.tree_leaves(mtree)
+            vl = jax.tree_util.tree_leaves(vtree)
+            gl = jax.tree_util.tree_leaves(gtree)
+            new_w, new_m, new_v = [], [], []
+            for pw, pm, pv, pg in zip(wl, ml, vl, gl):
+                nw, nm, nv = self._jit_adam_leaf(pw, pm, pv, pg, lr_v, cs,
+                                                 t, inv_v)
+                new_w.append(nw)
+                new_m.append(nm)
+                new_v.append(nv)
+            return (jax.tree_util.tree_unflatten(tdef, new_w),
+                    jax.tree_util.tree_unflatten(tdef, new_m),
+                    jax.tree_util.tree_unflatten(tdef, new_v))
+
+        for gi in range(len(self.bounds)):
+            self._w[gi], self._mu[gi], self._nu[gi] = upd(
+                self._w[gi], self._mu[gi], self._nu[gi], g_groups[gi])
+        self._rest, self._mu_rest, self._nu_rest = upd(
+            self._rest, self._mu_rest, self._nu_rest, g_rest)
+
+    # --- eval / interop ---------------------------------------------------
+    def loss_eval(self, batch: Dict[str, Any]):
+        ids, labels = jnp.asarray(batch["input_ids"]), \
+            jnp.asarray(batch["labels"])
+        S = int(ids.shape[-1])
+        pos = batch.get("positions")
+        pos = (jnp.asarray(pos) if pos is not None
+               else jnp.arange(S, dtype=jnp.int32)[None, :])
+        x = self._jit_emb_fwd(self._rest, ids)
+        for gi in range(len(self.bounds)):
+            x = self._jit_group_fwd(self._w[gi], x, pos)
+        return self._jit_head_loss(self._rest, x, labels)
+
+    def materialize(self) -> Dict[str, Any]:
+        """Full host-numpy parameter pytree in the engine's stacked layout
+        (pulls everything to the client — tests/export only)."""
+        slices = [jax.tree_util.tree_map(np.asarray, w) for w in self._w]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *slices)
+        out = {k: jax.tree_util.tree_map(np.asarray, v)
+               for k, v in self._rest.items()}
+        out["blocks"] = {"block": stacked}
+        return out
+
+    def ingest(self, params: Dict[str, Any]) -> None:
+        stacked = params["blocks"]["block"]
+        for gi, (lo, hi) in enumerate(self.bounds):
+            self._w[gi] = jax.tree_util.tree_map(
+                lambda a, cur: jax.device_put(
+                    np.asarray(a)[lo:hi], cur.sharding),
+                stacked, self._w[gi])
+        self._rest = jax.tree_util.tree_map(
+            lambda a, cur: jax.device_put(np.asarray(a), cur.sharding),
+            {k: v for k, v in params.items() if k != "blocks"}, self._rest)
+
+    # --- checkpoint -------------------------------------------------------
+    def save_files(self, dst_dir: str) -> None:
+        os.makedirs(dst_dir, exist_ok=True)
+
+        def dump(name, tree):
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+                np.asarray(leaf, np.float32).tofile(
+                    os.path.join(dst_dir, f"{name}.{i}.bin"))
+
+        for gi in range(len(self.bounds)):
+            dump(f"gs_w{gi:03d}", self._w[gi])
+            dump(f"gs_m{gi:03d}", self._mu[gi])
+            dump(f"gs_v{gi:03d}", self._nu[gi])
+        dump("gs_rest_w", self._rest)
+        dump("gs_rest_m", self._mu_rest)
+        dump("gs_rest_v", self._nu_rest)
+        with open(os.path.join(dst_dir, "grouped_stream_meta.json"),
+                  "w") as f:
+            json.dump({"num_layers": self.L, "group": self.G,
+                       "count": self.count,
+                       "tie_embeddings": self.cfg.tie_embeddings}, f)
+
+    def load_files(self, src_dir: str,
+                   load_optimizer_states: bool = True) -> None:
+        with open(os.path.join(src_dir, "grouped_stream_meta.json")) as f:
+            meta = json.load(f)
+        if meta["num_layers"] != self.L or meta["group"] != self.G:
+            raise ValueError(
+                f"grouped-stream checkpoint is {meta['num_layers']} layers "
+                f"/ group {meta['group']}; engine has {self.L}/{self.G}")
+
+        def adopt(name, tree):
+            leaves, tdef = jax.tree_util.tree_flatten(tree)
+            out = []
+            for i, leaf in enumerate(leaves):
+                arr = np.fromfile(
+                    os.path.join(src_dir, f"{name}.{i}.bin"),
+                    dtype=np.float32).reshape(leaf.shape)
+                arr = arr.astype(leaf.dtype)    # typed-moment storage
+                out.append(jax.device_put(arr, leaf.sharding))
+            return jax.tree_util.tree_unflatten(tdef, out)
+
+        for gi in range(len(self.bounds)):
+            self._w[gi] = adopt(f"gs_w{gi:03d}", self._w[gi])
+            if load_optimizer_states:
+                self._mu[gi] = adopt(f"gs_m{gi:03d}", self._mu[gi])
+                self._nu[gi] = adopt(f"gs_v{gi:03d}", self._nu[gi])
+        self._rest = adopt("gs_rest_w", self._rest)
+        if load_optimizer_states:
+            self._mu_rest = adopt("gs_rest_m", self._mu_rest)
+            self._nu_rest = adopt("gs_rest_v", self._nu_rest)
+            self.count = int(meta["count"])
+
+    def close(self) -> None:
+        self._w = self._mu = self._nu = []

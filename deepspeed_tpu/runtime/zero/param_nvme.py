@@ -65,6 +65,11 @@ def validate_param_nvme_config(config, mesh) -> None:
         raise ValueError(
             "offload_param.device=nvme requires offload_param.nvme_path "
             "(the swap directory)")
+    if zc.offload_param.grouped_stream:
+        raise ValueError(
+            "offload_param.grouped_stream composes with device=cpu only "
+            "(pinned-host state); the NVMe tier has its own per-layer "
+            "interpreter — drop grouped_stream or set device=cpu")
     if zc.offload_optimizer_device not in ("cpu", "nvme"):
         raise ValueError(
             "offload_param.device=nvme requires offload_optimizer.device "
@@ -120,6 +125,23 @@ def get_any_compression(config) -> bool:
     from deepspeed_tpu.compression import get_compression_config
 
     return get_compression_config(config.compression_config).any_enabled
+
+
+def stash_to_host(x):
+    """Move an activation to pinned host memory (backends without a host
+    space — the virtual CPU mesh — keep it where it is). Shared by the
+    interpreter tiers (param-NVMe and grouped-stream)."""
+    try:
+        return jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
+    except Exception:       # backend without host memory space (CPU)
+        return x
+
+
+def unstash_from_host(x):
+    if getattr(getattr(x, "sharding", None), "memory_kind", None) \
+            == "pinned_host":
+        return jax.device_put(x, x.sharding.with_memory_kind("device"))
+    return x
 
 
 class _HostParamCache:
@@ -422,19 +444,8 @@ class NVMeParamTrainer:
         return self._put_dev(self._get_host(None), self._rest_sh)
 
     # --- activation stash -------------------------------------------------
-    def _stash(self, x):
-        try:
-            return jax.device_put(
-                x, x.sharding.with_memory_kind("pinned_host"))
-        except Exception:       # backend without host memory space (CPU)
-            return x
-
-    def _unstash(self, x):
-        if getattr(getattr(x, "sharding", None), "memory_kind", None) \
-                == "pinned_host":
-            return jax.device_put(
-                x, x.sharding.with_memory_kind("device"))
-        return x
+    _stash = staticmethod(stash_to_host)
+    _unstash = staticmethod(unstash_from_host)
 
     # --- the streamed step ------------------------------------------------
     def train_batch(self, batch: Dict[str, Any], lr: Optional[float] = None):

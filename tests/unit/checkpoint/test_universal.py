@@ -113,55 +113,10 @@ def test_optimizer_state_actually_restored(tmp_path):
 # arrays, so changing the expert-axis degree at resume must preserve the
 # trajectory — including expert optimizer state.
 
-def _moe_model_and_loss():
-    import flax.linen as nn
-
-    from deepspeed_tpu.models.llama import loss_fn as lm_loss
-    from deepspeed_tpu.models.transformer import (
-        GatedMLP, RMSNorm, SelfAttention, make_causal_mask,
-    )
-    from deepspeed_tpu.moe.layer import MoE
-
-    V, D, F, H, E = 256, 32, 64, 4, 4
-
-    class MoELM(nn.Module):
-        @nn.compact
-        def __call__(self, ids):
-            B, S = ids.shape
-            x = nn.Embed(V, D, dtype=jnp.float32, name="wte")(ids)
-            mask = make_causal_mask(S)
-            aux_total = 0.0
-            for i in range(2):
-                h = RMSNorm(dtype=jnp.float32, name=f"ln_a{i}")(x)
-                x = x + SelfAttention(num_heads=H, dtype=jnp.float32,
-                                      assume_causal_mask=True,
-                                      name=f"attn{i}")(h, mask=mask)
-                h = RMSNorm(dtype=jnp.float32, name=f"ln_m{i}")(x)
-                if i % 2 == 1:
-                    out, aux = MoE(num_experts=E, hidden_size=D,
-                                   intermediate_size=F, k=1,
-                                   dtype=jnp.float32, name=f"moe{i}")(h)
-                    x = x + out
-                    aux_total = aux_total + aux
-                else:
-                    x = x + GatedMLP(intermediate_size=F,
-                                     dtype=jnp.float32, name=f"mlp{i}")(h)
-            x = RMSNorm(dtype=jnp.float32, name="ln_f")(x)
-            logits = nn.Dense(V, use_bias=False, dtype=jnp.float32,
-                              name="lm_head")(x)
-            return logits.astype(jnp.float32), aux_total
-
-    model = MoELM()
-
-    def loss(params, batch, rngs=None):
-        logits, aux = model.apply({"params": params}, batch["input_ids"])
-        return lm_loss(logits, batch["labels"]) + 0.01 * aux
-
-    return model, loss
-
-
 def _moe_engine(expert, zero_stage=1):
-    model, loss = _moe_model_and_loss()
+    from tests.unit.moe_fixtures import moe_model_and_loss
+
+    model, loss = moe_model_and_loss()
     mesh = make_mesh(dims={"pipe": 1, "data": 8, "expert": expert,
                            "sequence": 1, "tensor": 1})
     cfg = {"train_batch_size": 8,

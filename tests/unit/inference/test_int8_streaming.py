@@ -152,3 +152,30 @@ def test_streaming_validation_errors():
             config={"dtype": "float32",
                     "quant": {"enabled": True, "bits": 8,
                               "streaming": True}})
+
+
+def test_panel_pin_and_autotune_gate():
+    """quant.block_n pins the streaming panel; off-TPU the microbench is
+    skipped and the measured default ships."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = np.random.default_rng(0).integers(1, 250, (1, 16))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    e = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "streaming": True,
+                          "block_n": 128}})
+    out = e.generate(ids, max_new_tokens=4)
+    assert out.shape == (1, 20)
+    assert e._decoder.int8_block_n == 128
+
+    e2 = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "bits": 8, "streaming": True}})
+    e2.generate(ids, max_new_tokens=4)
+    assert e2._decoder.int8_block_n == 256      # off-TPU: no microbench

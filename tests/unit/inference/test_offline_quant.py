@@ -29,9 +29,7 @@ from deepspeed_tpu.inference.offline_quant import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
-TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))), "tools",
-    "make_hf_llama_ckpt.py")
+TOOL = os.path.join(REPO, "tools", "make_hf_llama_ckpt.py")
 
 
 @pytest.fixture(scope="module")
@@ -197,16 +195,22 @@ def test_int8_matmul_prepadded_weight():
     from deepspeed_tpu.ops.int8_matmul import int8_matmul, quantize_rowwise
 
     rng = np.random.default_rng(0)
-    K, N, pad = 100, 64, 28
+    K, Kp = 1500, 2048        # offline padding targets 2048 multiples
+    N = 64
     x = jnp.asarray(rng.standard_normal((2, K)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
     q, s = quantize_rowwise(w)
-    qp = jnp.pad(q, ((0, pad), (0, 0)))
-    sp = jnp.pad(s, (0, pad), constant_values=1.0)
-    ref = int8_matmul(x, q, s, block_k=64, block_n=64)
-    got = int8_matmul(x, qp, sp, block_k=64, block_n=64)
+    qp = jnp.pad(q, ((0, Kp - K), (0, 0)))
+    sp = jnp.pad(s, (0, Kp - K), constant_values=1.0)
+    ref = int8_matmul(x, q, s, block_k=256, block_n=64)
+    got = int8_matmul(x, qp, sp, block_k=256, block_n=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+    # a mismatched pairing (not the 2048-padding contract) still asserts
+    bad_q = jnp.pad(q, ((0, 100), (0, 0)))
+    with np.testing.assert_raises(AssertionError):
+        int8_matmul(x, bad_q, jnp.pad(s, (0, 100)), block_k=256,
+                    block_n=64)
 
 
 def test_prefused_matches_in_graph_fuse(tiny_ckpt):

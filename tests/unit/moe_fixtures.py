@@ -1,0 +1,55 @@
+"""Shared MoE test model: tiny alternating dense/MoE LM.
+
+One definition serving both the expert-axis universal-checkpoint
+trajectories (tests/unit/checkpoint/test_universal.py) and the
+expert-parallel multiprocess worker (tests/unit/multiprocess/
+worker_train.py) — the cross-process and resharding coverage must pin the
+SAME architecture.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def moe_model_and_loss(vocab=256, hidden=32, ffn=64, heads=4, experts=4,
+                       k=1):
+    from deepspeed_tpu.models.llama import loss_fn as lm_loss
+    from deepspeed_tpu.models.transformer import (
+        GatedMLP, RMSNorm, SelfAttention, make_causal_mask,
+    )
+    from deepspeed_tpu.moe.layer import MoE
+
+    class MoELM(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            B, S = ids.shape
+            x = nn.Embed(vocab, hidden, dtype=jnp.float32, name="wte")(ids)
+            mask = make_causal_mask(S)
+            aux_total = 0.0
+            for i in range(2):
+                h = RMSNorm(dtype=jnp.float32, name=f"ln_a{i}")(x)
+                x = x + SelfAttention(num_heads=heads, dtype=jnp.float32,
+                                      assume_causal_mask=True,
+                                      name=f"attn{i}")(h, mask=mask)
+                h = RMSNorm(dtype=jnp.float32, name=f"ln_m{i}")(x)
+                if i % 2 == 1:
+                    out, aux = MoE(num_experts=experts, hidden_size=hidden,
+                                   intermediate_size=ffn, k=k,
+                                   dtype=jnp.float32, name=f"moe{i}")(h)
+                    x = x + out
+                    aux_total = aux_total + aux
+                else:
+                    x = x + GatedMLP(intermediate_size=ffn,
+                                     dtype=jnp.float32, name=f"mlp{i}")(h)
+            x = RMSNorm(dtype=jnp.float32, name="ln_f")(x)
+            logits = nn.Dense(vocab, use_bias=False, dtype=jnp.float32,
+                              name="lm_head")(x)
+            return logits.astype(jnp.float32), aux_total
+
+    model = MoELM()
+
+    def loss(params, batch, rngs=None):
+        logits, aux = model.apply({"params": params}, batch["input_ids"])
+        return lm_loss(logits, batch["labels"]) + 0.01 * aux
+
+    return model, loss

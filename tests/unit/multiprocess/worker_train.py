@@ -35,28 +35,65 @@ def main(result_path: str) -> None:
     import deepspeed_tpu
     from deepspeed_tpu import comm as dist
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    from deepspeed_tpu.parallel.mesh import make_mesh
 
     dist.init_distributed()         # the comm.py rendezvous branch
     assert jax.process_count() == int(os.environ["DS_TPU_NUM_PROCESSES"]), \
         f"rendezvous failed: {jax.process_count()} processes"
 
     ckpt_dir = os.environ["MP_CKPT_DIR"]
+    variant = os.environ.get("MP_VARIANT", "zero2")
     B, S = 8, 16
+    n = jax.device_count()
+
+    # mesh + per-variant config over the GLOBAL device set (VERDICT r3 #6:
+    # the reference's DistributedTest runs every feature over real ranked
+    # processes; zero-2 DP was the only axis crossing a process boundary)
+    mesh_dims = {"pipe": 1, "data": n, "expert": 1, "sequence": 1,
+                 "tensor": 1}
+    zero_stage = 2
+    pipeline = None
+    if variant == "zero3":
+        zero_stage = 3
+    elif variant == "tp2":
+        mesh_dims.update(data=n // 2, tensor=2)
+        zero_stage = 1
+    elif variant == "pp2":
+        mesh_dims.update(pipe=2, data=n // 2)
+        zero_stage = 1
+        pipeline = {"schedule": "gpipe"}
+    elif variant == "ep2":
+        mesh_dims.update(expert=2)
+        zero_stage = 1
+    else:
+        assert variant == "zero2", f"unknown MP_VARIANT {variant!r}"
 
     def build():
-        model = LlamaModel(LlamaConfig.tiny(dtype=jax.numpy.float32))
+        mesh = make_mesh(dims=dict(mesh_dims))
         cfg = {
             "train_batch_size": B,
             "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
             "gradient_clipping": 1.0,
-            "zero_optimization": {"stage": 2},
+            "zero_optimization": {"stage": zero_stage},
+            "mesh": {k: v for k, v in mesh_dims.items() if v > 1},
             "steps_per_print": 1000,
         }
+        if pipeline:
+            cfg["pipeline"] = pipeline
         rng = np.random.default_rng(0)
         t = rng.integers(0, 256, (B, S + 1))
+        sample = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+        if variant == "ep2":
+            from tests.unit.moe_fixtures import moe_model_and_loss
+
+            model, loss = moe_model_and_loss()
+            return deepspeed_tpu.initialize(
+                model=model, loss_fn=loss, config=cfg, mesh=mesh,
+                sample_batch=sample)
+        mcfg = LlamaConfig.tiny(dtype=jax.numpy.float32)
         return deepspeed_tpu.initialize(
-            model=model, config=cfg,
-            sample_batch={"input_ids": t[:, :-1], "labels": t[:, 1:]})
+            model=LlamaModel(mcfg), model_config=mcfg, config=cfg,
+            mesh=mesh, sample_batch=sample)
 
     def batch(i):
         rng = np.random.default_rng(100 + i)

@@ -138,3 +138,76 @@ def test_typed_moments_tuple_container_pytree():
     for leaf, old in zip(jax.tree_util.tree_leaves(new_params),
                          jax.tree_util.tree_leaves(params)):
         assert np.all(np.asarray(leaf) < np.asarray(old))
+
+
+# --- factored (rank-1) second moment (VERDICT r3 #3) ----------------------
+
+def test_factored_nu_state_shapes_and_memory():
+    """Matrix params store row+col second-moment stats instead of the full
+    matrix: nu elements collapse from O(I*J) to O(I+J)."""
+    params = {"w": jnp.ones((64, 48)), "s": jnp.ones((32,)),
+              "t": jnp.ones((4, 16, 24))}
+    opt = build_optimizer("adamw", {"lr": 1e-3, "nu_dtype": "factored"})
+    state = opt.init(params)
+    from deepspeed_tpu.runtime.zero.infinity import locate_adam_state
+
+    node = locate_adam_state(state)
+    assert node.nu["w"]["r"].shape == (64,)
+    assert node.nu["w"]["c"].shape == (48,)
+    assert node.nu["s"].shape == (32,)           # vectors stay dense
+    assert node.nu["t"]["r"].shape == (4, 16)    # leading dims kept
+    assert node.nu["t"]["c"].shape == (4, 24)
+    n_params = 64 * 48 + 32 + 4 * 16 * 24
+    n_nu = sum(l.size for l in jax.tree_util.tree_leaves(node.nu))
+    assert n_nu < 0.1 * n_params, (n_nu, n_params)
+
+
+def test_factored_nu_converges_close_to_dense():
+    """Training with the factored nu tracks dense-Adam convergence on the
+    tiny-LM memorization task (approximation, not bit parity)."""
+    rng = np.random.default_rng(5)
+    t = rng.integers(0, 256, (8, 17))
+    batch = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    def run(nu_kw):
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-2, "weight_decay": 0.01,
+                                     **nu_kw}},
+            "gradient_clipping": 1.0,
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": False},
+            "seed": 0,
+        }
+        eng = deepspeed_tpu.initialize(
+            model=LlamaModel(LlamaConfig.tiny(dtype=jnp.float32)),
+            config=cfg, sample_batch=batch)
+        return [float(eng.train_batch(dict(batch))) for _ in range(12)]
+
+    dense = run({})
+    fact = run({"nu_dtype": "factored"})
+    assert fact[-1] < fact[0] - 1.0, fact          # it learns
+    # and lands in the same neighborhood as dense Adam
+    assert fact[-1] < dense[-1] + 0.5, (fact[-1], dense[-1])
+
+
+def test_factored_composes_with_bf16_mu():
+    opt = build_optimizer("adamw", {"lr": 1e-3, "mu_dtype": "bfloat16",
+                                    "nu_dtype": "factored"})
+    params = {"w": jnp.ones((16, 8))}
+    state = opt.init(params)
+    from deepspeed_tpu.runtime.zero.infinity import locate_adam_state
+
+    node = locate_adam_state(state)
+    assert node.mu["w"].dtype == jnp.bfloat16
+    g = {"w": 0.1 * jnp.ones((16, 8))}
+    updates, _ = opt.update(g, state, params)
+    assert np.all(np.isfinite(np.asarray(updates["w"])))
+
+
+def test_factored_mu_raises():
+    with pytest.raises(ValueError, match="SECOND moment"):
+        build_optimizer("adamw", {"lr": 1e-3, "mu_dtype": "factored"})
+    with pytest.raises(ValueError, match="SECOND moment"):
+        build_optimizer("adamw", {"lr": 1e-3, "moment_dtype": "factored"})

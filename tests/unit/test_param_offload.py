@@ -245,3 +245,20 @@ def test_param_offload_generic_model_fallback():
     b = _batch(np.random.default_rng(0))
     losses = [float(e.train_batch(b)) for _ in range(4)]
     assert losses[-1] < losses[0]
+
+
+def test_grads_to_host_off_still_offloads_params():
+    """grads_to_host=false keeps grads on device (faster at sub-HBM grad
+    scales) while params/moments stay host-resident; trajectory unchanged."""
+    cfg = _config(offload_param=True)
+    cfg["zero_optimization"]["offload_param"]["grads_to_host"] = False
+    e = _engine(cfg)
+    kinds = {l.sharding.memory_kind
+             for l in jax.tree_util.tree_leaves(e.params)}
+    assert kinds == {"pinned_host"}, kinds
+    e_ref = _engine(_config(offload_param=True))
+    for i in range(3):
+        b = _batch(np.random.default_rng(100 + i))
+        np.testing.assert_allclose(float(e.train_batch(b)),
+                                   float(e_ref.train_batch(b)),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,0 +1,90 @@
+"""AOT memory probe: does the streamed offload TRAIN STEP fit HBM at 7B?
+
+The 7B capacity attempt OOMed in jit(init_fn) (the full fp32 stacked tree
+materializes in HBM before the host copy). Init can be fixed by feeding
+host-built params; the open question is the step program: the backward
+scan accumulates the stacked fp32 grad tree (27 GB) — does XLA place that
+accumulation in host space (out_shardings pinned_host) or in HBM?
+
+Compiles the engine-shaped grads program with abstract inputs and prints
+the compiler's memory analysis. No data, no init — just the answer.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.models.llama import (
+    LlamaConfig, LlamaModel, StreamedLlamaModel, loss_fn as lm_loss,
+)
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+H, F, L, HEADS = 4096, 11008, 32, 32
+VOCAB, BS, SEQ = 32000, 4, 512
+
+
+def main():
+    cfg = LlamaConfig(
+        vocab_size=VOCAB, hidden_size=H, intermediate_size=F, num_layers=L,
+        num_heads=HEADS, num_kv_heads=HEADS, max_seq_len=SEQ,
+        dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
+        remat_scope="block", scan_layers=True)
+    mesh = make_mesh(dims={"pipe": 1, "data": 1, "expert": 1,
+                           "sequence": 1, "tensor": 1})
+    host = NamedSharding(mesh, P(), memory_kind="pinned_host")
+    dev = NamedSharding(mesh, P())
+
+    model = LlamaModel(cfg)
+    ids0 = jnp.zeros((BS, SEQ), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, ids0)["params"], jax.random.PRNGKey(0))
+    host_sh = jax.tree_util.tree_map(lambda _: host, abstract)
+    # streamed twin: device shardings per slice
+    stream_sh = jax.tree_util.tree_map(lambda _: dev, abstract)
+    streamed = StreamedLlamaModel(cfg, stream_sh)
+
+    def loss(params, batch):
+        logits = streamed.apply({"params": params}, batch["input_ids"])
+        return lm_loss(logits, batch["labels"])
+
+    def grads_fn(params, batch):
+        l, g = jax.value_and_grad(loss)(params, batch)
+        return l, g
+
+    batch_abs = {"input_ids": jax.ShapeDtypeStruct((BS, SEQ), jnp.int32),
+                 "labels": jax.ShapeDtypeStruct((BS, SEQ), jnp.int32)}
+    params_abs = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=host),
+        abstract)
+    lowered = jax.jit(
+        grads_fn,
+        in_shardings=(host_sh, {"input_ids": dev, "labels": dev}),
+        out_shardings=(dev, host_sh),
+    ).lower(params_abs, batch_abs)
+    try:
+        compiled = lowered.compile()
+        ma = compiled.memory_analysis()
+        print(json.dumps({
+            "fits": True,
+            "temp_gb": round(ma.temp_size_in_bytes / 1e9, 2),
+            "argument_gb": round(ma.argument_size_in_bytes / 1e9, 2),
+            "output_gb": round(ma.output_size_in_bytes / 1e9, 2),
+        }))
+    except Exception as e:
+        msg = str(e)
+        import re
+        m = re.search(r"Ran out of memory in memory space hbm[^\n]*"
+                      r"|Largest program allocations[\s\S]{0,2000}", msg)
+        print(json.dumps({"fits": False,
+                          "error": m.group(0) if m else msg[-2000:]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ def time_best(window_fn, windows: int) -> float:
 
 
 def inference_main(int8: bool = False, batch_size: int = 1,
-                   stream: bool = False):
+                   stream: bool = False, panel=None):
     """--inference [--int8] [--batch N]: fused-generation decode benchmark —
     TTFT (p50) and decode tokens/s on the flagship model (the DS-Inference
     headline family; reference kernels csrc/transformer/inference/).
@@ -68,7 +68,8 @@ def inference_main(int8: bool = False, batch_size: int = 1,
               "tensor_parallel": {"tp_size": 1}}
     if int8:
         config["quant"] = {"enabled": True, "bits": 8, "group_size": 128,
-                           "streaming": stream}
+                           "streaming": stream,
+                           **({"block_n": panel} if panel else {})}
     engine = deepspeed_tpu.init_inference(model=model, config=config,
                                           params=params, model_config=cfg)
 
@@ -701,7 +702,7 @@ def autotune_main():
                   "remat_policies": ["block:nothing_saveable",
                                      "block:save_mlp", "none"],
                   "fused_lm_loss_options": [False],
-                  "moment_dtypes": [None, "bfloat16"],
+                  "moment_dtypes": [None, "bfloat16", "bf16mu+factored"],
                   "tuner_early_stopping": 100,
                   "start_profile_step": 2, "end_profile_step": 5}
         hbm = 15.75e9
@@ -946,8 +947,28 @@ if __name__ == "__main__":
                 sys.exit("--batch requires a positive integer, e.g. "
                          "bench.py --inference --batch 8")
             bs = int(sys.argv[i])
-        inference_main(int8="--int8" in sys.argv, batch_size=bs,
-                       stream="--stream" in sys.argv)
+        panel = None
+        if "--panel" in sys.argv:
+            i = sys.argv.index("--panel") + 1
+            if i >= len(sys.argv) or not sys.argv[i].isdigit() \
+                    or int(sys.argv[i]) < 1:
+                sys.exit("--panel requires a positive integer, e.g. "
+                         "bench.py --inference --int8 --stream --panel 256")
+            panel = int(sys.argv[i])
+        if "--panel-ab" in sys.argv:
+            # panel ranking in the REAL decode program, same session
+            for pn in (256, 512, 128):
+                inference_main(int8=True, batch_size=bs, stream=True,
+                               panel=pn)
+        elif "--ab" in sys.argv:
+            # official same-session pair (tunnel throttle makes cross-
+            # session absolutes incomparable): bf16 then int8-streaming
+            inference_main(int8=False, batch_size=bs)
+            inference_main(int8=True, batch_size=bs, stream=True,
+                           panel=panel)
+        else:
+            inference_main(int8="--int8" in sys.argv, batch_size=bs,
+                           stream="--stream" in sys.argv, panel=panel)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

@@ -32,9 +32,12 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # (the 256-vs-512 answer swings with the part/session — docs/
     # PERF_ANALYSIS.md decode section); an int pins it explicitly
     block_n: Optional[int] = None
-    # at-init on-chip microbench picking block_n per session (skipped when
-    # block_n is pinned or off-TPU)
-    autotune_panel: bool = True
+    # OPT-IN at-init synthetic microbench for block_n. Left off by
+    # default: round-4 calibration showed the isolated matmul chain ranks
+    # 512 marginally ahead while the REAL decode program measures 256
+    # faster by ~11% same-session — calibrate with
+    # `bench.py --inference --panel-ab` (real program) and pin block_n
+    autotune_panel: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

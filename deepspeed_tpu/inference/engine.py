@@ -524,11 +524,18 @@ class InferenceEngine:
         F2 = 2 * cfg.intermediate_size
         rng = np.random.default_rng(0)
         q1 = jnp.asarray(rng.integers(-127, 128, (D, F2), dtype=np.int8))
-        s1 = jnp.full((D,), 1e-2, jnp.float32)
         q2 = jnp.asarray(rng.integers(-127, 128, (F2, D), dtype=np.int8))
-        s2 = jnp.full((F2,), 1e-2, jnp.float32)
+        # unit-gain scales (E|q| ~ 73): each matmul's output magnitude ~
+        # its input's, so the R-step chain stays in bf16 range with no
+        # normalization op between matmuls (a reduce there serializes the
+        # DMA pipeline being ranked)
+        s1 = jnp.full((D,), 1.0 / (73.0 * np.sqrt(D)), jnp.float32)
+        s2 = jnp.full((F2,), 1.0 / (73.0 * np.sqrt(F2)), jnp.float32)
         x0 = jnp.ones((1, D), jnp.bfloat16)
-        R = 32
+        # R large enough that kernel time dominates the ~100 ms tunnel
+        # round trip each fence pays (at R=32 the window WAS the RTT and
+        # every candidate measured identical)
+        R = 768
         results = {}
         for c in (128, 256, 512):
             def loop(x, c=c):
@@ -537,8 +544,7 @@ class InferenceEngine:
                                     out_dtype=jnp.bfloat16)
                     z = int8_matmul(y, q2, s2, block_n=c,
                                     out_dtype=jnp.bfloat16)
-                    # bounded feedback keeps the chain data-dependent
-                    return z / (jnp.max(jnp.abs(z)) + 1.0)
+                    return z
 
                 return jax.lax.fori_loop(0, R, body, x)
 

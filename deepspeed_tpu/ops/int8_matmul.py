@@ -118,6 +118,8 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     Bp, Kp, Np = B + pad_b, K + pad_k, N + pad_n
     nm, nk, nn = Bp // block_m, Kp // block_k, Np // block_n
 
+    # measured round 4: explicit dimension_semantics hints did not beat
+    # Mosaic's default pipelining (354.0 vs 346.4 tok/s adjacent runs)
     out = pl.pallas_call(
         functools.partial(_kernel, nk=nk),
         grid=(nm, nn, nk),

@@ -80,22 +80,9 @@ def validate_grouped_stream_config(config, mesh) -> None:
         raise NotImplementedError(
             "offload_param.grouped_stream composes with plain data-parallel "
             f"meshes only (got {dict(mesh.shape)})")
-    for feature, enabled in (
-            ("compression", _any_compression(config)),
-            ("eigenvalue", config.eigenvalue_enabled),
-            ("progressive_layer_drop", config.pld_enabled),
-            ("flops_profiler", config.flops_profiler.enabled),
-            ("quantize_training", config.quantize_training_enabled)):
-        if enabled:
-            raise NotImplementedError(
-                f"offload_param.grouped_stream does not compose with "
-                f"{feature} (both rewrite the loss/step)")
+    from deepspeed_tpu.runtime.zero.param_nvme import reject_loss_rewriters
 
-
-def _any_compression(config) -> bool:
-    from deepspeed_tpu.compression import get_compression_config
-
-    return get_compression_config(config.compression_config).any_enabled
+    reject_loss_rewriters(config, "offload_param.grouped_stream")
 
 
 class GroupedStreamTrainer:

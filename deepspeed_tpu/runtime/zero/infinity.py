@@ -60,6 +60,21 @@ def validate_offload_config(config) -> None:
 
         validate_param_nvme_config(config, mesh=None)
         return
+    opt_params = dict(opt.params) if opt is not None else {}
+    if zc.offload_optimizer_device in ("cpu", "nvme") or \
+            zc.offload_param_device == "cpu":
+        typed = [k for k in ("moment_dtype", "mu_dtype", "nu_dtype")
+                 if opt_params.get(k) is not None
+                 and str(opt_params[k]).lower() not in ("float32", "fp32")]
+        if typed:
+            raise NotImplementedError(
+                f"offloaded optimizer states are dense fp32 (the swapped "
+                f"per-sub-group Adam step, zero/infinity.py group_update); "
+                f"optimizer.params {typed} would be silently ignored — "
+                f"unset them (moment precision is an HBM-residency knob; "
+                f"offloaded moments never occupy HBM between steps). The "
+                f"grouped-stream tier (offload_param.grouped_stream) does "
+                f"support bf16 moment storage")
     if zc.offload_param_device == "cpu":
         # stage-3 requirement raises in stages.plan_zero_shardings; here the
         # cross-feature contracts

@@ -109,6 +109,18 @@ def validate_param_nvme_config(config, mesh) -> None:
         raise NotImplementedError(
             "offload_param.device=nvme does not compose with pipeline "
             "parallelism (the pipeline loss owns the layer loop)")
+    reject_loss_rewriters(config, "offload_param.device=nvme")
+
+
+def get_any_compression(config) -> bool:
+    from deepspeed_tpu.compression import get_compression_config
+
+    return get_compression_config(config.compression_config).any_enabled
+
+
+def reject_loss_rewriters(config, tier: str) -> None:
+    """Shared gate for the interpreter tiers: features that rewrite the
+    loss/step cannot compose with a host-driven layer loop."""
     for feature, enabled in (
             ("compression", get_any_compression(config)),
             ("eigenvalue", config.eigenvalue_enabled),
@@ -117,14 +129,8 @@ def validate_param_nvme_config(config, mesh) -> None:
             ("quantize_training", config.quantize_training_enabled)):
         if enabled:
             raise NotImplementedError(
-                f"offload_param.device=nvme does not compose with "
-                f"{feature} (both rewrite the loss/step)")
-
-
-def get_any_compression(config) -> bool:
-    from deepspeed_tpu.compression import get_compression_config
-
-    return get_compression_config(config.compression_config).any_enabled
+                f"{tier} does not compose with {feature} "
+                f"(both rewrite the loss/step)")
 
 
 def stash_to_host(x):

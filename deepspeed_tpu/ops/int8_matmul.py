@@ -16,7 +16,7 @@ Falls back to ``interpret=True`` off-TPU so tests run on the CPU mesh.
 """
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,7 +59,7 @@ def _use_interpret() -> bool:
 
 
 def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
-                block_k: int = 2048, block_n: int = 256,
+                block_k: Optional[int] = None, block_n: int = 256,
                 out_dtype=None) -> jnp.ndarray:
     """y = (x * scale) @ q  for int8 q.
 
@@ -95,6 +95,18 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     # M-blocking keeps prefill shapes (batch x prompt rows) inside VMEM —
     # decode (M<=8 after padding) stays one block
     block_m = min(max(8, -(-B // 8) * 8), 512)
+    if block_k is None:
+        # default policy: FULL K whenever the double-buffered pipeline
+        # fits VMEM — K-splits pay an f32 accumulator round-trip per N
+        # panel, measured round 4 at the 770M decode: full-K on
+        # down_proj's K=4096 took 331.0 -> 368.9 tok/s (adjacent runs);
+        # larger K (7B's padded 12288) falls back to 2048-wide splits.
+        # The budget counts BOTH tile streams (x: block_m*block_k*2 B,
+        # w: block_k*block_n*3 B, each double-buffered) so prefill
+        # shapes (block_m up to 512) keep the round-3 VMEM fix
+        vmem_cap = (15 * 1024 * 1024
+                    // (2 * (2 * block_m + 3 * block_n)))
+        block_k = K if K <= vmem_cap else 2048
     block_k = min(block_k, K)
     block_n = min(block_n, N)
     if K % block_k:

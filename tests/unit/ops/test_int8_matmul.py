@@ -61,3 +61,22 @@ def test_block_k_divisor_avoids_traced_weight_pad(rng):
     want = x @ (q.astype(jnp.float32) * s[:, None])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("B,K,N", [
+    (1, 4096, 256),        # decode shape: default takes full K
+    (1, 12288, 256),       # 7B padded down_proj: falls back to 2048 splits
+    (700, 4096, 256),      # prefill rows: block_m 512, budget must hold
+    (1, 4100, 128),        # K not a 256 multiple under the 2048 fallback
+])
+def test_default_block_k_policy(rng, B, K, N):
+    """The block_k=None auto policy (full-K within the VMEM budget, else
+    2048-wide splits + divisor logic) computes correctly across the decode,
+    prefill, and large-K regimes."""
+    x = jnp.asarray(rng.standard_normal((B, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)) * 0.1, jnp.float32)
+    q, s = quantize_rowwise(w)
+    got = int8_matmul(x, q, s, block_n=min(N, 256))
+    want = x @ (q.astype(jnp.float32) * s[:, None])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-3, atol=3e-3)

@@ -154,8 +154,10 @@ def main():
         out["int8_over_bf16"] = round(
             out["int8_stream"]["decode_tok_s"] / out["bf16"]["decode_tok_s"],
             3)
+    suffix = ("_int8_only" if args.skip_bf16
+              else "_bf16_only" if args.skip_int8 else "")
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "bench_7b_decode.json")
+                        f"bench_7b_decode{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

@@ -32,12 +32,16 @@ def time_best(window_fn, windows: int) -> float:
 
 
 def inference_main(int8: bool = False, batch_size: int = 1,
-                   stream: bool = False, panel=None):
+                   stream: bool = False, panel=None, kv8: bool = False):
     """--inference [--int8] [--batch N]: fused-generation decode benchmark —
     TTFT (p50) and decode tokens/s on the flagship model (the DS-Inference
     headline family; reference kernels csrc/transformer/inference/).
     ``--batch N`` measures throughput serving: decode is weight-streaming
     bound, so tokens/s scales ~linearly with batch until compute binds."""
+    if kv8 and not (int8 and stream):
+        # quant.kv_cache only reaches the config on the int8-streaming
+        # path; a bf16 run labeled _kv8 would corrupt the A/B records
+        sys.exit("--kv8 requires --int8 --stream")
     import jax
     import jax.numpy as jnp
 
@@ -69,6 +73,7 @@ def inference_main(int8: bool = False, batch_size: int = 1,
     if int8:
         config["quant"] = {"enabled": True, "bits": 8, "group_size": 128,
                            "streaming": stream,
+                           **({"kv_cache": True} if kv8 else {}),
                            **({"block_n": panel} if panel else {})}
     engine = deepspeed_tpu.init_inference(model=model, config=config,
                                           params=params, model_config=cfg)
@@ -140,6 +145,7 @@ def inference_main(int8: bool = False, batch_size: int = 1,
         "metric": "llama770m_decode_tokens_per_sec"
                   + ("_int8" if int8 else "")
                   + ("_stream" if (int8 and stream) else "")
+                  + ("_kv8" if kv8 else "")
                   + (f"_b{batch}" if batch > 1 else ""),
         "value": round(best, 1),
         "unit": "tokens/s",
@@ -151,7 +157,10 @@ def inference_main(int8: bool = False, batch_size: int = 1,
                    "hbm_util_nominal": round(hbm_util_nominal, 3),
                    "batch": batch, "prompt_len": prompt_len,
                    "gen_len": gen_len, "params": int(n_params),
+                   "weight_stream_GBps": round(stream_rate / 1e9, 1),
                    "int8": int8, "int8_streaming": bool(int8 and stream),
+                   "int8_tiled": bool(int8 and stream
+                                      and engine._config.quant.tiled),
                    "int8_panel": getattr(engine._decoder, "int8_block_n",
                                          None) if (int8 and stream) else None,
                    "int8_panel_trace": getattr(engine,
@@ -955,11 +964,29 @@ if __name__ == "__main__":
                 sys.exit("--panel requires a positive integer, e.g. "
                          "bench.py --inference --int8 --stream --panel 256")
             panel = int(sys.argv[i])
+            streaming_run = (("--int8" in sys.argv
+                              and "--stream" in sys.argv)
+                             or any(f in sys.argv for f in
+                                    ("--ab", "--kv8-ab", "--panel-ab")))
+            if not streaming_run:
+                # panel only reaches the config on the int8-STREAMING
+                # path; silently ignoring it breaks the documented
+                # calibration flow
+                sys.exit("--panel applies to the int8 streaming path only; "
+                         "add --int8 --stream (or --ab/--kv8-ab), e.g. "
+                         "bench.py --inference --int8 --stream --panel 256")
         if "--panel-ab" in sys.argv:
             # panel ranking in the REAL decode program, same session
             for pn in (256, 512, 128):
                 inference_main(int8=True, batch_size=bs, stream=True,
                                panel=pn)
+        elif "--kv8-ab" in sys.argv:
+            # same-session pair isolating the int8 KV cache: int8-stream
+            # with bf16 cache, then with the int8 cache
+            inference_main(int8=True, batch_size=bs, stream=True,
+                           panel=panel)
+            inference_main(int8=True, batch_size=bs, stream=True,
+                           panel=panel, kv8=True)
         elif "--ab" in sys.argv:
             # official same-session pair (tunnel throttle makes cross-
             # session absolutes incomparable): bf16 then int8-streaming
@@ -968,7 +995,8 @@ if __name__ == "__main__":
                            panel=panel)
         else:
             inference_main(int8="--int8" in sys.argv, batch_size=bs,
-                           stream="--stream" in sys.argv, panel=panel)
+                           stream="--stream" in sys.argv, panel=panel,
+                           kv8="--kv8" in sys.argv)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

@@ -38,6 +38,20 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # faster by ~11% same-session — calibrate with
     # `bench.py --inference --panel-ab` (real program) and pin block_n
     autotune_panel: bool = False
+    # int8 KV cache (fused Llama decode path only): K/V quantize at
+    # append with per-(token, head) symmetric scales and dequantize as a
+    # post-dot multiply inside attention — halves the cache read, which
+    # dominates per-step HBM traffic at long context / batched serving
+    # (reference: csrc/transformer/inference/csrc/dequantize.cu int8
+    # cache paths). Off by default (bit-exact cache parity)
+    kv_cache: bool = False
+    # contiguous-DMA weight layout (ops/int8_matmul.tile_rowwise):
+    # [nk, nn, 2048, 512] tiles instead of row-major [K, N] — each grid
+    # step's weight DMA is one linear ~1 MB read. +44% measured int8 byte
+    # rate (round-5 probe: 538 vs 375 GB/s; 90% of the session's bf16
+    # pipeline). When on, block_n/autotune_panel apply only to leaves
+    # that fall back to row-major (N not divisible by 256)
+    tiled: bool = True
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

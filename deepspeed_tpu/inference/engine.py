@@ -324,6 +324,13 @@ class InferenceEngine:
                 # is nothing to (re)quantize and the generation program
                 # must not fuse/dequantize at its top either
                 self._quantized = True
+                if self._config.quant.tiled:
+                    # row-major on disk → contiguous-DMA tiles, once
+                    from deepspeed_tpu.models.llama import (
+                        retile_stream_tree,
+                    )
+
+                    self.params = retile_stream_tree(self.params)
             elif self._pre_fused and self._config.quant.streaming:
                 # pre-fused dense tree + streaming: the rowwise in-graph
                 # quantization at the program top consumes the fused tree
@@ -484,7 +491,19 @@ class InferenceEngine:
         # K/V are written in the model config's compute dtype — caches must
         # match it (config "dtype" only steers conversion/casting upstream)
         cache_dtype = getattr(cfg, "dtype", None) or self.dtype
-        self._kv_caches = init_caches(cfg, batch_size, max_len, cache_dtype)
+        if self._config.quant.kv_cache:
+            from deepspeed_tpu.models.llama import FusedLlamaDecoderModel
+
+            if not isinstance(decoder, FusedLlamaDecoderModel):
+                raise ValueError(
+                    "quant.kv_cache requires the fused Llama decode path "
+                    "(a scan-stacked LlamaConfig model); got "
+                    f"{type(decoder).__name__}")
+            self._kv_caches = init_caches(cfg, batch_size, max_len,
+                                          cache_dtype, int8=True)
+        else:
+            self._kv_caches = init_caches(cfg, batch_size, max_len,
+                                          cache_dtype)
         self._gen_cache = OrderedDict()
 
         pre_q = self._pre_quantized
@@ -513,6 +532,18 @@ class InferenceEngine:
         qc = self._config.quant
         if qc.block_n:
             return int(qc.block_n)
+        if qc.tiled:
+            # tiled leaves carry their blocking in the layout; block_n
+            # only reaches row-major fallback leaves — shipped default.
+            # Say so when the user asked for the sweep instead of
+            # silently skipping it
+            if qc.autotune_panel:
+                log_dist(
+                    "quant.autotune_panel skipped: quant.tiled is on and "
+                    "the tiled layout fixes its own blocking (set "
+                    "tiled: false to calibrate row-major panels)",
+                    ranks=[0])
+            return 256
         if getattr(self, "_int8_panel_choice", None):
             return self._int8_panel_choice
         if not qc.autotune_panel or jax.default_backend() != "tpu":
@@ -631,7 +662,9 @@ class InferenceEngine:
             from deepspeed_tpu.models.llama import quantize_fused_rowwise
 
             mcfg = self.model_config
-            params_fn = lambda p: quantize_fused_rowwise(p, mcfg)
+            tiled = self._config.quant.tiled
+            params_fn = lambda p: quantize_fused_rowwise(p, mcfg,
+                                                         tiled=tiled)
         elif self._quant_streaming:
             # fused tree rebuilt as rowwise int8 at the program top; every
             # decode matmul then streams int8 through the Pallas kernel
@@ -640,8 +673,9 @@ class InferenceEngine:
             from deepspeed_tpu.models.llama import quantize_fused_rowwise
 
             mcfg = self.model_config
+            tiled = self._config.quant.tiled
             params_fn = lambda p: quantize_fused_rowwise(
-                transform(self._effective_params(p)), mcfg)
+                transform(self._effective_params(p)), mcfg, tiled=tiled)
         elif self._quantized and transform is not None:
             params_fn = lambda p: transform(self._effective_params(p))
         elif self._quantized:
@@ -652,7 +686,9 @@ class InferenceEngine:
                     "stream" if self._quant_streaming else "",
                     "fused" if transform is not None else "",
                     self._config.quant.bits if self._quantized else 0,
-                    getattr(self._decoder, "int8_block_n", 0))
+                    getattr(self._decoder, "int8_block_n", 0),
+                    "tiled" if self._config.quant.tiled else "",
+                    "kv8" if self._config.quant.kv_cache else "")
         eos = -1 if eos_token_id is None else int(eos_token_id)
         if speculative:
             from deepspeed_tpu.inference.speculative import (

@@ -258,11 +258,15 @@ def save_quantized(out_dir: str, cfg, params: Dict) -> None:
             a = a.view(np.uint16)
         np.save(os.path.join(out_dir, f"leaf{i:04d}.npy"), a,
                 allow_pickle=False)
-    meta = {k: (str(v) if k == "dtype" else v)
+    import jax.numpy as jnp
+
+    # canonical dtype name ("bfloat16"), not str(type) — the loader must
+    # never have to parse "<class 'jax.numpy.bfloat16'>"
+    meta = {k: (jnp.dtype(v).name if k == "dtype" else v)
             for k, v in dataclasses.asdict(cfg).items()}
     with open(os.path.join(out_dir, "quantized_meta.json"), "w") as f:
-        json.dump({"config": meta, "n_leaves": len(leaves),
-                   "leaf_dtypes": dtypes}, f)
+        json.dump({"schema_version": 2, "config": meta,
+                   "n_leaves": len(leaves), "leaf_dtypes": dtypes}, f)
     # structure file: rebuildable from an eval-shape of the same checkpoint;
     # simplest robust form is a paths list
     paths = [jax.tree_util.keystr(kp)
@@ -282,8 +286,15 @@ def load_quantized(out_dir: str):
     ccfg = dict(meta["config"])
     names = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
              "float16": jnp.float16}
-    key = str(ccfg.get("dtype", "bfloat16")).split(".")[-1].strip("'>")
-    ccfg["dtype"] = names.get(key, jnp.bfloat16)
+    key = str(ccfg.get("dtype", "bfloat16"))
+    if meta.get("schema_version", 1) < 2:
+        # v1 stored str(type) — "<class 'jax.numpy.bfloat16'>"
+        key = key.split(".")[-1].strip("'>")
+    if key not in names:
+        raise ValueError(
+            f"quantized checkpoint dtype {key!r} not supported "
+            f"(expected one of {sorted(names)})")
+    ccfg["dtype"] = names[key]
     cfg = LlamaConfig(**ccfg)
     with open(os.path.join(out_dir, "quantized_paths.json")) as f:
         paths = json.load(f)

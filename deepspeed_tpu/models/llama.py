@@ -428,7 +428,8 @@ def fuse_decode_params(params: Any, cfg: LlamaConfig) -> Any:
     return out
 
 
-def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig) -> Any:
+def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig,
+                           tiled: bool = True) -> Any:
     """int8 weight-streaming layout for a :func:`fuse_decode_params` tree.
 
     Every decode matmul weight becomes ``{"q": int8, "scale": f32 rows}``
@@ -440,18 +441,36 @@ def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig) -> Any:
     path (csrc/transformer/inference/csrc/dequantize.cu + pt_binding int8
     GEMMs). Tied-embeddings models get an int8 ``attend_head`` built from
     emb.T for the vocab matmul; the embedding table itself stays dense for
-    the lookup."""
-    from deepspeed_tpu.ops.int8_matmul import quantize_rowwise
+    the lookup.
+
+    ``tiled`` (default): q is additionally re-laid as contiguous
+    [nk, nn, bk, bn] DMA tiles (ops/int8_matmul.tile_rowwise) — +44%
+    measured weight byte rate over the row-major layout (round-5 probe).
+    Leaves whose N divides by no tile panel stay row-major (the kernel
+    dispatches per leaf on q.ndim)."""
+    from deepspeed_tpu.ops.int8_matmul import (
+        pick_tile_block_n, quantize_rowwise, tile_rowwise)
+
+    def maybe_tile(q, s):
+        bn = pick_tile_block_n(q.shape[-1]) if tiled else None
+        if bn is None:
+            return {"q": q, "scale": s}
+        qt, st = tile_rowwise(q, s, block_n=bn)
+        return {"q": qt, "scale": st}
 
     def q2(w):
-        q, s = quantize_rowwise(w.astype(jnp.float32))
-        return {"q": q, "scale": s}
+        return maybe_tile(*quantize_rowwise(w.astype(jnp.float32)))
 
     qstack = jax.vmap(lambda w: quantize_rowwise(w.astype(jnp.float32)))
 
     def qlayers(w):
         q, s = qstack(w)
-        return {"q": q, "scale": s}
+        bn = pick_tile_block_n(q.shape[-1]) if tiled else None
+        if bn is None:
+            return {"q": q, "scale": s}
+        qt, st = jax.vmap(lambda qq, ss: tile_rowwise(qq, ss, block_n=bn))(
+            q, s)
+        return {"q": qt, "scale": st}
 
     blk = fused["blocks"]["block"]
     out = {k: v for k, v in fused.items() if k not in ("blocks", "lm_head")}
@@ -468,6 +487,45 @@ def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig) -> Any:
     elif cfg.tie_embeddings:
         out["attend_head"] = q2(fused["embed_tokens"]["embedding"].T)
     return out
+
+
+def retile_stream_tree(params: Any) -> Any:
+    """One-time transform of a row-major int8 streaming tree (offline
+    checkpoints, inference/offline_quant.py) to the contiguous-DMA tiled
+    layout (ops/int8_matmul.tile_rowwise). MUTATES the dict tree in place,
+    one q-leaf at a time, dropping each old leaf's reference before the
+    next converts — a functional tree_map would hold old+new full trees
+    simultaneously (2x ~7 GB at 7B, the difference between fitting and
+    OOM on a 15.75 GB chip). Leaves whose N has no tile panel (or
+    already-tiled trees) pass through unchanged."""
+    from deepspeed_tpu.ops.int8_matmul import (
+        pick_tile_block_n, tile_rowwise)
+
+    def is_qleaf(x):
+        return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+    def walk(node):
+        if is_qleaf(node):
+            q, s = node["q"], node["scale"]
+            if q.ndim not in (2, 3):      # already tiled (4/5-dim)
+                return
+            bn = pick_tile_block_n(q.shape[-1])
+            if bn is None:
+                return
+            fn = lambda qq, ss: tile_rowwise(qq, ss, block_n=bn)
+            if q.ndim == 3:               # layer-stacked
+                fn = jax.vmap(fn)
+            qt, st = jax.jit(fn)(q, s)
+            qt.block_until_ready()
+            node["q"], node["scale"] = qt, st   # drops the dict's old refs
+            del q, s                            # ...and the locals'
+            return
+        if isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+
+    walk(params)
+    return params
 
 
 def decode_positions_and_mask(batch: int, T: int, S_max: int, cache_index,
@@ -531,16 +589,65 @@ class FusedLlamaDecoderModel:
             """Matmul dispatch: dense kernels use the MXU dot; int8
             weight-streaming leaves (quantize_fused_rowwise) go through the
             Pallas kernel that converts int8→f32 in VMEM, halving the HBM
-            bytes per decode step."""
+            bytes per decode step.
+
+            PREFILL rows (T >= 32: prompt processing — decode steps are
+            T=1, speculative drafts <= ~16) skip the kernel: at M >> 1 the
+            matmul is MXU-bound, not weight-bandwidth-bound, and the
+            matvec kernel's VMEM-dequant pipeline only taxes it (measured
+            round 4: 7B int8 TTFT 64.2 vs bf16 47.8 ms). Dequantize once
+            per call and run the plain XLA GEMM — the convert streams the
+            weight once, which prefill pays anyway."""
             if isinstance(w, dict) and "q" in w:
                 from deepspeed_tpu.ops.int8_matmul import int8_matmul
 
                 Bm, Tm, Km = x.shape
-                y = int8_matmul(x.reshape(Bm * Tm, Km), w["q"], w["scale"],
+                q, s = w["q"], w["scale"]
+                if Tm >= 32:
+                    Kp = s.shape[0]
+                    if Kp > Km:                # offline/tile K padding
+                        x = jnp.pad(x, ((0, 0), (0, 0), (0, Kp - Km)))
+                    xs = (x.astype(jnp.float32)
+                          * s[None, None, :]).astype(cfg.dtype)
+                    if q.ndim == 4:
+                        # contract straight over the tiled layout — a
+                        # row-major untile at 7B is a 6.7 GB int8 shuffle
+                        # plus a 13 GB bf16 materialization per prefill
+                        # (measured round 5: int8 TTFT 110 vs bf16 45 ms);
+                        # the einsum lets XLA convert tile-wise into the
+                        # MXU feed instead
+                        nk, nn, bk, bn = q.shape
+                        x4 = xs.reshape(Bm, Tm, nk, bk)
+                        y = jnp.einsum("mtkb,knbs->mtns", x4,
+                                       q.astype(cfg.dtype))
+                        return y.reshape(Bm, Tm, nn * bn)
+                    return xs @ q.astype(cfg.dtype)
+                y = int8_matmul(x.reshape(Bm * Tm, Km), q, s,
                                 block_n=self.int8_block_n,
                                 out_dtype=cfg.dtype)
                 return y.reshape(Bm, Tm, -1)
             return x @ w
+
+        kv_int8 = len(kv_caches) == 4
+
+        def attn_int8(q, kq, ks, vq, vs):
+            """dot_product_attention semantics over an int8 cache: the
+            per-(slot, head) scales factor out of both dots over D, so
+            the cache reads stay 1 byte/elem and dequant is a post-dot
+            row multiply (softmax stays fp32, same as the dense core)."""
+            scale = float(hd) ** -0.5
+            qs = q * jnp.asarray(scale, q.dtype)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qs,
+                                kq.astype(q.dtype)).astype(jnp.float32)
+            scores = scores * ks.transpose(0, 2, 1)[:, :, None, :]
+            scores = scores + mask
+            weights = jax.nn.softmax(scores, axis=-1)
+            # fold the value scales into the probabilities (rows sum to
+            # <= max |v| scale — still bf16-safe magnitudes)
+            weights = (weights * vs.transpose(0, 2, 1)[:, :, None, :]
+                       ).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", weights,
+                              vq.astype(q.dtype))
 
         def block(x, layer):
             h = rms(x, layer["input_norm"]["scale"])
@@ -551,32 +658,53 @@ class FusedLlamaDecoderModel:
             v = qkv[..., q_sz + n_kv * hd:].reshape(B, T, n_kv, hd)
             q = rotary_embedding(q, positions, cfg.rope_base)
             k = rotary_embedding(k, positions, cfg.rope_base)
-            ck, cv = layer["_cache"]
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_index, 0, 0))
-            kk, vv = ck, cv
-            if n_kv != cfg.num_heads:
-                rep = cfg.num_heads // n_kv
-                kk = jnp.repeat(kk, rep, axis=2)
-                vv = jnp.repeat(vv, rep, axis=2)
-            a = dot_product_attention(q, kk, vv, mask=mask)
+            rep = cfg.num_heads // n_kv
+            if kv_int8:
+                ckq, cks, cvq, cvs = layer["_cache"]
+                kq, ksc = quantize_kv_heads(k)
+                vq, vsc = quantize_kv_heads(v)
+                idx = (0, cache_index, 0)
+                ckq = jax.lax.dynamic_update_slice(ckq, kq, idx + (0,))
+                cks = jax.lax.dynamic_update_slice(cks, ksc, idx)
+                cvq = jax.lax.dynamic_update_slice(cvq, vq, idx + (0,))
+                cvs = jax.lax.dynamic_update_slice(cvs, vsc, idx)
+                kkq, kks, vvq, vvs = ckq, cks, cvq, cvs
+                if rep > 1:
+                    kkq = jnp.repeat(kkq, rep, axis=2)
+                    kks = jnp.repeat(kks, rep, axis=2)
+                    vvq = jnp.repeat(vvq, rep, axis=2)
+                    vvs = jnp.repeat(vvs, rep, axis=2)
+                a = attn_int8(q, kkq, kks, vvq, vvs)
+                new_cache = (ckq, cks, cvq, cvs)
+            else:
+                ck, cv = layer["_cache"]
+                ck = jax.lax.dynamic_update_slice(ck, k,
+                                                  (0, cache_index, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, v,
+                                                  (0, cache_index, 0, 0))
+                kk, vv = ck, cv
+                if rep > 1:
+                    kk = jnp.repeat(kk, rep, axis=2)
+                    vv = jnp.repeat(vv, rep, axis=2)
+                a = dot_product_attention(q, kk, vv, mask=mask)
+                new_cache = (ck, cv)
             a = a.reshape(B, T, q_sz)
             x = x + mm(a, layer["o_proj"])
             h = rms(x, layer["post_attn_norm"]["scale"])
             gu = mm(h, layer["gateup_proj"])
             g, u = jnp.split(gu, 2, axis=-1)
             x = x + mm(nn.silu(g) * u, layer["down_proj"])
-            return x, (ck, cv)
+            return x, new_cache
 
         def scan_body(x, layer_and_cache):
-            layer, ck, cv = layer_and_cache
-            layer = dict(layer, _cache=(ck, cv))
+            layer, cache = layer_and_cache[0], layer_and_cache[1:]
+            layer = dict(layer, _cache=cache)
             x, new_cache = block(x, layer)
             return x, new_cache
 
         x, new_caches = jax.lax.scan(
             scan_body, x,
-            (fused_params["blocks"]["block"], kv_caches[0], kv_caches[1]))
+            (fused_params["blocks"]["block"],) + tuple(kv_caches))
 
         scale = fused_params["final_norm"]["scale"]
         x = rms(x, scale)
@@ -592,15 +720,37 @@ class FusedLlamaDecoderModel:
 
 
 def init_kv_caches(cfg: LlamaConfig, batch_size: int, max_seq_len: int,
-                   dtype=None):
+                   dtype=None, int8: bool = False):
     """Preallocated KV workspace (reference inference_context.h allocates one
     arena sized from max_out_tokens; here it is an explicit pytree the engine
-    shards/donates)."""
+    shards/donates).
+
+    ``int8`` (``quant.kv_cache``): K/V store as int8 with per-(token, head)
+    symmetric scales — a 4-tuple (kq, kscale, vq, vscale). Halves the
+    per-step cache read, which DOMINATES weight traffic at long context /
+    large batch (the reference's int8 inference cache paths,
+    csrc/transformer/inference/csrc/dequantize.cu)."""
     n_kv = cfg.num_kv_heads or cfg.num_heads
     head_dim = cfg.hidden_size // cfg.num_heads
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch_size, max_seq_len, n_kv, head_dim)
+    if int8:
+        sshape = shape[:-1]
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32),
+                jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32))
     return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def quantize_kv_heads(x: jnp.ndarray):
+    """[B, T, H, D] float → (int8, scale [B, T, H]): symmetric absmax per
+    appended (token, head) row. The scale factors out of the attention
+    dots over D, so dequant is a post-dot multiply — the cache read
+    itself stays int8."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, 1e-10)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def loss_fn(logits, labels, ignore_index: int = -100):

@@ -80,6 +80,10 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_layer_freq: int = 1                      # every Nth layer is MoE
     moe_norm_topk: bool = True                   # renormalize top-k weights
+    # "swiglu" (Mixtral: gate/up/down, no bias) or "mlp" (Megatron-DS
+    # experts: c_fc → activation → c_proj with biases — the layout of
+    # reference moe/experts.py expert copies)
+    moe_expert_style: str = "swiglu"
 
     dtype: Any = jnp.float32
     remat: bool = False
@@ -129,6 +133,12 @@ class DenseRoutedMoE(nn.Module):
     intermediate_size: int
     norm_topk: bool = True
     dtype: Any = jnp.float32
+    # "swiglu": gate/up/down einsum stacks, no bias (Mixtral). "mlp":
+    # c_fc → activation → c_proj with biases — the Megatron-DS expert
+    # layout (reference moe/experts.py holds num_experts copies of the
+    # dense MLP; here they run as ONE batched einsum over the E axis)
+    expert_style: str = "swiglu"
+    activation: Any = None                       # "mlp" style only
 
     @nn.compact
     def __call__(self, x):                      # [B, S, D]
@@ -146,14 +156,28 @@ class DenseRoutedMoE(nn.Module):
              * vals[..., None]).sum(axis=1)     # [T, E]
 
         init = nn.initializers.lecun_normal()
-        wg = self.param("gate_proj", init, (E, D, F), jnp.float32)
-        wu = self.param("up_proj", init, (E, D, F), jnp.float32)
-        wd = self.param("down_proj", init, (E, F, D), jnp.float32)
         td = t.astype(self.dtype)
-        g = jnp.einsum("td,edf->tef", td, wg.astype(self.dtype))
-        u = jnp.einsum("td,edf->tef", td, wu.astype(self.dtype))
-        h = nn.silu(g) * u
-        y = jnp.einsum("tef,efd->ted", h, wd.astype(self.dtype))
+        if self.expert_style == "mlp":
+            wf = self.param("c_fc", init, (E, D, F), jnp.float32)
+            bf = self.param("c_fc_bias", nn.initializers.zeros, (E, F),
+                            jnp.float32)
+            wp = self.param("c_proj", init, (E, F, D), jnp.float32)
+            bp = self.param("c_proj_bias", nn.initializers.zeros, (E, D),
+                            jnp.float32)
+            act = self.activation or (lambda v: nn.gelu(v,
+                                                        approximate=False))
+            h = (jnp.einsum("td,edf->tef", td, wf.astype(self.dtype))
+                 + bf.astype(self.dtype)[None])
+            y = (jnp.einsum("tef,efd->ted", act(h), wp.astype(self.dtype))
+                 + bp.astype(self.dtype)[None])
+        else:
+            wg = self.param("gate_proj", init, (E, D, F), jnp.float32)
+            wu = self.param("up_proj", init, (E, D, F), jnp.float32)
+            wd = self.param("down_proj", init, (E, F, D), jnp.float32)
+            g = jnp.einsum("td,edf->tef", td, wg.astype(self.dtype))
+            u = jnp.einsum("td,edf->tef", td, wu.astype(self.dtype))
+            h = nn.silu(g) * u
+            y = jnp.einsum("tef,efd->ted", h, wd.astype(self.dtype))
         out = jnp.einsum("ted,te->td", y.astype(jnp.float32), w)
         return out.reshape(B, S, D).astype(x.dtype)
 
@@ -216,6 +240,9 @@ class UnifiedBlock(nn.Module):
             mlp = DenseRoutedMoE(
                 num_experts=cfg.moe_num_experts, top_k=cfg.moe_top_k,
                 intermediate_size=cfg.ffn_size, norm_topk=cfg.moe_norm_topk,
+                expert_style=cfg.moe_expert_style,
+                activation=(_act(cfg.activation)
+                            if cfg.moe_expert_style == "mlp" else None),
                 dtype=cfg.dtype, name="moe")
         elif cfg.gated_mlp:
             mlp = GatedMLP(intermediate_size=cfg.ffn_size, dtype=cfg.dtype,
@@ -258,6 +285,41 @@ class UnifiedBlock(nn.Module):
         return out
 
 
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _fetch_leaf(w, sharding):
+    """host→device parameter fetch whose VJP does NOT transpose into a
+    device→host move: the cotangent passes through device-resident and the
+    engine moves the assembled grad tree host-side at the PROGRAM boundary
+    (jit out_shardings), outside AD.
+
+    Why: differentiating a plain ``jax.device_put(w_host, device)`` makes
+    AD emit the transposed copy — an output pinned to host memory in the
+    middle of the backward — which the axon tunnel's AOT helper refuses
+    for unrolled programs ("layout for this output is not set to host
+    memory", round-4 scope note). The grouped-stream tier proves
+    host-memory moves at program boundaries DO work on this path; this
+    custom_vjp keeps all mid-graph values device-resident."""
+    return jax.device_put(w, sharding)
+
+
+def _fetch_leaf_fwd(w, sharding):
+    return jax.device_put(w, sharding), None
+
+
+def _fetch_leaf_bwd(sharding, _res, g):
+    return (g,)
+
+
+_fetch_leaf.defvjp(_fetch_leaf_fwd, _fetch_leaf_bwd)
+
+
+def _fetch_tree(tree, shardings):
+    return jax.tree_util.tree_map(_fetch_leaf, tree, shardings)
+
+
 class StreamedTransformerLM:
     """Apply-twin of :class:`TransformerLM` that streams host-resident
     parameters into device memory at each submodule's point of use — the
@@ -286,9 +348,7 @@ class StreamedTransformerLM:
         self._shardings = stream_shardings
 
     def _stream(self, params, key):
-        return jax.tree_util.tree_map(
-            lambda w, sh: jax.device_put(w, sh),
-            params[key], self._shardings[key])
+        return _fetch_tree(params[key], self._shardings[key])
 
     def apply(self, variables, input_ids, positions=None,
               attention_mask=None, token_type_ids=None, rngs=None,
@@ -333,8 +393,7 @@ class StreamedTransformerLM:
                 # fetch INSIDE the (possibly rematerialized) body: the host
                 # tree is the saved residual, and backward re-fetches the
                 # device copy instead of keeping every layer HBM-resident
-                w = jax.tree_util.tree_map(
-                    lambda a, s: jax.device_put(a, s), w_host, sh)
+                w = _fetch_tree(w_host, sh)
                 return block.apply({"params": w}, h, mask, positions,
                                    rngs=rngs)
 

@@ -6,5 +6,5 @@ Importing this package registers every policy with
 
 from deepspeed_tpu.module_inject.containers import (  # noqa: F401
     bert, bloom, clip, distilbert, gpt2, gptj, gptneo, gptneox, llama,
-    megatron, mixtral, opt,
+    megatron, megatron_moe, mixtral, opt,
 )

@@ -1,0 +1,197 @@
+"""Megatron-DS MoE policy + expert-sharded checkpoint import.
+
+Reference: ``module_inject/containers/megatron_gpt_moe.py`` (the
+DS_MegatronGPTMoEContainer / MegatronMoELayerPolicy pair) together with the
+engine's expert checkpoint contract
+(``runtime/engine.py:2515 _get_expert_ckpt_name``): a Megatron-DeepSpeed
+MoE checkpoint is the base model states file plus ONE FILE PER GLOBAL
+EXPERT —
+
+    mp_rank_{mp:02d}_model_states.pt
+    layer_{moe_layer_id}_expert_{eid}_mp_rank_{mp:02d}_model_states.pt
+    (old layout: expert_{eid}_mp_rank_{mp:02d}_model_states.pt)
+
+with expert keys named ``...mlp.deepspeed_moe.experts.deepspeed_experts.
+{eid}.dense_h_to_4h/dense_4h_to_h.{weight,bias}`` and the router at
+``...mlp.deepspeed_moe.gate.wg.weight``. Each expert-parallel rank saved
+only its local experts, so the per-expert files ARE the expert sharding;
+:func:`load_megatron_ds_moe_checkpoint` re-assembles the global expert
+set (the ep→1 reshard), and the policy stacks them into the batched
+[E, D, F] einsum layout the unified MoE runs on the MXU — the same
+resharding direction as the universal checkpoint's expert-axis rows
+(checkpoint/universal.py).
+"""
+
+import os
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.models.unified import TransformerConfig
+from deepspeed_tpu.module_inject.containers.megatron import (
+    MegatronLayerPolicy,
+)
+from deepspeed_tpu.module_inject.policy import (
+    _np, dense_, ln_, register_policy, split_fused_qkv,
+)
+
+_EXPERT_RE = re.compile(
+    r"^(?:layer_(\d+)_)?expert_(\d+)_mp_rank_(\d+)_model_states\.pt$")
+_MOE_PREFIX = ".deepspeed_moe.experts.deepspeed_experts."
+
+
+def load_megatron_ds_moe_checkpoint(ckpt_dir: str,
+                                    tag: Optional[str] = None,
+                                    mp_rank: int = 0) -> Dict[str, Any]:
+    """Merge a Megatron-DS MoE checkpoint directory into one state dict.
+
+    Returns the base ``module`` state dict with every expert file's keys
+    folded in under their GLOBAL expert ids (the reference loader renames
+    global→local per ep-rank, ``runtime/engine.py:2416-2421``; importing
+    for inference wants the whole expert set, i.e. an ep→1 reshard)."""
+    import torch
+
+    root = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+    base_name = f"mp_rank_{mp_rank:02d}_model_states.pt"
+    base_path = os.path.join(root, base_name)
+    if not os.path.exists(base_path):
+        raise FileNotFoundError(
+            f"no {base_name} under {root} — not a Megatron-DS checkpoint "
+            f"directory")
+    base = torch.load(base_path, map_location="cpu", weights_only=False)
+    sd = dict(base.get("module", base))
+    eids = set()
+    for fname in sorted(os.listdir(root)):
+        m = _EXPERT_RE.match(fname)
+        if not m or int(m.group(3)) != mp_rank:
+            continue
+        expert_sd = torch.load(os.path.join(root, fname),
+                               map_location="cpu", weights_only=False)
+        eid = int(m.group(2))
+        for k, v in expert_sd.items():
+            if _MOE_PREFIX not in k:
+                raise ValueError(
+                    f"expert file {fname} key {k!r} is not a deepspeed_moe "
+                    f"expert parameter")
+            sd[k] = v
+        eids.add(eid)
+    if not eids:
+        raise FileNotFoundError(
+            f"no expert_*_model_states.pt files under {root}; for a dense "
+            f"Megatron checkpoint use MegatronLayerPolicy")
+    if eids != set(range(max(eids) + 1)):
+        # interior holes (interrupted copy) must fail HERE, not as a bare
+        # KeyError inside the stacking loop
+        raise ValueError(
+            f"expert files under {root} cover ids {sorted(eids)} — not a "
+            f"contiguous 0..{max(eids)} set; the checkpoint is incomplete")
+    sd["_num_experts_found"] = len(eids)
+    return sd
+
+
+@register_policy
+class MegatronMoELayerPolicy(MegatronLayerPolicy):
+    """Megatron-GPT topology with ``deepspeed_moe`` expert MLPs.
+
+    Inherits the fused-QKV/learned-position handling from the dense
+    Megatron policy (as the reference's MegatronMoELayerPolicy inherits
+    MegatronLayerPolicy and replaces only the mlp accessor,
+    ``containers/megatron_gpt_moe.py:36``)."""
+
+    model_types = ("megatron-moe", "megatron-ds-moe")
+    class_name_hints = ("MegatronMoE",)
+
+    def build_config(self, hf_config, dtype=None) -> TransformerConfig:
+        cfg = super().build_config(hf_config, dtype=dtype)
+        get = lambda *names, default=None: next(
+            (getattr(hf_config, n) for n in names if hasattr(hf_config, n)),
+            default)
+        num_experts = get("num_experts", "moe_num_experts", default=0)
+        if isinstance(num_experts, (list, tuple)):   # reference stores lists
+            num_experts = max(num_experts)
+        import dataclasses
+
+        return dataclasses.replace(
+            cfg,
+            moe_num_experts=int(num_experts),
+            moe_top_k=int(get("moe_top_k", "top_k", default=1)),
+            # Megatron's top-1 combine weight is the raw softmax prob
+            # (reference moe/sharded_moe.py top1gating) — no renormalize
+            moe_norm_topk=False,
+            moe_layer_freq=int(get("moe_layer_freq", "expert_interval",
+                                   default=1)),
+            moe_expert_style="mlp",
+        )
+
+    def convert(self, sd, hf_config):
+        cfg = self.build_config(hf_config)
+        head_dim = cfg.hidden_size // cfg.num_heads
+        version = getattr(hf_config, "checkpoint_version", None)
+        version = 2 if version is None else version
+        qkv_layout = "per_head" if version >= 2 else "concat_rows"
+        prefix = next((p for p in ("language_model.transformer.",
+                                   "transformer.", "model.", "")
+                       if f"{p}layers.0.input_layernorm.weight" in sd), None)
+        if prefix is None:
+            raise ValueError(
+                "unrecognized Megatron state_dict layout: no "
+                "'<root>layers.0.input_layernorm.weight' under any known "
+                "root")
+        emb = next((p for p in ("language_model.embedding.", "embedding.",
+                                prefix, "")
+                    if f"{p}word_embeddings.weight" in sd), None)
+        if emb is None:
+            raise ValueError("no word_embeddings.weight under any known root")
+        E = cfg.moe_num_experts
+        found = sd.get("_num_experts_found")
+        if found is not None and found != E:
+            raise ValueError(
+                f"checkpoint holds {found} experts but the config says "
+                f"{E} (num_experts) — refusing to import a partial or "
+                f"overfull expert set")
+        params = {
+            "wte": {"embedding": _np(sd[f"{emb}word_embeddings.weight"])},
+            "wpe": {"embedding": _np(
+                sd[f"{emb}position_embeddings.weight"])},
+            "ln_f": ln_(sd, f"{prefix}final_layernorm"),
+        }
+        for i in range(cfg.num_layers):
+            b = f"{prefix}layers.{i}"
+            attn = split_fused_qkv(
+                sd[f"{b}.attention.query_key_value.weight"],
+                sd.get(f"{b}.attention.query_key_value.bias"),
+                cfg.num_heads, head_dim, layout=qkv_layout)
+            attn["o_proj"] = dense_(sd, f"{b}.attention.dense")
+            layer = {
+                "ln_1": ln_(sd, f"{b}.input_layernorm"),
+                "ln_2": ln_(sd, f"{b}.post_attention_layernorm"),
+                "attn": attn,
+            }
+            moe_root = f"{b}.mlp.deepspeed_moe"
+            if cfg.is_moe_layer(i) and f"{moe_root}.gate.wg.weight" in sd:
+                ex = f"{moe_root}.experts.deepspeed_experts"
+                layer["moe"] = {
+                    # router wg stores [E, D]; flax gate kernel is [D, E]
+                    "gate": {"kernel": _np(
+                        sd[f"{moe_root}.gate.wg.weight"]).T},
+                    "c_fc": np.stack(
+                        [_np(sd[f"{ex}.{e}.dense_h_to_4h.weight"]).T
+                         for e in range(E)]),
+                    "c_fc_bias": np.stack(
+                        [_np(sd[f"{ex}.{e}.dense_h_to_4h.bias"])
+                         for e in range(E)]),
+                    "c_proj": np.stack(
+                        [_np(sd[f"{ex}.{e}.dense_4h_to_h.weight"]).T
+                         for e in range(E)]),
+                    "c_proj_bias": np.stack(
+                        [_np(sd[f"{ex}.{e}.dense_4h_to_h.bias"])
+                         for e in range(E)]),
+                }
+            else:
+                layer["mlp"] = {
+                    "c_fc": dense_(sd, f"{b}.mlp.dense_h_to_4h"),
+                    "c_proj": dense_(sd, f"{b}.mlp.dense_4h_to_h"),
+                }
+            params[f"layer_{i}"] = layer
+        return params

@@ -54,8 +54,142 @@ def _kernel(x_ref, q_ref, o_ref, acc, *, nk: int):
         o_ref[...] = acc[...].astype(o_ref.dtype)
 
 
+def _kernel_tiled(x_ref, q_ref, o_ref, acc, *, nk: int):
+    """Same contraction as :func:`_kernel` but the weight block arrives as
+    one [1, 1, bk, bn] tile of the pre-tiled layout (see
+    :func:`tile_rowwise`) — the HBM source of each DMA is fully
+    contiguous instead of bn-byte rows strided by N."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    x = x_ref[...]
+    w = q_ref[0, 0].astype(x.dtype)
+    acc[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def tile_rowwise(q: jnp.ndarray, scale: jnp.ndarray,
+                 block_k: Optional[int] = None,
+                 block_n: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Re-lay a row-major int8 weight [K, N] as contiguous DMA tiles
+    [nk, nn, block_k, block_n] (one-time, at quantization/load).
+
+    Why: streaming a (bk, bn) block out of a row-major [K, N] int8 array
+    reads bn CONTIGUOUS BYTES per row — 256 B at the shipped panel width,
+    half of what the same panel costs in bf16 — so the weight-streaming
+    DMAs run below HBM burst efficiency. With the tile itself contiguous
+    in HBM each grid step issues one bk*bn-byte linear read (1 MB at
+    4096x256). K is padded up to a block_k multiple here, once, so the
+    decode loop never pads the weight per step; pad rows are zero and the
+    matching scale rows are 1.0.
+
+    N must divide by block_n (all production N panels are 256-multiples);
+    callers with odd N keep the row-major path.
+
+    Default blocking 2048 x 512, measured round 5 on the 7B MLP chain
+    (tools/probe_int8_byterate.json, adjacent runs in one session):
+    tiled 2048x512 = 538 GB/s of int8 bytes vs 512x4096 = 520, 1024x512
+    = 515, 2048x256 = 511, full-K x 512 = 475, full-K x 256 = 395, and
+    the row-major kernel's 375 — i.e. 90% of the same-session bf16
+    pipeline (601 GB/s). Contiguity flips the round-4 full-K preference:
+    once tiles stream linearly, deeper k-pipelining beats saving the
+    accumulator round-trip.
+    """
+    K, N = q.shape
+    if block_k is None:
+        block_k = 2048
+    block_k = min(block_k, K)
+    assert N % block_n == 0, (N, block_n)
+    pad_k = (-K) % block_k
+    if pad_k:
+        q = jnp.pad(q, ((0, pad_k), (0, 0)))
+        scale = jnp.pad(scale, (0, pad_k), constant_values=1.0)
+    Kp = K + pad_k
+    nk, nn = Kp // block_k, N // block_n
+    # JAX arrays are dense row-major; the transpose materializes the
+    # re-laid copy (no view semantics), which IS the contiguous layout
+    qt = q.reshape(nk, block_k, nn, block_n).transpose(0, 2, 1, 3)
+    return qt, scale
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _default_block_k(K: int, block_m: int, block_n: int) -> int:
+    """FULL K whenever the double-buffered pipeline fits VMEM — K-splits
+    pay an f32 accumulator round-trip per N panel (measured round 4 at the
+    770M decode: full-K on down_proj's K=4096 took 331.0 -> 368.9 tok/s).
+    The budget counts BOTH tile streams (x: block_m*block_k*2 B, w:
+    block_k*block_n*3 B, each double-buffered)."""
+    vmem_cap = (15 * 1024 * 1024
+                // (2 * (2 * block_m + 3 * block_n)))
+    block_k = K if K <= vmem_cap else 2048
+    if K % block_k:
+        # prefer the largest 256-multiple divisor of K within the cap so
+        # the row-major path never pads the weight per step
+        for cand in range(block_k - block_k % 256, 0, -256):
+            if K % cand == 0:
+                return cand
+    return block_k
+
+
+def pick_tile_block_n(N: int) -> Optional[int]:
+    """Widest measured-good tile panel dividing N, or None (keep the
+    row-major layout). 512 is the round-5 probe winner; 256 covers the
+    32000-vocab head; other Ns (tiny test configs) stay row-major."""
+    for bn in (512, 256):
+        if N % bn == 0:
+            return bn
+    return None
+
+
+def int8_matmul_tiled(x: jnp.ndarray, qt: jnp.ndarray, scale: jnp.ndarray,
+                      out_dtype=None) -> jnp.ndarray:
+    """y = (x * scale) @ untile(qt) for a :func:`tile_rowwise` weight.
+
+    x: [B, K] with K <= Kp = nk*bk (activation is zero-padded up to the
+    tiled K here — cheap, x is the tiny decode operand); qt:
+    [nk, nn, bk, bn] int8; scale: [Kp]. Each grid step's weight DMA is
+    one contiguous bk*bn-byte read, which is the point (see
+    tile_rowwise)."""
+    B, K = x.shape
+    nk, nn, block_k, block_n = qt.shape
+    Kp, N = nk * block_k, nn * block_n
+    assert K <= Kp < K + max(block_k, 2048) and scale.shape == (Kp,), (
+        x.shape, qt.shape, scale.shape)
+    out_dtype = out_dtype or x.dtype
+    if Kp > K:
+        x = jnp.pad(x, ((0, 0), (0, Kp - K)))
+    xs = (x.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
+    block_m = min(max(8, -(-B // 8) * 8), 512)
+    pad_b = (-B) % block_m
+    if pad_b:
+        xs = jnp.pad(xs, ((0, pad_b), (0, 0)))
+    nm = (B + pad_b) // block_m
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_tiled, nk=nk),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda m, n, k: (m, k)),
+            pl.BlockSpec((1, 1, block_k, block_n),
+                         lambda m, n, k: (k, n, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda m, n, k: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((B + pad_b, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        interpret=_use_interpret(),
+    )(xs, qt)
+    return out[:B]
 
 
 def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
@@ -74,11 +208,17 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     more outstanding DMAs to overlap. VMEM per grid step ≈
     block_k·block_n·(1B int8 + 2B convert), double-buffered.
     """
+    if q.ndim == 4:          # tile_rowwise layout — contiguous-DMA path
+        return int8_matmul_tiled(x, q, scale, out_dtype=out_dtype)
     B, K = x.shape
     Kq, N = q.shape
     # Kq > K only for offline K-padding to the next 2048 multiple — a
     # looser bound would let a mismatched weight/activation pair compute
-    # garbage silently instead of asserting
+    # garbage silently instead of asserting. CONTRACT: a padded q must
+    # come from inference/offline_quant.py (pad rows zero, pad scales
+    # 1.0) — the zero rows are what make zero-padding the activation
+    # exact; the shape check cannot verify the rows themselves without
+    # streaming the weight, which is the cost this kernel exists to avoid
     assert (Kq == K or (Kq % 2048 == 0 and 0 < Kq - K < 2048)) \
         and scale.shape == (Kq,), (x.shape, q.shape, scale.shape)
     out_dtype = out_dtype or x.dtype
@@ -96,30 +236,20 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     # decode (M<=8 after padding) stays one block
     block_m = min(max(8, -(-B // 8) * 8), 512)
     if block_k is None:
-        # default policy: FULL K whenever the double-buffered pipeline
-        # fits VMEM — K-splits pay an f32 accumulator round-trip per N
-        # panel, measured round 4 at the 770M decode: full-K on
-        # down_proj's K=4096 took 331.0 -> 368.9 tok/s (adjacent runs);
-        # larger K (7B's padded 12288) falls back to 2048-wide splits.
-        # The budget counts BOTH tile streams (x: block_m*block_k*2 B,
-        # w: block_k*block_n*3 B, each double-buffered) so prefill
-        # shapes (block_m up to 512) keep the round-3 VMEM fix
-        vmem_cap = (15 * 1024 * 1024
-                    // (2 * (2 * block_m + 3 * block_n)))
-        block_k = K if K <= vmem_cap else 2048
+        block_k = _default_block_k(K, block_m=block_m, block_n=block_n)
     block_k = min(block_k, K)
-    block_n = min(block_n, N)
     if K % block_k:
-        # A K that the default cap doesn't divide (e.g. Llama-7B's 11008
-        # under block_k=2048) would force a jnp.pad of the int8 weight —
-        # traced into the decode loop, a fresh padded copy every step,
-        # exactly the HBM traffic the kernel exists to avoid. Prefer the
-        # largest 256-multiple divisor of K within the cap; only a K not
-        # divisible by 256 at all falls back to the pad.
+        # ANY non-dividing block_k (caller-supplied included, e.g. a
+        # sweep passing 1024 against K=11008) would trace a jnp.pad of
+        # the int8 weight into the decode loop — a fresh padded HBM copy
+        # every step, exactly the traffic this kernel exists to avoid.
+        # Snap to the largest 256-multiple divisor <= block_k; only a K
+        # with no such divisor falls through to the pad.
         for cand in range(block_k - block_k % 256, 0, -256):
             if K % cand == 0:
                 block_k = cand
                 break
+    block_n = min(block_n, N)
     pad_b = (-B) % block_m
     pad_k = (-K) % block_k
     pad_n = (-N) % block_n

@@ -121,7 +121,7 @@ def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
         """
         have = set(getattr(jax.typeof(x), "vma", ()))
         missing = tuple(a for a in axes if a not in have)
-        return lax.pvary(x, missing) if missing else x
+        return lax.pcast(x, missing, to="varying") if missing else x
 
     blocks_v = jax.tree_util.tree_map(
         lambda x, ax: _varying(x, all_axes + tuple(ax)),

@@ -54,10 +54,7 @@ def spmd_pipeline(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     # mark the carries as device-varying over the pipe axis (their values
     # differ per stage once the ring starts turning)
     def _varying(x):
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return lax.pvary(x, (axis_name,))
+        return lax.pcast(x, (axis_name,), to="varying")
 
     state = _varying(jnp.zeros_like(microbatches[0]))
     outputs = _varying(jnp.zeros_like(microbatches))

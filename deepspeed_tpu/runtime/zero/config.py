@@ -54,6 +54,13 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     # false skips the host round-trip — faster steps, params/moments stay
     # offloaded either way
     grads_to_host: bool = True
+    # grouped_stream only: double-buffer the group weight fetch — each
+    # group program also returns a device copy of the NEXT group's
+    # weights, so the host→HBM transfer overlaps the current group's
+    # compute (the reference's overlapped sub-group pipeline,
+    # stage3.py:1775-1835). Costs one extra group of fp32 weights in HBM;
+    # disable at sizes where two groups + grads don't fit
+    stream_prefetch: bool = True
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

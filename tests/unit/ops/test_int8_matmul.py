@@ -80,3 +80,33 @@ def test_default_block_k_policy(rng, B, K, N):
     want = x @ (q.astype(jnp.float32) * s[:, None])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-3, atol=3e-3)
+
+
+@pytest.mark.parametrize("K,N,bk", [
+    (512, 512, None),      # default 2048 cap -> full K here
+    (4096, 512, 2048),     # the measured production blocking (k-split)
+    (1100, 256, 256),      # K padded up to the tile multiple
+    (96, 512, None),       # K smaller than any block: single short tile
+])
+def test_tiled_layout_matches_rowwise(rng, K, N, bk):
+    """tile_rowwise + the contiguous-DMA kernel path reproduces the
+    row-major kernel bit-for-bit math (same contraction, re-laid DMAs)."""
+    from deepspeed_tpu.ops.int8_matmul import tile_rowwise
+
+    x = jnp.asarray(rng.standard_normal((3, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)) * 0.1, jnp.float32)
+    q, s = quantize_rowwise(w)
+    want = int8_matmul(x, q, s)
+    qt, st = tile_rowwise(q, s, block_k=bk, block_n=min(N, 512))
+    assert qt.ndim == 4
+    got = int8_matmul(x, qt, st)     # auto-dispatch on ndim
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pick_tile_block_n():
+    from deepspeed_tpu.ops.int8_matmul import pick_tile_block_n
+
+    assert pick_tile_block_n(4608) == 512
+    assert pick_tile_block_n(32000) == 256     # vocab head
+    assert pick_tile_block_n(192) is None      # tiny test configs

@@ -7,7 +7,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from deepspeed_tpu.ops.onebit import (
     OnebitAdamState, _ErrorState, compressed_allreduce, error_buffers,
@@ -81,7 +81,7 @@ def test_compressed_allreduce_shard_map(devices, rng, world):
         step, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data")),
         out_specs=(P("data"), P("data"), P("data")),
-        check_rep=False))
+        check_vma=False))
 
     acc = np.zeros(n)
     T = 150
@@ -258,7 +258,7 @@ def test_onebit_adam_shard_map_multidevice(devices, rng):
         step, mesh=mesh,
         in_specs=(rep, rep, rep, rep, P("data"), P("data"), P("data")),
         out_specs=(rep, rep, rep, rep, P("data"), P("data")),
-        check_rep=False))
+        check_vma=False))
 
     count = jnp.zeros((), jnp.int32)
     m, v = {"w": jnp.zeros(n)}, {"w": jnp.zeros(n)}

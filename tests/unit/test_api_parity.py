@@ -134,7 +134,7 @@ def test_safe_get_set_full_param_and_state():
 
 
 def test_coalesced_collectives(dp8_mesh):
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     import deepspeed_tpu.comm as dist
 
@@ -153,7 +153,7 @@ def test_coalesced_collectives(dp8_mesh):
         in_specs=(PartitionSpec("data"), PartitionSpec("data")),
         out_specs=(PartitionSpec("data"), PartitionSpec("data"),
                    PartitionSpec("data")),
-        check_rep=False))
+        check_vma=False))
     o0, o1, g0 = fn(xs[0], xs[1])
     # xs[0] row r = [4r..4r+3], flat len 4 padded to 8: scatter leaves the
     # column sums in the first 4 slots, zeros in the padding

@@ -1,0 +1,168 @@
+"""Megatron-DS MoE injection container (VERDICT r4 #7).
+
+Round-trip contract: a synthetic expert-sharded Megatron-DS MoE checkpoint
+(one base model_states file + one file per global expert, the layout of
+reference runtime/engine.py:2515 _get_expert_ckpt_name) imports onto the
+unified decode path with numerically identical parameters, and the
+imported model decodes greedily to the same tokens as the source params.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.unified import TransformerConfig, TransformerLM
+from deepspeed_tpu.module_inject.containers.megatron_moe import (
+    MegatronMoELayerPolicy, load_megatron_ds_moe_checkpoint,
+)
+
+
+class _MoECfg:
+    """hf_config stand-in for a Megatron-DS MoE checkpoint's args."""
+    vocab_size = 96
+    hidden_size = 24
+    num_layers = 2
+    num_attention_heads = 4
+    ffn_hidden_size = 48
+    max_position_embeddings = 32
+    num_experts = 4
+    checkpoint_version = 2.0
+    model_type = "megatron-moe"
+
+
+def _t(a):
+    return torch.from_numpy(np.asarray(a, np.float32))
+
+
+def _export_megatron_moe(params, cfg: TransformerConfig, out_dir: str):
+    """Write ``params`` (a TransformerLM tree) as a Megatron-DS MoE
+    checkpoint directory — the inverse of the import path, used to prove
+    the mapping is a bijection."""
+    H = cfg.num_heads
+    hd = cfg.hidden_size // H
+    D = cfg.hidden_size
+    base = {
+        "word_embeddings.weight": _t(params["wte"]["embedding"]),
+        "position_embeddings.weight": _t(params["wpe"]["embedding"]),
+        "final_layernorm.weight": _t(params["ln_f"]["scale"]),
+        "final_layernorm.bias": _t(params["ln_f"]["bias"]),
+    }
+    experts = {e: {} for e in range(cfg.moe_num_experts)}
+    for i in range(cfg.num_layers):
+        p = params[f"layer_{i}"]
+        b = f"layers.{i}"
+        base[f"{b}.input_layernorm.weight"] = _t(p["ln_1"]["scale"])
+        base[f"{b}.input_layernorm.bias"] = _t(p["ln_1"]["bias"])
+        base[f"{b}.post_attention_layernorm.weight"] = _t(p["ln_2"]["scale"])
+        base[f"{b}.post_attention_layernorm.bias"] = _t(p["ln_2"]["bias"])
+        # fuse q/k/v kernels [D, H*hd] into the per-head (v2) row layout
+        qh = np.asarray(p["attn"]["q_proj"]["kernel"]).T.reshape(H, hd, D)
+        kh = np.asarray(p["attn"]["k_proj"]["kernel"]).T.reshape(H, hd, D)
+        vh = np.asarray(p["attn"]["v_proj"]["kernel"]).T.reshape(H, hd, D)
+        w = np.stack([qh, kh, vh], axis=1).reshape(3 * H * hd, D)
+        bq = np.asarray(p["attn"]["q_proj"]["bias"]).reshape(H, hd)
+        bk = np.asarray(p["attn"]["k_proj"]["bias"]).reshape(H, hd)
+        bv = np.asarray(p["attn"]["v_proj"]["bias"]).reshape(H, hd)
+        bias = np.stack([bq, bk, bv], axis=1).reshape(-1)
+        base[f"{b}.attention.query_key_value.weight"] = _t(w)
+        base[f"{b}.attention.query_key_value.bias"] = _t(bias)
+        base[f"{b}.attention.dense.weight"] = _t(
+            np.asarray(p["attn"]["o_proj"]["kernel"]).T)
+        base[f"{b}.attention.dense.bias"] = _t(p["attn"]["o_proj"]["bias"])
+        moe = p["moe"]
+        base[f"{b}.mlp.deepspeed_moe.gate.wg.weight"] = _t(
+            np.asarray(moe["gate"]["kernel"]).T)
+        ex = f"{b}.mlp.deepspeed_moe.experts.deepspeed_experts"
+        for e in range(cfg.moe_num_experts):
+            experts[e][f"{ex}.{e}.dense_h_to_4h.weight"] = _t(
+                np.asarray(moe["c_fc"][e]).T)
+            experts[e][f"{ex}.{e}.dense_h_to_4h.bias"] = _t(
+                moe["c_fc_bias"][e])
+            experts[e][f"{ex}.{e}.dense_4h_to_h.weight"] = _t(
+                np.asarray(moe["c_proj"][e]).T)
+            experts[e][f"{ex}.{e}.dense_4h_to_h.bias"] = _t(
+                moe["c_proj_bias"][e])
+    os.makedirs(out_dir, exist_ok=True)
+    torch.save({"module": base},
+               os.path.join(out_dir, "mp_rank_00_model_states.pt"))
+    # one file per GLOBAL expert — this IS the expert sharding on disk
+    for e, esd in experts.items():
+        torch.save(esd, os.path.join(
+            out_dir, f"layer_0_expert_{e}_mp_rank_00_model_states.pt"))
+
+
+@pytest.fixture(scope="module")
+def moe_roundtrip(tmp_path_factory):
+    policy = MegatronMoELayerPolicy()
+    cfg = policy.build_config(_MoECfg())
+    assert cfg.moe_num_experts == 4 and cfg.moe_expert_style == "mlp"
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 96, (2, 10)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    ckpt = str(tmp_path_factory.mktemp("meg_moe_ckpt"))
+    _export_megatron_moe(jax.tree_util.tree_map(np.asarray, params),
+                         cfg, ckpt)
+    sd = load_megatron_ds_moe_checkpoint(ckpt)
+    imported = policy.convert(sd, _MoECfg())
+    return cfg, model, params, imported, ids
+
+
+def test_import_is_numerically_identical(moe_roundtrip):
+    cfg, model, params, imported, ids = moe_roundtrip
+    flat_src = jax.tree_util.tree_leaves_with_path(
+        jax.tree_util.tree_map(np.asarray, params))
+    flat_imp = dict(jax.tree_util.tree_leaves_with_path(imported))
+    src = {jax.tree_util.keystr(k): v for k, v in flat_src}
+    imp = {jax.tree_util.keystr(k): v for k, v in flat_imp.items()}
+    assert set(src) == set(imp), (set(src) ^ set(imp))
+    for k in src:
+        np.testing.assert_allclose(src[k], imp[k], rtol=1e-6, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_imported_model_logits_match(moe_roundtrip):
+    cfg, model, params, imported, ids = moe_roundtrip
+    ref = model.apply({"params": params}, ids)
+    got = model.apply({"params": imported}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_imported_model_decodes(moe_roundtrip):
+    import deepspeed_tpu
+
+    cfg, model, params, imported, ids = moe_roundtrip
+    eng = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=imported,
+        config={"dtype": "float32"})
+    toks = np.asarray(eng.generate(ids, max_new_tokens=4))
+    assert toks.shape == (2, 14)
+    ref = deepspeed_tpu.init_inference(
+        model=model, model_config=cfg, params=params,
+        config={"dtype": "float32"})
+    np.testing.assert_array_equal(
+        toks, np.asarray(ref.generate(ids, max_new_tokens=4)))
+
+
+def test_missing_expert_files_raise(tmp_path):
+    torch.save({"module": {}},
+               os.path.join(tmp_path, "mp_rank_00_model_states.pt"))
+    with pytest.raises(FileNotFoundError, match="expert"):
+        load_megatron_ds_moe_checkpoint(str(tmp_path))
+
+
+def test_expert_count_mismatch_raises(moe_roundtrip, tmp_path):
+    cfg, model, params, _, _ = moe_roundtrip
+    ckpt = str(tmp_path / "ck")
+    _export_megatron_moe(jax.tree_util.tree_map(np.asarray, params),
+                         cfg, ckpt)
+    os.remove(os.path.join(
+        ckpt, "layer_0_expert_3_mp_rank_00_model_states.pt"))
+    sd = load_megatron_ds_moe_checkpoint(ckpt)
+    with pytest.raises(ValueError, match="experts"):
+        MegatronMoELayerPolicy().convert(sd, _MoECfg())

@@ -1,0 +1,141 @@
+"""Same-session byte-rate shootout for the int8 decode matmul designs.
+
+Round-5 question (VERDICT #1): the round-4 kernel streams ~270-380 GB/s of
+int8 bytes where XLA's bf16 pipeline reaches ~670 GB/s at 7B shapes. Root
+cause hypothesis: the row-major [K, N] weight layout makes every (bk, bn)
+tile DMA read only bn contiguous BYTES per row (256 B at the shipped
+panel), below HBM burst efficiency; bf16 rows are 2x longer for the same
+panel. Candidates measured here, all on the 7B MLP chain
+[1,4096]@[4096,22016] -> [1,22016]@[22016,4096]:
+
+  bf16        — plain XLA bf16 matmuls (the 670 GB/s reference pipeline)
+  row-major   — shipping kernel (full-K x 256 panels on a [K, N] weight)
+  tiled-256   — tile_rowwise layout, contiguous full-K x 256 tiles
+  tiled-512   — same, 512-wide tiles (contiguity may flip the 256-vs-512
+                panel answer: fewer, larger linear reads)
+  w8a8-xla    — dynamic per-token activation quant + native int8 x int8
+                lax.dot_general (no Pallas; XLA streams int8 natively)
+  w8a16-xla   — x @ q.astype(bf16): the convert-materializes case the
+                kernel exists to beat (sanity lower bound)
+
+Per tpu-tunnel discipline: one process, adjacent runs, element fence via
+float(), best-of-3 windows sized >> the ~100 ms tunnel RTT.
+
+Writes tools/probe_int8_byterate.json.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.int8_matmul import (
+    int8_matmul, tile_rowwise, _default_block_k)
+
+D, F2 = 4096, 22016
+R = 1024
+INT8_BYTES = D * F2 + F2 * D            # per chain iter
+BF16_BYTES = 2 * INT8_BYTES
+
+
+def window(run, x0, reps=3):
+    float(jnp.sum(run(x0)))              # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        float(jnp.sum(run(x0)))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    q1 = jnp.asarray(rng.integers(-127, 128, (D, F2), dtype=np.int8))
+    q2 = jnp.asarray(rng.integers(-127, 128, (F2, D), dtype=np.int8))
+    # unit-gain scales keep the R-step chain in bf16 range (same trick as
+    # the engine's panel autotune)
+    s1 = jnp.full((D,), 1.0 / (73.0 * np.sqrt(D)), jnp.float32)
+    s2 = jnp.full((F2,), 1.0 / (73.0 * np.sqrt(F2)), jnp.float32)
+    w1 = (q1.astype(jnp.float32) * s1[:, None]).astype(jnp.bfloat16)
+    w2 = (q2.astype(jnp.float32) * s2[:, None]).astype(jnp.bfloat16)
+    x0 = jnp.ones((1, D), jnp.bfloat16)
+
+    results = {}
+
+    def record(name, fn, weight_bytes, ws, *, block=None):
+        # weights ride as jit ARGUMENTS (``ws``), not closure constants:
+        # baked-in constants ship inside the program to the tunnel's
+        # remote-compile endpoint and 360 MB of bf16 trips its request
+        # cap (HTTP 413)
+        try:
+            def loop(x, ws):
+                def body(i, x):
+                    return fn(fn(x, 0, ws), 1, ws)
+                return jax.lax.fori_loop(0, R, body, x)
+            jitted = jax.jit(loop)
+            t = window(lambda x: jitted(x, ws), x0)
+            gbs = weight_bytes * R / t / 1e9
+            results[name] = {"window_s": round(t, 4),
+                             "weight_GBps": round(gbs, 1)}
+            if block:
+                results[name]["block"] = block
+            print(f"{name:12s} {t*1e3:9.1f} ms  {gbs:7.1f} GB/s weight bytes")
+        except Exception as e:                      # noqa: BLE001
+            results[name] = {"error": repr(e)[:200]}
+            print(f"{name:12s} FAILED: {e!r}")
+
+    # --- bf16 XLA reference pipeline
+    record("bf16", lambda x, i, ws: x @ ws[i], BF16_BYTES, (w1, w2))
+
+    # --- shipping row-major kernel
+    record("row-major",
+           lambda x, i, ws: int8_matmul(x, ws[2 * i], ws[2 * i + 1],
+                                        out_dtype=jnp.bfloat16),
+           INT8_BYTES, (q1, s1, q2, s2))
+
+    # --- tiled layouts (block_k=None takes the production default per K;
+    # smaller explicit block_k trades the full-K accumulator economy for
+    # more outstanding DMAs — the pipelining-depth axis)
+    for bn, bk in ((256, 2048), (512, 2048), (512, 4096), (768, 2048)):
+        t1 = tile_rowwise(q1, s1, block_k=bk, block_n=bn)
+        t2 = tile_rowwise(q2, s2, block_k=bk, block_n=bn)
+        record(f"tiled-{bn}" + ("" if bk is None else f"x{bk}"),
+               lambda x, i, ws: int8_matmul(
+                   x, ws[2 * i], ws[2 * i + 1], out_dtype=jnp.bfloat16),
+               INT8_BYTES, (t1[0], t1[1], t2[0], t2[1]),
+               block=[list(t1[0].shape), list(t2[0].shape)])
+
+    # --- XLA-native int8 x int8 with dynamic activation quant
+    def w8a8(x, i, ws):
+        q, s = ws[2 * i], ws[2 * i + 1]
+        xs = x.astype(jnp.float32) * s[None, :]
+        ax = jnp.max(jnp.abs(xs), axis=1, keepdims=True) / 127.0
+        ax = jnp.maximum(ax, 1e-30)
+        xi = jnp.clip(jnp.round(xs / ax), -127, 127).astype(jnp.int8)
+        y = jax.lax.dot_general(xi, q, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        return (y.astype(jnp.float32) * ax).astype(jnp.bfloat16)
+    record("w8a8-xla", w8a8, INT8_BYTES, (q1, s1, q2, s2))
+
+    # --- convert-materializing sanity case
+    def w8a16(x, i, ws):
+        q, s = ws[2 * i], ws[2 * i + 1]
+        xs = (x.astype(jnp.float32) * s[None, :]).astype(jnp.bfloat16)
+        return xs @ q.astype(jnp.bfloat16)
+    record("w8a16-xla", w8a16, INT8_BYTES, (q1, s1, q2, s2))
+
+    out = {"shapes": {"D": D, "F2": F2, "R": R},
+           "backend": jax.default_backend(),
+           "results": results}
+    with open("tools/probe_int8_byterate.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["results"], indent=1))
+
+
+if __name__ == "__main__":
+    main()

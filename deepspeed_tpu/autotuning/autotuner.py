@@ -419,10 +419,15 @@ class Autotuner:
         best = self._finalist_pass(best)
         if best is not probe_best:
             # the finalist pass changed the winner: report ITS re-measured
-            # number, not the probe winner's stale one
+            # number IN THE CONFIGURED METRIC'S UNITS
             top = self._finalist_table["finalists"][0]
-            val = (top["latency_p50"] if self.cfg.metric == "latency"
-                   else top["throughput_p50"])
+            if self.cfg.metric == "latency":
+                val = top["latency_p50"]
+            elif self.cfg.metric == "flops":
+                val = (top["throughput_p50"]
+                       * self.model_info.flops_per_sample)
+            else:
+                val = top["throughput_p50"]
             logger.info(f"autotuning: best config {best.key()} "
                         f"{self.cfg.metric}={val:.2f} (finalist re-measure; "
                         f"probe winner was {probe_best.key()})")

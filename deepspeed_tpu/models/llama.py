@@ -47,6 +47,17 @@ class LlamaConfig:
     scan_layers: bool = True
     attention_impl: str = "auto"   # flash kicks in at long seqlen
     tie_embeddings: bool = False
+    # ZeRO-3/FSDP gather discipline for the layer scan: constrain each
+    # scan iteration's parameter SLICE to replicated, so the SPMD
+    # partitioner all-gathers ONE layer inside the loop body instead of
+    # hoisting a loop-invariant gather of the whole stacked tree (at 7B
+    # that hoist is a 13.5 GB temp — the difference between ZeRO-3
+    # fitting a 16 GB chip and not; see tools/zero3_7b_projection.py).
+    # Under block remat the gather itself rematerializes in backward.
+    # Off by default: only meaningful when params are sharded over
+    # data/mics; skipped automatically under tensor/sequence sharding
+    # (the constraint would fight the TP spec).
+    fsdp_gather_scan: bool = False
 
     def __post_init__(self):
         if self.remat_scope not in ("block", "attn", "mlp"):
@@ -132,6 +143,23 @@ class LlamaBlock(nn.Module):
         return x + h
 
 
+def _fsdp_gather_leaf(a):
+    """Replicate-constrain one per-layer weight slice inside the scan body
+    (see LlamaConfig.fsdp_gather_scan). No-op without an ambient mesh or
+    when model axes are active."""
+    from jax.sharding import PartitionSpec, get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return a
+    shape = dict(mesh.shape)
+    if shape.get("data", 1) <= 1 and shape.get("mics", 1) <= 1:
+        return a
+    if any(shape.get(ax, 1) > 1 for ax in ("tensor", "sequence", "expert")):
+        return a
+    return jax.lax.with_sharding_constraint(a, PartitionSpec())
+
+
 class _ScanLlamaBlock(nn.Module):
     """Scan body: (carry, None) contract over a stack of identical blocks."""
 
@@ -141,8 +169,18 @@ class _ScanLlamaBlock(nn.Module):
     def __call__(self, x, mask, positions):
         cfg = self.cfg
         block_cls = LlamaBlock
+        if cfg.fsdp_gather_scan:
+            # map the sliced params through the gather constraint ON READ,
+            # inside the (possibly rematerialized) body — backward then
+            # re-gathers instead of keeping L gathered layers live
+            block_cls = nn.map_variables(
+                block_cls, "params",
+                trans_in_fn=lambda vs: jax.tree_util.tree_map(
+                    _fsdp_gather_leaf, vs),
+                trans_out_fn=lambda vs: vs,   # init writes pass through
+                mutable=True)
         if cfg.remat and cfg.remat_scope == "block":
-            block_cls = nn.remat(LlamaBlock, policy=_remat_policy(cfg.remat_policy))
+            block_cls = nn.remat(block_cls, policy=_remat_policy(cfg.remat_policy))
         return block_cls(cfg, name="block")(x, mask, positions), None
 
 

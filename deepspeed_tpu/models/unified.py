@@ -20,6 +20,7 @@ flax model whose config spans the same architecture space:
 """
 
 import dataclasses
+import functools
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
@@ -283,9 +284,6 @@ class UnifiedBlock(nn.Module):
         if kv_cache is not None:
             return out, new_cache
         return out
-
-
-import functools
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

@@ -86,6 +86,9 @@ def load_megatron_ds_moe_checkpoint(ckpt_dir: str,
         raise ValueError(
             f"expert files under {root} cover ids {sorted(eids)} — not a "
             f"contiguous 0..{max(eids)} set; the checkpoint is incomplete")
+    # count rides in-band so policy.convert can cross-check against the
+    # config; it is a plain int, NOT a tensor — strip before treating the
+    # dict as a pure state dict
     sd["_num_experts_found"] = len(eids)
     return sd
 

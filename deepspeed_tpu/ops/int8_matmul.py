@@ -131,14 +131,10 @@ def _default_block_k(K: int, block_m: int, block_n: int) -> int:
     block_k*block_n*3 B, each double-buffered)."""
     vmem_cap = (15 * 1024 * 1024
                 // (2 * (2 * block_m + 3 * block_n)))
-    block_k = K if K <= vmem_cap else 2048
-    if K % block_k:
-        # prefer the largest 256-multiple divisor of K within the cap so
-        # the row-major path never pads the weight per step
-        for cand in range(block_k - block_k % 256, 0, -256):
-            if K % cand == 0:
-                return cand
-    return block_k
+    # non-dividing results are snapped to the largest 256-multiple
+    # divisor by int8_matmul itself (one snap, one place — it applies to
+    # caller-supplied block_k too)
+    return K if K <= vmem_cap else 2048
 
 
 def pick_tile_block_n(N: int) -> Optional[int]:

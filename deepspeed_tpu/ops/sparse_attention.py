@@ -373,7 +373,7 @@ def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, kpm_ref, o_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(layout_ref[h, qi, ki] != 0)
+    @pl.when(layout_ref[jnp.minimum(h, layout_ref.shape[0] - 1), qi, ki] != 0)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -483,7 +483,7 @@ def _sparse_bwd_dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, kpm_ref,
     def _init():
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(layout_ref[h, qi, ki] != 0)
+    @pl.when(layout_ref[jnp.minimum(h, layout_ref.shape[0] - 1), qi, ki] != 0)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -525,7 +525,7 @@ def _sparse_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, kpm_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(layout_ref[h, qi, ki] != 0)
+    @pl.when(layout_ref[jnp.minimum(h, layout_ref.shape[0] - 1), qi, ki] != 0)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -676,6 +676,15 @@ def sparse_attention(q, k, v, layout, block: int,
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if not isinstance(layout, jax.core.Tracer):
+        # the layout rides as a SCALAR-PREFETCH array (SMEM, ~1 MB total):
+        # [16, 128, 128] int32 at seq 8192 alone overflows it and crashes
+        # the TPU compiler. Every stock SparsityConfig with
+        # different_layouts_per_head=False emits H identical copies —
+        # dedupe to [1, nq, nk]; the kernels clamp their head index
+        lay = np.asarray(layout)
+        if lay.ndim == 3 and lay.shape[0] > 1 and (lay == lay[:1]).all():
+            layout = lay[:1]
     layout = jnp.asarray(layout, dtype=jnp.int32)
     if key_padding_mask is None:
         key_padding_mask = jnp.ones((q.shape[0], k.shape[1]), dtype=jnp.int32)

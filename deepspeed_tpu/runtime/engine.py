@@ -158,6 +158,7 @@ class DeepSpeedEngine:
         }[self._config.precision_dtype]
 
         # loss / model fn -----------------------------------------------------
+        model = self._maybe_enable_fsdp_gather(model, loss_fn)
         if loss_fn is not None:
             self.loss_fn = loss_fn
         elif model is not None and hasattr(model, "apply"):
@@ -480,6 +481,31 @@ class DeepSpeedEngine:
                 (lambda s: NamedSharding(mesh, s))
             out[key] = jax.tree_util.tree_map(mapper, sub, is_leaf=is_spec)
         return out
+
+    def _maybe_enable_fsdp_gather(self, model, user_loss_fn):
+        """Stage-3 HBM-resident training over a real data axis: rebuild a
+        scan-layers LlamaModel with ``fsdp_gather_scan`` so each scan
+        iteration gathers ONE layer's sharded weights inside the loop
+        (reference analogue: the per-submodule fetch/release of
+        parameter_offload.py:201 — here expressed as an in-scan sharding
+        constraint for XLA to schedule; see LlamaConfig.fsdp_gather_scan
+        and tools/zero3_7b_projection.py for the 7B memory consequence)."""
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+        zc = self._config.zero_config
+        if (zc.stage < 3 or zc.offload_param_device != "none"
+                or self.mesh.shape.get("data", 1) <= 1
+                or any(self.mesh.shape.get(ax, 1) > 1
+                       for ax in ("tensor", "sequence", "expert"))
+                or user_loss_fn is not None
+                or not isinstance(model, LlamaModel)
+                or not getattr(model.cfg, "scan_layers", False)
+                or model.cfg.fsdp_gather_scan):
+            return model
+        import dataclasses
+
+        return LlamaModel(dataclasses.replace(model.cfg,
+                                              fsdp_gather_scan=True))
 
     def _setup_param_streaming(self, model, user_loss_fn):
         """ZeRO-3 parameter offload compute path (reference

@@ -411,3 +411,36 @@ def test_reduction_knobs_train(dp8_mesh):
     # bf16 grad casting wiggles the trajectory slightly but must converge
     assert abs(l_ref - l_knob) < 0.15, (l_ref, l_knob)
     assert l_knob < 6.0
+
+
+def test_stage3_enables_fsdp_gather_scan(dp8_mesh):
+    """HBM-resident ZeRO-3 over a real data axis rebuilds a scan-layers
+    LlamaModel with fsdp_gather_scan (per-layer in-scan gathers — the
+    memory discipline that lets 7B fit a v5e-16, see
+    tools/zero3_7b_projection.py), and training still steps with
+    identical param structure."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, hidden_size=128,
+                           intermediate_size=256)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, cfg.vocab_size, size=(8, 17))
+    batch = {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+    eng = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}},
+        sample_batch=batch)
+    losses = [float(eng.train_batch(batch)) for _ in range(3)]
+    assert losses[-1] < losses[0]
+    # stage 1 (no param sharding) must NOT rewrap
+    eng1 = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}},
+        sample_batch=batch)
+    float(eng1.train_batch(batch))

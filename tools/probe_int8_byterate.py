@@ -34,8 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.ops.int8_matmul import (
-    int8_matmul, tile_rowwise, _default_block_k)
+from deepspeed_tpu.ops.int8_matmul import int8_matmul, tile_rowwise
 
 D, F2 = 4096, 22016
 R = 1024
@@ -101,7 +100,9 @@ def main():
     # --- tiled layouts (block_k=None takes the production default per K;
     # smaller explicit block_k trades the full-K accumulator economy for
     # more outstanding DMAs — the pipelining-depth axis)
-    for bn, bk in ((256, 2048), (512, 2048), (512, 4096), (768, 2048)):
+    # NB: every bn must divide both N=22016 and N=4096 (tile_rowwise
+    # asserts); 768 does not — it crashed a round-5 probe run
+    for bn, bk in ((256, 2048), (512, 2048), (512, 4096), (512, 1024)):
         t1 = tile_rowwise(q1, s1, block_k=bk, block_n=bn)
         t2 = tile_rowwise(q2, s2, block_k=bk, block_n=bn)
         record(f"tiled-{bn}" + ("" if bk is None else f"x{bk}"),

@@ -1,0 +1,373 @@
+"""The BASELINE.json headline artifact: ZeRO-3 tokens/sec/chip at 7B
+(VERDICT r4 #4).
+
+One real v5e chip cannot hold a 7B ZeRO-3 shard of a dp=8 pod (that IS
+the point of ZeRO-3 — state shards 8 ways), so the artifact has two
+halves, mirroring the reference's own method of staking multi-node
+claims on measured single-node efficiency
+(/root/reference/docs/_posts/2021-03-08-zero3-offload.md:65):
+
+1. ``--anchor`` (real chip): measure a NEW MFU point at the largest
+   HBM-RESIDENT trainable size — a ~0.95B Llama (H=2048, F=5504, L=16)
+   with bf16 mu + factored nu + fused loss (the 1.34B/L=24 shape wanted
+   20.43 GB). 7B-like matmul shapes, no host traffic — this pins the
+   hardware efficiency term of the projection with a measurement, not a
+   model.
+
+2. ``--project`` (virtual CPU mesh): AOT-compile the REAL 7B fused
+   ZeRO-3 train step over a dp=8 mesh (params+grads+opt sharded over
+   data, the stage-3 plan from runtime/zero/stages.py) across a remat
+   ladder, read ``compiled.memory_analysis()`` per-device peaks, and
+   project tokens/sec/chip:
+
+       eff_hw   = anchor_mfu * (1 + recompute_anchor)
+       tok/s/chip = eff_hw * PEAK / (6N * (1 + recompute_case))
+
+   The memory accounting is the compiler's, not a spreadsheet; the
+   efficiency is measured on silicon; only the composition is a model.
+
+Writes tools/zero3_7b_projection.json (merging both halves).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_PEAK = 197e12
+V5E_HBM = 15.75e9
+V5P_PEAK = 459e12
+V5P_HBM = 95e9
+VOCAB = 32000
+SEQ = 512
+REMAT_RECOMPUTE = {"none": 0.0, "save_mlp": 0.2, "block_nothing": 1 / 3}
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "zero3_7b_projection.json")
+
+
+def _load():
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            return json.load(f)
+    return {}
+
+
+def _save(d):
+    with open(OUT, "w") as f:
+        json.dump(d, f, indent=1)
+    print(json.dumps(d))
+
+
+def anchor():
+    """Measured MFU at the largest HBM-resident size (real chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    # ~0.95B: the 1.34B (L=24, micro 8) attempt measured 20.43 GB wanted
+    # (fp32 master + fp32 grads + bf16 params/mu + activations) — L=16 at
+    # micro 4 is the largest 7B-shaped config that actually fits
+    H, F, L, HEADS = 2048, 5504, 16, 16
+    MICRO, GAS = 4, 4
+    cfg = LlamaConfig(
+        vocab_size=VOCAB, hidden_size=H, intermediate_size=F, num_layers=L,
+        num_heads=HEADS, num_kv_heads=HEADS, max_seq_len=SEQ,
+        dtype=jnp.bfloat16, remat=True, remat_policy="nothing_saveable",
+        remat_scope="block", scan_layers=True)
+    model = LlamaModel(cfg)
+    ds = {
+        "train_micro_batch_size_per_gpu": MICRO,
+        "gradient_accumulation_steps": GAS,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "mu_dtype": "bfloat16",
+                                 "nu_dtype": "factored"}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "fused_lm_loss": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+    }
+    rng = np.random.default_rng(0)
+
+    def batch():
+        t = rng.integers(0, VOCAB, (MICRO * GAS, SEQ + 1))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    t0 = time.time()
+    eng = deepspeed_tpu.initialize(model=model, config=ds,
+                                   sample_batch=batch())
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(eng.params))
+    print(f"# engine up in {time.time()-t0:.0f}s, {n_params/1e9:.2f}B "
+          f"params", file=sys.stderr, flush=True)
+    float(eng.train_batch(batch()))              # compile + warm
+    times = []
+    for i in range(6):
+        t0 = time.time()
+        loss = float(eng.train_batch(batch()))
+        times.append(time.time() - t0)
+        print(f"# step {i}: {times[-1]:.2f}s loss={loss:.3f}",
+              file=sys.stderr, flush=True)
+    best = min(times)
+    tok_s = MICRO * GAS * SEQ / best
+    mfu = 6 * n_params * tok_s / V5E_PEAK
+    row = {
+        "shape": {"H": H, "F": F, "L": L, "heads": HEADS,
+                  "micro": MICRO, "gas": GAS, "seq": SEQ},
+        "n_params": n_params,
+        "moments": "bf16 mu + factored nu",
+        "step_walls_s": [round(t, 2) for t in times],
+        "tokens_per_sec": round(tok_s, 1),
+        "measured_mfu": round(mfu, 4),
+        "remat": "block_nothing",
+        "eff_hw": round(mfu * (1 + REMAT_RECOMPUTE["block_nothing"]), 4),
+    }
+    d = _load()
+    d["anchor_hbm_resident"] = row
+    _save(d)
+
+
+def project():
+    """AOT-compile the 7B ZeRO-3 step at dp=8 (CPU mesh), project."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=16"
+                               ).strip()
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge._backends:
+        xla_bridge._clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    from deepspeed_tpu.models.llama import loss_fn as lm_loss
+    from deepspeed_tpu.ops.optimizers import scale_by_adam_factored_nu
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.stages import (
+        opt_state_shardings, plan_zero_shardings,
+    )
+
+    d = _load()
+    eff_hw = d.get("anchor_hbm_resident", {}).get("eff_hw")
+    if eff_hw is None:
+        print("# no anchor yet — run --anchor on the chip first; "
+              "projecting with the round-3 block-remat MFU 0.4173",
+              file=sys.stderr)
+        eff_hw = round(0.4173 * (1 + REMAT_RECOMPUTE["block_nothing"]), 4)
+
+    H, F, L, HEADS = 4096, 11008, 32, 32         # Llama-7B
+
+    def build(remat_case):
+        base = dict(vocab_size=VOCAB, hidden_size=H, intermediate_size=F,
+                    num_layers=L, num_heads=HEADS, num_kv_heads=HEADS,
+                    max_seq_len=SEQ, dtype=jnp.bfloat16, scan_layers=True,
+                    fsdp_gather_scan=True)
+        if remat_case == "none":
+            return LlamaConfig(**base, remat=False)
+        policy = ("save_mlp" if remat_case == "save_mlp"
+                  else "nothing_saveable")
+        return LlamaConfig(**base, remat=True, remat_scope="block",
+                           remat_policy=policy)
+
+    def analyze(remat_case, micro_per_chip, moments, dp=8):
+        cfg = build(remat_case)
+        model = LlamaModel(cfg)
+        devices = np.array(jax.devices()[:dp]).reshape(1, dp, 1, 1, 1, 1)
+        mesh = Mesh(devices, ("pipe", "data", "expert", "mics",
+                              "sequence", "tensor"))
+        zc = DeepSpeedZeroConfig(stage=3)
+        abstract = jax.eval_shape(
+            lambda r: model.init(r, jnp.zeros((1, SEQ), jnp.int32))["params"],
+            jax.random.PRNGKey(0))
+        plan = plan_zero_shardings(abstract, mesh, zc)
+        if moments == "bf16mu_facnu":
+            inner = scale_by_adam_factored_nu(0.9, 0.999, 1e-8,
+                                              mu_dtype=jnp.bfloat16)
+            optimizer = optax.chain(optax.clip_by_global_norm(1.0), inner,
+                                    optax.scale(-1e-4))
+        else:
+            optimizer = optax.chain(optax.clip_by_global_norm(1.0),
+                                    optax.adamw(1e-4))
+        abs_opt = jax.eval_shape(optimizer.init, abstract)
+        opt_sh = opt_state_shardings(abs_opt, abstract, plan, mesh)
+        B = micro_per_chip * dp
+        bspec = NamedSharding(mesh, PartitionSpec("data"))
+
+        def train_step(params, opt_state, batch):
+            def loss(p):
+                logits = model.apply({"params": p}, batch["input_ids"])
+                return lm_loss(logits, batch["labels"])
+
+            l, grads = jax.value_and_grad(loss)(params)
+            grads = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, grads, plan.grad_specs)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt, l
+
+        def with_sh(tree, sh_tree):
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                tree, sh_tree)
+
+        abs_params = with_sh(abstract, plan.param_shardings)
+        abs_opt_sh = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+            if hasattr(a, "shape") and s is not None else
+            jax.ShapeDtypeStruct(a.shape, a.dtype), abs_opt, opt_sh)
+        abs_batch = {
+            "input_ids": jax.ShapeDtypeStruct((B, SEQ), jnp.int32,
+                                              sharding=bspec),
+            "labels": jax.ShapeDtypeStruct((B, SEQ), jnp.int32,
+                                           sharding=bspec),
+        }
+        t0 = time.time()
+        with jax.set_mesh(mesh):
+            compiled = jax.jit(train_step, donate_argnums=(0, 1)).lower(
+                abs_params, abs_opt_sh, abs_batch).compile()
+        ma = compiled.memory_analysis()
+        peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + max(ma.output_size_in_bytes - ma.alias_size_in_bytes, 0))
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(abstract))
+        extra = REMAT_RECOMPUTE[remat_case]
+        tok_v5e = eff_hw * V5E_PEAK / (6 * n_params * (1 + extra))
+        tok_v5p = eff_hw * V5P_PEAK / (6 * n_params * (1 + extra))
+        return {
+            "remat": remat_case, "micro_per_chip": micro_per_chip,
+            "moments": moments, "dp": dp, "zero_stage": 3,
+            "n_params": n_params,
+            "est_peak_gb": round(peak / 1e9, 2),
+            "fits_v5e": bool(peak < V5E_HBM * 0.92),
+            "fits_v5p": bool(peak < V5P_HBM * 0.92),
+            "proj_tok_s_chip_v5e": round(tok_v5e, 1),
+            "proj_tok_s_chip_v5p": round(tok_v5p, 1),
+            "compile_s": round(time.time() - t0, 1),
+        }
+
+    cases = [("block_nothing", 8, "bf16mu_facnu", 8)] if "--one" in sys.argv else [("block_nothing", 8, "bf16mu_facnu", 8),
+             ("block_nothing", 4, "bf16mu_facnu", 8),
+             ("block_nothing", 2, "bf16mu_facnu", 8),
+             ("block_nothing", 16, "bf16mu_facnu", 8),
+             ("save_mlp", 8, "bf16mu_facnu", 8),
+             ("save_mlp", 4, "bf16mu_facnu", 8),
+             ("save_mlp", 8, "fp32", 8),
+             ("none", 4, "bf16mu_facnu", 8),
+             ("none", 8, "bf16mu_facnu", 8),
+             ("block_nothing", 8, "bf16mu_facnu", 16),
+             ("save_mlp", 8, "bf16mu_facnu", 16)]
+    rows = []
+    for case in cases:
+        print(f"# compiling 7B zero-3 {case} ...", flush=True)
+        try:
+            rows.append(analyze(*case))
+        except Exception as e:  # noqa: BLE001
+            rows.append({"remat": case[0], "micro_per_chip": case[1],
+                         "moments": case[2], "dp": case[3],
+                         "error": str(e)[:400]})
+        print(json.dumps(rows[-1]), flush=True)
+    d = _load()
+    d["eff_hw_used"] = eff_hw
+    d["projection_7b_dp8"] = rows
+
+    # --- analytic v5e composition -------------------------------------
+    # The CPU backend's SPMD partitioner hoists the loop-invariant
+    # all-gather of the scan-stacked weights OUT of the layer loop (a
+    # 13.5 GB bf16 temp that dwarfs everything and is micro-invariant:
+    # see the micro 2/4/8 plateau in the compiled rows), even under the
+    # in-scan replicate constraint (LlamaConfig.fsdp_gather_scan). TPU's
+    # partitioner windows that gather through the loop — so the compiled
+    # rows are honest UPPER BOUNDS and this block composes the per-chip
+    # peak explicitly, with every term stated:
+    #   state/chip (exact, from the stage-3 plan) + fp32 grads/chip +
+    #   a 2-layer gathered window + activations/micro measured as the
+    #   micro-ladder delta of the COMPILED rows (the hoisted gather
+    #   cancels in the difference) + the chunked-loss logits buffer.
+    n = 6_738_415_616
+    layer_bf16 = 2 * (n - 2 * VOCAB * H) / L / 1e9
+    act_per_micro = {}
+    by_key = {(r.get("remat"), r.get("micro_per_chip"), r.get("moments"),
+               r.get("dp")): r for r in rows if "est_peak_gb" in r}
+    for remat, lo, hi in (("block_nothing", 8, 16), ("save_mlp", 4, 8)):
+        a = by_key.get((remat, lo, "bf16mu_facnu", 8))
+        b = by_key.get((remat, hi, "bf16mu_facnu", 8))
+        if a and b:
+            act_per_micro[remat] = round(
+                (b["est_peak_gb"] - a["est_peak_gb"]) / (hi - lo), 3)
+    analytic = []
+    for remat in ("block_nothing", "save_mlp"):
+        apm = act_per_micro.get(remat)
+        if apm is None:
+            continue
+        for dp in (8, 16):
+            for micro in (2, 4, 8):
+                state = (4 * n + 2 * n) / dp / 1e9    # fp32 master + bf16 mu
+                grads = 4 * n / dp / 1e9              # fp32 grad shard
+                logits = micro * SEQ * 512 * 4 / 1e9  # chunked loss buffer
+                peak = (state + grads + 2 * layer_bf16 + apm * micro
+                        + logits)
+                extra = REMAT_RECOMPUTE[remat]
+                analytic.append({
+                    "remat": remat, "dp": dp, "micro_per_chip": micro,
+                    "act_gb_per_micro": apm,
+                    "analytic_peak_gb": round(peak, 2),
+                    "fits_v5e": bool(peak * 1e9 < V5E_HBM * 0.92),
+                    "proj_tok_s_chip_v5e": round(
+                        eff_hw * V5E_PEAK / (6 * n * (1 + extra)), 1),
+                })
+    d["analytic_v5e"] = {
+        "assumptions": "windowed per-layer gather (TPU partitioner), "
+                       "2-layer window, fp32 grads sharded over dp, "
+                       "bf16 mu + factored nu, chunked LM loss",
+        "layer_bf16_gb": round(layer_bf16, 3),
+        "rows": analytic,
+    }
+    fit_rows = ([r for r in rows if r.get("fits_v5e")]
+                or [r for r in analytic if r.get("fits_v5e")])
+    if fit_rows:
+        best = max(fit_rows, key=lambda r: r["proj_tok_s_chip_v5e"])
+        d["headline"] = {
+            "metric": "zero3_7b_tokens_per_sec_per_chip_v5e_projected",
+            "value": best["proj_tok_s_chip_v5e"],
+            "config": {k: best.get(k) for k in ("remat", "micro_per_chip",
+                                                "moments", "dp")},
+            "memory_evidence": ("compiled dp=8 rows (CPU-partitioner "
+                                "upper bounds) + analytic_v5e composition"),
+            "efficiency_evidence": "measured MFU anchor (anchor_hbm_resident)",
+        }
+    # v5p fits everywhere incl. no-remat — record that headline too
+    v5p_rows = [r for r in rows if r.get("fits_v5p")]
+    if v5p_rows:
+        bestp = max(v5p_rows, key=lambda r: r["proj_tok_s_chip_v5p"])
+        d["headline_v5p"] = {
+            "metric": "zero3_7b_tokens_per_sec_per_chip_v5p_projected",
+            "value": bestp["proj_tok_s_chip_v5p"],
+            "config": {k: bestp[k] for k in ("remat", "micro_per_chip",
+                                             "moments", "dp")},
+        }
+    _save(d)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--anchor", action="store_true")
+    ap.add_argument("--project", action="store_true")
+    ap.add_argument("--one", action="store_true")
+    a = ap.parse_args()
+    if a.anchor:
+        anchor()
+    if a.project:
+        project()
+    if not (a.anchor or a.project):
+        ap.error("pass --anchor (real chip) and/or --project (CPU mesh)")

@@ -83,6 +83,9 @@ def main():
     ap.add_argument("--bf16-moments", action="store_true",
                     help="bf16 moment storage (grouped tier): host state "
                          "12 B/param instead of 16 — at 7B, 81 GB vs 108")
+    ap.add_argument("--no-prefetch", action="store_true",
+                    help="disable the grouped-stream double-buffered group "
+                         "fetch (round-5 overlap A/B arm)")
     args = ap.parse_args()
     offload = not args.no_offload
 
@@ -91,6 +94,8 @@ def main():
         zero["offload_param"] = {"device": "cpu"}
         if args.grouped:
             zero["offload_param"]["grouped_stream"] = args.grouped
+        if args.no_prefetch:
+            zero["offload_param"]["stream_prefetch"] = False
         if args.arch == "unified":
             # grads (5.4 GB at 1.3B) fit HBM; params/moments stay offloaded.
             # NOTE: through the axon tunnel the AOT compile helper currently
@@ -149,6 +154,8 @@ def main():
         "vs_baseline": round(state_gb / 15.75, 2),   # state:HBM ratio
         "detail": {"offload": offload, "arch": args.arch,
                    "grouped_stream": args.grouped,
+                   "stream_prefetch": bool(args.grouped
+                                           and not args.no_prefetch),
                    "moment_dtype": ("bfloat16" if args.bf16_moments
                                     else "float32"),
                    "train_state_gb": round(state_gb, 1),
@@ -157,7 +164,8 @@ def main():
                    "backend": jax.default_backend()},
     }
     print(json.dumps(out))
-    suffix = f"_g{args.grouped}" if args.grouped else ""
+    suffix = (f"_g{args.grouped}" if args.grouped else "") \
+        + ("_nopf" if args.no_prefetch else "")
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"zero_offload_capacity_{args.arch}_{args.size}{suffix}.json")

@@ -74,7 +74,9 @@ def inference_main(int8: bool = False, batch_size: int = 1,
         config["quant"] = {"enabled": True, "bits": 8, "group_size": 128,
                            "streaming": stream,
                            **({"kv_cache": True} if kv8 else {}),
-                           **({"block_n": panel} if panel else {})}
+                           **({"block_n": panel} if panel else {}),
+                           **({"w8a8_prefill": False}
+                              if "--no-w8a8" in sys.argv else {})}
     engine = deepspeed_tpu.init_inference(model=model, config=config,
                                           params=params, model_config=cfg)
 

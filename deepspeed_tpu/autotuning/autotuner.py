@@ -76,7 +76,8 @@ class Candidate:
                  num_micro: Optional[int] = None,
                  remat: Optional[str] = None,
                  fused_loss: Optional[bool] = None,
-                 moment_dtype: Optional[str] = None):
+                 moment_dtype: Optional[str] = None,
+                 grad_accum_dtype: Optional[str] = None):
         self.zero_stage = zero_stage
         self.micro_batch = micro_batch
         self.gas = gas
@@ -89,6 +90,10 @@ class Candidate:
         # optimizer-state memory — the knob that opened save_mlp on the
         # single chip, docs/PERF_ANALYSIS.md round 3)
         self.moment_dtype = moment_dtype
+        # grad storage dtype between backward and update (None = fp32;
+        # "bf16" halves the materialized grad tree — lossless at gas=1,
+        # docs/PERF_ANALYSIS.md round 5)
+        self.grad_accum_dtype = grad_accum_dtype
 
     def key(self) -> str:
         k = f"z{self.zero_stage}_mbs{self.micro_batch}_gas{self.gas}"
@@ -97,6 +102,7 @@ class Candidate:
         k += f"_fl{int(self.fused_loss)}" if self.fused_loss is not None \
             else ""
         k += f"_m[{self.moment_dtype}]" if self.moment_dtype else ""
+        k += f"_g[{self.grad_accum_dtype}]" if self.grad_accum_dtype else ""
         return k
 
     def model_overrides(self) -> Optional[Dict[str, Any]]:
@@ -133,6 +139,8 @@ class Candidate:
                 p["nu_dtype"] = "factored"
             else:
                 p["moment_dtype"] = self.moment_dtype
+        if self.grad_accum_dtype:
+            cfg["data_types"] = {"grad_accum_dtype": self.grad_accum_dtype}
         ov = self.model_overrides()
         if ov is not None:
             # consumed (popped) by the caller's engine_factory; harmless to
@@ -161,6 +169,8 @@ def estimate_memory_per_device(info: ModelInfo, cand: Candidate,
     elif cand.moment_dtype == "bf16mu+factored":
         # bf16 mu (4->2) + factored nu (4->~0)
         opt -= n * 6
+    if cand.grad_accum_dtype in ("bf16", "bfloat16"):
+        grads //= 2
     if cand.zero_stage >= 1:
         opt //= dp_size
     if cand.zero_stage >= 2:
@@ -235,6 +245,7 @@ class Autotuner:
         remats = self.cfg.remat_policies or [None]
         fused_opts = self.cfg.fused_lm_loss_options or [None]
         moments = self.cfg.moment_dtypes or [None]
+        grad_dts = self.cfg.grad_accum_dtypes or [None]
         pipe = int((self.base_config.get("mesh") or {}).get("pipe", 1) or 1)
         out = []
         for stage in stages:
@@ -242,6 +253,7 @@ class Autotuner:
               for remat in remats:
                 for fl in fused_opts:
                   for md in moments:
+                   for gd in grad_dts:
                     tbs = mbs * self.dp_size
                     if tbs < self.cfg.min_train_batch_size:
                         continue
@@ -260,12 +272,14 @@ class Autotuner:
                                            if mbs % d == 0)]
                         cands = [Candidate(stage, mbs, num_micro=pm,
                                            remat=remat, fused_loss=fl,
-                                           moment_dtype=md)
+                                           moment_dtype=md,
+                                           grad_accum_dtype=gd)
                                  for pm in pm_opts]
                     else:
                         cands = [Candidate(stage, mbs, remat=remat,
                                            fused_loss=fl,
-                                           moment_dtype=md)]
+                                           moment_dtype=md,
+                                           grad_accum_dtype=gd)]
                     for cand in cands:
                         if self.hbm is not None and \
                                 estimate_memory_per_device(

@@ -37,6 +37,10 @@ class AutotuningConfig(DeepSpeedConfigModel):
     # Adam moment storage dtypes, e.g. [None, "bfloat16"] — bf16 halves
     # optimizer-state memory (ops/optimizers.scale_by_adam_typed)
     moment_dtypes: Optional[List[Optional[str]]] = None
+    # grad storage dtypes between backward and update, e.g. [None, "bf16"]
+    # — bf16 halves the materialized grad tree (data_types.grad_accum_dtype;
+    # lossless at gas=1)
+    grad_accum_dtypes: Optional[List[Optional[str]]] = None
     # finalist re-measurement (VERDICT r4 #9): 3-step probes map
     # feasibility but sit inside tunnel noise, so the top-N candidates
     # are re-timed back-to-back in the same session with a longer

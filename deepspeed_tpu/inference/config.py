@@ -52,6 +52,22 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # pipeline). When on, block_n/autotune_panel apply only to leaves
     # that fall back to row-major (N not divisible by 256)
     tiled: bool = True
+    # w8a8 PREFILL: prompt rows dynamically quantize activations
+    # per-token (symmetric int8, weight row scales pre-folded) and run a
+    # native s8xs8->s32 dot — the int8 MXU path, 2x the bf16 systolic
+    # rate on v5e-class parts — instead of converting the weight into a
+    # bf16 GEMM feed. This is the lever for int8 TTFT <= bf16 TTFT
+    # (reference analogue: the int8 GEMMs behind pt_binding.cpp's
+    # quantized inference entry points). Decode steps are unaffected
+    # (weight-streaming kernel). Adds per-token activation rounding on
+    # prompt processing only; disable for bit-cautious serving.
+    w8a8_prefill: bool = True
+    # w8a8 DECODE (experimental, default off): decode-step matvecs also
+    # quantize the activation per token and run the s8xs8->s32 Pallas
+    # kernel (no int8→bf16 convert copy in VMEM — the freed budget buys
+    # deeper weight-DMA buffering). Adds per-step activation rounding on
+    # EVERY layer; enable only after an A/B on your checkpoint.
+    w8a8_decode: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

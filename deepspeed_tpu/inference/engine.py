@@ -486,6 +486,10 @@ class InferenceEngine:
             transform = None
         if self._quant_streaming and hasattr(decoder, "int8_block_n"):
             decoder.int8_block_n = self._pick_int8_panel()
+        if hasattr(decoder, "w8a8_prefill"):
+            decoder.w8a8_prefill = self._config.quant.w8a8_prefill
+        if hasattr(decoder, "w8a8_decode"):
+            decoder.w8a8_decode = self._config.quant.w8a8_decode
         self._decoder = decoder
         self._decode_transform = transform
         # K/V are written in the model config's compute dtype — caches must

@@ -593,11 +593,22 @@ class FusedLlamaDecoderModel:
     flax params of its own) with the decoder ``apply`` contract:
     ``apply({"params": fused_tree}, ids, caches, index)``."""
 
-    def __init__(self, cfg: LlamaConfig, int8_block_n: int = 256):
+    def __init__(self, cfg: LlamaConfig, int8_block_n: int = 256,
+                 w8a8_prefill: bool = True):
         self.cfg = cfg
         # int8-streaming N-panel width — session-tunable (the engine's
         # at-init microbench sets it; docs/PERF_ANALYSIS.md decode notes)
         self.int8_block_n = int8_block_n
+        # prefill rows run native s8xs8 dots (int8 MXU) instead of a
+        # convert-into-bf16-GEMM — see quant.w8a8_prefill. Applied per
+        # matmul only above the weight-size threshold where the halved
+        # feed bytes beat the per-token quant chain's fixed cost (7B
+        # shapes win, 770M shapes lose — measured round 5)
+        self.w8a8_prefill = w8a8_prefill
+        self.w8a8_min_weight_numel = 16_000_000
+        # decode-step matvecs through the s8xs8 kernel (experimental,
+        # engine-plumbed from quant.w8a8_decode; default off)
+        self.w8a8_decode = False
 
     def apply(self, variables, input_ids, kv_caches, cache_index,
               attn_start=0):
@@ -645,8 +656,50 @@ class FusedLlamaDecoderModel:
                     Kp = s.shape[0]
                     if Kp > Km:                # offline/tile K padding
                         x = jnp.pad(x, ((0, 0), (0, 0), (0, Kp - Km)))
-                    xs = (x.astype(jnp.float32)
-                          * s[None, None, :]).astype(cfg.dtype)
+                    xs32 = x.astype(jnp.float32) * s[None, None, :]
+                    # w8a8 only where the weight is big enough for the
+                    # halved feed bytes to beat the per-token quant
+                    # chain's fixed cost: 7B matmuls (K*N ~ 50-90M)
+                    # measured TTFT 80.5 -> 75.0/68.1 ms, while at 770M
+                    # (K*N ~ 7M) the same routing REGRESSED TTFT 40 ->
+                    # 50-63 ms — threshold between the two regimes
+                    _numel = 1
+                    for _d in q.shape:
+                        _numel *= int(_d)
+                    if self.w8a8_prefill and \
+                            _numel >= self.w8a8_min_weight_numel:
+                        # w8a8: weight row scales are already folded into
+                        # the activation above, so a per-token dynamic
+                        # symmetric quant covers the whole contraction and
+                        # the dot runs s8xs8->s32 on the int8 MXU (2x the
+                        # bf16 systolic rate) with NO weight convert in
+                        # the feed — the round-5 TTFT lever
+                        # (quant.w8a8_prefill)
+                        from deepspeed_tpu.ops.int8_matmul import (
+                            quantize_per_row,
+                        )
+
+                        xq, sx = quantize_per_row(xs32)
+                        if q.ndim == 4:
+                            # one einsum over the tiled layout. A/B'd
+                            # against unrolled per-k-slice batched dots
+                            # (hypothesis: the 2-contracting-dim einsum
+                            # re-lays the weight) — the unroll measured
+                            # WORSE (7B TTFT 90.1 vs 75.0 ms, compiles
+                            # 262 s vs 16) — keep the einsum
+                            nk, nn, bk, bn = q.shape
+                            x4 = xq.reshape(Bm, Tm, nk, bk)
+                            y = jnp.einsum(
+                                "mtkb,knbs->mtns", x4, q,
+                                preferred_element_type=jnp.int32)
+                            y = y.reshape(Bm, Tm, nn * bn)
+                        else:
+                            y = jax.lax.dot_general(
+                                xq, q, (((2,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+                        return (y.astype(jnp.float32) * sx
+                                ).astype(cfg.dtype)
+                    xs = xs32.astype(cfg.dtype)
                     if q.ndim == 4:
                         # contract straight over the tiled layout — a
                         # row-major untile at 7B is a 6.7 GB int8 shuffle
@@ -660,6 +713,14 @@ class FusedLlamaDecoderModel:
                                        q.astype(cfg.dtype))
                         return y.reshape(Bm, Tm, nn * bn)
                     return xs @ q.astype(cfg.dtype)
+                if self.w8a8_decode and q.ndim == 4:
+                    from deepspeed_tpu.ops.int8_matmul import (
+                        int8_matmul_tiled_w8a8,
+                    )
+
+                    y = int8_matmul_tiled_w8a8(
+                        x.reshape(Bm * Tm, Km), q, s, out_dtype=cfg.dtype)
+                    return y.reshape(Bm, Tm, -1)
                 y = int8_matmul(x.reshape(Bm * Tm, Km), q, s,
                                 block_n=self.int8_block_n,
                                 out_dtype=cfg.dtype)

@@ -75,6 +75,79 @@ def _kernel_tiled(x_ref, q_ref, o_ref, acc, *, nk: int):
         o_ref[...] = acc[...].astype(o_ref.dtype)
 
 
+def _kernel_tiled_w8a8(x_ref, q_ref, o_ref, acc, *, nk: int):
+    """w8a8 variant of :func:`_kernel_tiled`: the activation arrives
+    ALREADY int8 (per-token dynamic quant outside the kernel, weight row
+    scales pre-folded) and the dot runs s8xs8->s32 on the MXU — no
+    int8→bf16 convert copy in VMEM, so the weight pipeline's per-buffer
+    footprint drops from 3 B/elem to 1 and the saved budget buys deeper
+    DMA buffering. Output stays int32; the caller applies the per-token
+    scale (one multiply on [B, N])."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    acc[...] += jax.lax.dot_general(
+        x_ref[...], q_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        o_ref[...] = acc[...]
+
+
+def quantize_per_row(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, K] float → (xq int8, sx f32 [B, 1]) symmetric per row (per
+    token). The w8a8 activation-side quant — weight row scales must be
+    folded into x BEFORE this."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    sx = jnp.maximum(amax, 1e-12) / 127.0
+    xq = jnp.clip(jnp.round(x32 / sx), -127, 127).astype(jnp.int8)
+    return xq, sx
+
+
+def int8_matmul_tiled_w8a8(x: jnp.ndarray, qt: jnp.ndarray,
+                           scale: jnp.ndarray,
+                           out_dtype=None) -> jnp.ndarray:
+    """y ≈ (x * scale) @ untile(qt) with the activation dynamically
+    quantized per token — both operands int8, s32 accumulation
+    (quant.w8a8_decode). Same tiling contract as
+    :func:`int8_matmul_tiled`."""
+    B, K = x.shape
+    nk, nn, block_k, block_n = qt.shape
+    Kp, N = nk * block_k, nn * block_n
+    assert K <= Kp < K + max(block_k, 2048) and scale.shape == (Kp,), (
+        x.shape, qt.shape, scale.shape)
+    out_dtype = out_dtype or x.dtype
+    if Kp > K:
+        x = jnp.pad(x, ((0, 0), (0, Kp - K)))
+    xq, sx = quantize_per_row(x.astype(jnp.float32) * scale[None, :])
+    block_m = min(max(8, -(-B // 8) * 8), 512)
+    pad_b = (-B) % block_m
+    if pad_b:
+        xq = jnp.pad(xq, ((0, pad_b), (0, 0)))
+    nm = (B + pad_b) // block_m
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_tiled_w8a8, nk=nk),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda m, n, k: (m, k)),
+            pl.BlockSpec((1, 1, block_k, block_n),
+                         lambda m, n, k: (k, n, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda m, n, k: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((B + pad_b, N), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.int32)],
+        interpret=_use_interpret(),
+    )(xq, qt)
+    return (out[:B].astype(jnp.float32) * sx[:B]).astype(out_dtype)
+
+
 def tile_rowwise(q: jnp.ndarray, scale: jnp.ndarray,
                  block_k: Optional[int] = None,
                  block_n: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -288,6 +288,28 @@ class DeepSpeedConfig:
             fused = {"enabled": fused}
         self.fused_lm_loss_enabled: bool = fused.get("enabled", False)
         self.fused_lm_loss_chunk: int = fused.get("chunk_size", 256)
+        # reference data_types.grad_accum_dtype (runtime/config.py
+        # get_data_types): the dtype gradients are STORED in between
+        # backward and the optimizer step. Default (None) keeps the param
+        # dtype (fp32 master). "bf16" halves the materialized grad tree —
+        # at gas=1 this loses nothing (the backward computes in the bf16
+        # compute dtype anyway; fp32 storage only re-encodes bf16 values),
+        # and the optimizer chain upcasts to fp32 before clipping/Adam
+        # math. At gas>1 the micro-batch accumulator also runs at this
+        # dtype, which IS a fidelity trade — documented, opt-in.
+        dtypes = p.get("data_types", {})
+        _ga = dtypes.get("grad_accum_dtype")
+        if _ga is not None:
+            _ga = {"fp32": "float32", "float32": "float32",
+                   "bf16": "bfloat16", "bfloat16": "bfloat16"}.get(
+                       str(_ga).lower())
+            if _ga is None:
+                raise ValueError(
+                    f"data_types.grad_accum_dtype="
+                    f"{dtypes.get('grad_accum_dtype')!r}: supported values "
+                    f"are fp32/bf16 (fp16 grad accumulation is not "
+                    f"supported on the TPU build — use bf16)")
+        self.grad_accum_dtype: Optional[str] = _ga
         # checkify-style numerics guard (SURVEY §5: the TPU build's answer
         # to the reference's safe_mode/overflow sanitizers): every step also
         # verifies loss/grad finiteness in-graph; a tripped check skips the

@@ -493,6 +493,7 @@ class DeepSpeedEngine:
         from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
 
         zc = self._config.zero_config
+        self.fsdp_gather_scan_enabled = False
         if (zc.stage < 3 or zc.offload_param_device != "none"
                 or self.mesh.shape.get("data", 1) <= 1
                 or any(self.mesh.shape.get(ax, 1) > 1
@@ -504,6 +505,7 @@ class DeepSpeedEngine:
             return model
         import dataclasses
 
+        self.fsdp_gather_scan_enabled = True
         return LlamaModel(dataclasses.replace(model.cfg,
                                               fsdp_gather_scan=True))
 
@@ -608,6 +610,18 @@ class DeepSpeedEngine:
             base = build_optimizer(opt_cfg.type, opt_cfg.params, lr=lr_schedule)
 
         chain = []
+        if self._config.grad_accum_dtype == "bfloat16":
+            # grads arrive bf16 (data_types.grad_accum_dtype); upcast at
+            # the head so global-norm clipping and Adam math run fp32 —
+            # the converts fuse into the per-leaf update kernels, so the
+            # fp32 tree is never materialized whole
+            def _upcast(updates, state, params=None):
+                del params
+                return jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), updates), state
+
+            chain.append(optax.GradientTransformation(
+                lambda params: optax.EmptyState(), _upcast))
         if self._config.gradient_clipping > 0:
             chain.append(optax.clip_by_global_norm(self._config.gradient_clipping))
         chain.append(base)
@@ -655,6 +669,9 @@ class DeepSpeedEngine:
         _comm_dtypes = {"fp16": jnp.float16, "float16": jnp.float16,
                         "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
                         "fp32": jnp.float32, "float32": jnp.float32}
+        accum_dtype = ({"bfloat16": jnp.bfloat16, "float32": None}
+                       [self._config.grad_accum_dtype]
+                       if self._config.grad_accum_dtype else None)
         comm_dtype = None
         if self._config.communication_data_type:
             key = self._config.communication_data_type.lower()
@@ -689,6 +706,17 @@ class DeepSpeedEngine:
             loss, grads = jax.value_and_grad(scaled_loss)(params)
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
             grads = constrain_grads(grads)
+            if accum_dtype is not None:
+                # data_types.grad_accum_dtype: store the materialized grad
+                # tree at the accumulation dtype (the backward computed in
+                # the bf16 compute dtype; fp32 storage only re-encodes) —
+                # at 770M this is 1.55 GB of HBM back before the update.
+                # AFTER constrain_grads: the sharding-constraint boundary
+                # is where XLA places the cross-replica reduction, and the
+                # reduction dtype is communication_data_type's knob, not
+                # this one (reference keeps grad_accum_dtype storage-only)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(accum_dtype), grads)
             return loss / scale, grads
 
         def apply_update(params, opt_state, grads, scaler_state,
@@ -751,7 +779,7 @@ class DeepSpeedEngine:
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, jnp.float32), s),
+                    jnp.zeros(p.shape, accum_dtype or jnp.float32), s),
                 params, grad_shardings)
             (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), batch)
             grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
@@ -772,7 +800,8 @@ class DeepSpeedEngine:
             """NVMe path: the fused program minus the update — loss, grads,
             global norm, and finiteness, all in one compiled program."""
             loss, grads = accumulate_grads(params, scaler_state.scale, batch)
-            gnorm = optax.global_norm(grads)
+            gnorm = optax.global_norm(jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads))
             grads_ok = (grads_finite(grads) if (fp16 or numerics)
                         else jnp.asarray(True))
             loss_ok = (jnp.isfinite(loss) if numerics else jnp.asarray(True))
@@ -781,8 +810,28 @@ class DeepSpeedEngine:
         with jax.set_mesh(mesh):
             self._jit_loss = jax.jit(lambda p, b: loss_fn(p, b))
             self._jit_grad = jax.jit(grad_step)
-            self._jit_apply = jax.jit(apply_update, donate_argnums=(0, 1, 2))
-            self._jit_train_batch = jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
+            ts_out_sh = None
+            if ((plan.offload_param or plan.offload_optimizer)
+                    and mesh.devices.flat[0].platform != "cpu"):
+                # offloaded params/states come back out of the step still
+                # host-resident: the TPU AOT path refuses a program whose
+                # entry outputs were moved to host without a host-memory
+                # output layout ("layout for this output is not set to
+                # host memory") — declare them. (The virtual CPU backend
+                # cannot annotate host jit outputs; there host and device
+                # memory are the same RAM, so nothing is lost.)
+                ts_out_sh = (self.zero_plan.param_shardings,
+                             self._opt_shardings
+                             if plan.offload_optimizer and self._nvme is None
+                             else None,
+                             None, None, None)
+            self._jit_apply = jax.jit(
+                apply_update, donate_argnums=(0, 1, 2),
+                out_shardings=(ts_out_sh[0], ts_out_sh[1], None, None)
+                if ts_out_sh is not None else None)
+            self._jit_train_batch = jax.jit(
+                train_batch_fn, donate_argnums=(0, 1, 2),
+                out_shardings=ts_out_sh)
             self._jit_accum = jax.jit(
                 lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g),
                 donate_argnums=(0,))
@@ -806,7 +855,8 @@ class DeepSpeedEngine:
                 self._jit_grads_batch = jax.jit(grads_batch_fn,
                                                 out_shardings=grads_out_sh)
                 self._jit_gnorm_finite = jax.jit(
-                    lambda g: (optax.global_norm(g),
+                    lambda g: (optax.global_norm(jax.tree_util.tree_map(
+                        lambda x: x.astype(jnp.float32), g)),
                                grads_finite(g) if (fp16 or numerics)
                                else jnp.asarray(True)))
 

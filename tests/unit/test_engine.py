@@ -436,6 +436,9 @@ def test_stage3_enables_fsdp_gather_scan(dp8_mesh):
         sample_batch=batch)
     losses = [float(eng.train_batch(batch)) for _ in range(3)]
     assert losses[-1] < losses[0]
+    # the rewrap itself must have fired (loss decreasing alone would
+    # pass with the gate silently regressed)
+    assert eng.fsdp_gather_scan_enabled
     # stage 1 (no param sharding) must NOT rewrap
     eng1 = deepspeed_tpu.initialize(
         model=model,
@@ -444,3 +447,40 @@ def test_stage3_enables_fsdp_gather_scan(dp8_mesh):
                 "zero_optimization": {"stage": 1}},
         sample_batch=batch)
     float(eng1.train_batch(batch))
+    assert not eng1.fsdp_gather_scan_enabled
+
+
+def test_grad_accum_dtype_bf16_trajectory_parity():
+    """data_types.grad_accum_dtype=bf16 (reference runtime/config.py
+    get_data_types) stores the materialized grad tree in bf16; at gas=1
+    the backward already computed in the compute dtype, so vs fp32
+    storage the trajectory may differ only by storage rounding."""
+    e_ref, rng = make_engine(stage=1, gradient_accumulation_steps=1,
+                             gradient_clipping=1.0)
+    batches = [make_batch(rng, e_ref.train_batch_size()) for _ in range(6)]
+    ref = [float(e_ref.train_batch(b)) for b in batches]
+
+    e_bf16, _ = make_engine(stage=1, gradient_accumulation_steps=1,
+                            gradient_clipping=1.0,
+                            data_types={"grad_accum_dtype": "bf16"})
+    got = [float(e_bf16.train_batch(b)) for b in batches]
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0.03)
+    assert got[-1] < got[0]
+
+
+def test_grad_accum_dtype_bf16_gas_scan_runs():
+    """gas>1: the scan accumulator itself runs at the accum dtype (the
+    documented fidelity trade) — must still train."""
+    eng, rng = make_engine(stage=1, gradient_accumulation_steps=2,
+                           data_types={"grad_accum_dtype": "bfloat16"})
+    losses = [float(eng.train_batch(make_batch(rng, eng.train_batch_size())))
+              for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_grad_accum_dtype_rejects_fp16():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "data_types": {"grad_accum_dtype": "fp16"}})

@@ -43,6 +43,12 @@ ARCHS = {
                 moe_layer_freq=2, tie_embeddings=False),
     "remat": dict(pos_emb="rotary", gated_mlp=True, activation="silu",
                   remat=True, tie_embeddings=False),
+    # outside-remat fetch: the on-chip (axon tunnel) variant — the device
+    # copy is a saved residual instead of a backward re-fetch
+    # (TransformerConfig.stream_fetch_outside_remat; round-5 bisect)
+    "remat_out": dict(pos_emb="rotary", gated_mlp=True, activation="silu",
+                      remat=True, tie_embeddings=False,
+                      stream_fetch_outside_remat=True),
 }
 
 

@@ -90,6 +90,9 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--kv8", action="store_true",
                     help="add a third arm: int8-stream + int8 KV cache")
+    ap.add_argument("--w8a8-ab", action="store_true",
+                    help="add an adjacent arm with w8a8 prefill disabled "
+                         "(same-session TTFT isolation)")
     args = ap.parse_args()
 
     import jax
@@ -151,6 +154,23 @@ def main():
         del qparams
         out["int8_place_s"] = round(time.time() - t0, 1)
         out["int8_stream"] = measure(eng, ids, args.gen, "int8 stream")
+        if args.w8a8_ab:
+            # adjacent arm, same session: w8a8 prefill OFF (convert
+            # einsum) — isolates the prefill routing's TTFT effect from
+            # session-to-session tunnel swing
+            qp = eng.params
+            eng.release_workspace()
+            del eng
+            gc.collect()
+            eng = deepspeed_tpu.init_inference(
+                model_config=cfg, params=qp,
+                config={"dtype": "bfloat16",
+                        "quant": {"enabled": True, "bits": 8,
+                                  "streaming": True,
+                                  "w8a8_prefill": False}})
+            del qp
+            out["int8_stream_no_w8a8"] = measure(eng, ids, args.gen,
+                                                 "int8 stream no-w8a8")
         if args.kv8:
             # same weights, int8 KV cache — adjacent arm, same session.
             # The engine owns the (re-tiled) param tree; hand it to a

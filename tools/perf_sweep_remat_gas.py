@@ -90,6 +90,52 @@ FACTORED2_TRIALS = [
 ]
 
 
+# round-5 ladder: data_types.grad_accum_dtype=bf16 stores the materialized
+# grad tree at 2 B/param (-1.55 GB at 770M; at gas=1 lossless — backward
+# computes in bf16 anyway). Stacked with factored nu that is ~3 GB freed
+# vs the shipping config — enough for the attn/mlp-scope policies that
+# keep one sublayer's activations resident and cut the recompute tax.
+GRAD_TRIALS = [
+    ("g_base_save_mlp_bf16mom", 16, 1, True, "save_mlp", "block", False,
+     "bfloat16", None),
+    ("g16_save_mlp_bf16g", 16, 1, True, "save_mlp", "block", False,
+     "bfloat16", "bf16"),
+    ("g16_save_mlp_attn_bf16g", 16, 1, True, "save_mlp_attn", "block",
+     False, "bfloat16", "bf16"),
+    ("g16_attn_scope_bf16g", 16, 1, True, "nothing_saveable", "attn",
+     False, "bfloat16", "bf16"),
+    ("g16_attn_scope_bf16g_fac", 16, 1, True, "nothing_saveable", "attn",
+     False, "bf16mu+factored", "bf16"),
+    ("g16_mlp_scope_bf16g_fac", 16, 1, True, "nothing_saveable", "mlp",
+     False, "bf16mu+factored", "bf16"),
+    ("g16_attn_scope_bf16g_fac_fused", 16, 1, True, "nothing_saveable",
+     "attn", True, "bf16mu+factored", "bf16"),
+    ("g16_noremat_bf16g_fac_fused", 16, 1, False, "nothing_saveable",
+     "block", True, "bf16mu+factored", "bf16"),
+    ("g24_save_mlp_bf16g_fac", 24, 1, True, "save_mlp", "block", False,
+     "bf16mu+factored", "bf16"),
+    ("g24_attn_scope_bf16g_fac", 24, 1, True, "nothing_saveable", "attn",
+     False, "bf16mu+factored", "bf16"),
+]
+
+
+# follow-up probes: the micro=16 attn/mlp-scope arms die on hoisted
+# whole-stack bf16 weight casts + resident MLP activations; halving the
+# microbatch halves the resident set (gas=2 keeps the global batch)
+GRAD2_TRIALS = [
+    ("g8g2_attn_scope_bf16g_fac", 8, 2, True, "nothing_saveable", "attn",
+     False, "bf16mu+factored", "bf16"),
+    ("g8g2_attn_scope_bf16g_fac_fused", 8, 2, True, "nothing_saveable",
+     "attn", True, "bf16mu+factored", "bf16"),
+    ("g8g2_mlp_scope_bf16g_fac", 8, 2, True, "nothing_saveable", "mlp",
+     False, "bf16mu+factored", "bf16"),
+    ("g12_save_mlp_attn_bf16g_fac", 12, 1, True, "save_mlp_attn", "block",
+     False, "bf16mu+factored", "bf16"),
+    ("g12_attn_scope_bf16g_fac", 12, 1, True, "nothing_saveable", "attn",
+     False, "bf16mu+factored", "bf16"),
+]
+
+
 def run_trial(spec):
     import jax
     import jax.numpy as jnp
@@ -100,6 +146,7 @@ def run_trial(spec):
 
     label, micro, gas, remat, policy, scope, fused = spec[:7]
     moment_dtype = spec[7] if len(spec) > 7 else None
+    grad_accum = spec[8] if len(spec) > 8 else None
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=1536, intermediate_size=4096,
         num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
@@ -125,6 +172,8 @@ def run_trial(spec):
     }
     if fused:
         ds_config["fused_lm_loss"] = {"enabled": True, "chunk_size": 128}
+    if grad_accum:
+        ds_config["data_types"] = {"grad_accum_dtype": grad_accum}
     model = LlamaModel(cfg)
     rng = np.random.default_rng(0)
     tbs = micro * gas
@@ -157,7 +206,8 @@ def run_trial(spec):
                       "mfu": round(mfu, 4), "wall_s": round(best, 2),
                       "micro": micro, "gas": gas, "policy": policy,
                       "scope": scope, "fused": fused,
-                      "moment_dtype": moment_dtype}))
+                      "moment_dtype": moment_dtype,
+                      "grad_accum_dtype": grad_accum}))
 
 
 def main():
@@ -168,6 +218,10 @@ def main():
         trials = FACTORED2_TRIALS
     elif "--factored" in sys.argv:
         trials = FACTORED_TRIALS
+    elif "--grads" in sys.argv:
+        trials = GRAD_TRIALS
+    elif "--grads2" in sys.argv:
+        trials = GRAD2_TRIALS
     results = []
     for spec in trials:
         cmd = [sys.executable, os.path.abspath(__file__),
@@ -193,7 +247,9 @@ def main():
         print(json.dumps(results[-1]), flush=True)
     suffix = ("_moments" if "--moments" in sys.argv
               else "_factored2" if "--factored2" in sys.argv
-              else "_factored" if "--factored" in sys.argv else "")
+              else "_factored" if "--factored" in sys.argv
+              else "_grads2" if "--grads2" in sys.argv
+              else "_grads" if "--grads" in sys.argv else "")
     with open(f"/root/repo/tools/perf_sweep_remat_gas{suffix}.json",
               "w") as f:
         json.dump(results, f, indent=2)

@@ -12,7 +12,11 @@ Round 4 additions:
 - ``--arch unified`` (VERDICT r3 #4 on-chip proof): a ~1.3B GPT-2-shaped
   unified TransformerLM (21 GB state > HBM) streams through the
   model-agnostic ``streamed_twin`` protocol — the capacity feature is no
-  longer Llama-only.
+  longer Llama-only. Round 5: runs ON THE CHIP — the tunnel AOT refusal
+  was bisected to the remat×stream interaction
+  (tools/repro_axon_host_layout.py) and fixed by
+  ``stream_fetch_outside_remat`` + host-declared grad outputs
+  (``grads_to_host``).
 
 Run:
     python tools/zero_offload_capacity.py [--size 2b7|7b] [--arch llama|unified]
@@ -66,7 +70,8 @@ def build_model(arch: str, size: str):
         vocab_size=VOCAB, hidden_size=H, intermediate_size=F, num_layers=L,
         num_heads=HEADS, max_seq_len=SEQ, pos_emb="learned", norm="rmsnorm",
         activation="gelu_new", attn_bias=False, mlp_bias=False,
-        tie_embeddings=True, dtype=jnp.bfloat16, remat=True)
+        tie_embeddings=True, dtype=jnp.bfloat16, remat=True,
+        stream_fetch_outside_remat=True)
     return TransformerLM(cfg)
 
 
@@ -97,14 +102,16 @@ def main():
         if args.no_prefetch:
             zero["offload_param"]["stream_prefetch"] = False
         if args.arch == "unified":
-            # grads (5.4 GB at 1.3B) fit HBM; params/moments stay offloaded.
-            # NOTE: through the axon tunnel the AOT compile helper currently
-            # refuses this program's AD-transposed host moves ("layout for
-            # this output is not set to host memory") regardless of this
-            # knob — the unified streamed capacity path is pinned on the
-            # CPU mesh (tests/unit/test_param_offload_unified.py) and
-            # runs on directly-attached TPU VMs
-            zero["offload_param"]["grads_to_host"] = False
+            # grads land in pinned host RAM at the program boundary
+            # (declared jit out_shardings — the pattern the grouped-stream
+            # tier proves on this tunnel). Round-5 finding: with the
+            # custom-vjp fetches keeping MID-GRAPH values device-resident,
+            # the one remaining AOT refusal was the undeclared grads
+            # OUTPUT itself ("layout for this output is not set to host
+            # memory" at 1.3B, fine at toy scale) — grads_to_host=True is
+            # what declares it, so at capacity scale it is both the memory
+            # discipline AND the compile fix.
+            zero["offload_param"]["grads_to_host"] = True
         zero["offload_optimizer"] = {"device": "cpu"}
     opt_params = {"lr": 1e-4, "weight_decay": 0.0}
     if args.bf16_moments:

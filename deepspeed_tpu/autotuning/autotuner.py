@@ -140,7 +140,8 @@ class Candidate:
             else:
                 p["moment_dtype"] = self.moment_dtype
         if self.grad_accum_dtype:
-            cfg["data_types"] = {"grad_accum_dtype": self.grad_accum_dtype}
+            cfg.setdefault("data_types", {})["grad_accum_dtype"] = \
+                self.grad_accum_dtype
         ov = self.model_overrides()
         if ov is not None:
             # consumed (popped) by the caller's engine_factory; harmless to

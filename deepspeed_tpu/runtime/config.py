@@ -340,7 +340,9 @@ class DeepSpeedConfig:
         self.moe = MoEConfig(**p.get("moe", {}))
         self.checkpoint_config = CheckpointConfig(**p.get("checkpoint", {}))
         self.hybrid_engine = HybridEngineConfig(**p.get("hybrid_engine", {}))
-        self.data_types = DataTypeConfig(**p.get("data_types", {}))
+        # single source of truth: the model carries the NORMALIZED dtype
+        # name (self.grad_accum_dtype above), never the raw alias
+        self.data_types = DataTypeConfig(grad_accum_dtype=self.grad_accum_dtype)
         self.aio = AIOConfig(**p.get("aio", {}))
         self.elasticity = ElasticityConfig(**p.get("elasticity", {}))
         self.compression_config = p.get("compression_training", {})

@@ -93,6 +93,9 @@ def main():
     ap.add_argument("--w8a8-ab", action="store_true",
                     help="add an adjacent arm with w8a8 prefill disabled "
                          "(same-session TTFT isolation)")
+    ap.add_argument("--w8a8-decode", action="store_true",
+                    help="add an adjacent arm with the experimental "
+                         "s8xs8 decode kernel (quant.w8a8_decode)")
     args = ap.parse_args()
 
     import jax
@@ -171,6 +174,21 @@ def main():
             del qp
             out["int8_stream_no_w8a8"] = measure(eng, ids, args.gen,
                                                  "int8 stream no-w8a8")
+        if args.w8a8_decode:
+            # experimental s8xs8 decode kernel (quant.w8a8_decode) —
+            # adjacent arm, same session, same weights
+            qp = eng.params
+            eng.release_workspace()
+            del eng
+            gc.collect()
+            eng = deepspeed_tpu.init_inference(
+                model_config=cfg, params=qp,
+                config={"dtype": "bfloat16",
+                        "quant": {"enabled": True, "bits": 8,
+                                  "streaming": True, "w8a8_decode": True}})
+            del qp
+            out["int8_stream_w8a8dec"] = measure(eng, ids, args.gen,
+                                                 "int8 stream w8a8-decode")
         if args.kv8:
             # same weights, int8 KV cache — adjacent arm, same session.
             # The engine owns the (re-tiled) param tree; hand it to a

@@ -180,7 +180,7 @@ def project():
         return LlamaConfig(**base, remat=True, remat_scope="block",
                            remat_policy=policy)
 
-    def analyze(remat_case, micro_per_chip, moments, dp=8):
+    def analyze(remat_case, micro_per_chip, moments, dp=8, grads_dt=None):
         cfg = build(remat_case)
         model = LlamaModel(cfg)
         devices = np.array(jax.devices()[:dp]).reshape(1, dp, 1, 1, 1, 1)
@@ -212,6 +212,12 @@ def project():
             l, grads = jax.value_and_grad(loss)(params)
             grads = jax.tree_util.tree_map(
                 jax.lax.with_sharding_constraint, grads, plan.grad_specs)
+            if grads_dt == "bf16":
+                # data_types.grad_accum_dtype=bf16 (round 5): the
+                # materialized grad shard drops to 2 B/param; the typed
+                # Adam upcasts to fp32 inside the update
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.bfloat16), grads)
             updates, new_opt = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_opt, l
 
@@ -247,6 +253,7 @@ def project():
         return {
             "remat": remat_case, "micro_per_chip": micro_per_chip,
             "moments": moments, "dp": dp, "zero_stage": 3,
+            "grad_dtype": grads_dt or "fp32",
             "n_params": n_params,
             "est_peak_gb": round(peak / 1e9, 2),
             "fits_v5e": bool(peak < V5E_HBM * 0.92),
@@ -267,6 +274,16 @@ def project():
              ("none", 8, "bf16mu_facnu", 8),
              ("block_nothing", 8, "bf16mu_facnu", 16),
              ("save_mlp", 8, "bf16mu_facnu", 16)]
+    if "--grads" in sys.argv:
+        # round-5 bf16 grad-storage ladder: the dp=8 peaks were ~1.6 GB
+        # over the v5e cutoff with fp32 grad shards — can 2 B/param grads
+        # close exactly that gap and put 7B ZeRO-3 on a v5e-8?
+        cases = [("block_nothing", 8, "bf16mu_facnu", 8, "bf16"),
+                 ("block_nothing", 4, "bf16mu_facnu", 8, "bf16"),
+                 ("save_mlp", 8, "bf16mu_facnu", 8, "bf16"),
+                 ("save_mlp", 4, "bf16mu_facnu", 8, "bf16"),
+                 ("save_mlp", 8, "bf16mu_facnu", 16, "bf16"),
+                 ("none", 8, "bf16mu_facnu", 16, "bf16")]
     rows = []
     for case in cases:
         print(f"# compiling 7B zero-3 {case} ...", flush=True)
@@ -279,6 +296,12 @@ def project():
         print(json.dumps(rows[-1]), flush=True)
     d = _load()
     d["eff_hw_used"] = eff_hw
+    if "--grads" in sys.argv:
+        # round-5 bf16-grad ladder lives under its own key; the fp32
+        # ladder + analytic composition below stay as recorded
+        d["projection_7b_dp8_bf16grads"] = rows
+        _save(d)
+        return
     d["projection_7b_dp8"] = rows
 
     # --- analytic v5e composition -------------------------------------
@@ -364,6 +387,9 @@ if __name__ == "__main__":
     ap.add_argument("--anchor", action="store_true")
     ap.add_argument("--project", action="store_true")
     ap.add_argument("--one", action="store_true")
+    ap.add_argument("--grads", action="store_true",
+                    help="bf16 grad-storage ladder (round 5) — saved "
+                         "under projection_7b_dp8_bf16grads")
     a = ap.parse_args()
     if a.anchor:
         anchor()

@@ -68,6 +68,14 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # deeper weight-DMA buffering). Adds per-step activation rounding on
     # EVERY layer; enable only after an A/B on your checkpoint.
     w8a8_decode: bool = False
+    # fused gated-MLP decode kernel (experimental, default off): silu(x@G)
+    # * (x@U) @ D runs as ONE Pallas kernel (ops/int8_matmul.int8_mlp_fused)
+    # — one launch and one uninterrupted weight-DMA pipeline per layer
+    # instead of two kernels with a drain/fill boundary. Numerically the
+    # same contraction (the intermediate stays in VMEM instead of HBM);
+    # measured a wash inside a throttled tunnel window — A/B on your part
+    # before enabling (tools/bench_7b_decode.py --fused-mlp).
+    fused_mlp: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

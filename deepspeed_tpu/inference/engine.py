@@ -319,6 +319,16 @@ class InferenceEngine:
                         "path (a scan-stacked LlamaConfig model); "
                         f"got {type(self.model_config).__name__}")
                 self._quant_streaming = True
+            if self._config.quant.fused_mlp and not (
+                    self._config.quant.streaming and self._config.quant.tiled):
+                # loud, like the streaming/bits checks above: without the
+                # tiled streaming layout the decode-path eligibility guard
+                # can never pass and the knob would be silently inert —
+                # an A/B against a no-op arm measures nothing
+                raise ValueError(
+                    "quant.fused_mlp requires quant.streaming and "
+                    "quant.tiled (the fused kernel runs on the tiled "
+                    "int8 weight layout)")
             if self._pre_quantized:
                 # offline-quantized checkpoint: weights arrive int8; there
                 # is nothing to (re)quantize and the generation program
@@ -331,6 +341,12 @@ class InferenceEngine:
                     )
 
                     self.params = retile_stream_tree(self.params)
+                if self._config.quant.fused_mlp:
+                    from deepspeed_tpu.models.llama import (
+                        retile_gateup_for_fused_mlp,
+                    )
+
+                    self.params = retile_gateup_for_fused_mlp(self.params)
             elif self._pre_fused and self._config.quant.streaming:
                 # pre-fused dense tree + streaming: the rowwise in-graph
                 # quantization at the program top consumes the fused tree
@@ -490,6 +506,8 @@ class InferenceEngine:
             decoder.w8a8_prefill = self._config.quant.w8a8_prefill
         if hasattr(decoder, "w8a8_decode"):
             decoder.w8a8_decode = self._config.quant.w8a8_decode
+        if hasattr(decoder, "fused_mlp"):
+            decoder.fused_mlp = self._config.quant.fused_mlp
         self._decoder = decoder
         self._decode_transform = transform
         # K/V are written in the model config's compute dtype — caches must
@@ -667,8 +685,10 @@ class InferenceEngine:
 
             mcfg = self.model_config
             tiled = self._config.quant.tiled
+            fmlp = self._config.quant.fused_mlp
             params_fn = lambda p: quantize_fused_rowwise(p, mcfg,
-                                                         tiled=tiled)
+                                                         tiled=tiled,
+                                                         fused_mlp=fmlp)
         elif self._quant_streaming:
             # fused tree rebuilt as rowwise int8 at the program top; every
             # decode matmul then streams int8 through the Pallas kernel
@@ -678,8 +698,10 @@ class InferenceEngine:
 
             mcfg = self.model_config
             tiled = self._config.quant.tiled
+            fmlp = self._config.quant.fused_mlp
             params_fn = lambda p: quantize_fused_rowwise(
-                transform(self._effective_params(p)), mcfg, tiled=tiled)
+                transform(self._effective_params(p)), mcfg, tiled=tiled,
+                fused_mlp=fmlp)
         elif self._quantized and transform is not None:
             params_fn = lambda p: transform(self._effective_params(p))
         elif self._quantized:

@@ -467,7 +467,8 @@ def fuse_decode_params(params: Any, cfg: LlamaConfig) -> Any:
 
 
 def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig,
-                           tiled: bool = True) -> Any:
+                           tiled: bool = True,
+                           fused_mlp: bool = False) -> Any:
     """int8 weight-streaming layout for a :func:`fuse_decode_params` tree.
 
     Every decode matmul weight becomes ``{"q": int8, "scale": f32 rows}``
@@ -501,9 +502,16 @@ def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig,
 
     qstack = jax.vmap(lambda w: quantize_rowwise(w.astype(jnp.float32)))
 
-    def qlayers(w):
+    def qlayers(w, even_split=False):
         q, s = qstack(w)
         bn = pick_tile_block_n(q.shape[-1]) if tiled else None
+        if even_split and bn is not None:
+            # fused-MLP eligibility (quant.fused_mlp): the gate|up halves
+            # must split at panel granularity — pick the widest panel
+            # giving an EVEN panel count (7B: 22016/512=43 odd → 256)
+            N = q.shape[-1]
+            bn = next((b for b in (512, 256, 128)
+                       if N % b == 0 and (N // b) % 2 == 0), bn)
         if bn is None:
             return {"q": q, "scale": s}
         qt, st = jax.vmap(lambda qq, ss: tile_rowwise(qq, ss, block_n=bn))(
@@ -517,7 +525,7 @@ def quantize_fused_rowwise(fused: Any, cfg: LlamaConfig,
         "post_attn_norm": blk["post_attn_norm"],
         "qkv_proj": qlayers(blk["qkv_proj"]),
         "o_proj": qlayers(blk["o_proj"]),
-        "gateup_proj": qlayers(blk["gateup_proj"]),
+        "gateup_proj": qlayers(blk["gateup_proj"], even_split=fused_mlp),
         "down_proj": qlayers(blk["down_proj"]),
     }}
     if "lm_head" in fused:
@@ -559,6 +567,49 @@ def retile_stream_tree(params: Any) -> Any:
             del q, s                            # ...and the locals'
             return
         if isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+
+    walk(params)
+    return params
+
+
+def retile_gateup_for_fused_mlp(params: Any) -> Any:
+    """Re-lay ``gateup_proj`` leaves so the gate|up halves split at tile
+    PANEL granularity — the eligibility condition of the fused gated-MLP
+    kernel (ops/int8_matmul.int8_mlp_fused). At Llama-7B shapes the
+    default 512 panel gives 43 panels (odd: 22016/512) so the fused path
+    could never engage; 256 gives 86 (43 per half — exact). One-time,
+    in-place, pure reshape/transpose per leaf (no requantization — tile
+    geometry only). Called by the engine when ``quant.fused_mlp`` is
+    enabled."""
+
+    def _untile(qt):
+        nk, nn, bk, bn = qt.shape
+        return qt.transpose(0, 2, 1, 3).reshape(nk * bk, nn * bn)
+
+    def _retile(q2, bn_new):
+        Kp, N = q2.shape
+        bk = min(2048, Kp)
+        nk, nn = Kp // bk, N // bn_new
+        return q2.reshape(nk, bk, nn, bn_new).transpose(0, 2, 1, 3)
+
+    def walk(node):
+        if isinstance(node, dict):
+            gu = node.get("gateup_proj")
+            if (isinstance(gu, dict) and gu.get("q") is not None
+                    and gu["q"].ndim in (4, 5)):
+                q = gu["q"]
+                nn, bn = q.shape[-3], q.shape[-1]
+                if nn % 2 and bn % 2 == 0 and bn >= 256:
+                    bn_new = bn // 2
+                    fn = lambda qq: _retile(_untile(qq), bn_new)
+                    if q.ndim == 5:
+                        fn = jax.vmap(fn)
+                    qt = jax.jit(fn)(q)
+                    qt.block_until_ready()
+                    gu["q"] = qt
+                    del q
             for v in node.values():
                 walk(v)
 
@@ -609,6 +660,8 @@ class FusedLlamaDecoderModel:
         # decode-step matvecs through the s8xs8 kernel (experimental,
         # engine-plumbed from quant.w8a8_decode; default off)
         self.w8a8_decode = False
+        # fused gated-MLP decode kernel (quant.fused_mlp; default off)
+        self.fused_mlp = False
 
     def apply(self, variables, input_ids, kv_caches, cache_index,
               attn_start=0):
@@ -790,9 +843,31 @@ class FusedLlamaDecoderModel:
             a = a.reshape(B, T, q_sz)
             x = x + mm(a, layer["o_proj"])
             h = rms(x, layer["post_attn_norm"]["scale"])
-            gu = mm(h, layer["gateup_proj"])
-            g, u = jnp.split(gu, 2, axis=-1)
-            x = x + mm(nn.silu(g) * u, layer["down_proj"])
+            guw, dw = layer["gateup_proj"], layer["down_proj"]
+            if (self.fused_mlp and T < 32 and B * T <= 512
+                    and isinstance(guw, dict) and isinstance(dw, dict)
+                    and guw.get("q") is not None and guw["q"].ndim == 4
+                    and dw.get("q") is not None and dw["q"].ndim == 4
+                    # gate|up halves must split at panel granularity
+                    and guw["q"].shape[1] % 2 == 0
+                    and (guw["q"].shape[1] // 2) * guw["q"].shape[3]
+                    == cfg.intermediate_size
+                    # Mosaic lane alignment: every tile edge that becomes
+                    # a traced slice offset must be 128-aligned (fall
+                    # back gracefully, do not trip the kernel assert)
+                    and all(d % 128 == 0
+                            for d in (guw["q"].shape[2], guw["q"].shape[3],
+                                      dw["q"].shape[2], dw["q"].shape[3]))):
+                from deepspeed_tpu.ops.int8_matmul import int8_mlp_fused
+
+                y = int8_mlp_fused(
+                    h.reshape(B * T, h.shape[-1]), guw["q"], guw["scale"],
+                    dw["q"], dw["scale"], out_dtype=cfg.dtype)
+                x = x + y.reshape(B, T, -1)
+            else:
+                gu = mm(h, guw)
+                g, u = jnp.split(gu, 2, axis=-1)
+                x = x + mm(nn.silu(g) * u, dw)
             return x, new_cache
 
         def scan_body(x, layer_and_cache):

@@ -148,6 +148,154 @@ def int8_matmul_tiled_w8a8(x: jnp.ndarray, qt: jnp.ndarray,
     return (out[:B].astype(jnp.float32) * sx[:B]).astype(out_dtype)
 
 
+def _kernel_mlp_fused(xs_ref, gq_ref, uq_ref, dq_ref, sd_ref, o_ref,
+                      h, gacc, uacc, oacc, *,
+                      nkg: int, nng_half: int, nkd: int, nnd: int,
+                      bkg: int, bng: int, bkd: int, bnd: int):
+    """One TPU grid for the whole gated MLP: silu(x@G) * (x@U) @ D.
+
+    TPU Pallas grids execute SEQUENTIALLY, so the kernel stages the
+    intermediate h = silu(g)*u in a VMEM scratch across grid steps —
+    phase A (steps 0..nng_half*nkg) streams gate/up tiles and fills h
+    one bng-chunk at a time; phase B streams down tiles contracting h.
+    One launch and one uninterrupted weight-DMA pipeline instead of two
+    kernels with a drain/fill boundary between them — the boundary is
+    pure lost stream time at decode shapes (docs/PERF_ANALYSIS.md
+    round-5 decode sections). Down-projection row scales are folded
+    into h as chunks are produced; gate/up row scales are folded into
+    x by the caller."""
+    i = pl.program_id(0)
+    nA = nng_half * nkg
+
+    @pl.when(i == 0)
+    def _zero_h():
+        h[...] = jnp.zeros_like(h)
+
+    @pl.when(i < nA)
+    def _phase_a():
+        kk = i % nkg
+        jj = i // nkg
+
+        @pl.when(kk == 0)
+        def _init():
+            gacc[...] = jnp.zeros_like(gacc)
+            uacc[...] = jnp.zeros_like(uacc)
+
+        xk = xs_ref[:, pl.ds(kk * bkg, bkg)]
+        gacc[...] += jax.lax.dot_general(
+            xk, gq_ref[0, 0].astype(xk.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        uacc[...] += jax.lax.dot_general(
+            xk, uq_ref[0, 0].astype(xk.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nkg - 1)
+        def _emit():
+            g32 = gacc[...]
+            hv = (g32 / (1.0 + jnp.exp(-g32))) * uacc[...]
+            hv = hv * sd_ref[0, pl.ds(jj * bng, bng)][None, :]
+            h[:, pl.ds(jj * bng, bng)] = hv.astype(h.dtype)
+
+    @pl.when(i >= nA)
+    def _phase_b():
+        kd = (i - nA) % nkd
+        jd = (i - nA) // nkd
+
+        @pl.when(kd == 0)
+        def _init():
+            oacc[...] = jnp.zeros_like(oacc)
+
+        hk = h[:, pl.ds(kd * bkd, bkd)]
+        oacc[...] += jax.lax.dot_general(
+            hk, dq_ref[0, 0].astype(hk.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(kd == nkd - 1)
+        def _out():
+            o_ref[:, pl.ds(jd * bnd, bnd)] = oacc[...].astype(o_ref.dtype)
+
+
+def int8_mlp_fused(x: jnp.ndarray,
+                   gu_qt: jnp.ndarray, gu_scale: jnp.ndarray,
+                   down_qt: jnp.ndarray, down_scale: jnp.ndarray,
+                   out_dtype=None) -> jnp.ndarray:
+    """Fused gated-MLP over tile_rowwise int8 weights:
+    ``silu(x@gate) * (x@up) @ down`` in ONE Pallas kernel
+    (quant.fused_mlp). gu_qt is the fused [gate|up] weight
+    [nkg, nng, bkg, bng] with nng even (gate panels first); down_qt is
+    [nkd, nnd, bkd, bnd] over K = intermediate (padded). Scales are the
+    rowwise quantization scales ([Kg_pad], [Kd_pad])."""
+    B, K = x.shape
+    nkg, nng, bkg, bng = gu_qt.shape
+    nkd, nnd, bkd, bnd = down_qt.shape
+    assert nng % 2 == 0, nng
+    nng_half = nng // 2
+    F = nng_half * bng                    # true intermediate width
+    Kg_pad, Kd_pad = nkg * bkg, nkd * bkd
+    assert Kd_pad >= F and gu_scale.shape == (Kg_pad,) \
+        and down_scale.shape == (Kd_pad,), (
+            gu_qt.shape, down_qt.shape, gu_scale.shape, down_scale.shape)
+    # Mosaic must statically prove dynamic-slice starts are lane-aligned:
+    # every block edge that becomes a traced offset has to be a multiple
+    # of 128 (production tiles are 2048x512)
+    assert bkg % 128 == 0 and bng % 128 == 0 and bkd % 128 == 0 \
+        and bnd % 128 == 0, (bkg, bng, bkd, bnd)
+    out_dtype = out_dtype or x.dtype
+    if Kg_pad > K:
+        x = jnp.pad(x, ((0, 0), (0, Kg_pad - K)))
+    xs = (x.astype(jnp.float32) * gu_scale[None, :]).astype(x.dtype)
+    block_m = min(max(8, -(-B // 8) * 8), 512)
+    # single M block by construction: the grid has no M dimension (the
+    # sequential phase structure owns it) — more rows need a caller-side
+    # split, not a silent partial write
+    assert B <= block_m, (B, block_m)
+    pad_b = (-B) % block_m
+    if pad_b:
+        xs = jnp.pad(xs, ((0, pad_b), (0, 0)))
+    nA = nng_half * nkg
+    nB = nnd * nkd
+    N_out = nnd * bnd
+
+    def idx_gate(i):
+        a = i < nA
+        return (jnp.where(a, i % nkg, 0), jnp.where(a, i // nkg, 0), 0, 0)
+
+    def idx_up(i):
+        a = i < nA
+        return (jnp.where(a, i % nkg, 0),
+                nng_half + jnp.where(a, i // nkg, 0), 0, 0)
+
+    def idx_down(i):
+        b = i >= nA
+        return (jnp.where(b, (i - nA) % nkd, 0),
+                jnp.where(b, (i - nA) // nkd, 0), 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_mlp_fused, nkg=nkg, nng_half=nng_half,
+                          nkd=nkd, nnd=nnd, bkg=bkg, bng=bng, bkd=bkd,
+                          bnd=bnd),
+        grid=(nA + nB,),
+        in_specs=[
+            pl.BlockSpec((block_m, Kg_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1, bkg, bng), idx_gate),
+            pl.BlockSpec((1, 1, bkg, bng), idx_up),
+            pl.BlockSpec((1, 1, bkd, bnd), idx_down),
+            pl.BlockSpec((1, Kd_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, N_out), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B + pad_b, N_out), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_m, Kd_pad), x.dtype),       # h
+            pltpu.VMEM((block_m, bng), jnp.float32),      # gate acc
+            pltpu.VMEM((block_m, bng), jnp.float32),      # up acc
+            pltpu.VMEM((block_m, bnd), jnp.float32),      # out acc
+        ],
+        interpret=_use_interpret(),
+    )(xs, gu_qt, gu_qt, down_qt,
+      down_scale.astype(jnp.float32)[None, :])
+    return out[:B]
+
+
 def tile_rowwise(q: jnp.ndarray, scale: jnp.ndarray,
                  block_k: Optional[int] = None,
                  block_n: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -340,9 +340,12 @@ class DeepSpeedConfig:
         self.moe = MoEConfig(**p.get("moe", {}))
         self.checkpoint_config = CheckpointConfig(**p.get("checkpoint", {}))
         self.hybrid_engine = HybridEngineConfig(**p.get("hybrid_engine", {}))
-        # single source of truth: the model carries the NORMALIZED dtype
-        # name (self.grad_accum_dtype above), never the raw alias
-        self.data_types = DataTypeConfig(grad_accum_dtype=self.grad_accum_dtype)
+        # raw dict goes through the model so unknown keys still fail fast
+        # (extra='forbid'); the normalized dtype name overrides the alias
+        # so the model field and the validated attribute cannot disagree
+        self.data_types = DataTypeConfig(
+            **{**p.get("data_types", {}),
+               "grad_accum_dtype": self.grad_accum_dtype})
         self.aio = AIOConfig(**p.get("aio", {}))
         self.elasticity = ElasticityConfig(**p.get("elasticity", {}))
         self.compression_config = p.get("compression_training", {})

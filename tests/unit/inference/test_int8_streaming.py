@@ -381,3 +381,62 @@ def test_w8a8_decode_kernel_close_to_dense():
     d, q = np.asarray(dl, np.float64), np.asarray(ql, np.float64)
     rel = np.abs(d - q).max() / (np.abs(d).max() + 1e-9)
     assert rel < 0.1, rel
+
+
+def test_fused_mlp_decode_matches_two_kernel():
+    """quant.fused_mlp: the one-kernel gated MLP must match the
+    two-kernel int8 path (same contraction, intermediate stays in VMEM)
+    and track the dense decoder."""
+    # intermediate 768: the default 512 panel gives 3 gateup panels
+    # (odd, the 7B shape problem in miniature) — fused_mlp=True must
+    # re-pick an even-splitting panel (256 -> 6)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=256,
+                      intermediate_size=768, num_layers=2, num_heads=4,
+                      num_kv_heads=4, max_seq_len=128, dtype=jnp.float32,
+                      scan_layers=True)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(0, 512, (2, 4)))       # decode rows
+    params = model.init(jax.random.PRNGKey(5), ids)["params"]
+    fused = fuse_decode_params(params, cfg)
+    qtree = quantize_fused_rowwise(fused, cfg, fused_mlp=True)
+    guq = qtree["blocks"]["block"]["gateup_proj"]["q"]
+    assert guq.ndim == 5 and guq.shape[2] % 2 == 0, guq.shape  # even split
+    assert (guq.shape[2] // 2) * guq.shape[4] == 768, guq.shape
+    caches = init_kv_caches(cfg, 2, 64)
+    base = FusedLlamaDecoderModel(cfg)
+    dec = FusedLlamaDecoderModel(cfg)
+    dec.fused_mlp = True
+    bl, _ = base.apply({"params": qtree}, ids, caches, 0)
+    fl, _ = dec.apply({"params": qtree}, ids, caches, 0)
+    b, f = np.asarray(bl, np.float64), np.asarray(fl, np.float64)
+    rel = np.abs(b - f).max() / (np.abs(b).max() + 1e-9)
+    assert rel < 1e-2, rel
+    dl, _ = base.apply({"params": fused}, ids, caches, 0)
+    d = np.asarray(dl, np.float64)
+    rel_d = np.abs(d - f).max() / (np.abs(d).max() + 1e-9)
+    assert rel_d < 0.08, rel_d
+
+
+def test_retile_gateup_for_fused_mlp_offline_tree():
+    """Offline checkpoints tiled at the default panel can have an ODD
+    gateup panel count (7B: 43) — the engine's one-time re-lay halves
+    the panel so the fused kernel can engage, without requantizing."""
+    from deepspeed_tpu.models.llama import retile_gateup_for_fused_mlp
+    from deepspeed_tpu.ops.int8_matmul import quantize_rowwise, tile_rowwise
+
+    rng = np.random.default_rng(9)
+    K, F = 256, 768                        # N = 1536 -> 3 panels at 512
+    w = jnp.asarray(rng.normal(0, 0.1, (K, 2 * F)), jnp.float32)
+    q, s = quantize_rowwise(w)
+    qt, st = tile_rowwise(q, s, block_n=512)
+    assert qt.shape[1] == 3                # odd — ineligible as-is
+    tree = {"gateup_proj": {"q": qt, "scale": st}}
+    retile_gateup_for_fused_mlp(tree)
+    q2 = tree["gateup_proj"]["q"]
+    assert q2.shape[1] == 6 and q2.shape[3] == 256, q2.shape
+    # geometry-only: untiling both layouts gives the identical matrix
+    def untile(t):
+        nk, nn, bk, bn = t.shape
+        return np.asarray(t.transpose(0, 2, 1, 3).reshape(nk * bk, nn * bn))
+    np.testing.assert_array_equal(untile(qt), untile(q2))

@@ -96,6 +96,9 @@ def main():
     ap.add_argument("--w8a8-decode", action="store_true",
                     help="add an adjacent arm with the experimental "
                          "s8xs8 decode kernel (quant.w8a8_decode)")
+    ap.add_argument("--fused-mlp", action="store_true",
+                    help="add an adjacent arm with the fused gated-MLP "
+                         "decode kernel (quant.fused_mlp)")
     args = ap.parse_args()
 
     import jax
@@ -157,54 +160,45 @@ def main():
         del qparams
         out["int8_place_s"] = round(time.time() - t0, 1)
         out["int8_stream"] = measure(eng, ids, args.gen, "int8 stream")
+
+        def rebuild_arm(eng, extra_quant, out_key, label):
+            """Adjacent arm, same session, same weights: hand the
+            engine-owned (re-tiled) tree to a fresh engine rather than
+            re-reading 7 GB from disk. The release/gc ordering before
+            the rebuild is what keeps both trees from coexisting in
+            HBM."""
+            qp = eng.params
+            eng.release_workspace()
+            del eng
+            gc.collect()
+            eng = deepspeed_tpu.init_inference(
+                model_config=cfg, params=qp,
+                config={"dtype": "bfloat16",
+                        "quant": {"enabled": True, "bits": 8,
+                                  "streaming": True, **extra_quant}})
+            del qp
+            out[out_key] = measure(eng, ids, args.gen, label)
+            return eng
+
         if args.w8a8_ab:
-            # adjacent arm, same session: w8a8 prefill OFF (convert
-            # einsum) — isolates the prefill routing's TTFT effect from
-            # session-to-session tunnel swing
-            qp = eng.params
-            eng.release_workspace()
-            del eng
-            gc.collect()
-            eng = deepspeed_tpu.init_inference(
-                model_config=cfg, params=qp,
-                config={"dtype": "bfloat16",
-                        "quant": {"enabled": True, "bits": 8,
-                                  "streaming": True,
-                                  "w8a8_prefill": False}})
-            del qp
-            out["int8_stream_no_w8a8"] = measure(eng, ids, args.gen,
-                                                 "int8 stream no-w8a8")
+            # w8a8 prefill OFF (convert einsum) — isolates the prefill
+            # routing's TTFT effect from session-to-session tunnel swing
+            eng = rebuild_arm(eng, {"w8a8_prefill": False},
+                              "int8_stream_no_w8a8", "int8 stream no-w8a8")
         if args.w8a8_decode:
-            # experimental s8xs8 decode kernel (quant.w8a8_decode) —
-            # adjacent arm, same session, same weights
-            qp = eng.params
-            eng.release_workspace()
-            del eng
-            gc.collect()
-            eng = deepspeed_tpu.init_inference(
-                model_config=cfg, params=qp,
-                config={"dtype": "bfloat16",
-                        "quant": {"enabled": True, "bits": 8,
-                                  "streaming": True, "w8a8_decode": True}})
-            del qp
-            out["int8_stream_w8a8dec"] = measure(eng, ids, args.gen,
-                                                 "int8 stream w8a8-decode")
+            # experimental s8xs8 decode kernel
+            eng = rebuild_arm(eng, {"w8a8_decode": True},
+                              "int8_stream_w8a8dec",
+                              "int8 stream w8a8-decode")
+        if args.fused_mlp:
+            # fused gated-MLP kernel
+            eng = rebuild_arm(eng, {"fused_mlp": True},
+                              "int8_stream_fused_mlp",
+                              "int8 stream fused-mlp")
         if args.kv8:
-            # same weights, int8 KV cache — adjacent arm, same session.
-            # The engine owns the (re-tiled) param tree; hand it to a
-            # fresh engine rather than re-reading 7 GB from disk
-            qp = eng.params
-            eng.release_workspace()
-            del eng
-            gc.collect()
-            eng = deepspeed_tpu.init_inference(
-                model_config=cfg, params=qp,
-                config={"dtype": "bfloat16",
-                        "quant": {"enabled": True, "bits": 8,
-                                  "streaming": True, "kv_cache": True}})
-            del qp
-            out["int8_stream_kv8"] = measure(eng, ids, args.gen,
-                                             "int8 stream kv8")
+            # int8 KV cache
+            eng = rebuild_arm(eng, {"kv_cache": True},
+                              "int8_stream_kv8", "int8 stream kv8")
         eng.release_workspace()
         del eng
 

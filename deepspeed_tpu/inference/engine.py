@@ -304,6 +304,19 @@ class InferenceEngine:
                 "quant: {enabled: true, streaming: true} — refusing to "
                 "guess; the tree only runs through the int8 streaming "
                 "decode path")
+        if self._config.quant.fused_mlp and not (
+                self._config.quant.enabled and self._config.quant.streaming
+                and self._config.quant.tiled):
+            # loud, like the streaming/bits checks below — and OUTSIDE the
+            # quant.enabled branch, so quant={fused_mlp: true} alone (or
+            # with streaming/tiled off) cannot be silently inert: the
+            # decode-path eligibility guard can only pass on the tiled
+            # int8 streaming layout, and an A/B against a no-op arm
+            # measures nothing
+            raise ValueError(
+                "quant.fused_mlp requires quant.enabled, quant.streaming "
+                "and quant.tiled (the fused kernel runs on the tiled "
+                "int8 weight layout)")
         if self._config.quant.enabled:
             if self._config.quant.streaming:
                 from deepspeed_tpu.models.llama import LlamaConfig
@@ -319,16 +332,6 @@ class InferenceEngine:
                         "path (a scan-stacked LlamaConfig model); "
                         f"got {type(self.model_config).__name__}")
                 self._quant_streaming = True
-            if self._config.quant.fused_mlp and not (
-                    self._config.quant.streaming and self._config.quant.tiled):
-                # loud, like the streaming/bits checks above: without the
-                # tiled streaming layout the decode-path eligibility guard
-                # can never pass and the knob would be silently inert —
-                # an A/B against a no-op arm measures nothing
-                raise ValueError(
-                    "quant.fused_mlp requires quant.streaming and "
-                    "quant.tiled (the fused kernel runs on the tiled "
-                    "int8 weight layout)")
             if self._pre_quantized:
                 # offline-quantized checkpoint: weights arrive int8; there
                 # is nothing to (re)quantize and the generation program

@@ -584,29 +584,28 @@ def retile_gateup_for_fused_mlp(params: Any) -> Any:
     geometry only). Called by the engine when ``quant.fused_mlp`` is
     enabled."""
 
+    from deepspeed_tpu.ops.int8_matmul import tile_rowwise
+
     def _untile(qt):
         nk, nn, bk, bn = qt.shape
         return qt.transpose(0, 2, 1, 3).reshape(nk * bk, nn * bn)
-
-    def _retile(q2, bn_new):
-        Kp, N = q2.shape
-        bk = min(2048, Kp)
-        nk, nn = Kp // bk, N // bn_new
-        return q2.reshape(nk, bk, nn, bn_new).transpose(0, 2, 1, 3)
 
     def walk(node):
         if isinstance(node, dict):
             gu = node.get("gateup_proj")
             if (isinstance(gu, dict) and gu.get("q") is not None
                     and gu["q"].ndim in (4, 5)):
-                q = gu["q"]
+                q, s = gu["q"], gu["scale"]
                 nn, bn = q.shape[-3], q.shape[-1]
                 if nn % 2 and bn % 2 == 0 and bn >= 256:
-                    bn_new = bn // 2
-                    fn = lambda qq: _retile(_untile(qq), bn_new)
+                    # re-lay through the ONE blocking implementation
+                    # (tile_rowwise; Kp is already a block_k multiple so
+                    # the scale passes through unchanged)
+                    fn = lambda qq, ss: tile_rowwise(
+                        _untile(qq), ss, block_n=bn // 2)
                     if q.ndim == 5:
                         fn = jax.vmap(fn)
-                    qt = jax.jit(fn)(q)
+                    qt, _ = jax.jit(fn)(q, s)
                     qt.block_until_ready()
                     gu["q"] = qt
                     del q
@@ -844,7 +843,11 @@ class FusedLlamaDecoderModel:
             x = x + mm(a, layer["o_proj"])
             h = rms(x, layer["post_attn_norm"]["scale"])
             guw, dw = layer["gateup_proj"], layer["down_proj"]
-            if (self.fused_mlp and T < 32 and B * T <= 512
+            # B*T bound sized by the kernel's VMEM h-scratch
+            # (block_m x Kd_pad bf16): 64 rows x 22528 at 7B = 2.8 MB,
+            # comfortably inside budget; 512 rows would need 23 MB and
+            # fail at compile, not fall back
+            if (self.fused_mlp and T < 32 and B * T <= 64
                     and isinstance(guw, dict) and isinstance(dw, dict)
                     and guw.get("q") is not None and guw["q"].ndim == 4
                     and dw.get("q") is not None and dw["q"].ndim == 4

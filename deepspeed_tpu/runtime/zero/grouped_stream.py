@@ -140,6 +140,16 @@ class GroupedStreamTrainer:
                 "bfloat16 to halve host state instead)")
         self.mu_dtype = mu_dt or jnp.float32
         self.nu_dtype = nu_dt or jnp.float32
+        # grad STORAGE dtype between backward and the group update
+        # (data_types.grad_accum_dtype — same contract as the fused
+        # engine): bf16 halves the grad leg of the tier's host traffic
+        # (device→host writeback after each group vjp, host→device fetch
+        # into the update program, and the gas accumulation round trips);
+        # update math upcasts to fp32. At gas>1 the accumulator also
+        # runs at this dtype — the documented fidelity trade.
+        self.grad_dtype = (jnp.bfloat16
+                           if config.grad_accum_dtype == "bfloat16"
+                           else jnp.float32)
 
         from deepspeed_tpu.runtime.zero.stages import _supports_host_memory
 
@@ -224,17 +234,27 @@ class GroupedStreamTrainer:
                 logits = jnp.dot(xn.astype(cfg.dtype), k)
             return lm_loss(logits.astype(jnp.float32), labels)
 
+        gdt = self.grad_dtype
+
+        def to_gdt(tree):
+            # grad storage dtype (data_types.grad_accum_dtype): applied at
+            # the vjp output, BEFORE the device→host writeback — the cast
+            # is what halves the grad leg of the host traffic
+            if gdt == jnp.float32:
+                return tree
+            return jax.tree_util.tree_map(lambda g: g.astype(gdt), tree)
+
         def head_vjp(rest, x, labels):
             loss, pull = jax.vjp(
                 lambda r, h: head_loss(r, h, labels), rest, x)
             drest, dx = pull(jnp.ones((), jnp.float32))
-            return loss, dx, drest
+            return loss, dx, to_gdt(drest)
 
         def group_vjp(wg, x, pos, dy):
             _, pull = jax.vjp(
                 lambda w, h: group_chain(fetch(w), h, pos), wg, x)
             dw, dx = pull(dy)
-            return dx, dw
+            return dx, to_gdt(dw)
 
         def acc_tree(prev, new):
             # in-graph host fetch + add; result back to host
@@ -256,7 +276,7 @@ class GroupedStreamTrainer:
             _, pull = jax.vjp(
                 lambda w, h: group_chain(w, h, pos), wg_dev, x)
             dw, dx = pull(dy)
-            return dx, dw
+            return dx, to_gdt(dw)
 
         def group_vjp_dev_pf(wg_dev, x, pos, dy, wg_next):
             dx, dw = group_vjp_dev(wg_dev, x, pos, dy)
@@ -335,7 +355,7 @@ class GroupedStreamTrainer:
         def emb_vjp_acc(rest, ids, dx, gprev):
             _, pull = jax.vjp(lambda r: emb_fwd(r, ids), rest)
             (drest,) = pull(dx)
-            return acc_tree(gprev, drest)
+            return acc_tree(gprev, to_gdt(drest))
 
         self._jit_emb_vjp_acc = jax.jit(emb_vjp_acc, out_shardings=out_host)
 

@@ -149,3 +149,35 @@ def test_bf16_moments_storage():
     assert losses[-1] < losses[0], losses
     for leaf in jax.tree_util.tree_leaves(e._pnvme._mu[0]):
         assert leaf.dtype == jnp.bfloat16
+
+
+def test_grouped_stream_bf16_grads_trajectory_close():
+    """data_types.grad_accum_dtype=bf16 on the grouped tier: the grad
+    writeback/accumulator legs run at 2 B/param; update math stays fp32.
+    The trajectory must track the fp32-grad grouped run within storage
+    rounding."""
+    model = _model()
+    batches = _batches(7, 6)
+    ref = deepspeed_tpu.initialize(model=model, config=_config(grouped=2),
+                                   sample_batch=batches[0])
+    ref_losses = [float(ref.train_batch(b)) for b in batches]
+
+    cfg = _config(grouped=2)
+    cfg["data_types"] = {"grad_accum_dtype": "bf16"}
+    eng = deepspeed_tpu.initialize(model=model, config=cfg,
+                                   sample_batch=batches[0])
+    losses = [float(eng.train_batch(b)) for b in batches]
+    np.testing.assert_allclose(losses, ref_losses, rtol=0, atol=0.05)
+
+
+def test_grouped_stream_bf16_grads_gas_runs():
+    """gas>1 with bf16 grads: the accumulator leg also runs bf16 (the
+    documented trade) — still trains."""
+    model = _model()
+    cfg = _config(grouped=2, gas=2)
+    cfg["data_types"] = {"grad_accum_dtype": "bf16"}
+    eng = deepspeed_tpu.initialize(model=model, config=cfg,
+                                   sample_batch=_batches(0, 1)[0])
+    batches = _batches(3, 6, bs=16)
+    losses = [float(eng.train_batch(b)) for b in batches]
+    assert losses[-1] < losses[0], losses

@@ -88,6 +88,10 @@ def main():
     ap.add_argument("--bf16-moments", action="store_true",
                     help="bf16 moment storage (grouped tier): host state "
                          "12 B/param instead of 16 — at 7B, 81 GB vs 108")
+    ap.add_argument("--bf16-grads", action="store_true",
+                    help="bf16 grad storage (data_types.grad_accum_dtype) "
+                         "— halves the grad leg of the tier's host "
+                         "traffic (round-5 A/B arm)")
     ap.add_argument("--no-prefetch", action="store_true",
                     help="disable the grouped-stream double-buffered group "
                          "fetch (round-5 overlap A/B arm)")
@@ -124,6 +128,8 @@ def main():
         "bf16": {"enabled": True},
         "zero_optimization": zero,
     }
+    if args.bf16_grads:
+        cfg["data_types"] = {"grad_accum_dtype": "bf16"}
     rng = np.random.default_rng(0)
 
     def batch():
@@ -165,6 +171,8 @@ def main():
                                            and not args.no_prefetch),
                    "moment_dtype": ("bfloat16" if args.bf16_moments
                                     else "float32"),
+                   "grad_dtype": ("bfloat16" if args.bf16_grads
+                                  else "float32"),
                    "train_state_gb": round(state_gb, 1),
                    "hbm_gb": 15.75, "init_s": round(init_s, 1),
                    "step_walls_s": steps, "loss": loss,
@@ -172,7 +180,8 @@ def main():
     }
     print(json.dumps(out))
     suffix = (f"_g{args.grouped}" if args.grouped else "") \
-        + ("_nopf" if args.no_prefetch else "")
+        + ("_nopf" if args.no_prefetch else "") \
+        + ("_bf16g" if args.bf16_grads else "")
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"zero_offload_capacity_{args.arch}_{args.size}{suffix}.json")

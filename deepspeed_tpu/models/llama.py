@@ -605,10 +605,14 @@ def retile_gateup_for_fused_mlp(params: Any) -> Any:
                         _untile(qq), ss, block_n=bn // 2)
                     if q.ndim == 5:
                         fn = jax.vmap(fn)
-                    qt, _ = jax.jit(fn)(q, s)
+                    qt, st = jax.jit(fn)(q, s)
                     qt.block_until_ready()
-                    gu["q"] = qt
-                    del q
+                    # keep the RETURNED scale: if tile_rowwise K-padded
+                    # (non-default original block_k), q and scale must
+                    # stay length-matched or the kernels' Kg_pad asserts
+                    # fire mid-decode
+                    gu["q"], gu["scale"] = qt, st
+                    del q, s
             for v in node.values():
                 walk(v)
 

@@ -200,3 +200,24 @@ def test_nvme_checkpoint_across_sub_group_size(tmp_path):
     got = [float(e2.train_batch(_batch(np.random.default_rng(10 + i))))
            for i in range(2)]
     np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_nvme_bf16_grads_trajectory_close(tmp_path):
+    """data_types.grad_accum_dtype=bf16 on the NVMe tier: the fused grads
+    program stores bf16 grads (grads_batch_fn applies the engine-wide
+    cast) and the per-group update upcasts — the trajectory must track
+    the fp32-grad NVMe run within storage rounding."""
+    e_ref, _ = _engine(tmp_path / "a", nvme=True, sub_group_size=4000)
+    batches = [_batch(np.random.default_rng(100 + i)) for i in range(5)]
+    ref = [float(e_ref.train_batch(b)) for b in batches]
+
+    cfg = _config({"offload_optimizer": {"device": "nvme",
+                                         "nvme_path": str(tmp_path / "b")},
+                   "sub_group_size": 4000})
+    cfg["data_types"] = {"grad_accum_dtype": "bf16"}
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    rng = np.random.default_rng(0)
+    eng = deepspeed_tpu.initialize(model=model, config=cfg,
+                                   sample_batch=_batch(rng))
+    got = [float(eng.train_batch(b)) for b in batches]
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0.05)

@@ -196,11 +196,6 @@ def main():
             eng = rebuild_arm(eng, {"w8a8_decode": True},
                               "int8_stream_w8a8dec",
                               "int8 stream w8a8-decode")
-        if args.fused_mlp:
-            # fused gated-MLP kernel
-            eng = rebuild_arm(eng, {"fused_mlp": True},
-                              "int8_stream_fused_mlp",
-                              "int8 stream fused-mlp")
         if args.kv8:
             # int8 KV cache
             eng = rebuild_arm(eng, {"kv_cache": True},
@@ -248,8 +243,19 @@ def main():
                     getattr(eng, "last_acceptance", 0.0), 2),
                 "draft_len": K,
                 "note": "structured prompt (32-token unit repeated); "
-                        "greedy-exact",
+                        "greedy-exact. RATES INCLUDE prefill+RTT in the "
+                        "denominator (whole-generate wall) unlike the "
+                        "other arms' TTFT-netted decode rates — compare "
+                        "only the speedup ratio across arms",
             }
+        if args.fused_mlp:
+            # fused gated-MLP kernel — LAST: its engagement path re-lays
+            # the SHARED gateup tree in place (retile_gateup_for_fused_mlp
+            # via the engine) to 256-wide panels, which would contaminate
+            # any arm measured after it (~5% slower gateup streaming)
+            eng = rebuild_arm(eng, {"fused_mlp": True},
+                              "int8_stream_fused_mlp",
+                              "int8 stream fused-mlp")
         eng.release_workspace()
         del eng
 

@@ -7,6 +7,7 @@ import time
 from typing import Dict, List
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -29,7 +30,7 @@ def _bench_op(mesh, op_name: str, nbytes: int, trials: int = 5) -> Dict:
         "all_to_all": (lambda t: dist.all_to_all_single(t, group="data"), P("data"), P("data")),
     }
     fn, in_spec, out_spec = ops[op_name]
-    jitted = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+    jitted = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
                                    out_specs=out_spec))
     jitted(x).block_until_ready()  # compile
     t0 = time.time()

@@ -26,6 +26,7 @@ from enum import Enum
 from typing import Optional, Sequence, Union
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map, axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -138,7 +139,7 @@ def get_world_size(group: Optional[AxisName] = None) -> int:
     if group is None:
         return jax.device_count()
     try:
-        return lax.axis_size(group)  # inside shard_map/pmap trace
+        return axis_size(group)  # inside shard_map/pmap trace
     except (NameError, Exception):
         mesh = _current_mesh()
         if mesh is not None:
@@ -244,7 +245,7 @@ def all_to_all_single(tensor, group: AxisName = "data", split_axis: int = 0,
     """reference all_to_all_single (MoE dispatch). ``tensor`` must have its
     ``split_axis`` divisible by the group size."""
     _profile("all_to_all", tensor)
-    group_size = lax.axis_size(group)
+    group_size = axis_size(group)
     return lax.all_to_all(tensor, group, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
@@ -271,7 +272,7 @@ def reduce_scatter_tensor(output_unused, tensor, op: ReduceOp = ReduceOp.SUM,
         raise NotImplementedError("reduce_scatter supports SUM/AVG")
     out = reduce_scatter(tensor, group, axis=0)
     if op == ReduceOp.AVG:
-        out = out / lax.axis_size(group)
+        out = out / axis_size(group)
     return out
 
 
@@ -286,7 +287,7 @@ def reduce_scatter_coalesced(tensor_list, group: AxisName = "data"):
     """reference runtime/comm/coalesced_collectives.py:29: reduce-scatter a
     batch of tensors in one launch. Each flat tensor is padded to the group
     size and scattered; XLA coalesces the launches."""
-    size = lax.axis_size(group)
+    size = axis_size(group)
     outs = []
     for t in tensor_list:
         flat = t.reshape(-1)
@@ -307,14 +308,14 @@ def ppermute(tensor, perm, group: AxisName = "pipe"):
 
 def send_forward(tensor, group: AxisName = "pipe"):
     """Shift +1 along the pipe ring (stage i → stage i+1)."""
-    n = lax.axis_size(group)
+    n = axis_size(group)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return ppermute(tensor, perm, group)
 
 
 def send_backward(tensor, group: AxisName = "pipe"):
     """Shift -1 along the pipe ring (stage i → stage i-1)."""
-    n = lax.axis_size(group)
+    n = axis_size(group)
     perm = [(i, (i - 1) % n) for i in range(n)]
     return ppermute(tensor, perm, group)
 
@@ -342,7 +343,7 @@ def eager_all_reduce_over_mesh(x, mesh, axis: str = "data", op: ReduceOp = Reduc
 
     t0 = time.time()
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda t: all_reduce(t, op, axis),
             mesh=mesh,
             in_specs=PartitionSpec(axis),

@@ -1,2 +1,6 @@
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.kv_pool import BlockPool
+from deepspeed_tpu.inference.scheduler import (
+    Completion, ContinuousBatchingScheduler, Request,
+)

@@ -59,9 +59,12 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # bf16 GEMM feed. This is the lever for int8 TTFT <= bf16 TTFT
     # (reference analogue: the int8 GEMMs behind pt_binding.cpp's
     # quantized inference entry points). Decode steps are unaffected
-    # (weight-streaming kernel). Adds per-token activation rounding on
-    # prompt processing only; disable for bit-cautious serving.
-    w8a8_prefill: bool = True
+    # (weight-streaming kernel). OPT-IN (like w8a8_decode): it adds
+    # per-token activation rounding on prompt processing — a silent
+    # numerics change for anyone upgrading with quant.streaming on — so
+    # the speed is traded for bits only when asked (README quantization
+    # notes; was default-on in round 5).
+    w8a8_prefill: bool = False
     # w8a8 DECODE (experimental, default off): decode-step matvecs also
     # quantize the activation per token and run the s8xs8->s32 Pallas
     # kernel (no int8→bf16 convert copy in VMEM — the freed budget buys

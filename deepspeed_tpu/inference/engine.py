@@ -26,6 +26,7 @@ from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.parallel.mesh import make_mesh
 from deepspeed_tpu.parallel.partition import tree_shardings
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.jax_compat import set_mesh
 
 
 def resolve_decoder(cfg):
@@ -66,6 +67,68 @@ def resolve_decoder(cfg):
         f"got {type(cfg).__name__}")
 
 
+def resolve_paged_decoder(cfg):
+    """(paged_apply, init_pools_fn, params_transform, fused_decoder) for
+    a model config — the paged-KV analogue of :func:`resolve_decoder`.
+    ``fused_decoder`` is the FusedLlamaDecoderModel instance on the
+    scan-Llama path (the engine plumbs quant knobs onto it and its
+    presence is the int8-KV eligibility gate) and None elsewhere.
+
+    ``paged_apply(params, ids, pools, block_tables, write_pos, valid_len)
+    -> (logits, pools)``. Dispatch mirrors the dense path: scan-stacked
+    LlamaConfig → the fused decoder's ``apply_paged`` (composes with the
+    int8 weight paths and ``quant.kv_cache``); per-layer LlamaConfig →
+    PagedLlamaDecoderModel; TransformerConfig → the unified paged twin.
+    """
+    from deepspeed_tpu.models.llama import (
+        FusedLlamaDecoderModel, LlamaConfig, PagedLlamaDecoderModel,
+        fuse_decode_params, init_paged_kv_pools as llama_pools,
+    )
+    from deepspeed_tpu.models.unified import (
+        PagedTransformerDecoderModel, TransformerConfig,
+        init_paged_kv_pools as unified_pools,
+    )
+
+    if isinstance(cfg, LlamaConfig):
+        if cfg.scan_layers:
+            decoder = FusedLlamaDecoderModel(cfg)
+
+            def paged_apply(params, ids, pools, bt, wp, vl):
+                return decoder.apply_paged({"params": params}, ids, pools,
+                                           bt, wp, vl)
+
+            return (paged_apply, llama_pools,
+                    lambda p: fuse_decode_params(p, cfg), decoder)
+        module = PagedLlamaDecoderModel(cfg)
+
+        def paged_apply(params, ids, pools, bt, wp, vl):
+            return module.apply({"params": params}, ids, pools, bt, wp, vl)
+
+        return paged_apply, llama_pools, None, None
+    if isinstance(cfg, TransformerConfig):
+        if not cfg.causal or not cfg.lm_head:
+            raise ValueError(
+                "serve() requires a causal LM; encoder architectures "
+                f"(causal={cfg.causal}, lm_head={cfg.lm_head}) have no "
+                "decode path")
+        module = PagedTransformerDecoderModel(cfg)
+
+        def paged_apply(params, ids, pools, bt, wp, vl):
+            return module.apply({"params": params}, ids, pools, bt, wp, vl)
+
+        def unified_pools_no_int8(cfg, num_blocks, block_size, dtype=None,
+                                  int8=False):
+            if int8:
+                raise ValueError("quant.kv_cache requires the fused Llama "
+                                 "decode path")
+            return unified_pools(cfg, num_blocks, block_size, dtype)
+
+        return paged_apply, unified_pools_no_int8, None, None
+    raise ValueError(
+        f"serve() needs a LlamaConfig or TransformerConfig model config, "
+        f"got {type(cfg).__name__}")
+
+
 def check_decode_length(cfg, total_len: int) -> None:
     """Learned-position tables are finite: decoding past ``max_seq_len``
     would silently clamp the embedding gather (XLA out-of-bounds semantics),
@@ -83,6 +146,8 @@ def check_decode_length(cfg, total_len: int) -> None:
 GEN_BUCKET = 32         # max_new_tokens rounds up to this program capacity
 PROMPT_BUCKET = 32      # prompt length rounds up to this (left-padded)
 GEN_CACHE_MAX = 16      # compiled-program LRU bound
+SERVE_CACHE_MAX = 4     # serve-executor LRU bound (each entry
+                        # pins a full K/V block pool in HBM)
 
 
 def gen_capacity(max_new_tokens: int) -> int:
@@ -200,6 +265,161 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int,
         return jnp.concatenate([input_ids, out], axis=1), caches
 
     return jax.jit(gen, donate_argnums=(2,))
+
+
+class PagedServeExecutor:
+    """Compiled prefill/decode programs over the device block pool — the
+    executor the continuous-batching scheduler drives
+    (inference/scheduler.py documents the protocol).
+
+    Static shapes: ONE decode program per (num_slots, table_width,
+    decode_chunk) serves the whole session regardless of traffic; prefill
+    programs are bucketed by prompt capacity (PROMPT_BUCKET) exactly like
+    ``generate()``. Prompts are RIGHT-padded — pad writes land in the
+    null block, so no ``attn_start`` plumbing and no left-shift of
+    positions. Pools are donated through every call, so the block pool
+    lives in one set of device buffers for the session.
+
+    Per-slot sampling state (rng key, temperature, top_k, top_p, eos) is
+    bound at admission (``set_slot``) and carried in per-slot arrays —
+    slot recycling overwrites the row, so state can never leak between
+    requests sharing a slot (pinned by tests/unit/inference/test_serve.py).
+    """
+
+    def __init__(self, paged_apply, params, pools, model_config, mesh_ctx,
+                 num_slots: int, decode_chunk: int = 1):
+        self._apply = paged_apply
+        self._params = params
+        self._pools = pools
+        self._cfg = model_config
+        self._ctx = mesh_ctx
+        self.num_slots = num_slots
+        self.decode_chunk = max(1, int(decode_chunk))
+        self._temps = np.zeros(num_slots, np.float32)
+        self._top_ks = np.zeros(num_slots, np.int32)
+        self._top_ps = np.ones(num_slots, np.float32)
+        self._eos_ids = np.full(num_slots, -1, np.int32)
+        self._rngs = np.array([
+            np.asarray(jax.random.PRNGKey(i)) for i in range(num_slots)])
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fn = None
+
+    # --- scheduler protocol ---------------------------------------------------
+    def set_slot(self, slot: int, req) -> None:
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._top_ps[slot] = req.top_p
+        self._eos_ids[slot] = req.eos_id
+        self._rngs[slot] = np.array(
+            jax.random.fold_in(jax.random.PRNGKey(req.seed), 0))
+
+    def prefill(self, slot: int, prompt, block_row) -> int:
+        T = int(len(prompt))
+        T_cap = prompt_capacity(T, self._cfg)
+        fn = self._prefill_fns.get(T_cap)
+        if fn is None:
+            fn = self._build_prefill_fn(T_cap)
+            self._prefill_fns[T_cap] = fn
+        tokens = np.zeros((1, T_cap), np.int32)
+        tokens[0, :T] = prompt
+        with self._ctx():
+            tok, new_key, self._pools = fn(
+                self._params, jnp.asarray(tokens), self._pools,
+                jnp.asarray(block_row, jnp.int32)[None],
+                jnp.asarray(T, jnp.int32), jnp.asarray(self._rngs[slot]),
+                jnp.asarray(self._temps[slot]),
+                jnp.asarray(self._top_ks[slot]),
+                jnp.asarray(self._top_ps[slot]))
+        self._rngs[slot] = np.array(new_key)
+        return int(tok)
+
+    def decode(self, tokens, block_tables, seq_lens, active, steps_left,
+               max_steps=None):
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode_fn(self.decode_chunk)
+        n = self.decode_chunk if max_steps is None \
+            else max(1, min(int(max_steps), self.decode_chunk))
+        with self._ctx():
+            out, self._pools, new_rngs = self._decode_fn(
+                self._params, jnp.asarray(tokens, jnp.int32), self._pools,
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(seq_lens, jnp.int32),
+                jnp.asarray(steps_left, jnp.int32),
+                jnp.asarray(n, jnp.int32),
+                jnp.asarray(self._rngs), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+                jnp.asarray(self._eos_ids))
+        self._rngs = np.array(new_rngs)
+        return np.asarray(out)[:, :n]
+
+    # --- program builders -----------------------------------------------------
+    def _build_prefill_fn(self, T_cap: int):
+        paged_apply = self._apply
+
+        def pf(params, tokens, pools, bt, true_len, key, temp, top_k,
+               top_p):
+            from deepspeed_tpu.inference.sampling import sample_logits
+
+            logits, pools = paged_apply(
+                params, tokens, pools, bt, jnp.zeros(1, jnp.int32),
+                true_len[None])
+            last = jax.lax.dynamic_index_in_dim(
+                logits, true_len - 1, axis=1, keepdims=False)  # [1, V]
+            key, sub = jax.random.split(key)
+            tok = sample_logits(last, sub, temp, top_k, top_p)[0]
+            return tok, key, pools
+
+        return jax.jit(pf, donate_argnums=(2,))
+
+    def _build_decode_fn(self, chunk: int):
+        paged_apply = self._apply
+        B = self.num_slots
+
+        def step(params, tokens, pools, bt, seq_lens, steps_left, n_steps,
+                 rngs, temps, top_ks, top_ps, eos_ids):
+            from deepspeed_tpu.inference.sampling import (
+                sample_logits_per_slot,
+            )
+
+            # while_loop, not scan: ``n_steps`` is TRACED (the scheduler
+            # caps each call at the next slot completion when the queue
+            # has work — zero quantization waste at chunk boundaries) and
+            # the loop exits early when every slot is done; ``chunk`` is
+            # only the static buffer capacity.
+            out = jnp.zeros((chunk, B), jnp.int32)
+
+            def cond(carry):
+                i, _, _, _, _, alive, _ = carry
+                return jnp.logical_and(i < n_steps, (alive > 0).any())
+
+            def body(carry):
+                i, tokens, pools, seq_lens, rngs, alive, out = carry
+                valid = (alive > 0).astype(jnp.int32)
+                logits, pools = paged_apply(params, tokens[:, None], pools,
+                                            bt, seq_lens, valid)
+                split = jax.vmap(jax.random.split)(rngs)
+                keys, rngs = split[:, 0], split[:, 1]
+                nxt = sample_logits_per_slot(logits[:, -1], keys, temps,
+                                             top_ks, top_ps)
+                # finished/inactive slots keep re-feeding their last
+                # token; its KV write is masked (valid_len 0) and the
+                # scheduler ignores the emission
+                nxt = jnp.where(valid == 1, nxt, tokens)
+                seq_lens = seq_lens + valid
+                hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
+                alive = jnp.where(valid == 1,
+                                  jnp.where(hit_eos, 0, alive - 1), alive)
+                out = out.at[i].set(nxt)
+                return i + 1, nxt, pools, seq_lens, rngs, alive, out
+
+            i0 = jnp.asarray(0, jnp.int32)
+            _, tokens, pools, seq_lens, rngs, alive, out = \
+                jax.lax.while_loop(cond, body, (i0, tokens, pools,
+                                                seq_lens, rngs, steps_left,
+                                                out))
+            return out.T, pools, rngs           # [B, chunk]
+
+        return jax.jit(step, donate_argnums=(2,))
 
 
 class InferenceEngine:
@@ -443,7 +663,7 @@ class InferenceEngine:
 
     # --- plain forward --------------------------------------------------------
     def _ctx(self):
-        return jax.set_mesh(self.mesh)
+        return set_mesh(self.mesh)
 
     def profile_model_time(self, use_cuda_events: bool = False):
         """Record per-forward model latencies (reference engine.py:213
@@ -621,6 +841,55 @@ class InferenceEngine:
                  ranks=[0])
         return choice
 
+    def _decode_params_fn(self, transform):
+        """(params_fn, cache_key) turning ``self.params`` into the tree a
+        decode program consumes: int8 dequant and/or the fused weight-
+        layout transform, composed per the quant mode. Shared by
+        ``generate()`` (runs it once at the program top) and ``serve()``
+        (materializes it once for the whole serving session)."""
+        if self._pre_quantized:
+            # offline int8 checkpoint: weights are already the fused
+            # quantized tree — the program consumes them as-is
+            params_fn = None
+        elif self._quant_streaming and self._pre_fused:
+            # pre-fused dense tree: rowwise-quantize it at the program top
+            # (no fuse transform — it already happened on the host)
+            from deepspeed_tpu.models.llama import quantize_fused_rowwise
+
+            mcfg = self.model_config
+            tiled = self._config.quant.tiled
+            fmlp = self._config.quant.fused_mlp
+            params_fn = lambda p: quantize_fused_rowwise(p, mcfg,
+                                                         tiled=tiled,
+                                                         fused_mlp=fmlp)
+        elif self._quant_streaming:
+            # fused tree rebuilt as rowwise int8 at the program top; every
+            # decode matmul then streams int8 through the Pallas kernel
+            # (models/llama.quantize_fused_rowwise + FusedLlamaDecoderModel
+            # mm dispatch)
+            from deepspeed_tpu.models.llama import quantize_fused_rowwise
+
+            mcfg = self.model_config
+            tiled = self._config.quant.tiled
+            fmlp = self._config.quant.fused_mlp
+            params_fn = lambda p: quantize_fused_rowwise(
+                transform(self._effective_params(p)), mcfg, tiled=tiled,
+                fused_mlp=fmlp)
+        elif self._quantized and transform is not None:
+            params_fn = lambda p: transform(self._effective_params(p))
+        elif self._quantized:
+            params_fn = self._effective_params
+        else:
+            params_fn = transform
+        base_key = ("int8w" if self._quantized else "",
+                    "stream" if self._quant_streaming else "",
+                    "fused" if transform is not None else "",
+                    self._config.quant.bits if self._quantized else 0,
+                    getattr(self._decoder, "int8_block_n", 0),
+                    "tiled" if self._config.quant.tiled else "",
+                    "kv8" if self._config.quant.kv_cache else "")
+        return params_fn, base_key
+
     def reset_cache(self):
         """Zero the KV workspace (reference reset_cache, pt_binding.cpp:1937)."""
         if self._kv_caches is not None:
@@ -677,47 +946,7 @@ class InferenceEngine:
         # qkv/gateup) run once at the program top (params_fn), NOT inside
         # the decode loop — see build_generate_fn
         transform = self._decode_transform
-        if self._pre_quantized:
-            # offline int8 checkpoint: weights are already the fused
-            # quantized tree — the program consumes them as-is
-            params_fn = None
-        elif self._quant_streaming and self._pre_fused:
-            # pre-fused dense tree: rowwise-quantize it at the program top
-            # (no fuse transform — it already happened on the host)
-            from deepspeed_tpu.models.llama import quantize_fused_rowwise
-
-            mcfg = self.model_config
-            tiled = self._config.quant.tiled
-            fmlp = self._config.quant.fused_mlp
-            params_fn = lambda p: quantize_fused_rowwise(p, mcfg,
-                                                         tiled=tiled,
-                                                         fused_mlp=fmlp)
-        elif self._quant_streaming:
-            # fused tree rebuilt as rowwise int8 at the program top; every
-            # decode matmul then streams int8 through the Pallas kernel
-            # (models/llama.quantize_fused_rowwise + FusedLlamaDecoderModel
-            # mm dispatch)
-            from deepspeed_tpu.models.llama import quantize_fused_rowwise
-
-            mcfg = self.model_config
-            tiled = self._config.quant.tiled
-            fmlp = self._config.quant.fused_mlp
-            params_fn = lambda p: quantize_fused_rowwise(
-                transform(self._effective_params(p)), mcfg, tiled=tiled,
-                fused_mlp=fmlp)
-        elif self._quantized and transform is not None:
-            params_fn = lambda p: transform(self._effective_params(p))
-        elif self._quantized:
-            params_fn = self._effective_params
-        else:
-            params_fn = transform
-        base_key = ("int8w" if self._quantized else "",
-                    "stream" if self._quant_streaming else "",
-                    "fused" if transform is not None else "",
-                    self._config.quant.bits if self._quantized else 0,
-                    getattr(self._decoder, "int8_block_n", 0),
-                    "tiled" if self._config.quant.tiled else "",
-                    "kv8" if self._config.quant.kv_cache else "")
+        params_fn, base_key = self._decode_params_fn(transform)
         eos = -1 if eos_token_id is None else int(eos_token_id)
         if speculative:
             from deepspeed_tpu.inference.speculative import (
@@ -764,3 +993,139 @@ class InferenceEngine:
             jax.block_until_ready(tokens)
             self._model_times.append(time.time() - t0)
         return tokens
+
+    # --- continuous-batching serving (paged KV cache) -------------------------
+    def generate_stream(self, requests, *, num_slots: int = 4,
+                        block_size: int = 16, num_blocks: Optional[int] = None,
+                        max_context: Optional[int] = None,
+                        decode_chunk: int = 1):
+        """Serve ``requests`` with continuous batching over a paged KV
+        cache, yielding a ``Completion`` per request as it finishes.
+
+        Unlike ``generate()`` (whole-batch lockstep: every row waits for
+        the slowest), requests are admitted into ``num_slots`` decode
+        slots the moment one frees, and a finished sequence's KV blocks
+        recycle into the shared pool — under mixed-length traffic the
+        decode program stays busy with REAL work (bench.py --serve
+        measures the aggregate-throughput win). The decode program is
+        compiled once per serving config (static slot count and
+        block-table width); prefills reuse the prompt buckets.
+
+        requests: iterable of ``inference.scheduler.Request`` (or dicts
+        of its fields; ``rid`` defaults to the index). ``num_blocks``
+        caps the pool — smaller pools queue requests (backpressure)
+        instead of failing. ``decode_chunk`` > 1 amortizes host
+        round-trips by sampling several tokens per program call at the
+        cost of coarser admission granularity.
+        """
+        from deepspeed_tpu.inference.kv_pool import BlockPool, blocks_for
+        from deepspeed_tpu.inference.scheduler import (
+            ContinuousBatchingScheduler, Request,
+        )
+
+        cfg = self.model_config
+        assert cfg is not None, \
+            "serve() requires a model config (LlamaConfig/TransformerConfig)"
+        reqs = []
+        for i, r in enumerate(requests):
+            if isinstance(r, dict):
+                r = Request(**dict({"rid": i}, **r))
+            reqs.append(r)
+        if not reqs:
+            return
+        for r in reqs:
+            check_decode_length(cfg, len(r.prompt) + r.max_new_tokens)
+        if max_context is None:
+            max_context = max(len(r.prompt) + r.max_new_tokens
+                              for r in reqs)
+        width = blocks_for(max_context, block_size)
+        # bucket the table width (same reuse logic as prompt_capacity for
+        # prompts): traffic-derived shapes otherwise mint one compiled
+        # executor + pool set per distinct longest-request length
+        width = -(-width // 4) * 4
+        if num_blocks is None:
+            # full occupancy with zero backpressure; pass a smaller pool
+            # to trade queueing for HBM
+            num_blocks = num_slots * width + 1
+
+        executor = self._get_serve_executor(num_slots, block_size,
+                                            num_blocks, decode_chunk)
+        scheduler = ContinuousBatchingScheduler(
+            executor, num_slots, BlockPool(num_blocks, block_size), width)
+        for r in reqs:
+            scheduler.submit(r, now=r.arrival_time)
+        yield from scheduler.run_iter()
+
+    def serve(self, requests, **kwargs):
+        """Drain :meth:`generate_stream`; returns completions in finish
+        order (reference serving story: DeepSpeed-Inference
+        arXiv:2207.00032 throughput-at-scale serving)."""
+        return list(self.generate_stream(requests, **kwargs))
+
+    def _get_serve_executor(self, num_slots, block_size, num_blocks,
+                            decode_chunk):
+        """Build — or reuse — the serving executor for one pool shape.
+
+        The executor owns the device block pool AND the compiled
+        prefill/decode programs; rebuilding it per ``serve()`` call would
+        recompile everything (jit caches by closure identity), so it is
+        cached per (serving shape, params identity). Reusing the pool
+        across sessions is sound: every position a session READS (col <=
+        row_pos < seq_len + T) was written by that same session first,
+        so a previous session's stale KV can never leak into attention.
+        """
+        cfg = self.model_config
+        kv8 = self._config.quant.kv_cache
+        key = (num_slots, block_size, num_blocks, decode_chunk, kv8)
+        cache = getattr(self, "_serve_executors", None)
+        if cache is None:
+            cache = self._serve_executors = OrderedDict()
+        hit = cache.get(key)
+        if hit is not None:
+            cached_params, executor = hit
+            # identity check, not a key ingredient: id() in a key can
+            # collide after the old tree is collected, silently serving
+            # stale weights; holding the object also means a params swap
+            # evicts (not leaks) the superseded executor's pools
+            if cached_params is self.params:
+                cache.move_to_end(key)
+                return executor
+            del cache[key]
+        paged_apply, init_pools, transform, decoder = \
+            resolve_paged_decoder(cfg)
+        if kv8 and decoder is None:
+            raise ValueError(
+                "quant.kv_cache requires the fused Llama decode path "
+                "(a scan-stacked LlamaConfig model)")
+        if decoder is not None:
+            # mirror _ensure_decode's knob plumbing onto the fused decoder
+            if self._quant_streaming:
+                decoder.int8_block_n = self._pick_int8_panel()
+            decoder.w8a8_prefill = self._config.quant.w8a8_prefill
+            decoder.w8a8_decode = self._config.quant.w8a8_decode
+            decoder.fused_mlp = self._config.quant.fused_mlp
+        if self._pre_quantized or self._pre_fused:
+            # offline trees are already in the fused layout
+            transform = None
+        params_fn, _ = self._decode_params_fn(transform)
+        cache_dtype = getattr(cfg, "dtype", None) or self.dtype
+        with self._ctx():
+            # materialize the decode tree ONCE for the session — serving
+            # runs many small programs, so a per-call transform (the
+            # generate() pattern) would re-fuse/dequantize every step
+            serve_params = (self.params if params_fn is None
+                            else jax.jit(params_fn)(self.params))
+            pools = init_pools(cfg, num_blocks, block_size, cache_dtype,
+                               int8=kv8)
+        executor = PagedServeExecutor(
+            paged_apply, serve_params, pools, cfg, self._ctx, num_slots,
+            decode_chunk=decode_chunk)
+        while len(cache) >= SERVE_CACHE_MAX:
+            cache.popitem(last=False)          # each entry pins K/V pools
+        cache[key] = (self.params, executor)
+        return executor
+
+    def release_serve_workspace(self):
+        """Drop cached serving executors (block pools + compiled
+        programs) — the serving analogue of :meth:`release_workspace`."""
+        self._serve_executors = OrderedDict()

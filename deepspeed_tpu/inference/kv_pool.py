@@ -1,0 +1,121 @@
+"""Block-pooled KV cache accounting for the serving layer.
+
+The device side of the paged KV cache is a fixed-shape block pool
+(``ops/paged_attention.init_paged_pool``) that jitted programs index
+through per-slot block tables. THIS module is the host side: which pool
+blocks are free, which belong to which serving slot, and the int32 block
+tables the programs consume. The logic is pure Python/numpy (the one
+import from the device side is the shared ``blocks_for`` rounding rule),
+so the continuous-batching scheduler's allocation behavior is
+unit-testable without compiling a model
+(tests/unit/inference/test_scheduler.py).
+
+Reference analogue: the inference context arena
+(csrc/transformer/inference/includes/inference_context.h) sizes ONE
+workspace and rotates it; paged blocks instead recycle at sequence
+granularity, which is what lets new requests stream into freed capacity
+mid-decode (DeepSpeed-Inference arXiv:2207.00032 §serving; Ragged Paged
+Attention arXiv:2604.15464).
+"""
+
+from typing import List, Sequence
+
+import numpy as np
+
+# ONE rounding rule for host allocation and device sizing — a fork here
+# would silently desynchronize the scheduler's accounting from the pool
+# shapes the programs index
+from deepspeed_tpu.ops.paged_attention import blocks_for  # noqa: F401
+
+
+class BlockPool:
+    """Free-list over ``num_blocks`` pool blocks; block 0 is the NULL
+    block (masked writes land there) and is never handed out."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={num_blocks}: need >= 2 (block 0 is reserved "
+                f"as the null block)")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size}: must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: recently freed (still-warm) blocks are reused
+        # first
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        """Pop ``n`` block ids; raises if the pool cannot satisfy it —
+        callers check :meth:`can_allocate` first (queue backpressure is
+        the scheduler's job, not an exception path)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"block pool exhausted: requested {n}, free {len(self._free)}")
+        ids = [self._free.pop() for _ in range(n)]
+        self._allocated.update(ids)
+        return ids
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Return blocks to the pool (sequence finished). Double-free and
+        freeing the null block are hard errors — both indicate scheduler
+        bookkeeping corruption that would silently cross-contaminate KV."""
+        for b in ids:
+            if b == 0:
+                raise ValueError("cannot free the null block")
+            if b not in self._allocated:
+                raise ValueError(f"double free of block {b}")
+            self._allocated.discard(b)
+            self._free.append(b)
+
+
+class SlotBlockTables:
+    """Per-slot block tables: int32 [num_slots, width], unused entries 0.
+
+    The array object is reused in place so the scheduler can hand the
+    same backing store to the decode program every step.
+    """
+
+    def __init__(self, num_slots: int, width: int, pool: BlockPool):
+        self.pool = pool
+        self.width = int(width)
+        self.table = np.zeros((num_slots, width), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(num_slots)]
+
+    def capacity_tokens(self) -> int:
+        """Max logical positions addressable per slot."""
+        return self.width * self.pool.block_size
+
+    def assign(self, slot: int, num_tokens: int) -> None:
+        """Allocate and install blocks covering ``num_tokens`` for a slot
+        (slot must be empty). Caller checks ``pool.can_allocate`` first."""
+        need = blocks_for(num_tokens, self.pool.block_size)
+        if need > self.width:
+            raise ValueError(
+                f"request needs {need} blocks but the block table is "
+                f"{self.width} wide ({self.capacity_tokens()} tokens)")
+        if self._slot_blocks[slot]:
+            raise RuntimeError(f"slot {slot} already holds blocks")
+        ids = self.pool.allocate(need)
+        self._slot_blocks[slot] = ids
+        self.table[slot, :need] = ids
+        self.table[slot, need:] = 0
+
+    def release(self, slot: int) -> None:
+        """Recycle a finished slot's blocks back into the pool."""
+        ids = self._slot_blocks[slot]
+        if ids:
+            self.pool.free(ids)
+        self._slot_blocks[slot] = []
+        self.table[slot, :] = 0
+
+    def blocks_of(self, slot: int) -> List[int]:
+        return list(self._slot_blocks[slot])

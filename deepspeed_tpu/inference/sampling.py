@@ -87,3 +87,41 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
         return jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
 
     return jax.lax.cond(temperature > 0.0, sampled, greedy, (logits, rng))
+
+
+def sample_logits_per_slot(logits: jnp.ndarray, rngs: jnp.ndarray,
+                           temperature: jnp.ndarray, top_k: jnp.ndarray,
+                           top_p: jnp.ndarray) -> jnp.ndarray:
+    """[B, V] logits with PER-ROW sampling state → [B] token ids.
+
+    The continuous-batching decode program serves B independent requests
+    per step, each with its own rng key / temperature / top_k / top_p
+    (inference/scheduler.py binds them at slot admission) — vmapping
+    :func:`sample_logits` over rows keeps the per-request semantics
+    identical to the single-stream path while the program stays one
+    static shape.
+
+    rngs: [B, 2] uint32 PRNG keys. Consumed keys are the caller's to
+    split — pass fresh keys every step (see the engine's decode program).
+
+    All-greedy shortcut: under vmap the per-row greedy/sampled
+    ``lax.cond`` lowers to a select that EXECUTES the sampled branch
+    (full-vocab sort + cumsum) for every row every step; serving defaults
+    to greedy, so a scalar cond on ``any(temperature > 0)`` keeps the hot
+    path at one argmax — the same economy the single-stream
+    :func:`sample_logits` gets from its scalar cond.
+    """
+
+    def all_greedy(op):
+        rows, _ = op
+        return jnp.argmax(rows, axis=-1).astype(jnp.int32)
+
+    def per_slot(op):
+        rows, keys = op
+        return jax.vmap(
+            lambda row, key, t, k, p: sample_logits(row[None], key, t, k,
+                                                    p)[0]
+        )(rows, keys, temperature, top_k, top_p)
+
+    return jax.lax.cond((temperature > 0.0).any(), per_slot, all_greedy,
+                        (logits, rngs))

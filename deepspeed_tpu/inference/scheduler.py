@@ -1,0 +1,298 @@
+"""Continuous-batching scheduler for the paged-KV serving layer.
+
+The static fused path (``InferenceEngine.generate``) runs whole-batch
+lockstep: every row prefills together and decodes until the SLOWEST row
+finishes — head-of-line blocking under mixed-length traffic. This
+scheduler instead runs a fixed set of decode SLOTS against one
+static-shape decode program and admits queued requests into slots the
+moment they free: an arriving request is prefilled (its prompt's KV lands
+in pool blocks) while the in-flight slots keep decoding, and a finishing
+sequence returns its blocks to the pool for the next arrival. Occupancy —
+not program shape — is what varies (DeepSpeed-Inference arXiv:2207.00032;
+Orca/vLLM-style iteration-level scheduling on top of the paged pool).
+
+The scheduler is pure host logic over an EXECUTOR protocol, so its
+admission/recycling/backpressure behavior is unit-tested with a fake
+executor (tests/unit/inference/test_scheduler.py); the real executor —
+compiled prefill/decode programs over the device block pool — lives in
+``inference/engine.py`` (``InferenceEngine.serve``).
+
+Executor protocol (duck-typed)::
+
+    set_slot(slot: int, req: Request) -> None
+        # bind per-slot sampling state (rng key, temperature, top_k,
+        # top_p, eos) — isolation per slot is part of the contract
+    prefill(slot: int, prompt: np.ndarray, block_row: np.ndarray) -> int
+        # write the prompt's KV through the slot's block-table row,
+        # return the first sampled token
+    decode(tokens, block_tables, seq_lens, active, steps_left,
+           max_steps) -> np.ndarray
+        # one program call over ALL slots: [num_slots] int32 last tokens
+        # in, [num_slots, n] int32 sampled tokens out (n >= 1; chunked
+        # executors may decode several steps per call — the scheduler
+        # consumes per-slot tokens up to eos/budget and ignores the
+        # rest). ``max_steps`` (int or None) caps n: the scheduler sets
+        # it to the nearest slot completion while the queue holds work,
+        # so chunking can never delay an admission past a free slot
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, Iterable, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.kv_pool import (
+    BlockPool, SlotBlockTables, blocks_for,
+)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``arrival_time`` (absolute ``time.time()``
+    seconds) gates admission for trace replay; None = eligible now."""
+
+    rid: Any
+    prompt: np.ndarray                 # int32 [T], T >= 1
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: int = -1                   # < 0 disables EOS stopping
+    seed: int = 0
+    arrival_time: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens must "
+                             f"be >= 1")
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: generated tokens + latency breakdown."""
+
+    rid: Any
+    prompt: np.ndarray
+    tokens: np.ndarray                 # generated tokens (incl. eos if hit)
+    t_submit: float
+    t_admitted: float
+    t_first_token: float
+    t_finish: float
+
+    @property
+    def latency(self) -> float:
+        return self.t_finish - self.t_submit
+
+    @property
+    def queue_delay(self) -> float:
+        return self.t_admitted - self.t_submit
+
+
+class _Slot:
+    __slots__ = ("req", "seq_len", "remaining", "out", "t_admitted",
+                 "t_first")
+
+    def __init__(self):
+        self.req: Optional[Request] = None
+        self.seq_len = 0               # tokens whose KV is written
+        self.remaining = 0             # generation budget left
+        self.out: List[int] = []
+        self.t_admitted = 0.0
+        self.t_first = 0.0
+
+    @property
+    def free(self) -> bool:
+        return self.req is None
+
+
+class ContinuousBatchingScheduler:
+    """FIFO request queue over ``num_slots`` decode slots + a block pool.
+
+    One :meth:`step` = admit-what-fits, then one decode program call over
+    all slots. Admission is strict FIFO: if the head request's blocks
+    don't fit, the queue WAITS (backpressure) — nothing is dropped and
+    nothing skips ahead, so completion order under load is predictable.
+    """
+
+    def __init__(self, executor, num_slots: int, pool: BlockPool,
+                 table_width: int):
+        self.executor = executor
+        self.num_slots = int(num_slots)
+        self.pool = pool
+        self.tables = SlotBlockTables(num_slots, table_width, pool)
+        self.queue: Deque[Request] = deque()
+        self.slots = [_Slot() for _ in range(num_slots)]
+        self.seq_lens = np.zeros(num_slots, np.int32)
+        self.last_tokens = np.zeros(num_slots, np.int32)
+        self.active = np.zeros(num_slots, bool)
+        self.steps_left = np.zeros(num_slots, np.int32)
+        self._submit_times = {}
+
+    # --- queue ---------------------------------------------------------------
+    def submit(self, req: Request, now: Optional[float] = None) -> None:
+        need = blocks_for(len(req.prompt) + req.max_new_tokens,
+                          self.pool.block_size)
+        if need > self.tables.width:
+            raise ValueError(
+                f"request {req.rid}: needs {need} blocks "
+                f"({len(req.prompt)}+{req.max_new_tokens} tokens) but the "
+                f"serve config caps a slot at {self.tables.width} blocks — "
+                f"raise max_context")
+        if need > self.pool.num_blocks - 1:
+            # backpressure waits for blocks to RECYCLE; a request larger
+            # than the whole pool would wait forever (an unsatisfiable
+            # FIFO head also starves everything behind it) — reject now
+            raise ValueError(
+                f"request {req.rid}: needs {need} blocks but the pool "
+                f"only has {self.pool.num_blocks - 1} usable — raise "
+                f"num_blocks")
+        self._submit_times[req.rid] = (now if now is not None
+                                       else time.time())
+        self.queue.append(req)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.queue) or bool(self.active.any())
+
+    def next_arrival(self) -> Optional[float]:
+        """Earliest queued arrival_time, for idle waiting."""
+        times = [r.arrival_time for r in self.queue
+                 if r.arrival_time is not None]
+        return min(times) if times else None
+
+    # --- admission -----------------------------------------------------------
+    def _admit(self, now: float) -> List[Completion]:
+        done = []
+        for slot_id, slot in enumerate(self.slots):
+            if not self.queue or not slot.free:
+                continue
+            req = self.queue[0]
+            if req.arrival_time is not None and req.arrival_time > now:
+                break                  # FIFO: later requests wait too
+            need = blocks_for(len(req.prompt) + req.max_new_tokens,
+                              self.pool.block_size)
+            if not self.pool.can_allocate(need):
+                break                  # backpressure: queue, don't crash
+            self.queue.popleft()
+            self.tables.assign(slot_id, len(req.prompt) + req.max_new_tokens)
+            self.executor.set_slot(slot_id, req)
+            t_admit = time.time()
+            first = int(self.executor.prefill(
+                slot_id, req.prompt, self.tables.table[slot_id]))
+            t_first = time.time()
+            slot.req = req
+            slot.seq_len = len(req.prompt)
+            slot.remaining = req.max_new_tokens - 1
+            slot.out = [first]
+            slot.t_admitted = t_admit
+            slot.t_first = t_first
+            self.seq_lens[slot_id] = slot.seq_len
+            self.last_tokens[slot_id] = first
+            hit_eos = req.eos_id >= 0 and first == req.eos_id
+            if slot.remaining == 0 or hit_eos:
+                done.append(self._finish(slot_id, t_first))
+            else:
+                self.active[slot_id] = True
+                self.steps_left[slot_id] = slot.remaining
+        return done
+
+    # --- completion ----------------------------------------------------------
+    def _finish(self, slot_id: int, t_finish: float) -> Completion:
+        slot = self.slots[slot_id]
+        req = slot.req
+        comp = Completion(
+            rid=req.rid, prompt=req.prompt,
+            tokens=np.asarray(slot.out, np.int32),
+            t_submit=self._submit_times.pop(req.rid, slot.t_admitted),
+            t_admitted=slot.t_admitted, t_first_token=slot.t_first,
+            t_finish=t_finish)
+        self.tables.release(slot_id)   # blocks recycle to the pool
+        slot.req = None
+        slot.out = []
+        slot.seq_len = 0
+        slot.remaining = 0
+        self.active[slot_id] = False
+        self.steps_left[slot_id] = 0
+        self.seq_lens[slot_id] = 0
+        self.last_tokens[slot_id] = 0
+        return comp
+
+    # --- one scheduling iteration --------------------------------------------
+    def step(self, now: Optional[float] = None) -> List[Completion]:
+        """Admit what fits, run one decode call, retire finished slots.
+        Returns completions finished this step (possibly empty)."""
+        now = time.time() if now is None else now
+        done = self._admit(now)
+        if not self.active.any():
+            return done
+        # adaptive decode quantum: chunked executors amortize host round
+        # trips over several steps, but while the QUEUE holds admissible
+        # work the call must stop at the next slot completion — otherwise
+        # a freed slot idles to the chunk boundary and the occupancy win
+        # this scheduler exists for quantizes away
+        max_steps = None
+        if self.queue:
+            max_steps = int(self.steps_left[self.active].min())
+        toks = np.asarray(self.executor.decode(
+            self.last_tokens.copy(), self.tables.table,
+            self.seq_lens.copy(), self.active.copy(),
+            self.steps_left.copy(), max_steps), np.int32)
+        if toks.ndim == 1:
+            toks = toks[:, None]
+        t_now = time.time()
+        for slot_id, slot in enumerate(self.slots):
+            if not self.active[slot_id]:
+                continue
+            for tok in toks[slot_id]:
+                if slot.remaining <= 0:
+                    break              # chunked executor overshoot: ignore
+                tok = int(tok)
+                slot.out.append(tok)
+                slot.seq_len += 1      # the fed token's KV was written
+                slot.remaining -= 1
+                self.last_tokens[slot_id] = tok
+                if (slot.req.eos_id >= 0 and tok == slot.req.eos_id):
+                    slot.remaining = 0
+            self.seq_lens[slot_id] = slot.seq_len
+            self.steps_left[slot_id] = slot.remaining
+            if slot.remaining <= 0:
+                done.append(self._finish(slot_id, t_now))
+        return done
+
+    def run_iter(self, poll_interval: float = 0.001):
+        """Drain queue + slots, yielding each Completion as it finishes —
+        THE serving loop (wait policy included); ``run()`` and the
+        engine's ``generate_stream`` both drive through here so the
+        idle/arrival throttling can never diverge between them."""
+        while self.busy:
+            done = self.step()
+            yield from done
+            if not self.active.any() and self.queue:
+                nxt = self.next_arrival()
+                if nxt is not None:
+                    wait = nxt - time.time()
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+                    continue
+                if not done:
+                    # pool exhausted with nothing decoding: impossible by
+                    # construction (finishing slots free blocks), but do
+                    # not spin silently if an executor misbehaves
+                    time.sleep(poll_interval)
+
+    def run(self, poll_interval: float = 0.001) -> List[Completion]:
+        """Drain to completion; all completions in finish order."""
+        return list(self.run_iter(poll_interval))
+
+
+def serve_trace(scheduler: ContinuousBatchingScheduler,
+                requests: Iterable[Request]) -> List[Completion]:
+    """Submit requests (honoring ``arrival_time``) and drain."""
+    for r in requests:
+        scheduler.submit(r, now=r.arrival_time)
+    return scheduler.run()

@@ -147,7 +147,9 @@ def _fsdp_gather_leaf(a):
     """Replicate-constrain one per-layer weight slice inside the scan body
     (see LlamaConfig.fsdp_gather_scan). No-op without an ambient mesh or
     when model axes are active."""
-    from jax.sharding import PartitionSpec, get_abstract_mesh
+    from jax.sharding import PartitionSpec
+
+    from deepspeed_tpu.utils.jax_compat import get_abstract_mesh
 
     mesh = get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
@@ -222,6 +224,44 @@ class _ScanLlamaDecodeBlock(nn.Module):
         y, new_cache = LlamaDecodeBlock(self.cfg, name="block")(
             x, mask, positions, kv_cache, cache_index)
         return y, new_cache
+
+
+class PagedLlamaDecodeBlock(nn.Module):
+    """Block decoding against the shared paged KV block pool
+    (ops/paged_attention): same parameter structure as LlamaBlock /
+    LlamaDecodeBlock, so trained params apply directly; only the cache
+    layout differs from LlamaDecodeBlock."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, mask, positions, kv_pool, block_tables, write_pos,
+                 valid_len):
+        cfg = self.cfg
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, name="input_norm")(x)
+        h, new_pool = SelfAttention(
+            num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+            use_rope=True, rope_base=cfg.rope_base, dtype=cfg.dtype,
+            attention_impl="xla", name="attn",
+        )(h, mask=mask, positions=positions, paged_cache=kv_pool,
+          block_tables=block_tables, write_pos=write_pos,
+          valid_len=valid_len)
+        x = x + h
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, name="post_attn_norm")(x)
+        h = GatedMLP(intermediate_size=cfg.intermediate_size, dtype=cfg.dtype,
+                     name="mlp")(h)
+        return x + h, new_pool
+
+
+class _ScanPagedLlamaDecodeBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, mask, positions, kv_pool, block_tables, write_pos,
+                 valid_len):
+        y, new_pool = PagedLlamaDecodeBlock(self.cfg, name="block")(
+            x, mask, positions, kv_pool, block_tables, write_pos, valid_len)
+        return y, new_pool
 
 
 class LlamaModel(nn.Module):
@@ -331,6 +371,71 @@ class LlamaDecoderModel(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
         return logits.astype(jnp.float32), new_caches
+
+
+class PagedLlamaDecoderModel(nn.Module):
+    """Paged-KV decode twin of :class:`LlamaDecoderModel`: same parameter
+    tree, but K/V live in a shared block pool indexed through per-slot
+    block tables instead of a dense [L, B, S_max, ...] arena — the layout
+    behind the continuous-batching scheduler (inference/scheduler.py).
+
+    kv_pools: (k_pool, v_pool) of [L, num_blocks, block_size, n_kv, hd].
+    block_tables: int32 [B, W]. write_pos: int32 [B] — per-slot tokens
+    already in cache (0 for prefill). valid_len: int32 [B] or None —
+    real tokens per row along T (right-padding / inactive slots write to
+    the null block). Greedy-exact vs the dense twin
+    (tests/unit/inference/test_paged_decode.py).
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, kv_pools, block_tables, write_pos,
+                 valid_len=None):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        S = block_tables.shape[1] * kv_pools[0].shape[2]
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         param_dtype=jnp.float32, dtype=cfg.dtype,
+                         name="embed_tokens")
+        x = embed(input_ids)
+        positions = write_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        from deepspeed_tpu.ops.paged_attention import paged_context_mask
+
+        mask = paged_context_mask(positions, S)
+
+        if cfg.scan_layers:
+            ScanBlock = nn.scan(
+                _ScanPagedLlamaDecodeBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast,
+                         nn.broadcast, nn.broadcast),
+                out_axes=0,
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, new_pools = ScanBlock(cfg, name="blocks")(
+                x, mask, positions, kv_pools, block_tables, write_pos,
+                valid_len)
+        else:
+            new_k, new_v = [], []
+            for i in range(cfg.num_layers):
+                x, (pk, pv) = PagedLlamaDecodeBlock(cfg, name=f"layers_{i}")(
+                    x, mask, positions,
+                    (kv_pools[0][i], kv_pools[1][i]), block_tables,
+                    write_pos, valid_len)
+                new_k.append(pk)
+                new_v.append(pv)
+            new_pools = (jnp.stack(new_k), jnp.stack(new_v))
+
+        x = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+        return logits.astype(jnp.float32), new_pools
 
 
 class StreamedLlamaModel:
@@ -579,10 +684,17 @@ def retile_gateup_for_fused_mlp(params: Any) -> Any:
     PANEL granularity — the eligibility condition of the fused gated-MLP
     kernel (ops/int8_matmul.int8_mlp_fused). At Llama-7B shapes the
     default 512 panel gives 43 panels (odd: 22016/512) so the fused path
-    could never engage; 256 gives 86 (43 per half — exact). One-time,
-    in-place, pure reshape/transpose per leaf (no requantization — tile
-    geometry only). Called by the engine when ``quant.fused_mlp`` is
-    enabled."""
+    could never engage; 256 gives 86 (43 per half — exact). Pure
+    reshape/transpose per leaf (no requantization — tile geometry only).
+    Called by the engine when ``quant.fused_mlp`` is enabled.
+
+    PURE: returns a new tree rebuilding only the dicts on the path to a
+    re-laid leaf; the caller-supplied tree is never mutated (other
+    engine-side transforms may still hold it). Unaffected leaves are
+    shared by reference, and each converted leaf's old buffer is only
+    kept alive by the INPUT tree — callers that rebind (``params =
+    retile_gateup_for_fused_mlp(params)``) keep peak extra memory to the
+    gateup leaves alone."""
 
     from deepspeed_tpu.ops.int8_matmul import tile_rowwise
 
@@ -590,34 +702,42 @@ def retile_gateup_for_fused_mlp(params: Any) -> Any:
         nk, nn, bk, bn = qt.shape
         return qt.transpose(0, 2, 1, 3).reshape(nk * bk, nn * bn)
 
-    def walk(node):
-        if isinstance(node, dict):
-            gu = node.get("gateup_proj")
-            if (isinstance(gu, dict) and gu.get("q") is not None
-                    and gu["q"].ndim in (4, 5)):
-                q, s = gu["q"], gu["scale"]
-                nn, bn = q.shape[-3], q.shape[-1]
-                if nn % 2 and bn % 2 == 0 and bn >= 256:
-                    # re-lay through the ONE blocking implementation
-                    # (tile_rowwise; Kp is already a block_k multiple so
-                    # the scale passes through unchanged)
-                    fn = lambda qq, ss: tile_rowwise(
-                        _untile(qq), ss, block_n=bn // 2)
-                    if q.ndim == 5:
-                        fn = jax.vmap(fn)
-                    qt, st = jax.jit(fn)(q, s)
-                    qt.block_until_ready()
-                    # keep the RETURNED scale: if tile_rowwise K-padded
-                    # (non-default original block_k), q and scale must
-                    # stay length-matched or the kernels' Kg_pad asserts
-                    # fire mid-decode
-                    gu["q"], gu["scale"] = qt, st
-                    del q, s
-            for v in node.values():
-                walk(v)
+    def _retile(gu):
+        q, s = gu["q"], gu["scale"]
+        nn, bn = q.shape[-3], q.shape[-1]
+        if not (nn % 2 and bn % 2 == 0 and bn >= 256):
+            return gu
+        # re-lay through the ONE blocking implementation (tile_rowwise;
+        # Kp is already a block_k multiple so the scale passes through
+        # unchanged)
+        fn = lambda qq, ss: tile_rowwise(_untile(qq), ss, block_n=bn // 2)
+        if q.ndim == 5:
+            fn = jax.vmap(fn)
+        qt, st = jax.jit(fn)(q, s)
+        qt.block_until_ready()
+        # keep the RETURNED scale: if tile_rowwise K-padded (non-default
+        # original block_k), q and scale must stay length-matched or the
+        # kernels' Kg_pad asserts fire mid-decode
+        return {"q": qt, "scale": st}
 
-    walk(params)
-    return params
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = node
+        for key, val in node.items():
+            gu = val
+            if (key == "gateup_proj" and isinstance(gu, dict)
+                    and gu.get("q") is not None and gu["q"].ndim in (4, 5)):
+                new = _retile(gu)
+            else:
+                new = walk(val)
+            if new is not val:
+                if out is node:
+                    out = dict(node)   # copy-on-write along the path
+                out[key] = new
+        return out
+
+    return walk(params)
 
 
 def decode_positions_and_mask(batch: int, T: int, S_max: int, cache_index,
@@ -648,16 +768,18 @@ class FusedLlamaDecoderModel:
     ``apply({"params": fused_tree}, ids, caches, index)``."""
 
     def __init__(self, cfg: LlamaConfig, int8_block_n: int = 256,
-                 w8a8_prefill: bool = True):
+                 w8a8_prefill: bool = False):
         self.cfg = cfg
         # int8-streaming N-panel width — session-tunable (the engine's
         # at-init microbench sets it; docs/PERF_ANALYSIS.md decode notes)
         self.int8_block_n = int8_block_n
         # prefill rows run native s8xs8 dots (int8 MXU) instead of a
-        # convert-into-bf16-GEMM — see quant.w8a8_prefill. Applied per
-        # matmul only above the weight-size threshold where the halved
-        # feed bytes beat the per-token quant chain's fixed cost (7B
-        # shapes win, 770M shapes lose — measured round 5)
+        # convert-into-bf16-GEMM — see quant.w8a8_prefill. OPT-IN (the
+        # per-token activation rounding is a numerics change; matches
+        # the config default). Applied per matmul only above the
+        # weight-size threshold where the halved feed bytes beat the
+        # per-token quant chain's fixed cost (7B shapes win, 770M
+        # shapes lose — measured round 5)
         self.w8a8_prefill = w8a8_prefill
         self.w8a8_min_weight_numel = 16_000_000
         # decode-step matvecs through the s8xs8 kernel (experimental,
@@ -666,124 +788,126 @@ class FusedLlamaDecoderModel:
         # fused gated-MLP decode kernel (quant.fused_mlp; default off)
         self.fused_mlp = False
 
+    def _rms(self, x, scale):
+        cfg = self.cfg
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+                * scale).astype(cfg.dtype)
+
+    def _mm(self, x, w):
+        """Matmul dispatch: dense kernels use the MXU dot; int8
+        weight-streaming leaves (quantize_fused_rowwise) go through the
+        Pallas kernel that converts int8→f32 in VMEM, halving the HBM
+        bytes per decode step. Shared by the dense-cache ``apply`` and
+        the paged ``apply_paged`` so the weight path cannot drift between
+        the two serving modes.
+
+        PREFILL rows (T >= 32: prompt processing — decode steps are
+        T=1, speculative drafts <= ~16) skip the kernel: at M >> 1 the
+        matmul is MXU-bound, not weight-bandwidth-bound, and the
+        matvec kernel's VMEM-dequant pipeline only taxes it (measured
+        round 4: 7B int8 TTFT 64.2 vs bf16 47.8 ms). Dequantize once
+        per call and run the plain XLA GEMM — the convert streams the
+        weight once, which prefill pays anyway."""
+        cfg = self.cfg
+        if isinstance(w, dict) and "q" in w:
+            from deepspeed_tpu.ops.int8_matmul import int8_matmul
+
+            Bm, Tm, Km = x.shape
+            q, s = w["q"], w["scale"]
+            if Tm >= 32:
+                Kp = s.shape[0]
+                if Kp > Km:                # offline/tile K padding
+                    x = jnp.pad(x, ((0, 0), (0, 0), (0, Kp - Km)))
+                xs32 = x.astype(jnp.float32) * s[None, None, :]
+                # w8a8 only where the weight is big enough for the
+                # halved feed bytes to beat the per-token quant
+                # chain's fixed cost: 7B matmuls (K*N ~ 50-90M)
+                # measured TTFT 80.5 -> 75.0/68.1 ms, while at 770M
+                # (K*N ~ 7M) the same routing REGRESSED TTFT 40 ->
+                # 50-63 ms — threshold between the two regimes
+                _numel = 1
+                for _d in q.shape:
+                    _numel *= int(_d)
+                if self.w8a8_prefill and \
+                        _numel >= self.w8a8_min_weight_numel:
+                    # w8a8: weight row scales are already folded into
+                    # the activation above, so a per-token dynamic
+                    # symmetric quant covers the whole contraction and
+                    # the dot runs s8xs8->s32 on the int8 MXU (2x the
+                    # bf16 systolic rate) with NO weight convert in
+                    # the feed — the round-5 TTFT lever
+                    # (quant.w8a8_prefill)
+                    from deepspeed_tpu.ops.int8_matmul import (
+                        quantize_per_row,
+                    )
+
+                    xq, sx = quantize_per_row(xs32)
+                    if q.ndim == 4:
+                        # one einsum over the tiled layout. A/B'd
+                        # against unrolled per-k-slice batched dots
+                        # (hypothesis: the 2-contracting-dim einsum
+                        # re-lays the weight) — the unroll measured
+                        # WORSE (7B TTFT 90.1 vs 75.0 ms, compiles
+                        # 262 s vs 16) — keep the einsum
+                        nk, nn, bk, bn = q.shape
+                        x4 = xq.reshape(Bm, Tm, nk, bk)
+                        y = jnp.einsum(
+                            "mtkb,knbs->mtns", x4, q,
+                            preferred_element_type=jnp.int32)
+                        y = y.reshape(Bm, Tm, nn * bn)
+                    else:
+                        y = jax.lax.dot_general(
+                            xq, q, (((2,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+                    return (y.astype(jnp.float32) * sx
+                            ).astype(cfg.dtype)
+                xs = xs32.astype(cfg.dtype)
+                if q.ndim == 4:
+                    # contract straight over the tiled layout — a
+                    # row-major untile at 7B is a 6.7 GB int8 shuffle
+                    # plus a 13 GB bf16 materialization per prefill
+                    # (measured round 5: int8 TTFT 110 vs bf16 45 ms);
+                    # the einsum lets XLA convert tile-wise into the
+                    # MXU feed instead
+                    nk, nn, bk, bn = q.shape
+                    x4 = xs.reshape(Bm, Tm, nk, bk)
+                    y = jnp.einsum("mtkb,knbs->mtns", x4,
+                                   q.astype(cfg.dtype))
+                    return y.reshape(Bm, Tm, nn * bn)
+                return xs @ q.astype(cfg.dtype)
+            if self.w8a8_decode and q.ndim == 4:
+                from deepspeed_tpu.ops.int8_matmul import (
+                    int8_matmul_tiled_w8a8,
+                )
+
+                y = int8_matmul_tiled_w8a8(
+                    x.reshape(Bm * Tm, Km), q, s, out_dtype=cfg.dtype)
+                return y.reshape(Bm, Tm, -1)
+            y = int8_matmul(x.reshape(Bm * Tm, Km), q, s,
+                            block_n=self.int8_block_n,
+                            out_dtype=cfg.dtype)
+            return y.reshape(Bm, Tm, -1)
+        return x @ w
+
     def apply(self, variables, input_ids, kv_caches, cache_index,
               attn_start=0):
+        """Dense-cache decode (the original contract): preallocated
+        [L, B, S_max, n_kv, hd] caches (int8: 4-array variant), one write
+        index for the whole batch."""
         fused_params = variables["params"]
         cfg = self.cfg
-        assert cfg.scan_layers, "fused decode expects scan-stacked params"
         B, T = input_ids.shape
         S_max = kv_caches[0].shape[2]
         n_kv = cfg.num_kv_heads or cfg.num_heads
         hd = cfg.hidden_size // cfg.num_heads
-        emb = fused_params["embed_tokens"]["embedding"]
-        x = emb[input_ids].astype(cfg.dtype)
         positions, mask = decode_positions_and_mask(B, T, S_max, cache_index,
                                                     attn_start)
-
-        from deepspeed_tpu.models.transformer import (
-            dot_product_attention, rotary_embedding,
-        )
-
-        def rms(x, scale):
-            x32 = x.astype(jnp.float32)
-            var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-            return (x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
-                    * scale).astype(cfg.dtype)
-
-        def mm(x, w):
-            """Matmul dispatch: dense kernels use the MXU dot; int8
-            weight-streaming leaves (quantize_fused_rowwise) go through the
-            Pallas kernel that converts int8→f32 in VMEM, halving the HBM
-            bytes per decode step.
-
-            PREFILL rows (T >= 32: prompt processing — decode steps are
-            T=1, speculative drafts <= ~16) skip the kernel: at M >> 1 the
-            matmul is MXU-bound, not weight-bandwidth-bound, and the
-            matvec kernel's VMEM-dequant pipeline only taxes it (measured
-            round 4: 7B int8 TTFT 64.2 vs bf16 47.8 ms). Dequantize once
-            per call and run the plain XLA GEMM — the convert streams the
-            weight once, which prefill pays anyway."""
-            if isinstance(w, dict) and "q" in w:
-                from deepspeed_tpu.ops.int8_matmul import int8_matmul
-
-                Bm, Tm, Km = x.shape
-                q, s = w["q"], w["scale"]
-                if Tm >= 32:
-                    Kp = s.shape[0]
-                    if Kp > Km:                # offline/tile K padding
-                        x = jnp.pad(x, ((0, 0), (0, 0), (0, Kp - Km)))
-                    xs32 = x.astype(jnp.float32) * s[None, None, :]
-                    # w8a8 only where the weight is big enough for the
-                    # halved feed bytes to beat the per-token quant
-                    # chain's fixed cost: 7B matmuls (K*N ~ 50-90M)
-                    # measured TTFT 80.5 -> 75.0/68.1 ms, while at 770M
-                    # (K*N ~ 7M) the same routing REGRESSED TTFT 40 ->
-                    # 50-63 ms — threshold between the two regimes
-                    _numel = 1
-                    for _d in q.shape:
-                        _numel *= int(_d)
-                    if self.w8a8_prefill and \
-                            _numel >= self.w8a8_min_weight_numel:
-                        # w8a8: weight row scales are already folded into
-                        # the activation above, so a per-token dynamic
-                        # symmetric quant covers the whole contraction and
-                        # the dot runs s8xs8->s32 on the int8 MXU (2x the
-                        # bf16 systolic rate) with NO weight convert in
-                        # the feed — the round-5 TTFT lever
-                        # (quant.w8a8_prefill)
-                        from deepspeed_tpu.ops.int8_matmul import (
-                            quantize_per_row,
-                        )
-
-                        xq, sx = quantize_per_row(xs32)
-                        if q.ndim == 4:
-                            # one einsum over the tiled layout. A/B'd
-                            # against unrolled per-k-slice batched dots
-                            # (hypothesis: the 2-contracting-dim einsum
-                            # re-lays the weight) — the unroll measured
-                            # WORSE (7B TTFT 90.1 vs 75.0 ms, compiles
-                            # 262 s vs 16) — keep the einsum
-                            nk, nn, bk, bn = q.shape
-                            x4 = xq.reshape(Bm, Tm, nk, bk)
-                            y = jnp.einsum(
-                                "mtkb,knbs->mtns", x4, q,
-                                preferred_element_type=jnp.int32)
-                            y = y.reshape(Bm, Tm, nn * bn)
-                        else:
-                            y = jax.lax.dot_general(
-                                xq, q, (((2,), (0,)), ((), ())),
-                                preferred_element_type=jnp.int32)
-                        return (y.astype(jnp.float32) * sx
-                                ).astype(cfg.dtype)
-                    xs = xs32.astype(cfg.dtype)
-                    if q.ndim == 4:
-                        # contract straight over the tiled layout — a
-                        # row-major untile at 7B is a 6.7 GB int8 shuffle
-                        # plus a 13 GB bf16 materialization per prefill
-                        # (measured round 5: int8 TTFT 110 vs bf16 45 ms);
-                        # the einsum lets XLA convert tile-wise into the
-                        # MXU feed instead
-                        nk, nn, bk, bn = q.shape
-                        x4 = xs.reshape(Bm, Tm, nk, bk)
-                        y = jnp.einsum("mtkb,knbs->mtns", x4,
-                                       q.astype(cfg.dtype))
-                        return y.reshape(Bm, Tm, nn * bn)
-                    return xs @ q.astype(cfg.dtype)
-                if self.w8a8_decode and q.ndim == 4:
-                    from deepspeed_tpu.ops.int8_matmul import (
-                        int8_matmul_tiled_w8a8,
-                    )
-
-                    y = int8_matmul_tiled_w8a8(
-                        x.reshape(Bm * Tm, Km), q, s, out_dtype=cfg.dtype)
-                    return y.reshape(Bm, Tm, -1)
-                y = int8_matmul(x.reshape(Bm * Tm, Km), q, s,
-                                block_n=self.int8_block_n,
-                                out_dtype=cfg.dtype)
-                return y.reshape(Bm, Tm, -1)
-            return x @ w
-
         kv_int8 = len(kv_caches) == 4
+        rep = cfg.num_heads // n_kv
+
+        from deepspeed_tpu.models.transformer import dot_product_attention
 
         def attn_int8(q, kq, ks, vq, vs):
             """dot_product_attention semantics over an int8 cache: the
@@ -804,18 +928,9 @@ class FusedLlamaDecoderModel:
             return jnp.einsum("bhqk,bkhd->bqhd", weights,
                               vq.astype(q.dtype))
 
-        def block(x, layer):
-            h = rms(x, layer["input_norm"]["scale"])
-            qkv = mm(h, layer["qkv_proj"])
-            q_sz = cfg.num_heads * hd
-            q = qkv[..., :q_sz].reshape(B, T, cfg.num_heads, hd)
-            k = qkv[..., q_sz:q_sz + n_kv * hd].reshape(B, T, n_kv, hd)
-            v = qkv[..., q_sz + n_kv * hd:].reshape(B, T, n_kv, hd)
-            q = rotary_embedding(q, positions, cfg.rope_base)
-            k = rotary_embedding(k, positions, cfg.rope_base)
-            rep = cfg.num_heads // n_kv
+        def attn_core(q, k, v, cache):
             if kv_int8:
-                ckq, cks, cvq, cvs = layer["_cache"]
+                ckq, cks, cvq, cvs = cache
                 kq, ksc = quantize_kv_heads(k)
                 vq, vsc = quantize_kv_heads(v)
                 idx = (0, cache_index, 0)
@@ -830,19 +945,98 @@ class FusedLlamaDecoderModel:
                     vvq = jnp.repeat(vvq, rep, axis=2)
                     vvs = jnp.repeat(vvs, rep, axis=2)
                 a = attn_int8(q, kkq, kks, vvq, vvs)
-                new_cache = (ckq, cks, cvq, cvs)
-            else:
-                ck, cv = layer["_cache"]
-                ck = jax.lax.dynamic_update_slice(ck, k,
-                                                  (0, cache_index, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, v,
-                                                  (0, cache_index, 0, 0))
-                kk, vv = ck, cv
-                if rep > 1:
-                    kk = jnp.repeat(kk, rep, axis=2)
-                    vv = jnp.repeat(vv, rep, axis=2)
-                a = dot_product_attention(q, kk, vv, mask=mask)
-                new_cache = (ck, cv)
+                return a, (ckq, cks, cvq, cvs)
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(ck, k,
+                                              (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v,
+                                              (0, cache_index, 0, 0))
+            kk, vv = ck, cv
+            if rep > 1:
+                kk = jnp.repeat(kk, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            a = dot_product_attention(q, kk, vv, mask=mask)
+            return a, (ck, cv)
+
+        return self._forward(fused_params, input_ids, positions, kv_caches,
+                             attn_core)
+
+    def apply_paged(self, variables, input_ids, kv_pools, block_tables,
+                    write_pos, valid_len=None):
+        """Paged-KV twin of :meth:`apply`: K/V live in shared block pools
+        ([L, num_blocks, block_size, n_kv, hd]; the int8 variant is the
+        4-tuple (kq, kscale, vq, vscale) with per-(token, head) scale
+        pools [L, nb, bs, n_kv]) indexed
+        through per-slot ``block_tables`` [B, W]. ``write_pos`` [B] is
+        each slot's context length before this call; ``valid_len`` [B]
+        masks right-padding/inactive slots (their writes land in the null
+        block). Same weight path (``_mm``), same attention math — only
+        the cache layout differs, which is what the exact-parity tests
+        pin (tests/unit/inference/test_paged_decode.py)."""
+        fused_params = variables["params"]
+        cfg = self.cfg
+        B, T = input_ids.shape
+        n_kv = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.hidden_size // cfg.num_heads
+        positions = write_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        kv_int8 = len(kv_pools) == 4
+
+        from deepspeed_tpu.ops.paged_attention import (
+            paged_append, paged_append_scales, paged_attention,
+            paged_attention_int8,
+        )
+
+        def attn_core(q, k, v, cache):
+            if kv_int8:
+                kqp, ksp, vqp, vsp = cache
+                kq, ksc = quantize_kv_heads(k)
+                vq, vsc = quantize_kv_heads(v)
+                kqp, vqp = paged_append(kqp, vqp, kq, vq, block_tables,
+                                        write_pos, valid_len)
+                ksp = paged_append_scales(ksp, ksc, block_tables,
+                                          write_pos, valid_len)
+                vsp = paged_append_scales(vsp, vsc, block_tables,
+                                          write_pos, valid_len)
+                a = paged_attention_int8(q, kqp, ksp, vqp, vsp,
+                                         block_tables, positions)
+                return a, (kqp, ksp, vqp, vsp)
+            kp, vp = cache
+            kp, vp = paged_append(kp, vp, k, v, block_tables, write_pos,
+                                  valid_len)
+            a = paged_attention(q, kp, vp, block_tables, positions)
+            return a, (kp, vp)
+
+        return self._forward(fused_params, input_ids, positions, kv_pools,
+                             attn_core)
+
+    def _forward(self, fused_params, input_ids, positions, caches,
+                 attn_core):
+        """Shared fused-decode body: embed → scan(blocks) → norm → head.
+        ``attn_core(q, k, v, layer_cache) -> (ctx [B, T, H, hd],
+        new_layer_cache)`` is the only seam between the dense-cache and
+        paged-KV paths; everything else (weight dispatch, RoPE, fused
+        MLP, head) is one implementation."""
+        cfg = self.cfg
+        assert cfg.scan_layers, "fused decode expects scan-stacked params"
+        B, T = input_ids.shape
+        n_kv = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.hidden_size // cfg.num_heads
+        emb = fused_params["embed_tokens"]["embedding"]
+        x = emb[input_ids].astype(cfg.dtype)
+        mm, rms = self._mm, self._rms
+
+        from deepspeed_tpu.models.transformer import rotary_embedding
+
+        def block(x, layer):
+            h = rms(x, layer["input_norm"]["scale"])
+            qkv = mm(h, layer["qkv_proj"])
+            q_sz = cfg.num_heads * hd
+            q = qkv[..., :q_sz].reshape(B, T, cfg.num_heads, hd)
+            k = qkv[..., q_sz:q_sz + n_kv * hd].reshape(B, T, n_kv, hd)
+            v = qkv[..., q_sz + n_kv * hd:].reshape(B, T, n_kv, hd)
+            q = rotary_embedding(q, positions, cfg.rope_base)
+            k = rotary_embedding(k, positions, cfg.rope_base)
+            a, new_cache = attn_core(q, k, v, layer["_cache"])
             a = a.reshape(B, T, q_sz)
             x = x + mm(a, layer["o_proj"])
             h = rms(x, layer["post_attn_norm"]["scale"])
@@ -885,7 +1079,7 @@ class FusedLlamaDecoderModel:
 
         x, new_caches = jax.lax.scan(
             scan_body, x,
-            (fused_params["blocks"]["block"],) + tuple(kv_caches))
+            (fused_params["blocks"]["block"],) + tuple(caches))
 
         scale = fused_params["final_norm"]["scale"]
         x = rms(x, scale)
@@ -920,6 +1114,22 @@ def init_kv_caches(cfg: LlamaConfig, batch_size: int, max_seq_len: int,
         return (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32),
                 jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32))
     return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def init_paged_kv_pools(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                        dtype=None, int8: bool = False):
+    """Shared K/V block pools for the paged decode paths
+    (:class:`PagedLlamaDecoderModel` / ``FusedLlamaDecoderModel.apply_paged``).
+
+    ``int8`` (``quant.kv_cache``): payloads store int8 with per-(token,
+    head) symmetric scale pools — the paged analogue of the dense int8
+    cache, sharing its dequant math (quantize_kv_heads)."""
+    from deepspeed_tpu.ops.paged_attention import init_paged_pool
+
+    n_kv = cfg.num_kv_heads or cfg.num_heads
+    head_dim = cfg.hidden_size // cfg.num_heads
+    return init_paged_pool(cfg.num_layers, num_blocks, block_size, n_kv,
+                           head_dim, dtype or cfg.dtype, int8=int8)
 
 
 def quantize_kv_heads(x: jnp.ndarray):

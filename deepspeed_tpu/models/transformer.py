@@ -17,6 +17,7 @@ from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 
 Dtype = Any
@@ -118,7 +119,9 @@ def _sequence_parallel_attention(q, k, v, impl: str):
     """Dispatch to Ulysses / ring context parallelism over the ambient mesh's
     ``sequence`` axis (requires the engine's mesh context; [B,S,H,D] logical
     arrays are mapped to per-device [B, S/P, H, D] shards)."""
-    from jax.sharding import PartitionSpec, get_abstract_mesh
+    from jax.sharding import PartitionSpec
+
+    from deepspeed_tpu.utils.jax_compat import get_abstract_mesh
 
     mesh = get_abstract_mesh()
     if mesh is None or "sequence" not in mesh.axis_names or \
@@ -142,8 +145,12 @@ def _sequence_parallel_attention(q, k, v, impl: str):
         from deepspeed_tpu.ops.ring_attention import ring_attention
         inner = lambda q_, k_, v_: ring_attention(q_, k_, v_, causal=True)
 
-    return jax.shard_map(
-        inner, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
+    # check_vma=False: the ring/ulysses cores carry cond-guarded psums
+    # whose replication typing the checker cannot prove (same escape
+    # hatch the op tests use; jax_compat maps it to check_rep on old jax)
+    return shard_map(
+        inner, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False)(q, k, v)
 
 
 class RMSNorm(nn.Module):
@@ -197,7 +204,8 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, positions=None, deterministic=True,
-                 kv_cache=None, cache_index=None):
+                 kv_cache=None, cache_index=None, paged_cache=None,
+                 block_tables=None, write_pos=None, valid_len=None):
         features = x.shape[-1]
         n_kv = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or features // self.num_heads
@@ -222,7 +230,22 @@ class SelfAttention(nn.Module):
                                  self.rotary_dim, self.rotary_interleaved)
 
         updated_cache = None
-        if kv_cache is not None:
+        if paged_cache is not None:
+            # paged decode: scatter new k/v into the shared block pool
+            # through this slot batch's block tables, then attend over the
+            # per-slot gathered view (ops/paged_attention; the caller's
+            # mask covers context length + architecture terms)
+            from deepspeed_tpu.ops.paged_attention import (
+                paged_append, paged_gather,
+            )
+
+            kp, vp = paged_cache
+            kp, vp = paged_append(kp, vp, k, v, block_tables, write_pos,
+                                  valid_len)
+            k = paged_gather(kp, block_tables)
+            v = paged_gather(vp, block_tables)
+            updated_cache = (kp, vp)
+        elif kv_cache is not None:
             # decode: append new k/v at cache_index (functional KV cache)
             ck, cv = kv_cache
             ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
@@ -251,11 +274,12 @@ class SelfAttention(nn.Module):
             impl = "flash" if (flash_ok
                                and x.shape[1] >= self.flash_min_seqlen) \
                 else "xla"
-        if impl == "flash" and kv_cache is None:
+        caching = kv_cache is not None or paged_cache is not None
+        if impl == "flash" and not caching:
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
-        elif impl in ("ulysses", "ring", "ring_flash") and kv_cache is None:
+        elif impl in ("ulysses", "ring", "ring_flash") and not caching:
             out = _sequence_parallel_attention(q, k, v, impl)
         else:
             dropout_rng = None
@@ -270,7 +294,7 @@ class SelfAttention(nn.Module):
         o_bias = self.use_bias if self.out_bias is None else self.out_bias
         out = nn.Dense(features, use_bias=o_bias, dtype=self.dtype,
                        param_dtype=jnp.float32, name="o_proj")(out)
-        if kv_cache is not None:
+        if updated_cache is not None:
             return out, updated_cache
         return out
 

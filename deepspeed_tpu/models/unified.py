@@ -240,7 +240,9 @@ class UnifiedBlock(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, mask, positions, kv_cache=None, cache_index=None):
+    def __call__(self, x, mask, positions, kv_cache=None, cache_index=None,
+                 paged_cache=None, block_tables=None, write_pos=None,
+                 valid_len=None):
         cfg = self.cfg
         attn = SelfAttention(
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
@@ -266,10 +268,14 @@ class UnifiedBlock(nn.Module):
                       use_bias=cfg.mlp_bias, activation=_act(cfg.activation),
                       name="mlp")
 
+        caching = kv_cache is not None or paged_cache is not None
+
         def attend(h):
-            # SelfAttention returns (out, cache) iff kv_cache is given
+            # SelfAttention returns (out, cache) iff a cache is given
             return attn(h, mask=mask, positions=positions,
-                        kv_cache=kv_cache, cache_index=cache_index)
+                        kv_cache=kv_cache, cache_index=cache_index,
+                        paged_cache=paged_cache, block_tables=block_tables,
+                        write_pos=write_pos, valid_len=valid_len)
 
         new_cache = None
         if cfg.parallel_attn:
@@ -277,23 +283,23 @@ class UnifiedBlock(nn.Module):
             h1 = _norm(cfg, "ln_1")(x)
             h2 = h1 if cfg.parallel_shared_ln else _norm(cfg, "ln_2")(x)
             a = attend(h1)
-            if kv_cache is not None:
+            if caching:
                 a, new_cache = a
             out = x + a + mlp(h2)
         elif cfg.pre_ln:
             a = attend(_norm(cfg, "ln_1")(x))
-            if kv_cache is not None:
+            if caching:
                 a, new_cache = a
             x = x + a
             out = x + mlp(_norm(cfg, "ln_2")(x))
         else:
             # post-LN (BERT): ln(x + sub(x))
             a = attend(x)
-            if kv_cache is not None:
+            if caching:
                 a, new_cache = a
             x = _norm(cfg, "ln_1")(x + a)
             out = _norm(cfg, "ln_2")(x + mlp(x))
-        if kv_cache is not None:
+        if caching:
             return out, new_cache
         return out
 
@@ -597,6 +603,96 @@ class TransformerDecoderModel(nn.Module):
         return logits.astype(jnp.float32), new_caches
 
 
+class PagedTransformerDecoderModel(nn.Module):
+    """Paged-KV decode twin of :class:`TransformerDecoderModel`: same
+    parameter tree, but K/V live in a shared block pool indexed through
+    per-slot block tables (ops/paged_attention) instead of a dense
+    [L, B, S_max, ...] arena — the layout that lets the continuous-batching
+    scheduler recycle cache capacity at sequence granularity while this
+    module's shapes stay static (fixed slot count, fixed table width).
+
+    kv_pools: (k_pool, v_pool) of [L, num_blocks, block_size, n_kv, hd].
+    block_tables: int32 [B, W]; write_pos: int32 [B] — per-slot context
+    length before this call (0 for prefill); valid_len: int32 [B] or None —
+    tokens of the T axis that are real per row (right-padding/inactive
+    slots write to the null block). Exact same mask/position math as the
+    dense twin, only over the gathered block axis.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, kv_pools, block_tables, write_pos,
+                 valid_len=None):
+        cfg = self.cfg
+        if not cfg.causal or not cfg.lm_head:
+            raise ValueError(
+                "PagedTransformerDecoderModel requires a causal LM config "
+                "(encoder architectures cannot generate)")
+        B, T = input_ids.shape
+        S = block_tables.shape[1] * kv_pools[0].shape[2]
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="wte")
+        x = wte(input_ids)
+        positions = write_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        if cfg.pos_emb == "learned":
+            wpe = nn.Embed(cfg.max_seq_len + cfg.pos_offset, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=jnp.float32, name="wpe")
+            # clamp: padded/inactive rows may carry positions past the
+            # table; their outputs are masked/ignored, but the gather
+            # must not hit XLA OOB semantics mid-batch
+            safe = jnp.clip(positions + cfg.pos_offset, 0,
+                            cfg.max_seq_len + cfg.pos_offset - 1)
+            x = x + wpe(safe)
+        if cfg.token_type_vocab:
+            tte = nn.Embed(cfg.token_type_vocab, cfg.hidden_size, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="wtte")
+            x = x + tte(jnp.zeros_like(input_ids))
+        if cfg.embed_ln or not cfg.pre_ln:
+            x = _norm(cfg, "ln_emb")(x)
+
+        # same semantics as the dense twin's mask, over the gathered axis:
+        # column j of the per-slot view IS logical position j (the ONE
+        # causal-context rule, shared with the llama paged twins)
+        from deepspeed_tpu.ops.paged_attention import paged_context_mask
+
+        row_pos = positions                                      # [B, T]
+        col = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
+        neg = jnp.finfo(jnp.float32).min
+        base_mask = paged_context_mask(row_pos, S)
+        if cfg.pos_emb == "alibi":
+            slopes = alibi_slopes(cfg.num_heads)
+            rel = (col[0, 0] - row_pos[:, :, None]).astype(jnp.float32)
+            base_mask = base_mask + (slopes[None, :, None, None]
+                                     * rel[:, None, :, :])
+
+        new_k, new_v = [], []
+        for i in range(cfg.num_layers):
+            mask = base_mask
+            if cfg.attn_windows is not None and cfg.attn_windows[i]:
+                w = cfg.attn_windows[i]
+                mask = mask + jnp.where(col > row_pos[:, None, :, None] - w,
+                                        0.0, neg)
+            x, (ck, cv) = UnifiedBlock(cfg, layer_idx=i, name=f"layer_{i}")(
+                x, mask, positions,
+                paged_cache=(kv_pools[0][i], kv_pools[1][i]),
+                block_tables=block_tables, write_pos=write_pos,
+                valid_len=valid_len)
+            new_k.append(ck)
+            new_v.append(cv)
+        new_pools = (jnp.stack(new_k), jnp.stack(new_v))
+
+        if cfg.final_norm:
+            x = _norm(cfg, "ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              name="lm_head")(x)
+        return logits.astype(jnp.float32), new_pools
+
+
 def init_kv_caches(cfg: TransformerConfig, batch_size: int, max_seq_len: int,
                    dtype=None):
     """Preallocated KV workspace for :class:`TransformerDecoderModel` (the
@@ -607,3 +703,14 @@ def init_kv_caches(cfg: TransformerConfig, batch_size: int, max_seq_len: int,
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch_size, max_seq_len, n_kv, head_dim)
     return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def init_paged_kv_pools(cfg: TransformerConfig, num_blocks: int,
+                        block_size: int, dtype=None):
+    """Shared K/V block pools for :class:`PagedTransformerDecoderModel`."""
+    from deepspeed_tpu.ops.paged_attention import init_paged_pool
+
+    n_kv = cfg.num_kv_heads or cfg.num_heads
+    head_dim = cfg.hidden_size // cfg.num_heads
+    return init_paged_pool(cfg.num_layers, num_blocks, block_size, n_kv,
+                           head_dim, dtype or cfg.dtype)

@@ -8,6 +8,7 @@ for custom models and for tests that pin down collective placement.
 """
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -26,7 +27,7 @@ def linear_layer(x, kernel, bias=None, *, mesh: Mesh, axis: str = TENSOR_AXIS):
 
     if bias is None:
         bias = jnp.zeros((kernel.shape[1],), dtype=kernel.dtype)
-    return jax.shard_map(local, mesh=mesh,
+    return shard_map(local, mesh=mesh,
                          in_specs=(P(), P(None, axis), P(axis)),
                          out_specs=P(None, None, axis))(x, kernel, bias)
 
@@ -45,7 +46,7 @@ def linear_allreduce(x, kernel, bias=None, *, mesh: Mesh,
 
     if bias is None:
         bias = jnp.zeros((kernel.shape[1],), dtype=kernel.dtype)
-    return jax.shard_map(local, mesh=mesh,
+    return shard_map(local, mesh=mesh,
                          in_specs=(P(None, None, axis), P(axis, None), P()),
                          out_specs=P())(x, kernel, bias)
 
@@ -67,6 +68,6 @@ def embedding_layer(ids, table, *, mesh: Mesh, axis: str = TENSOR_AXIS):
         out = tab_[safe] * ok[..., None].astype(tab_.dtype)
         return jax.lax.psum(out, axis)
 
-    return jax.shard_map(local, mesh=mesh,
+    return shard_map(local, mesh=mesh,
                          in_specs=(P(), P(axis, None)),
                          out_specs=P())(ids, table)

@@ -99,9 +99,21 @@ def _kernel_tiled_w8a8(x_ref, q_ref, o_ref, acc, *, nk: int):
 
 
 def quantize_per_row(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """[B, K] float → (xq int8, sx f32 [B, 1]) symmetric per row (per
-    token). The w8a8 activation-side quant — weight row scales must be
-    folded into x BEFORE this."""
+    """Symmetric per-row (per-token) activation quant over the LAST axis.
+
+    Contract: the contraction axis K must be LAST. Supported shapes are
+    ``[B, K]`` (the w8a8 decode kernel feed) and ``[B, T, K]`` (the w8a8
+    prefill feed — one scale per (batch, token) row), returning
+    ``(xq int8, sx f32)`` with ``sx`` shaped like ``x`` minus K plus a
+    trailing 1 (``[B, 1]`` / ``[B, T, 1]``) so ``dequant = y * sx``
+    broadcasts over the output features. Weight row scales must be folded
+    into ``x`` BEFORE this. Other ranks are rejected loudly — the
+    reduction is ``axis=-1``, so e.g. a [K]-vector or a 4-D tile layout
+    would quantize over the wrong axis and return garbage scales rather
+    than erroring downstream."""
+    assert x.ndim in (2, 3), (
+        f"quantize_per_row expects [B, K] or [B, T, K] (contraction axis "
+        f"last); got shape {x.shape}")
     x32 = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
     sx = jnp.maximum(amax, 1e-12) / 127.0

@@ -1,0 +1,197 @@
+"""Paged KV-cache primitives — block-table attention for the serving layer.
+
+Ragged Paged Attention (arXiv:2604.15464) style: instead of one dense
+``[B, S_max, n_kv, hd]`` workspace per decode slot, K/V live in a shared
+fixed-shape BLOCK POOL ``[num_blocks, block_size, n_kv, hd]`` and each slot
+owns an int32 block table mapping its logical token positions to pool
+blocks. Blocks are recycled when a sequence finishes, so HBM holds
+``sum(len_i)`` tokens instead of ``num_slots * S_max`` — the enabler for
+continuous batching (``deepspeed_tpu/inference/scheduler.py``).
+
+This module is the jnp REFERENCE implementation: the gather through the
+block table is an XLA gather and the attention core reuses
+``models.transformer.dot_product_attention`` semantics, exact-match tested
+against the dense-cache decode path on the CPU mesh
+(tests/unit/inference/test_paged_attention.py). A Pallas flash-style
+variant that never materializes the gathered K/V can slot in behind the
+same signatures later.
+
+Conventions:
+
+- Block id 0 is the NULL block — never allocated to a sequence; writes
+  from masked-out rows/tokens are steered there, so the scatter stays
+  static-shaped with no host-side branching.
+- ``block_tables``: int32 [B, W] (W = max blocks per slot, static);
+  unused entries are 0 and are harmless because attention masks every
+  column at or beyond the row's context length.
+- Pool arrays carry NO layer axis here; model code scans over a leading
+  layer axis and passes per-layer slices.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def blocks_for(num_tokens: int, block_size: int) -> int:
+    """Number of pool blocks covering ``num_tokens`` logical positions."""
+    return -(-num_tokens // block_size)
+
+
+def init_paged_pool(num_layers: int, num_blocks: int, block_size: int,
+                    n_kv: int, head_dim: int, dtype=jnp.float32,
+                    int8: bool = False):
+    """Layer-stacked K/V block pools.
+
+    Dense: ``(k_pool, v_pool)`` of [L, num_blocks, block_size, n_kv, hd].
+    ``int8`` (quant.kv_cache): 4-tuple ``(kq, kscale, vq, vscale)`` with
+    int8 payloads and per-(token, head) f32 scales [L, nb, bs, n_kv] —
+    the same per-row symmetric layout as the dense int8 cache
+    (models.llama.quantize_kv_heads), so the two paths share dequant math.
+    """
+    shape = (num_layers, num_blocks, block_size, n_kv, head_dim)
+    if int8:
+        sshape = shape[:-1]
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32),
+                jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32))
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def write_indices(block_tables: jnp.ndarray, write_pos: jnp.ndarray,
+                  T: int, block_size: int,
+                  valid_len: Optional[jnp.ndarray] = None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(block_ids [B, T], offsets [B, T]) for appending T tokens per row.
+
+    Token t of row b lands at logical position ``write_pos[b] + t`` →
+    pool slot ``(table[b, pos // bs], pos % bs)``. Tokens at or beyond
+    ``valid_len[b]`` (right-padding, inactive slots) are steered to the
+    null block (0, 0) instead — the scatter stays static-shaped and the
+    garbage never reads back because attention masks by context length.
+    """
+    B, W = block_tables.shape
+    pos = write_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    ok = jnp.ones((B, T), bool) if valid_len is None else \
+        (jnp.arange(T, dtype=jnp.int32)[None, :] < valid_len[:, None])
+    blk = jnp.clip(pos // block_size, 0, W - 1)
+    bids = jnp.take_along_axis(block_tables, blk, axis=1)
+    bids = jnp.where(ok, bids, 0)
+    offs = jnp.where(ok, pos % block_size, 0)
+    return bids, offs
+
+
+def paged_append(k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+                 k: jnp.ndarray, v: jnp.ndarray,
+                 block_tables: jnp.ndarray, write_pos: jnp.ndarray,
+                 valid_len: Optional[jnp.ndarray] = None):
+    """Scatter new K/V ([B, T, n_kv, hd]) into one layer's block pool.
+
+    The dense-cache analogue is ``lax.dynamic_update_slice`` at
+    ``cache_index``; here the write goes through the block table. Rows
+    whose blocks were allocated by the scheduler never collide; all
+    masked writes collapse onto the null block.
+    """
+    bids, offs = write_indices(block_tables, write_pos, k.shape[1],
+                               k_pool.shape[1], valid_len)
+    k_pool = k_pool.at[bids, offs].set(k)
+    v_pool = v_pool.at[bids, offs].set(v)
+    return k_pool, v_pool
+
+
+def paged_append_scales(scale_pool: jnp.ndarray, scales: jnp.ndarray,
+                        block_tables: jnp.ndarray, write_pos: jnp.ndarray,
+                        valid_len: Optional[jnp.ndarray] = None):
+    """int8-cache companion of :func:`paged_append` for the per-(token,
+    head) scale arrays: scale_pool [nb, bs, n_kv], scales [B, T, n_kv]."""
+    bids, offs = write_indices(block_tables, write_pos, scales.shape[1],
+                               scale_pool.shape[1], valid_len)
+    return scale_pool.at[bids, offs].set(scales)
+
+
+def paged_gather(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
+    """[nb, bs, ...] pool × [B, W] table → [B, W*bs, ...] per-slot view.
+
+    Column j of the result is logical position j of that slot (table
+    entry j // bs). Unused table entries read the null block; callers
+    mask those columns by context length.
+    """
+    g = pool[block_tables]                       # [B, W, bs, ...]
+    B, W, bs = g.shape[:3]
+    return g.reshape(B, W * bs, *g.shape[3:])
+
+
+def paged_context_mask(row_pos: jnp.ndarray, S: int) -> jnp.ndarray:
+    """Additive [B, 1, T, S] mask over the gathered-cache axis: query
+    token with absolute position p attends exactly the logical columns
+    ``<= p`` — identical semantics to the dense decode mask
+    (models.llama.decode_positions_and_mask) with attn_start=0, because
+    paged prompts are never left-padded (pad writes go to the null
+    block instead of occupying slots)."""
+    col = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
+    valid = col <= row_pos[:, None, :, None]
+    return jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
+
+
+def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+                    block_tables: jnp.ndarray, row_pos: jnp.ndarray,
+                    mask_extra: Optional[jnp.ndarray] = None,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Reference paged attention for one layer.
+
+    q: [B, T, H, hd] (already rotary-embedded); k_pool/v_pool:
+    [nb, bs, n_kv, hd]; row_pos: [B, T] absolute positions of the query
+    tokens (= context length before this call + arange(T)). K/V heads are
+    broadcast to H when grouped (GQA). ``mask_extra`` ([B|1, H|1, T, S])
+    adds architecture terms (ALiBi, local windows) on top of the causal
+    context mask. Exact-match vs the dense path: same fp32-softmax core,
+    same mask values, only the K/V layout differs.
+    """
+    k = paged_gather(k_pool, block_tables)       # [B, S, n_kv, hd]
+    v = paged_gather(v_pool, block_tables)
+    H = q.shape[2]
+    n_kv = k.shape[2]
+    if n_kv != H:
+        rep = H // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    mask = paged_context_mask(row_pos, k.shape[1])
+    if mask_extra is not None:
+        mask = mask + mask_extra
+    from deepspeed_tpu.models.transformer import dot_product_attention
+
+    return dot_product_attention(q, k, v, mask=mask, scale=scale)
+
+
+def paged_attention_int8(q: jnp.ndarray, kq_pool: jnp.ndarray,
+                         ks_pool: jnp.ndarray, vq_pool: jnp.ndarray,
+                         vs_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                         row_pos: jnp.ndarray) -> jnp.ndarray:
+    """Paged attention over an int8 block pool (quant.kv_cache).
+
+    Same math as the fused dense int8 path (FusedLlamaDecoderModel
+    ``attn_int8``): per-(token, head) scales factor out of both dots over
+    hd, so pool reads stay 1 byte/elem and dequant is a post-dot row
+    multiply; softmax stays fp32.
+    """
+    kq = paged_gather(kq_pool, block_tables)     # [B, S, n_kv, hd] int8
+    ks = paged_gather(ks_pool, block_tables)     # [B, S, n_kv] f32
+    vq = paged_gather(vq_pool, block_tables)
+    vs = paged_gather(vs_pool, block_tables)
+    H, hd = q.shape[2], q.shape[3]
+    n_kv = kq.shape[2]
+    if n_kv != H:
+        rep = H // n_kv
+        kq = jnp.repeat(kq, rep, axis=2)
+        ks = jnp.repeat(ks, rep, axis=2)
+        vq = jnp.repeat(vq, rep, axis=2)
+        vs = jnp.repeat(vs, rep, axis=2)
+    mask = paged_context_mask(row_pos, kq.shape[1])
+    qs = q * jnp.asarray(float(hd) ** -0.5, q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qs,
+                        kq.astype(q.dtype)).astype(jnp.float32)
+    scores = scores * ks.transpose(0, 2, 1)[:, :, None, :]
+    scores = scores + mask
+    weights = jax.nn.softmax(scores, axis=-1)
+    weights = (weights * vs.transpose(0, 2, 1)[:, :, None, :]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, vq.astype(q.dtype))

@@ -20,6 +20,7 @@ import functools
 from typing import Optional
 
 import jax
+from deepspeed_tpu.utils.jax_compat import axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -30,7 +31,7 @@ def ring_attention(q, k, v, *, causal: bool = True,
                    sm_scale: Optional[float] = None,
                    axis_name: str = "sequence"):
     """[B, S/P, H, D] per device → [B, S/P, H, D]."""
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, S_loc, H, D = q.shape
     if sm_scale is None:
@@ -113,7 +114,7 @@ def ring_flash_attention(q, k, v, causal=True, sm_scale=None,
 def _ring_flash_fwd_impl(q, k, v, causal, sm_scale, block_size, axis_name):
     from deepspeed_tpu.ops.flash_attention import _flash_fwd, _use_interpret
 
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, S_loc, H, D = q.shape
     if sm_scale is None:
@@ -198,7 +199,7 @@ def _ring_flash_bwd_rule(causal, sm_scale, block_size, axis_name,
     )
 
     q, k, v, out, lse_tot = residuals
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, S_loc, H, D = q.shape
     if sm_scale is None:

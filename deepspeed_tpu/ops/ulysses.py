@@ -14,6 +14,7 @@ Call inside shard_map with q/k/v sequence-sharded: [B, S/P, H, D].
 from typing import Optional
 
 import jax
+from deepspeed_tpu.utils.jax_compat import axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -37,7 +38,7 @@ def ulysses_attention(q, k, v, *, causal: bool = True,
     Requires H % P == 0 (heads divisible by the sequence-axis size), the
     same constraint DeepSpeed-Ulysses documents.
     """
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     H = q.shape[2]
     if H % P != 0:
         raise ValueError(f"num_heads {H} must be divisible by sequence axis {P}")

@@ -25,6 +25,7 @@ import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
+from deepspeed_tpu.utils.jax_compat import set_mesh
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -431,7 +432,7 @@ class DeepSpeedEngine:
         """Scoped ambient-mesh context: PartitionSpec-based sharding
         constraints (MoE dispatch, sequence parallel) resolve against this
         engine's mesh during tracing, without leaking a global mesh."""
-        return jax.set_mesh(self.mesh)
+        return set_mesh(self.mesh)
 
     # --- config accessors (reference engine.py exposes the same names) -------
     def train_batch_size(self) -> int:
@@ -774,15 +775,28 @@ class DeepSpeedEngine:
             def micro(carry, mb):
                 acc, loss_sum = carry
                 loss, grads = grad_step(params, mb, scale)
-                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                # the scan CARRY accumulates in fp32 even when
+                # grad_accum_dtype=bf16: each micro-grad arrives
+                # bf16-stored (grad_step's cast — the per-micro
+                # materialization stays cheap) but summing in bf16 loses
+                # one ulp per add, an error that GROWS with gas; fp32
+                # carry + one final cast bounds it at a single rounding
+                # (regression-pinned in tests/unit/test_engine.py)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
                 return (acc, loss_sum + loss), None
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, accum_dtype or jnp.float32), s),
+                    jnp.zeros(p.shape, jnp.float32), s),
                 params, grad_shardings)
             (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), batch)
-            grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
+            # the STORED tree keeps the configured accumulation dtype
+            # (grad_accum_dtype is a storage knob — the NVMe/grouped
+            # tiers bank this tree host-side)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g / gas).astype(accum_dtype)
+                if accum_dtype is not None else g / gas, acc)
             return loss_sum / gas, grads
 
         def train_batch_fn(params, opt_state, scaler_state, batch):
@@ -807,7 +821,7 @@ class DeepSpeedEngine:
             loss_ok = (jnp.isfinite(loss) if numerics else jnp.asarray(True))
             return loss, grads, gnorm, grads_ok, loss_ok
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             self._jit_loss = jax.jit(lambda p, b: loss_fn(p, b))
             self._jit_grad = jax.jit(grad_step)
             ts_out_sh = None

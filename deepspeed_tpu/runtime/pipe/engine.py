@@ -20,6 +20,7 @@ uniform balancing (parts from runtime/utils.partition_uniform).
 from typing import Any, Dict, Optional
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
@@ -105,7 +106,7 @@ def make_pipeline_lm_loss(cfg: LlamaConfig, mesh, num_micro: Optional[int] = Non
             count = lax.psum(count, "data")
             return loss_sum / jnp.maximum(count, 1)
 
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=mesh,
             in_specs=(PartitionSpec("pipe"), PartitionSpec(),
                       PartitionSpec("data"), PartitionSpec("data")),

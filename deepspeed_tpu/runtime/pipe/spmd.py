@@ -23,6 +23,7 @@ import functools
 from typing import Any, Callable
 
 import jax
+from deepspeed_tpu.utils.jax_compat import varying_cast, axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -46,7 +47,7 @@ def spmd_pipeline(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stage — they are rotated back around the ring so the result is replicated
     over the pipe axis).
     """
-    P = num_stages or lax.axis_size(axis_name)
+    P = num_stages or axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     T = M + P - 1
@@ -54,7 +55,7 @@ def spmd_pipeline(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     # mark the carries as device-varying over the pipe axis (their values
     # differ per stage once the ring starts turning)
     def _varying(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return varying_cast(x, (axis_name,))
 
     state = _varying(jnp.zeros_like(microbatches[0]))
     outputs = _varying(jnp.zeros_like(microbatches))

@@ -20,6 +20,7 @@ import contextlib
 from typing import Any, Callable, Optional
 
 import jax
+from deepspeed_tpu.utils.jax_compat import set_mesh
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.runtime.zero.stages import plan_zero_shardings
@@ -96,7 +97,7 @@ class Init:
         abstract = jax.eval_shape(fn, *args, **kwargs)
         plan = plan_zero_shardings(abstract, self.mesh, _ZeroConfigView(3),
                                    self.rules)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return jax.jit(fn,
                            out_shardings=plan.param_shardings)(*args, **kwargs)
 

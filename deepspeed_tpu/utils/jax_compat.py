@@ -1,0 +1,103 @@
+"""JAX API compatibility seam — one place per moved/renamed symbol.
+
+The codebase targets current JAX (``jax.shard_map``, ``jax.set_mesh``,
+``lax.pcast(..., to="varying")``/``lax.pvary``, ``jax.typeof``), but the
+deployed toolchain can lag (0.4.x still spells these
+``jax.experimental.shard_map.shard_map`` / ``with mesh:`` / no varying
+casts at all) and future bumps keep retiring the deprecated spellings —
+``jax.experimental.shard_map`` and ``lax.pvary`` both DeprecationWarning
+before removal. Every call site imports from HERE instead of probing
+``jax`` itself, so a version bump is a one-file change and the pytest
+``filterwarnings = error::DeprecationWarning`` entries scoped to the hot
+modules (pytest.ini) can stay on without churn.
+"""
+
+import contextlib
+
+import jax
+from jax import lax as _lax
+
+__all__ = ["shard_map", "set_mesh", "varying_cast", "vma_of", "HAS_VMA",
+           "axis_size"]
+
+
+# --- shard_map: jax.shard_map (new) / jax.experimental.shard_map (old) -------
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(*args, **kwargs):
+        """Old-jax shard_map with the new kwarg spelling accepted:
+        ``check_vma`` (vma-era) maps onto ``check_rep``."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_old(*args, **kwargs)
+
+
+# --- mesh context: jax.set_mesh (new) / `with mesh:` (old) -------------------
+if hasattr(jax, "set_mesh"):
+    set_mesh = jax.set_mesh
+else:  # pragma: no cover - exercised only on older jax
+    def set_mesh(mesh):
+        """On pre-set_mesh jax, Mesh itself is the context manager."""
+        return mesh if mesh is not None else contextlib.nullcontext()
+
+
+# --- varying-manual-axes casts ------------------------------------------------
+# jax >= 0.7: lax.pcast(x, axes, to="varying"); the pvary spelling
+# deprecation-warns before removal; pre-vma jax has neither AND does not
+# track vma types, so the cast is a no-op there by construction.
+HAS_VMA = hasattr(_lax, "pcast") or hasattr(_lax, "pvary")
+
+if hasattr(_lax, "pcast"):
+    def varying_cast(x, axes):
+        return _lax.pcast(x, tuple(axes), to="varying")
+elif hasattr(_lax, "pvary"):  # pragma: no cover - mid-window jax
+    def varying_cast(x, axes):
+        return _lax.pvary(x, tuple(axes))
+else:  # pragma: no cover - pre-vma jax
+    def varying_cast(x, axes):
+        return x
+
+
+def vma_of(x):
+    """The varying-manual-axes set of a traced value; empty on jax
+    without vma typing (where everything is implicitly varying)."""
+    if hasattr(jax, "typeof"):
+        return set(getattr(jax.typeof(x), "vma", ()) or ())
+    return set()
+
+
+# --- axis_size: lax.axis_size (new) / psum(1, axis) (old) --------------------
+if hasattr(_lax, "axis_size"):
+    axis_size = _lax.axis_size
+else:  # pragma: no cover - exercised only on older jax
+    def axis_size(axis_name):
+        """Mapped-axis size inside shard_map/pmap on jax without
+        lax.axis_size: the env records it statically, so psum of a
+        constant folds to the size at trace time."""
+        return _lax.psum(1, axis_name)
+
+
+# --- ambient mesh: jax.sharding.get_abstract_mesh (new) / thread mesh (old) --
+def get_abstract_mesh():
+    """The ambient mesh set by :func:`set_mesh`, or None. On pre-
+    abstract-mesh jax the `with mesh:` context registers a physical mesh
+    in thread resources; both expose .axis_names/.shape as used here."""
+    try:
+        from jax.sharding import get_abstract_mesh as _gam
+
+        return _gam()
+    except ImportError:  # pragma: no cover - exercised only on older jax
+        from jax._src.mesh import thread_resources
+
+        m = thread_resources.env.physical_mesh
+        return m if m.axis_names else None
+
+
+# shard_map kwargs for call sites that are vma-clean on current jax but
+# trip the legacy check_rep machinery (no replication rules for the
+# newer primitives/patterns) on pre-vma jax: disable the legacy checker
+# there, keep full vma checking where it exists.
+LEGACY_SHARD_MAP_KW = {} if HAS_VMA else {"check_vma": False}

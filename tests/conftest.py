@@ -55,3 +55,16 @@ def pp2_dp2_tp2_mesh(devices):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-mark the tier-2 set ``slow`` (see tests/tier2_slow.py): the
+    default tier-1 run excludes `slow` to stay inside its 870 s CI
+    window; `pytest -m slow` runs the tier-2 set explicitly."""
+    from tests.tier2_slow import TIER2_SLOW, TIER2_SLOW_FILES
+
+    for item in items:
+        nodeid = item.nodeid.replace("\\", "/")
+        if nodeid in TIER2_SLOW or \
+                nodeid.split("::")[0] in TIER2_SLOW_FILES:
+            item.add_marker(pytest.mark.slow)

@@ -314,7 +314,7 @@ def test_tiled_prefill_einsum_path_matches_dense():
     assert rel < 0.08, rel
     # the tiled w8a8 prefill branch (size-gated off for these tiny
     # weights) must also track dense
-    dec8 = FusedLlamaDecoderModel(cfg)
+    dec8 = FusedLlamaDecoderModel(cfg, w8a8_prefill=True)
     dec8.w8a8_min_weight_numel = 0
     ql8, _ = dec8.apply({"params": qtree}, ids, caches, 0)
     rel8 = np.abs(d - np.asarray(ql8, np.float64)).max() / (
@@ -341,8 +341,7 @@ def test_w8a8_prefill_rowmajor_matches_dense():
     # premise: these shapes stayed row-major (2D q + stacked-layer dim)
     assert qtree["blocks"]["block"]["qkv_proj"]["q"].ndim == 3
     caches = init_kv_caches(cfg, 2, 64)
-    dec = FusedLlamaDecoderModel(cfg)
-    assert dec.w8a8_prefill
+    dec = FusedLlamaDecoderModel(cfg, w8a8_prefill=True)   # opt-in knob
     dec.w8a8_min_weight_numel = 0      # tiny weights: force the a8 branch
     dl, _ = dec.apply({"params": fused}, ids, caches, 0)
     ql, _ = dec.apply({"params": qtree}, ids, caches, 0)
@@ -421,7 +420,9 @@ def test_fused_mlp_decode_matches_two_kernel():
 def test_retile_gateup_for_fused_mlp_offline_tree():
     """Offline checkpoints tiled at the default panel can have an ODD
     gateup panel count (7B: 43) — the engine's one-time re-lay halves
-    the panel so the fused kernel can engage, without requantizing."""
+    the panel so the fused kernel can engage, without requantizing.
+    PURE: the caller's tree must come back untouched (other engine-side
+    transforms may still hold it)."""
     from deepspeed_tpu.models.llama import retile_gateup_for_fused_mlp
     from deepspeed_tpu.ops.int8_matmul import quantize_rowwise, tile_rowwise
 
@@ -431,12 +432,34 @@ def test_retile_gateup_for_fused_mlp_offline_tree():
     q, s = quantize_rowwise(w)
     qt, st = tile_rowwise(q, s, block_n=512)
     assert qt.shape[1] == 3                # odd — ineligible as-is
-    tree = {"gateup_proj": {"q": qt, "scale": st}}
-    retile_gateup_for_fused_mlp(tree)
-    q2 = tree["gateup_proj"]["q"]
+    other = {"q": qt + 0, "scale": st + 0}
+    tree = {"gateup_proj": {"q": qt, "scale": st}, "down_proj": other}
+    out = retile_gateup_for_fused_mlp(tree)
+    q2 = out["gateup_proj"]["q"]
     assert q2.shape[1] == 6 and q2.shape[3] == 256, q2.shape
     # geometry-only: untiling both layouts gives the identical matrix
     def untile(t):
         nk, nn, bk, bn = t.shape
         return np.asarray(t.transpose(0, 2, 1, 3).reshape(nk * bk, nn * bn))
     np.testing.assert_array_equal(untile(qt), untile(q2))
+    # the INPUT tree is untouched: same leaf objects, original layout
+    assert tree["gateup_proj"]["q"] is qt
+    assert tree["gateup_proj"]["scale"] is st
+    assert tree["gateup_proj"]["q"].shape == (1, 3, 256, 512)
+    # unaffected subtrees are shared by reference, not copied
+    assert out["down_proj"] is other
+
+
+def test_retile_gateup_noop_shares_tree():
+    """A tree with no eligible gateup leaf passes through unchanged —
+    ideally as the SAME object (no copies on the no-op path)."""
+    from deepspeed_tpu.models.llama import retile_gateup_for_fused_mlp
+    from deepspeed_tpu.ops.int8_matmul import quantize_rowwise, tile_rowwise
+
+    rng = np.random.default_rng(10)
+    w = jnp.asarray(rng.normal(0, 0.1, (256, 1024)), jnp.float32)
+    q, s = quantize_rowwise(w)
+    qt, st = tile_rowwise(q, s, block_n=512)
+    assert qt.shape[1] % 2 == 0            # even: already eligible
+    tree = {"gateup_proj": {"q": qt, "scale": st}}
+    assert retile_gateup_for_fused_mlp(tree) is tree

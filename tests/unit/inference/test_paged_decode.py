@@ -1,0 +1,184 @@
+"""Paged-KV decode parity: the paged twins must reproduce the dense-cache
+decode paths exactly (acceptance: exact greedy token parity on the CPU
+mesh for models/llama.py AND models/unified.py, tolerance-bounded for the
+int8 KV cache)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.llama import (
+    FusedLlamaDecoderModel, LlamaConfig, LlamaDecoderModel, LlamaModel,
+    PagedLlamaDecoderModel, fuse_decode_params, init_kv_caches,
+    init_paged_kv_pools,
+)
+from deepspeed_tpu.models.unified import (
+    PagedTransformerDecoderModel, TransformerConfig, TransformerDecoderModel,
+    TransformerLM,
+)
+from deepspeed_tpu.models.unified import (
+    init_kv_caches as unified_kv_caches,
+    init_paged_kv_pools as unified_pools,
+)
+
+BS = 4                                           # block size under test
+
+
+def _tables(B, W, contiguous=False):
+    """Per-slot block tables; deliberately NON-contiguous interleaved ids
+    unless asked otherwise — parity must not depend on block adjacency."""
+    ids = np.arange(1, B * W + 1, dtype=np.int32)
+    if not contiguous:
+        ids = ids.reshape(W, B).T.reshape(-1)    # interleave across slots
+    return jnp.asarray(ids.reshape(B, W))
+
+
+def greedy_paged(apply_fn, params, pools, bt, prompt, steps):
+    """Greedy decode through a paged apply: prefill then step tokens."""
+    B, T = prompt.shape
+    logits, pools = apply_fn(params, prompt, pools, bt,
+                             jnp.zeros(B, jnp.int32), None)
+    toks = [jnp.argmax(logits[:, -1], -1).astype(jnp.int32)]
+    for i in range(steps - 1):
+        logits, pools = apply_fn(params, toks[-1][:, None], pools, bt,
+                                 jnp.full(B, T + i, jnp.int32), None)
+        toks.append(jnp.argmax(logits[:, 0], -1).astype(jnp.int32))
+    return np.stack([np.asarray(t) for t in toks], 1)
+
+
+def greedy_dense(apply_fn, params, caches, prompt, steps):
+    B, T = prompt.shape
+    logits, caches = apply_fn(params, prompt, caches,
+                              jnp.asarray(0, jnp.int32))
+    toks = [jnp.argmax(logits[:, -1], -1).astype(jnp.int32)]
+    for i in range(steps - 1):
+        logits, caches = apply_fn(params, toks[-1][:, None], caches,
+                                  jnp.asarray(T + i, jnp.int32))
+        toks.append(jnp.argmax(logits[:, 0], -1).astype(jnp.int32))
+    return np.stack([np.asarray(t) for t in toks], 1)
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_paged_llama_decoder_matches_dense(scan):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=scan)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 256, (2, 9)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    dense = LlamaDecoderModel(cfg)
+    caches = init_kv_caches(cfg, 2, 24, jnp.float32)
+    ref = greedy_dense(
+        lambda p, t, c, i: dense.apply({"params": p}, t, c, i),
+        params, caches, ids, 8)
+
+    paged = PagedLlamaDecoderModel(cfg)
+    pools = init_paged_kv_pools(cfg, num_blocks=2 * 6 + 1, block_size=BS,
+                                dtype=jnp.float32)
+    got = greedy_paged(
+        lambda p, t, pools, bt, wp, vl: paged.apply(
+            {"params": p}, t, pools, bt, wp, vl),
+        params, pools, _tables(2, 6), ids, 8)
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("kv8", [False, True])
+def test_fused_paged_matches_fused_dense(kv8):
+    """FusedLlamaDecoderModel.apply_paged vs .apply — greedy-exact (bf16
+    pools excluded here: fp32 end-to-end), int8 KV exact too since both
+    paths share quantize_kv_heads math."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 256, (2, 7)))
+    params = model.init(jax.random.PRNGKey(1), ids)["params"]
+    fused = jax.jit(lambda p: fuse_decode_params(p, cfg))(params)
+    dec = FusedLlamaDecoderModel(cfg)
+
+    caches = init_kv_caches(cfg, 2, 24, jnp.float32, int8=kv8)
+    ref = greedy_dense(
+        lambda p, t, c, i: dec.apply({"params": p}, t, c, i),
+        fused, caches, ids, 8)
+
+    pools = init_paged_kv_pools(cfg, num_blocks=13, block_size=BS,
+                                dtype=jnp.float32, int8=kv8)
+    got = greedy_paged(
+        lambda p, t, pools, bt, wp, vl: dec.apply_paged(
+            {"params": p}, t, pools, bt, wp, vl),
+        fused, pools, _tables(2, 6), ids, 8)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_fused_paged_int8_kv_logits_close_to_fp():
+    """int8 paged pools vs fp dense cache: tolerance-bounded logits."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, 256, (1, 10)))
+    params = model.init(jax.random.PRNGKey(2), ids)["params"]
+    fused = jax.jit(lambda p: fuse_decode_params(p, cfg))(params)
+    dec = FusedLlamaDecoderModel(cfg)
+
+    caches = init_kv_caches(cfg, 1, 16, jnp.float32)
+    fl, _ = dec.apply({"params": fused}, ids, caches,
+                      jnp.asarray(0, jnp.int32))
+    pools = init_paged_kv_pools(cfg, num_blocks=5, block_size=BS,
+                                dtype=jnp.float32, int8=True)
+    pl, _ = dec.apply_paged({"params": fused}, ids, pools, _tables(1, 4),
+                            jnp.zeros(1, jnp.int32))
+    f, p = np.asarray(fl, np.float64), np.asarray(pl, np.float64)
+    rel = np.abs(f - p).max() / (np.abs(f).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+@pytest.mark.parametrize("kw", [
+    {},                                                    # learned (GPT-2)
+    {"pos_emb": "rotary", "parallel_attn": True,
+     "tie_embeddings": False},                             # GPT-J-ish
+    {"pos_emb": "alibi", "norm": "rmsnorm"},               # BLOOM-ish
+    {"attn_windows": (2, None)},                           # GPT-Neo local
+    {"num_kv_heads": 2},                                   # GQA
+])
+def test_paged_unified_matches_dense(kw):
+    cfg = TransformerConfig.tiny(**kw)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 256, (2, 8)))
+    params = model.init(jax.random.PRNGKey(3), ids)["params"]
+
+    dense = TransformerDecoderModel(cfg)
+    caches = unified_kv_caches(cfg, 2, 24)
+    ref = greedy_dense(
+        lambda p, t, c, i: dense.apply({"params": p}, t, c, i),
+        params, caches, ids, 6)
+
+    paged = PagedTransformerDecoderModel(cfg)
+    pools = unified_pools(cfg, num_blocks=13, block_size=BS)
+    got = greedy_paged(
+        lambda p, t, pools, bt, wp, vl: paged.apply(
+            {"params": p}, t, pools, bt, wp, vl),
+        params, pools, _tables(2, 6), ids, 6)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_paged_right_padded_prefill_matches_exact():
+    """valid_len right-padding: a padded prefill's logits at the last
+    REAL token equal the unpadded forward (pads write to the null block,
+    never occupy cache slots)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(4)
+    ids = jnp.asarray(rng.integers(0, 256, (1, 6)))
+    params = model.init(jax.random.PRNGKey(4), ids)["params"]
+    full = model.apply({"params": params}, ids)
+
+    paged = PagedLlamaDecoderModel(cfg)
+    pools = init_paged_kv_pools(cfg, num_blocks=5, block_size=BS,
+                                dtype=jnp.float32)
+    padded = jnp.pad(ids, ((0, 0), (0, 6)))      # T=12, true length 6
+    logits, pools = paged.apply({"params": params}, padded, pools,
+                                _tables(1, 4), jnp.zeros(1, jnp.int32),
+                                jnp.asarray([6], jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits[:, 5]),
+                               np.asarray(full[:, 5]), rtol=1e-4, atol=1e-4)

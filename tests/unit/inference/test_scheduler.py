@@ -1,0 +1,263 @@
+"""Continuous-batching scheduler unit tests over a FAKE executor — the
+admission/recycling/backpressure/sampling-isolation contract, with no
+model or compilation in the loop (acceptance checklist: mid-stream
+admission into a freed slot, block recycling after completion,
+pool-exhaustion backpressure, per-slot sampling-state isolation)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_pool import (
+    BlockPool, SlotBlockTables, blocks_for,
+)
+from deepspeed_tpu.inference.scheduler import (
+    Completion, ContinuousBatchingScheduler, Request,
+)
+
+
+class FakeExecutor:
+    """Deterministic executor: token = rid * 100 + step; records every
+    call so tests can assert WHAT the scheduler asked for."""
+
+    def __init__(self):
+        self.slot_reqs = {}                      # slot -> rid (latest)
+        self.slot_history = []                   # (slot, rid) bind order
+        self.prefills = []
+        self.decode_calls = []
+
+    def set_slot(self, slot, req):
+        self.slot_reqs[slot] = req
+        self.slot_history.append((slot, req.rid))
+
+    def prefill(self, slot, prompt, block_row):
+        self.prefills.append((slot, len(prompt), block_row.copy()))
+        return self.slot_reqs[slot].rid * 100
+
+    def decode(self, tokens, block_tables, seq_lens, active, steps_left,
+               max_steps=None):
+        self.decode_calls.append((tokens.copy(), active.copy(),
+                                  steps_left.copy(), max_steps))
+        out = np.zeros((len(tokens), 1), np.int32)
+        for s in range(len(tokens)):
+            if active[s]:
+                req = self.slot_reqs[s]
+                step = tokens[s] % 100 + 1
+                out[s, 0] = req.rid * 100 + step
+        return out
+
+
+def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6):
+    ex = FakeExecutor()
+    pool = BlockPool(num_blocks, block_size)
+    return ContinuousBatchingScheduler(ex, num_slots, pool, width), ex, pool
+
+
+def req(rid, plen=4, gen=3, **kw):
+    return Request(rid=rid, prompt=np.arange(1, plen + 1),
+                   max_new_tokens=gen, **kw)
+
+
+def drain(sched, max_steps=500):
+    out = []
+    for _ in range(max_steps):
+        if not sched.busy:
+            return out
+        out.extend(sched.step())
+    raise AssertionError("scheduler did not drain")
+
+
+def test_basic_completion_and_token_stream():
+    sched, ex, pool = make_sched()
+    sched.submit(req(1, plen=4, gen=3))
+    comps = drain(sched)
+    assert len(comps) == 1
+    c = comps[0]
+    assert c.rid == 1
+    # prefill token 100, then decode tokens 101, 102
+    np.testing.assert_array_equal(c.tokens, [100, 101, 102])
+    assert pool.num_free == pool.num_blocks - 1    # all recycled
+
+
+def test_mid_stream_admission_into_freed_slot():
+    """With both slots busy, a queued request must be admitted the step
+    after a slot frees — while the other slot keeps decoding."""
+    sched, ex, pool = make_sched(num_slots=2)
+    sched.submit(req(1, gen=2))                  # finishes fast
+    sched.submit(req(2, gen=10))                 # long-running
+    sched.submit(req(3, gen=6))                  # queued: both slots busy
+    comps = []
+    comps.extend(sched.step())                   # admits 1 and 2; queue: 3
+    assert ex.slot_history == [(0, 1), (1, 2)]
+    assert [r.rid for r in sched.queue] == [3]
+    while not any(c.rid == 1 for c in comps):
+        comps.extend(sched.step())
+    # rid 1 done, rid 2 still active; next step admits rid 3 into slot 0
+    assert sched.active.sum() == 1               # rid 2 decoding
+    comps.extend(sched.step())
+    assert not sched.queue                       # 3 admitted mid-stream
+    assert ex.slot_history[-1] == (0, 3)         # into the freed slot
+    assert sched.active.sum() == 2               # 2 and 3 both decoding
+    comps.extend(drain(sched))
+    # rid 2's stream was never disturbed by the admission
+    c2 = next(c for c in comps if c.rid == 2)
+    np.testing.assert_array_equal(c2.tokens, 200 + np.arange(10))
+    c3 = next(c for c in comps if c.rid == 3)
+    np.testing.assert_array_equal(c3.tokens, 300 + np.arange(6))
+
+
+def test_block_recycling_after_completion():
+    sched, ex, pool = make_sched(num_slots=1, num_blocks=5, block_size=4)
+    # each request needs blocks_for(4+4)=2 blocks; pool has 4 usable
+    free0 = pool.num_free
+    sched.submit(req(1, plen=4, gen=4))
+    sched.step()
+    assert pool.num_free == free0 - 2
+    drain(sched)
+    assert pool.num_free == free0                # recycled on completion
+    # the SAME physical blocks serve the next request
+    sched.submit(req(2, plen=4, gen=4))
+    sched.step()
+    assert pool.num_free == free0 - 2
+    drain(sched)
+
+
+def test_pool_exhaustion_backpressure_queues_not_crashes():
+    # 1 slot's worth of capacity only: 2 concurrent requests cannot fit
+    sched, ex, pool = make_sched(num_slots=2, num_blocks=3, block_size=4)
+    sched.submit(req(1, plen=4, gen=4))          # needs 2 blocks (all)
+    sched.submit(req(2, plen=4, gen=4))          # must WAIT in queue
+    sched.step()
+    assert sched.active.sum() == 1 and len(sched.queue) == 1
+    comps = drain(sched)                         # finishes both eventually
+    assert sorted(c.rid for c in comps) == [1, 2]
+    # strict FIFO held under pressure
+    assert [c.rid for c in comps] == [1, 2]
+
+
+def test_submit_rejects_request_larger_than_slot():
+    sched, ex, pool = make_sched(width=2, block_size=4)
+    with pytest.raises(ValueError, match="blocks"):
+        sched.submit(req(1, plen=8, gen=8))      # needs 4 > width 2
+
+
+def test_submit_rejects_request_larger_than_pool():
+    """A request that could never be satisfied even by a fully drained
+    pool must be rejected at submit — queueing it would hang the FIFO
+    (backpressure waits for recycling that can never suffice)."""
+    sched, ex, pool = make_sched(num_blocks=3, block_size=4, width=6)
+    with pytest.raises(ValueError, match="num_blocks"):
+        sched.submit(req(1, plen=8, gen=8))      # needs 4 > 2 usable
+    # and the scheduler is still serviceable afterwards
+    sched.submit(req(2, plen=4, gen=4))
+    assert [c.rid for c in drain(sched)] == [2]
+
+
+def test_per_slot_sampling_state_isolation():
+    """Each admission re-binds the slot's sampling state BEFORE its
+    prefill; a recycled slot must carry the new request's state, and the
+    co-resident slot's binding must be untouched."""
+    sched, ex, pool = make_sched(num_slots=2)
+    sched.submit(req(1, gen=2, temperature=0.7, top_k=5, seed=11))
+    sched.submit(req(2, gen=8, temperature=0.0, seed=22))
+    sched.submit(req(3, gen=2, temperature=0.9, top_p=0.5, seed=33))
+    comps = drain(sched)
+    # slot 0 served rid 1 then rid 3: bindings in that order
+    assert ex.slot_history[0] == (0, 1)
+    assert ex.slot_history[1] == (1, 2)
+    assert ex.slot_history[2] == (0, 3)          # recycled slot re-bound
+    assert ex.slot_reqs[0].temperature == 0.9    # rid 3's state, not rid 1's
+    assert ex.slot_reqs[1].seed == 22            # rid 2 untouched throughout
+
+
+def test_eos_truncates_and_finishes():
+    class EosExec(FakeExecutor):
+        def decode(self, tokens, bt, seq_lens, active, steps_left,
+                   max_steps=None):
+            out = super().decode(tokens, bt, seq_lens, active, steps_left,
+                                 max_steps)
+            for s in range(len(tokens)):
+                if active[s] and out[s, 0] % 100 == 2:
+                    out[s, 0] = 999              # eos at the 3rd token
+            return out
+
+    ex = EosExec()
+    pool = BlockPool(17, 4)
+    sched = ContinuousBatchingScheduler(ex, 1, pool, 6)
+    sched.submit(req(1, gen=10, eos_id=999))
+    comps = drain(sched)
+    np.testing.assert_array_equal(comps[0].tokens, [100, 101, 999])
+    assert pool.num_free == pool.num_blocks - 1
+
+
+def test_chunked_executor_overshoot_ignored():
+    """An executor returning more steps than a slot's budget: extras are
+    discarded, seq accounting stays exact."""
+    class ChunkExec(FakeExecutor):
+        def decode(self, tokens, bt, seq_lens, active, steps_left,
+                   max_steps=None):
+            n = 4                                 # always 4 steps
+            out = np.zeros((len(tokens), n), np.int32)
+            for s in range(len(tokens)):
+                if active[s]:
+                    base = tokens[s] % 100
+                    rid = self.slot_reqs[s].rid
+                    out[s] = [rid * 100 + base + i + 1 for i in range(n)]
+            return out
+
+    ex = ChunkExec()
+    sched = ContinuousBatchingScheduler(ex, 1, BlockPool(17, 4), 6)
+    sched.submit(req(1, gen=6))                  # 1 prefill + 5 decode
+    comps = drain(sched)
+    np.testing.assert_array_equal(comps[0].tokens, 100 + np.arange(6))
+
+
+def test_decode_step_cap_stops_at_next_completion_when_queued():
+    """While the queue holds work, decode calls are capped at the
+    earliest slot completion so a freed slot never idles to a chunk
+    boundary."""
+    sched, ex, pool = make_sched(num_slots=2)
+    sched.submit(req(1, gen=3))
+    sched.submit(req(2, gen=20))
+    sched.submit(req(3, gen=2))                  # queued
+    sched.step()
+    # rid1 has 2 decode steps left, rid2 has 19 → cap must be 2
+    assert ex.decode_calls[-1][3] == 2
+    drain(sched)
+    # with an empty queue the cap is released (None)
+    ex2 = FakeExecutor()
+    s2 = ContinuousBatchingScheduler(ex2, 2, BlockPool(17, 4), 6)
+    s2.submit(req(9, gen=5))
+    s2.step()
+    assert ex2.decode_calls[-1][3] is None
+
+
+def test_arrival_time_gating_fifo():
+    """Future arrivals are not admitted early, and FIFO order holds:
+    a not-yet-arrived head blocks later arrivals (predictable order)."""
+    sched, ex, pool = make_sched(num_slots=2)
+    sched.submit(req(1, gen=2, arrival_time=0.0), now=0.0)
+    sched.submit(req(2, gen=2, arrival_time=1e9), now=0.0)   # far future
+    sched.submit(req(3, gen=2, arrival_time=0.0), now=0.0)
+    sched.step(now=1.0)
+    assert ex.slot_history == [(0, 1)]           # 2 not due; 3 blocked FIFO
+    assert [r.rid for r in sched.queue] == [2, 3]
+
+
+def test_block_pool_accounting_guards():
+    pool = BlockPool(5, 4)
+    ids = pool.allocate(2)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(ids + ids[:1])                 # frees once, then dups
+    with pytest.raises(ValueError, match="null block"):
+        pool.free([0])
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.allocate(99)
+    tables = SlotBlockTables(2, 3, pool)
+    with pytest.raises(ValueError, match="wide"):
+        tables.assign(0, 100)
+
+
+def test_blocks_for():
+    assert blocks_for(1, 4) == 1
+    assert blocks_for(4, 4) == 1
+    assert blocks_for(5, 4) == 2

@@ -110,3 +110,32 @@ def test_pick_tile_block_n():
     assert pick_tile_block_n(4608) == 512
     assert pick_tile_block_n(32000) == 256     # vocab head
     assert pick_tile_block_n(192) is None      # tiny test configs
+
+
+def test_quantize_per_row_contract(rng):
+    """Last-axis contract: [B, K] and [B, T, K] quantize per leading row
+    with broadcastable scales; other ranks are rejected loudly (the 3-D
+    prefill call used to work by accident — now it is part of the
+    documented surface)."""
+    from deepspeed_tpu.ops.int8_matmul import quantize_per_row
+
+    x2 = jnp.asarray(rng.normal(0, 3.0, (4, 64)), jnp.float32)
+    q2, s2 = quantize_per_row(x2)
+    assert q2.shape == (4, 64) and s2.shape == (4, 1)
+    np.testing.assert_allclose(np.asarray(q2 * s2), np.asarray(x2),
+                               atol=float(s2.max()))
+
+    x3 = jnp.asarray(rng.normal(0, 3.0, (2, 5, 64)), jnp.float32)
+    q3, s3 = quantize_per_row(x3)
+    assert q3.shape == (2, 5, 64) and s3.shape == (2, 5, 1)
+    # each (batch, token) row quantizes independently — identical to the
+    # 2-D path on the flattened rows
+    qf, sf = quantize_per_row(x3.reshape(10, 64))
+    np.testing.assert_array_equal(np.asarray(q3).reshape(10, 64),
+                                  np.asarray(qf))
+    np.testing.assert_allclose(np.asarray(s3).reshape(10, 1),
+                               np.asarray(sf))
+
+    for bad in (jnp.ones((64,)), jnp.ones((2, 2, 2, 64))):
+        with pytest.raises(AssertionError, match="contraction axis"):
+            quantize_per_row(bad)

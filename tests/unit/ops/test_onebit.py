@@ -7,7 +7,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 from deepspeed_tpu.ops.onebit import (
     OnebitAdamState, _ErrorState, compressed_allreduce, error_buffers,

@@ -38,7 +38,7 @@ mesh = Mesh(np.asarray(jax.devices()[:1]), ("sequence",))
 spec = P(None, "sequence", None, None)
 
 def loss(q, k, v):
-    out = jax.shard_map(
+    out = shard_map(
         lambda q_, k_, v_: ring_flash_attention(q_, k_, v_, True),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
     return (out * out).mean(), out

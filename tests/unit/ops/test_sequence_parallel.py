@@ -1,6 +1,7 @@
 """Sequence-parallel attention tests: Ulysses + ring vs full attention."""
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -28,7 +29,7 @@ def test_ulysses_matches_full(sp_mesh, rng, causal):
     q, k, v = _qkv(rng)
     ref = _reference_attention(q, k, v, causal, 1.0 / 4.0)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, causal=causal),
         mesh=sp_mesh,
         in_specs=(P(None, "sequence"),) * 3,
@@ -43,7 +44,7 @@ def test_ring_matches_full(sp_mesh, rng, causal):
     q, k, v = _qkv(rng)
     ref = _reference_attention(q, k, v, causal, 1.0 / 4.0)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, causal=causal),
         mesh=sp_mesh,
         in_specs=(P(None, "sequence"),) * 3,
@@ -58,7 +59,7 @@ def test_ring_differentiable(sp_mesh, rng):
     sm = 1.0 / np.sqrt(8)
 
     def loss_ring(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q, k, v: ring_attention(q, k, v, causal=True),
             mesh=sp_mesh, in_specs=(P(None, "sequence"),) * 3,
             out_specs=P(None, "sequence"))(q, k, v)
@@ -77,7 +78,7 @@ def test_ring_differentiable(sp_mesh, rng):
 def test_ulysses_head_divisibility(sp_mesh, rng):
     q, k, v = _qkv(rng, H=3)  # 3 heads not divisible by seq axis 4
     with pytest.raises(Exception):
-        jax.jit(jax.shard_map(
+        jax.jit(shard_map(
             lambda q, k, v: ulysses_attention(q, k, v),
             mesh=sp_mesh, in_specs=(P(None, "sequence"),) * 3,
             out_specs=P(None, "sequence")))(q, k, v)
@@ -91,7 +92,7 @@ def test_ring_flash_matches_full(sp_mesh, rng, causal):
 
     q, k, v = _qkv(rng)
     ref = _reference_attention(q, k, v, causal, 1.0 / 4.0)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v: ring_flash_attention(q, k, v, causal, None, 8),
         mesh=sp_mesh,
         in_specs=(P(None, "sequence"),) * 3,
@@ -112,7 +113,7 @@ def test_ring_flash_grads_match_full(sp_mesh, rng, causal):
     ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
 
     def loss_ring(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q, k, v: ring_flash_attention(q, k, v, causal, None, 8),
             mesh=sp_mesh, in_specs=(P(None, "sequence"),) * 3,
             out_specs=P(None, "sequence"))(q, k, v)
@@ -139,7 +140,7 @@ def test_ring_flash_unaligned_shard(sp_mesh, rng):
     ref = _reference_attention(q, k, v, True, 1.0 / 4.0)
 
     def loss(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q, k, v: ring_flash_attention(q, k, v, True, None, 8),
             mesh=sp_mesh, in_specs=(P(None, "sequence"),) * 3,
             out_specs=P(None, "sequence"))(q, k, v)

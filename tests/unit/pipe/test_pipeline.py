@@ -2,6 +2,7 @@
 (reference tests/unit/runtime/pipe/)."""
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -101,7 +102,7 @@ def test_spmd_pipeline_matches_sequential(n_pipe, n_micro):
     def pipelined(params, x):
         return spmd_pipeline(_block_apply, params, x, axis_name="pipe")
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         pipelined, mesh=mesh,
         in_specs=({"w": P("pipe"), "b": P("pipe")}, P()),
         out_specs=P()))
@@ -123,7 +124,7 @@ def test_spmd_pipeline_differentiable():
             out = spmd_pipeline(_block_apply, p, xx, axis_name="pipe")
             return ((out ** 2).mean())
 
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=mesh,
             in_specs=({"w": P("pipe"), "b": P("pipe")}, P()),
             out_specs=P())(params, x)

@@ -134,7 +134,7 @@ def test_safe_get_set_full_param_and_state():
 
 
 def test_coalesced_collectives(dp8_mesh):
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     import deepspeed_tpu.comm as dist
 

@@ -1,6 +1,7 @@
 """Collective-verb numerics vs numpy (reference tests/unit/comm/test_dist.py)."""
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -10,7 +11,7 @@ import deepspeed_tpu.comm as dist
 
 
 def _run(mesh, fn, x, in_spec, out_spec):
-    shard = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
+    shard = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
     return np.asarray(shard(x))
 
 

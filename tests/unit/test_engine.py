@@ -469,13 +469,32 @@ def test_grad_accum_dtype_bf16_trajectory_parity():
 
 
 def test_grad_accum_dtype_bf16_gas_scan_runs():
-    """gas>1: the scan accumulator itself runs at the accum dtype (the
-    documented fidelity trade) — must still train."""
+    """gas>1: the STORED micro-grads are bf16 but the scan carry
+    accumulates fp32 (one final cast, bounded error) — must still
+    train."""
     eng, rng = make_engine(stage=1, gradient_accumulation_steps=2,
                            data_types={"grad_accum_dtype": "bfloat16"})
     losses = [float(eng.train_batch(make_batch(rng, eng.train_batch_size())))
               for _ in range(6)]
     assert losses[-1] < losses[0], losses
+
+
+def test_grad_accum_dtype_bf16_gas_error_bounded():
+    """REGRESSION (fp32 scan carry): with grad_accum_dtype=bf16, a gas=8
+    accumulation must match the fp32-accum trajectory to ~one bf16
+    rounding — NOT drift with the number of micro-steps (the old bf16
+    carry lost one ulp per add, so error GREW with gas). Same total
+    batch both ways; only the accumulation dtype differs."""
+    e_ref, rng = make_engine(stage=1, gradient_accumulation_steps=8)
+    batches = [make_batch(rng, e_ref.train_batch_size()) for _ in range(5)]
+    ref = [float(e_ref.train_batch(b)) for b in batches]
+
+    e_bf16, _ = make_engine(stage=1, gradient_accumulation_steps=8,
+                            data_types={"grad_accum_dtype": "bf16"})
+    got = [float(e_bf16.train_batch(b)) for b in batches]
+    # one storage rounding per step, not eight accumulated ones
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0.02)
+    assert got[-1] < got[0]
 
 
 def test_grad_accum_dtype_rejects_fp16():

@@ -145,7 +145,7 @@ def test_sparse_tensor_roundtrip(rng):
 def test_sparse_all_reduce_matches_dense(dp8_mesh):
     """shard_map sparse all-reduce == dense psum."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     vocab, d = 16, 4
     rng = np.random.default_rng(0)

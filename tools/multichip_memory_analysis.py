@@ -30,6 +30,7 @@ if xla_bridge._backends:
 jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
+from deepspeed_tpu.utils.jax_compat import set_mesh  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
@@ -118,7 +119,7 @@ def analyze(dp: int, remat_case: str, micro_per_chip: int = 16,
     }
 
     t0 = time.time()
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         lowered = jax.jit(train_step, donate_argnums=(0, 1)).lower(
             abs_params, abs_opt_sh, abs_batch)
         compiled = lowered.compile()

@@ -66,6 +66,7 @@ def _save(d):
 def anchor():
     """Measured MFU at the largest HBM-resident size (real chip)."""
     import jax
+    from deepspeed_tpu.utils.jax_compat import set_mesh
     import jax.numpy as jnp
 
     import deepspeed_tpu
@@ -140,6 +141,7 @@ def project():
                                + " --xla_force_host_platform_device_count=16"
                                ).strip()
     import jax
+    from deepspeed_tpu.utils.jax_compat import set_mesh
     from jax._src import xla_bridge
 
     if xla_bridge._backends:
@@ -239,7 +241,7 @@ def project():
                                            sharding=bspec),
         }
         t0 = time.time()
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             compiled = jax.jit(train_step, donate_argnums=(0, 1)).lower(
                 abs_params, abs_opt_sh, abs_batch).compile()
         ma = compiled.memory_analysis()

@@ -243,20 +243,31 @@ def pld_main():
 
 
 def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
-               seed=0, out_path="BENCH_SERVE.json"):
+               seed=0, out_path="BENCH_SERVE.json", kernels=None):
     """--serve: continuous batching (paged KV + slot scheduler) vs the
-    static whole-batch baseline on a mixed-length Poisson arrival trace.
+    static whole-batch baseline on a mixed-length Poisson arrival trace,
+    PLUS a same-config attention-kernel A/B (jnp reference gather vs the
+    Pallas ragged decode kernel, ``serve.attn_kernel``).
 
-    Both arms run the SAME engine and weights at the SAME slot count:
+    All serve arms run the SAME engine, weights, trace and slot count:
     the baseline groups requests into arrival-order batches of
     ``num_slots`` and runs ``generate()`` — whole-batch prefill, lockstep
     decode to the LONGEST request in the group (head-of-line blocking);
-    the serve arm admits requests into freed slots mid-stream
-    (``engine.serve``). Reports aggregate generated tokens/s and p50/p95
-    per-request latency for each arm, plus the speedup, as one JSON line
-    and a JSON artifact (default BENCH_SERVE.json).
+    the serve arms admit requests into freed slots mid-stream
+    (``engine.serve``) with ON-DEMAND block allocation, and differ only
+    in the paged-attention arm. Reports aggregate generated tokens/s,
+    p50/p95 per-request latency and queue-wait p50/p95 for each arm,
+    plus the per-step pool-occupancy time series (blocks allocated vs
+    the PR-1 upfront-reservation equivalent, live tokens, stalls) — as
+    one JSON line and a JSON artifact (default BENCH_SERVE.json).
 
-    Both arms are warmed first (compile paths populated), then timed on a
+    Off-TPU the Pallas arm runs in INTERPRET mode — a correctness/
+    plumbing arm whose tokens/s is not a kernel measurement (the artifact
+    records the backend so readers can tell); on TPU both arms compile
+    and the ratio is the kernel win. ``kernels`` restricts the arms
+    (``["reference"]`` / ``["pallas"]``; default both).
+
+    Arms are warmed first (compile paths populated), then timed on a
     fresh arrival clock — the comparison measures scheduling, not XLA
     compile time. Baseline caveat: ragged prompts are left-padded with
     token 0 to the group max (generate() has one attn_start per batch,
@@ -330,22 +341,31 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
 
     trace = make_trace(np.random.default_rng(seed + 1))
     total_gen = sum(g for _, g, _ in trace)
+    kernels = list(kernels or ("reference", "pallas"))
 
-    # --- continuous-batching arm ---------------------------------------------
-    def run_serve(timed: bool):
+    # --- continuous-batching arms (reference / pallas attention) -------------
+    def run_serve(timed: bool, attn_kernel: str):
         t0 = time.time() + (0.0 if not timed else 0.01)
         reqs = [Request(rid=i, prompt=p, max_new_tokens=g,
                         arrival_time=(t0 + off) if timed else None)
                 for i, (p, g, off) in enumerate(trace)]
         comps = engine.serve(reqs, num_slots=num_slots,
                              block_size=block_size,
-                             decode_chunk=decode_chunk)
+                             decode_chunk=decode_chunk,
+                             attn_kernel=attn_kernel,
+                             record_occupancy=timed)
         lat = sorted(c.t_finish - c.t_submit for c in comps)
+        qwait = sorted(c.queue_delay for c in comps)
         wall = max(c.t_finish for c in comps) - t0
-        return wall, lat
+        occ = engine.last_serve_occupancy if timed else None
+        preempt = engine.last_serve_scheduler.preemptions
+        return wall, lat, qwait, occ, preempt
 
-    run_serve(timed=False)                     # warm: compiles all programs
-    cb_wall, cb_lat = run_serve(timed=True)
+    arm_results = {}
+    for kern in kernels:
+        run_serve(timed=False, attn_kernel=kern)   # warm: compile programs
+        arm_results[kern] = run_serve(timed=True, attn_kernel=kern)
+    cb_wall = arm_results[kernels[0]][0]
 
     # --- static whole-batch baseline -----------------------------------------
     def run_baseline(timed: bool):
@@ -377,34 +397,89 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
     def pct(xs, q):
         return xs[min(len(xs) - 1, int(q * len(xs)))]
 
+    def arm_stats(kern):
+        wall, lat, qwait, occ, preempt = arm_results[kern]
+        d = {"attn_kernel": kern,
+             "tokens_per_sec": round(total_gen / wall, 1),
+             "wall_s": round(wall, 3),
+             "latency_p50_s": round(pct(lat, 0.5), 4),
+             "latency_p95_s": round(pct(lat, 0.95), 4),
+             "queue_wait_p50_s": round(pct(qwait, 0.5), 4),
+             "queue_wait_p95_s": round(pct(qwait, 0.95), 4),
+             "preemptions": preempt}
+        if occ:
+            alloc = [e["blocks_allocated"] for e in occ]
+            resv = [e["blocks_reserved_equiv"] for e in occ]
+            t0 = occ[0]["t"]
+            stride = max(1, len(occ) // 160)     # bound the artifact size
+            d["pool_occupancy"] = {
+                "usable_blocks": occ[0]["blocks_allocated"]
+                + occ[0]["blocks_free"],
+                "steps": len(occ),
+                "peak_blocks_allocated": max(alloc),
+                "mean_blocks_allocated": round(sum(alloc) / len(alloc), 2),
+                # what PR-1's admission-time reservation would have pinned
+                # for the same residency — the on-demand win per step
+                "peak_blocks_reserved_equiv": max(resv),
+                "mean_blocks_reserved_equiv": round(
+                    sum(resv) / len(resv), 2),
+                "stalled_step_fraction": round(
+                    sum(1 for e in occ if e["stalled_slots"]) / len(occ), 4),
+                "series": [
+                    {"t": round(e["t"] - t0, 3),
+                     "blocks_allocated": e["blocks_allocated"],
+                     "blocks_reserved_equiv": e["blocks_reserved_equiv"],
+                     "blocks_free": e["blocks_free"],
+                     "live_tokens": e["live_tokens"],
+                     "active_slots": e["active_slots"],
+                     "stalled_slots": e["stalled_slots"],
+                     "queued": e["queued"]}
+                    for e in occ[::stride]],
+            }
+        return d
+
     cb_tps = total_gen / cb_wall
     sb_tps = total_gen / sb_wall
+    detail = {
+        "continuous": arm_stats(kernels[0]),
+        "static_batch": {"tokens_per_sec": round(sb_tps, 1),
+                         "wall_s": round(sb_wall, 3),
+                         "latency_p50_s": round(pct(sb_lat, 0.5), 4),
+                         "latency_p95_s": round(pct(sb_lat, 0.95), 4)},
+        "speedup_tokens_per_sec": round(cb_tps / max(sb_tps, 1e-9), 3),
+        "num_slots": num_slots, "n_requests": n_requests,
+        "decode_chunk": decode_chunk, "block_size": block_size,
+        "prompt_lens": list(prompt_lens), "gen_mix": list(gen_mix),
+        "poisson_mean_gap_s": mean_gap,
+        "total_generated_tokens": int(total_gen),
+        "block_allocation": "on_demand",
+        "useful_token_fraction_static": round(
+            total_gen / sum(max(g for _, g, _ in trace[i:i + num_slots])
+                            * len(trace[i:i + num_slots])
+                            for i in range(0, n_requests, num_slots)), 3),
+        "backend": jax.default_backend(),
+    }
+    for kern in kernels[1:]:
+        detail[f"continuous_{kern}"] = arm_stats(kern)
+    if len(kernels) > 1:
+        ref_w = arm_results[kernels[0]][0]
+        alt_w = arm_results[kernels[1]][0]
+        detail["kernel_ab"] = {
+            "arms": list(kernels),
+            "tokens_per_sec": {k: round(total_gen / arm_results[k][0], 1)
+                               for k in kernels},
+            f"{kernels[1]}_vs_{kernels[0]}": round(ref_w / alt_w, 3),
+            "note": ("off-TPU the pallas arm runs in interpret mode — a "
+                     "parity/plumbing arm, not a kernel measurement"
+                     if jax.default_backend() != "tpu" else
+                     "compiled kernel A/B at equal config"),
+        }
     result = {
         "metric": "serve_continuous_batching_tokens_per_sec",
         "value": round(cb_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(cb_tps / max(sb_tps, 1e-9), 3),
-        "detail": {
-            "continuous": {"tokens_per_sec": round(cb_tps, 1),
-                           "wall_s": round(cb_wall, 3),
-                           "latency_p50_s": round(pct(cb_lat, 0.5), 4),
-                           "latency_p95_s": round(pct(cb_lat, 0.95), 4)},
-            "static_batch": {"tokens_per_sec": round(sb_tps, 1),
-                             "wall_s": round(sb_wall, 3),
-                             "latency_p50_s": round(pct(sb_lat, 0.5), 4),
-                             "latency_p95_s": round(pct(sb_lat, 0.95), 4)},
-            "speedup_tokens_per_sec": round(cb_tps / max(sb_tps, 1e-9), 3),
-            "num_slots": num_slots, "n_requests": n_requests,
-            "decode_chunk": decode_chunk, "block_size": block_size,
-            "prompt_lens": list(prompt_lens), "gen_mix": list(gen_mix),
-            "poisson_mean_gap_s": mean_gap,
-            "total_generated_tokens": int(total_gen),
-            "useful_token_fraction_static": round(
-                total_gen / sum(max(g for _, g, _ in trace[i:i + num_slots])
-                                * len(trace[i:i + num_slots])
-                                for i in range(0, n_requests, num_slots)), 3),
-            "backend": jax.default_backend(),
-        },
+        "detail": detail,
     }
     print(json.dumps(result))
     if out_path:
@@ -1330,9 +1405,18 @@ if __name__ == "__main__":
                          f"bench.py --serve {name} 8")
             return int(sys.argv[i])
 
+        kernels = None
+        if "--kernel" in sys.argv:
+            i = sys.argv.index("--kernel") + 1
+            arm = sys.argv[i] if i < len(sys.argv) else ""
+            if arm not in ("reference", "pallas", "both"):
+                sys.exit("--kernel requires reference|pallas|both, e.g. "
+                         "bench.py --serve --kernel pallas")
+            kernels = None if arm == "both" else [arm]
         serve_main(num_slots=_intflag("--slots"),
                    n_requests=_intflag("--requests"),
-                   decode_chunk=_intflag("--chunk"))
+                   decode_chunk=_intflag("--chunk"),
+                   kernels=kernels)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

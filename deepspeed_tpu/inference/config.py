@@ -81,6 +81,21 @@ class QuantizationConfig(DeepSpeedConfigModel):
     fused_mlp: bool = False
 
 
+class ServeConfig(DeepSpeedConfigModel):
+    """Continuous-batching serving knobs (``engine.serve`` /
+    ``generate_stream``)."""
+
+    # paged-attention decode arm: "pallas" streams one live pool block
+    # at a time into VMEM (ragged iteration — per-step KV bytes track
+    # live context; ops/paged_attention_kernel.py), "reference" is the
+    # jnp gather path (pool[block_tables] materialized at max_context
+    # width). "auto" = pallas on TPU, reference elsewhere (off-TPU the
+    # kernel only exists in interpret mode — a correctness arm, not a
+    # fast path). Parity is pinned in tier-1 via interpret mode
+    # (tests/unit/inference/test_paged_attention.py).
+    attn_kernel: str = "auto"
+
+
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     """Mirrors the reference's surface; CUDA-graph and kernel-injection knobs
     are accepted for compatibility (XLA compiles whole programs, injection is
@@ -91,6 +106,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
         default_factory=DeepSpeedTPConfig, alias="tp")
     moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    serve: ServeConfig = Field(default_factory=ServeConfig)
     max_out_tokens: int = Field(1024, ge=1)
     min_out_tokens: int = Field(1, ge=1)
     max_tokens: Optional[int] = None

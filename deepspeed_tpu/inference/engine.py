@@ -67,7 +67,7 @@ def resolve_decoder(cfg):
         f"got {type(cfg).__name__}")
 
 
-def resolve_paged_decoder(cfg):
+def resolve_paged_decoder(cfg, attn_kernel: str = "reference"):
     """(paged_apply, init_pools_fn, params_transform, fused_decoder) for
     a model config — the paged-KV analogue of :func:`resolve_decoder`.
     ``fused_decoder`` is the FusedLlamaDecoderModel instance on the
@@ -79,6 +79,12 @@ def resolve_paged_decoder(cfg):
     LlamaConfig → the fused decoder's ``apply_paged`` (composes with the
     int8 weight paths and ``quant.kv_cache``); per-layer LlamaConfig →
     PagedLlamaDecoderModel; TransformerConfig → the unified paged twin.
+
+    ``attn_kernel`` ("pallas" | "reference", already resolved from the
+    ``serve.attn_kernel`` knob) selects the paged-attention decode arm —
+    the Pallas ragged kernel or the jnp gather reference
+    (ops/paged_attention_kernel.resolve_paged_attention) — on every
+    dispatch target, so the arm can never differ between model paths.
     """
     from deepspeed_tpu.models.llama import (
         FusedLlamaDecoderModel, LlamaConfig, PagedLlamaDecoderModel,
@@ -88,10 +94,16 @@ def resolve_paged_decoder(cfg):
         PagedTransformerDecoderModel, TransformerConfig,
         init_paged_kv_pools as unified_pools,
     )
+    from deepspeed_tpu.ops.paged_attention_kernel import (
+        resolve_paged_attention,
+    )
+
+    resolve_paged_attention(attn_kernel)       # validate the arm loudly
 
     if isinstance(cfg, LlamaConfig):
         if cfg.scan_layers:
             decoder = FusedLlamaDecoderModel(cfg)
+            decoder.paged_attn_kernel = attn_kernel
 
             def paged_apply(params, ids, pools, bt, wp, vl):
                 return decoder.apply_paged({"params": params}, ids, pools,
@@ -99,7 +111,7 @@ def resolve_paged_decoder(cfg):
 
             return (paged_apply, llama_pools,
                     lambda p: fuse_decode_params(p, cfg), decoder)
-        module = PagedLlamaDecoderModel(cfg)
+        module = PagedLlamaDecoderModel(cfg, attn_kernel=attn_kernel)
 
         def paged_apply(params, ids, pools, bt, wp, vl):
             return module.apply({"params": params}, ids, pools, bt, wp, vl)
@@ -111,7 +123,7 @@ def resolve_paged_decoder(cfg):
                 "serve() requires a causal LM; encoder architectures "
                 f"(causal={cfg.causal}, lm_head={cfg.lm_head}) have no "
                 "decode path")
-        module = PagedTransformerDecoderModel(cfg)
+        module = PagedTransformerDecoderModel(cfg, attn_kernel=attn_kernel)
 
         def paged_apply(params, ids, pools, bt, wp, vl):
             return module.apply({"params": params}, ids, pools, bt, wp, vl)
@@ -995,10 +1007,38 @@ class InferenceEngine:
         return tokens
 
     # --- continuous-batching serving (paged KV cache) -------------------------
+    def _resolve_attn_kernel(self, override: Optional[str]) -> str:
+        """Resolve the serving paged-attention arm: explicit override >
+        ``serve.attn_kernel`` config; "auto" = the Pallas ragged kernel
+        on TPU, the jnp reference elsewhere (off-TPU pallas only exists
+        in interpret mode — a parity arm, not a fast path)."""
+        name = override or getattr(self._config, "serve").attn_kernel
+        if name == "auto":
+            from deepspeed_tpu.ops.paged_attention_kernel import (
+                pallas_paged_available,
+            )
+
+            # availability gate, not just backend: a skewed jax build
+            # without the pallas surface must DEGRADE to the reference
+            # arm (the jax_compat seam's whole point), not crash the
+            # first decode call (probe is lru-cached — one tiny kernel)
+            name = "pallas" if (jax.default_backend() == "tpu"
+                                and pallas_paged_available()) else \
+                "reference"
+        if name not in ("pallas", "reference"):
+            raise ValueError(
+                f"serve.attn_kernel={name!r}: expected 'auto', 'pallas' "
+                f"or 'reference'")
+        return name
+
     def generate_stream(self, requests, *, num_slots: int = 4,
                         block_size: int = 16, num_blocks: Optional[int] = None,
                         max_context: Optional[int] = None,
-                        decode_chunk: int = 1):
+                        decode_chunk: int = 1,
+                        attn_kernel: Optional[str] = None,
+                        reserve_upfront: bool = False,
+                        record_occupancy: bool = False,
+                        speculative: Optional[str] = None):
         """Serve ``requests`` with continuous batching over a paged KV
         cache, yielding a ``Completion`` per request as it finishes.
 
@@ -1014,18 +1054,34 @@ class InferenceEngine:
         requests: iterable of ``inference.scheduler.Request`` (or dicts
         of its fields; ``rid`` defaults to the index). ``num_blocks``
         caps the pool — smaller pools queue requests (backpressure)
-        instead of failing. ``decode_chunk`` > 1 amortizes host
-        round-trips by sampling several tokens per program call at the
-        cost of coarser admission granularity.
+        instead of failing; blocks are allocated ON DEMAND as slots
+        decode (admission claims only prompt blocks), so pool sizing is
+        about expected LIVE tokens — ``reserve_upfront=True`` restores
+        the worst-case reservation policy for A/B runs. ``decode_chunk``
+        > 1 amortizes host round-trips by sampling several tokens per
+        program call at the cost of coarser admission granularity.
+        ``attn_kernel`` overrides ``serve.attn_kernel`` for this call
+        ("pallas" ragged kernel | "reference" jnp gather).
+        ``record_occupancy`` keeps a per-step pool time series on
+        ``engine.last_serve_occupancy`` (the bench artifact's source).
         """
         from deepspeed_tpu.inference.kv_pool import BlockPool, blocks_for
         from deepspeed_tpu.inference.scheduler import (
             ContinuousBatchingScheduler, Request,
         )
 
+        if speculative is not None:
+            # mirror the generate() guard: the paged serving path has no
+            # draft/verify arena — silently ignoring the flag would look
+            # like speculative serving while measuring nothing
+            raise ValueError(
+                f"speculative={speculative!r}: paged serving "
+                "(serve/generate_stream) is non-speculative — "
+                "prompt-lookup decoding runs through generate()")
         cfg = self.model_config
         assert cfg is not None, \
             "serve() requires a model config (LlamaConfig/TransformerConfig)"
+        attn_kernel = self._resolve_attn_kernel(attn_kernel)
         reqs = []
         for i, r in enumerate(requests):
             if isinstance(r, dict):
@@ -1049,9 +1105,16 @@ class InferenceEngine:
             num_blocks = num_slots * width + 1
 
         executor = self._get_serve_executor(num_slots, block_size,
-                                            num_blocks, decode_chunk)
+                                            num_blocks, decode_chunk,
+                                            attn_kernel)
         scheduler = ContinuousBatchingScheduler(
-            executor, num_slots, BlockPool(num_blocks, block_size), width)
+            executor, num_slots, BlockPool(num_blocks, block_size), width,
+            reserve_upfront=reserve_upfront,
+            record_occupancy=record_occupancy)
+        # the log list is mutated in place by the scheduler, so callers
+        # can read it after draining the stream (bench.py --serve)
+        self.last_serve_occupancy = scheduler.occupancy_log
+        self.last_serve_scheduler = scheduler
         for r in reqs:
             scheduler.submit(r, now=r.arrival_time)
         yield from scheduler.run_iter()
@@ -1063,20 +1126,22 @@ class InferenceEngine:
         return list(self.generate_stream(requests, **kwargs))
 
     def _get_serve_executor(self, num_slots, block_size, num_blocks,
-                            decode_chunk):
+                            decode_chunk, attn_kernel="reference"):
         """Build — or reuse — the serving executor for one pool shape.
 
         The executor owns the device block pool AND the compiled
         prefill/decode programs; rebuilding it per ``serve()`` call would
         recompile everything (jit caches by closure identity), so it is
-        cached per (serving shape, params identity). Reusing the pool
-        across sessions is sound: every position a session READS (col <=
-        row_pos < seq_len + T) was written by that same session first,
-        so a previous session's stale KV can never leak into attention.
+        cached per (serving shape, attention-kernel arm, params
+        identity). Reusing the pool across sessions is sound: every
+        position a session READS (col <= row_pos < seq_len + T) was
+        written by that same session first, so a previous session's
+        stale KV can never leak into attention.
         """
         cfg = self.model_config
         kv8 = self._config.quant.kv_cache
-        key = (num_slots, block_size, num_blocks, decode_chunk, kv8)
+        key = (num_slots, block_size, num_blocks, decode_chunk, kv8,
+               attn_kernel)
         cache = getattr(self, "_serve_executors", None)
         if cache is None:
             cache = self._serve_executors = OrderedDict()
@@ -1092,7 +1157,7 @@ class InferenceEngine:
                 return executor
             del cache[key]
         paged_apply, init_pools, transform, decoder = \
-            resolve_paged_decoder(cfg)
+            resolve_paged_decoder(cfg, attn_kernel=attn_kernel)
         if kv8 and decoder is None:
             raise ValueError(
                 "quant.kv_cache requires the fused Llama decode path "

@@ -50,6 +50,12 @@ class BlockPool:
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_allocated(self) -> int:
+        """Blocks currently held by slots (occupancy accounting for the
+        bench's pool time series; null block excluded)."""
+        return len(self._allocated)
+
     def can_allocate(self, n: int) -> bool:
         return n <= len(self._free)
 
@@ -109,6 +115,28 @@ class SlotBlockTables:
         self.table[slot, :need] = ids
         self.table[slot, need:] = 0
 
+    def grow(self, slot: int, n_blocks: int) -> None:
+        """Append ``n_blocks`` fresh pool blocks to an occupied slot's
+        table — the ON-DEMAND allocation step (scheduler decode-chunk
+        boundaries): pool capacity then tracks live tokens instead of
+        the admission-time worst case. Caller checks
+        ``pool.can_allocate`` first; growing past the table width is a
+        hard error (submit() guarantees total need fits, so an overflow
+        here means scheduler accounting corruption)."""
+        if n_blocks < 1:
+            return
+        cur = len(self._slot_blocks[slot])
+        if not cur:
+            raise RuntimeError(f"slot {slot} holds no blocks — grow() is "
+                               f"for occupied slots; use assign()")
+        if cur + n_blocks > self.width:
+            raise ValueError(
+                f"slot {slot}: growing {cur}+{n_blocks} blocks exceeds the "
+                f"table width {self.width}")
+        ids = self.pool.allocate(n_blocks)
+        self._slot_blocks[slot].extend(ids)
+        self.table[slot, cur:cur + n_blocks] = ids
+
     def release(self, slot: int) -> None:
         """Recycle a finished slot's blocks back into the pool."""
         ids = self._slot_blocks[slot]
@@ -119,3 +147,12 @@ class SlotBlockTables:
 
     def blocks_of(self, slot: int) -> List[int]:
         return list(self._slot_blocks[slot])
+
+    def num_blocks_of(self, slot: int) -> int:
+        return len(self._slot_blocks[slot])
+
+    def slot_capacity_tokens(self, slot: int) -> int:
+        """Logical positions covered by the slot's CURRENT blocks (the
+        on-demand analogue of :meth:`capacity_tokens`, which is the
+        table-width bound)."""
+        return len(self._slot_blocks[slot]) * self.pool.block_size

@@ -11,11 +11,34 @@ sequence returns its blocks to the pool for the next arrival. Occupancy —
 not program shape — is what varies (DeepSpeed-Inference arXiv:2207.00032;
 Orca/vLLM-style iteration-level scheduling on top of the paged pool).
 
+Block allocation is ON-DEMAND (vLLM-style): admission allocates only the
+PROMPT's blocks, and each slot's table grows at decode-chunk boundaries
+just ahead of the KV it is about to write — pool capacity tracks live
+tokens, not the admission-time worst case ``prompt + max_new_tokens``,
+which is what lets a given pool admit MORE concurrent slots (the ragged
+Pallas decode kernel then keeps the per-step KV traffic proportional to
+the same live tokens; ops/paged_attention_kernel.py). When the pool
+cannot supply a mid-decode grow, the slot STALLS — excluded from decode
+calls (its in-program writes are masked off), tables intact — and
+resumes the step blocks free. If every active slot is stalled at once
+(only possible with >= 2 slots sharing a too-small pool), the youngest
+slot is PREEMPTED: its blocks recycle and its request requeues at the
+queue head for a fresh admission, guaranteeing progress. Preemption
+restarts that request's generation from its prompt (greedy output is
+unchanged — same tokens recomputed; a sampled stream restarts
+self-consistently from its seed). ``reserve_upfront=True`` restores the
+old reserve-everything-at-admission policy (no growth, no stalls) for
+A/B comparison. Note per-slot rng streams advance with decode program
+steps, so a stall can shift WHERE a sampled stream lands relative to an
+unstalled run; (prompt, seed) determinism at fixed pool pressure holds.
+
 The scheduler is pure host logic over an EXECUTOR protocol, so its
-admission/recycling/backpressure behavior is unit-tested with a fake
-executor (tests/unit/inference/test_scheduler.py); the real executor —
-compiled prefill/decode programs over the device block pool — lives in
-``inference/engine.py`` (``InferenceEngine.serve``).
+admission/recycling/backpressure/growth behavior is unit-tested with a
+fake executor (tests/unit/inference/test_scheduler.py); the real
+executor — compiled prefill/decode programs over the device block pool —
+lives in ``inference/engine.py`` (``InferenceEngine.serve``). Executors
+expose their decode chunk as an optional ``decode_chunk`` attribute
+(default 1) — the growth horizon per decode call.
 
 Executor protocol (duck-typed)::
 
@@ -120,7 +143,8 @@ class ContinuousBatchingScheduler:
     """
 
     def __init__(self, executor, num_slots: int, pool: BlockPool,
-                 table_width: int):
+                 table_width: int, reserve_upfront: bool = False,
+                 record_occupancy: bool = False):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -131,6 +155,16 @@ class ContinuousBatchingScheduler:
         self.last_tokens = np.zeros(num_slots, np.int32)
         self.active = np.zeros(num_slots, bool)
         self.steps_left = np.zeros(num_slots, np.int32)
+        # on-demand growth state: a stalled slot is active but excluded
+        # from decode calls until the pool can cover its next write
+        self.stalled = np.zeros(num_slots, bool)
+        self._cap_steps = np.zeros(num_slots, np.int64)
+        self.reserve_upfront = bool(reserve_upfront)
+        self.preemptions = 0
+        # per-step pool occupancy series for the bench artifact
+        # (BENCH_SERVE.json) — None disables recording
+        self.occupancy_log: Optional[List[dict]] = \
+            [] if record_occupancy else None
         self._submit_times = {}
 
     # --- queue ---------------------------------------------------------------
@@ -174,12 +208,18 @@ class ContinuousBatchingScheduler:
             req = self.queue[0]
             if req.arrival_time is not None and req.arrival_time > now:
                 break                  # FIFO: later requests wait too
-            need = blocks_for(len(req.prompt) + req.max_new_tokens,
-                              self.pool.block_size)
+            # on-demand: admission claims only the PROMPT's blocks (the
+            # KV prefill writes now); generation capacity grows at
+            # decode-chunk boundaries. reserve_upfront restores the old
+            # worst-case claim for A/B runs.
+            admit_tokens = len(req.prompt)
+            if self.reserve_upfront:
+                admit_tokens += req.max_new_tokens
+            need = blocks_for(admit_tokens, self.pool.block_size)
             if not self.pool.can_allocate(need):
                 break                  # backpressure: queue, don't crash
             self.queue.popleft()
-            self.tables.assign(slot_id, len(req.prompt) + req.max_new_tokens)
+            self.tables.assign(slot_id, admit_tokens)
             self.executor.set_slot(slot_id, req)
             t_admit = time.time()
             first = int(self.executor.prefill(
@@ -212,24 +252,114 @@ class ContinuousBatchingScheduler:
             t_admitted=slot.t_admitted, t_first_token=slot.t_first,
             t_finish=t_finish)
         self.tables.release(slot_id)   # blocks recycle to the pool
+        self._clear_slot(slot_id)
+        return comp
+
+    def _clear_slot(self, slot_id: int) -> None:
+        slot = self.slots[slot_id]
         slot.req = None
         slot.out = []
         slot.seq_len = 0
         slot.remaining = 0
         self.active[slot_id] = False
+        self.stalled[slot_id] = False
         self.steps_left[slot_id] = 0
         self.seq_lens[slot_id] = 0
         self.last_tokens[slot_id] = 0
-        return comp
+
+    # --- on-demand growth / preemption ----------------------------------------
+    def _grow(self, slot_ids, horizon: int) -> None:
+        """Grow each slot's table to cover the KV it will write in a
+        decode call of up to ``horizon`` steps; mark slots the pool
+        cannot cover as STALLED (resume is just this method succeeding
+        on a later step). Updates ``_cap_steps`` — the per-slot write
+        headroom the decode cap is derived from."""
+        bs = self.pool.block_size
+        for slot_id in slot_ids:
+            slot = self.slots[slot_id]
+            if slot.free or not self.active[slot_id]:
+                continue
+            cur = self.tables.num_blocks_of(slot_id)
+            if not self.reserve_upfront:
+                want = min(horizon, slot.remaining)
+                need = blocks_for(slot.seq_len + want, bs) - cur
+                if need > 0:
+                    take = min(need, self.pool.num_free,
+                               self.tables.width - cur)
+                    if take > 0:
+                        self.tables.grow(slot_id, take)
+                        cur += take
+            cap = cur * bs - slot.seq_len
+            self._cap_steps[slot_id] = cap
+            self.stalled[slot_id] = cap <= 0
+
+    def _preempt_youngest(self) -> None:
+        """Total-stall safety valve: every active slot needs a block and
+        the pool has none (possible only with >= 2 slots — submit()
+        rejects requests larger than the whole pool, so a lone slot
+        always fits). Evict the most recently admitted slot: its blocks
+        recycle NOW (letting older slots resume) and its request
+        requeues at the FIFO head for a fresh admission — generation
+        restarts from the prompt (greedy output identical; sampled
+        streams restart from their seed)."""
+        victim = max((s for s in range(self.num_slots) if self.active[s]),
+                     key=lambda s: (self.slots[s].t_admitted, s))
+        req = self.slots[victim].req
+        self.tables.release(victim)
+        self._clear_slot(victim)
+        self.queue.appendleft(req)     # keeps original submit time
+        self.preemptions += 1
+
+    def _record_occupancy(self, now: float) -> None:
+        if self.occupancy_log is None:
+            return
+        # what the PR-1 upfront policy would pin for the SAME residency —
+        # the per-step visualization of the reservation→on-demand win
+        reserved_equiv = sum(
+            blocks_for(len(s.req.prompt) + s.req.max_new_tokens,
+                       self.pool.block_size)
+            for s in self.slots if s.req is not None)
+        self.occupancy_log.append({
+            "t": now,
+            "blocks_allocated": self.pool.num_allocated,
+            "blocks_reserved_equiv": reserved_equiv,
+            "blocks_free": self.pool.num_free,
+            "live_tokens": int(self.seq_lens.sum()),
+            "active_slots": int(self.active.sum()),
+            "stalled_slots": int(self.stalled.sum()),
+            "queued": len(self.queue),
+        })
 
     # --- one scheduling iteration --------------------------------------------
     def step(self, now: Optional[float] = None) -> List[Completion]:
-        """Admit what fits, run one decode call, retire finished slots.
-        Returns completions finished this step (possibly empty)."""
+        """Grow in-flight tables, admit what fits, run one decode call,
+        retire finished slots. Returns completions finished this step
+        (possibly empty)."""
         now = time.time() if now is None else now
+        chunk = max(1, int(getattr(self.executor, "decode_chunk", 1)))
+        # growth FIRST: in-flight slots outrank the queue head for free
+        # blocks — admitting ahead of mid-decode grows would convert
+        # pool pressure into stalls of already-running requests
+        pre = [s for s in range(self.num_slots) if self.active[s]]
+        self._grow(pre, chunk)
         done = self._admit(now)
+        pre_set = set(pre)
+        self._grow([s for s in range(self.num_slots)
+                    if self.active[s] and s not in pre_set], chunk)
         if not self.active.any():
+            self._record_occupancy(now)
             return done
+        runnable = np.logical_and(self.active, ~self.stalled)
+        if not runnable.any():
+            # every active slot is stalled on an empty pool: preempt the
+            # youngest so the older slots resume THIS step
+            self._preempt_youngest()
+            self._grow([s for s in range(self.num_slots)
+                        if self.active[s]], chunk)
+            runnable = np.logical_and(self.active, ~self.stalled)
+            if not runnable.any():     # defensive: one preemption frees
+                self._record_occupancy(now)     # >= 1 block by invariant
+                return done
         # adaptive decode quantum: chunked executors amortize host round
         # trips over several steps, but while the QUEUE holds admissible
         # work the call must stop at the next slot completion — otherwise
@@ -237,16 +367,25 @@ class ContinuousBatchingScheduler:
         # this scheduler exists for quantizes away
         max_steps = None
         if self.queue:
-            max_steps = int(self.steps_left[self.active].min())
+            max_steps = int(self.steps_left[runnable].min())
+        # on-demand coverage cap: the program must not write KV past the
+        # blocks granted this step (partial grows shorten the call; the
+        # next step grows again)
+        feasible = int(self._cap_steps[runnable].min())
+        planned = chunk if max_steps is None else min(chunk, max_steps)
+        if feasible < planned:
+            max_steps = feasible
+        eff_steps = self.steps_left.copy()
+        eff_steps[self.stalled] = 0        # stalled slots must not write
         toks = np.asarray(self.executor.decode(
             self.last_tokens.copy(), self.tables.table,
-            self.seq_lens.copy(), self.active.copy(),
-            self.steps_left.copy(), max_steps), np.int32)
+            self.seq_lens.copy(), runnable.copy(),
+            eff_steps, max_steps), np.int32)
         if toks.ndim == 1:
             toks = toks[:, None]
         t_now = time.time()
         for slot_id, slot in enumerate(self.slots):
-            if not self.active[slot_id]:
+            if not runnable[slot_id]:
                 continue
             for tok in toks[slot_id]:
                 if slot.remaining <= 0:
@@ -262,6 +401,7 @@ class ContinuousBatchingScheduler:
             self.steps_left[slot_id] = slot.remaining
             if slot.remaining <= 0:
                 done.append(self._finish(slot_id, t_now))
+        self._record_occupancy(now)
         return done
 
     def run_iter(self, poll_interval: float = 0.001):

@@ -230,9 +230,12 @@ class PagedLlamaDecodeBlock(nn.Module):
     """Block decoding against the shared paged KV block pool
     (ops/paged_attention): same parameter structure as LlamaBlock /
     LlamaDecodeBlock, so trained params apply directly; only the cache
-    layout differs from LlamaDecodeBlock."""
+    layout differs from LlamaDecodeBlock. ``attn_kernel`` selects the
+    paged decode arm (serve.attn_kernel): the Pallas ragged kernel or
+    the jnp gather reference."""
 
     cfg: LlamaConfig
+    attn_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, x, mask, positions, kv_pool, block_tables, write_pos,
@@ -242,7 +245,12 @@ class PagedLlamaDecodeBlock(nn.Module):
         h, new_pool = SelfAttention(
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
             use_rope=True, rope_base=cfg.rope_base, dtype=cfg.dtype,
-            attention_impl="xla", name="attn",
+            attention_impl="xla", paged_attn_kernel=self.attn_kernel,
+            # PagedLlamaDecoderModel passes exactly paged_context_mask —
+            # the promise lets the pallas arm skip the mask input (the
+            # kernel recomputes causal-context from ctx lengths)
+            assume_causal_mask=True,
+            name="attn",
         )(h, mask=mask, positions=positions, paged_cache=kv_pool,
           block_tables=block_tables, write_pos=write_pos,
           valid_len=valid_len)
@@ -255,11 +263,13 @@ class PagedLlamaDecodeBlock(nn.Module):
 
 class _ScanPagedLlamaDecodeBlock(nn.Module):
     cfg: LlamaConfig
+    attn_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, x, mask, positions, kv_pool, block_tables, write_pos,
                  valid_len):
-        y, new_pool = PagedLlamaDecodeBlock(self.cfg, name="block")(
+        y, new_pool = PagedLlamaDecodeBlock(
+            self.cfg, attn_kernel=self.attn_kernel, name="block")(
             x, mask, positions, kv_pool, block_tables, write_pos, valid_len)
         return y, new_pool
 
@@ -383,11 +393,14 @@ class PagedLlamaDecoderModel(nn.Module):
     block_tables: int32 [B, W]. write_pos: int32 [B] — per-slot tokens
     already in cache (0 for prefill). valid_len: int32 [B] or None —
     real tokens per row along T (right-padding / inactive slots write to
-    the null block). Greedy-exact vs the dense twin
+    the null block). ``attn_kernel``: paged decode arm
+    (serve.attn_kernel) — Pallas ragged kernel or jnp gather reference.
+    Greedy-exact vs the dense twin
     (tests/unit/inference/test_paged_decode.py).
     """
 
     cfg: LlamaConfig
+    attn_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, input_ids, kv_pools, block_tables, write_pos,
@@ -415,13 +428,15 @@ class PagedLlamaDecoderModel(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, new_pools = ScanBlock(cfg, name="blocks")(
+            x, new_pools = ScanBlock(cfg, self.attn_kernel, name="blocks")(
                 x, mask, positions, kv_pools, block_tables, write_pos,
                 valid_len)
         else:
             new_k, new_v = [], []
             for i in range(cfg.num_layers):
-                x, (pk, pv) = PagedLlamaDecodeBlock(cfg, name=f"layers_{i}")(
+                x, (pk, pv) = PagedLlamaDecodeBlock(
+                    cfg, attn_kernel=self.attn_kernel,
+                    name=f"layers_{i}")(
                     x, mask, positions,
                     (kv_pools[0][i], kv_pools[1][i]), block_tables,
                     write_pos, valid_len)
@@ -787,6 +802,11 @@ class FusedLlamaDecoderModel:
         self.w8a8_decode = False
         # fused gated-MLP decode kernel (quant.fused_mlp; default off)
         self.fused_mlp = False
+        # paged decode arm (engine-plumbed from serve.attn_kernel):
+        # "pallas" routes T=1 apply_paged steps through the ragged
+        # Pallas kernel (ops/paged_attention_kernel.py) for both dense
+        # and int8 pools; "reference" is the jnp gather path
+        self.paged_attn_kernel = "reference"
 
     def _rms(self, x, scale):
         cfg = self.cfg
@@ -982,9 +1002,18 @@ class FusedLlamaDecoderModel:
         kv_int8 = len(kv_pools) == 4
 
         from deepspeed_tpu.ops.paged_attention import (
-            paged_append, paged_append_scales, paged_attention,
-            paged_attention_int8,
+            paged_append, paged_append_scales,
         )
+        from deepspeed_tpu.ops.paged_attention_kernel import (
+            resolve_paged_attention,
+        )
+
+        # ONE dispatch point for the serving attention arm: the Pallas
+        # ragged kernel streams live pool blocks (falling back to the
+        # reference for T > 1 prefill rows internally); the reference
+        # materializes the full-width gather
+        attn_fn, attn_int8_fn = resolve_paged_attention(
+            getattr(self, "paged_attn_kernel", "reference"))
 
         def attn_core(q, k, v, cache):
             if kv_int8:
@@ -997,13 +1026,13 @@ class FusedLlamaDecoderModel:
                                           write_pos, valid_len)
                 vsp = paged_append_scales(vsp, vsc, block_tables,
                                           write_pos, valid_len)
-                a = paged_attention_int8(q, kqp, ksp, vqp, vsp,
-                                         block_tables, positions)
+                a = attn_int8_fn(q, kqp, ksp, vqp, vsp,
+                                 block_tables, positions)
                 return a, (kqp, ksp, vqp, vsp)
             kp, vp = cache
             kp, vp = paged_append(kp, vp, k, v, block_tables, write_pos,
                                   valid_len)
-            a = paged_attention(q, kp, vp, block_tables, positions)
+            a = attn_fn(q, kp, vp, block_tables, positions)
             return a, (kp, vp)
 
         return self._forward(fused_params, input_ids, positions, kv_pools,

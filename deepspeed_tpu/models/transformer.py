@@ -201,6 +201,13 @@ class SelfAttention(nn.Module):
     use_bias: bool = False
     out_bias: Optional[bool] = None       # None → use_bias; GPT-Neo: qkv no, out yes
     attn_scale: Optional[float] = None    # None → 1/sqrt(head_dim); GPT-Neo: 1.0
+    # paged decode arm (serve.attn_kernel): "pallas" routes T=1 steps
+    # through the ragged Pallas kernel (one live pool block at a time in
+    # VMEM, GQA by indexing — ops/paged_attention_kernel.py); the
+    # reference path materializes the full-width pool gather. Prefill
+    # (T > 1) always takes the reference path — it is MXU-bound and
+    # happens once per request.
+    paged_attn_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, x, mask=None, positions=None, deterministic=True,
@@ -230,11 +237,12 @@ class SelfAttention(nn.Module):
                                  self.rotary_dim, self.rotary_interleaved)
 
         updated_cache = None
+        out = None
         if paged_cache is not None:
             # paged decode: scatter new k/v into the shared block pool
             # through this slot batch's block tables, then attend over the
-            # per-slot gathered view (ops/paged_attention; the caller's
-            # mask covers context length + architecture terms)
+            # per-slot view (ops/paged_attention; the caller's mask covers
+            # context length + architecture terms)
             from deepspeed_tpu.ops.paged_attention import (
                 paged_append, paged_gather,
             )
@@ -242,9 +250,30 @@ class SelfAttention(nn.Module):
             kp, vp = paged_cache
             kp, vp = paged_append(kp, vp, k, v, block_tables, write_pos,
                                   valid_len)
-            k = paged_gather(kp, block_tables)
-            v = paged_gather(vp, block_tables)
             updated_cache = (kp, vp)
+            if self.paged_attn_kernel == "pallas" and S == 1:
+                # ragged Pallas decode: the kernel streams live pool
+                # blocks and applies the causal-context mask itself; the
+                # caller's mask rides along as additive extra terms
+                # (ALiBi, local windows) — its causal component is
+                # redundant with the kernel's own and its fully-masked
+                # entries stay consistent with the ragged skip. When the
+                # caller PROMISES a pure causal-context mask
+                # (assume_causal_mask — the paged llama blocks), skip the
+                # mask input entirely: streaming a [B, H, S] fp32 mask
+                # per step per layer is exactly the max_context-width
+                # traffic the ragged kernel exists to avoid
+                from deepspeed_tpu.ops.paged_attention_kernel import (
+                    paged_attention_pallas,
+                )
+
+                extra = None if self.assume_causal_mask else mask
+                out = paged_attention_pallas(
+                    q, kp, vp, block_tables, positions, mask_extra=extra,
+                    scale=self.attn_scale)
+            else:
+                k = paged_gather(kp, block_tables)
+                v = paged_gather(vp, block_tables)
         elif kv_cache is not None:
             # decode: append new k/v at cache_index (functional KV cache)
             ck, cv = kv_cache
@@ -253,42 +282,44 @@ class SelfAttention(nn.Module):
             k, v = ck, cv
             updated_cache = (ck, cv)
 
-        # grouped-query: repeat kv heads
-        if n_kv != self.num_heads:
-            rep = self.num_heads // n_kv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if out is None:
+            # grouped-query: repeat kv heads
+            if n_kv != self.num_heads:
+                rep = self.num_heads // n_kv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
 
-        # "auto": XLA attention for short sequences (fusion wins), the
-        # Pallas flash kernel (fwd + FlashAttention-2 bwd) once the S^2
-        # score traffic dominates — measured training crossover ~1k on
-        # v5e (see flash_min_seqlen).
-        # flash implements ONLY causal masking at default scale, so auto
-        # requires the caller's promise that `mask` is pure-causal and no
-        # custom scale / active dropout is in play.
-        impl = self.attention_impl
-        if impl == "auto":
-            flash_ok = (self.assume_causal_mask
-                        and self.attn_scale is None
-                        and (self.dropout_rate == 0.0 or deterministic))
-            impl = "flash" if (flash_ok
-                               and x.shape[1] >= self.flash_min_seqlen) \
-                else "xla"
-        caching = kv_cache is not None or paged_cache is not None
-        if impl == "flash" and not caching:
-            from deepspeed_tpu.ops.flash_attention import flash_attention
+            # "auto": XLA attention for short sequences (fusion wins), the
+            # Pallas flash kernel (fwd + FlashAttention-2 bwd) once the S^2
+            # score traffic dominates — measured training crossover ~1k on
+            # v5e (see flash_min_seqlen).
+            # flash implements ONLY causal masking at default scale, so auto
+            # requires the caller's promise that `mask` is pure-causal and no
+            # custom scale / active dropout is in play.
+            impl = self.attention_impl
+            if impl == "auto":
+                flash_ok = (self.assume_causal_mask
+                            and self.attn_scale is None
+                            and (self.dropout_rate == 0.0 or deterministic))
+                impl = "flash" if (flash_ok
+                                   and x.shape[1] >= self.flash_min_seqlen) \
+                    else "xla"
+            caching = kv_cache is not None or paged_cache is not None
+            if impl == "flash" and not caching:
+                from deepspeed_tpu.ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
-        elif impl in ("ulysses", "ring", "ring_flash") and not caching:
-            out = _sequence_parallel_attention(q, k, v, impl)
-        else:
-            dropout_rng = None
-            if self.dropout_rate > 0.0 and not deterministic:
-                dropout_rng = self.make_rng("dropout")
-            out = dot_product_attention(
-                q, k, v, mask=mask, dropout_rng=dropout_rng,
-                dropout_rate=self.dropout_rate, deterministic=deterministic,
-                dtype=self.dtype, scale=self.attn_scale)
+                out = flash_attention(q, k, v, causal=True)
+            elif impl in ("ulysses", "ring", "ring_flash") and not caching:
+                out = _sequence_parallel_attention(q, k, v, impl)
+            else:
+                dropout_rng = None
+                if self.dropout_rate > 0.0 and not deterministic:
+                    dropout_rng = self.make_rng("dropout")
+                out = dot_product_attention(
+                    q, k, v, mask=mask, dropout_rng=dropout_rng,
+                    dropout_rate=self.dropout_rate,
+                    deterministic=deterministic,
+                    dtype=self.dtype, scale=self.attn_scale)
 
         out = out.reshape(B, S, self.num_heads * head_dim)
         o_bias = self.use_bias if self.out_bias is None else self.out_bias

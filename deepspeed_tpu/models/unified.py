@@ -238,6 +238,9 @@ class UnifiedBlock(nn.Module):
 
     cfg: TransformerConfig
     layer_idx: int = 0
+    # paged decode arm (serve.attn_kernel) — forwarded to SelfAttention;
+    # inert outside the paged-cache path
+    attn_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, x, mask, positions, kv_cache=None, cache_index=None,
@@ -250,6 +253,7 @@ class UnifiedBlock(nn.Module):
             rotary_dim=cfg.rotary_dim, rotary_interleaved=cfg.rotary_interleaved,
             dtype=cfg.dtype, use_bias=cfg.attn_bias,
             out_bias=cfg.attn_out_bias, attn_scale=cfg.attn_scale,
+            paged_attn_kernel=self.attn_kernel,
             name="attn")
         if cfg.is_moe_layer(self.layer_idx):
             mlp = DenseRoutedMoE(
@@ -615,11 +619,16 @@ class PagedTransformerDecoderModel(nn.Module):
     block_tables: int32 [B, W]; write_pos: int32 [B] — per-slot context
     length before this call (0 for prefill); valid_len: int32 [B] or None —
     tokens of the T axis that are real per row (right-padding/inactive
-    slots write to the null block). Exact same mask/position math as the
-    dense twin, only over the gathered block axis.
+    slots write to the null block). ``attn_kernel``: paged decode arm
+    (serve.attn_kernel) — the Pallas ragged kernel consumes the SAME
+    additive mask terms (ALiBi, per-layer windows) as extra bias on top
+    of its own context masking, so the architecture zoo serves through
+    either arm. Exact same mask/position math as the dense twin, only
+    over the gathered block axis.
     """
 
     cfg: TransformerConfig
+    attn_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, input_ids, kv_pools, block_tables, write_pos,
@@ -673,7 +682,9 @@ class PagedTransformerDecoderModel(nn.Module):
                 w = cfg.attn_windows[i]
                 mask = mask + jnp.where(col > row_pos[:, None, :, None] - w,
                                         0.0, neg)
-            x, (ck, cv) = UnifiedBlock(cfg, layer_idx=i, name=f"layer_{i}")(
+            x, (ck, cv) = UnifiedBlock(cfg, layer_idx=i,
+                                       attn_kernel=self.attn_kernel,
+                                       name=f"layer_{i}")(
                 x, mask, positions,
                 paged_cache=(kv_pools[0][i], kv_pools[1][i]),
                 block_tables=block_tables, write_pos=write_pos,

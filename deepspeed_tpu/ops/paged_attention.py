@@ -12,9 +12,10 @@ This module is the jnp REFERENCE implementation: the gather through the
 block table is an XLA gather and the attention core reuses
 ``models.transformer.dot_product_attention`` semantics, exact-match tested
 against the dense-cache decode path on the CPU mesh
-(tests/unit/inference/test_paged_attention.py). A Pallas flash-style
-variant that never materializes the gathered K/V can slot in behind the
-same signatures later.
+(tests/unit/inference/test_paged_attention.py). The Pallas ragged decode
+kernel that never materializes the gathered K/V lives behind the same
+signatures in ``ops/paged_attention_kernel.py`` (``serve.attn_kernel``);
+this reference is its parity oracle and the off-TPU serving path.
 
 Conventions:
 

@@ -1,0 +1,369 @@
+"""Pallas TPU ragged paged-attention decode kernel.
+
+Drop-in for the jnp reference ops in ``ops/paged_attention.py``
+(:func:`paged_attention` / :func:`paged_attention_int8` signatures): where
+the reference materializes the full-width ``pool[block_tables]`` gather —
+``B x W x bs`` tokens including null-block garbage, then ``jnp.repeat``
+for GQA — this kernel streams ONE live pool block at a time into VMEM and
+accumulates flash-style online softmax, so per-step KV bytes scale with
+each slot's LIVE context instead of ``max_context``
+(Ragged Paged Attention, arXiv:2604.15464; kernel-level serving
+optimization per DeepSpeed-Inference, arXiv:2207.00032).
+
+Design (same pattern family as ops/flash_attention.py / int8_matmul.py):
+
+- grid ``(slot, kv_block)`` with the kv axis innermost; fp32 running
+  max / sum / accumulator live in VMEM scratch across kv steps.
+- block tables and per-slot context lengths ride SCALAR PREFETCH
+  (``pltpu.PrefetchScalarGridSpec``): the index map dereferences
+  ``table[slot, block]`` in SMEM, so each grid step's K/V DMA reads the
+  mapped pool block directly — the gather never exists in HBM.
+- RAGGED iteration: table entries at/past a slot's context length are
+  not streamed. The grid is static ``(B, W)``, but dead steps remap
+  their DMA index to the slot's last live block (consecutive identical
+  block indices are not re-fetched by the pipeline) and skip all
+  compute via ``pl.when`` — the kv bytes moved track ``sum(ctx_i)``,
+  not ``B*W*bs``.
+- GQA broadcasts by INDEXING: q is viewed ``[n_kv, rep, hd]`` and
+  batch-dotted against the shared kv head — no ``jnp.repeat``
+  materialization of K/V.
+- int8 pools (``quant.kv_cache``): the kernel reads int8 payloads and
+  per-(token, head) scale rows, converts int8->f32 in VMEM and applies
+  the scales as post-dot row multiplies — the HBM read stays
+  1 byte/elem with no converted copy (the XLA path materializes one;
+  PERF_ANALYSIS round-4 kv8 note).
+
+DECODE kernel: T == 1 queries (the serving decode step). Prefill calls
+(T > 1) fall back to the jnp reference inside the same wrappers, so
+callers route unconditionally. Off-TPU the kernel runs in interpret
+mode — the tier-1 parity tests pin it bit-close to the reference on the
+CPU mesh (tests/unit/inference/test_paged_attention.py).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.paged_attention import (
+    paged_attention as _reference_attention,
+    paged_attention_int8 as _reference_attention_int8,
+)
+from deepspeed_tpu.utils.jax_compat import pallas_tpu
+
+pl, pltpu = pallas_tpu()
+
+NEG_INF = -1e30
+# additive-mask entries at/below this are treated as fully masked (the
+# callers build masks from jnp.finfo(f32).min; sums of two mask terms
+# overflow to -inf — both sit far below any real score+bias)
+MASK_MASKED = -1e29
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv_fn):
+    """One flash-style accumulation step over a ``[H, bs]`` score block.
+
+    ``pv_fn(p)`` maps probabilities ``[H, bs]`` to the value contribution
+    ``[H, hd]`` (the dense and int8 kernels differ only in how scores and
+    values are scaled). Invalid columns are explicitly ZEROED in p — with
+    ragged masks a whole block can be dead while the running max is still
+    NEG_INF, where the usual exp(s - m) trick would contribute exp(0)=1
+    garbage rows."""
+    m_prev = m_scr[...]
+    l_prev = l_scr[...]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+    corr = jnp.exp(m_prev - m_next)
+    p = jnp.where(valid, jnp.exp(s - m_next[:, :1]), 0.0)
+    l_scr[...] = corr * l_prev + jnp.broadcast_to(
+        jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+    acc_scr[...] = acc_scr[...] * corr[:, :1] + pv_fn(p)
+    m_scr[...] = m_next
+
+
+def _dense_kernel(bt_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, n_kv,
+                  rep, sm_scale, num_w, has_mask):
+    if has_mask:
+        mask_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        mask_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+    ctx = ctx_ref[b]
+    live = (ctx + bs - 1) // bs
+    H = n_kv * rep
+
+    @pl.when(w == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(w < live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [H, hd]
+        k = k_ref[0].astype(jnp.float32)            # [bs, n_kv, hd]
+        v = v_ref[0].astype(jnp.float32)
+        q3 = q.reshape(n_kv, rep, q.shape[-1])
+        kT = jnp.swapaxes(k, 0, 1)                  # [n_kv, bs, hd]
+        s3 = jax.lax.dot_general(q3, kT, (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        s = s3.reshape(H, bs) * sm_scale
+        col = w * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
+        valid = col < ctx
+        if has_mask:
+            mval = mask_ref[0].astype(jnp.float32)  # [H, bs]
+            valid = jnp.logical_and(valid, mval > MASK_MASKED)
+            s = s + jnp.where(mval > MASK_MASKED, mval, 0.0)
+        s = jnp.where(valid, s, NEG_INF)
+        vT = jnp.swapaxes(v, 0, 1)                  # [n_kv, bs, hd]
+
+        def pv(p):
+            p3 = p.reshape(n_kv, rep, bs)
+            out = jax.lax.dot_general(
+                p3, vT, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return out.reshape(H, out.shape[-1])
+
+        _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv)
+
+    @pl.when(w == num_w - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+def _int8_kernel(bt_ref, ctx_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
+                 o_ref, m_scr, l_scr, acc_scr, *, bs, n_kv, rep, sm_scale,
+                 num_w):
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+    ctx = ctx_ref[b]
+    live = (ctx + bs - 1) // bs
+    H = n_kv * rep
+
+    @pl.when(w == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(w < live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [H, hd]
+        # int8 -> f32 IN VMEM: the HBM read was 1 byte/elem
+        kq = kq_ref[0].astype(jnp.float32)          # [bs, n_kv, hd]
+        vq = vq_ref[0].astype(jnp.float32)
+        ksT = jnp.swapaxes(ks_ref[0].astype(jnp.float32), 0, 1)  # [n_kv, bs]
+        vsT = jnp.swapaxes(vs_ref[0].astype(jnp.float32), 0, 1)
+        q3 = q.reshape(n_kv, rep, q.shape[-1])
+        kT = jnp.swapaxes(kq, 0, 1)                 # [n_kv, bs, hd]
+        s3 = jax.lax.dot_general(q3, kT, (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        # per-(token, head) K scales factor out of the dot over hd —
+        # post-dot row multiply, same math as the jnp reference
+        s3 = s3 * ksT[:, None, :]
+        s = s3.reshape(H, bs) * sm_scale
+        col = w * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
+        valid = col < ctx
+        s = jnp.where(valid, s, NEG_INF)
+        vT = jnp.swapaxes(vq, 0, 1)                 # [n_kv, bs, hd]
+
+        def pv(p):
+            p3 = p.reshape(n_kv, rep, bs) * vsT[:, None, :]
+            out = jax.lax.dot_general(
+                p3, vT, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return out.reshape(H, out.shape[-1])
+
+        _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv)
+
+    @pl.when(w == num_w - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+def _ragged_specs(B, W, bs, H, hd):
+    """(q_spec, page_map, out_spec, mask_map) for the (slot, kv_block)
+    grid. ``page_map`` dereferences the prefetched block table; dead
+    steps (block >= the slot's live count) remap to the last live block
+    so the pipeline sees a repeated index and skips the re-fetch."""
+
+    def page_map(b, w, bt_ref, ctx_ref):
+        live = jnp.maximum((ctx_ref[b] + bs - 1) // bs, 1)
+        w_eff = jnp.minimum(w, live - 1)
+        return (bt_ref[b, w_eff], 0, 0, 0)
+
+    def mask_map(b, w, bt_ref, ctx_ref):
+        live = jnp.maximum((ctx_ref[b] + bs - 1) // bs, 1)
+        return (b, 0, jnp.minimum(w, live - 1))
+
+    q_spec = pl.BlockSpec((1, H, hd), lambda b, w, bt_ref, ctx_ref: (b, 0, 0))
+    out_spec = pl.BlockSpec((1, H, hd),
+                            lambda b, w, bt_ref, ctx_ref: (b, 0, 0))
+    return q_spec, page_map, out_spec, mask_map
+
+
+def _ctx_lengths(row_pos: jnp.ndarray, S: int) -> jnp.ndarray:
+    """Per-slot attendable length: the reference masks ``col <= row_pos``,
+    i.e. ``row_pos + 1`` logical positions. Clamped to [1, S] so inactive
+    slots (stale positions, all-null tables) stay in-bounds — they read
+    the null block and their output is ignored, exactly like the
+    reference gather."""
+    return jnp.clip(row_pos[:, 0].astype(jnp.int32) + 1, 1, S)
+
+
+def paged_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                           row_pos: jnp.ndarray,
+                           mask_extra: Optional[jnp.ndarray] = None,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Pallas ragged decode behind the :func:`paged_attention` signature.
+
+    q: [B, 1, H, hd] decode queries (T > 1 — prefill — falls back to the
+    jnp reference: prompt processing is MXU-bound and happens once per
+    request, while this kernel exists for the per-step KV traffic).
+    ``mask_extra`` ([B|1, H|1, 1, S]) adds architecture terms (ALiBi,
+    local windows) exactly as in the reference; entries <= -1e29 are
+    treated as fully masked.
+    """
+    if pl is None:
+        raise RuntimeError(
+            "the Pallas TPU surface is unavailable on this jax build — "
+            "use serve.attn_kernel='reference'")
+    B, T, H, hd = q.shape
+    if T != 1:
+        return _reference_attention(q, k_pool, v_pool, block_tables,
+                                    row_pos, mask_extra=mask_extra,
+                                    scale=scale)
+    nb, bs, n_kv, _ = k_pool.shape
+    W = block_tables.shape[1]
+    S = W * bs
+    rep = H // n_kv
+    sm_scale = float(scale) if scale is not None else float(hd) ** -0.5
+    ctx = _ctx_lengths(row_pos, S)
+    q_spec, page_map, out_spec, mask_map = _ragged_specs(B, W, bs, H, hd)
+    kv_spec = pl.BlockSpec((1, bs, n_kv, hd), page_map)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [q[:, 0], k_pool, v_pool]
+    has_mask = mask_extra is not None
+    if has_mask:
+        mask = jnp.broadcast_to(mask_extra.astype(jnp.float32),
+                                (B, H, 1, S))[:, :, 0, :]
+        in_specs.append(pl.BlockSpec((1, H, bs), mask_map))
+        inputs.append(mask)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, W),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_dense_kernel, bs=bs, n_kv=n_kv, rep=rep,
+                          sm_scale=sm_scale, num_w=W, has_mask=has_mask),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(block_tables.astype(jnp.int32), ctx, *inputs)
+    return out[:, None]
+
+
+def paged_attention_int8_pallas(q: jnp.ndarray, kq_pool: jnp.ndarray,
+                                ks_pool: jnp.ndarray, vq_pool: jnp.ndarray,
+                                vs_pool: jnp.ndarray,
+                                block_tables: jnp.ndarray,
+                                row_pos: jnp.ndarray,
+                                interpret: Optional[bool] = None
+                                ) -> jnp.ndarray:
+    """Pallas ragged decode behind the :func:`paged_attention_int8`
+    signature (quant.kv_cache block pools): int8 payloads + per-(token,
+    head) scale pools, dequantized in VMEM as post-dot multiplies."""
+    if pl is None:
+        raise RuntimeError(
+            "the Pallas TPU surface is unavailable on this jax build — "
+            "use serve.attn_kernel='reference'")
+    B, T, H, hd = q.shape
+    if T != 1:
+        return _reference_attention_int8(q, kq_pool, ks_pool, vq_pool,
+                                         vs_pool, block_tables, row_pos)
+    nb, bs, n_kv, _ = kq_pool.shape
+    W = block_tables.shape[1]
+    S = W * bs
+    rep = H // n_kv
+    ctx = _ctx_lengths(row_pos, S)
+    q_spec, page_map, out_spec, _ = _ragged_specs(B, W, bs, H, hd)
+
+    def scale_map(b, w, bt_ref, ctx_ref):
+        live = jnp.maximum((ctx_ref[b] + bs - 1) // bs, 1)
+        return (bt_ref[b, jnp.minimum(w, live - 1)], 0, 0)
+
+    kv_spec = pl.BlockSpec((1, bs, n_kv, hd), page_map)
+    sc_spec = pl.BlockSpec((1, bs, n_kv), scale_map)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, W),
+        in_specs=[q_spec, kv_spec, sc_spec, kv_spec, sc_spec],
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_int8_kernel, bs=bs, n_kv=n_kv, rep=rep,
+                          sm_scale=float(hd) ** -0.5, num_w=W),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(block_tables.astype(jnp.int32), ctx, q[:, 0], kq_pool, ks_pool,
+      vq_pool, vs_pool)
+    return out[:, None]
+
+
+def resolve_paged_attention(kernel: Optional[str]):
+    """(dense_fn, int8_fn) for a ``serve.attn_kernel`` arm. One dispatch
+    point shared by every paged decode path (fused llama, per-layer
+    llama, unified) so the kernel arm can never drift between them."""
+    if kernel in (None, "reference"):
+        return _reference_attention, _reference_attention_int8
+    if kernel == "pallas":
+        return paged_attention_pallas, paged_attention_int8_pallas
+    raise ValueError(
+        f"attn_kernel={kernel!r}: expected 'pallas' or 'reference'")
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_paged_available() -> bool:
+    """True when the Pallas paged-attention kernel runs on this
+    toolchain (compiled on TPU, interpret mode elsewhere). Probes a
+    1-block call once and caches — jax version skew that breaks the
+    pallas surface (import, PrefetchScalarGridSpec, interpret mode)
+    reports False, and the tests/CI fixture then forces the reference
+    arm (tests/unit/inference/conftest.py)."""
+    if pl is None or pltpu is None or \
+            not hasattr(pltpu, "PrefetchScalarGridSpec"):
+        return False
+    try:
+        q = jnp.zeros((1, 1, 2, 8), jnp.float32)
+        kp = jnp.zeros((2, 4, 1, 8), jnp.float32)
+        bt = jnp.ones((1, 1), jnp.int32)
+        rp = jnp.zeros((1, 1), jnp.int32)
+        out = paged_attention_pallas(q, kp, kp, bt, rp)
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # pragma: no cover - only on skewed toolchains
+        return False

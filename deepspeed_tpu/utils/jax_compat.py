@@ -80,6 +80,24 @@ else:  # pragma: no cover - exercised only on older jax
         return _lax.psum(1, axis_name)
 
 
+# --- pallas TPU surface: import seam for kernel modules -----------------------
+def pallas_tpu():
+    """``(pl, pltpu)`` — the Pallas core and TPU modules — or ``(None,
+    None)`` when the deployed jax lacks the Pallas TPU surface (version
+    skew / stripped builds). New kernel modules import through HERE so a
+    missing/moved pallas import degrades to their documented jnp
+    fallback instead of an ImportError at module import time (the
+    serving stack must stay importable on any toolchain; see
+    ops/paged_attention_kernel.py)."""
+    try:
+        from jax.experimental import pallas as _pl
+        from jax.experimental.pallas import tpu as _pltpu
+
+        return _pl, _pltpu
+    except Exception:  # pragma: no cover - only on skewed toolchains
+        return None, None
+
+
 # --- ambient mesh: jax.sharding.get_abstract_mesh (new) / thread mesh (old) --
 def get_abstract_mesh():
     """The ambient mesh set by :func:`set_mesh`, or None. On pre-

@@ -1,0 +1,51 @@
+"""Inference-test harness: keep tier-1 green across jax version skew.
+
+The Pallas paged-attention kernel runs in interpret mode on the CPU mesh
+— but the pallas surface itself (import path, PrefetchScalarGridSpec,
+interpret mode) has churned across jax releases. Rather than let a skewed
+toolchain fail every serving test:
+
+- tests marked ``pallas`` (the kernel parity suite and the pallas serve
+  arms) are SKIPPED when ``pallas_paged_available()`` probes False;
+- everything else is forced onto ``serve.attn_kernel="reference"`` via an
+  autouse fixture, so the serving stack's behavior tests never depend on
+  the kernel being buildable.
+
+On the deployed toolchain the probe passes and this file is inert (the
+fixture yields immediately); the seam it leans on lives in
+``utils/jax_compat.pallas_tpu`` + ``ops/paged_attention_kernel``.
+"""
+
+import pytest
+
+from deepspeed_tpu.ops.paged_attention_kernel import pallas_paged_available
+
+
+def pytest_collection_modifyitems(config, items):
+    if pallas_paged_available():
+        return
+    skip = pytest.mark.skip(
+        reason="pallas interpret mode unavailable on this jax build "
+               "(ops/paged_attention_kernel.pallas_paged_available)")
+    for item in items:
+        if "pallas" in item.keywords:
+            item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _reference_attn_kernel_without_pallas(monkeypatch):
+    """Force the reference serving arm when the kernel cannot build, so
+    engine-level tests (which resolve ``serve.attn_kernel``) stay green
+    regardless of jax skew."""
+    if not pallas_paged_available():
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        orig = InferenceEngine._resolve_attn_kernel
+
+        def forced(self, override):
+            orig(self, override)       # keep the invalid-arm ValueError
+            return "reference"
+
+        monkeypatch.setattr(InferenceEngine, "_resolve_attn_kernel",
+                            forced)
+    yield

@@ -1,6 +1,9 @@
-"""Paged KV-cache op tests: block scatter/gather round trips and the
+"""Paged KV-cache op tests: block scatter/gather round trips, the
 paged attention reference vs the dense attention core (the exact-parity
-contract the serving layer is built on)."""
+contract the serving layer is built on), and the Pallas ragged decode
+kernel vs the jnp reference (interpret mode on the CPU mesh) across GQA
+ratios, block sizes, partial last blocks, all-null rows, int8 pools and
+ALiBi/window masks."""
 
 import jax
 import jax.numpy as jnp
@@ -13,6 +16,12 @@ from deepspeed_tpu.ops.paged_attention import (
     paged_attention, paged_attention_int8, paged_context_mask, paged_gather,
     write_indices,
 )
+from deepspeed_tpu.ops.paged_attention_kernel import (
+    paged_attention_int8_pallas, paged_attention_pallas,
+    resolve_paged_attention,
+)
+
+pallas = pytest.mark.pallas
 
 
 def test_blocks_for():
@@ -152,3 +161,158 @@ def test_null_block_isolation():
                           jnp.asarray([0], jnp.int32))
     after = np.asarray(kp2)
     np.testing.assert_array_equal(after[1:], before)   # real blocks intact
+
+
+# --- Pallas ragged decode kernel vs the jnp reference ------------------------
+def _ragged_case(seed, H, n_kv, hd, bs, W, ctxs):
+    """Pool + tables + preloaded K/V for a batch of decode slots with
+    per-slot context lengths ``ctxs`` (the T=1 decode shape)."""
+    rng = np.random.default_rng(seed)
+    B = len(ctxs)
+    kp, vp = init_paged_pool(1, B * W + 1, bs, n_kv, hd)
+    kp, vp = kp[0], vp[0]
+    bt = jnp.asarray(
+        1 + np.arange(B * W).reshape(B, W), jnp.int32)
+    S = W * bs
+    k_all = jnp.asarray(rng.normal(size=(B, S, n_kv, hd)), jnp.float32)
+    v_all = jnp.asarray(rng.normal(size=(B, S, n_kv, hd)), jnp.float32)
+    vl = jnp.asarray(ctxs, jnp.int32)
+    kp, vp = paged_append(kp, vp, k_all, v_all, bt,
+                          jnp.zeros(B, jnp.int32), vl)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, hd)), jnp.float32)
+    row_pos = jnp.asarray(np.asarray(ctxs) - 1, jnp.int32)[:, None]
+    return q, kp, vp, bt, row_pos
+
+
+@pallas
+@pytest.mark.parametrize("bs", [8, 16, 32])
+@pytest.mark.parametrize("gqa", [1, 2, 4])
+def test_pallas_decode_parity_dense(bs, gqa):
+    """Ragged kernel == reference across block sizes and GQA ratios,
+    with partially-filled last blocks, an exactly-full table and a
+    1-token context in the same batch."""
+    n_kv, hd, W = 2, 16, 3
+    H = n_kv * gqa
+    ctxs = [2 * bs + bs // 2 + 1, W * bs, 1]     # partial / full / minimal
+    q, kp, vp, bt, row_pos = _ragged_case(bs, H, n_kv, hd, bs, W, ctxs)
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pallas
+def test_pallas_decode_all_null_row():
+    """A slot whose table is all null entries (freed/inactive) must read
+    the null block exactly like the reference gather — same (ignored)
+    output, no NaNs."""
+    bs, n_kv, hd, W = 8, 2, 16, 2
+    q, kp, vp, bt, row_pos = _ragged_case(7, 4, n_kv, hd, bs, W, [9, 3])
+    bt = bt.at[1].set(0)                          # row 1: all-null table
+    row_pos = row_pos.at[1, 0].set(5)             # stale position
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pallas
+@pytest.mark.parametrize("bs", [8, 16, 32])
+def test_pallas_decode_parity_int8(bs):
+    """int8 pools: kernel dequant (in-VMEM post-dot scale multiplies)
+    == the jnp reference's math, per-slot ragged contexts included."""
+    from deepspeed_tpu.models.llama import quantize_kv_heads
+
+    rng = np.random.default_rng(11)
+    n_kv, hd, W = 2, 16, 3
+    H = 4
+    ctxs = [bs + 3, 2 * bs, 1]
+    B = len(ctxs)
+    pools = init_paged_pool(1, B * W + 1, bs, n_kv, hd, int8=True)
+    kq, ks, vq, vs = (p[0] for p in pools)
+    bt = jnp.asarray(1 + np.arange(B * W).reshape(B, W), jnp.int32)
+    S = W * bs
+    k_all = jnp.asarray(rng.normal(size=(B, S, n_kv, hd)), jnp.float32)
+    v_all = jnp.asarray(rng.normal(size=(B, S, n_kv, hd)), jnp.float32)
+    kq8, ks8 = quantize_kv_heads(k_all)
+    vq8, vs8 = quantize_kv_heads(v_all)
+    wp = jnp.zeros(B, jnp.int32)
+    vl = jnp.asarray(ctxs, jnp.int32)
+    kq, vq = paged_append(kq, vq, kq8, vq8, bt, wp, vl)
+    ks = paged_append_scales(ks, ks8, bt, wp, vl)
+    vs = paged_append_scales(vs, vs8, bt, wp, vl)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, hd)), jnp.float32)
+    row_pos = jnp.asarray(np.asarray(ctxs) - 1, jnp.int32)[:, None]
+    out = paged_attention_int8_pallas(q, kq, ks, vq, vs, bt, row_pos,
+                                      interpret=True)
+    ref = paged_attention_int8(q, kq, ks, vq, vs, bt, row_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pallas
+def test_pallas_decode_mask_extra_alibi_window():
+    """Architecture mask terms (ALiBi slopes + a local window, the
+    unified-model serving shapes) ride the kernel as additive extras —
+    including a window that fully masks an interior live block."""
+    bs, n_kv, hd, W = 8, 2, 16, 3
+    H = 4
+    ctxs = [2 * bs + 5, 10]
+    q, kp, vp, bt, row_pos = _ragged_case(13, H, n_kv, hd, bs, W, ctxs)
+    S = W * bs
+    col = jnp.arange(S)[None, None, None, :]
+    win = jnp.where(col > row_pos[:, None, :, None] - 6, 0.0,
+                    jnp.finfo(jnp.float32).min)   # masks whole block 0
+    rel = (col[0, 0] - row_pos[:, :, None]).astype(jnp.float32)
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    ab = (alibi_slopes(H)[None, :, None, None] * rel[:, None, :, :])
+    mask = ab + win
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, mask_extra=mask,
+                                 interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos, mask_extra=mask)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pallas
+def test_pallas_decode_scale_override():
+    """attn_scale=1.0 (GPT-Neo) flows through the kernel's sm_scale."""
+    bs, n_kv, hd, W = 8, 2, 16, 2
+    q, kp, vp, bt, row_pos = _ragged_case(17, 4, n_kv, hd, bs, W, [11, 5])
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, scale=1.0,
+                                 interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos, scale=1.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pallas
+def test_pallas_prefill_falls_back_to_reference():
+    """T > 1 (prefill rows) returns the jnp reference EXACTLY — the
+    kernel is a decode kernel; routing is unconditional at call sites."""
+    rng = np.random.default_rng(19)
+    bs, n_kv, hd, W = 8, 2, 16, 2
+    H, B, T = 4, 2, 5
+    kp, vp = init_paged_pool(1, B * W + 1, bs, n_kv, hd)
+    kp, vp = kp[0], vp[0]
+    bt = jnp.asarray(1 + np.arange(B * W).reshape(B, W), jnp.int32)
+    k = jnp.asarray(rng.normal(size=(B, T, n_kv, hd)), jnp.float32)
+    kp, vp = paged_append(kp, vp, k, k, bt, jnp.zeros(B, jnp.int32), None)
+    q = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
+    row_pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos)
+    ref = paged_attention(q, kp, vp, bt, row_pos)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_resolve_paged_attention_arms():
+    ref = resolve_paged_attention("reference")
+    assert ref == (paged_attention, paged_attention_int8)
+    assert resolve_paged_attention(None) == ref
+    pal = resolve_paged_attention("pallas")
+    assert pal == (paged_attention_pallas, paged_attention_int8_pallas)
+    with pytest.raises(ValueError, match="attn_kernel"):
+        resolve_paged_attention("cuda")

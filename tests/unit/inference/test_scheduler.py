@@ -261,3 +261,187 @@ def test_blocks_for():
     assert blocks_for(1, 4) == 1
     assert blocks_for(4, 4) == 1
     assert blocks_for(5, 4) == 2
+
+
+# --- on-demand block allocation (grow / stall / resume / preempt) ------------
+class ChunkedFake(FakeExecutor):
+    """FakeExecutor emitting ``decode_chunk`` tokens per call (the real
+    executor's chunked shape, including the advertised attribute the
+    scheduler derives its growth horizon from)."""
+
+    decode_chunk = 4
+
+    def decode(self, tokens, bt, seq_lens, active, steps_left,
+               max_steps=None):
+        self.decode_calls.append((tokens.copy(), active.copy(),
+                                  steps_left.copy(), max_steps))
+        n = self.decode_chunk if max_steps is None \
+            else max(1, min(int(max_steps), self.decode_chunk))
+        out = np.zeros((len(tokens), n), np.int32)
+        for s in range(len(tokens)):
+            if active[s]:
+                base = tokens[s] % 100
+                rid = self.slot_reqs[s].rid
+                for i in range(n):
+                    out[s, i] = rid * 100 + base + i + 1
+        return out
+
+
+def test_on_demand_admits_more_concurrent_slots_than_upfront():
+    """THE reservation→on-demand win: at equal pool size, admission-time
+    worst-case reservation caps concurrency where on-demand allocation
+    (prompt blocks now, growth at decode boundaries) runs strictly more
+    slots at once — and still completes every request exactly."""
+    def run(reserve_upfront):
+        ex = FakeExecutor()
+        pool = BlockPool(6, 4)                   # 5 usable blocks
+        sched = ContinuousBatchingScheduler(ex, 3, pool, 6,
+                                            reserve_upfront=reserve_upfront)
+        for rid in (1, 2, 3):
+            # 4+8 tokens: upfront claims 3 blocks at admission; on-demand
+            # claims 1 (prompt) and grows
+            sched.submit(req(rid, plen=4, gen=8))
+        sched.step()
+        concurrent = int(sched.active.sum())
+        comps = drain(sched)
+        return concurrent, comps
+
+    up_concurrent, up_comps = run(True)
+    od_concurrent, od_comps = run(False)
+    assert up_concurrent == 1                    # 3 blocks each, 5 usable
+    assert od_concurrent == 3                    # prompt blocks only
+    assert od_concurrent > up_concurrent
+    for comps in (up_comps, od_comps):
+        assert sorted(c.rid for c in comps) == [1, 2, 3]
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, c.rid * 100 + np.arange(8))
+
+
+def test_grow_stall_resume():
+    """A slot the pool cannot grow STALLS (no decode participation, no
+    crash, tables intact) and resumes the step blocks free — its token
+    stream is exactly what an unconstrained run produces."""
+    ex = FakeExecutor()
+    pool = BlockPool(4, 4)                       # 3 usable
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6)
+    sched.submit(req(1, plen=4, gen=4))          # 2 blocks total
+    sched.submit(req(2, plen=4, gen=4))          # 2 blocks total
+    sched.step()
+    # both admitted (1 prompt block each); the third block went to slot
+    # 0's first-decode grow — slot 1 stalls, decode ran slot 0 only
+    assert sched.active.tolist() == [True, True]
+    assert sched.stalled.tolist() == [False, True]
+    assert ex.decode_calls[-1][1].tolist() == [True, False]
+    assert pool.num_free == 0
+    comps = []
+    while not comps:
+        comps.extend(sched.step())               # r1 decodes to completion
+    assert comps[0].rid == 1
+    np.testing.assert_array_equal(comps[0].tokens, 100 + np.arange(4))
+    sched.step()                                 # r2 grows from freed blocks
+    assert sched.stalled.tolist() == [False, False]
+    comps.extend(drain(sched))
+    c2 = next(c for c in comps if c.rid == 2)
+    np.testing.assert_array_equal(c2.tokens, 200 + np.arange(4))
+    assert sched.preemptions == 0                # pure stall-resume
+    assert pool.num_free == pool.num_blocks - 1
+
+
+def test_grow_at_chunk_boundary_accounting():
+    """With a chunked executor the table grows exactly to cover the next
+    chunk's writes — pool occupancy tracks live tokens, never the
+    admission-time worst case."""
+    ex = ChunkedFake()
+    pool = BlockPool(17, 4)
+    sched = ContinuousBatchingScheduler(ex, 1, pool, 8)
+    sched.submit(req(1, plen=4, gen=16))         # worst case would be 5 blocks
+    sched.step()
+    # admission: 1 block (prompt 4); growth: cover seq 4 + min(4, 15) = 8
+    # -> 2 blocks; NOT the upfront 5
+    assert pool.num_allocated == 2
+    assert sched.slots[0].seq_len == 8           # chunk of 4 consumed
+    sched.step()
+    assert pool.num_allocated == 3               # cover 8 + 4 = 12
+    assert sched.slots[0].seq_len == 12
+    comps = drain(sched)
+    np.testing.assert_array_equal(comps[0].tokens, 100 + np.arange(16))
+    assert pool.num_free == pool.num_blocks - 1
+
+
+def test_growth_priority_over_new_admissions():
+    """BlockPool exhaustion ordering: when the last free block is needed
+    by an in-flight slot's grow AND the queue head's admission, the grow
+    wins — admitting would convert an in-flight request into a stall."""
+    ex = FakeExecutor()
+    pool = BlockPool(4, 4)                       # 3 usable
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6)
+    sched.submit(req(1, plen=4, gen=8))          # 3 blocks by completion
+    sched.step()                                 # admit + grow to 2 blocks
+    sched.step()                                 # seq 5
+    sched.step()                                 # seq 6
+    sched.step()                                 # seq 7
+    assert sched.slots[0].seq_len == 8           # exactly at a boundary
+    assert pool.num_free == 1                    # one block left to fight over
+    sched.submit(req(2, plen=4, gen=4))          # wants the last free block
+    sched.step()                                 # r1 hits its block boundary
+    assert not sched.stalled[0]                  # r1 got the block
+    assert [r.rid for r in sched.queue] == [2]   # r2 waited
+    comps = drain(sched)
+    assert [c.rid for c in comps] == [1, 2]      # FIFO held
+    for c in comps:
+        np.testing.assert_array_equal(
+            c.tokens, c.rid * 100 + np.arange(len(c.tokens)))
+
+
+def test_total_stall_preempts_youngest_and_restarts():
+    """All active slots stalled on an empty pool: the youngest slot is
+    preempted (blocks recycle, request requeues at the FIFO head) so the
+    older slot resumes — and the preempted request's final output is the
+    full regeneration from its prompt."""
+    ex = FakeExecutor()
+    pool = BlockPool(3, 4)                       # 2 usable: both stall at once
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6)
+    sched.submit(req(1, plen=4, gen=4))
+    sched.submit(req(2, plen=4, gen=4))
+    comps = drain(sched)
+    assert sched.preemptions >= 1
+    assert [c.rid for c in comps] == [1, 2]      # FIFO survived preemption
+    for c in comps:
+        np.testing.assert_array_equal(c.tokens,
+                                      c.rid * 100 + np.arange(4))
+    assert pool.num_free == pool.num_blocks - 1  # no leaked blocks
+
+
+def test_reserve_upfront_never_stalls():
+    """The A/B compat mode: worst-case admission reservation means no
+    growth, no stalls, no preemptions — the PR-1 policy exactly."""
+    ex = FakeExecutor()
+    pool = BlockPool(17, 4)
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6,
+                                        reserve_upfront=True)
+    sched.submit(req(1, plen=4, gen=4))
+    sched.step()
+    assert pool.num_allocated == 2               # 8 tokens reserved upfront
+    drain(sched)
+    assert sched.preemptions == 0
+    assert pool.num_free == pool.num_blocks - 1
+
+
+def test_occupancy_log_records_pool_series():
+    ex = FakeExecutor()
+    pool = BlockPool(9, 4)
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6,
+                                        record_occupancy=True)
+    sched.submit(req(1, plen=4, gen=4))
+    sched.submit(req(2, plen=4, gen=6))
+    drain(sched)
+    log = sched.occupancy_log
+    assert log and {"t", "blocks_allocated", "blocks_free", "live_tokens",
+                    "active_slots", "stalled_slots",
+                    "queued"} <= set(log[0])
+    usable = pool.num_blocks - 1
+    assert all(e["blocks_allocated"] + e["blocks_free"] == usable
+               for e in log)
+    assert log[-1]["blocks_allocated"] == 0      # drained
+    assert max(e["blocks_allocated"] for e in log) > 0

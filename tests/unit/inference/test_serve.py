@@ -167,3 +167,55 @@ def test_generate_stream_yields_in_finish_order(llama_engine):
     assert sorted(r for r, _ in seen) == list(range(5))
     finishes = [t for _, t in seen]
     assert finishes == sorted(finishes)
+
+
+def test_serve_speculative_raises(llama_engine):
+    """serve()/generate_stream() + speculative= must fail LOUDLY (the
+    paged path has no draft arena) — mirroring the generate() guard —
+    instead of silently serving non-speculatively."""
+    with pytest.raises(ValueError, match="non-speculative"):
+        llama_engine.serve(mixed_requests(1), num_slots=2, block_size=4,
+                           speculative="prompt_lookup")
+
+
+def test_serve_rejects_unknown_attn_kernel(llama_engine):
+    with pytest.raises(ValueError, match="attn_kernel"):
+        llama_engine.serve(mixed_requests(1), num_slots=2, block_size=4,
+                           attn_kernel="cuda")
+
+
+@pytest.mark.pallas
+def test_serve_pallas_kernel_greedy_parity(llama_engine):
+    """The full serving loop on the Pallas ragged decode arm (interpret
+    mode on the CPU mesh) reproduces generate() exactly — decode steps
+    run the kernel, prefill rows take its in-wrapper reference
+    fallback."""
+    reqs = mixed_requests(3, seed=21)
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4,
+                               attn_kernel="pallas")
+    assert sorted(c.rid for c in comps) == list(range(3))
+    assert_greedy_parity(llama_engine, comps)
+
+
+def test_serve_records_occupancy_series(llama_engine):
+    comps = llama_engine.serve(mixed_requests(3), num_slots=2, block_size=4,
+                               record_occupancy=True)
+    assert sorted(c.rid for c in comps) == list(range(3))
+    log = llama_engine.last_serve_occupancy
+    assert log and log[-1]["blocks_allocated"] == 0
+    assert max(e["live_tokens"] for e in log) > 0
+    # on-demand: peak allocation stays below the worst-case reservation
+    # (sum of ceil((prompt+gen)/bs) over concurrently admitted requests
+    # is what reserve_upfront would pin from admission)
+    assert all(e["blocks_allocated"] + e["blocks_free"]
+               == log[0]["blocks_allocated"] + log[0]["blocks_free"]
+               for e in log)
+
+
+def test_serve_reserve_upfront_compat_parity(llama_engine):
+    """The A/B policy knob: worst-case reservation still serves exact
+    greedy streams (it is the PR-1 behavior, kept for occupancy A/Bs)."""
+    comps = llama_engine.serve(mixed_requests(3, seed=5), num_slots=2,
+                               block_size=4, reserve_upfront=True)
+    assert sorted(c.rid for c in comps) == list(range(3))
+    assert_greedy_parity(llama_engine, comps)

@@ -242,8 +242,20 @@ def pld_main():
     }))
 
 
+def assert_traces_equal(a, b):
+    """A/B hygiene: both arms must replay the IDENTICAL request sequence
+    (prompt tokens, generation budgets, arrival offsets) — seeded trace
+    regeneration plus this assert makes that a property of the bench,
+    not a hope (bench.py --serve --trace-seed N)."""
+    assert len(a) == len(b), (len(a), len(b))
+    for (pa, ga, oa), (pb, gb, ob) in zip(a, b):
+        assert ga == gb and oa == ob and np.array_equal(pa, pb), \
+            "trace replay diverged between arms"
+
+
 def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
-               seed=0, out_path="BENCH_SERVE.json", kernels=None):
+               seed=0, out_path="BENCH_SERVE.json", kernels=None,
+               trace_seed=None):
     """--serve: continuous batching (paged KV + slot scheduler) vs the
     static whole-batch baseline on a mixed-length Poisson arrival trace,
     PLUS a same-config attention-kernel A/B (jnp reference gather vs the
@@ -339,27 +351,34 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
             trace.append((prompt, g_len, float(arrivals[i])))
         return trace
 
-    trace = make_trace(np.random.default_rng(seed + 1))
+    # --trace-seed: every arm REGENERATES its trace from this seed and
+    # the replays are asserted identical — an A/B where the arms saw
+    # different request sequences measures the traffic, not the arms
+    trace_seed = (seed + 1) if trace_seed is None else int(trace_seed)
+    trace = make_trace(np.random.default_rng(trace_seed))
     total_gen = sum(g for _, g, _ in trace)
     kernels = list(kernels or ("reference", "pallas"))
 
     # --- continuous-batching arms (reference / pallas attention) -------------
     def run_serve(timed: bool, attn_kernel: str):
+        arm_trace = make_trace(np.random.default_rng(trace_seed))
+        assert_traces_equal(trace, arm_trace)
         t0 = time.time() + (0.0 if not timed else 0.01)
         reqs = [Request(rid=i, prompt=p, max_new_tokens=g,
                         arrival_time=(t0 + off) if timed else None)
-                for i, (p, g, off) in enumerate(trace)]
+                for i, (p, g, off) in enumerate(arm_trace)]
         comps = engine.serve(reqs, num_slots=num_slots,
                              block_size=block_size,
                              decode_chunk=decode_chunk,
                              attn_kernel=attn_kernel,
                              record_occupancy=timed)
         lat = sorted(c.t_finish - c.t_submit for c in comps)
+        ttft = sorted(c.t_first_token - c.t_submit for c in comps)
         qwait = sorted(c.queue_delay for c in comps)
         wall = max(c.t_finish for c in comps) - t0
         occ = engine.last_serve_occupancy if timed else None
         preempt = engine.last_serve_scheduler.preemptions
-        return wall, lat, qwait, occ, preempt
+        return wall, lat, qwait, occ, preempt, ttft
 
     arm_results = {}
     for kern in kernels:
@@ -398,12 +417,14 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
         return xs[min(len(xs) - 1, int(q * len(xs)))]
 
     def arm_stats(kern):
-        wall, lat, qwait, occ, preempt = arm_results[kern]
+        wall, lat, qwait, occ, preempt, ttft = arm_results[kern]
         d = {"attn_kernel": kern,
              "tokens_per_sec": round(total_gen / wall, 1),
              "wall_s": round(wall, 3),
              "latency_p50_s": round(pct(lat, 0.5), 4),
              "latency_p95_s": round(pct(lat, 0.95), 4),
+             "ttft_p50_s": round(pct(ttft, 0.5), 4),
+             "ttft_p95_s": round(pct(ttft, 0.95), 4),
              "queue_wait_p50_s": round(pct(qwait, 0.5), 4),
              "queue_wait_p95_s": round(pct(qwait, 0.95), 4),
              "preemptions": preempt}
@@ -450,7 +471,7 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
         "num_slots": num_slots, "n_requests": n_requests,
         "decode_chunk": decode_chunk, "block_size": block_size,
         "prompt_lens": list(prompt_lens), "gen_mix": list(gen_mix),
-        "poisson_mean_gap_s": mean_gap,
+        "poisson_mean_gap_s": mean_gap, "trace_seed": trace_seed,
         "total_generated_tokens": int(total_gen),
         "block_allocation": "on_demand",
         "useful_token_fraction_static": round(
@@ -485,6 +506,232 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
     if out_path:
         with open(out_path, "w") as f:
             json.dump(result, f, indent=1)
+    return result
+
+
+def serve_prefix_main(num_slots=None, trace_seed=None,
+                      out_path="BENCH_SERVE.json", kernel=None):
+    """--serve --shared-prefix: the prefix-cache A/B on a shared-prefix
+    trace (N personas x M continuations — the system-prompt/few-shot
+    traffic shape), same engine/weights/slots/kernel across arms:
+
+    - ``prefix_on``: serve.prefix_cache on, shared trace — admissions
+      reuse each persona's cached blocks and prefill only the tail;
+    - ``prefix_off``: cache off, same trace — every prompt prefills in
+      full (the PR-2 behavior);
+    - ``unique_baseline``: cache ON over a same-shape trace of UNIQUE
+      prompts — the hit-rate floor that shows the shared-trace hit rate
+      is content reuse, not accounting noise.
+
+    Reports TTFT p50/p95 per arm, block/token cache hit-rates,
+    evictions, and asserts the on/off greedy token streams are
+    IDENTICAL (the cache must be a pure perf optimization) and that all
+    arms replayed the identical request sequence (--trace-seed). Results
+    merge into the existing BENCH_SERVE.json under
+    ``detail.prefix_cache_ab`` (the continuous-vs-static sections stay).
+
+    The persona length is deliberately several prompt buckets long: an
+    offset prefill of the uncached tail drops into a SMALLER compiled
+    bucket (engine.prompt_capacity), so the TTFT win is real compute
+    skipped, not just accounting.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
+            dtype=jnp.bfloat16, scan_layers=True)
+        num_slots = num_slots or 8
+        block_size = 32
+        decode_chunk = 8
+        n_personas, n_cont = 4, 12
+        persona_len = 224                    # 7 full blocks, 2+ buckets
+        cont_lens = (16, 24, 32)
+        gen_mix = (16, 32, 64)
+        mean_gap = 0.05
+    else:
+        cfg = LlamaConfig(
+            vocab_size=4096, hidden_size=512, intermediate_size=1024,
+            num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=512,
+            dtype=jnp.float32)
+        num_slots = num_slots or 4
+        block_size = 8
+        decode_chunk = 8
+        n_personas, n_cont = 3, 8
+        persona_len = 88                     # 11 full blocks; tail
+        cont_lens = (5, 8, 11)               # prefills in the T=32 bucket
+        gen_mix = (8, 12, 16)                # vs 96/128 for cold prompts
+        mean_gap = 0.004
+    kernel = kernel or "reference"
+
+    model = LlamaModel(cfg)
+    params = jax.jit(
+        lambda r: model.init(
+            r, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, params=params, model_config=cfg,
+        config={"dtype": "bfloat16" if on_tpu else "float32"})
+
+    trace_seed = 1 if trace_seed is None else int(trace_seed)
+    n_requests = n_personas * n_cont
+
+    def make_trace(rng, shared: bool):
+        """(prompt, gen, arrival) triples. ``shared``: prompts are
+        persona + continuation; else unique random prompts of the SAME
+        lengths (apples-to-apples hit-rate floor)."""
+        personas = [rng.integers(1, cfg.vocab_size, persona_len)
+                    for _ in range(n_personas)]
+        items = [(p, int(rng.choice(cont_lens)), int(rng.choice(gen_mix)))
+                 for p in personas for _ in range(n_cont)]
+        rng.shuffle(items)
+        arrivals = np.cumsum(rng.exponential(mean_gap, n_requests))
+        trace = []
+        for i, (persona, c_len, g_len) in enumerate(items):
+            cont = rng.integers(1, cfg.vocab_size, c_len)
+            prompt = (np.concatenate([persona, cont]) if shared else
+                      rng.integers(1, cfg.vocab_size,
+                                   persona_len + c_len))
+            trace.append((prompt, g_len, float(arrivals[i])))
+        return trace
+
+    def run_arm(shared: bool, prefix_cache: bool, timed: bool):
+        arm_trace = make_trace(np.random.default_rng(trace_seed), shared)
+        t0 = time.time() + (0.0 if not timed else 0.01)
+        reqs = [Request(rid=i, prompt=p, max_new_tokens=g,
+                        arrival_time=(t0 + off) if timed else None)
+                for i, (p, g, off) in enumerate(arm_trace)]
+        engine.reset_prefix_cache()          # every arm starts COLD
+        comps = engine.serve(reqs, num_slots=num_slots,
+                             block_size=block_size,
+                             decode_chunk=decode_chunk,
+                             attn_kernel=kernel,
+                             prefix_cache=prefix_cache)
+        stats = engine.last_serve_scheduler.prefix_cache_stats()
+        wall = max(c.t_finish for c in comps) - t0
+        return {
+            "trace": arm_trace,
+            "tokens": {c.rid: np.asarray(c.tokens) for c in comps},
+            "ttft": sorted(c.t_first_token - c.t_submit for c in comps),
+            "lat": sorted(c.t_finish - c.t_submit for c in comps),
+            "wall": wall,
+            "stats": stats,
+        }
+
+    def warm_arm(prefix_cache: bool):
+        """Deterministic compile warm-up: which prefill bucket a trace
+        request hits depends on admission order (a cache-hit tail
+        buckets smaller than its cold prompt), so replaying the trace
+        untimed can MISS a bucket the timed run then compiles mid-flight
+        — instead, touch every cold bucket (one distinct persona per
+        continuation length), every hit-tail bucket (repeats), and the
+        CoW copy program (block-aligned full-cover repeats)
+        explicitly."""
+        rng = np.random.default_rng(0)
+        ps = [rng.integers(1, cfg.vocab_size, persona_len)
+              for _ in cont_lens]
+        reqs, rid = [], 0
+        for rep in range(2):
+            for p, c in zip(ps, cont_lens):
+                reqs.append(Request(
+                    rid=rid, max_new_tokens=4,
+                    prompt=np.concatenate(
+                        [p, rng.integers(1, cfg.vocab_size, c)])))
+                rid += 1
+        for _ in range(2):
+            reqs.append(Request(rid=rid, prompt=ps[0], max_new_tokens=4))
+            rid += 1
+        engine.reset_prefix_cache()
+        engine.serve(reqs, num_slots=num_slots, block_size=block_size,
+                     decode_chunk=decode_chunk, attn_kernel=kernel,
+                     prefix_cache=prefix_cache)
+
+    arms_spec = {
+        "prefix_on": (True, True),
+        "prefix_off": (True, False),
+        "unique_baseline": (False, True),
+    }
+    arms = {}
+    for name, (shared, pc) in arms_spec.items():
+        warm_arm(pc)
+        arms[name] = run_arm(shared, pc, timed=True)
+
+    # A/B hygiene: identical replay across the shared-trace arms, and
+    # identical greedy token streams — the cache is a pure perf opt
+    assert_traces_equal(arms["prefix_on"]["trace"],
+                        arms["prefix_off"]["trace"])
+    for rid, toks in arms["prefix_on"]["tokens"].items():
+        assert np.array_equal(toks, arms["prefix_off"]["tokens"][rid]), \
+            f"request {rid}: prefix-cache arm diverged from cache-off"
+
+    def pct(xs, q):
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    total_gen = sum(g for _, g, _ in arms["prefix_on"]["trace"])
+
+    def arm_detail(name):
+        a = arms[name]
+        s = a["stats"]
+        return {
+            "ttft_p50_s": round(pct(a["ttft"], 0.5), 4),
+            "ttft_p95_s": round(pct(a["ttft"], 0.95), 4),
+            "latency_p50_s": round(pct(a["lat"], 0.5), 4),
+            "tokens_per_sec": round(total_gen / a["wall"], 1),
+            "wall_s": round(a["wall"], 3),
+            "block_hit_rate": s["block_hit_rate"],
+            "token_hit_rate": s["token_hit_rate"],
+            "hit_blocks": s["hit_blocks"],
+            "lookup_blocks": s["lookup_blocks"],
+            "evictions": s["evictions"],
+            "prefix_cache": s["enabled"],
+        }
+
+    on, off = arm_detail("prefix_on"), arm_detail("prefix_off")
+    uniq = arm_detail("unique_baseline")
+    uniq_rate = max(uniq["block_hit_rate"], 1e-9)
+    ab = {
+        "arms": {"prefix_on": on, "prefix_off": off,
+                 "unique_baseline": uniq},
+        "trace": {"personas": n_personas, "continuations": n_cont,
+                  "persona_len": persona_len, "cont_lens": list(cont_lens),
+                  "gen_mix": list(gen_mix), "n_requests": n_requests,
+                  "block_size": block_size, "num_slots": num_slots,
+                  "trace_seed": trace_seed, "attn_kernel": kernel,
+                  "poisson_mean_gap_s": mean_gap},
+        "ttft_p50_speedup_x": round(off["ttft_p50_s"]
+                                    / max(on["ttft_p50_s"], 1e-9), 3),
+        "block_hit_rate_vs_unique_x": round(
+            on["block_hit_rate"] / uniq_rate, 1),
+        "greedy_identical": True,            # asserted above
+        "backend": jax.default_backend(),
+    }
+    result = {
+        "metric": "serve_prefix_cache_ttft_p50_s",
+        "value": on["ttft_p50_s"],
+        "unit": "s",
+        "vs_baseline": ab["ttft_p50_speedup_x"],
+        "detail": ab,
+    }
+    print(json.dumps(result))
+    if out_path:
+        # merge under the serve artifact: the continuous-vs-static and
+        # kernel-A/B sections from --serve stay alongside
+        artifact = {}
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            pass
+        artifact.setdefault("detail", {})["prefix_cache_ab"] = ab
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
     return result
 
 
@@ -1413,10 +1660,16 @@ if __name__ == "__main__":
                 sys.exit("--kernel requires reference|pallas|both, e.g. "
                          "bench.py --serve --kernel pallas")
             kernels = None if arm == "both" else [arm]
-        serve_main(num_slots=_intflag("--slots"),
-                   n_requests=_intflag("--requests"),
-                   decode_chunk=_intflag("--chunk"),
-                   kernels=kernels)
+        if "--shared-prefix" in sys.argv:
+            serve_prefix_main(num_slots=_intflag("--slots"),
+                              trace_seed=_intflag("--trace-seed"),
+                              kernel=(kernels or [None])[0])
+        else:
+            serve_main(num_slots=_intflag("--slots"),
+                       n_requests=_intflag("--requests"),
+                       decode_chunk=_intflag("--chunk"),
+                       kernels=kernels,
+                       trace_seed=_intflag("--trace-seed"))
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

@@ -94,6 +94,19 @@ class ServeConfig(DeepSpeedConfigModel):
     # fast path). Parity is pinned in tier-1 via interpret mode
     # (tests/unit/inference/test_paged_attention.py).
     attn_kernel: str = "auto"
+    # PREFIX CACHING (on|off): content-address full KV blocks by their
+    # token ids so prompts sharing a block-aligned prefix (system
+    # prompts, few-shot preambles, multi-turn histories) prefill it once
+    # — later admissions reuse the blocks read-only (refcounted,
+    # copy-on-write where a write would land in a shared block) and
+    # prefill only the uncached tail. Cuts TTFT and pool residency on
+    # shared-prefix traffic (bench.py --serve --shared-prefix measures
+    # the A/B); zero-ref cached blocks are reclaimed LRU-first the
+    # moment admission or growth needs them, so the cache never adds
+    # backpressure. Outputs are exactly the uncached path's (greedy
+    # streams pinned identical in tier-1) — on by default; turn off for
+    # strictly-unique traffic to skip the hashing overhead.
+    prefix_cache: bool = True
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -315,6 +315,11 @@ class PagedServeExecutor:
             np.asarray(jax.random.PRNGKey(i)) for i in range(num_slots)])
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fn = None
+        self._copy_fn = None
+        # host-side prefix-cache pool pinned by the engine so the content
+        # index survives across serve() calls on this executor (the
+        # device pools it describes already do)
+        self._host_pool = None
 
     # --- scheduler protocol ---------------------------------------------------
     def set_slot(self, slot: int, req) -> None:
@@ -325,25 +330,51 @@ class PagedServeExecutor:
         self._rngs[slot] = np.array(
             jax.random.fold_in(jax.random.PRNGKey(req.seed), 0))
 
-    def prefill(self, slot: int, prompt, block_row) -> int:
-        T = int(len(prompt))
+    def prefill(self, slot: int, prompt, block_row, start: int = 0) -> int:
+        """Prefill ``prompt[start:]`` at write position ``start`` —
+        ``start`` > 0 is the prefix-cache hit path: KV for the first
+        ``start`` tokens already sits in the row's shared blocks, so
+        only the uncached tail is computed (the TTFT win), through the
+        same ``T_cap``-bucketed programs (the tail length buckets, so a
+        long shared preamble drops the prefill into a smaller bucket).
+        Returns the first sampled token either way."""
+        start = int(start)
+        T = int(len(prompt)) - start
         T_cap = prompt_capacity(T, self._cfg)
         fn = self._prefill_fns.get(T_cap)
         if fn is None:
             fn = self._build_prefill_fn(T_cap)
             self._prefill_fns[T_cap] = fn
         tokens = np.zeros((1, T_cap), np.int32)
-        tokens[0, :T] = prompt
+        tokens[0, :T] = prompt[start:]
         with self._ctx():
             tok, new_key, self._pools = fn(
                 self._params, jnp.asarray(tokens), self._pools,
                 jnp.asarray(block_row, jnp.int32)[None],
-                jnp.asarray(T, jnp.int32), jnp.asarray(self._rngs[slot]),
+                jnp.asarray(T, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(self._rngs[slot]),
                 jnp.asarray(self._temps[slot]),
                 jnp.asarray(self._top_ks[slot]),
                 jnp.asarray(self._top_ps[slot]))
         self._rngs[slot] = np.array(new_key)
         return int(tok)
+
+    def copy_blocks(self, pairs) -> None:
+        """Prefix-cache CoW: duplicate device KV blocks (src → dst per
+        pair) across every layer and pool array, before the claiming
+        slot's first write (scheduler contract)."""
+        from deepspeed_tpu.ops.paged_attention import copy_pool_blocks
+
+        if self._copy_fn is None:
+            # one jit object; XLA's shape-keyed cache compiles per pair
+            # count (CoW is 1 pair per admission in practice)
+            self._copy_fn = jax.jit(copy_pool_blocks, donate_argnums=(0,))
+        fn = self._copy_fn
+        src = jnp.asarray([p[0] for p in pairs], jnp.int32)
+        dst = jnp.asarray([p[1] for p in pairs], jnp.int32)
+        with self._ctx():
+            self._pools = fn(self._pools, src, dst)
 
     def decode(self, tokens, block_tables, seq_lens, active, steps_left,
                max_steps=None):
@@ -368,12 +399,15 @@ class PagedServeExecutor:
     def _build_prefill_fn(self, T_cap: int):
         paged_apply = self._apply
 
-        def pf(params, tokens, pools, bt, true_len, key, temp, top_k,
-               top_p):
+        def pf(params, tokens, pools, bt, true_len, start, key, temp,
+               top_k, top_p):
             from deepspeed_tpu.inference.sampling import sample_logits
 
+            # ``start`` (traced — no recompile per hit length) is the
+            # cached-prefix offset: positions/writes begin there, and
+            # attention still sees the shared blocks through the table
             logits, pools = paged_apply(
-                params, tokens, pools, bt, jnp.zeros(1, jnp.int32),
+                params, tokens, pools, bt, start[None],
                 true_len[None])
             last = jax.lax.dynamic_index_in_dim(
                 logits, true_len - 1, axis=1, keepdims=False)  # [1, V]
@@ -1038,6 +1072,7 @@ class InferenceEngine:
                         attn_kernel: Optional[str] = None,
                         reserve_upfront: bool = False,
                         record_occupancy: bool = False,
+                        prefix_cache: Optional[bool] = None,
                         speculative: Optional[str] = None):
         """Serve ``requests`` with continuous batching over a paged KV
         cache, yielding a ``Completion`` per request as it finishes.
@@ -1064,8 +1099,21 @@ class InferenceEngine:
         ("pallas" ragged kernel | "reference" jnp gather).
         ``record_occupancy`` keeps a per-step pool time series on
         ``engine.last_serve_occupancy`` (the bench artifact's source).
+        ``prefix_cache`` overrides ``serve.prefix_cache``: when on,
+        prompts sharing a block-aligned prefix (system prompts, few-shot
+        preambles, multi-turn histories) prefill it ONCE — admission
+        reuses the cached blocks read-only (refcounted, copy-on-write
+        where a write would land in a shared block) and prefills only
+        the uncached tail, cutting time-to-first-token and freeing pool
+        capacity for deeper concurrency. Outputs are exactly those of
+        the uncached path (the cache stores KV a cold prefill would
+        recompute bit-identically); the content index persists across
+        ``serve()`` calls that reuse the executor —
+        :meth:`reset_prefix_cache` drops it.
         """
-        from deepspeed_tpu.inference.kv_pool import BlockPool, blocks_for
+        from deepspeed_tpu.inference.kv_pool import (
+            BlockPool, PrefixCachingBlockPool, blocks_for,
+        )
         from deepspeed_tpu.inference.scheduler import (
             ContinuousBatchingScheduler, Request,
         )
@@ -1107,10 +1155,31 @@ class InferenceEngine:
         executor = self._get_serve_executor(num_slots, block_size,
                                             num_blocks, decode_chunk,
                                             attn_kernel)
+        pc = (getattr(self._config, "serve").prefix_cache
+              if prefix_cache is None else bool(prefix_cache))
+        if pc:
+            # reuse the executor's host pool when quiescent: the content
+            # index then spans serve() calls — a second trace sharing the
+            # first one's prefixes starts warm (device KV persisted with
+            # the executor's pools all along). A non-quiescent pool (an
+            # abandoned stream still holds blocks) or a shape change
+            # starts cold instead of guessing.
+            pool = executor._host_pool
+            if (pool is None or pool.num_allocated
+                    or pool.num_blocks != num_blocks
+                    or pool.block_size != block_size):
+                pool = PrefixCachingBlockPool(num_blocks, block_size)
+            executor._host_pool = pool
+        else:
+            # an uncached session writes blocks with no index bookkeeping
+            # — any retained index would lie about device content, so
+            # drop it (next cached session starts cold, never stale)
+            executor._host_pool = None
+            pool = BlockPool(num_blocks, block_size)
         scheduler = ContinuousBatchingScheduler(
-            executor, num_slots, BlockPool(num_blocks, block_size), width,
+            executor, num_slots, pool, width,
             reserve_upfront=reserve_upfront,
-            record_occupancy=record_occupancy)
+            record_occupancy=record_occupancy, prefix_cache=pc)
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log
@@ -1189,6 +1258,14 @@ class InferenceEngine:
             cache.popitem(last=False)          # each entry pins K/V pools
         cache[key] = (self.params, executor)
         return executor
+
+    def reset_prefix_cache(self):
+        """Forget all cached prefixes (host-side content indexes on every
+        cached serving executor). Device pools stay; the next cached
+        serve() starts cold — the bench A/B's between-arms reset."""
+        for _, ex in getattr(self, "_serve_executors",
+                             OrderedDict()).values():
+            ex._host_pool = None
 
     def release_serve_workspace(self):
         """Drop cached serving executors (block pools + compiled

@@ -16,9 +16,20 @@ workspace and rotates it; paged blocks instead recycle at sequence
 granularity, which is what lets new requests stream into freed capacity
 mid-decode (DeepSpeed-Inference arXiv:2207.00032 §serving; Ragged Paged
 Attention arXiv:2604.15464).
+
+:class:`PrefixCachingBlockPool` layers PREFIX CACHING on the same pool:
+full blocks are content-addressed by a chained hash of their token ids
+(:func:`block_content_keys`), held via refcounts so one block can sit in
+many slot tables read-only, retained at refcount 0 on an LRU instead of
+freed, and reclaimed lazily when the free list runs dry — prompt prefixes
+shared across requests (system prompts, few-shot preambles) then prefill
+once and serve many (vLLM-style automatic prefix caching over the
+DeepSpeed-Inference block pool).
 """
 
-from typing import List, Sequence
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -26,6 +37,30 @@ import numpy as np
 # would silently desynchronize the scheduler's accounting from the pool
 # shapes the programs index
 from deepspeed_tpu.ops.paged_attention import blocks_for  # noqa: F401
+
+
+def block_content_keys(tokens, block_size: int, salt: int = 0) -> List[bytes]:
+    """Content-address keys for each FULL block of a token stream.
+
+    Key i is a chained digest of (key_{i-1}, token ids of block i, salt),
+    so equal keys imply equal *prefixes* — the lookup that turns the block
+    pool into a prefix cache can walk keys left to right and stop at the
+    first miss (vLLM-style hash-chained block identity). Only full blocks
+    get keys: a partial block's content is still growing, so it is never
+    shareable. ``salt`` namespaces the index (e.g. per model) — two
+    streams only collide if tokens AND salt match.
+    """
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+    n_full = len(toks) // block_size
+    keys: List[bytes] = []
+    h = hashlib.sha256(b"prefix-cache-salt:%d" % salt).digest()
+    for i in range(n_full):
+        m = hashlib.sha256()
+        m.update(h)
+        m.update(toks[i * block_size:(i + 1) * block_size].tobytes())
+        h = m.digest()
+        keys.append(h)
+    return keys
 
 
 class BlockPool:
@@ -82,6 +117,186 @@ class BlockPool:
             self._allocated.discard(b)
             self._free.append(b)
 
+    def release_blocks(self, ids: Sequence[int]) -> None:
+        """Policy seam for :class:`SlotBlockTables`: a slot dropping its
+        blocks. Plain pools free them outright; the prefix-caching pool
+        overrides this with refcount decrements so shared/cached blocks
+        survive the releasing slot."""
+        self.free(ids)
+
+
+class PrefixCachingBlockPool(BlockPool):
+    """Block pool with a content-addressed prefix-cache index on top.
+
+    Three disjoint states per block (null block 0 is in none of them):
+
+    - FREE: on the free list, content meaningless.
+    - HELD: refcount >= 1 — referenced by that many slot tables. A held
+      block may ALSO be registered in the index (its content is a known
+      token-block), in which case new admissions can share it (refcount
+      goes up) while the writer is still decoding.
+    - CACHED: refcount 0 but registered — content (and the device KV
+      behind it) still valid; sits on an LRU and is reclaimed only when
+      the free list runs dry, so the cache is strictly opportunistic:
+      ``can_allocate``/``num_free`` count cached blocks as allocatable
+      capacity and admission/growth backpressure can never deadlock on
+      cache residency.
+
+    Invariants (hard errors, pinned in
+    tests/unit/inference/test_prefix_cache.py): refcounts never go
+    negative, a referenced block is never evicted, the null block is
+    never indexed or evicted, and a registered block's key can never be
+    silently rebound.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, salt: int = 0):
+        super().__init__(num_blocks, block_size)
+        self.salt = int(salt)
+        self._refs: Dict[int, int] = {}
+        self._index: Dict[bytes, int] = {}          # content key -> block
+        self._block_key: Dict[int, bytes] = {}      # reverse mapping
+        # zero-ref cached blocks, least-recently released first
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0
+
+    # --- capacity: cached blocks are allocatable --------------------------
+    @property
+    def num_free(self) -> int:
+        """Allocatable blocks: truly free + evictable (cached, ref 0).
+        This is the number growth/admission may claim right now — cache
+        residency must never read as pool pressure."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def num_cached(self) -> int:
+        """Zero-ref blocks retained only for prefix reuse."""
+        return len(self._lru)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= self.num_free
+
+    def refcount(self, bid: int) -> int:
+        return self._refs.get(bid, 0)
+
+    def is_cached(self, bid: int) -> bool:
+        return bid in self._block_key
+
+    # --- allocation / refcounting -----------------------------------------
+    def _evict(self, bid: int) -> None:
+        """Drop a CACHED block from the index so its frame can be
+        reallocated. Internal to :meth:`allocate` (LRU order); evicting a
+        referenced block or the null block indicates corrupted
+        accounting and is a hard error, never a silent KV clobber."""
+        if bid == 0:
+            raise ValueError("cannot evict the null block")
+        if self._refs.get(bid, 0):
+            raise RuntimeError(
+                f"evicting block {bid} with refcount {self._refs[bid]} — "
+                f"a shared block's KV would be clobbered")
+        key = self._block_key.pop(bid, None)
+        if key is None:
+            raise RuntimeError(f"block {bid} is not cached")
+        del self._index[key]
+        self._lru.pop(bid, None)
+        self.evictions += 1
+
+    def allocate(self, n: int) -> List[int]:
+        """Pop ``n`` frames: free list first, then LRU eviction of cached
+        blocks. Allocated blocks start with refcount 1 (owned by the
+        claiming slot)."""
+        if n > self.num_free:
+            raise RuntimeError(
+                f"block pool exhausted: requested {n}, free "
+                f"{len(self._free)} + cached {len(self._lru)}")
+        ids = []
+        for _ in range(n):
+            if self._free:
+                ids.append(self._free.pop())
+            else:
+                bid, _ = self._lru.popitem(last=False)   # oldest first
+                self._evict(bid)
+                ids.append(bid)
+        self._allocated.update(ids)
+        for b in ids:
+            self._refs[b] = 1
+        return ids
+
+    def share(self, bid: int) -> None:
+        """Add a table reference to an existing block (cache hit reuse).
+        A CACHED block leaves the LRU — it is pinned until released."""
+        if bid == 0:
+            raise ValueError("cannot share the null block")
+        r = self._refs.get(bid, 0)
+        if r == 0:
+            if bid not in self._block_key:
+                raise ValueError(
+                    f"cannot share block {bid}: neither held nor cached")
+            self._lru.pop(bid, None)
+            self._allocated.add(bid)
+        self._refs[bid] = r + 1
+
+    def release_blocks(self, ids: Sequence[int]) -> None:
+        """Drop one table reference per block. At refcount 0 a registered
+        block parks on the cache LRU (KV intact, evictable); an
+        unregistered one frees outright. Going below zero is a hard
+        error — it means two owners both thought the ref was theirs."""
+        for b in ids:
+            if b == 0:
+                raise ValueError("cannot release the null block")
+            r = self._refs.get(b, 0)
+            if r <= 0:
+                raise ValueError(
+                    f"refcount underflow: block {b} released at ref {r}")
+            r -= 1
+            self._refs[b] = r
+            if r == 0:
+                self._allocated.discard(b)
+                if b in self._block_key:
+                    self._lru[b] = None              # most recent at end
+                else:
+                    self._free.append(b)
+
+    def free(self, ids: Sequence[int]) -> None:
+        raise RuntimeError(
+            "PrefixCachingBlockPool blocks are refcounted — use "
+            "release_blocks(); free() would bypass sharing/cache state")
+
+    # --- content index ----------------------------------------------------
+    def register(self, key: bytes, bid: int) -> bool:
+        """Publish a held block's content key. Returns False (no-op) when
+        the key is already indexed — first writer wins, duplicates just
+        free normally on release (dedup without a device copy). The
+        registering slot must still hold the block (ref >= 1): a
+        zero-ref or free frame has no owner vouching for its content."""
+        if bid == 0:
+            raise ValueError("cannot register the null block")
+        if self._refs.get(bid, 0) < 1:
+            raise ValueError(
+                f"cannot register block {bid}: refcount is 0 — only a "
+                f"holder may publish content")
+        if key in self._index:
+            return False
+        prev = self._block_key.get(bid)
+        if prev is not None and prev != key:
+            raise ValueError(
+                f"block {bid} already registered under a different key — "
+                f"content changed while indexed")
+        self._index[key] = bid
+        self._block_key[bid] = key
+        return True
+
+    def lookup(self, keys: Sequence[bytes]) -> List[int]:
+        """Longest indexed prefix of ``keys`` → block ids. Pure peek: no
+        refcount or LRU mutation (callers pin matches via :meth:`share`
+        before anything can evict them)."""
+        out = []
+        for k in keys:
+            bid = self._index.get(k)
+            if bid is None:
+                break
+            out.append(bid)
+        return out
+
 
 class SlotBlockTables:
     """Per-slot block tables: int32 [num_slots, width], unused entries 0.
@@ -137,11 +352,71 @@ class SlotBlockTables:
         self._slot_blocks[slot].extend(ids)
         self.table[slot, cur:cur + n_blocks] = ids
 
+    def assign_cached(self, slot: int, shared_ids: Sequence[int],
+                      num_tokens: int, cow_src: Optional[int] = None
+                      ) -> Optional[List[Tuple[int, int]]]:
+        """Install a cached-prefix admission: ``shared_ids`` (an indexed
+        block-aligned prefix, used READ-ONLY) followed by fresh blocks
+        covering the rest of ``num_tokens``. Requires a
+        :class:`PrefixCachingBlockPool`.
+
+        ``cow_src`` is the copy-on-write case — the prompt is entirely
+        covered by cached blocks, so the last prompt token must be
+        recomputed (its logits seed sampling) and would land INSIDE the
+        final cached block: that block is not shared; instead the first
+        fresh block becomes its copy target and the returned ``(src,
+        dst)`` pair tells the executor to duplicate the device KV before
+        the slot writes. The shared original is never mutated.
+
+        Returns the copy pairs (possibly empty), or None — with NO state
+        change — when the pool cannot supply the fresh tail
+        (backpressure; the cached prefix is re-released). Callers must
+        apply the device copies before the next pool allocation: the
+        source keeps no reference once this returns, so a later
+        allocation could evict it.
+        """
+        need = blocks_for(num_tokens, self.pool.block_size)
+        if need > self.width:
+            raise ValueError(
+                f"request needs {need} blocks but the block table is "
+                f"{self.width} wide ({self.capacity_tokens()} tokens)")
+        if self._slot_blocks[slot]:
+            raise RuntimeError(f"slot {slot} already holds blocks")
+        shared_ids = list(shared_ids)
+        # pin everything we read — including the CoW source, which must
+        # survive until the device copy — before any allocation can evict
+        pins = shared_ids + ([cow_src] if cow_src is not None else [])
+        for b in pins:
+            self.pool.share(b)
+        n_fresh = need - len(shared_ids)
+        if not self.pool.can_allocate(n_fresh):
+            self.pool.release_blocks(pins)
+            return None
+        fresh = self.pool.allocate(n_fresh)
+        pairs: List[Tuple[int, int]] = []
+        if cow_src is not None:
+            pairs.append((cow_src, fresh[0]))
+            # the pin outlives this call only on the LRU (src stays
+            # indexed); safe because the copy happens before the caller
+            # allocates again
+            self.pool.release_blocks([cow_src])
+        ids = shared_ids + fresh
+        self._slot_blocks[slot] = ids
+        self.table[slot, :need] = ids
+        self.table[slot, need:] = 0
+        return pairs
+
     def release(self, slot: int) -> None:
-        """Recycle a finished slot's blocks back into the pool."""
+        """Recycle a finished slot's blocks back into the pool (with a
+        prefix-caching pool: drop this slot's references — shared/cached
+        blocks survive). Released TAIL-FIRST: the caching pool's LRU
+        appends in release order and evicts oldest-first, so a
+        sequence's tail blocks are reclaimed before its head — a prefix
+        truncated at the tail still matches partially, one missing its
+        head matches nothing (lookup walks keys left to right)."""
         ids = self._slot_blocks[slot]
         if ids:
-            self.pool.free(ids)
+            self.pool.release_blocks(ids[::-1])
         self._slot_blocks[slot] = []
         self.table[slot, :] = 0
 

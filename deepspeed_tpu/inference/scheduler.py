@@ -47,7 +47,16 @@ Executor protocol (duck-typed)::
         # top_p, eos) — isolation per slot is part of the contract
     prefill(slot: int, prompt: np.ndarray, block_row: np.ndarray) -> int
         # write the prompt's KV through the slot's block-table row,
-        # return the first sampled token
+        # return the first sampled token. With prefix caching the
+        # scheduler passes a 4th positional arg ``start`` when (and only
+        # when) a cached prefix was reused: KV for prompt[:start] is
+        # already in the table's shared blocks, so the executor prefills
+        # prompt[start:] at write position ``start`` (offset prefill)
+    copy_blocks(pairs: List[Tuple[int, int]]) -> None
+        # prefix-cache CoW: duplicate device KV of block src into dst for
+        # each (src, dst) pair, across every layer/pool. Called before
+        # the slot's first write; only required of executors driven with
+        # prefix_cache=True
     decode(tokens, block_tables, seq_lens, active, steps_left,
            max_steps) -> np.ndarray
         # one program call over ALL slots: [num_slots] int32 last tokens
@@ -67,7 +76,8 @@ from typing import Any, Deque, Iterable, List, Optional
 import numpy as np
 
 from deepspeed_tpu.inference.kv_pool import (
-    BlockPool, SlotBlockTables, blocks_for,
+    BlockPool, PrefixCachingBlockPool, SlotBlockTables,
+    block_content_keys, blocks_for,
 )
 
 
@@ -144,10 +154,31 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, executor, num_slots: int, pool: BlockPool,
                  table_width: int, reserve_upfront: bool = False,
-                 record_occupancy: bool = False):
+                 record_occupancy: bool = False,
+                 prefix_cache: bool = False):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
+        # PREFIX CACHING: admission looks up the longest cached
+        # block-aligned prefix of each prompt and claims only the
+        # uncached tail (prefill starts at the first uncached token);
+        # completion/preemption release references instead of freeing, so
+        # full blocks stay reusable. Strictly opportunistic: the cache
+        # never holds capacity admission needs (kv_pool.
+        # PrefixCachingBlockPool makes cached blocks allocatable).
+        self.prefix_cache = bool(prefix_cache)
+        if self.prefix_cache and not isinstance(pool,
+                                                PrefixCachingBlockPool):
+            raise ValueError(
+                "prefix_cache=True needs a PrefixCachingBlockPool (got "
+                f"{type(pool).__name__}) — plain pools have no content "
+                "index or refcounts")
+        # hit accounting for the bench artifact / tests: blocks looked
+        # up vs matched, prompt tokens total vs served from cache
+        self.cache_lookup_blocks = 0
+        self.cache_hit_blocks = 0
+        self.cache_hit_tokens = 0
+        self.cache_prompt_tokens = 0
         self.tables = SlotBlockTables(num_slots, table_width, pool)
         self.queue: Deque[Request] = deque()
         self.slots = [_Slot() for _ in range(num_slots)]
@@ -215,15 +246,53 @@ class ContinuousBatchingScheduler:
             admit_tokens = len(req.prompt)
             if self.reserve_upfront:
                 admit_tokens += req.max_new_tokens
-            need = blocks_for(admit_tokens, self.pool.block_size)
-            if not self.pool.can_allocate(need):
-                break                  # backpressure: queue, don't crash
+            start, copy_pairs = 0, []
+            if self.prefix_cache:
+                bs = self.pool.block_size
+                keys = block_content_keys(req.prompt, bs, self.pool.salt)
+                matched = self.pool.lookup(keys)
+                if matched and len(matched) * bs >= len(req.prompt):
+                    # whole prompt cached (block-aligned prompt): the last
+                    # token must still be recomputed — its logits seed
+                    # sampling — and it lands INSIDE the last cached
+                    # block, so that one is copy-on-write instead of
+                    # shared (1-token prefill into a private copy beats
+                    # re-prefilling the whole block)
+                    shared, cow_src = matched[:-1], matched[-1]
+                    start = len(req.prompt) - 1
+                else:
+                    shared, cow_src = matched, None
+                    start = len(shared) * bs
+                res = self.tables.assign_cached(slot_id, shared,
+                                                admit_tokens,
+                                                cow_src=cow_src)
+                if res is None:
+                    break              # backpressure: queue, don't crash
+                copy_pairs = res
+                self.cache_lookup_blocks += len(keys)
+                self.cache_hit_blocks += len(matched)
+                self.cache_hit_tokens += start
+                self.cache_prompt_tokens += len(req.prompt)
+            else:
+                need = blocks_for(admit_tokens, self.pool.block_size)
+                if not self.pool.can_allocate(need):
+                    break              # backpressure: queue, don't crash
+                self.tables.assign(slot_id, admit_tokens)
             self.queue.popleft()
-            self.tables.assign(slot_id, admit_tokens)
             self.executor.set_slot(slot_id, req)
+            if copy_pairs:
+                # device-side CoW duplication BEFORE the slot's first
+                # write (and before any allocation could evict the
+                # source) — executors serving a prefix-cache scheduler
+                # must implement copy_blocks
+                self.executor.copy_blocks(copy_pairs)
             t_admit = time.time()
-            first = int(self.executor.prefill(
-                slot_id, req.prompt, self.tables.table[slot_id]))
+            first = int(
+                self.executor.prefill(slot_id, req.prompt,
+                                      self.tables.table[slot_id], start)
+                if start else
+                self.executor.prefill(slot_id, req.prompt,
+                                      self.tables.table[slot_id]))
             t_first = time.time()
             slot.req = req
             slot.seq_len = len(req.prompt)
@@ -233,6 +302,12 @@ class ContinuousBatchingScheduler:
             slot.t_first = t_first
             self.seq_lens[slot_id] = slot.seq_len
             self.last_tokens[slot_id] = first
+            # EAGER registration: the prompt's full blocks are indexed the
+            # moment their KV exists, so requests sharing a prefix that
+            # are admitted later THIS STEP (or any step while this slot
+            # still decodes) already hit — registration only at
+            # completion would miss every concurrent burst
+            self._register_slot_prefix(slot_id)
             hit_eos = req.eos_id >= 0 and first == req.eos_id
             if slot.remaining == 0 or hit_eos:
                 done.append(self._finish(slot_id, t_first))
@@ -242,6 +317,29 @@ class ContinuousBatchingScheduler:
         return done
 
     # --- completion ----------------------------------------------------------
+    def _register_slot_prefix(self, slot_id: int) -> None:
+        """Index the slot's FULL blocks by content (prompt + generated
+        tokens whose KV is written). Shared blocks already carry these
+        keys (register no-ops); a private block whose content duplicates
+        an indexed one simply stays unregistered and frees normally —
+        first writer wins, no device copy for dedup."""
+        if not self.prefix_cache:
+            return
+        slot = self.slots[slot_id]
+        bs = self.pool.block_size
+        blocks = self.tables.blocks_of(slot_id)
+        n_full = min(slot.seq_len // bs, len(blocks))
+        if n_full < 1:
+            return
+        # KV at position p holds token p of prompt++generated (the last
+        # sampled token's KV is never written, so seq_len bounds this)
+        stream = np.concatenate(
+            [slot.req.prompt, np.asarray(slot.out, np.int32)])
+        keys = block_content_keys(stream[:n_full * bs], bs,
+                                  self.pool.salt)
+        for key, bid in zip(keys, blocks[:n_full]):
+            self.pool.register(key, bid)
+
     def _finish(self, slot_id: int, t_finish: float) -> Completion:
         slot = self.slots[slot_id]
         req = slot.req
@@ -251,6 +349,12 @@ class ContinuousBatchingScheduler:
             t_submit=self._submit_times.pop(req.rid, slot.t_admitted),
             t_admitted=slot.t_admitted, t_first_token=slot.t_first,
             t_finish=t_finish)
+        # index full blocks (now including generated content — a future
+        # prompt that embeds this completion, e.g. a multi-turn
+        # continuation, prefills only its new tokens) BEFORE releasing:
+        # at ref 0 registered blocks park on the cache LRU, unregistered
+        # ones free
+        self._register_slot_prefix(slot_id)
         self.tables.release(slot_id)   # blocks recycle to the pool
         self._clear_slot(slot_id)
         return comp
@@ -305,6 +409,12 @@ class ContinuousBatchingScheduler:
         victim = max((s for s in range(self.num_slots) if self.active[s]),
                      key=lambda s: (self.slots[s].t_admitted, s))
         req = self.slots[victim].req
+        # register before releasing: the victim's prompt blocks park on
+        # the cache LRU instead of freeing, so its restart-from-prompt
+        # readmission hits its OWN prefix and re-prefills only the
+        # partial tail (unless pool pressure evicted the blocks first —
+        # the cache never outranks a grow)
+        self._register_slot_prefix(victim)
         self.tables.release(victim)
         self._clear_slot(victim)
         self.queue.appendleft(req)     # keeps original submit time
@@ -323,6 +433,7 @@ class ContinuousBatchingScheduler:
             "t": now,
             "blocks_allocated": self.pool.num_allocated,
             "blocks_reserved_equiv": reserved_equiv,
+            "blocks_cached": getattr(self.pool, "num_cached", 0),
             "blocks_free": self.pool.num_free,
             "live_tokens": int(self.seq_lens.sum()),
             "active_slots": int(self.active.sum()),
@@ -428,6 +539,26 @@ class ContinuousBatchingScheduler:
     def run(self, poll_interval: float = 0.001) -> List[Completion]:
         """Drain to completion; all completions in finish order."""
         return list(self.run_iter(poll_interval))
+
+    def prefix_cache_stats(self) -> dict:
+        """Prefix-cache effectiveness counters (bench artifact /
+        acceptance pins). Block hit-rate is over full prompt blocks
+        looked up at admission; token hit-rate is prompt tokens whose
+        prefill was skipped over all prompt tokens (the CoW recompute
+        token counts as a miss — it IS re-prefilled)."""
+        lb, hb = self.cache_lookup_blocks, self.cache_hit_blocks
+        tt, ht = self.cache_prompt_tokens, self.cache_hit_tokens
+        return {
+            "enabled": self.prefix_cache,
+            "lookup_blocks": lb,
+            "hit_blocks": hb,
+            "block_hit_rate": round(hb / lb, 4) if lb else 0.0,
+            "prompt_tokens": tt,
+            "hit_tokens": ht,
+            "token_hit_rate": round(ht / tt, 4) if tt else 0.0,
+            "evictions": getattr(self.pool, "evictions", 0),
+            "cached_blocks": getattr(self.pool, "num_cached", 0),
+        }
 
 
 def serve_trace(scheduler: ContinuousBatchingScheduler,

@@ -391,7 +391,12 @@ class PagedLlamaDecoderModel(nn.Module):
 
     kv_pools: (k_pool, v_pool) of [L, num_blocks, block_size, n_kv, hd].
     block_tables: int32 [B, W]. write_pos: int32 [B] — per-slot tokens
-    already in cache (0 for prefill). valid_len: int32 [B] or None —
+    already in cache (0 for a cold prefill; the cached-prefix length for
+    an OFFSET prefill, where the serving prefix cache supplies the first
+    write_pos tokens' KV through shared table entries and only the tail
+    is fed — positions, writes and the causal context mask all derive
+    from write_pos, so T > 1 at any offset is first-class).
+    valid_len: int32 [B] or None —
     real tokens per row along T (right-padding / inactive slots write to
     the null block). ``attn_kernel``: paged decode arm
     (serve.attn_kernel) — Pallas ragged kernel or jnp gather reference.
@@ -988,7 +993,11 @@ class FusedLlamaDecoderModel:
         4-tuple (kq, kscale, vq, vscale) with per-(token, head) scale
         pools [L, nb, bs, n_kv]) indexed
         through per-slot ``block_tables`` [B, W]. ``write_pos`` [B] is
-        each slot's context length before this call; ``valid_len`` [B]
+        each slot's context length before this call — the running
+        sequence length for decode steps, 0 for a cold prefill, and the
+        cached-prefix offset for prefix-cache-hit prefills
+        (the T tail tokens then write/attend from that offset);
+        ``valid_len`` [B]
         masks right-padding/inactive slots (their writes land in the null
         block). Same weight path (``_mm``), same attention math — only
         the cache layout differs, which is what the exact-parity tests

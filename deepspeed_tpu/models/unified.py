@@ -617,7 +617,11 @@ class PagedTransformerDecoderModel(nn.Module):
 
     kv_pools: (k_pool, v_pool) of [L, num_blocks, block_size, n_kv, hd].
     block_tables: int32 [B, W]; write_pos: int32 [B] — per-slot context
-    length before this call (0 for prefill); valid_len: int32 [B] or None —
+    length before this call (0 for a cold prefill; the cached-prefix
+    length for an offset prefill under the serving prefix cache — all
+    position/mask/learned-embedding math derives from it, so a T > 1
+    tail at any offset attends the shared prefix correctly);
+    valid_len: int32 [B] or None —
     tokens of the T axis that are real per row (right-padding/inactive
     slots write to the null block). ``attn_kernel``: paged decode arm
     (serve.attn_kernel) — the Pallas ragged kernel consumes the SAME

@@ -110,6 +110,18 @@ def paged_append_scales(scale_pool: jnp.ndarray, scales: jnp.ndarray,
     return scale_pool.at[bids, offs].set(scales)
 
 
+def copy_pool_blocks(pools, src_ids: jnp.ndarray, dst_ids: jnp.ndarray):
+    """Duplicate whole pool blocks across every layer — the device side
+    of prefix-cache copy-on-write (inference/kv_pool.py): when a slot
+    must write into a block other slot tables read, the host allocates a
+    private frame and this op copies the shared block's KV into it
+    before the write. ``pools`` is any layer-stacked pool pytree
+    ([L, num_blocks, ...] leaves — the dense (k, v) pair or the int8
+    4-tuple with its scale pools); src_ids/dst_ids are int32 [N]."""
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, dst_ids].set(a[:, src_ids]), pools)
+
+
 def paged_gather(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
     """[nb, bs, ...] pool × [B, W] table → [B, W*bs, ...] per-slot view.
 

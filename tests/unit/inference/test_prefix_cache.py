@@ -1,0 +1,358 @@
+"""Prefix-cache unit tests: the content-addressed refcounted block pool
+(kv_pool.PrefixCachingBlockPool), copy-on-write through SlotBlockTables,
+and the scheduler's cached-prefix admission — all host logic over a fake
+executor, no model in the loop.
+
+Invariant pins (acceptance checklist): refcounts never go negative, CoW
+never mutates a shared block in place, evicting a referenced block is a
+hard error, and the null block (0) is never indexed or evicted."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_pool import (
+    BlockPool, PrefixCachingBlockPool, SlotBlockTables,
+    block_content_keys, blocks_for,
+)
+from deepspeed_tpu.inference.scheduler import (
+    ContinuousBatchingScheduler, Request,
+)
+
+from tests.unit.inference.test_scheduler import FakeExecutor, drain
+
+
+# --- content keys -----------------------------------------------------------
+
+def test_block_content_keys_full_blocks_only_and_chained():
+    toks = np.arange(1, 11)                      # 10 tokens, bs 4 -> 2 keys
+    keys = block_content_keys(toks, 4)
+    assert len(keys) == 2
+    # prefix property: same head stream -> same head keys
+    assert block_content_keys(toks[:8], 4) == keys
+    # a different FIRST block changes every downstream key (chained hash)
+    other = block_content_keys(np.concatenate([[99], toks[1:]]), 4)
+    assert other[0] != keys[0] and other[1] != keys[1]
+    # same second block under a different prefix must NOT collide
+    assert other[1] != keys[1]
+
+
+def test_block_content_keys_salt_namespaces():
+    toks = np.arange(8)
+    assert block_content_keys(toks, 4, salt=0) != \
+        block_content_keys(toks, 4, salt=1)
+
+
+# --- pool invariants --------------------------------------------------------
+
+def cached_pool(num_blocks=10, block_size=4):
+    return PrefixCachingBlockPool(num_blocks, block_size)
+
+
+def test_refcount_never_negative():
+    pool = cached_pool()
+    (b,) = pool.allocate(1)
+    pool.release_blocks([b])                     # ref 1 -> 0 (frees)
+    with pytest.raises(ValueError, match="underflow"):
+        pool.release_blocks([b])
+
+
+def test_evicting_referenced_block_is_hard_error():
+    pool = cached_pool()
+    (b,) = pool.allocate(1)
+    pool.register(b"key", b)
+    with pytest.raises(RuntimeError, match="refcount"):
+        pool._evict(b)
+    # allocate-driven eviction can never reach a referenced block: drain
+    # the pool completely — the registered-but-held block survives
+    pool.allocate(pool.num_free)
+    assert pool.is_cached(b) and pool.refcount(b) == 1
+
+
+def test_null_block_never_indexed_or_evicted():
+    pool = cached_pool()
+    with pytest.raises(ValueError, match="null"):
+        pool.register(b"key", 0)
+    with pytest.raises(ValueError, match="null"):
+        pool.share(0)
+    with pytest.raises(ValueError, match="null"):
+        pool.release_blocks([0])
+    with pytest.raises(ValueError, match="null"):
+        pool._evict(0)
+
+
+def test_register_requires_holder_and_dedups():
+    pool = cached_pool()
+    a, b = pool.allocate(2)
+    assert pool.register(b"k", a) is True
+    assert pool.register(b"k", b) is False       # first writer wins
+    pool.release_blocks([b])
+    assert not pool.is_cached(b)                 # unregistered dup freed
+    with pytest.raises(ValueError, match="refcount is 0"):
+        pool.register(b"k2", b)
+    with pytest.raises(ValueError, match="different key"):
+        pool.register(b"k3", a)                  # rebind = content change
+
+
+def test_cached_blocks_are_allocatable_lru_first():
+    """The cache is strictly opportunistic: zero-ref cached blocks count
+    as free capacity and evict oldest-released-first when the free list
+    runs dry — admission can never deadlock on cache residency."""
+    pool = cached_pool(num_blocks=4)             # 3 usable
+    ids = pool.allocate(3)
+    for i, b in enumerate(ids):
+        pool.register(b"k%d" % i, b)
+    pool.release_blocks(ids)                     # all cached, ref 0
+    assert pool.num_cached == 3 and pool.num_free == 3
+    assert pool.can_allocate(3)
+    got = pool.allocate(2)                       # evicts ids[0], ids[1]
+    assert got == ids[:2] and pool.evictions == 2
+    assert not pool.is_cached(ids[0]) and pool.is_cached(ids[2])
+    assert pool.lookup([b"k0"]) == []            # evicted key gone
+
+
+def test_share_pins_and_release_reparks():
+    pool = cached_pool()
+    (b,) = pool.allocate(1)
+    pool.register(b"k", b)
+    pool.release_blocks([b])
+    assert pool.num_cached == 1
+    pool.share(b)                                # cache hit: pinned again
+    assert pool.refcount(b) == 1 and pool.num_cached == 0
+    pool.share(b)
+    assert pool.refcount(b) == 2                 # two tables, one block
+    pool.release_blocks([b, b])
+    assert pool.num_cached == 1                  # parked, content intact
+    with pytest.raises(ValueError, match="neither held nor cached"):
+        pool.share(99)
+
+
+def test_lookup_longest_prefix_stops_at_first_miss():
+    pool = cached_pool()
+    a, b = pool.allocate(2)
+    pool.register(b"k0", a)
+    pool.register(b"k1", b)
+    assert pool.lookup([b"k0", b"k1", b"k2"]) == [a, b]
+    assert pool.lookup([b"kX", b"k1"]) == []     # head miss = no match
+
+
+def test_caching_pool_rejects_raw_free():
+    pool = cached_pool()
+    ids = pool.allocate(1)
+    with pytest.raises(RuntimeError, match="release_blocks"):
+        pool.free(ids)
+
+
+# --- copy-on-write through the tables ---------------------------------------
+
+def test_cow_never_mutates_shared_block_in_place():
+    """Slot B admits a prompt fully covered by cached blocks: the last
+    block is DUPLICATED into a private frame (copy pair returned), the
+    shared original keeps its id, its index entry, and its place in slot
+    A's table."""
+    pool = cached_pool(num_blocks=12)
+    tables = SlotBlockTables(2, 6, pool)
+    tables.assign(0, 8)                          # slot A: 2 blocks
+    a_blocks = tables.blocks_of(0)
+    keys = [b"k0", b"k1"]
+    for k, bid in zip(keys, a_blocks):
+        pool.register(k, bid)
+    matched = pool.lookup(keys)
+    pairs = tables.assign_cached(1, matched[:-1], 8, cow_src=matched[-1])
+    src, dst = pairs[0]
+    assert src == a_blocks[1] and dst != src
+    # shared original untouched: still slot A's, still indexed
+    assert tables.blocks_of(0) == a_blocks
+    assert pool.lookup(keys) == a_blocks
+    # slot B reads the head block shared and writes only its private copy
+    assert tables.blocks_of(1) == [a_blocks[0], dst]
+    assert pool.refcount(a_blocks[0]) == 2
+    assert pool.refcount(a_blocks[1]) == 1       # CoW source not retained
+    assert pool.refcount(dst) == 1 and not pool.is_cached(dst)
+
+
+def test_assign_cached_backpressure_rolls_back():
+    pool = cached_pool(num_blocks=4)             # 3 usable
+    tables = SlotBlockTables(2, 6, pool)
+    tables.assign(0, 8)                          # 2 blocks held
+    a = tables.blocks_of(0)
+    pool.register(b"k0", a[0])
+    assert tables.assign_cached(1, [a[0]], 16) is None   # needs 3 fresh
+    assert pool.refcount(a[0]) == 1              # share rolled back
+    assert pool.num_free == 1                    # nothing leaked
+
+
+# --- scheduler: cached-prefix admission -------------------------------------
+
+class PrefixFakeExecutor(FakeExecutor):
+    """FakeExecutor speaking the prefix-cache executor extensions: offset
+    prefill (4th positional arg) and CoW block copies."""
+
+    def __init__(self):
+        super().__init__()
+        self.copies = []
+
+    def prefill(self, slot, prompt, block_row, start=0):
+        self.prefills.append((slot, len(prompt), int(start),
+                              block_row.copy()))
+        return self.slot_reqs[slot].rid * 100
+
+    def copy_blocks(self, pairs):
+        self.copies.append(list(pairs))
+
+
+def make_psched(num_slots=2, num_blocks=17, block_size=4, width=6):
+    ex = PrefixFakeExecutor()
+    pool = PrefixCachingBlockPool(num_blocks, block_size)
+    sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
+                                        prefix_cache=True)
+    return sched, ex, pool
+
+
+def preq(rid, prompt, gen=3, **kw):
+    return Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                   max_new_tokens=gen, **kw)
+
+
+def test_prefix_cache_requires_caching_pool():
+    with pytest.raises(ValueError, match="PrefixCachingBlockPool"):
+        ContinuousBatchingScheduler(PrefixFakeExecutor(), 2,
+                                    BlockPool(9, 4), 6, prefix_cache=True)
+
+
+def test_shared_prefix_admission_claims_only_uncached_tail():
+    """Two prompts sharing a 2-block prefix: the second admission shares
+    the prefix blocks (refcount, not copies), allocates strictly fewer
+    fresh blocks, and prefills from the first uncached token."""
+    sched, ex, pool = make_psched()
+    shared = np.arange(1, 9)                     # 8 tokens = 2 full blocks
+    sched.submit(preq(1, np.concatenate([shared, [91, 92]]), gen=8))
+    sched.step()                                 # r1 admitted + registered
+    sched.submit(preq(2, np.concatenate([shared, [81, 82, 83]]), gen=8))
+    sched.step()
+    r1_blocks = sched.tables.blocks_of(0)
+    r2_blocks = sched.tables.blocks_of(1)
+    assert r2_blocks[:2] == r1_blocks[:2]        # same frames, shared
+    assert pool.refcount(r1_blocks[0]) == 2
+    # r2's 11-token prompt covers 3 blocks but only 1 was claimed fresh
+    assert len(set(r2_blocks) - set(r1_blocks)) == len(r2_blocks) - 2
+    # offset prefill: 8 cached tokens skipped
+    assert ex.prefills[-1][0:3] == (1, 11, 8)
+    assert sched.cache_hit_blocks == 2 and sched.cache_hit_tokens == 8
+    comps = drain(sched)
+    for c in comps:                              # streams unaffected
+        np.testing.assert_array_equal(
+            c.tokens, c.rid * 100 + np.arange(len(c.tokens)))
+
+
+def test_fully_cached_prompt_takes_cow_and_recomputes_last_token():
+    """Block-aligned prompt entirely in cache: admission shares all but
+    the final block, CoW-copies that one, and prefills exactly the last
+    token (its logits seed sampling) — never writing the shared frame."""
+    sched, ex, pool = make_psched()
+    prompt = np.arange(1, 9)                     # exactly 2 blocks
+    sched.submit(preq(1, prompt, gen=2))
+    drain(sched)
+    assert pool.num_cached >= 2                  # prompt blocks parked
+    cached = pool.lookup(block_content_keys(prompt, 4, pool.salt))
+    sched.submit(preq(2, prompt, gen=4))
+    sched.step()
+    assert len(ex.copies) == 1
+    (src, dst), = ex.copies[0]
+    assert src == cached[-1] and dst != src
+    assert sched.tables.blocks_of(0)[:2] == [cached[0], dst]
+    assert pool.lookup(block_content_keys(prompt, 4, pool.salt)) == cached
+    assert ex.prefills[-1][0:3] == (0, 8, 7)     # 1-token recompute
+    comps = drain(sched)
+    np.testing.assert_array_equal(
+        next(c for c in comps if c.rid == 2).tokens, 200 + np.arange(4))
+
+
+def test_generated_tokens_extend_the_cached_prefix():
+    """Multi-turn shape: a follow-up prompt embedding a completion's
+    prompt+output hits blocks registered at finish — only the new turn
+    prefills."""
+    sched, ex, pool = make_psched()
+    prompt = np.arange(1, 6)                     # 5 tokens
+    sched.submit(preq(1, prompt, gen=4))
+    comps = drain(sched)
+    out = comps[0].tokens
+    # KV exists for prompt + all but the last generated token
+    history = np.concatenate([prompt, out])[:len(prompt) + len(out) - 1]
+    follow = np.concatenate([history, [71, 72, 73]])
+    sched.submit(preq(2, follow, gen=2))
+    sched.step()
+    n_hit = (len(history) // 4)
+    assert ex.prefills[-1][2] == n_hit * 4       # cached turn skipped
+    assert sched.cache_hit_blocks >= n_hit
+    drain(sched)
+
+
+def test_preempt_then_readmit_hits_own_cached_prefix():
+    """PR-2's total-stall path, now cache-aware: the preempted request's
+    prompt blocks park on the cache LRU instead of freeing, so its
+    restart-from-prompt readmission shares what survives and claims
+    strictly fewer fresh blocks than its cold admission — with the same
+    final token stream."""
+    ex = PrefixFakeExecutor()
+    pool = PrefixCachingBlockPool(6, 4)          # 5 usable
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6, prefix_cache=True)
+    sched.submit(preq(1, np.arange(1, 9), gen=8))      # 2+2 blocks
+    sched.submit(preq(2, np.arange(11, 19), gen=8))    # 2+2 blocks
+    comps = drain(sched)
+    assert sched.preemptions >= 1
+    # the readmission prefill starts at the surviving cached prefix
+    starts = [p[2] for p in ex.prefills]
+    assert starts[0] == 0 and starts[1] == 0     # both cold at first
+    assert any(s > 0 for s in starts[2:]), starts  # readmit = offset
+    # fewer fresh blocks than cold: hits were recorded for the readmit
+    assert sched.cache_hit_blocks >= 1
+    assert [c.rid for c in comps] == [1, 2]      # FIFO survived
+    for c in comps:
+        np.testing.assert_array_equal(c.tokens,
+                                      c.rid * 100 + np.arange(8))
+    assert pool.num_free == pool.num_blocks - 1  # nothing leaked
+
+
+def test_cache_never_blocks_admission_of_unique_traffic():
+    """A full cache + a stream of unique prompts: every admission evicts
+    what it needs (LRU) and proceeds — backpressure semantics identical
+    to the uncached pool."""
+    sched, ex, pool = make_psched(num_blocks=9)  # 8 usable
+    for rid in range(6):
+        sched.submit(preq(rid, np.arange(rid * 100, rid * 100 + 8),
+                          gen=2))
+    comps = drain(sched)
+    assert sorted(c.rid for c in comps) == list(range(6))
+    assert pool.evictions > 0                    # cache turned over
+    assert sched.cache_hit_blocks == 0           # unique: no false hits
+    stats = sched.prefix_cache_stats()
+    assert stats["block_hit_rate"] == 0.0
+    assert stats["evictions"] == pool.evictions
+
+
+def test_prefix_cache_stats_rates():
+    sched, ex, pool = make_psched()
+    prompt = np.arange(1, 9)
+    sched.submit(preq(1, prompt, gen=2))
+    drain(sched)
+    sched.submit(preq(2, np.concatenate([prompt, [91, 92]]), gen=2))
+    drain(sched)
+    s = sched.prefix_cache_stats()
+    assert s["enabled"] and s["lookup_blocks"] == 4 and s["hit_blocks"] == 2
+    assert s["block_hit_rate"] == 0.5
+    assert s["hit_tokens"] == 8 and s["prompt_tokens"] == 18
+
+
+def test_occupancy_log_reports_cached_blocks():
+    ex = PrefixFakeExecutor()
+    pool = PrefixCachingBlockPool(17, 4)
+    sched = ContinuousBatchingScheduler(ex, 2, pool, 6, prefix_cache=True,
+                                        record_occupancy=True)
+    sched.submit(preq(1, np.arange(1, 9), gen=2))
+    drain(sched)
+    log = sched.occupancy_log
+    assert log[-1]["blocks_cached"] >= 2         # prompt blocks parked
+    usable = pool.num_blocks - 1
+    # cached blocks count as free capacity (num_free includes them)
+    assert all(e["blocks_allocated"] + e["blocks_free"] == usable
+               for e in log)

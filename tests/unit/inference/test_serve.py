@@ -219,3 +219,93 @@ def test_serve_reserve_upfront_compat_parity(llama_engine):
                                block_size=4, reserve_upfront=True)
     assert sorted(c.rid for c in comps) == list(range(3))
     assert_greedy_parity(llama_engine, comps)
+
+
+# --- prefix caching ---------------------------------------------------------
+
+def shared_prefix_requests(n=6, prefix_len=12, seed=0):
+    """n requests sharing one persona prefix (full blocks at bs=4) with
+    distinct continuations — the traffic shape prefix caching exists
+    for."""
+    rng = np.random.default_rng(seed)
+    persona = rng.integers(1, 256, prefix_len)
+    return [Request(rid=i,
+                    prompt=np.concatenate(
+                        [persona, rng.integers(1, 256, 2 + i % 4)]),
+                    max_new_tokens=4 + i % 3)
+            for i in range(n)]
+
+
+def test_serve_prefix_cache_exact_vs_off_and_generate(llama_engine,
+                                                      serve_attn_kernel):
+    """THE greedy-exactness pin: on a shared-prefix trace, the
+    prefix-cache arm's token streams are identical to prefix_cache=off
+    and to generate() — the cache is a pure perf optimization, on either
+    attention arm."""
+    reqs = shared_prefix_requests()
+    on = {c.rid: c.tokens for c in llama_engine.serve(
+        reqs, num_slots=2, block_size=4, prefix_cache=True,
+        attn_kernel=serve_attn_kernel)}
+    stats = llama_engine.last_serve_scheduler.prefix_cache_stats()
+    assert stats["hit_blocks"] > 0               # the cache actually fired
+    llama_engine.reset_prefix_cache()
+    off = {c.rid: c.tokens for c in llama_engine.serve(
+        shared_prefix_requests(), num_slots=2, block_size=4,
+        prefix_cache=False, attn_kernel=serve_attn_kernel)}
+    assert sorted(on) == sorted(off) == list(range(6))
+    for rid in on:
+        np.testing.assert_array_equal(on[rid], off[rid])
+    for c in llama_engine.serve(shared_prefix_requests(), num_slots=2,
+                                block_size=4, prefix_cache=True,
+                                attn_kernel=serve_attn_kernel):
+        ref = np.asarray(llama_engine.generate(
+            jnp.asarray(c.prompt)[None],
+            max_new_tokens=len(c.tokens)))[0, len(c.prompt):]
+        np.testing.assert_array_equal(c.tokens, ref)
+
+
+def test_serve_prefix_cache_cow_identical_prompts(llama_engine):
+    """Identical block-aligned prompts: the later admissions reuse the
+    whole prefix via copy-on-write of the final block (the 1-token
+    recompute path) — streams still exactly greedy."""
+    prompt = np.random.default_rng(7).integers(1, 256, 8)   # 2 full blocks
+    reqs = [Request(rid=i, prompt=prompt, max_new_tokens=5)
+            for i in range(3)]
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4,
+                               prefix_cache=True)
+    stats = llama_engine.last_serve_scheduler.prefix_cache_stats()
+    assert stats["hit_tokens"] >= 2 * (len(prompt) - 1)
+    assert_greedy_parity(llama_engine, comps)
+    a, b, c = (c.tokens for c in sorted(comps, key=lambda c: c.rid))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+def test_serve_prefix_cache_persists_across_calls(llama_engine):
+    """The content index rides the cached executor: a second serve()
+    call over the same prefixes starts warm; reset_prefix_cache() makes
+    the next call cold again."""
+    llama_engine.reset_prefix_cache()
+    llama_engine.serve(shared_prefix_requests(3), num_slots=2,
+                       block_size=4, prefix_cache=True)
+    llama_engine.serve(shared_prefix_requests(3), num_slots=2,
+                       block_size=4, prefix_cache=True)
+    warm = llama_engine.last_serve_scheduler.prefix_cache_stats()
+    assert warm["block_hit_rate"] > 0.5          # everything re-hit
+    llama_engine.reset_prefix_cache()
+    llama_engine.serve(shared_prefix_requests(3, seed=11)[:1], num_slots=2,
+                       block_size=4, prefix_cache=True)
+    cold = llama_engine.last_serve_scheduler.prefix_cache_stats()
+    assert cold["hit_blocks"] == 0
+
+
+def test_serve_prefix_cache_tiny_pool_evicts_and_completes(llama_engine):
+    """Cache + backpressure: a pool near one request's size still drains
+    the whole shared-prefix trace exactly (cached blocks are reclaimed
+    LRU-first, never deadlocking admission)."""
+    llama_engine.reset_prefix_cache()
+    reqs = shared_prefix_requests(4)
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4,
+                               num_blocks=8, prefix_cache=True)
+    assert sorted(c.rid for c in comps) == list(range(4))
+    assert_greedy_parity(llama_engine, comps)

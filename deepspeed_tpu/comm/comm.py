@@ -172,7 +172,7 @@ def get_backend_name() -> str:
 
 def _current_mesh():
     try:
-        from jax.sharding import get_abstract_mesh  # jax>=0.5
+        from deepspeed_tpu.utils.jax_compat import get_abstract_mesh
 
         m = get_abstract_mesh()
         if m is not None and m.axis_names:

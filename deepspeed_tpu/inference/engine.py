@@ -210,8 +210,9 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
         cache.move_to_end(key)
     else:
         if len(cache) >= GEN_CACHE_MAX:
-            cache.popitem(last=False)
-        cache[key] = (builder(cap) if builder is not None
+            # managing the caller-owned LRU IS this function's contract
+            cache.popitem(last=False)    # dstlint: disable=no-arg-mutation
+        cache[key] = (builder(cap) if builder is not None  # dstlint: disable=no-arg-mutation
                       else build_generate_fn(apply_fn, B, T, cap,
                                              params_fn=params_fn))
     return cache[key], cap
@@ -542,7 +543,7 @@ class InferenceEngine:
             abstract = jax.eval_shape(
                 lambda r: model.init(r, jnp.asarray(sample_input))["params"], rng)
             shardings = tree_shardings(abstract, self.mesh)
-            with self.mesh:
+            with set_mesh(self.mesh):
                 params = jax.jit(
                     lambda r: model.init(r, jnp.asarray(sample_input))["params"],
                     out_shardings=shardings)(rng)

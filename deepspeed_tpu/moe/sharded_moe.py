@@ -26,8 +26,11 @@ def _axis_in_context_mesh(axis: Optional[str]) -> bool:
     if axis is None:
         return False
     try:
-        mesh = jax.sharding.get_abstract_mesh()
-        return axis in mesh.axis_names and mesh.shape[axis] > 1
+        from deepspeed_tpu.utils.jax_compat import get_abstract_mesh
+
+        mesh = get_abstract_mesh()
+        return (mesh is not None and axis in mesh.axis_names
+                and mesh.shape[axis] > 1)
     except Exception:
         return False
 

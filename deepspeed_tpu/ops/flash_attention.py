@@ -27,8 +27,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.utils.jax_compat import pallas_tpu, vma_of
+
+pl, pltpu = pallas_tpu(placeholder=True)
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
@@ -39,8 +41,7 @@ def _out_struct(shape, dtype, like):
     """ShapeDtypeStruct that carries the varying-mesh-axes (vma) of ``like``
     — required for pallas_call outputs when running inside shard_map with
     check_vma=True (e.g. ring attention's per-block kernels)."""
-    vma = getattr(jax.typeof(like), "vma", None) if hasattr(jax, "typeof") \
-        else None
+    vma = vma_of(like)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

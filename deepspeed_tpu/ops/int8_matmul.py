@@ -20,8 +20,10 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.utils.jax_compat import pallas_tpu
+
+pl, pltpu = pallas_tpu(placeholder=True)
 
 
 def quantize_rowwise(w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:

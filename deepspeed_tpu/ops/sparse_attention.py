@@ -33,8 +33,9 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from deepspeed_tpu.utils.jax_compat import pallas_tpu
+
+pl, pltpu = pallas_tpu(placeholder=True)
 
 NEG_INF = -1e30
 
@@ -66,7 +67,11 @@ class SparsityConfig:
         return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int32)
 
     def propagate_first_head(self, layout: np.ndarray) -> np.ndarray:
+        """Broadcast head 0's pattern to every head. Pure: the input
+        layout is left untouched (copy-on-write) — callers use the
+        returned array (the retile_gateup_for_fused_mlp bug class)."""
         if not self.different_layout_per_head:
+            layout = layout.copy()
             layout[1:] = layout[0]
         return layout
 

@@ -1,0 +1,2 @@
+"""Developer tooling shipped with the package (static analysis, CI
+helpers). Nothing here is imported by the runtime/serving code paths."""

@@ -1,0 +1,24 @@
+"""dstlint — the framework's JAX/TPU invariant checker.
+
+Two backends behind one finding stream:
+
+- **AST pass** (:mod:`.astpass`): framework-specific rules over the
+  package source — the ``utils/jax_compat`` seam, host syncs inside
+  jitted code, recompile hazards, Pallas kernel hygiene, in-place
+  argument mutation, and buffer-donation checks on the serving entry
+  points. Pure ``ast``, no jax import, runs in milliseconds.
+- **jaxpr pass** (:mod:`.jaxprpass`): abstractly traces the registered
+  serving entry points (paged decode step, prefill bucket,
+  ``copy_pool_blocks``) and fails on callback/transfer primitives in
+  their jaxprs, on a missing ``pallas_call`` in the Pallas arm (silent
+  fallback to the reference gather), and on equation-count drift beyond
+  the checked-in budgets (``tools/dstlint/jaxpr_budgets.json``).
+
+CLI: ``bin/dst lint`` (see :mod:`.cli`); library entry:
+:func:`run_lint`. Rule catalog: ``docs/LINT.md``.
+"""
+
+from deepspeed_tpu.tools.dstlint.core import (  # noqa: F401
+    Baseline, Finding, LintConfig, load_baseline, run_lint,
+)
+from deepspeed_tpu.tools.dstlint.astpass import AST_RULES  # noqa: F401

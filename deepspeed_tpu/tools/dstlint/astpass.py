@@ -1,0 +1,766 @@
+"""dstlint AST rules — the framework's source-level invariants.
+
+Six rules (catalog with bad/good examples: ``docs/LINT.md``):
+
+- ``jax-compat-seam``   moved/renamed JAX symbols must route through
+  ``utils/jax_compat`` (the seam that revived the engines on jax
+  0.4.37) — both imports and attribute uses, plus the retired
+  ``with mesh:`` context spelling.
+- ``no-host-sync-in-jit``   ``.item()`` / ``float()`` / ``int()`` /
+  ``np.asarray`` / ``jax.device_get`` / ``.block_until_ready()`` on
+  traced values inside jit/scan/while_loop bodies.
+- ``recompile-hazard``   Python ``if``/``assert``/f-strings over traced
+  values (concretization → silent retrace per shape), and
+  array-building expressions passed in ``static_argnums`` positions.
+- ``pallas-kernel-hygiene``   no ``jnp.repeat``, no ``print``, no
+  data-dependent Python control flow inside Pallas kernel bodies.
+- ``no-arg-mutation``   helpers under ``ops/``/``inference/`` must not
+  mutate their inputs in place (the ``retile_gateup_for_fused_mlp``
+  purity bug class). Pallas kernels and ``*_ref``/``*_scr`` parameters
+  (the Ref mutation protocol) are exempt.
+- ``donation-check``   jitted entry points in ``inference/engine.py`` /
+  ``runtime/engine.py`` taking pool/cache-sized buffers must donate
+  them (``donate_argnums``) or double peak HBM for the workspace.
+
+Everything here is a best-effort, zero-false-positive-biased *static*
+approximation: function references are resolved lexically (a function
+object stored in a dict and jitted later is out of scope), and taint is
+a single forward pass per function (parameters of traced functions are
+tainted; ``.shape``/``.dtype``/``len()`` launder taint because shapes
+are static under tracing).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from deepspeed_tpu.tools.dstlint.core import Finding
+
+# --- rule ids ---------------------------------------------------------------
+SEAM = "jax-compat-seam"
+HOST_SYNC = "no-host-sync-in-jit"
+RECOMPILE = "recompile-hazard"
+PALLAS = "pallas-kernel-hygiene"
+ARG_MUT = "no-arg-mutation"
+DONATION = "donation-check"
+
+AST_RULES = (SEAM, HOST_SYNC, RECOMPILE, PALLAS, ARG_MUT, DONATION)
+
+# the one module allowed to touch the moved symbols directly
+SEAM_MODULE = "deepspeed_tpu/utils/jax_compat.py"
+
+#: symbols the jax_compat seam owns — exact dotted paths. Prefixes of
+#: jax.experimental.{shard_map,pallas} are matched separately so both
+#: the module import and any attribute under it are caught.
+SEAM_SYMBOLS = {
+    "jax.set_mesh": "set_mesh",
+    "jax.shard_map": "shard_map",
+    "jax.lax.pvary": "varying_cast",
+    "jax.lax.pcast": "varying_cast",
+    "jax.lax.axis_size": "axis_size",
+    "jax.typeof": "vma_of",
+    "jax.sharding.get_abstract_mesh": "get_abstract_mesh",
+}
+SEAM_PREFIXES = {
+    "jax.experimental.shard_map": "shard_map",
+    "jax.experimental.pallas": "pallas_tpu()",
+}
+
+JIT_WRAPPERS = {"jax.jit", "jax.pmap"}
+#: traced-callable positions in control-flow combinators
+TRACED_ARG_POS = {
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.eval_shape": (0,),
+    "jax.make_jaxpr": (0,),
+}
+
+HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+HOST_SYNC_CASTS = {"float", "int", "bool", "complex"}
+NUMPY_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+
+#: attribute reads that launder taint — static under tracing
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "aval",
+                "itemsize", "weak_type"}
+STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                "callable", "id", "range", "enumerate", "zip"}
+
+#: parameter names that identify session-sized device buffers at the
+#: serving/training entry points (donation-check)
+BUFFER_PARAM_NAMES = {"pools", "pool", "caches", "kv_caches", "kv_pools",
+                      "opt_state"}
+DONATION_FILES = ("inference/engine.py", "runtime/engine.py")
+
+MUTATING_METHODS = {"append", "extend", "insert", "remove", "clear",
+                    "pop", "popitem", "update", "setdefault", "sort",
+                    "reverse", "add", "discard"}
+#: Pallas Ref / VMEM-scratch naming convention — mutation is the protocol
+REF_PARAM_SUFFIXES = ("_ref", "_scr", "refs", "_vmem", "_smem")
+
+
+def _func_name_parts(node: ast.AST) -> Optional[List[str]]:
+    """['jax', 'lax', 'pvary'] for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class _Scope:
+    """One lexical function (or module) scope."""
+
+    def __init__(self, node, parent: Optional["_Scope"]):
+        self.node = node
+        self.parent = parent
+        self.local_funcs: Dict[str, "_FuncInfo"] = {}
+
+    def resolve(self, name: str) -> Optional["_FuncInfo"]:
+        scope = self
+        while scope is not None:
+            info = scope.local_funcs.get(name)
+            if info is not None:
+                return info
+            scope = scope.parent
+        return None
+
+
+class _FuncInfo:
+    def __init__(self, node, scope: _Scope, parent: Optional["_FuncInfo"]):
+        self.node = node
+        self.scope = scope            # scope of the function's BODY
+        self.parent = parent
+        self.traced = False
+        self.kernel = False
+        self.jit_calls: List[ast.Call] = []   # jax.jit(...) wrapping this def
+
+    def in_traced_context(self) -> bool:
+        info = self
+        while info is not None:
+            if info.traced or info.kernel:
+                return True
+            info = info.parent
+        return False
+
+    def in_kernel_context(self) -> bool:
+        info = self
+        while info is not None:
+            if info.kernel:
+                return True
+            info = info.parent
+        return False
+
+
+class ModuleAnalyzer:
+    def __init__(self, tree: ast.Module, relpath: str):
+        self.tree = tree
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        self.aliases: Dict[str, str] = {}
+        self.module_scope = _Scope(tree, None)
+        self.funcs: List[_FuncInfo] = []
+        self._scope_of_body: Dict[ast.AST, _Scope] = {tree: self.module_scope}
+
+    # --- shared resolution ---------------------------------------------------
+    def _collect_aliases(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        parts = _func_name_parts(node)
+        if not parts:
+            return None
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def emit(self, rule: str, node: ast.AST, message: str):
+        self.findings.append(Finding(
+            rule, self.relpath, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), message))
+
+    # --- pass 1: scopes + function table ------------------------------------
+    def _build_scopes(self):
+        def visit(node, scope: _Scope, parent_func: Optional[_FuncInfo]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    body_scope = _Scope(child, scope)
+                    info = _FuncInfo(child, body_scope, parent_func)
+                    self.funcs.append(info)
+                    self._scope_of_body[child] = body_scope
+                    if not isinstance(child, ast.Lambda):
+                        scope.local_funcs[child.name] = info
+                    visit(child, body_scope, info)
+                elif isinstance(child, ast.ClassDef):
+                    # methods live in the class "scope"; resolution-wise a
+                    # plain nested scope is close enough for this pass
+                    class_scope = _Scope(child, scope)
+                    self._scope_of_body[child] = class_scope
+                    visit(child, class_scope, parent_func)
+                else:
+                    visit(child, scope, parent_func)
+
+        visit(self.tree, self.module_scope, None)
+
+    # --- pass 2: mark traced / kernel functions ------------------------------
+    def _callable_arg_to_info(self, arg: ast.AST,
+                              scope: _Scope) -> Optional[_FuncInfo]:
+        """Resolve a callable argument: a local name, a lambda, or
+        functools.partial(name, ...)."""
+        if isinstance(arg, ast.Lambda):
+            return next((f for f in self.funcs if f.node is arg), None)
+        if isinstance(arg, ast.Name):
+            return scope.resolve(arg.id)
+        if isinstance(arg, ast.Call):
+            d = self.dotted(arg.func)
+            if d in ("functools.partial", "partial") and arg.args:
+                return self._callable_arg_to_info(arg.args[0], scope)
+        return None
+
+    def _mark_functions(self):
+        # decorators
+        for info in self.funcs:
+            if isinstance(info.node, ast.Lambda):
+                continue
+            for dec in info.node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                d = self.dotted(target)
+                if d in JIT_WRAPPERS:
+                    info.traced = True
+                    # record BARE @jax.jit too: donation-check reads a
+                    # non-Call entry as "jit with no kwargs" (nothing
+                    # donated) — the most idiomatic way to miss donation
+                    info.jit_calls.append(dec)
+                elif d in ("functools.partial", "partial") \
+                        and isinstance(dec, ast.Call) and dec.args \
+                        and self.dotted(dec.args[0]) in JIT_WRAPPERS:
+                    info.traced = True
+                    info.jit_calls.append(dec)
+
+        # call sites: jax.jit(f), lax.while_loop(cond, body, ...),
+        # pl.pallas_call(kernel | functools.partial(kernel, ...), ...)
+        for node, scope in self._walk_with_scopes():
+            if not isinstance(node, ast.Call):
+                continue
+            d = self.dotted(node.func)
+            if d is None:
+                continue
+            if d in JIT_WRAPPERS and node.args:
+                info = self._callable_arg_to_info(node.args[0], scope)
+                if info is not None:
+                    info.traced = True
+                    info.jit_calls.append(node)
+            elif d in TRACED_ARG_POS:
+                for pos in TRACED_ARG_POS[d]:
+                    if pos < len(node.args):
+                        info = self._callable_arg_to_info(
+                            node.args[pos], scope)
+                        if info is not None:
+                            info.traced = True
+            elif d.endswith(".pallas_call") or d == "pallas_call":
+                if node.args:
+                    info = self._callable_arg_to_info(node.args[0], scope)
+                    if info is not None:
+                        info.kernel = True
+
+    def _walk_with_scopes(self):
+        """(node, enclosing_scope) for every node — scope meaning the
+        innermost function/module body the node sits in."""
+        def visit(node, scope):
+            for child in ast.iter_child_nodes(node):
+                child_scope = self._scope_of_body.get(child, scope)
+                yield child, child_scope
+                yield from visit(child, child_scope)
+
+        yield from visit(self.tree, self.module_scope)
+
+    # --- rules ---------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self._collect_aliases()
+        self._build_scopes()
+        self._mark_functions()
+        if self.relpath != SEAM_MODULE:
+            self._rule_seam()
+        self._rule_traced_bodies()
+        if self.relpath.startswith(("deepspeed_tpu/ops/",
+                                    "deepspeed_tpu/inference/")):
+            self._rule_arg_mutation()
+        if self.relpath.endswith(DONATION_FILES):
+            self._rule_donation()
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings
+
+    # jax-compat-seam ---------------------------------------------------------
+    def _seam_hit(self, dotted: str) -> Optional[str]:
+        if dotted in SEAM_SYMBOLS:
+            return SEAM_SYMBOLS[dotted]
+        for prefix, repl in SEAM_PREFIXES.items():
+            if dotted == prefix or dotted.startswith(prefix + "."):
+                return repl
+        return None
+
+    def _rule_seam(self):
+        seen_lines: Set[int] = set()
+
+        def hit(node, dotted):
+            repl = self._seam_hit(dotted)
+            if repl is not None and node.lineno not in seen_lines:
+                seen_lines.add(node.lineno)
+                self.emit(SEAM, node,
+                          f"direct use of seam-covered symbol "
+                          f"'{dotted}' — import "
+                          f"'{repl}' from deepspeed_tpu.utils.jax_compat "
+                          f"instead (one-file jax version bumps)")
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    hit(node, a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    hit(node, f"{node.module}.{a.name}")
+            elif isinstance(node, ast.Attribute):
+                d = self.dotted(node)
+                if d is None:
+                    continue
+                parts = _func_name_parts(node)
+                if d in SEAM_SYMBOLS:
+                    # exact moved symbols (lax.pvary, jax.set_mesh, ...)
+                    # flag through any alias
+                    hit(node, d)
+                elif parts and parts[0] == "jax":
+                    # prefix families (pallas, experimental.shard_map):
+                    # alias USES are consequences of an already-flagged
+                    # import — only literal jax.experimental... chains
+                    # flag here
+                    hit(node, d)
+            elif isinstance(node, ast.With):
+                # retired `with mesh:` context spelling — a bare Mesh as
+                # context manager deprecates; route through set_mesh()
+                for item in node.items:
+                    ctx = item.context_expr
+                    parts = _func_name_parts(ctx)
+                    if parts and parts[-1] in ("mesh", "_mesh") \
+                            and not isinstance(ctx, ast.Call):
+                        self.emit(
+                            SEAM, ctx,
+                            "'with mesh:' is the retired context "
+                            "spelling — use 'with set_mesh(mesh):' from "
+                            "deepspeed_tpu.utils.jax_compat")
+
+    # traced-body rules: host syncs, recompile hazards, kernel hygiene -------
+    def _rule_traced_bodies(self):
+        roots = [f for f in self.funcs
+                 if (f.traced or f.kernel)
+                 and (f.parent is None or not f.parent.in_traced_context())]
+        for info in roots:
+            # taint seeds ONLY from params of functions the tracer calls
+            # directly (jit roots, while_loop/scan/cond bodies, kernels) —
+            # a nested helper invoked manually may take static values
+            # (dict keys, config) and tainting its params would flag
+            # legitimate host math; its closure over traced values is
+            # still tracked via the inherited environment.
+            self._check_traced_function(info, self._initial_taint(info))
+        # static_argnums hazards live at the jit CALL, not inside a body
+        self._rule_static_argnums()
+
+    @staticmethod
+    def _initial_taint(info: _FuncInfo) -> Set[str]:
+        """Positional/vararg params are traced values; keyword-only
+        params are the functools.partial static-config idiom."""
+        node = info.node
+        args = node.args
+        names = [a.arg for a in args.args]
+        names += [a.arg for a in getattr(args, "posonlyargs", [])]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        return {n for n in names if n not in ("self", "cls")}
+
+    def _check_traced_function(self, info: _FuncInfo, taint: Set[str]):
+        kernel = info.in_kernel_context()
+        walker = _TracedBodyWalker(self, info, set(taint), kernel)
+        body = info.node.body
+        if isinstance(info.node, ast.Lambda):
+            walker.visit(info.node.body)
+        else:
+            for stmt in body:
+                walker.visit(stmt)
+        # nested defs inherit the enclosing taint environment (closures);
+        # their OWN params seed taint only when the tracer calls them
+        # directly (marked traced/kernel — combinator bodies, jit roots)
+        for child in self.funcs:
+            if child.parent is info:
+                child_taint = set(walker.taint)
+                if child.traced or child.kernel:
+                    child_taint |= self._initial_taint(child)
+                self._check_traced_function(child, child_taint)
+
+    def _rule_static_argnums(self):
+        """Array-building expressions passed in static positions: a
+        jnp/np-array static arg is unhashable → TypeError at best, a
+        per-call recompile with weird cache keys at worst."""
+        for info in self.funcs:
+            for call in info.jit_calls:
+                keywords = call.keywords if isinstance(call, ast.Call) \
+                    else []
+                static_kw = next((k for k in keywords
+                                  if k.arg == "static_argnums"), None)
+                if static_kw is None:
+                    continue
+                positions = _const_int_tuple(static_kw.value)
+                if positions is None:
+                    continue
+                # check call sites of the jitted value is out of scope;
+                # instead flag static positions whose PARAM has an
+                # array-ish buffer name — those are traced by contract
+                params = [a.arg for a in info.node.args.args]
+                for pos in positions:
+                    # multi-character buffer names only: single-letter
+                    # params (k, x, ...) are idiomatic STATIC scalars in
+                    # jit signatures and must not collide
+                    if pos < len(params) and (
+                            params[pos] in BUFFER_PARAM_NAMES
+                            or params[pos] in ("tokens", "ids", "logits")):
+                        self.emit(
+                            RECOMPILE, call,
+                            f"static_argnums includes "
+                            f"'{params[pos]}' which names a traced "
+                            f"array — unhashable at call time or a "
+                            f"recompile per distinct buffer")
+
+    # no-arg-mutation ---------------------------------------------------------
+    def _rule_arg_mutation(self):
+        for info in self.funcs:
+            if isinstance(info.node, ast.Lambda) or info.in_kernel_context():
+                continue
+            params = self._initial_taint(info)
+            params = {p for p in params
+                      if not p.endswith(REF_PARAM_SUFFIXES)}
+            if not params:
+                continue
+            walker = _ArgMutationWalker(self, params)
+            for stmt in info.node.body:
+                walker.visit(stmt)
+
+    # donation-check ----------------------------------------------------------
+    def _rule_donation(self):
+        for info in self.funcs:
+            if isinstance(info.node, ast.Lambda):
+                continue
+            params = [a.arg for a in info.node.args.args]
+            buffer_pos = [i for i, p in enumerate(params)
+                          if p in BUFFER_PARAM_NAMES]
+            if not buffer_pos:
+                continue
+            for call in info.jit_calls:
+                donated = set()
+                keywords = call.keywords if isinstance(call, ast.Call) \
+                    else []
+                for k in keywords:
+                    if k.arg in ("donate_argnums", "donate_argnames"):
+                        vals = _const_int_tuple(k.value)
+                        if vals is None:     # dynamic spec: trust it
+                            donated = set(buffer_pos)
+                        else:
+                            donated |= set(vals)
+                missing = [params[i] for i in buffer_pos
+                           if i not in donated]
+                if missing:
+                    self.emit(
+                        DONATION, call,
+                        f"jit of '{info.node.name}' does not donate "
+                        f"buffer argument(s) {missing} — without "
+                        f"donate_argnums the pool/cache is copied, "
+                        f"doubling its HBM footprint per step")
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[tuple]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+class _TracedBodyWalker(ast.NodeVisitor):
+    """Host-sync / recompile-hazard / kernel-hygiene checks over ONE
+    function body, with a single-pass forward taint approximation.
+    Does not descend into nested function defs (the analyzer re-enters
+    them with the inherited taint environment)."""
+
+    def __init__(self, mod: ModuleAnalyzer, info: _FuncInfo,
+                 taint: Set[str], kernel: bool):
+        self.mod = mod
+        self.info = info
+        self.taint = taint
+        self.kernel = kernel
+
+    # --- taint ---------------------------------------------------------------
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.taint
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            # x.shape[0] is static even though x is traced
+            if isinstance(base, ast.Attribute) and base.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(base) or self.is_tainted(node.slice)
+        if isinstance(node, ast.Call):
+            d = self.mod.dotted(node.func)
+            if d in STATIC_CALLS or (d or "").split(".")[-1] in STATIC_CALLS:
+                return False
+            if isinstance(node.func, ast.Attribute) \
+                    and self.is_tainted(node.func.value):
+                return True
+            return any(self.is_tainted(a) for a in node.args) or \
+                any(self.is_tainted(k.value) for k in node.keywords)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.is_tainted(node.left) or \
+                any(self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.BoolOp,)):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        return False
+
+    def _assign_names(self, target: ast.AST, tainted: bool):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.taint.add(target.id)
+            else:
+                self.taint.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_names(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_names(target.value, tainted)
+
+    # --- traversal -----------------------------------------------------------
+    def visit_FunctionDef(self, node):      # noqa: N802 - handled separately
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        tainted = self.is_tainted(node.value)
+        for t in node.targets:
+            self._assign_names(t, tainted)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        if self.is_tainted(node.value):
+            self._assign_names(node.target, True)
+
+    def visit_AnnAssign(self, node):
+        self.generic_visit(node)
+        if node.value is not None:
+            self._assign_names(node.target, self.is_tainted(node.value))
+
+    def visit_If(self, node):
+        if self.is_tainted(node.test):
+            rule = PALLAS if self.kernel else RECOMPILE
+            what = "data-dependent Python `if` in a Pallas kernel body " \
+                   "(use pl.when / jnp.where)" if self.kernel else \
+                   "Python `if` on a traced value concretizes at trace " \
+                   "time (TracerBoolConversionError or a recompile per " \
+                   "value) — use jnp.where / lax.cond"
+            self.mod.emit(rule, node, what)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.is_tainted(node.test):
+            rule = PALLAS if self.kernel else RECOMPILE
+            self.mod.emit(rule, node,
+                          "Python `while` over a traced value — use "
+                          "lax.while_loop" if not self.kernel else
+                          "data-dependent Python `while` in a Pallas "
+                          "kernel body — use lax.fori_loop/pl.when")
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        if self.kernel and self.is_tainted(node.iter):
+            self.mod.emit(PALLAS, node,
+                          "data-dependent Python `for` in a Pallas "
+                          "kernel body — iteration counts must be static")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self.is_tainted(node.test):
+            self.mod.emit(RECOMPILE, node,
+                          "`assert` on a traced value concretizes at "
+                          "trace time — use checkify or move the check "
+                          "outside the jitted function")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue) and \
+                    self.is_tainted(v.value):
+                self.mod.emit(RECOMPILE, node,
+                              "f-string over a traced value (e.g. a "
+                              "shape-derived cache key built at trace "
+                              "time) concretizes the tracer")
+                break
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        d = self.mod.dotted(node.func)
+        # host syncs -----------------------------------------------------
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in HOST_SYNC_METHODS \
+                and not node.args \
+                and self.is_tainted(node.func.value):
+            self.mod.emit(HOST_SYNC, node,
+                          f".{node.func.attr}() inside a jitted/traced "
+                          f"body is a device->host sync (or a trace "
+                          f"error) — keep the value on device")
+        elif d is not None and d in ("jax.device_get",):
+            self.mod.emit(HOST_SYNC, node,
+                          "jax.device_get inside a jitted/traced body "
+                          "is a device->host sync — keep the value on "
+                          "device")
+        elif d in NUMPY_MATERIALIZERS \
+                and any(self.is_tainted(a) for a in node.args):
+            self.mod.emit(HOST_SYNC, node,
+                          f"{d.replace('numpy', 'np')} on a traced "
+                          f"value materializes on host — use jnp")
+        elif d in HOST_SYNC_CASTS and len(node.args) == 1 \
+                and self.is_tainted(node.args[0]):
+            self.mod.emit(HOST_SYNC, node,
+                          f"{d}() on a traced value forces a host sync "
+                          f"(ConcretizationTypeError under jit) — keep "
+                          f"math in jnp")
+        # kernel hygiene --------------------------------------------------
+        if self.kernel:
+            if d is not None and (d == "jax.numpy.repeat"
+                                  or d == "numpy.repeat"):
+                self.mod.emit(PALLAS, node,
+                              "jnp.repeat inside a Pallas kernel "
+                              "materializes the broadcast — index a "
+                              "reshaped view instead (GQA: [n_kv, rep, "
+                              "hd])")
+            elif d == "print":
+                self.mod.emit(PALLAS, node,
+                              "print() in a Pallas kernel body — use "
+                              "pl.debug_print")
+        self.generic_visit(node)
+
+
+class _ArgMutationWalker(ast.NodeVisitor):
+    """In-place mutation of function parameters (helpers must be pure)."""
+
+    def __init__(self, mod: ModuleAnalyzer, params: Set[str]):
+        self.mod = mod
+        self.params = set(params)
+
+    def _param_base(self, node: ast.AST) -> Optional[str]:
+        """The parameter name if ``node`` is (a subscript chain over) a
+        bare parameter; attribute access (obj.field) is NOT flagged —
+        mutating self/attr state is a different contract."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Name) and node.id in self.params:
+            return node.id
+        return None
+
+    def visit_FunctionDef(self, node):      # nested defs: own parameters
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                p = self._param_base(t)
+                if p is not None:
+                    self.mod.emit(
+                        ARG_MUT, node,
+                        f"in-place write into parameter '{p}' — helpers "
+                        f"must not mutate their inputs (return a new "
+                        f"value; copy-on-write if cheap)")
+            elif isinstance(t, ast.Name) and t.id in self.params:
+                # rebinding shadows the param: later subscript writes hit
+                # the local, which is fine
+                self.params.discard(t.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Subscript):
+            p = self._param_base(node.target)
+            if p is not None:
+                self.mod.emit(ARG_MUT, node,
+                              f"in-place augmented write into parameter "
+                              f"'{p}' — helpers must not mutate inputs")
+        elif isinstance(node.target, ast.Name):
+            self.params.discard(node.target.id)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                p = self._param_base(t)
+                if p is not None:
+                    self.mod.emit(ARG_MUT, node,
+                                  f"del on parameter '{p}' contents — "
+                                  f"helpers must not mutate inputs")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS:
+            p = self._param_base(f.value)
+            if p is not None:
+                self.mod.emit(ARG_MUT, node,
+                              f"'{p}.{f.attr}(...)' mutates parameter "
+                              f"'{p}' in place — helpers must not "
+                              f"mutate inputs")
+        self.generic_visit(node)
+
+
+def analyze_module(tree: ast.Module, relpath: str) -> List[Finding]:
+    return ModuleAnalyzer(tree, relpath).run()

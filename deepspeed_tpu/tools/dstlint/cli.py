@@ -1,0 +1,175 @@
+"""``bin/dst lint`` — CLI for the dstlint analyzer.
+
+Exit codes: 0 clean (baselined findings do not fail the run), 1
+non-baselined findings, 2 internal error. ``--format json`` is the
+machine interface consumed by the tier-1 pytest wrapper
+(tests/unit/test_dstlint.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import traceback
+from typing import List, Tuple
+
+from deepspeed_tpu.tools.dstlint import core
+
+
+def _repo_root() -> str:
+    import deepspeed_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(deepspeed_tpu.__file__)))
+
+
+def _default_targets(root: str) -> List[str]:
+    return [os.path.join(root, "deepspeed_tpu")]
+
+
+def _iter_py_files(targets: List[str], root: str
+                   ) -> List[Tuple[str, str]]:
+    """(repo-relative posix path, source) for every .py under targets."""
+    out = []
+    for target in targets:
+        target = os.path.abspath(target)
+        if os.path.isfile(target):
+            paths = [target]
+        else:
+            paths = []
+            for dirpath, dirnames, filenames in os.walk(target):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                paths.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        for p in sorted(paths):
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            try:
+                with open(p, encoding="utf-8") as f:
+                    out.append((rel, f.read()))
+            except (OSError, UnicodeDecodeError) as e:
+                print(f"dstlint: skipping unreadable {rel}: {e}",
+                      file=sys.stderr)
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dst lint",
+        description="static analysis of the framework's JAX/TPU "
+                    "invariants (rule catalog: docs/LINT.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the "
+                        "deepspeed_tpu package)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default all)")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default "
+                        "tools/dstlint/baseline.json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(grandfather everything currently firing)")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr entry-point pass (no jax "
+                        "import; milliseconds instead of seconds)")
+    p.add_argument("--budgets", default=None,
+                   help="jaxpr equation-budget file (default "
+                        "tools/dstlint/jaxpr_budgets.json)")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="re-trace the entry points and rewrite the "
+                        "budget file")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings covered by the baseline")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    except SystemExit:
+        raise
+    except Exception:
+        traceback.print_exc()
+        print("dstlint: internal error (this is a dstlint bug, not a "
+              "finding)", file=sys.stderr)
+        return 2
+
+
+def _main(argv) -> int:
+    args = build_parser().parse_args(argv)
+    root = _repo_root()
+    baseline_path = args.baseline or os.path.join(
+        root, "tools", "dstlint", "baseline.json")
+    budgets_path = args.budgets or os.path.join(
+        root, "tools", "dstlint", "jaxpr_budgets.json")
+
+    config = core.LintConfig(
+        select={r.strip() for r in args.select.split(",") if r.strip()}
+        or None,
+        ignore={r.strip() for r in args.ignore.split(",") if r.strip()})
+
+    if args.update_budgets:
+        from deepspeed_tpu.tools.dstlint import jaxprpass
+
+        reports = jaxprpass.trace_entry_points()
+        budgets = jaxprpass.budgets_from_reports(reports)
+        os.makedirs(os.path.dirname(budgets_path), exist_ok=True)
+        with open(budgets_path, "w") as f:
+            json.dump(budgets, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"dstlint: wrote {len(budgets['entries'])} entry budgets "
+              f"to {os.path.relpath(budgets_path, root)}")
+        for name, rep in sorted(reports.items()):
+            status = rep.error or f"{rep.eqns} eqns, " \
+                                  f"{rep.pallas_calls} pallas_call"
+            print(f"  {name}: {status}")
+        if any(r.error for r in reports.values()):
+            return 2
+        return 0
+
+    files = _iter_py_files(args.paths or _default_targets(root), root)
+    findings = core.run_lint(files, config)
+
+    if not args.no_jaxpr:
+        from deepspeed_tpu.tools.dstlint import jaxprpass
+
+        jf = [f for f in jaxprpass.run_jaxpr_pass(budgets_path)
+              if config.rule_enabled(f.rule)]
+        findings.extend(jf)
+
+    line_texts = core.collect_line_texts(files, findings)
+    if args.update_baseline:
+        baseline = core.Baseline.from_findings(findings, line_texts)
+        os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+        core.save_baseline(baseline_path, baseline)
+        print(f"dstlint: baselined {len(findings)} finding(s) into "
+              f"{os.path.relpath(baseline_path, root)}")
+        return 0
+
+    baseline = core.load_baseline(baseline_path)
+    findings = baseline.filter(findings, line_texts)
+    active = [f for f in findings if not f.baselined]
+    shown = findings if args.show_baselined else active
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "files_checked": len(files),
+            "findings": [f.to_json() for f in findings],
+            "counts": {"active": len(active),
+                       "baselined": len(findings) - len(active)},
+        }, indent=1))
+    else:
+        for f in shown:
+            print(f.render())
+        print(f"dstlint: {len(files)} files, {len(active)} finding(s)"
+              f" ({len(findings) - len(active)} baselined)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""dstlint core: findings, suppressions, baseline, and the file driver.
+
+Deliberately dependency-free (stdlib ``ast`` only) so the AST pass can
+run in any environment — the jaxpr pass, which needs an importable
+``jax``, plugs into the same finding stream from :mod:`.jaxprpass`.
+"""
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: suppression comments: ``# dstlint: disable=rule-a,rule-b`` on the
+#: finding's line silences those rules there; ``disable-file=`` anywhere
+#: in the file silences them for the whole file. ``disable=all`` works.
+_SUPPRESS_RE = re.compile(r"#\s*dstlint:\s*disable(?P<scope>-file)?="
+                          r"(?P<rules>[A-Za-z0-9_,-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative, posix separators
+    line: int            # 1-indexed
+    col: int
+    message: str
+    baselined: bool = False
+
+    def fingerprint(self, line_text: str = "") -> str:
+        """Stable identity for baselining: rule + path + the stripped
+        source text of the finding's line — tolerant of line-number
+        drift from unrelated edits, invalidated when the flagged code
+        itself changes (which is what a baseline should do). Findings
+        with no source line (the jaxpr pass's pseudo-paths) fall back
+        to the message, so distinct defects on one entry point never
+        share a baseline grant."""
+        h = hashlib.sha1()
+        ident = line_text.strip() or self.message
+        h.update(f"{self.rule}::{self.path}::{ident}".encode("utf-8"))
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}{tag}"
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class Suppressions:
+    """Per-file ``# dstlint: disable=`` comment index."""
+
+    def __init__(self, source_lines: Sequence[str]):
+        self.by_line: Dict[int, set] = {}
+        self.file_level: set = set()
+        for i, text in enumerate(source_lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if m.group("scope"):
+                self.file_level |= rules
+            else:
+                self.by_line.setdefault(i, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        for ruleset in (self.file_level, self.by_line.get(line, ())):
+            if "all" in ruleset or rule in ruleset:
+                return True
+        return False
+
+
+class Baseline:
+    """Grandfathered findings. The file maps fingerprints to counts so N
+    identical findings on one line (or identical lines) need N slots —
+    a fixed violation frees its slot and a NEW identical one then fails
+    loudly instead of hiding under the old grant."""
+
+    def __init__(self, fingerprints: Optional[Dict[str, int]] = None):
+        self.fingerprints = dict(fingerprints or {})
+
+    def filter(self, findings: List[Finding],
+               line_texts: Dict[Tuple[str, int], str]) -> List[Finding]:
+        """Mark baselined findings (budget-respecting); returns the full
+        list with ``baselined`` set — callers decide whether baselined
+        findings fail the run (they don't, by default)."""
+        budget = dict(self.fingerprints)
+        out = []
+        for f in findings:
+            fp = f.fingerprint(line_texts.get((f.path, f.line), ""))
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                f = dataclasses.replace(f, baselined=True)
+            out.append(f)
+        return out
+
+    @staticmethod
+    def from_findings(findings: Iterable[Finding],
+                      line_texts: Dict[Tuple[str, int], str]) -> "Baseline":
+        fps: Dict[str, int] = {}
+        for f in findings:
+            fp = f.fingerprint(line_texts.get((f.path, f.line), ""))
+            fps[fp] = fps.get(fp, 0) + 1
+        return Baseline(fps)
+
+    def to_json(self) -> Dict:
+        return {"version": 1,
+                "fingerprints": dict(sorted(self.fingerprints.items()))}
+
+
+def load_baseline(path) -> Baseline:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return Baseline()
+    return Baseline(data.get("fingerprints", {}))
+
+
+def save_baseline(path, baseline: Baseline) -> None:
+    with open(path, "w") as f:
+        json.dump(baseline.to_json(), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+@dataclasses.dataclass
+class LintConfig:
+    select: Optional[set] = None     # None = all rules
+    ignore: set = dataclasses.field(default_factory=set)
+
+    def rule_enabled(self, rule: str) -> bool:
+        if rule in self.ignore:
+            return False
+        return self.select is None or rule in self.select
+
+
+def lint_source(source: str, relpath: str,
+                config: Optional[LintConfig] = None) -> List[Finding]:
+    """AST-lint one module's source. ``relpath`` is the repo-relative
+    posix path used both for reporting and for path-scoped rules
+    (``no-arg-mutation`` only fires under ``ops/``/``inference/``,
+    ``donation-check`` only on the engine entry-point files)."""
+    from deepspeed_tpu.tools.dstlint.astpass import analyze_module
+
+    config = config or LintConfig()
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("syntax-error", relpath, e.lineno or 1, 0,
+                        f"could not parse: {e.msg}")]
+    lines = source.splitlines()
+    sup = Suppressions(lines)
+    raw = analyze_module(tree, relpath)
+    return [f for f in raw
+            if config.rule_enabled(f.rule)
+            and not sup.is_suppressed(f.rule, f.line)]
+
+
+def run_lint(files: Sequence[Tuple[str, str]],
+             config: Optional[LintConfig] = None,
+             baseline: Optional[Baseline] = None) -> List[Finding]:
+    """Lint ``(relpath, source)`` pairs; apply the baseline across the
+    whole batch. Returns all findings, baselined ones marked."""
+    findings: List[Finding] = []
+    line_texts: Dict[Tuple[str, int], str] = {}
+    for relpath, source in files:
+        fs = lint_source(source, relpath, config)
+        lines = source.splitlines()
+        for f in fs:
+            if 1 <= f.line <= len(lines):
+                line_texts[(relpath, f.line)] = lines[f.line - 1]
+        findings.extend(fs)
+    if baseline is not None:
+        findings = baseline.filter(findings, line_texts)
+    return findings
+
+
+def collect_line_texts(files: Sequence[Tuple[str, str]],
+                       findings: Sequence[Finding]
+                       ) -> Dict[Tuple[str, int], str]:
+    """(path, line) -> source text for fingerprints, e.g. when WRITING a
+    baseline from a finding list produced elsewhere."""
+    by_path = {rel: src.splitlines() for rel, src in files}
+    out = {}
+    for f in findings:
+        lines = by_path.get(f.path)
+        if lines and 1 <= f.line <= len(lines):
+            out[(f.path, f.line)] = lines[f.line - 1]
+    return out

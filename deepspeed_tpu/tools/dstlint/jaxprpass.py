@@ -1,0 +1,277 @@
+"""dstlint jaxpr pass — trace the serving entry points, check what XLA
+will actually see.
+
+The AST rules catch what the *source* says; this pass catches what the
+*trace* contains. It abstractly traces (``jax.make_jaxpr`` — no device
+execution, no real weights) the registered serving entry points over a
+tiny Llama config:
+
+- the paged DECODE step (``PagedServeExecutor._build_decode_fn``), on
+  both attention arms,
+- a PREFILL bucket (``_build_prefill_fn(PROMPT_BUCKET)``),
+- the prefix-cache ``copy_pool_blocks`` program,
+
+and fails on:
+
+- ``jaxpr-forbidden-primitive``: callback/host-transfer primitives in a
+  hot serving jaxpr (a ``pure_callback`` or ``device_put`` smuggled into
+  the decode loop is a per-step host round-trip — the regression class
+  DeepSpeed-Inference calls out as dominating serving latency);
+- ``jaxpr-kernel-arm``: the Pallas arm tracing WITHOUT a
+  ``pallas_call`` equation — i.e. the kernel silently fell back to the
+  reference gather (wrapper dispatch drift, version-gated imports);
+- ``jaxpr-budget``: total equation count drifting beyond the
+  checked-in budget (``tools/dstlint/jaxpr_budgets.json``) — catches
+  accidental de-dup regressions (e.g. a loop-invariant dequant
+  re-materialized per decode step) and silent fallback in either
+  direction. Regenerate after intentional changes:
+  ``bin/dst lint --update-budgets``.
+"""
+
+import contextlib
+import dataclasses
+import json
+from collections import Counter
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.tools.dstlint.core import Finding
+
+JAXPR_RULES = ("jaxpr-forbidden-primitive", "jaxpr-kernel-arm",
+               "jaxpr-budget")
+
+#: primitive names that must never appear in a serving jaxpr — host
+#: callbacks and explicit transfers are per-step host round-trips
+FORBIDDEN_SUBSTRINGS = ("callback",)
+FORBIDDEN_EXACT = {"outside_call", "host_local_array_to_global_array",
+                   "device_put", "infeed", "outfeed"}
+
+DEFAULT_TOLERANCE_PCT = 25
+
+# tiny serving shape — big enough to exercise GQA + multi-block tables
+_SLOTS = 2
+_WIDTH = 4
+_BLOCK = 8
+_NUM_BLOCKS = 9
+_CHUNK = 4
+
+
+@dataclasses.dataclass
+class EntryReport:
+    name: str
+    eqns: int
+    primitives: Dict[str, int]
+    pallas_calls: int
+    error: Optional[str] = None
+
+
+def _count_jaxpr(jaxpr, counter: Counter) -> int:
+    """Total equation count, recursing into call/control-flow/pallas
+    sub-jaxprs; fills ``counter`` with primitive names."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        counter[eqn.primitive.name] += 1
+        total += 1
+        for v in eqn.params.values():
+            total += _count_sub(v, counter)
+    return total
+
+
+def _count_sub(v, counter: Counter) -> int:
+    import jax
+
+    core = jax.core if hasattr(jax, "core") else None
+    if core is not None and isinstance(v, core.ClosedJaxpr):
+        return _count_jaxpr(v.jaxpr, counter)
+    if core is not None and isinstance(v, core.Jaxpr):
+        return _count_jaxpr(v, counter)
+    if isinstance(v, (list, tuple)):
+        return sum(_count_sub(x, counter) for x in v)
+    return 0
+
+
+def _abstract_serving_pieces(arm: str):
+    """(decode_jit, decode_avals, prefill_jit, prefill_avals, copy_jit,
+    copy_avals) for a tiny Llama over the given attention arm — all
+    arguments are ShapeDtypeStructs, nothing touches a device."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import (
+        PROMPT_BUCKET, PagedServeExecutor, resolve_paged_decoder,
+    )
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    from deepspeed_tpu.ops.paged_attention import copy_pool_blocks
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    raw_params = jax.eval_shape(
+        lambda r, x: model.init(r, x)["params"], rng, ids)
+    paged_apply, init_pools, transform, _ = resolve_paged_decoder(
+        cfg, attn_kernel=arm)
+    params = raw_params if transform is None else \
+        jax.eval_shape(transform, raw_params)
+    pools = jax.eval_shape(
+        lambda: init_pools(cfg, _NUM_BLOCKS, _BLOCK, jnp.float32))
+
+    ex = PagedServeExecutor(paged_apply, None, None, cfg,
+                            contextlib.nullcontext, num_slots=_SLOTS,
+                            decode_chunk=_CHUNK)
+    decode_jit = ex._build_decode_fn(_CHUNK)
+    prefill_jit = ex._build_prefill_fn(PROMPT_BUCKET)
+
+    sds = jax.ShapeDtypeStruct
+    B, W = _SLOTS, _WIDTH
+    i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+    decode_avals = (
+        params, sds((B,), i32), pools, sds((B, W), i32), sds((B,), i32),
+        sds((B,), i32), sds((), i32), sds((B, 2), u32), sds((B,), f32),
+        sds((B,), i32), sds((B,), f32), sds((B,), i32))
+    prefill_avals = (
+        params, sds((1, PROMPT_BUCKET), i32), pools, sds((1, W), i32),
+        sds((), i32), sds((), i32), sds((2,), u32), sds((), f32),
+        sds((), i32), sds((), f32))
+    copy_jit = jax.jit(copy_pool_blocks, donate_argnums=(0,))
+    copy_avals = (pools, sds((1,), i32), sds((1,), i32))
+    return (decode_jit, decode_avals, prefill_jit, prefill_avals,
+            copy_jit, copy_avals)
+
+
+def _report(name: str, fn, avals) -> EntryReport:
+    import jax
+
+    try:
+        jaxpr = jax.make_jaxpr(fn)(*avals)
+    except Exception as e:   # report, don't crash the linter (exit 2 is
+        # reserved for dstlint's own bugs; a broken entry point is a finding)
+        return EntryReport(name, 0, {}, 0, error=f"{type(e).__name__}: {e}")
+    counter: Counter = Counter()
+    total = _count_jaxpr(jaxpr.jaxpr, counter)
+    return EntryReport(name, total, dict(counter),
+                       counter.get("pallas_call", 0))
+
+
+def available_arms() -> List[str]:
+    """'reference' always; 'pallas' when the kernel actually runs on
+    this toolchain (the same probe the serving tests gate on)."""
+    arms = ["reference"]
+    try:
+        from deepspeed_tpu.ops.paged_attention_kernel import (
+            pallas_paged_available,
+        )
+
+        if pallas_paged_available():
+            arms.append("pallas")
+    except Exception:
+        pass
+    return arms
+
+
+def trace_entry_points(arms: Optional[List[str]] = None
+                       ) -> Dict[str, EntryReport]:
+    reports: Dict[str, EntryReport] = {}
+    for arm in (arms if arms is not None else available_arms()):
+        try:
+            (decode_jit, decode_avals, prefill_jit, prefill_avals,
+             copy_jit, copy_avals) = _abstract_serving_pieces(arm)
+        except Exception as e:
+            reports[f"decode_step/{arm}"] = EntryReport(
+                f"decode_step/{arm}", 0, {}, 0,
+                error=f"{type(e).__name__}: {e}")
+            continue
+        reports[f"decode_step/{arm}"] = _report(
+            f"decode_step/{arm}", decode_jit, decode_avals)
+        reports[f"prefill_bucket/{arm}"] = _report(
+            f"prefill_bucket/{arm}", prefill_jit, prefill_avals)
+        if arm == "reference":
+            reports["copy_pool_blocks"] = _report(
+                "copy_pool_blocks", copy_jit, copy_avals)
+    return reports
+
+
+def check_reports(reports: Dict[str, EntryReport],
+                  budgets: Optional[dict]) -> List[Finding]:
+    """Findings from traced entry reports + the checked-in budget file.
+    The pseudo-path ``<jaxpr:NAME>`` keeps jaxpr findings addressable by
+    ``--select/--ignore`` and the baseline machinery."""
+    findings: List[Finding] = []
+    entries = (budgets or {}).get("entries", {})
+
+    def emit(rule, name, msg):
+        findings.append(Finding(rule, f"<jaxpr:{name}>", 1, 0, msg))
+
+    for name, rep in reports.items():
+        if rep.error is not None:
+            emit("jaxpr-budget", name,
+                 f"entry point failed to trace: {rep.error}")
+            continue
+        for prim, n in sorted(rep.primitives.items()):
+            if prim in FORBIDDEN_EXACT or any(
+                    s in prim for s in FORBIDDEN_SUBSTRINGS):
+                emit("jaxpr-forbidden-primitive", name,
+                     f"forbidden primitive '{prim}' x{n} in the "
+                     f"serving jaxpr — host round-trip per step")
+        # only the DECODE step must contain the kernel: prefill (T>1)
+        # falls back to the reference in-wrapper by design
+        if name.startswith("decode_step") and name.endswith("/pallas") \
+                and rep.pallas_calls == 0:
+            emit("jaxpr-kernel-arm", name,
+                 "Pallas arm traced WITHOUT any pallas_call equation — "
+                 "the kernel silently fell back to the reference "
+                 "gather (dispatch or version-gate drift)")
+        budget = entries.get(name)
+        if budget is None:
+            emit("jaxpr-budget", name,
+                 f"no checked-in equation budget for this entry point "
+                 f"(measured {rep.eqns} eqns) — run "
+                 f"`bin/dst lint --update-budgets`")
+            continue
+        ref = budget.get("eqns", 0)
+        tol = budget.get("tolerance_pct", DEFAULT_TOLERANCE_PCT)
+        if ref and abs(rep.eqns - ref) * 100 > tol * ref:
+            emit("jaxpr-budget", name,
+                 f"equation count drifted: {rep.eqns} vs budget {ref} "
+                 f"(±{tol}%) — a de-dup/fallback regression, or an "
+                 f"intentional change (then run "
+                 f"`bin/dst lint --update-budgets`)")
+    # a budgeted entry point that did not trace at all must fail loudly
+    # too: the usual cause is the Pallas arm dropping out on a skewed
+    # toolchain — exactly the silent reference fallback this pass exists
+    # to catch
+    for name in sorted(entries):
+        if name not in reports:
+            emit("jaxpr-budget", name,
+                 "budgeted entry point was NOT traced this run (its "
+                 "attention arm is unavailable on this toolchain?) — "
+                 "serving would silently fall back to the reference "
+                 "arm; fix the toolchain or re-anchor with "
+                 "`bin/dst lint --update-budgets`")
+    return findings
+
+
+def load_budgets(path) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def budgets_from_reports(reports: Dict[str, EntryReport],
+                         tolerance_pct: int = DEFAULT_TOLERANCE_PCT
+                         ) -> dict:
+    import jax
+
+    entries = {}
+    for name, rep in sorted(reports.items()):
+        if rep.error is None:
+            entries[name] = {"eqns": rep.eqns,
+                             "tolerance_pct": tolerance_pct,
+                             "pallas_calls": rep.pallas_calls}
+    return {"version": 1, "jax_version": jax.__version__,
+            "entries": entries}
+
+
+def run_jaxpr_pass(budgets_path) -> List[Finding]:
+    return check_reports(trace_entry_points(), load_budgets(budgets_path))

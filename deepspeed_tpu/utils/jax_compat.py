@@ -81,20 +81,48 @@ else:  # pragma: no cover - exercised only on older jax
 
 
 # --- pallas TPU surface: import seam for kernel modules -----------------------
-def pallas_tpu():
+class _MissingPallas:
+    """Placeholder for a missing Pallas surface: importable, but any
+    attribute access raises a diagnosis instead of the bare
+    ``'NoneType' object has no attribute ...`` deep inside tracing."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def __getattr__(self, attr):
+        # AttributeError (not RuntimeError) so hasattr/getattr-default
+        # availability probes (e.g. paged_attention_kernel's
+        # hasattr(pltpu, "PrefetchScalarGridSpec")) degrade gracefully
+        # while direct use still carries the diagnosis
+        raise AttributeError(
+            f"jax.experimental.{self._name}.{attr}: the Pallas surface "
+            f"is unavailable on this jax build (version skew / stripped "
+            f"build) — the Pallas kernel paths cannot run here; use the "
+            f"reference/XLA arms")
+
+    def __bool__(self):  # pragma: no cover - skewed toolchains
+        return False
+
+
+def pallas_tpu(placeholder: bool = False):
     """``(pl, pltpu)`` — the Pallas core and TPU modules — or ``(None,
     None)`` when the deployed jax lacks the Pallas TPU surface (version
-    skew / stripped builds). New kernel modules import through HERE so a
+    skew / stripped builds). Kernel modules import through HERE so a
     missing/moved pallas import degrades to their documented jnp
     fallback instead of an ImportError at module import time (the
     serving stack must stay importable on any toolchain; see
-    ops/paged_attention_kernel.py)."""
+    ops/paged_attention_kernel.py). ``placeholder=True`` returns
+    raising proxies instead of ``(None, None)`` — for modules that
+    dispatch lazily and would otherwise die with an opaque NoneType
+    AttributeError mid-trace."""
     try:
         from jax.experimental import pallas as _pl
         from jax.experimental.pallas import tpu as _pltpu
 
         return _pl, _pltpu
     except Exception:  # pragma: no cover - only on skewed toolchains
+        if placeholder:
+            return _MissingPallas("pallas"), _MissingPallas("pallas.tpu")
         return None, None
 
 

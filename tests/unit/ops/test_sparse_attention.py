@@ -57,6 +57,18 @@ def test_unidirectional_layout_is_lower_triangular():
     assert (np.triu(layout, k=1) == 0).all()
 
 
+def test_propagate_first_head_is_pure():
+    """dstlint no-arg-mutation regression: the input layout must be
+    left untouched (copy-on-write), like retile_gateup_for_fused_mlp."""
+    cfg = CONFIGS["dense"]
+    layout = cfg.setup_layout(64)
+    layout[0, 0, 0] = 1          # head 0 differs from the other heads
+    before = layout.copy()
+    out = cfg.propagate_first_head(layout)
+    np.testing.assert_array_equal(layout, before)
+    assert (out[1:] == out[0]).all() and out is not layout
+
+
 @pytest.mark.parametrize("name", sorted(CONFIGS))
 def test_sparse_matches_masked_reference(rng, name):
     cfg = CONFIGS[name]

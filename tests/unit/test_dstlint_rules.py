@@ -1,0 +1,475 @@
+"""Per-rule dstlint coverage: every shipped rule catches its target
+snippet (positive fixture) and stays silent on the idiomatic spelling
+(negative fixture), plus the suppression-comment and baseline-file
+round-trips and the jaxpr-pass failure modes on fabricated reports.
+
+Pure library-level tests — no subprocess, no jax tracing (the full
+analyzer-over-the-repo gate lives in tests/unit/test_dstlint.py).
+"""
+
+import textwrap
+
+from deepspeed_tpu.tools.dstlint import core
+from deepspeed_tpu.tools.dstlint.jaxprpass import EntryReport, check_reports
+
+OPS = "deepspeed_tpu/ops/somemod.py"          # no-arg-mutation scope
+ENGINE = "deepspeed_tpu/inference/engine.py"  # donation-check scope
+ANY = "deepspeed_tpu/runtime/somemod.py"
+
+
+def lint(src, relpath=ANY, **cfg):
+    return core.lint_source(textwrap.dedent(src), relpath,
+                            core.LintConfig(**cfg))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --- jax-compat-seam ---------------------------------------------------------
+
+def test_seam_catches_direct_attribute_use():
+    src = """
+        import jax
+
+        def enter(mesh):
+            return jax.set_mesh(mesh)
+    """
+    assert rules_of(lint(src)) == ["jax-compat-seam"]
+
+
+def test_seam_catches_lax_alias_and_import():
+    src = """
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+
+        def f(x):
+            return lax.pvary(x, ("data",))
+    """
+    assert rules_of(lint(src)) == ["jax-compat-seam", "jax-compat-seam"]
+
+
+def test_seam_catches_pallas_import_once_not_per_use():
+    src = """
+        from jax.experimental import pallas as pl
+
+        def build():
+            return pl.BlockSpec((1, 1), lambda i: (i, 0))
+    """
+    fs = lint(src)
+    assert rules_of(fs) == ["jax-compat-seam"]
+    assert fs[0].line == 2          # the import, not the pl.* uses
+
+
+def test_seam_catches_retired_with_mesh_spelling():
+    src = """
+        def run(self):
+            with self.mesh:
+                pass
+    """
+    assert rules_of(lint(src)) == ["jax-compat-seam"]
+
+
+def test_seam_silent_on_compat_import_and_seam_module_itself():
+    src = """
+        from deepspeed_tpu.utils.jax_compat import set_mesh, shard_map
+
+        def enter(mesh):
+            with set_mesh(mesh):
+                return shard_map
+    """
+    assert lint(src) == []
+    direct = """
+        import jax
+        set_mesh = jax.set_mesh
+    """
+    assert lint(direct, "deepspeed_tpu/utils/jax_compat.py") == []
+
+
+# --- no-host-sync-in-jit -----------------------------------------------------
+
+def test_host_sync_item_inside_jit():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.sum().item()
+    """
+    assert rules_of(lint(src)) == ["no-host-sync-in-jit"]
+
+
+def test_host_sync_float_and_asarray_on_traced_args():
+    src = """
+        import jax
+        import numpy as np
+
+        def gen(x, y):
+            return float(x) + np.asarray(y)
+
+        fn = jax.jit(gen)
+    """
+    assert rules_of(lint(src)) == ["no-host-sync-in-jit"] * 2
+
+
+def test_host_sync_inside_while_loop_body():
+    src = """
+        from jax import lax
+
+        def drive(x0):
+            def body(x):
+                return x + x.mean().item()
+
+            def cond(x):
+                return (x < 1).all()
+
+            return lax.while_loop(cond, body, x0)
+    """
+    assert rules_of(lint(src)) == ["no-host-sync-in-jit"]
+
+
+def test_host_sync_silent_outside_traced_context_and_on_shapes():
+    src = """
+        import jax
+
+        def host_side(x):
+            return x.item()
+
+        @jax.jit
+        def step(x):
+            return x * float(x.shape[0])
+    """
+    assert lint(src) == []
+
+
+def test_host_sync_silent_on_static_item_inside_jit():
+    # .item() on a host-static value (closure constant) inside a traced
+    # body is not a sync on a tracer — zero-FP bias
+    src = """
+        import jax
+        import numpy as np
+
+        SCALE = np.float32(2.0)
+
+        @jax.jit
+        def step(x):
+            return x * SCALE.item()
+    """
+    assert lint(src) == []
+
+
+# --- recompile-hazard --------------------------------------------------------
+
+def test_recompile_python_if_on_traced_value():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """
+    assert rules_of(lint(src)) == ["recompile-hazard"]
+
+
+def test_recompile_assert_and_fstring_on_traced_value():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            assert x > 0
+            key = f"bucket-{x}"
+            return x
+    """
+    assert rules_of(lint(src)) == ["recompile-hazard"] * 2
+
+
+def test_recompile_static_argnums_naming_a_buffer():
+    src = """
+        import jax
+
+        def step(params, tokens):
+            return tokens
+
+        fn = jax.jit(step, static_argnums=(1,))
+    """
+    assert rules_of(lint(src)) == ["recompile-hazard"]
+
+
+def test_recompile_static_argnums_silent_on_scalar_knob_names():
+    # single-letter params (top-k's `k`, a static `x` size) are
+    # idiomatic static scalars — must not collide with buffer names
+    src = """
+        import jax
+
+        def sample_topk(logits, k):
+            return logits[..., :k]
+
+        fn = jax.jit(sample_topk, static_argnums=(1,))
+    """
+    assert lint(src) == []
+
+
+def test_recompile_silent_on_none_checks_and_shape_branches():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is not None:
+                x = x + mask
+            if x.shape[0] > 1:
+                x = x[:1]
+            return x
+    """
+    assert lint(src) == []
+
+
+# --- pallas-kernel-hygiene ---------------------------------------------------
+
+def test_pallas_repeat_print_and_data_dependent_if():
+    src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            v = x_ref[...]
+            if v.sum() > 0:
+                o_ref[...] = jnp.repeat(v, 2, axis=0)
+            print(v)
+
+        def call(x):
+            return pl.pallas_call(kernel, out_shape=None)(x)
+    """
+    got = rules_of(lint(src, select={"pallas-kernel-hygiene"}))
+    assert got == ["pallas-kernel-hygiene"] * 3
+
+
+def test_pallas_silent_outside_kernels_and_on_partial_kernels():
+    src = """
+        import functools
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def reference(k, rep):
+            return jnp.repeat(k, rep, axis=2)     # allowed: not a kernel
+
+        def kernel(x_ref, o_ref, *, bs):
+            o_ref[...] = x_ref[...] * bs
+
+        def call(x):
+            return pl.pallas_call(functools.partial(kernel, bs=2),
+                                  out_shape=None)(x)
+    """
+    assert lint(src, select={"pallas-kernel-hygiene"}) == []
+
+
+# --- no-arg-mutation ---------------------------------------------------------
+
+def test_arg_mutation_subscript_write_and_method():
+    src = """
+        def retile(params):
+            params["w"] = params["w"].T
+            return params
+
+        def register(registry, op):
+            registry.update({op: 1})
+    """
+    assert rules_of(lint(src, OPS)) == ["no-arg-mutation"] * 2
+
+
+def test_arg_mutation_silent_on_locals_refs_and_outside_scope():
+    src = """
+        import numpy as np
+
+        def build(n):
+            out = np.zeros(n)
+            out[0] = 1              # local: fine
+            return out
+
+        def update(m_scr, x):
+            m_scr[...] = x          # pallas Ref protocol: exempt
+
+        def rebind(params):
+            params = dict(params)
+            params["w"] = 1         # shadowed copy: fine
+            return params
+    """
+    assert lint(src, OPS) == []
+    mutating = """
+        def f(d):
+            d["k"] = 1
+    """
+    # same code outside ops//inference/ is out of the rule's contract
+    assert lint(mutating, ANY) == []
+
+
+# --- donation-check ----------------------------------------------------------
+
+def test_donation_missing_on_pool_buffer():
+    src = """
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        fn = jax.jit(step)
+    """
+    assert rules_of(lint(src, ENGINE)) == ["donation-check"]
+
+
+def test_donation_missing_on_bare_jit_decorator():
+    # the MOST idiomatic spelling of the violation: a bare @jax.jit
+    # has no kwargs at all, so nothing is donated
+    src = """
+        import jax
+
+        @jax.jit
+        def step(params, tokens, pools):
+            return tokens, pools
+    """
+    assert rules_of(lint(src, ENGINE)) == ["donation-check"]
+
+
+def test_donation_satisfied_and_out_of_scope_file():
+    src = """
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        fn = jax.jit(step, donate_argnums=(2,))
+    """
+    assert lint(src, ENGINE) == []
+    undonated = """
+        import jax
+
+        def step(pools):
+            return pools
+
+        fn = jax.jit(step)
+    """
+    assert lint(undonated, OPS) == []
+
+
+# --- suppressions ------------------------------------------------------------
+
+def test_inline_suppression_silences_one_line():
+    src = """
+        import jax
+
+        def enter(mesh):
+            return jax.set_mesh(mesh)  # dstlint: disable=jax-compat-seam
+    """
+    assert lint(src) == []
+
+
+def test_file_level_suppression_and_select_ignore():
+    src = """
+        # dstlint: disable-file=jax-compat-seam
+        import jax
+
+        def enter(mesh):
+            return jax.set_mesh(mesh)
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """
+    assert rules_of(lint(src)) == ["no-host-sync-in-jit"]
+    assert lint(src, ignore={"no-host-sync-in-jit"}) == []
+    assert rules_of(lint(src, select={"no-host-sync-in-jit"})) == \
+        ["no-host-sync-in-jit"]
+
+
+# --- baseline round-trip -----------------------------------------------------
+
+def test_baseline_round_trip_grandfathers_then_catches_new():
+    src = textwrap.dedent("""
+        import jax
+
+        def enter(mesh):
+            return jax.set_mesh(mesh)
+    """)
+    files = [(ANY, src)]
+    findings = core.run_lint(files)
+    assert rules_of(findings) == ["jax-compat-seam"]
+
+    texts = core.collect_line_texts(files, findings)
+    baseline = core.Baseline.from_findings(findings, texts)
+    # round-trip through JSON exactly like the CLI does
+    baseline = core.Baseline(baseline.to_json()["fingerprints"])
+
+    again = core.run_lint(files, baseline=baseline)
+    assert [f.baselined for f in again] == [True]
+
+    # a NEW identical violation elsewhere is NOT covered by the grant
+    grown = src + textwrap.dedent("""
+        def enter2(mesh):
+            return jax.shard_map
+    """)
+    fresh = core.run_lint([(ANY, grown)], baseline=baseline)
+    assert sorted((f.rule, f.baselined) for f in fresh) == [
+        ("jax-compat-seam", False), ("jax-compat-seam", True)]
+
+
+# --- jaxpr pass (fabricated reports — no tracing) ----------------------------
+
+def _budgets(**entries):
+    return {"version": 1, "entries": entries}
+
+
+def test_jaxpr_silent_fallback_to_reference_fails_loudly():
+    reports = {"decode_step/pallas": EntryReport(
+        "decode_step/pallas", 400, {"while": 1}, pallas_calls=0)}
+    budgets = _budgets(**{"decode_step/pallas": {"eqns": 400}})
+    got = [f.rule for f in check_reports(reports, budgets)]
+    assert "jaxpr-kernel-arm" in got
+
+
+def test_jaxpr_prefill_pallas_fallback_is_expected():
+    reports = {"prefill_bucket/pallas": EntryReport(
+        "prefill_bucket/pallas", 300, {}, pallas_calls=0)}
+    budgets = _budgets(**{"prefill_bucket/pallas": {"eqns": 300}})
+    assert check_reports(reports, budgets) == []
+
+
+def test_jaxpr_forbidden_primitive_and_budget_drift():
+    reports = {"decode_step/reference": EntryReport(
+        "decode_step/reference", 800, {"pure_callback": 2}, 0)}
+    budgets = _budgets(**{"decode_step/reference":
+                          {"eqns": 400, "tolerance_pct": 25}})
+    got = [f.rule for f in check_reports(reports, budgets)]
+    assert got.count("jaxpr-forbidden-primitive") == 1
+    assert got.count("jaxpr-budget") == 1
+
+
+def test_jaxpr_budgeted_entry_not_traced_fails_loudly():
+    # the Pallas arm dropping out of available_arms() (toolchain skew)
+    # must not silently skip its checked-in budget
+    budgets = _budgets(**{"decode_step/pallas": {"eqns": 449}})
+    got = check_reports({}, budgets)
+    assert [f.rule for f in got] == ["jaxpr-budget"]
+    assert "NOT traced" in got[0].message
+
+
+def test_jaxpr_findings_fingerprint_by_message_not_shared():
+    a = core.Finding("jaxpr-budget", "<jaxpr:decode_step/pallas>", 1, 0,
+                     "no checked-in budget")
+    b = core.Finding("jaxpr-budget", "<jaxpr:decode_step/pallas>", 1, 0,
+                     "equation count drifted: 900 vs 449")
+    assert a.fingerprint("") != b.fingerprint("")
+
+
+def test_jaxpr_missing_budget_and_trace_error_are_findings():
+    reports = {
+        "decode_step/reference": EntryReport(
+            "decode_step/reference", 400, {}, 0),
+        "prefill_bucket/reference": EntryReport(
+            "prefill_bucket/reference", 0, {}, 0,
+            error="ValueError: boom"),
+    }
+    got = [f.rule for f in check_reports(reports, _budgets())]
+    assert got == ["jaxpr-budget", "jaxpr-budget"]

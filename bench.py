@@ -735,6 +735,209 @@ def serve_prefix_main(num_slots=None, trace_seed=None,
     return result
 
 
+def serve_chaos_main(seed=None, out_path="BENCH_SERVE.json"):
+    """--serve --chaos: the fault-tolerance contract measured on the
+    REAL compiled serving path (docs/SERVING.md).
+
+    Two arms over one seeded mixed-length trace on the same engine:
+
+    - ``fault_free``: the plain continuous-batching run (the
+      degradation baseline);
+    - ``chaos``: the same trace with a seeded ``FaultInjector`` plan
+      (pool-exhaustion window, mid-prefill fault, slot-attributed
+      mid-decode fault, cancel burst) plus two requests carrying
+      already-expired deadlines, the invariant auditor at EVERY chunk,
+      and an abandoned-stream probe (a half-consumed generate_stream
+      dropped mid-flight) after the drain.
+
+    The bench ASSERTS the contract before recording: every request
+    resolves to a terminal status, unaffected completions are
+    byte-identical to the fault-free arm, and the pool ends fully free
+    with a clean audit — then writes degradation metrics (tokens/s
+    ratio, status counts, injector firing log, preemptions) into
+    ``detail.chaos`` of BENCH_SERVE.json.
+    """
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+    from deepspeed_tpu.inference.scheduler import COMPLETED, Request
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    on_tpu = jax.default_backend() == "tpu"
+    seed = 0 if seed is None else int(seed)
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
+            dtype=jnp.bfloat16, scan_layers=True)
+        num_slots, n_requests, decode_chunk, block_size = 8, 32, 8, 32
+        prompt_lens, gen_mix = (32, 64, 96), (16, 32, 64)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=4096, hidden_size=512, intermediate_size=1024,
+            num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=512,
+            dtype=jnp.float32)
+        num_slots, n_requests, decode_chunk, block_size = 4, 24, 8, 8
+        prompt_lens, gen_mix = (6, 10, 17), (8, 12, 24)
+
+    model = LlamaModel(cfg)
+    params = jax.jit(
+        lambda r: model.init(
+            r, jnp.zeros((1, max(prompt_lens)), jnp.int32))["params"])(
+        jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, params=params, model_config=cfg,
+        config={"dtype": "bfloat16" if on_tpu else "float32"})
+
+    def make_trace():
+        rng = np.random.default_rng(seed + 1)
+        return [(rng.integers(1, cfg.vocab_size,
+                              int(rng.choice(prompt_lens))),
+                 int(rng.choice(gen_mix)))
+                for _ in range(n_requests)]
+
+    trace = make_trace()
+    total_gen = sum(g for _, g in trace)
+    # deterministic victims: one prefill fault, one decode fault window,
+    # a cancel burst, two expired deadlines — all drawn from the seed
+    rng = np.random.default_rng(seed)
+    victims = rng.choice(n_requests, size=5, replace=False).tolist()
+    prefill_victim = victims[0]
+    cancel_burst = victims[1:3]
+    deadline_victims = set(victims[3:5])
+    plan = [
+        FaultSpec(site="pool", step=int(rng.integers(3, 8)),
+                  duration=int(rng.integers(2, 5))),
+        FaultSpec(site="prefill", rid=prefill_victim,
+                  message="injected prefill fault"),
+        FaultSpec(site="decode", step=int(rng.integers(8, 14)),
+                  slot=int(rng.integers(0, num_slots)),
+                  message="injected decode fault"),
+        FaultSpec(site="cancel", step=int(rng.integers(4, 10)),
+                  rids=cancel_burst),
+    ]
+
+    def reqs_for(chaos: bool):
+        return [Request(
+            rid=i, prompt=p, max_new_tokens=g,
+            deadline_s=(0.0 if chaos and i in deadline_victims else None))
+            for i, (p, g) in enumerate(make_trace())]
+
+    def run(chaos: bool):
+        fi = FaultInjector(plan, seed=seed) if chaos else None
+        t0 = time.time()
+        comps = engine.serve(reqs_for(chaos), num_slots=num_slots,
+                             block_size=block_size,
+                             decode_chunk=decode_chunk,
+                             fault_injector=fi,
+                             audit_every=1 if chaos else 0)
+        wall = time.time() - t0
+        sched = engine.last_serve_scheduler
+        sched.audit(context="post-drain")        # clean or this run dies
+        assert sched.pool.num_allocated == 0, "pool not fully free"
+        return {"comps": {c.rid: c for c in comps}, "wall": wall,
+                "preemptions": sched.preemptions,
+                "injector": fi.summary() if fi else None}
+
+    run(chaos=False)                             # compile warm-up
+    base = run(chaos=False)
+    chaos = run(chaos=True)
+
+    # --- the contract, asserted before anything is recorded ------------------
+    assert sorted(chaos["comps"]) == list(range(n_requests)), \
+        "a request vanished without a terminal status"
+    status_counts, affected = {}, set()
+    generated_chaos = 0
+    for rid, c in chaos["comps"].items():
+        status_counts[c.status] = status_counts.get(c.status, 0) + 1
+        generated_chaos += len(c.tokens)
+        ref = np.asarray(base["comps"][rid].tokens)
+        got = np.asarray(c.tokens)
+        if c.status == COMPLETED:
+            assert np.array_equal(got, ref), \
+                f"unaffected request {rid} diverged under chaos"
+        else:
+            affected.add(rid)
+            # partial streams are exact prefixes of the fault-free one
+            assert np.array_equal(got, ref[:len(got)]), \
+                f"request {rid}: partial stream diverged"
+
+    # --- abandoned-stream probe on the same executor --------------------------
+    stream = engine.generate_stream(reqs_for(False)[:6],
+                                    num_slots=num_slots,
+                                    block_size=block_size,
+                                    decode_chunk=decode_chunk)
+    next(stream)
+    abandoned_pool = engine.last_serve_scheduler.pool
+    held_mid_flight = abandoned_pool.num_allocated
+    del stream
+    gc.collect()
+    assert abandoned_pool.num_allocated == 0, \
+        "abandoned stream leaked KV blocks"
+
+    base_tps = total_gen / base["wall"]
+    chaos_tps = generated_chaos / chaos["wall"]
+    detail = {
+        "seed": seed,
+        "n_requests": n_requests, "num_slots": num_slots,
+        "decode_chunk": decode_chunk, "block_size": block_size,
+        "total_trace_tokens": int(total_gen),
+        "fault_free": {
+            "tokens_per_sec": round(base_tps, 1),
+            "wall_s": round(base["wall"], 3),
+            "generated_tokens": int(total_gen),
+        },
+        "chaos": {
+            "tokens_per_sec": round(chaos_tps, 1),
+            "wall_s": round(chaos["wall"], 3),
+            "generated_tokens": int(generated_chaos),
+            "status_counts": status_counts,
+            "affected_requests": sorted(affected),
+            "preemptions": chaos["preemptions"],
+            "injector": chaos["injector"],
+        },
+        "degradation": {
+            # throughput of the surviving work vs the fault-free run —
+            # isolation means faults cost their own tokens, not the arm
+            "tokens_per_sec_ratio": round(chaos_tps / max(base_tps, 1e-9),
+                                          3),
+            "completed_fraction": round(
+                status_counts.get(COMPLETED, 0) / n_requests, 3),
+        },
+        "unaffected_byte_identical": True,       # asserted above
+        "pool_fully_free_after_all_arms": True,  # asserted above
+        "auditor": "clean (every chunk)",
+        "abandoned_stream_probe": {
+            "blocks_held_mid_flight": int(held_mid_flight),
+            "blocks_after_gc": 0,
+        },
+        "backend": jax.default_backend(),
+    }
+    result = {
+        "metric": "serve_chaos_tokens_per_sec_ratio",
+        "value": detail["degradation"]["tokens_per_sec_ratio"],
+        "unit": "x_of_fault_free",
+        "vs_baseline": detail["degradation"]["completed_fraction"],
+        "detail": detail,
+    }
+    print(json.dumps(result))
+    if out_path:
+        artifact = {}
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            pass
+        artifact.setdefault("detail", {})["chaos"] = detail
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return result
+
+
 def rlhf_main():
     """--rlhf: the DS-Chat-shaped three-model PPO loop — 770M actor on the
     hybrid engine (rollout prompt 256 + gen 128, the reference RLHF
@@ -1660,7 +1863,9 @@ if __name__ == "__main__":
                 sys.exit("--kernel requires reference|pallas|both, e.g. "
                          "bench.py --serve --kernel pallas")
             kernels = None if arm == "both" else [arm]
-        if "--shared-prefix" in sys.argv:
+        if "--chaos" in sys.argv:
+            serve_chaos_main(seed=_intflag("--seed"))
+        elif "--shared-prefix" in sys.argv:
             serve_prefix_main(num_slots=_intflag("--slots"),
                               trace_seed=_intflag("--trace-seed"),
                               kernel=(kernels or [None])[0])

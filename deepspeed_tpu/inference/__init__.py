@@ -1,6 +1,11 @@
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
-from deepspeed_tpu.inference.kv_pool import BlockPool
+from deepspeed_tpu.inference.faults import (
+    FaultInjector, FaultSpec, RequestFault,
+)
+from deepspeed_tpu.inference.kv_pool import BlockPool, PoolAuditError
 from deepspeed_tpu.inference.scheduler import (
+    CANCELLED, COMPLETED, FAILED, PREEMPTED_LIMIT, REJECTED,
+    TERMINAL_STATUSES, TIMED_OUT,
     Completion, ContinuousBatchingScheduler, Request,
 )

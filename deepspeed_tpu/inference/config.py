@@ -107,6 +107,29 @@ class ServeConfig(DeepSpeedConfigModel):
     # streams pinned identical in tier-1) — on by default; turn off for
     # strictly-unique traffic to skip the hashing overhead.
     prefix_cache: bool = True
+    # --- fault tolerance (docs/SERVING.md) -------------------------------
+    # bounded preemption: restart-from-prompt retries per request before
+    # it resolves PREEMPTED_LIMIT deterministically (victim selection is
+    # preempt-age-aware, so the cap is only reached when the pool truly
+    # cannot make progress — never as a livelock)
+    max_preemptions: int = 8
+    # default queue-wait bound in seconds (None = wait forever);
+    # Request.queue_timeout_s overrides per request, Request.deadline_s
+    # bounds total submit→finish wall clock
+    queue_timeout_s: Optional[float] = None
+    # stream lease: a generate_stream holds an expiring claim on its
+    # executor's pool; an abandoned iterator is reclaimed either by its
+    # finalizer (GC) or — if the object lingers un-pulled — by the next
+    # serve() call once this many seconds pass without progress, so
+    # abandoned streams can never strand KV blocks
+    lease_timeout_s: float = 60.0
+    # invariant auditor cadence: cross-check pool refcounts, block
+    # tables, free lists and the prefix-cache index every N decode
+    # chunks, failing fast with a full violation report (kv_pool.
+    # PoolAuditError). 0 disables; chaos tests run with 1. The sweep is
+    # O(pool blocks) of host set arithmetic — at the default cadence it
+    # is noise next to one decode program dispatch
+    audit_every: int = 64
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

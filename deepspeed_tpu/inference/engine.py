@@ -12,6 +12,7 @@ one program; where it injects fused kernels, XLA fuses — with the Pallas
 flash-attention path available for long prefills.
 """
 
+import sys
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
@@ -280,6 +281,51 @@ def build_generate_fn(apply_fn, B: int, T: int, max_new_tokens: int,
     return jax.jit(gen, donate_argnums=(2,))
 
 
+class ServeLease:
+    """Expiring claim one ``generate_stream`` holds on its executor.
+
+    The abandoned-iterator problem: a caller that drops a half-consumed
+    ``generate_stream`` leaves its scheduler suspended with KV blocks
+    allocated — before leases, those blocks stayed stranded until an
+    unrelated shape change rebuilt the pool. Now every stream holds a
+    lease that (a) is RELEASED deterministically when the generator is
+    closed or garbage-collected (the ``finally`` in ``generate_stream``
+    runs ``scheduler.shutdown()`` — all blocks back to the pool, cached
+    prefixes parked on the LRU), and (b) EXPIRES after
+    ``serve.lease_timeout_s`` seconds without progress, so even a
+    lingering un-pulled iterator object is reclaimed by the next
+    ``serve()`` call on the same executor instead of forcing a cold
+    pool. Touched once per yielded completion."""
+
+    def __init__(self, scheduler, timeout_s: float):
+        self.scheduler = scheduler
+        self.timeout_s = float(timeout_s)
+        self.expires_at = time.time() + self.timeout_s
+        self.closed = False
+        # CANCELLED terminals produced by an expiry-driven reclamation:
+        # kept here so the ORIGINAL stream, if its consumer resumes,
+        # still resolves every request it was serving (generate_stream
+        # drains these after its run loop ends)
+        self.reclaimed = []
+
+    def touch(self) -> None:
+        self.expires_at = time.time() + self.timeout_s
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (time.time() if now is None else now) > self.expires_at
+
+    def reclaim(self, error: str = "stream lease reclaimed") -> None:
+        """Release everything the stream still holds (idempotent). At
+        interpreter shutdown the finalizer-driven call is skipped —
+        module globals are already torn down and the process's pool
+        dies with it anyway (reclaiming would raise into the
+        'Exception ignored' stream)."""
+        if self.closed or sys.is_finalizing():
+            return
+        self.closed = True
+        self.reclaimed = self.scheduler.shutdown(error=error)
+
+
 class PagedServeExecutor:
     """Compiled prefill/decode programs over the device block pool — the
     executor the continuous-batching scheduler drives
@@ -321,6 +367,8 @@ class PagedServeExecutor:
         # index survives across serve() calls on this executor (the
         # device pools it describes already do)
         self._host_pool = None
+        # the live stream's lease (ServeLease) — None when quiescent
+        self._lease = None
 
     # --- scheduler protocol ---------------------------------------------------
     def set_slot(self, slot: int, req) -> None:
@@ -965,6 +1013,15 @@ class InferenceEngine:
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, T = input_ids.shape
+        # generate() keeps RAISE semantics for malformed inputs (the
+        # serving path's per-request REJECTED isolation exists to
+        # protect co-batched neighbors; a single direct call has none)
+        if T < 1:
+            raise ValueError("generate() got an empty prompt "
+                             "(input_ids.shape[1] == 0)")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         check_decode_length(self.model_config, T + max_new_tokens)
         if speculative not in (None, "prompt_lookup"):
             raise ValueError(
@@ -1074,7 +1131,12 @@ class InferenceEngine:
                         reserve_upfront: bool = False,
                         record_occupancy: bool = False,
                         prefix_cache: Optional[bool] = None,
-                        speculative: Optional[str] = None):
+                        speculative: Optional[str] = None,
+                        max_preemptions: Optional[int] = None,
+                        queue_timeout_s: Optional[float] = None,
+                        lease_timeout_s: Optional[float] = None,
+                        audit_every: Optional[int] = None,
+                        fault_injector=None):
         """Serve ``requests`` with continuous batching over a paged KV
         cache, yielding a ``Completion`` per request as it finishes.
 
@@ -1111,12 +1173,30 @@ class InferenceEngine:
         recompute bit-identically); the content index persists across
         ``serve()`` calls that reuse the executor —
         :meth:`reset_prefix_cache` drops it.
+
+        FAULT TOLERANCE (docs/SERVING.md): every request resolves to
+        exactly one ``Completion`` with a terminal ``status`` —
+        pre-admission validation failures (empty prompt, prompt/budget
+        past ``max_context``, bad ``max_new_tokens``) yield ``REJECTED``
+        results instead of raising mid-batch; mid-flight executor
+        errors fail only the request they belong to (``FAILED``);
+        :meth:`cancel_request` / ``Request.deadline_s`` /
+        ``queue_timeout_s`` resolve ``CANCELLED``/``TIMED_OUT`` at chunk
+        boundaries; restart-from-prompt preemption is bounded by
+        ``max_preemptions`` (``PREEMPTED_LIMIT``). The stream holds an
+        expiring lease: abandoning the iterator releases every KV block
+        (close/GC, or ``lease_timeout_s`` expiry reclaimed by the next
+        serve call). ``audit_every`` sets the invariant-auditor cadence
+        (0 disables); ``fault_injector`` (a
+        :class:`~deepspeed_tpu.inference.faults.FaultInjector`) drives
+        deterministic chaos runs. Knob defaults come from the ``serve``
+        config section.
         """
         from deepspeed_tpu.inference.kv_pool import (
             BlockPool, PrefixCachingBlockPool, blocks_for,
         )
         from deepspeed_tpu.inference.scheduler import (
-            ContinuousBatchingScheduler, Request,
+            REJECTED, Completion, ContinuousBatchingScheduler, Request,
         )
 
         if speculative is not None:
@@ -1131,15 +1211,51 @@ class InferenceEngine:
         assert cfg is not None, \
             "serve() requires a model config (LlamaConfig/TransformerConfig)"
         attn_kernel = self._resolve_attn_kernel(attn_kernel)
-        reqs = []
+        serve_cfg = getattr(self._config, "serve")
+
+        def rejected_completion(rid, prompt, reason):
+            t = time.time()
+            try:
+                prompt = np.asarray(prompt, np.int32).reshape(-1)
+            except (TypeError, ValueError) as bad:
+                # un-arrayable prompt: the rejection must still resolve
+                # (its shape is part of WHY it was rejected)
+                reason = f"{reason}; prompt not int-array-like: {bad}"
+                prompt = np.zeros(0, np.int32)
+            return Completion(
+                rid=rid, prompt=prompt,
+                tokens=np.zeros(0, np.int32), t_submit=t, t_admitted=t,
+                t_first_token=t, t_finish=t, status=REJECTED,
+                error=str(reason))
+
+        # PRE-ADMISSION VALIDATION: a malformed request in a batch must
+        # not kill its co-submitted neighbors — it resolves to a
+        # REJECTED result on its own stream slot instead of raising out
+        # of serve() (the single-request generate() keeps its raise
+        # behavior: there is nobody else in that batch to protect)
+        rejected, reqs = [], []
         for i, r in enumerate(requests):
             if isinstance(r, dict):
-                r = Request(**dict({"rid": i}, **r))
+                rid = r.get("rid", i)
+                try:
+                    r = Request(**dict({"rid": i}, **r))
+                except (TypeError, ValueError) as e:
+                    rejected.append(rejected_completion(
+                        rid, r.get("prompt", []), e))
+                    continue
+            try:
+                # model-capability validation (e.g. a learned position
+                # table shorter than prompt + budget) is per-request too
+                check_decode_length(cfg, len(r.prompt) + r.max_new_tokens)
+            except ValueError as e:
+                rejected.append(rejected_completion(r.rid, r.prompt, e))
+                continue
             reqs.append(r)
         if not reqs:
+            # nothing admissible: emit the rejections without minting an
+            # executor (each executor pins a full KV pool in HBM)
+            yield from rejected
             return
-        for r in reqs:
-            check_decode_length(cfg, len(r.prompt) + r.max_new_tokens)
         if max_context is None:
             max_context = max(len(r.prompt) + r.max_new_tokens
                               for r in reqs)
@@ -1156,15 +1272,24 @@ class InferenceEngine:
         executor = self._get_serve_executor(num_slots, block_size,
                                             num_blocks, decode_chunk,
                                             attn_kernel)
-        pc = (getattr(self._config, "serve").prefix_cache
+        # LEASE RECLAMATION: a previous stream on this executor that was
+        # closed (or whose lease expired without progress — an iterator
+        # object lingering un-pulled) releases everything it still
+        # holds, so its pool is quiescent and reusable below instead of
+        # stranding blocks until a shape change
+        stale = executor._lease
+        if stale is not None and (stale.closed or stale.expired()):
+            stale.reclaim(error="stream lease expired")
+            executor._lease = None
+        pc = (serve_cfg.prefix_cache
               if prefix_cache is None else bool(prefix_cache))
         if pc:
             # reuse the executor's host pool when quiescent: the content
             # index then spans serve() calls — a second trace sharing the
             # first one's prefixes starts warm (device KV persisted with
-            # the executor's pools all along). A non-quiescent pool (an
-            # abandoned stream still holds blocks) or a shape change
-            # starts cold instead of guessing.
+            # the executor's pools all along). A non-quiescent pool (a
+            # still-LIVE concurrent stream holds blocks) or a shape
+            # change starts cold instead of guessing.
             pool = executor._host_pool
             if (pool is None or pool.num_allocated
                     or pool.num_blocks != num_blocks
@@ -1180,20 +1305,68 @@ class InferenceEngine:
         scheduler = ContinuousBatchingScheduler(
             executor, num_slots, pool, width,
             reserve_upfront=reserve_upfront,
-            record_occupancy=record_occupancy, prefix_cache=pc)
+            record_occupancy=record_occupancy, prefix_cache=pc,
+            max_preemptions=(serve_cfg.max_preemptions
+                             if max_preemptions is None
+                             else int(max_preemptions)),
+            queue_timeout_s=(serve_cfg.queue_timeout_s
+                             if queue_timeout_s is None
+                             else queue_timeout_s),
+            audit_every=(serve_cfg.audit_every if audit_every is None
+                         else int(audit_every)),
+            fault_injector=fault_injector)
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log
         self.last_serve_scheduler = scheduler
         for r in reqs:
-            scheduler.submit(r, now=r.arrival_time)
-        yield from scheduler.run_iter()
+            try:
+                scheduler.submit(r, now=r.arrival_time)
+            except ValueError as e:
+                # oversized for this serve config (slot width / whole
+                # pool): per-request REJECTED, neighbors unaffected
+                rejected.append(rejected_completion(r.rid, r.prompt, e))
+        yield from rejected
+        lease = ServeLease(
+            scheduler, (serve_cfg.lease_timeout_s
+                        if lease_timeout_s is None else lease_timeout_s))
+        executor._lease = lease
+        try:
+            for comp in scheduler.run_iter():
+                lease.touch()
+                yield comp
+            # if a LATER serve() call reclaimed this stream's expired
+            # lease while the consumer was paused between pulls, the
+            # in-flight/queued requests resolved CANCELLED over there —
+            # surface those terminals here so every request still
+            # resolves on the stream that was serving it
+            for comp in lease.reclaimed:
+                yield comp
+        finally:
+            # runs on normal drain, explicit close(), AND garbage
+            # collection of an abandoned iterator: every block the
+            # stream still held returns to the pool (the engine.py leak
+            # this lease mechanism exists to close)
+            lease.reclaim(error="stream closed before completion")
+            if executor._lease is lease:
+                executor._lease = None
 
     def serve(self, requests, **kwargs):
         """Drain :meth:`generate_stream`; returns completions in finish
         order (reference serving story: DeepSpeed-Inference
         arXiv:2207.00032 throughput-at-scale serving)."""
         return list(self.generate_stream(requests, **kwargs))
+
+    def cancel_request(self, rid) -> bool:
+        """Cooperatively cancel an in-flight/queued serve request: it
+        resolves on its stream as a ``CANCELLED`` completion at the
+        next decode-chunk boundary, its blocks release (shared
+        prefix-cache blocks only deref), and co-scheduled requests are
+        untouched. Returns False when no live serve session knows the
+        rid. Safe to call from a consumer loop between ``next()`` pulls
+        (the scheduler is only ever stepped by the stream's thread)."""
+        sched = getattr(self, "last_serve_scheduler", None)
+        return bool(sched is not None and sched.cancel(rid))
 
     def _get_serve_executor(self, num_slots, block_size, num_blocks,
                             decode_chunk, attn_kernel="reference"):

@@ -1,0 +1,221 @@
+"""Deterministic fault injection for the serving stack.
+
+The fault-tolerance contract of the continuous-batching scheduler —
+per-request isolation, full block release on every exit path, bounded
+preemption, deadline/cancellation semantics — is only worth having if it
+can be PROVEN under the failures it claims to survive. This module is
+the proof harness: a seeded :class:`FaultInjector` whose hook points sit
+at the scheduler's host-side call boundaries (pool allocation, the
+prefill call, the decode call, chunk pacing, cancellation), so a fault
+plan replays bit-identically run over run and the chaos suite
+(tests/unit/inference/test_chaos.py) can assert that unaffected
+co-scheduled requests produce byte-identical streams while the pool
+returns to fully-free.
+
+Hooks fire at HOST boundaries only: an "executor exception mid-decode"
+is raised before the jitted decode call of that step, so donated device
+buffers are never left half-consumed — the same boundary at which a real
+executor error would surface to the scheduler's try/except. Pool
+exhaustion is modeled by freezing the scheduler's view of the free list
+for a step window (allocation-side starvation, exactly what a co-tenant
+burst does), which drives the stall → total-stall → bounded-preemption
+ladder.
+
+Nothing here imports jax: the injector is pure host logic, usable with
+the unit tests' fake executors and with the real engine alike
+(``engine.generate_stream(..., fault_injector=...)`` /
+``bench.py --serve --chaos``).
+"""
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+
+class RequestFault(RuntimeError):
+    """An executor error attributable to ONE request (slot).
+
+    The scheduler fails only that request and keeps serving the rest.
+    Executors (or the injector standing in for one) raise it when the
+    failure has a per-slot cause — a poisoned sampling parameter, a
+    per-request numerical blowup; an UNattributable executor exception
+    (plain ``Exception``) fails every runnable slot instead, because
+    the scheduler cannot know which request's state is corrupt.
+    """
+
+    def __init__(self, message: str, slot: Optional[int] = None,
+                 rid: Any = None):
+        super().__init__(message)
+        self.slot = slot
+        self.rid = rid
+
+
+#: injector hook sites (scheduler call boundaries)
+SITES = ("pool", "prefill", "decode", "cancel", "slow")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One planned fault.
+
+    site:
+      - ``pool``     free list reads as empty for scheduler steps
+                     ``[step, step + duration)`` (stall/preempt ladder)
+      - ``prefill``  raise just before the matching request's prefill
+                     (match by ``rid``; ``step`` optional extra gate)
+      - ``decode``   raise just before the decode call of ``step``;
+                     ``slot`` set → :class:`RequestFault` (isolated),
+                     unset → plain RuntimeError (fails all runnable)
+      - ``cancel``   cancel ``rids`` at the top of ``step`` (the burst)
+      - ``slow``     sleep ``seconds`` before the decode of ``step``
+                     (a slow chunk — exercises deadline expiry without
+                     wall-clock-dependent tests)
+    ``times`` bounds how often a prefill/decode spec fires (pool windows
+    are range-gated, not counted).
+    """
+
+    site: str
+    step: Optional[int] = None
+    rid: Any = None
+    rids: Sequence[Any] = ()
+    slot: Optional[int] = None
+    duration: int = 1
+    seconds: float = 0.0
+    times: int = 1
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"expected one of {SITES}")
+
+
+class FaultInjector:
+    """Seeded, replayable fault plan over the scheduler's hook points.
+
+    ``plan`` is a sequence of :class:`FaultSpec` (or dicts of its
+    fields). ``seed`` namespaces the injector's rng — specs themselves
+    are deterministic; the rng exists for plan GENERATORS (e.g.
+    :meth:`random_plan`) so a whole randomized scenario is reproducible
+    from one integer. Every firing is appended to :attr:`log` as
+    ``(step, site, detail)`` — the chaos bench's degradation record.
+    """
+
+    def __init__(self, plan: Sequence = (), seed: int = 0):
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(self.seed)
+        self.plan: List[FaultSpec] = [
+            f if isinstance(f, FaultSpec) else FaultSpec(**f)
+            for f in plan]
+        self._remaining = [max(0, int(f.times)) for f in self.plan]
+        self.log: List[dict] = []
+
+    # --- plan generation ----------------------------------------------------
+    @classmethod
+    def random_plan(cls, seed: int, rids: Sequence[Any],
+                    horizon: int = 64) -> "FaultInjector":
+        """A reproducible mixed-fault scenario over ``rids``: one pool
+        freeze, one attributed decode fault, one prefill fault, one
+        cancel burst — sites/steps/victims drawn from ``seed``. Used by
+        ``bench.py --serve --chaos`` so each chaos run is one integer."""
+        rng = np.random.default_rng(seed)
+        rids = list(rids)
+        steps = sorted(rng.choice(np.arange(2, max(3, horizon)),
+                                  size=4, replace=False).tolist())
+        victims = [rids[i] for i in
+                   rng.choice(len(rids), size=min(3, len(rids)),
+                              replace=False)]
+        plan = [
+            FaultSpec(site="pool", step=steps[0],
+                      duration=int(rng.integers(2, 6))),
+            FaultSpec(site="prefill", rid=victims[0],
+                      message="injected prefill fault"),
+            FaultSpec(site="decode", step=steps[2],
+                      slot=int(rng.integers(0, 2)),
+                      message="injected decode fault"),
+            FaultSpec(site="cancel", step=steps[3],
+                      rids=victims[1:]),
+        ]
+        return cls(plan, seed=seed)
+
+    # --- firing -------------------------------------------------------------
+    def _record(self, step: int, site: str, **detail):
+        self.log.append(dict({"step": int(step), "site": site}, **detail))
+
+    def pool_exhausted(self, step: int) -> bool:
+        """True while a ``pool`` window covers ``step`` — the scheduler
+        must treat the free list as empty (stall, never crash)."""
+        for f in self.plan:
+            if f.site == "pool" and f.step is not None \
+                    and f.step <= step < f.step + max(1, f.duration):
+                if not any(e["site"] == "pool" and e["step"] == step
+                           for e in self.log):
+                    self._record(step, "pool", until=f.step + f.duration)
+                return True
+        return False
+
+    def before_prefill(self, step: int, slot: int, rid: Any) -> None:
+        """Raise the planned prefill fault for ``rid`` (attributed: the
+        scheduler fails exactly this request)."""
+        for i, f in enumerate(self.plan):
+            if f.site != "prefill" or self._remaining[i] <= 0:
+                continue
+            if f.rid is not None and f.rid != rid:
+                continue
+            if f.step is not None and f.step != step:
+                continue
+            self._remaining[i] -= 1
+            self._record(step, "prefill", rid=rid, slot=slot)
+            raise RequestFault(f.message, slot=slot, rid=rid)
+
+    def before_decode(self, step: int) -> None:
+        """Raise the planned decode fault for ``step``: slot-attributed
+        (:class:`RequestFault`) or a blanket RuntimeError."""
+        for i, f in enumerate(self.plan):
+            if f.site != "decode" or self._remaining[i] <= 0:
+                continue
+            if f.step is not None and f.step != step:
+                continue
+            self._remaining[i] -= 1
+            self._record(step, "decode", slot=f.slot)
+            if f.slot is not None:
+                raise RequestFault(f.message, slot=f.slot)
+            raise RuntimeError(f.message)
+
+    def cancels(self, step: int) -> List[Any]:
+        """rids to cancel at the top of ``step`` (the cancel burst)."""
+        out: List[Any] = []
+        for i, f in enumerate(self.plan):
+            if f.site != "cancel" or self._remaining[i] <= 0:
+                continue
+            if f.step is not None and f.step != step:
+                continue
+            self._remaining[i] -= 1
+            burst = list(f.rids) if len(f.rids) else \
+                ([f.rid] if f.rid is not None else [])
+            if burst:
+                self._record(step, "cancel", rids=list(burst))
+                out.extend(burst)
+        return out
+
+    def chunk_delay(self, step: int) -> float:
+        """Seconds to stall before the decode of ``step`` (slow chunk)."""
+        total = 0.0
+        for i, f in enumerate(self.plan):
+            if f.site != "slow" or self._remaining[i] <= 0:
+                continue
+            if f.step is not None and f.step != step:
+                continue
+            self._remaining[i] -= 1
+            self._record(step, "slow", seconds=f.seconds)
+            total += float(f.seconds)
+        return total
+
+    def summary(self) -> dict:
+        """Firing log rollup for the chaos bench artifact."""
+        by_site: dict = {}
+        for e in self.log:
+            by_site[e["site"]] = by_site.get(e["site"], 0) + 1
+        return {"seed": self.seed, "fired": len(self.log),
+                "by_site": by_site, "log": list(self.log)}

@@ -33,6 +33,22 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+
+class PoolAuditError(RuntimeError):
+    """Invariant-auditor failure: accounting corruption detected.
+
+    Carries the full violation report — every broken invariant found in
+    one sweep, not just the first — so the failure is diagnosable from
+    the exception alone (the auditor exists to fail FAST, close to the
+    corrupting write, instead of letting a bad refcount surface three
+    requests later as silently cross-contaminated KV)."""
+
+    def __init__(self, violations: Sequence[str], context: str = ""):
+        self.violations = list(violations)
+        head = f"pool audit failed ({len(self.violations)} violation(s)"
+        head += f"; {context})" if context else ")"
+        super().__init__("\n  - ".join([head] + self.violations))
+
 # ONE rounding rule for host allocation and device sizing — a fork here
 # would silently desynchronize the scheduler's accounting from the pool
 # shapes the programs index
@@ -123,6 +139,33 @@ class BlockPool:
         overrides this with refcount decrements so shared/cached blocks
         survive the releasing slot."""
         self.free(ids)
+
+    def audit(self) -> List[str]:
+        """Cheap host-side invariant sweep; returns violations (empty =
+        clean). O(num_blocks) sets/sums — safe to run every serving
+        chunk. The scheduler's auditor layers table cross-checks on top
+        (:meth:`SlotBlockTables.audit`)."""
+        v: List[str] = []
+        free = self._free
+        free_set = set(free)
+        if len(free_set) != len(free):
+            v.append(f"free list holds duplicates "
+                     f"({len(free) - len(free_set)})")
+        if 0 in free_set or 0 in self._allocated:
+            v.append("null block 0 on the free list or allocated")
+        bad = [b for b in free_set | self._allocated
+               if not (0 < b < self.num_blocks)]
+        if bad:
+            v.append(f"out-of-range block ids {sorted(bad)[:8]}")
+        overlap = free_set & self._allocated
+        if overlap:
+            v.append(f"blocks both free and allocated "
+                     f"{sorted(overlap)[:8]}")
+        if len(free_set) + len(self._allocated) != self.num_blocks - 1:
+            v.append(
+                f"accounting leak: free {len(free_set)} + allocated "
+                f"{len(self._allocated)} != usable {self.num_blocks - 1}")
+        return v
 
 
 class PrefixCachingBlockPool(BlockPool):
@@ -297,6 +340,64 @@ class PrefixCachingBlockPool(BlockPool):
             out.append(bid)
         return out
 
+    def audit(self) -> List[str]:
+        """Prefix-caching invariant sweep: the three block states (FREE /
+        HELD / CACHED) must partition the usable pool, refcounts must
+        agree with the held set, and the content index must be a
+        bijection whose entries are all live frames."""
+        v: List[str] = []
+        free_set = set(self._free)
+        lru_set = set(self._lru)
+        held = {b for b, r in self._refs.items() if r > 0}
+        if len(free_set) != len(self._free):
+            v.append(f"free list holds duplicates "
+                     f"({len(self._free) - len(free_set)})")
+        if 0 in free_set | lru_set | held:
+            v.append("null block 0 in free/cached/held state")
+        bad = [b for b in free_set | lru_set | held
+               if not (0 < b < self.num_blocks)]
+        if bad:
+            v.append(f"out-of-range block ids {sorted(bad)[:8]}")
+        neg = {b: r for b, r in self._refs.items() if r < 0}
+        if neg:
+            v.append(f"negative refcounts {neg}")
+        for name, other in (("cached", lru_set), ("held", held)):
+            overlap = free_set & other
+            if overlap:
+                v.append(f"blocks both free and {name} "
+                         f"{sorted(overlap)[:8]}")
+        overlap = lru_set & held
+        if overlap:
+            v.append(f"blocks both cached (ref 0) and held "
+                     f"{sorted(overlap)[:8]}")
+        if held != self._allocated:
+            v.append(f"allocated set disagrees with refcounts: "
+                     f"allocated-only "
+                     f"{sorted(self._allocated - held)[:8]}, held-only "
+                     f"{sorted(held - self._allocated)[:8]}")
+        if len(free_set) + len(lru_set) + len(held) != self.num_blocks - 1:
+            v.append(
+                f"accounting leak: free {len(free_set)} + cached "
+                f"{len(lru_set)} + held {len(held)} != usable "
+                f"{self.num_blocks - 1}")
+        # content index <-> reverse map bijection, entries live
+        for key, bid in self._index.items():
+            if self._block_key.get(bid) != key:
+                v.append(f"index entry block {bid} not mirrored in "
+                         f"reverse map")
+        for bid, key in self._block_key.items():
+            if self._index.get(key) != bid:
+                v.append(f"reverse-map block {bid} not mirrored in index")
+            if bid in free_set:
+                v.append(f"indexed block {bid} sits on the free list")
+        for bid in lru_set:
+            if bid not in self._block_key:
+                v.append(f"LRU block {bid} has no content key")
+            if self._refs.get(bid, 0) != 0:
+                v.append(f"LRU block {bid} has refcount "
+                         f"{self._refs.get(bid)}")
+        return v
+
 
 class SlotBlockTables:
     """Per-slot block tables: int32 [num_slots, width], unused entries 0.
@@ -431,3 +532,58 @@ class SlotBlockTables:
         on-demand analogue of :meth:`capacity_tokens`, which is the
         table-width bound)."""
         return len(self._slot_blocks[slot]) * self.pool.block_size
+
+    def audit(self) -> List[str]:
+        """Pool sweep + table cross-checks: every table row mirrors its
+        slot's block list, every held block is reachable from exactly
+        as many tables as its refcount says (prefix-caching pool) or
+        exactly one (plain pool), and no free/cached frame is still
+        wired into a table. This is the serving auditor's core — it
+        catches the leak/double-free/aliasing class at the step
+        boundary where it happened."""
+        v = self.pool.audit()
+        refcounted = isinstance(self.pool, PrefixCachingBlockPool)
+        table_refs: Dict[int, int] = {}
+        for slot, ids in enumerate(self._slot_blocks):
+            n = len(ids)
+            if n > self.width:
+                v.append(f"slot {slot} holds {n} blocks > width "
+                         f"{self.width}")
+                n = self.width
+            row = self.table[slot]
+            if list(row[:n]) != list(ids[:n]):
+                v.append(f"slot {slot} table row diverges from its "
+                         f"block list: {row[:n].tolist()} vs {ids[:n]}")
+            if n < self.width and row[n:].any():
+                v.append(f"slot {slot} table has stale entries past its "
+                         f"{n} blocks: {row[n:].tolist()}")
+            if len(set(ids)) != len(ids):
+                v.append(f"slot {slot} references a block twice: {ids}")
+            for b in ids:
+                if b == 0:
+                    v.append(f"slot {slot} references the null block")
+                else:
+                    table_refs[b] = table_refs.get(b, 0) + 1
+        if refcounted:
+            for b, n in table_refs.items():
+                r = self.pool.refcount(b)
+                if r != n:
+                    v.append(f"block {b}: refcount {r} but referenced "
+                             f"by {n} table(s)")
+            stranded = self.pool._allocated - set(table_refs)
+            if stranded:
+                v.append(f"held blocks in no table (leaked refs) "
+                         f"{sorted(stranded)[:8]}")
+        else:
+            multi = {b: n for b, n in table_refs.items() if n > 1}
+            if multi:
+                v.append(f"plain-pool blocks shared across slots "
+                         f"{multi}")
+            if set(table_refs) != self.pool._allocated:
+                v.append(
+                    f"table blocks disagree with the allocated set: "
+                    f"tables-only "
+                    f"{sorted(set(table_refs) - self.pool._allocated)[:8]}"
+                    f", allocated-only "
+                    f"{sorted(self.pool._allocated - set(table_refs))[:8]}")
+        return v

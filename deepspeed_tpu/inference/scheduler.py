@@ -32,6 +32,25 @@ A/B comparison. Note per-slot rng streams advance with decode program
 steps, so a stall can shift WHERE a sampled stream lands relative to an
 unstalled run; (prompt, seed) determinism at fixed pool pressure holds.
 
+FAULT TOLERANCE (docs/SERVING.md): every submitted request resolves to
+exactly ONE terminal :class:`Completion` whose ``status`` is one of
+:data:`TERMINAL_STATUSES` — executor errors are isolated to the request
+they belong to (a slot-attributed
+:class:`~deepspeed_tpu.inference.faults.RequestFault` fails one request,
+an unattributed exception fails the runnable set, and either way the
+queue keeps draining instead of the whole ``serve()`` call raising),
+``cancel(rid)`` / per-request deadlines / queue-wait timeouts are
+enforced cooperatively at chunk boundaries, total-stall preemption is
+bounded (``max_preemptions``) with preempt-age-aware victim rotation so
+no request can starve or livelock, and EVERY exit path releases the
+slot's blocks (deref-only for shared prefix-cache blocks). A cheap
+host-side invariant auditor (:meth:`ContinuousBatchingScheduler.audit`)
+cross-checks refcounts/tables/free lists/prefix index every
+``audit_every`` chunks and fails fast with the full violation report.
+The deterministic seeded :class:`~deepspeed_tpu.inference.faults.
+FaultInjector` drives the chaos suite
+(tests/unit/inference/test_chaos.py) and ``bench.py --serve --chaos``.
+
 The scheduler is pure host logic over an EXECUTOR protocol, so its
 admission/recycling/backpressure/growth behavior is unit-tested with a
 fake executor (tests/unit/inference/test_scheduler.py); the real
@@ -71,20 +90,42 @@ Executor protocol (duck-typed)::
 import dataclasses
 import time
 from collections import deque
-from typing import Any, Deque, Iterable, List, Optional
+from typing import Any, Deque, Dict, Iterable, List, Optional, Set
 
 import numpy as np
 
+from deepspeed_tpu.inference.faults import FaultInjector, RequestFault
 from deepspeed_tpu.inference.kv_pool import (
-    BlockPool, PrefixCachingBlockPool, SlotBlockTables,
+    BlockPool, PoolAuditError, PrefixCachingBlockPool, SlotBlockTables,
     block_content_keys, blocks_for,
 )
+
+# --- terminal request statuses ----------------------------------------------
+#: the request ran its full course (eos or budget)
+COMPLETED = "COMPLETED"
+#: an executor error attributed to this request (others keep serving)
+FAILED = "FAILED"
+#: pre-admission validation refused the request (never held blocks)
+REJECTED = "REJECTED"
+#: client cancel() landed (cooperative, at a chunk boundary)
+CANCELLED = "CANCELLED"
+#: deadline_s / queue_timeout_s expired before completion
+TIMED_OUT = "TIMED_OUT"
+#: restart-from-prompt retries exhausted max_preemptions (no livelock)
+PREEMPTED_LIMIT = "PREEMPTED_LIMIT"
+
+TERMINAL_STATUSES = (COMPLETED, FAILED, REJECTED, CANCELLED, TIMED_OUT,
+                     PREEMPTED_LIMIT)
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request. ``arrival_time`` (absolute ``time.time()``
-    seconds) gates admission for trace replay; None = eligible now."""
+    seconds) gates admission for trace replay; None = eligible now.
+    ``deadline_s`` is a wall-clock budget from submit (queued OR
+    decoding — a request past it resolves ``TIMED_OUT`` at the next
+    chunk boundary, partial tokens attached); ``queue_timeout_s`` bounds
+    queue wait only (overrides the scheduler-level default)."""
 
     rid: Any
     prompt: np.ndarray                 # int32 [T], T >= 1
@@ -95,6 +136,8 @@ class Request:
     eos_id: int = -1                   # < 0 disables EOS stopping
     seed: int = 0
     arrival_time: Optional[float] = None
+    deadline_s: Optional[float] = None
+    queue_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -107,7 +150,13 @@ class Request:
 
 @dataclasses.dataclass
 class Completion:
-    """A finished request: generated tokens + latency breakdown."""
+    """A finished request: tokens + latency breakdown + terminal status.
+
+    Every submitted request resolves to exactly one Completion — the
+    fault-tolerance contract. ``status`` is one of
+    :data:`TERMINAL_STATUSES`; non-``COMPLETED`` terminals carry the
+    reason in ``error`` and whatever tokens were generated before the
+    exit (``REJECTED``/queue ``TIMED_OUT``: none)."""
 
     rid: Any
     prompt: np.ndarray
@@ -116,6 +165,12 @@ class Completion:
     t_admitted: float
     t_first_token: float
     t_finish: float
+    status: str = COMPLETED
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == COMPLETED
 
     @property
     def latency(self) -> float:
@@ -155,7 +210,11 @@ class ContinuousBatchingScheduler:
     def __init__(self, executor, num_slots: int, pool: BlockPool,
                  table_width: int, reserve_upfront: bool = False,
                  record_occupancy: bool = False,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 max_preemptions: int = 8,
+                 queue_timeout_s: Optional[float] = None,
+                 audit_every: int = 64,
+                 fault_injector: Optional[FaultInjector] = None):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -192,6 +251,24 @@ class ContinuousBatchingScheduler:
         self._cap_steps = np.zeros(num_slots, np.int64)
         self.reserve_upfront = bool(reserve_upfront)
         self.preemptions = 0
+        # --- fault tolerance ------------------------------------------------
+        # bounded preemption: a request restart-from-prompt-ed more than
+        # this many times resolves PREEMPTED_LIMIT instead of livelocking
+        # (victim selection is preempt-count-aware, so the bound is only
+        # reached when the pool genuinely cannot make progress)
+        self.max_preemptions = int(max_preemptions)
+        # default queue-wait bound (None = wait forever); per-request
+        # Request.queue_timeout_s overrides
+        self.queue_timeout_s = queue_timeout_s
+        # invariant auditor cadence: cross-check refcounts/tables/free
+        # lists/prefix index every N steps (0 disables; chaos tests run
+        # with 1 — every chunk)
+        self.audit_every = int(audit_every)
+        self.last_audit_violations: List[str] = []
+        self.fault_injector = fault_injector
+        self._step_idx = 0
+        self._cancelled: Set[Any] = set()
+        self._preempt_counts: Dict[Any, int] = {}
         # per-step pool occupancy series for the bench artifact
         # (BENCH_SERVE.json) — None disables recording
         self.occupancy_log: Optional[List[dict]] = \
@@ -230,12 +307,130 @@ class ContinuousBatchingScheduler:
                  if r.arrival_time is not None]
         return min(times) if times else None
 
+    # --- cancellation / deadlines --------------------------------------------
+    def cancel(self, rid: Any) -> bool:
+        """Cooperatively cancel a queued or in-flight request: it
+        resolves ``CANCELLED`` at the next step boundary (its blocks
+        release; with prefix caching, shared blocks only DEREF — other
+        holders and the content index are untouched). Returns False for
+        an unknown/already-finished rid (no pending-cancel is stored, so
+        a recycled rid can never be killed by a stale cancel)."""
+        known = any(r.rid == rid for r in self.queue) or \
+            any(s.req is not None and s.req.rid == rid for s in self.slots)
+        if known:
+            self._cancelled.add(rid)
+        return known
+
+    def _terminal_queued(self, req: Request, status: str, error: str,
+                         now: float,
+                         t_admitted: Optional[float] = None) -> Completion:
+        """Resolve a request that never produced tokens (cancel/timeout
+        while queued, or a prefill that failed before its first token —
+        the caller releases any blocks in that case): the one structured
+        terminal result plus the forget-this-rid bookkeeping."""
+        t_sub = self._submit_times.pop(req.rid, now)
+        self._cancelled.discard(req.rid)
+        self._preempt_counts.pop(req.rid, None)
+        return Completion(
+            rid=req.rid, prompt=req.prompt,
+            tokens=np.zeros(0, np.int32), t_submit=t_sub,
+            t_admitted=now if t_admitted is None else t_admitted,
+            t_first_token=now, t_finish=now,
+            status=status, error=error)
+
+    def _terminal_slot(self, slot_id: int, status: str, error: str,
+                       now: float, register: bool = True) -> Completion:
+        """Resolve an in-flight slot to a non-COMPLETED terminal: build
+        the Completion (partial tokens attached), release every block
+        (deref-only for shared prefix-cache blocks), clear the slot.
+        ``register=False`` skips prefix registration — used when the
+        KV's integrity is in doubt (executor faults)."""
+        slot = self.slots[slot_id]
+        req = slot.req
+        if register:
+            self._register_slot_prefix(slot_id)
+        comp = Completion(
+            rid=req.rid, prompt=req.prompt,
+            tokens=np.asarray(slot.out, np.int32),
+            t_submit=self._submit_times.pop(req.rid, slot.t_admitted),
+            t_admitted=slot.t_admitted, t_first_token=slot.t_first,
+            t_finish=now, status=status, error=error)
+        self._cancelled.discard(req.rid)
+        self._preempt_counts.pop(req.rid, None)
+        self.tables.release(slot_id)
+        self._clear_slot(slot_id)
+        return comp
+
+    def _deadline_of(self, req: Request) -> Optional[float]:
+        if req.deadline_s is None:
+            return None
+        t_sub = self._submit_times.get(req.rid)
+        return None if t_sub is None else t_sub + req.deadline_s
+
+    def _reap(self, now: float) -> List[Completion]:
+        """Apply cancellations, deadlines and queue-wait timeouts at the
+        step boundary (the cooperative enforcement point: decode chunks
+        are never interrupted mid-program). Runs BEFORE admission so a
+        doomed queue head can never take a slot from a live request."""
+        done: List[Completion] = []
+        if self.queue:
+            keep: Deque[Request] = deque()
+            for req in self.queue:
+                if req.rid in self._cancelled:
+                    done.append(self._terminal_queued(
+                        req, CANCELLED, "cancelled while queued", now))
+                    continue
+                dl = self._deadline_of(req)
+                if dl is not None and now > dl:
+                    done.append(self._terminal_queued(
+                        req, TIMED_OUT,
+                        f"deadline_s={req.deadline_s} expired while "
+                        f"queued", now))
+                    continue
+                qt = req.queue_timeout_s if req.queue_timeout_s is not None \
+                    else self.queue_timeout_s
+                t_sub = self._submit_times.get(req.rid)
+                if qt is not None and t_sub is not None \
+                        and now - t_sub > qt:
+                    done.append(self._terminal_queued(
+                        req, TIMED_OUT,
+                        f"queue wait exceeded {qt}s", now))
+                    continue
+                keep.append(req)
+            self.queue = keep
+        for slot_id, slot in enumerate(self.slots):
+            if slot.req is None:
+                continue
+            if slot.req.rid in self._cancelled:
+                done.append(self._terminal_slot(
+                    slot_id, CANCELLED, "cancelled mid-stream", now))
+                continue
+            dl = self._deadline_of(slot.req)
+            if dl is not None and now > dl:
+                done.append(self._terminal_slot(
+                    slot_id, TIMED_OUT,
+                    f"deadline_s={slot.req.deadline_s} expired "
+                    f"mid-stream", now))
+        return done
+
     # --- admission -----------------------------------------------------------
+    def _free_blocks(self) -> int:
+        """The pool capacity this step may claim — the injector's pool
+        windows read as 0 (allocation-side starvation: the exhaustion
+        ladder is stall → total-stall → bounded preemption, never a
+        crash)."""
+        if self.fault_injector is not None \
+                and self.fault_injector.pool_exhausted(self._step_idx):
+            return 0
+        return self.pool.num_free
+
     def _admit(self, now: float) -> List[Completion]:
         done = []
         for slot_id, slot in enumerate(self.slots):
             if not self.queue or not slot.free:
                 continue
+            if self._free_blocks() == 0:
+                break                  # injected/real exhaustion: queue
             req = self.queue[0]
             if req.arrival_time is not None and req.arrival_time > now:
                 break                  # FIFO: later requests wait too
@@ -275,24 +470,41 @@ class ContinuousBatchingScheduler:
                 self.cache_prompt_tokens += len(req.prompt)
             else:
                 need = blocks_for(admit_tokens, self.pool.block_size)
-                if not self.pool.can_allocate(need):
+                if need > self._free_blocks():
                     break              # backpressure: queue, don't crash
                 self.tables.assign(slot_id, admit_tokens)
             self.queue.popleft()
-            self.executor.set_slot(slot_id, req)
-            if copy_pairs:
-                # device-side CoW duplication BEFORE the slot's first
-                # write (and before any allocation could evict the
-                # source) — executors serving a prefix-cache scheduler
-                # must implement copy_blocks
-                self.executor.copy_blocks(copy_pairs)
             t_admit = time.time()
-            first = int(
-                self.executor.prefill(slot_id, req.prompt,
-                                      self.tables.table[slot_id], start)
-                if start else
-                self.executor.prefill(slot_id, req.prompt,
-                                      self.tables.table[slot_id]))
+            try:
+                self.executor.set_slot(slot_id, req)
+                if copy_pairs:
+                    # device-side CoW duplication BEFORE the slot's first
+                    # write (and before any allocation could evict the
+                    # source) — executors serving a prefix-cache scheduler
+                    # must implement copy_blocks
+                    self.executor.copy_blocks(copy_pairs)
+                if self.fault_injector is not None:
+                    self.fault_injector.before_prefill(
+                        self._step_idx, slot_id, req.rid)
+                first = int(
+                    self.executor.prefill(slot_id, req.prompt,
+                                          self.tables.table[slot_id],
+                                          start)
+                    if start else
+                    self.executor.prefill(slot_id, req.prompt,
+                                          self.tables.table[slot_id]))
+            except Exception as e:
+                # PER-REQUEST ISOLATION (mid-prefill): this request
+                # resolves FAILED; its blocks release (shared prefix
+                # blocks only deref) and the slot is immediately
+                # admissible again — co-scheduled slots never see the
+                # fault. No prefix registration: the KV behind a failed
+                # prefill is not trustworthy content.
+                self.tables.release(slot_id)
+                done.append(self._terminal_queued(
+                    req, FAILED, f"executor prefill error: {e}",
+                    time.time(), t_admitted=t_admit))
+                continue
             t_first = time.time()
             slot.req = req
             slot.seq_len = len(req.prompt)
@@ -349,6 +561,8 @@ class ContinuousBatchingScheduler:
             t_submit=self._submit_times.pop(req.rid, slot.t_admitted),
             t_admitted=slot.t_admitted, t_first_token=slot.t_first,
             t_finish=t_finish)
+        self._cancelled.discard(req.rid)
+        self._preempt_counts.pop(req.rid, None)
         # index full blocks (now including generated content — a future
         # prompt that embeds this completion, e.g. a multi-turn
         # continuation, prefills only its new tokens) BEFORE releasing:
@@ -388,7 +602,7 @@ class ContinuousBatchingScheduler:
                 want = min(horizon, slot.remaining)
                 need = blocks_for(slot.seq_len + want, bs) - cur
                 if need > 0:
-                    take = min(need, self.pool.num_free,
+                    take = min(need, self._free_blocks(),
                                self.tables.width - cur)
                     if take > 0:
                         self.tables.grow(slot_id, take)
@@ -397,18 +611,39 @@ class ContinuousBatchingScheduler:
             self._cap_steps[slot_id] = cap
             self.stalled[slot_id] = cap <= 0
 
-    def _preempt_youngest(self) -> None:
+    def _preempt_for_progress(self, now: float) -> Optional[Completion]:
         """Total-stall safety valve: every active slot needs a block and
         the pool has none (possible only with >= 2 slots — submit()
         rejects requests larger than the whole pool, so a lone slot
-        always fits). Evict the most recently admitted slot: its blocks
-        recycle NOW (letting older slots resume) and its request
-        requeues at the FIFO head for a fresh admission — generation
-        restarts from the prompt (greedy output identical; sampled
-        streams restart from their seed)."""
+        always fits). Evict one slot: its blocks recycle NOW (letting
+        the others resume) and its request requeues at the FIFO head
+        for a fresh admission — generation restarts from the prompt
+        (greedy output identical; sampled streams restart from their
+        seed).
+
+        Victim selection is PREEMPT-AGE-AWARE: among active slots, pick
+        the one whose request has been preempted FEWEST times (ties:
+        most recently admitted — the classic youngest-first). A request
+        that keeps losing the youngest race therefore stops being the
+        victim after its first eviction, so repeated total stalls rotate
+        victims instead of starving one request forever. The rotation is
+        BOUNDED: a request past ``max_preemptions`` restarts resolves to
+        a deterministic ``PREEMPTED_LIMIT`` terminal (partial tokens of
+        the current attempt attached) instead of livelocking — returned
+        here, None when the victim was requeued normally."""
         victim = max((s for s in range(self.num_slots) if self.active[s]),
-                     key=lambda s: (self.slots[s].t_admitted, s))
+                     key=lambda s: (
+                         -self._preempt_counts.get(self.slots[s].req.rid, 0),
+                         self.slots[s].t_admitted, s))
         req = self.slots[victim].req
+        self.preemptions += 1
+        count = self._preempt_counts.get(req.rid, 0) + 1
+        self._preempt_counts[req.rid] = count
+        if count > self.max_preemptions:
+            return self._terminal_slot(
+                victim, PREEMPTED_LIMIT,
+                f"preempted {count} times "
+                f"(max_preemptions={self.max_preemptions})", now)
         # register before releasing: the victim's prompt blocks park on
         # the cache LRU instead of freeing, so its restart-from-prompt
         # readmission hits its OWN prefix and re-prefills only the
@@ -418,7 +653,7 @@ class ContinuousBatchingScheduler:
         self.tables.release(victim)
         self._clear_slot(victim)
         self.queue.appendleft(req)     # keeps original submit time
-        self.preemptions += 1
+        return None
 
     def _record_occupancy(self, now: float) -> None:
         if self.occupancy_log is None:
@@ -443,33 +678,43 @@ class ContinuousBatchingScheduler:
 
     # --- one scheduling iteration --------------------------------------------
     def step(self, now: Optional[float] = None) -> List[Completion]:
-        """Grow in-flight tables, admit what fits, run one decode call,
-        retire finished slots. Returns completions finished this step
-        (possibly empty)."""
+        """Reap cancels/deadlines, grow in-flight tables, admit what
+        fits, run one decode call, retire finished slots. Returns
+        completions resolved this step — COMPLETED and non-COMPLETED
+        terminals alike (possibly empty)."""
         now = time.time() if now is None else now
+        self._step_idx += 1
+        fi = self.fault_injector
+        if fi is not None:
+            for rid in fi.cancels(self._step_idx):
+                self.cancel(rid)
+        # cancellation/deadline enforcement point: chunk boundaries only
+        done = self._reap(now)
         chunk = max(1, int(getattr(self.executor, "decode_chunk", 1)))
         # growth FIRST: in-flight slots outrank the queue head for free
         # blocks — admitting ahead of mid-decode grows would convert
         # pool pressure into stalls of already-running requests
         pre = [s for s in range(self.num_slots) if self.active[s]]
         self._grow(pre, chunk)
-        done = self._admit(now)
+        done.extend(self._admit(now))
         pre_set = set(pre)
         self._grow([s for s in range(self.num_slots)
                     if self.active[s] and s not in pre_set], chunk)
         if not self.active.any():
-            self._record_occupancy(now)
+            self._finish_step(now)
             return done
         runnable = np.logical_and(self.active, ~self.stalled)
         if not runnable.any():
-            # every active slot is stalled on an empty pool: preempt the
-            # youngest so the older slots resume THIS step
-            self._preempt_youngest()
+            # every active slot is stalled on an empty pool: preempt one
+            # (age-aware, bounded) so the others resume THIS step
+            term = self._preempt_for_progress(now)
+            if term is not None:
+                done.append(term)
             self._grow([s for s in range(self.num_slots)
                         if self.active[s]], chunk)
             runnable = np.logical_and(self.active, ~self.stalled)
             if not runnable.any():     # defensive: one preemption frees
-                self._record_occupancy(now)     # >= 1 block by invariant
+                self._finish_step(now)          # >= 1 block by invariant
                 return done
         # adaptive decode quantum: chunked executors amortize host round
         # trips over several steps, but while the QUEUE holds admissible
@@ -488,10 +733,26 @@ class ContinuousBatchingScheduler:
             max_steps = feasible
         eff_steps = self.steps_left.copy()
         eff_steps[self.stalled] = 0        # stalled slots must not write
-        toks = np.asarray(self.executor.decode(
-            self.last_tokens.copy(), self.tables.table,
-            self.seq_lens.copy(), runnable.copy(),
-            eff_steps, max_steps), np.int32)
+        try:
+            if fi is not None:
+                delay = fi.chunk_delay(self._step_idx)
+                if delay > 0:
+                    time.sleep(delay)
+                fi.before_decode(self._step_idx)
+            toks = np.asarray(self.executor.decode(
+                self.last_tokens.copy(), self.tables.table,
+                self.seq_lens.copy(), runnable.copy(),
+                eff_steps, max_steps), np.int32)
+        except Exception as e:
+            # PER-REQUEST ISOLATION (mid-decode): the call failed as a
+            # whole, so NO slot consumed tokens this step. A
+            # slot-attributed RequestFault fails exactly that request;
+            # an unattributed exception fails every runnable slot (the
+            # scheduler cannot know whose state is corrupt). Either way
+            # the queue keeps serving and serve() never raises.
+            done.extend(self._on_decode_error(e, runnable, now))
+            self._finish_step(now)
+            return done
         if toks.ndim == 1:
             toks = toks[:, None]
         t_now = time.time()
@@ -512,7 +773,79 @@ class ContinuousBatchingScheduler:
             self.steps_left[slot_id] = slot.remaining
             if slot.remaining <= 0:
                 done.append(self._finish(slot_id, t_now))
+        self._finish_step(now)
+        return done
+
+    def _finish_step(self, now: float) -> None:
+        """Common step epilogue: occupancy sample + auditor cadence."""
         self._record_occupancy(now)
+        if self.audit_every > 0 and self._step_idx % self.audit_every == 0:
+            self.audit(context=f"step {self._step_idx}")
+
+    def _on_decode_error(self, e: Exception, runnable: np.ndarray,
+                         now: float) -> List[Completion]:
+        slot = getattr(e, "slot", None)
+        if slot is not None and 0 <= int(slot) < self.num_slots \
+                and self.slots[int(slot)].req is not None:
+            targets = [int(slot)]
+        else:
+            targets = [s for s in range(self.num_slots) if runnable[s]]
+        return [self._terminal_slot(
+                    s, FAILED, f"executor decode error: {e}", now,
+                    register=False)
+                for s in targets]
+
+    # --- invariant auditor ----------------------------------------------------
+    def audit(self, context: str = "") -> None:
+        """Cross-check pool free lists, refcounts, block tables, the
+        prefix-cache index and the scheduler's own slot state; raise
+        :class:`~deepspeed_tpu.inference.kv_pool.PoolAuditError` with
+        the full violation report on ANY inconsistency. Cheap (O(pool)
+        host sets) — the serving default runs it every
+        ``audit_every`` chunks; chaos tests run it every chunk."""
+        v = self.tables.audit()
+        for s, slot in enumerate(self.slots):
+            if slot.req is None:
+                if self.tables.num_blocks_of(s):
+                    v.append(f"free slot {s} still holds blocks "
+                             f"{self.tables.blocks_of(s)}")
+                if self.active[s] or self.stalled[s]:
+                    v.append(f"free slot {s} marked active/stalled")
+            else:
+                cap = self.tables.slot_capacity_tokens(s)
+                if slot.seq_len > cap:
+                    v.append(f"slot {s} seq_len {slot.seq_len} exceeds "
+                             f"granted capacity {cap}")
+                if self.seq_lens[s] != slot.seq_len:
+                    v.append(f"slot {s} seq_len array "
+                             f"{int(self.seq_lens[s])} diverges from "
+                             f"slot state {slot.seq_len}")
+        self.last_audit_violations = v
+        if v:
+            raise PoolAuditError(v, context)
+
+    # --- stream reclamation ---------------------------------------------------
+    def shutdown(self, error: str = "stream closed") -> List[Completion]:
+        """Resolve EVERYTHING still in flight or queued to ``CANCELLED``
+        and release every block — the reclamation path behind the
+        engine's stream leases (an abandoned ``generate_stream`` must
+        return its pool to fully-free without waiting for an executor
+        invalidation). In-flight prefixes register first, so with a
+        caching pool the reclaimed KV parks on the LRU and the next
+        session starts warm. Idempotent; audits on exit when auditing
+        is enabled."""
+        done: List[Completion] = []
+        now = time.time()
+        for slot_id, slot in enumerate(self.slots):
+            if slot.req is not None:
+                done.append(self._terminal_slot(
+                    slot_id, CANCELLED, error, now))
+        while self.queue:
+            done.append(self._terminal_queued(
+                self.queue.popleft(), CANCELLED, error, now))
+        self._cancelled.clear()
+        if self.audit_every > 0:
+            self.audit(context="shutdown")
         return done
 
     def run_iter(self, poll_interval: float = 0.001):

@@ -5,8 +5,9 @@ Two backends behind one finding stream:
 - **AST pass** (:mod:`.astpass`): framework-specific rules over the
   package source — the ``utils/jax_compat`` seam, host syncs inside
   jitted code, recompile hazards, Pallas kernel hygiene, in-place
-  argument mutation, and buffer-donation checks on the serving entry
-  points. Pure ``ast``, no jax import, runs in milliseconds.
+  argument mutation, buffer-donation checks on the serving entry
+  points, and silently-swallowed exceptions in the serving fault
+  paths. Pure ``ast``, no jax import, runs in milliseconds.
 - **jaxpr pass** (:mod:`.jaxprpass`): abstractly traces the registered
   serving entry points (paged decode step, prefill bucket,
   ``copy_pool_blocks``) and fails on callback/transfer primitives in

@@ -1,6 +1,6 @@
 """dstlint AST rules — the framework's source-level invariants.
 
-Six rules (catalog with bad/good examples: ``docs/LINT.md``):
+Seven rules (catalog with bad/good examples: ``docs/LINT.md``):
 
 - ``jax-compat-seam``   moved/renamed JAX symbols must route through
   ``utils/jax_compat`` (the seam that revived the engines on jax
@@ -21,6 +21,11 @@ Six rules (catalog with bad/good examples: ``docs/LINT.md``):
 - ``donation-check``   jitted entry points in ``inference/engine.py`` /
   ``runtime/engine.py`` taking pool/cache-sized buffers must donate
   them (``donate_argnums``) or double peak HBM for the workspace.
+- ``no-silent-except``   bare/``Exception``-broad handlers in the
+  ``inference/`` serving hot paths must handle the exception EXPLICITLY
+  (bind it and use it — convert to a terminal status, log it — or
+  re-raise); a swallowed exception in the fault-tolerance layer turns
+  an isolatable failure into silent KV/bookkeeping corruption.
 
 Everything here is a best-effort, zero-false-positive-biased *static*
 approximation: function references are resolved lexically (a function
@@ -42,8 +47,10 @@ RECOMPILE = "recompile-hazard"
 PALLAS = "pallas-kernel-hygiene"
 ARG_MUT = "no-arg-mutation"
 DONATION = "donation-check"
+SILENT_EXCEPT = "no-silent-except"
 
-AST_RULES = (SEAM, HOST_SYNC, RECOMPILE, PALLAS, ARG_MUT, DONATION)
+AST_RULES = (SEAM, HOST_SYNC, RECOMPILE, PALLAS, ARG_MUT, DONATION,
+             SILENT_EXCEPT)
 
 # the one module allowed to touch the moved symbols directly
 SEAM_MODULE = "deepspeed_tpu/utils/jax_compat.py"
@@ -306,6 +313,8 @@ class ModuleAnalyzer:
         if self.relpath.startswith(("deepspeed_tpu/ops/",
                                     "deepspeed_tpu/inference/")):
             self._rule_arg_mutation()
+        if self.relpath.startswith("deepspeed_tpu/inference/"):
+            self._rule_silent_except()
         if self.relpath.endswith(DONATION_FILES):
             self._rule_donation()
         self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
@@ -463,6 +472,52 @@ class ModuleAnalyzer:
             walker = _ArgMutationWalker(self, params)
             for stmt in info.node.body:
                 walker.visit(stmt)
+
+    # no-silent-except --------------------------------------------------------
+    _BROAD_EXC = {"Exception", "BaseException", "builtins.Exception",
+                  "builtins.BaseException"}
+
+    def _is_broad_handler(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:                  # bare `except:`
+            return True
+        types = t.elts if isinstance(t, ast.Tuple) else [t]
+        for node in types:
+            d = self.dotted(node)
+            if d in self._BROAD_EXC:
+                return True
+        return False
+
+    def _rule_silent_except(self):
+        """Broad handlers (`except:`, `except Exception`) in the serving
+        hot paths must be EXPLICIT about the fault: either re-raise
+        somewhere in the handler, or bind the exception and actually use
+        it (converting to a terminal status / report). A handler that
+        does neither swallows executor/bookkeeping failures the
+        fault-tolerance layer exists to surface."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._is_broad_handler(handler):
+                    continue
+                reraises = any(isinstance(n, ast.Raise)
+                               for stmt in handler.body
+                               for n in ast.walk(stmt))
+                uses_exc = handler.name is not None and any(
+                    isinstance(n, ast.Name) and n.id == handler.name
+                    for stmt in handler.body
+                    for n in ast.walk(stmt))
+                if reraises or uses_exc:
+                    continue
+                what = "bare `except:`" if handler.type is None else \
+                    "`except Exception`"
+                self.emit(
+                    SILENT_EXCEPT, handler,
+                    f"{what} swallows the exception silently in a "
+                    f"serving hot path — bind it (`except Exception as "
+                    f"e:`) and convert it to an explicit outcome "
+                    f"(terminal status, report), or re-raise")
 
     # donation-check ----------------------------------------------------------
     def _rule_donation(self):

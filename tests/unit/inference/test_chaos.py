@@ -1,0 +1,453 @@
+"""Chaos suite: the serving stack's fault-tolerance contract under
+deterministic injected faults (inference/faults.FaultInjector).
+
+Every scenario pins the same four acceptance properties:
+
+1. the pool ends FULLY FREE (zero allocated blocks, zero outstanding
+   refcounts — cached prefix blocks at ref 0 count as free capacity);
+2. the invariant auditor is CLEAN (these runs audit every chunk);
+3. every submitted request resolved to exactly one terminal status;
+4. the token streams of UNAFFECTED co-scheduled requests are
+   byte-identical to a fault-free run of the same trace.
+
+Scenarios are seeded/planned — a failure reproduces from the test body
+alone. Host-level (fake executor) scenarios cover the scheduler ladder;
+the engine-level scenarios drive the real compiled serving path.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.faults import (
+    FaultInjector, FaultSpec, RequestFault,
+)
+from deepspeed_tpu.inference.kv_pool import (
+    BlockPool, PoolAuditError, PrefixCachingBlockPool,
+)
+from deepspeed_tpu.inference.scheduler import (
+    CANCELLED, COMPLETED, FAILED, PREEMPTED_LIMIT, TERMINAL_STATUSES,
+    TIMED_OUT, ContinuousBatchingScheduler, Request,
+)
+
+from tests.unit.inference.test_scheduler import FakeExecutor, drain, req
+from tests.unit.inference.test_prefix_cache import PrefixFakeExecutor
+
+pytestmark = pytest.mark.chaos
+
+
+def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
+               prefix=False, **kw):
+    """Scheduler under test: auditor at EVERY chunk (the chaos-mode
+    cadence), deterministic fake executor."""
+    ex = PrefixFakeExecutor() if prefix else FakeExecutor()
+    pool = (PrefixCachingBlockPool(num_blocks, block_size) if prefix
+            else BlockPool(num_blocks, block_size))
+    kw.setdefault("audit_every", 1)
+    sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
+                                        prefix_cache=prefix, **kw)
+    return sched, ex, pool
+
+
+def assert_quiescent(sched):
+    """Acceptance invariant: fully-free pool, zero outstanding
+    refcounts, auditor clean."""
+    pool = sched.pool
+    assert pool.num_allocated == 0, \
+        f"{pool.num_allocated} blocks still allocated"
+    assert pool.num_free == pool.num_blocks - 1
+    if isinstance(pool, PrefixCachingBlockPool):
+        bad = {b: r for b, r in pool._refs.items() if r != 0}
+        assert not bad, f"outstanding refcounts {bad}"
+    sched.audit(context="post-drain")          # raises on any violation
+
+
+def by_rid(comps):
+    out = {}
+    for c in comps:
+        assert c.rid not in out, f"rid {c.rid} resolved twice"
+        assert c.status in TERMINAL_STATUSES
+        out[c.rid] = c
+    return out
+
+
+def fault_free(reqs_fn, **sched_kw):
+    """Token streams of the trace with no faults injected."""
+    sched, _, _ = make_sched(**sched_kw)
+    for r in reqs_fn():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert_quiescent(sched)
+    return {rid: c.tokens for rid, c in comps.items()}
+
+
+# --- scenario 1: pool exhaustion window --------------------------------------
+
+def test_chaos_pool_exhaustion_window_stalls_then_recovers():
+    """A frozen free list mid-serve drives the stall ladder instead of
+    crashing; once the window lifts every request completes with the
+    exact fault-free stream."""
+    def reqs():
+        return [req(1, plen=4, gen=8), req(2, plen=4, gen=8),
+                req(3, plen=4, gen=6)]
+
+    ref = fault_free(reqs, num_blocks=17)
+    fi = FaultInjector([FaultSpec(site="pool", step=2, duration=4)])
+    sched, _, _ = make_sched(num_blocks=17, fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert fi.log and fi.log[0]["site"] == "pool"   # window actually hit
+    assert {c.status for c in comps.values()} == {COMPLETED}
+    for rid, c in comps.items():
+        np.testing.assert_array_equal(c.tokens, ref[rid])
+    assert_quiescent(sched)
+
+
+def test_chaos_pool_exhaustion_total_stall_preempts_and_recovers():
+    """Freeze with every slot needing growth: total stall → bounded
+    preemption → restart-from-prompt, outputs still exact."""
+    def reqs():
+        return [req(1, plen=4, gen=8), req(2, plen=4, gen=8)]
+
+    ref = fault_free(reqs, num_blocks=17)
+    # freeze exactly when both slots must claim their 3rd block (seq 8
+    # at step ~5): every active slot stalls at once → preemption ladder
+    fi = FaultInjector([FaultSpec(site="pool", step=5, duration=4)])
+    sched, _, pool = make_sched(num_blocks=17, fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert sched.preemptions >= 1                   # ladder reached rung 2
+    assert {c.status for c in comps.values()} == {COMPLETED}
+    for rid, c in comps.items():
+        np.testing.assert_array_equal(c.tokens, ref[rid])
+    assert_quiescent(sched)
+
+
+# --- scenario 2: executor failure mid-prefill --------------------------------
+
+def test_chaos_mid_prefill_fault_is_isolated():
+    def reqs():
+        return [req(1, gen=6), req(2, gen=6), req(3, gen=6)]
+
+    ref = fault_free(reqs)
+    fi = FaultInjector([FaultSpec(site="prefill", rid=2,
+                                  message="prefill blew up")])
+    sched, _, _ = make_sched(fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert comps[2].status == FAILED
+    assert "prefill blew up" in comps[2].error
+    assert comps[2].tokens.size == 0
+    for rid in (1, 3):                              # neighbors untouched
+        assert comps[rid].status == COMPLETED
+        np.testing.assert_array_equal(comps[rid].tokens, ref[rid])
+    assert_quiescent(sched)
+
+
+# --- scenario 3/4: executor failure mid-decode -------------------------------
+
+def test_chaos_mid_decode_fault_attributed_fails_one():
+    def reqs():
+        return [req(1, gen=10), req(2, gen=10)]
+
+    ref = fault_free(reqs)
+    # slot 1 (rid 2) faults at decode step 3; rid 1 must stream on
+    fi = FaultInjector([FaultSpec(site="decode", step=3, slot=1,
+                                  message="decode NaN")])
+    sched, _, _ = make_sched(fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert comps[2].status == FAILED and "decode NaN" in comps[2].error
+    # the failed stream kept its pre-fault tokens (a prefix of the
+    # fault-free stream — the failing call consumed nothing)
+    np.testing.assert_array_equal(
+        comps[2].tokens, ref[2][:len(comps[2].tokens)])
+    assert comps[1].status == COMPLETED
+    np.testing.assert_array_equal(comps[1].tokens, ref[1])
+    assert_quiescent(sched)
+
+
+def test_chaos_mid_decode_fault_unattributed_fails_runnable_not_queued():
+    """An executor exception with no slot attribution fails every
+    runnable slot (whose state the scheduler cannot trust) — but the
+    QUEUE keeps serving: serve() never raises and later requests get
+    their exact streams."""
+    def reqs():
+        return [req(1, gen=10), req(2, gen=10), req(3, gen=4)]
+
+    ref = fault_free(reqs)
+    fi = FaultInjector([FaultSpec(site="decode", step=2,
+                                  message="device wedged")])
+    sched, _, _ = make_sched(num_slots=2, fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert comps[1].status == FAILED and comps[2].status == FAILED
+    assert comps[3].status == COMPLETED             # queued at fault time
+    np.testing.assert_array_equal(comps[3].tokens, ref[3])
+    assert_quiescent(sched)
+
+
+# --- scenario 5: cancel burst ------------------------------------------------
+
+def test_chaos_cancel_burst_partial_tokens_and_isolation():
+    def reqs():
+        return [req(1, gen=12), req(2, gen=12), req(3, gen=12)]
+
+    ref = fault_free(reqs, num_slots=3)
+    fi = FaultInjector([FaultSpec(site="cancel", step=4, rids=[1, 3])])
+    sched, _, _ = make_sched(num_slots=3, fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    for rid in (1, 3):
+        c = comps[rid]
+        assert c.status == CANCELLED
+        assert 0 < len(c.tokens) < 12               # partial stream
+        np.testing.assert_array_equal(c.tokens, ref[rid][:len(c.tokens)])
+    assert comps[2].status == COMPLETED
+    np.testing.assert_array_equal(comps[2].tokens, ref[2])
+    assert_quiescent(sched)
+
+
+def test_chaos_cancel_queued_and_unknown_rid():
+    sched, _, _ = make_sched(num_slots=1)
+    sched.submit(req(1, gen=8))
+    sched.submit(req(2, gen=8))                     # queued behind 1
+    sched.step()
+    assert sched.cancel(2) is True                  # queued: known
+    assert sched.cancel(99) is False                # unknown: refused
+    comps = by_rid(drain(sched))
+    assert comps[2].status == CANCELLED and comps[2].tokens.size == 0
+    assert comps[1].status == COMPLETED
+    np.testing.assert_array_equal(comps[1].tokens, 100 + np.arange(8))
+    assert_quiescent(sched)
+
+
+# --- scenario 6/7: deadlines and queue timeouts ------------------------------
+
+def test_chaos_deadline_expiry_mid_stream():
+    """deadline_s is enforced at chunk boundaries: the stream resolves
+    TIMED_OUT with the tokens generated so far (a prefix of the
+    fault-free stream); co-scheduled requests are untouched."""
+    def reqs():
+        return [req(1, gen=20), req(2, gen=6)]
+
+    ref = fault_free(reqs)
+    sched, _, _ = make_sched()
+    r1 = req(1, gen=20, deadline_s=5.0)
+    sched.submit(r1, now=0.0)
+    sched.submit(req(2, gen=6), now=0.0)
+    for t in (0.0, 1.0, 2.0):
+        sched.step(now=t)
+    comps = []
+    for t in (10.0, 11.0, 12.0, 13.0):              # past rid 1's deadline
+        comps.extend(sched.step(now=t))
+    comps.extend(drain(sched))
+    comps = by_rid(comps)
+    assert comps[1].status == TIMED_OUT
+    assert 0 < len(comps[1].tokens) < 20
+    np.testing.assert_array_equal(
+        comps[1].tokens, ref[1][:len(comps[1].tokens)])
+    assert comps[2].status == COMPLETED
+    np.testing.assert_array_equal(comps[2].tokens, ref[2])
+    assert_quiescent(sched)
+
+
+def test_chaos_queue_timeout_only_bounds_waiting():
+    """queue_timeout_s resolves a starved QUEUED request TIMED_OUT (no
+    tokens, no blocks ever held); the slot-holding request never sees
+    the timeout."""
+    sched, _, _ = make_sched(num_slots=1, queue_timeout_s=5.0)
+    sched.submit(req(1, gen=16), now=0.0)
+    sched.submit(req(2, gen=4), now=0.0)            # will starve
+    comps = []
+    t = 0.0
+    while sched.busy:
+        comps.extend(sched.step(now=t))
+        t += 1.0
+    comps = by_rid(comps)
+    assert comps[2].status == TIMED_OUT and comps[2].tokens.size == 0
+    assert "queue wait" in comps[2].error
+    assert comps[1].status == COMPLETED
+    np.testing.assert_array_equal(comps[1].tokens, 100 + np.arange(16))
+    assert_quiescent(sched)
+
+
+def test_chaos_deadline_expiry_while_queued():
+    sched, _, _ = make_sched(num_slots=1)
+    sched.submit(req(1, gen=16), now=0.0)
+    sched.submit(req(2, gen=4, deadline_s=3.0), now=0.0)
+    comps = []
+    t = 0.0
+    while sched.busy:
+        comps.extend(sched.step(now=t))
+        t += 1.0
+    comps = by_rid(comps)
+    assert comps[2].status == TIMED_OUT and comps[2].tokens.size == 0
+    assert "deadline" in comps[2].error
+    assert comps[1].status == COMPLETED
+    assert_quiescent(sched)
+
+
+# --- scenario 8: bounded preemption ------------------------------------------
+
+def test_chaos_preempt_limit_terminates_deterministically():
+    """max_preemptions=0: the first total-stall victim resolves
+    PREEMPTED_LIMIT instead of restarting — no livelock, and the
+    surviving request's stream is exact."""
+    sched, _, pool = make_sched(num_blocks=3)       # 2 usable: total stall
+    sched.submit(req(1, plen=4, gen=4))
+    sched.submit(req(2, plen=4, gen=4))
+    sched.max_preemptions = 0
+    comps = by_rid(drain(sched))
+    assert sched.preemptions == 1
+    limited = [c for c in comps.values() if c.status == PREEMPTED_LIMIT]
+    assert len(limited) == 1
+    assert "max_preemptions=0" in limited[0].error
+    survivor = next(c for c in comps.values() if c.status == COMPLETED)
+    np.testing.assert_array_equal(
+        survivor.tokens, survivor.rid * 100 + np.arange(4))
+    assert_quiescent(sched)
+
+
+def test_chaos_preempt_rotation_spreads_victims():
+    """Preempt-age-aware victim selection: under sustained total stalls
+    the SAME request is not evicted every round — with a per-request cap
+    of 1 the whole trace still completes (naive youngest-first would
+    push one rid over any cap or starve it)."""
+    sched, _, _ = make_sched(num_slots=3, num_blocks=5, width=6,
+                             max_preemptions=3)
+    for rid in (1, 2, 3):
+        sched.submit(req(rid, plen=4, gen=8))       # 3 blocks each at peak
+    comps = by_rid(drain(sched, max_steps=2000))
+    assert sched.preemptions >= 2                   # sustained pressure
+    assert {c.status for c in comps.values()} == {COMPLETED}
+    for rid, c in comps.items():
+        np.testing.assert_array_equal(c.tokens, rid * 100 + np.arange(8))
+    assert_quiescent(sched)
+
+
+# --- slow chunk + wall-clock deadline ----------------------------------------
+
+def test_chaos_slow_chunk_trips_wall_clock_deadline():
+    fi = FaultInjector([FaultSpec(site="slow", step=2, seconds=0.25)])
+    sched, _, _ = make_sched(fault_injector=fi)
+    sched.submit(req(1, gen=20, deadline_s=0.1))
+    sched.submit(req(2, gen=4))
+    comps = by_rid(drain(sched))
+    assert any(e["site"] == "slow" for e in fi.log)
+    assert comps[1].status == TIMED_OUT
+    assert comps[2].status == COMPLETED
+    np.testing.assert_array_equal(comps[2].tokens, 200 + np.arange(4))
+    assert_quiescent(sched)
+
+
+# --- prefix-caching pool under faults ----------------------------------------
+
+def test_chaos_faults_with_prefix_cache_keep_index_consistent():
+    """Cancel + decode fault on a caching pool: shared blocks only
+    deref, the content index stays audit-clean, and a later same-prefix
+    admission still hits."""
+    shared = np.arange(1, 9)                        # 2 full blocks
+
+    def preq(rid, tail, gen=6, **kw):
+        return Request(rid=rid,
+                       prompt=np.concatenate([shared, tail]),
+                       max_new_tokens=gen, **kw)
+
+    fi = FaultInjector([
+        FaultSpec(site="cancel", step=3, rids=[2]),
+        FaultSpec(site="decode", step=5, slot=0, message="boom"),
+    ])
+    sched, ex, pool = make_sched(prefix=True, num_blocks=33,
+                                 fault_injector=fi)
+    sched.submit(preq(1, [91, 92], gen=10))
+    sched.submit(preq(2, [81, 82], gen=10))
+    sched.submit(preq(3, [71, 72], gen=4))
+    comps = by_rid(drain(sched))
+    assert comps[2].status == CANCELLED
+    assert comps[1].status == FAILED                # slot 0 at step 5
+    assert comps[3].status == COMPLETED
+    # the shared prefix survived both exits: a fresh admission hits it
+    hits_before = sched.cache_hit_blocks
+    sched.submit(preq(9, [61, 62], gen=2))
+    drain(sched)
+    assert sched.cache_hit_blocks >= hits_before + 2
+    assert_quiescent(sched)
+
+
+# --- auditor fails fast on real corruption -----------------------------------
+
+def test_chaos_auditor_detects_seeded_corruption():
+    sched, _, pool = make_sched()
+    sched.submit(req(1, gen=8))
+    sched.step()
+    held = sched.tables.blocks_of(0)
+    pool._free.append(held[0])                      # corrupt: free a held block
+    with pytest.raises(PoolAuditError, match="free and allocated"):
+        sched.step()
+    assert sched.last_audit_violations
+
+
+def test_chaos_auditor_detects_refcount_drift():
+    sched, _, pool = make_sched(prefix=True)
+    sched.submit(req(1, gen=8))
+    sched.step()
+    bid = sched.tables.blocks_of(0)[0]
+    pool._refs[bid] += 1                            # phantom reference
+    with pytest.raises(PoolAuditError, match="refcount"):
+        sched.audit()
+
+
+# --- seeded random plans (fast seeds, tier-1) --------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_chaos_random_plan_always_quiesces(seed):
+    """Randomized mixed-fault plans (one integer each): whatever fires,
+    every request resolves to a terminal status, unaffected completions
+    are byte-exact, and the pool audits clean and fully free."""
+    def reqs():
+        return [req(rid, plen=4 + rid % 3, gen=6 + rid % 5)
+                for rid in range(1, 7)]
+
+    ref = fault_free(reqs, num_slots=2, num_blocks=33)
+    fi = FaultInjector.random_plan(seed, rids=[r.rid for r in reqs()],
+                                   horizon=20)
+    sched, _, _ = make_sched(num_slots=2, num_blocks=33,
+                             fault_injector=fi)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched, max_steps=2000))
+    assert sorted(comps) == [1, 2, 3, 4, 5, 6]      # everyone resolved
+    for rid, c in comps.items():
+        if c.status == COMPLETED:
+            np.testing.assert_array_equal(c.tokens, ref[rid])
+        else:
+            # partial streams are prefixes of the fault-free stream
+            np.testing.assert_array_equal(
+                c.tokens, ref[rid][:len(c.tokens)])
+    assert_quiescent(sched)
+
+
+# --- shutdown (the lease reclamation path) -----------------------------------
+
+def test_chaos_shutdown_releases_everything_and_is_idempotent():
+    sched, _, pool = make_sched(prefix=True)
+    for rid in (1, 2, 3):
+        sched.submit(req(rid, gen=20))
+    sched.step()
+    assert pool.num_allocated > 0
+    terms = sched.shutdown(error="client went away")
+    assert {c.status for c in terms} == {CANCELLED}
+    assert sorted(c.rid for c in terms) == [1, 2, 3]
+    assert_quiescent(sched)
+    assert sched.shutdown() == []                   # idempotent
+    # reclaimed prefixes parked on the cache: a rerun of rid 1 hits
+    sched.submit(req(1, gen=4))
+    drain(sched)
+    assert sched.cache_hit_blocks >= 1
+    assert_quiescent(sched)

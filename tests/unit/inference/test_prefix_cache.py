@@ -343,6 +343,90 @@ def test_prefix_cache_stats_rates():
     assert s["hit_tokens"] == 8 and s["prompt_tokens"] == 18
 
 
+def test_cancel_derefs_but_never_frees_shared_blocks():
+    """Cancellation under sharing: two slots hold the same prefix
+    blocks; cancelling one must DECREMENT the shared refcounts (never
+    free the frames) — the survivor's table, the refcounts it relies
+    on, and the content index all stay intact, and its stream is
+    unaffected."""
+    sched, ex, pool = make_psched()
+    shared = np.arange(1, 9)                     # 2 full blocks
+    sched.submit(preq(1, np.concatenate([shared, [91, 92]]), gen=10))
+    sched.step()
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=10))
+    sched.step()
+    r1_blocks = sched.tables.blocks_of(0)
+    r2_blocks = sched.tables.blocks_of(1)
+    assert r2_blocks[:2] == r1_blocks[:2]        # sharing established
+    assert pool.refcount(r1_blocks[0]) == 2
+    assert sched.cancel(2) is True
+    comps = sched.step()                         # cancel lands at boundary
+    cancelled = [c for c in comps if c.rid == 2]
+    assert cancelled and cancelled[0].status == "CANCELLED"
+    # shared frames deref'd to 1 (NOT freed), survivor untouched
+    assert pool.refcount(r1_blocks[0]) == 1
+    assert pool.refcount(r1_blocks[1]) == 1
+    # survivor's table intact (it may have GROWN on-demand since)
+    assert sched.tables.blocks_of(0)[:len(r1_blocks)] == r1_blocks
+    assert pool.is_cached(r1_blocks[0])          # index entry survives
+    # the cancelled slot's PRIVATE tail went back to the pool: each
+    # frame is either unreferenced now or already recycled into the
+    # survivor's on-demand growth — never still pinned by the dead slot
+    live = set(sched.tables.blocks_of(0))
+    assert all(pool.refcount(b) == 0 or b in live
+               for b in r2_blocks if b not in r1_blocks)
+    comps = drain(sched)
+    c1 = next(c for c in comps if c.rid == 1)
+    np.testing.assert_array_equal(c1.tokens, 100 + np.arange(10))
+    sched.audit(context="post-cancel")           # refcounts consistent
+    assert pool.num_allocated == 0
+
+
+def test_post_cancel_same_prefix_admission_still_hits():
+    """A same-prefix admission AFTER a cancellation must still hit the
+    cache: the cancelled slot registered its full blocks before
+    releasing, so they parked on the LRU instead of freeing."""
+    sched, ex, pool = make_psched()
+    prompt = np.concatenate([np.arange(1, 9), [91, 92]])
+    sched.submit(preq(1, prompt, gen=12))
+    sched.step()                                 # admitted, decoding
+    sched.cancel(1)
+    sched.step()                                 # resolves CANCELLED
+    assert pool.num_allocated == 0
+    assert pool.num_cached >= 2                  # prefix parked, not freed
+    hits0 = sched.cache_hit_blocks
+    sched.submit(preq(2, prompt, gen=3))
+    comps = drain(sched)
+    assert sched.cache_hit_blocks >= hits0 + 2   # cancelled prefix re-hit
+    c2 = next(c for c in comps if c.rid == 2)
+    assert c2.status == "COMPLETED"
+    np.testing.assert_array_equal(c2.tokens, 200 + np.arange(3))
+    assert pool.num_allocated == 0
+
+
+def test_cancel_timeout_under_sharing_respects_cow_source():
+    """Deadline expiry of a slot that admitted via CoW: its private copy
+    frees, the original cached source keeps its entry and any other
+    holder's reference."""
+    sched, ex, pool = make_psched()
+    prompt = np.arange(1, 9)                     # exactly 2 blocks
+    sched.submit(preq(1, prompt, gen=2))
+    drain(sched)                                 # registers + parks prefix
+    cached = pool.lookup(block_content_keys(prompt, 4, pool.salt))
+    sched.submit(preq(2, prompt, gen=12, deadline_s=5.0), now=0.0)
+    sched.step(now=0.0)                          # CoW admission
+    assert len(ex.copies) == 1
+    (src, dst), = ex.copies[0]
+    comps = sched.step(now=100.0)                # deadline blown
+    assert [c.status for c in comps if c.rid == 2] == ["TIMED_OUT"]
+    # the CoW source survives with its index entry; the private copy is
+    # back in circulation with no references
+    assert pool.lookup(block_content_keys(prompt, 4, pool.salt)) == cached
+    assert pool.refcount(dst) == 0
+    assert pool.num_allocated == 0
+    sched.audit(context="post-timeout")
+
+
 def test_occupancy_log_reports_cached_blocks():
     ex = PrefixFakeExecutor()
     pool = PrefixCachingBlockPool(17, 4)

@@ -145,6 +145,10 @@ def test_serve_int8_kv_pool_close_to_fp():
 
 
 def test_serve_learned_positions_length_check():
+    """Learned-position overflow is per-request validation like any
+    other: the doomed request resolves REJECTED (naming max_seq_len)
+    while a co-batched in-range request still serves; the
+    single-request generate() keeps its raise."""
     cfg = TransformerConfig.tiny(pos_emb="learned", max_seq_len=16)
     model = TransformerLM(cfg)
     ids = jnp.zeros((1, 8), jnp.int32)
@@ -152,10 +156,17 @@ def test_serve_learned_positions_length_check():
     engine = deepspeed_tpu.init_inference(
         model=model, config={"dtype": "float32"}, params=params,
         model_config=cfg)
+    comps = engine.serve([Request(rid=0, prompt=np.arange(1, 13),
+                                  max_new_tokens=8),
+                          Request(rid=1, prompt=np.arange(1, 7),
+                                  max_new_tokens=4)],
+                         num_slots=1, block_size=4)
+    by = {c.rid: c for c in comps}
+    assert by[0].status == "REJECTED" and "max_seq_len" in by[0].error
+    assert by[1].status == "COMPLETED" and len(by[1].tokens) == 4
     with pytest.raises(ValueError, match="max_seq_len"):
-        engine.serve([Request(rid=0, prompt=np.arange(1, 13),
-                              max_new_tokens=8)],
-                     num_slots=1, block_size=4)
+        engine.generate(jnp.asarray(np.arange(1, 13))[None],
+                        max_new_tokens=8)
 
 
 def test_generate_stream_yields_in_finish_order(llama_engine):
@@ -309,3 +320,179 @@ def test_serve_prefix_cache_tiny_pool_evicts_and_completes(llama_engine):
                                num_blocks=8, prefix_cache=True)
     assert sorted(c.rid for c in comps) == list(range(4))
     assert_greedy_parity(llama_engine, comps)
+
+
+# --- fault tolerance (docs/SERVING.md) ---------------------------------------
+
+def test_serve_rejects_invalid_requests_per_request(llama_engine):
+    """Pre-admission validation: a malformed request in a batch resolves
+    to a REJECTED completion on its own slot — it must never raise out
+    of serve() and kill its co-submitted neighbors."""
+    from deepspeed_tpu.inference.scheduler import COMPLETED, REJECTED
+
+    good = mixed_requests(2)
+    batch = [
+        {"rid": "empty", "prompt": [], "max_new_tokens": 4},
+        good[0],
+        {"rid": "nogen", "prompt": [1, 2, 3], "max_new_tokens": 0},
+        good[1],
+        # prompt + budget past max_context: oversized for the slot table
+        {"rid": "huge", "prompt": list(range(1, 40)),
+         "max_new_tokens": 64},
+    ]
+    comps = llama_engine.serve(batch, num_slots=2, block_size=4,
+                               max_context=24)
+    by = {c.rid: c for c in comps}
+    assert len(by) == 5 and {"empty", "nogen", "huge", 0, 1} == set(by)
+    for rid in ("empty", "nogen", "huge"):
+        assert by[rid].status == REJECTED, rid
+        assert by[rid].error and by[rid].tokens.size == 0
+    survivors = [c for c in comps if c.status == COMPLETED]
+    assert len(survivors) == 2
+    assert_greedy_parity(llama_engine, survivors)
+
+
+def test_generate_keeps_raise_behavior_on_invalid_args(llama_engine):
+    """The single-request dense path must keep raising (nothing else in
+    the batch to protect) — pinned so the serving-side REJECTED
+    semantics never bleed into generate()."""
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        llama_engine.generate(jnp.asarray([[1, 2, 3]]), max_new_tokens=0)
+    with pytest.raises(ValueError, match="empty prompt"):
+        llama_engine.generate(jnp.zeros((1, 0), jnp.int32),
+                              max_new_tokens=4)
+
+
+def test_abandoned_generate_stream_reclaims_blocks(llama_engine):
+    """THE leak regression (engine.py lease mechanism): dropping a
+    half-consumed generate_stream must return the pool to fully-free
+    the moment the iterator is garbage-dropped — not when a later shape
+    change happens to rebuild the executor — and the reclaimed prefixes
+    stay warm for the next session."""
+    import gc
+
+    llama_engine.reset_prefix_cache()
+    reqs = shared_prefix_requests(6)
+    stream = llama_engine.generate_stream(reqs, num_slots=2,
+                                          block_size=4,
+                                          prefix_cache=True)
+    next(stream)                                 # mid-flight, blocks held
+    sched = llama_engine.last_serve_scheduler
+    pool = sched.pool
+    assert pool.num_allocated > 0
+    del stream
+    gc.collect()                                 # finalizer closes the gen
+    assert pool.num_allocated == 0               # fully free again
+    assert all(r == 0 for r in pool._refs.values())
+    sched.audit(context="post-abandon")
+    # the executor reuses the SAME pool warm: same-prefix traffic hits
+    comps = llama_engine.serve(shared_prefix_requests(3), num_slots=2,
+                               block_size=4, prefix_cache=True)
+    stats = llama_engine.last_serve_scheduler.prefix_cache_stats()
+    assert llama_engine.last_serve_scheduler.pool is pool
+    assert stats["hit_blocks"] > 0
+    assert_greedy_parity(llama_engine, comps)
+
+
+def test_expired_lease_is_reclaimed_by_next_serve(llama_engine):
+    """A lingering un-pulled iterator object (no GC) must not strand
+    blocks forever: its lease expires and the next serve() call on the
+    executor reclaims them."""
+    llama_engine.reset_prefix_cache()
+    stream = llama_engine.generate_stream(mixed_requests(4), num_slots=2,
+                                          block_size=4,
+                                          lease_timeout_s=0.0)
+    next(stream)
+    pool1 = llama_engine.last_serve_scheduler.pool
+    assert pool1.num_allocated > 0
+    comps = llama_engine.serve(mixed_requests(3), num_slots=2,
+                               block_size=4)    # reclaims the stale lease
+    assert pool1.num_allocated == 0
+    assert sorted(c.rid for c in comps) == list(range(3))
+    assert_greedy_parity(llama_engine, comps)
+    # the reclaimed stream still RESOLVES everything it was serving:
+    # resuming it yields CANCELLED terminals for the reclaimed
+    # requests, never a fabricated COMPLETED
+    leftovers = list(stream)
+    assert leftovers, "reclaimed requests vanished from their stream"
+    assert all(c.status == "CANCELLED" for c in leftovers)
+    assert "lease" in leftovers[0].error
+
+
+def test_serve_cancel_request_mid_stream(llama_engine):
+    """Cooperative cancellation through the engine API: the cancelled
+    request resolves CANCELLED with a partial (still exactly-greedy)
+    stream; everything else completes untouched."""
+    from deepspeed_tpu.inference.scheduler import CANCELLED, COMPLETED
+
+    reqs = mixed_requests(4)
+    got = []
+    stream = llama_engine.generate_stream(reqs, num_slots=2,
+                                          block_size=4)
+    first = next(stream)
+    got.append(first)
+    # pick a rid still in flight and cancel it between pulls
+    live = [r.rid for r in reqs if r.rid != first.rid]
+    victim = live[0]
+    assert llama_engine.cancel_request(victim)
+    got.extend(stream)
+    by = {c.rid: c for c in got}
+    assert by[victim].status == CANCELLED
+    ref = np.asarray(llama_engine.generate(
+        jnp.asarray(by[victim].prompt)[None],
+        max_new_tokens=int(len(by[victim].tokens) or 1)))[0]
+    if len(by[victim].tokens):
+        np.testing.assert_array_equal(
+            np.concatenate([by[victim].prompt, by[victim].tokens]), ref)
+    done = [c for c in got if c.status == COMPLETED]
+    assert len(done) == 3
+    assert_greedy_parity(llama_engine, done)
+    assert llama_engine.cancel_request("nope") is False
+
+
+def test_serve_deadline_times_out_request(llama_engine):
+    """Request-level deadline through the real engine: the doomed
+    request resolves TIMED_OUT at a chunk boundary; neighbors' streams
+    are byte-identical to generate()."""
+    from deepspeed_tpu.inference.scheduler import (
+        COMPLETED, Request, TIMED_OUT,
+    )
+
+    rng = np.random.default_rng(17)
+    reqs = [Request(rid=0, prompt=rng.integers(1, 256, 6),
+                    max_new_tokens=64, deadline_s=0.0),
+            Request(rid=1, prompt=rng.integers(1, 256, 8),
+                    max_new_tokens=5)]
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4)
+    by = {c.rid: c for c in comps}
+    assert by[0].status == TIMED_OUT and "deadline" in by[0].error
+    assert by[1].status == COMPLETED
+    assert_greedy_parity(llama_engine, [by[1]])
+    assert llama_engine.last_serve_scheduler.pool.num_allocated == 0
+
+
+def test_serve_fault_injector_end_to_end(llama_engine):
+    """A seeded injector through the REAL compiled serving path: the
+    attributed decode fault fails one request, everyone else matches
+    the fault-free run byte-for-byte, the pool drains clean."""
+    from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+    from deepspeed_tpu.inference.scheduler import COMPLETED, FAILED
+
+    reqs = mixed_requests(4, seed=13)
+    ref = {c.rid: c.tokens for c in llama_engine.serve(
+        mixed_requests(4, seed=13), num_slots=2, block_size=4)}
+    fi = FaultInjector([FaultSpec(site="decode", step=3, slot=1,
+                                  message="injected")])
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4,
+                               fault_injector=fi, audit_every=1)
+    by = {c.rid: c for c in comps}
+    failed = [c for c in comps if c.status == FAILED]
+    assert len(failed) == 1
+    np.testing.assert_array_equal(
+        failed[0].tokens, ref[failed[0].rid][:len(failed[0].tokens)])
+    for c in comps:
+        if c.status == COMPLETED:
+            np.testing.assert_array_equal(c.tokens, ref[c.rid])
+    sched = llama_engine.last_serve_scheduler
+    assert sched.pool.num_allocated == 0
+    sched.audit(context="post-chaos")

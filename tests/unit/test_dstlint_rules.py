@@ -354,6 +354,98 @@ def test_donation_satisfied_and_out_of_scope_file():
     assert lint(undonated, OPS) == []
 
 
+# --- no-silent-except --------------------------------------------------------
+
+INFER = "deepspeed_tpu/inference/scheduler.py"   # no-silent-except scope
+
+
+def test_silent_except_bare_and_broad_pass_flagged():
+    src = """
+        def step(self):
+            try:
+                self.executor.decode()
+            except Exception:
+                pass
+    """
+    assert rules_of(lint(src, INFER)) == ["no-silent-except"]
+    bare = """
+        def step(self):
+            try:
+                self.executor.decode()
+            except:
+                self.count += 1
+    """
+    assert rules_of(lint(bare, INFER)) == ["no-silent-except"]
+
+
+def test_silent_except_broad_tuple_flagged():
+    src = """
+        def step(self):
+            try:
+                run()
+            except (ValueError, Exception):
+                return None
+    """
+    assert rules_of(lint(src, INFER)) == ["no-silent-except"]
+
+
+def test_silent_except_explicit_handling_is_clean():
+    # binding the exception AND using it = explicit fault conversion
+    # (the scheduler's per-request isolation idiom)
+    src = """
+        def step(self):
+            try:
+                self.executor.decode()
+            except Exception as e:
+                self.fail_slot(error=str(e))
+    """
+    assert lint(src, INFER) == []
+    # re-raising (bare or wrapped) is also explicit
+    reraise = """
+        def step(self):
+            try:
+                run()
+            except Exception:
+                cleanup()
+                raise
+    """
+    assert lint(reraise, INFER) == []
+
+
+def test_silent_except_specific_types_and_other_paths_clean():
+    # narrow handlers are deliberate control flow, not swallowing
+    src = """
+        def probe(params):
+            try:
+                return params["blocks"]["qkv"]
+            except (KeyError, TypeError):
+                return None
+    """
+    assert lint(src, INFER) == []
+    # outside inference/ the rule does not apply
+    swallower = """
+        def f():
+            try:
+                run()
+            except Exception:
+                pass
+    """
+    assert lint(swallower, ANY) == []
+    assert lint(swallower, OPS) == []
+
+
+def test_silent_except_bound_but_unused_name_flagged():
+    # `as e` alone is not handling — the name must be USED
+    src = """
+        def step(self):
+            try:
+                run()
+            except Exception as e:
+                return None
+    """
+    assert rules_of(lint(src, INFER)) == ["no-silent-except"]
+
+
 # --- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_silences_one_line():

@@ -510,7 +510,8 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
 
 
 def serve_prefix_main(num_slots=None, trace_seed=None,
-                      out_path="BENCH_SERVE.json", kernel=None):
+                      out_path="BENCH_SERVE.json", kernel=None,
+                      host_cache=False):
     """--serve --shared-prefix: the prefix-cache A/B on a shared-prefix
     trace (N personas x M continuations — the system-prompt/few-shot
     traffic shape), same engine/weights/slots/kernel across arms:
@@ -534,6 +535,17 @@ def serve_prefix_main(num_slots=None, trace_seed=None,
     offset prefill of the uncached tail drops into a SMALLER compiled
     bucket (engine.prompt_capacity), so the TTFT win is real compute
     skipped, not just accounting.
+
+    ``--host-cache`` adds the TIERED-KV A/B (docs/SERVING.md): the same
+    shared trace served from a device pool SHRUNK until the device LRU
+    must evict each persona between uses (2 slots, ~live-tokens-only
+    slack), with vs without a host-RAM tier (``host_cache_gb``). The
+    tiered arm spills evicted persona blocks to host RAM and restores
+    them by async device_put ahead of the tail prefill; the no-tier arm
+    re-prefills every evicted persona in full. Records per-arm TTFT,
+    the host-tier lookup hit-rate, spill/restore bytes, and asserts the
+    greedy streams are byte-identical (the tier is a pure capacity/perf
+    layer) — merged as ``detail.host_cache_ab``.
     """
     import jax
     import jax.numpy as jnp
@@ -712,6 +724,137 @@ def serve_prefix_main(num_slots=None, trace_seed=None,
         "greedy_identical": True,            # asserted above
         "backend": jax.default_backend(),
     }
+
+    host_ab = None
+    if host_cache:
+        from deepspeed_tpu.ops.paged_attention import blocks_for
+
+        # device pool shrunk to LIVE tokens + a sliver: 2 slots' worth
+        # of blocks plus ~4 of LRU slack, so a persona can never sit
+        # out a full reuse cycle in HBM. The tier trace reshapes the
+        # shared-prefix traffic to what the tier targets: DOUBLED
+        # personas (long system prompts — a restore must out-save one
+        # decode round, and the saving scales with persona length while
+        # the cost is fixed) CYCLED round-robin with arrivals spaced
+        # near the service rate, so every reuse is separated by the
+        # other personas' admissions and the shrunken LRU provably
+        # evicts it in between — warm admissions either host-hit (tier
+        # on) or re-prefill the whole persona cold (tier off)
+        tier_slots = 2
+        tier_persona = persona_len * 2
+        tier_gap = 0.25
+        max_ctx = tier_persona + max(cont_lens) + max(gen_mix)
+        t_width = -(-blocks_for(max_ctx, block_size) // 4) * 4
+        small_pool = tier_slots * t_width + 5
+        host_gb = 0.25 if not on_tpu else 2.0
+        tier_kw = dict(num_slots=tier_slots, block_size=block_size,
+                       num_blocks=small_pool, max_context=max_ctx,
+                       decode_chunk=decode_chunk, attn_kernel=kernel,
+                       prefix_cache=True)
+
+        def tier_trace(rng):
+            """(prompt, gen, arrival-offset) triples: n_requests over
+            n_personas personas, round-robin (reuse is always separated
+            by the other personas), deterministic ``tier_gap`` spacing
+            (identical arrival pattern across arms by construction)."""
+            ps = [rng.integers(1, cfg.vocab_size, tier_persona)
+                  for _ in range(n_personas)]
+            out = []
+            for i in range(n_requests):
+                c = int(rng.choice(cont_lens))
+                g = int(rng.choice(gen_mix))
+                out.append((np.concatenate(
+                    [ps[i % n_personas],
+                     rng.integers(1, cfg.vocab_size, c)]),
+                    g, i * tier_gap))
+            return out
+
+        def warm_tier_arm(gb):
+            rng = np.random.default_rng(0)
+            ps = [rng.integers(1, cfg.vocab_size, tier_persona)
+                  for _ in range(n_personas)]
+            reqs, rid = [], 0
+            for rep in range(3):     # reps 2-3 reuse post-eviction (the
+                for p, c in zip(ps, cont_lens):   # restore programs)
+                    reqs.append(Request(
+                        rid=rid, max_new_tokens=4,
+                        prompt=np.concatenate(
+                            [p, rng.integers(1, cfg.vocab_size, c)])))
+                    rid += 1
+            engine.reset_prefix_cache()
+            engine.serve(reqs, host_cache_gb=gb, **tier_kw)
+
+        def run_tier_arm(gb):
+            arm_trace = tier_trace(np.random.default_rng(trace_seed))
+            t0 = time.time() + 0.01
+            reqs = [Request(rid=i, prompt=p, max_new_tokens=g,
+                            arrival_time=t0 + off)
+                    for i, (p, g, off) in enumerate(arm_trace)]
+            engine.reset_prefix_cache()          # both arms start COLD
+            comps = engine.serve(reqs, host_cache_gb=gb, **tier_kw)
+            stats = engine.last_serve_scheduler.prefix_cache_stats()
+            return {
+                "trace": arm_trace,
+                "tokens": {c.rid: np.asarray(c.tokens) for c in comps},
+                "ttft": sorted(c.t_first_token - c.t_submit
+                               for c in comps),
+                "wall": max(c.t_finish for c in comps) - t0,
+                "gen_total": sum(len(c.tokens) for c in comps),
+                "stats": stats,
+            }
+
+        tier_arms = {}
+        for name, gb in (("tier_on", host_gb), ("tier_off", 0)):
+            warm_tier_arm(gb)
+            tier_arms[name] = run_tier_arm(gb)
+        assert_traces_equal(tier_arms["tier_on"]["trace"],
+                            tier_arms["tier_off"]["trace"])
+        for rid, toks in tier_arms["tier_on"]["tokens"].items():
+            assert np.array_equal(
+                toks, tier_arms["tier_off"]["tokens"][rid]), \
+                f"request {rid}: host-tier arm diverged from no-tier"
+
+        def tier_detail(name):
+            a = tier_arms[name]
+            s = a["stats"]
+            return {
+                "ttft_p50_s": round(pct(a["ttft"], 0.5), 4),
+                "ttft_p95_s": round(pct(a["ttft"], 0.95), 4),
+                "tokens_per_sec": round(a["gen_total"] / a["wall"], 1),
+                "wall_s": round(a["wall"], 3),
+                "device_block_hit_rate": s["block_hit_rate"],
+                "token_hit_rate": s["token_hit_rate"],
+                "device_evictions": s["device_evictions"],
+                "host_tier_enabled": s["host_tier_enabled"],
+                "host_hit_rate": s["host_lookup_hit_rate"],
+                "host_hits": s["host_hits"],
+                "host_spills": s["host_spills"],
+                "host_restores": s["host_restores"],
+                "host_restore_failures": s["host_restore_failures"],
+                "host_evictions": s["host_evictions"],
+                "host_bytes_spilled": s["host_bytes_spilled"],
+                "host_bytes_restored": s["host_bytes_restored"],
+            }
+
+        t_on, t_off = tier_detail("tier_on"), tier_detail("tier_off")
+        host_ab = {
+            "arms": {"tier_on": t_on, "tier_off": t_off},
+            "config": {"num_slots": tier_slots,
+                       "num_blocks": small_pool,
+                       "table_width": t_width,
+                       "block_size": block_size,
+                       "persona_len": tier_persona,
+                       "arrival_gap_s": tier_gap,
+                       "host_cache_gb": host_gb,
+                       "trace_seed": trace_seed,
+                       "attn_kernel": kernel},
+            "ttft_p50_speedup_x": round(
+                t_off["ttft_p50_s"] / max(t_on["ttft_p50_s"], 1e-9), 3),
+            "host_hit_rate": t_on["host_hit_rate"],
+            "greedy_identical": True,        # asserted above
+            "backend": jax.default_backend(),
+        }
+
     result = {
         "metric": "serve_prefix_cache_ttft_p50_s",
         "value": on["ttft_p50_s"],
@@ -720,6 +863,14 @@ def serve_prefix_main(num_slots=None, trace_seed=None,
         "detail": ab,
     }
     print(json.dumps(result))
+    if host_ab is not None:
+        print(json.dumps({
+            "metric": "serve_host_cache_ttft_p50_s",
+            "value": host_ab["arms"]["tier_on"]["ttft_p50_s"],
+            "unit": "s",
+            "vs_baseline": host_ab["ttft_p50_speedup_x"],
+            "detail": host_ab,
+        }))
     if out_path:
         # merge under the serve artifact: the continuous-vs-static and
         # kernel-A/B sections from --serve stay alongside
@@ -730,6 +881,8 @@ def serve_prefix_main(num_slots=None, trace_seed=None,
         except (OSError, ValueError):
             pass
         artifact.setdefault("detail", {})["prefix_cache_ab"] = ab
+        if host_ab is not None:
+            artifact["detail"]["host_cache_ab"] = host_ab
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=1)
     return result
@@ -1868,7 +2021,8 @@ if __name__ == "__main__":
         elif "--shared-prefix" in sys.argv:
             serve_prefix_main(num_slots=_intflag("--slots"),
                               trace_seed=_intflag("--trace-seed"),
-                              kernel=(kernels or [None])[0])
+                              kernel=(kernels or [None])[0],
+                              host_cache="--host-cache" in sys.argv)
         else:
             serve_main(num_slots=_intflag("--slots"),
                        n_requests=_intflag("--requests"),

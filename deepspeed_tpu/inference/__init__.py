@@ -4,6 +4,7 @@ from deepspeed_tpu.inference.faults import (
     FaultInjector, FaultSpec, RequestFault,
 )
 from deepspeed_tpu.inference.kv_pool import BlockPool, PoolAuditError
+from deepspeed_tpu.inference.kv_tiering import HostKVTier
 from deepspeed_tpu.inference.scheduler import (
     CANCELLED, COMPLETED, FAILED, PREEMPTED_LIMIT, REJECTED,
     TERMINAL_STATUSES, TIMED_OUT,

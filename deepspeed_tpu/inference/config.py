@@ -107,6 +107,26 @@ class ServeConfig(DeepSpeedConfigModel):
     # streams pinned identical in tier-1) — on by default; turn off for
     # strictly-unique traffic to skip the hashing overhead.
     prefix_cache: bool = True
+    # TIERED KV (inference/kv_tiering.py, docs/SERVING.md): host-RAM
+    # spillover tier behind the device prefix cache, in GB (0 = off,
+    # the default). When on, device-LRU evictions copy their KV frames
+    # into a byte-capped host LRU keyed by the same content hashes, and
+    # admissions whose prefix misses HBM but hits host RAM restore by
+    # async device_put overlapped with the previous decode chunk —
+    # reusable-prefix capacity becomes host-RAM-bound (10-100x the
+    # device cache for multi-tenant system-prompt traffic) while
+    # allocation/backpressure semantics are untouched (the tier can
+    # never block a device allocation; a failed restore degrades that
+    # one request to a cold prefill). Requires prefix_cache. Size it to
+    # (prefixes worth keeping warm) x bytes/block — docs/SERVING.md
+    # "Tiered KV" has the sizing arithmetic.
+    host_cache_gb: float = 0.0
+    # host-tier staging arena in MB (0 = plain per-frame numpy): backs
+    # spilled frames with one ContiguousMemoryAllocator arena (the
+    # swap_tensor staging idiom — stable addresses, no per-spill
+    # allocator churn); frames the arena cannot fit fall back to numpy
+    # per frame, so this is a perf knob, never a capacity limit.
+    host_staging_mb: int = 0
     # --- fault tolerance (docs/SERVING.md) -------------------------------
     # bounded preemption: restart-from-prompt retries per request before
     # it resolves PREEMPTED_LIMIT deterministically (victim selection is

@@ -363,10 +363,18 @@ class PagedServeExecutor:
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fn = None
         self._copy_fn = None
+        self._spill_fn = None
+        self._restore_fn = None
         # host-side prefix-cache pool pinned by the engine so the content
         # index survives across serve() calls on this executor (the
         # device pools it describes already do)
         self._host_pool = None
+        # host-RAM KV tier (inference/kv_tiering.HostKVTier), pinned like
+        # the host pool — but CONTENT-addressed, so its frames stay valid
+        # across serve() calls, pool resets, even cache-off interludes
+        # (the executor cache already keys on params identity, and a
+        # chained content hash names the KV of one exact token prefix)
+        self._host_tier = None
         # the live stream's lease (ServeLease) — None when quiescent
         self._lease = None
 
@@ -424,6 +432,110 @@ class PagedServeExecutor:
         dst = jnp.asarray([p[1] for p in pairs], jnp.int32)
         with self._ctx():
             self._pools = fn(self._pools, src, dst)
+
+    # --- tiered KV: spill / restore (scheduler protocol extensions) ----------
+    def spill_blocks(self, entries) -> None:
+        """Device→host spill: copy the KV frames of evicted blocks into
+        the host tier under their content keys (scheduler contract:
+        called before anything can rewrite those frames). One jitted
+        gather per batch of evictions, one device_get for the lot —
+        present keys only refresh the tier's LRU (no transfer)."""
+        from deepspeed_tpu.ops.paged_attention import gather_pool_blocks
+
+        tier = self._host_tier
+        if tier is None or not entries:
+            return
+        fresh = [(k, b) for k, b in entries if not tier.touch(k)]
+        if not fresh:
+            return
+        if self._spill_fn is None:
+            # a pure read — the pool must SURVIVE the spill, so nothing
+            # is donated (copy/restore donate because they REPLACE pools)
+            self._spill_fn = jax.jit(gather_pool_blocks)  # dstlint: disable=donation-check
+        # pow2-bucketed batch: eviction bursts vary per allocation, and
+        # a shape-keyed jit would recompile for every distinct length —
+        # pad with the null block (a read nobody consumes below)
+        ids = [b for _, b in fresh]
+        ids += [0] * ((1 << (len(ids) - 1).bit_length()) - len(ids))
+        with self._ctx():
+            frames = self._spill_fn(self._pools,
+                                    jnp.asarray(ids, jnp.int32))
+        host = jax.device_get(frames)
+        leaves = jax.tree_util.tree_leaves(host)
+        for i, (key, _) in enumerate(fresh):
+            tier.put(key, [leaf[:, i] for leaf in leaves])
+
+    def begin_restore(self, slot: int, entries):
+        """Start the async host→device leg of a tier restore: stack the
+        tier frames into FRESH staging arrays (the kv_tiering alias
+        guard — device_put may zero-copy alias host buffers on CPU
+        backends, so tier-owned storage never goes straight to the
+        device) and dispatch the transfer. Returns the handle
+        ``finish_restore`` lands next step — overlapping the decode
+        chunk in between — or None when the tier lost a key (the
+        scheduler degrades to a cold prefill)."""
+        from deepspeed_tpu.inference.kv_tiering import RestoreHandle
+
+        tier = self._host_tier
+        if tier is None or not entries:
+            return None
+        staged_np = tier.stage_frames(entries)
+        if staged_np is None:
+            return None
+        nbytes = int(sum(int(a.nbytes) for a in staged_np))
+        # pow2-bucket the restore width like the spill side (one
+        # compiled scatter per bucket, not per hit length): pad lanes
+        # write zeros into the null block — the masked-write sink
+        n = len(entries)
+        cap = 1 << (n - 1).bit_length()
+        if cap != n:
+            staged_np = [
+                np.concatenate(
+                    [s, np.zeros(s.shape[:1] + (cap - n,) + s.shape[2:],
+                                 s.dtype)], axis=1)
+                for s in staged_np]
+        # rebuild the pools' pytree structure so finish_restore's
+        # tree_map pairs frames with their pool leaves, and place each
+        # staged leaf with its pool leaf's sharding: an unsharded
+        # device_put would park the frames on the default device and
+        # defer the real placement to finish_restore's jitted scatter —
+        # a reshard at the latency-critical landing boundary instead of
+        # inside the overlap window this dispatch exists to use
+        treedef = jax.tree_util.tree_structure(self._pools)
+        with self._ctx():
+            staged = jax.device_put(
+                jax.tree_util.tree_unflatten(treedef, staged_np),
+                jax.tree_util.tree_map(lambda p: p.sharding,
+                                       self._pools))
+        return RestoreHandle(
+            slot=slot, entries=list(entries),
+            block_ids=np.asarray([b for _, b in entries]
+                                 + [0] * (cap - n), np.int32),
+            staged=staged, nbytes=nbytes)
+
+    def finish_restore(self, handle) -> bool:
+        """Land a restore: scatter the staged frames into their claimed
+        pool blocks (jitted, pools donated — the same in-place pool
+        discipline as decode/copy). The transfer itself was dispatched
+        at begin_restore; by now it has had a full decode chunk to
+        complete, so this call is the cheap scatter, not the wait.
+
+        Failure contract: a CLEAN refusal (nothing touched the pools)
+        must return False — the scheduler degrades just that request.
+        Raising means the scatter consumed the DONATED pools and died,
+        leaving them in unknown state: the scheduler applies the same
+        blast radius as an unattributed decode error."""
+        from deepspeed_tpu.ops.paged_attention import scatter_pool_blocks
+
+        if self._restore_fn is None:
+            self._restore_fn = jax.jit(scatter_pool_blocks,
+                                       donate_argnums=(0,))
+        with self._ctx():
+            self._pools = self._restore_fn(
+                self._pools, jnp.asarray(handle.block_ids), handle.staged)
+        if self._host_tier is not None:
+            self._host_tier.note_restored(handle.nbytes)
+        return True
 
     def decode(self, tokens, block_tables, seq_lens, active, steps_left,
                max_steps=None):
@@ -1131,6 +1243,7 @@ class InferenceEngine:
                         reserve_upfront: bool = False,
                         record_occupancy: bool = False,
                         prefix_cache: Optional[bool] = None,
+                        host_cache_gb: Optional[float] = None,
                         speculative: Optional[str] = None,
                         max_preemptions: Optional[int] = None,
                         queue_timeout_s: Optional[float] = None,
@@ -1173,6 +1286,18 @@ class InferenceEngine:
         recompute bit-identically); the content index persists across
         ``serve()`` calls that reuse the executor —
         :meth:`reset_prefix_cache` drops it.
+        ``host_cache_gb`` overrides ``serve.host_cache_gb`` (TIERED KV,
+        inference/kv_tiering.py): > 0 adds a host-RAM spillover tier of
+        that many GB behind the device prefix cache — device-LRU
+        evictions spill their KV frames to host memory under the same
+        content keys, and admissions whose prefix left HBM restore by
+        async ``device_put`` overlapped with the previous decode chunk,
+        so reusable-prefix capacity is host-RAM-bound instead of
+        HBM-bound. Requires the prefix cache; outputs stay exactly the
+        untiered path's (a failed restore degrades that one request to a
+        cold prefill). The tier is pinned per executor and, being
+        content-addressed, stays warm across serve() calls; resolved 0
+        drops any pinned tier (frees the host RAM).
 
         FAULT TOLERANCE (docs/SERVING.md): every request resolves to
         exactly one ``Completion`` with a terminal ``status`` —
@@ -1283,6 +1408,30 @@ class InferenceEngine:
             executor._lease = None
         pc = (serve_cfg.prefix_cache
               if prefix_cache is None else bool(prefix_cache))
+        gb = (serve_cfg.host_cache_gb
+              if host_cache_gb is None else float(host_cache_gb))
+        if gb > 0 and not pc:
+            raise ValueError(
+                "host_cache_gb > 0 requires the prefix cache — the host "
+                "tier is keyed by its content hashes (enable "
+                "prefix_cache, or set host_cache_gb: 0)")
+        host_tier = None
+        if pc and gb > 0:
+            from deepspeed_tpu.inference.kv_tiering import tier_from_gb
+
+            # reuse the pinned tier when its cap matches: frames are
+            # content-addressed, so they stay valid for this executor's
+            # params regardless of what happened to the device index in
+            # between (even cache-off sessions — unlike _host_pool,
+            # which binds keys to device block ids and must drop)
+            smb = int(serve_cfg.host_staging_mb)
+            host_tier = executor._host_tier
+            if host_tier is None \
+                    or host_tier.capacity_bytes != int(gb * (1 << 30)) \
+                    or host_tier.staging_mb != smb:
+                host_tier = tier_from_gb(gb, staging_mb=smb)
+        # resolved 0 drops any pinned tier (frees the host RAM)
+        executor._host_tier = host_tier
         if pc:
             # reuse the executor's host pool when quiescent: the content
             # index then spans serve() calls — a second trace sharing the
@@ -1314,7 +1463,8 @@ class InferenceEngine:
                              else queue_timeout_s),
             audit_every=(serve_cfg.audit_every if audit_every is None
                          else int(audit_every)),
-            fault_injector=fault_injector)
+            fault_injector=fault_injector,
+            host_tier=host_tier)
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log
@@ -1434,12 +1584,14 @@ class InferenceEngine:
         return executor
 
     def reset_prefix_cache(self):
-        """Forget all cached prefixes (host-side content indexes on every
-        cached serving executor). Device pools stay; the next cached
-        serve() starts cold — the bench A/B's between-arms reset."""
+        """Forget all cached prefixes (host-side content indexes AND
+        host-RAM KV tiers on every cached serving executor). Device
+        pools stay; the next cached serve() starts cold — the bench
+        A/B's between-arms reset."""
         for _, ex in getattr(self, "_serve_executors",
                              OrderedDict()).values():
             ex._host_pool = None
+            ex._host_tier = None
 
     def release_serve_workspace(self):
         """Drop cached serving executors (block pools + compiled

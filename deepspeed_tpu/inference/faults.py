@@ -52,7 +52,7 @@ class RequestFault(RuntimeError):
 
 
 #: injector hook sites (scheduler call boundaries)
-SITES = ("pool", "prefill", "decode", "cancel", "slow")
+SITES = ("pool", "prefill", "decode", "cancel", "slow", "restore")
 
 
 @dataclasses.dataclass
@@ -71,6 +71,16 @@ class FaultSpec:
       - ``slow``     sleep ``seconds`` before the decode of ``step``
                      (a slow chunk — exercises deadline expiry without
                      wall-clock-dependent tests)
+      - ``restore``  host-tier transfer fault (tiered KV): with
+                     ``seconds`` > 0 the restore is SLOW (sleep before
+                     landing it — the transfer straggles behind the
+                     decode chunk it should hide under); with
+                     ``seconds`` == 0 the restore FAILS just before the
+                     staged frames land (a failed ``device_put``) — the
+                     scheduler must DEGRADE that one request to a cold
+                     prefill, never a FAILED terminal, with co-scheduled
+                     streams untouched (match by ``rid``; ``step``
+                     optional extra gate)
     ``times`` bounds how often a prefill/decode spec fires (pool windows
     are range-gated, not counted).
     """
@@ -182,6 +192,43 @@ class FaultInjector:
             if f.slot is not None:
                 raise RequestFault(f.message, slot=f.slot)
             raise RuntimeError(f.message)
+
+    def restore_delay(self, step: int, rid: Any) -> float:
+        """Seconds to stall before landing ``rid``'s host-tier restore
+        (slow-restore specs: ``site='restore'`` with ``seconds`` > 0)."""
+        total = 0.0
+        for i, f in enumerate(self.plan):
+            if f.site != "restore" or f.seconds <= 0 \
+                    or self._remaining[i] <= 0:
+                continue
+            if f.rid is not None and f.rid != rid:
+                continue
+            if f.step is not None and f.step != step:
+                continue
+            self._remaining[i] -= 1
+            self._record(step, "restore", rid=rid, kind="slow",
+                         seconds=f.seconds)
+            total += float(f.seconds)
+        return total
+
+    def before_restore(self, step: int, slot: int, rid: Any) -> None:
+        """Raise the planned restore FAILURE for ``rid`` (``restore``
+        specs with ``seconds`` == 0): fires at the scheduler's
+        finish-restore boundary, standing in for a failed host→device
+        ``device_put``. The scheduler degrades exactly this request to
+        a cold prefill — the contract the chaos suite pins."""
+        for i, f in enumerate(self.plan):
+            if f.site != "restore" or f.seconds > 0 \
+                    or self._remaining[i] <= 0:
+                continue
+            if f.rid is not None and f.rid != rid:
+                continue
+            if f.step is not None and f.step != step:
+                continue
+            self._remaining[i] -= 1
+            self._record(step, "restore", rid=rid, slot=slot,
+                         kind="fail")
+            raise RequestFault(f.message, slot=slot, rid=rid)
 
     def cancels(self, step: int) -> List[Any]:
         """rids to cancel at the top of ``step`` (the cancel burst)."""

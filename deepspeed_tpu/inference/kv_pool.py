@@ -201,6 +201,15 @@ class PrefixCachingBlockPool(BlockPool):
         # zero-ref cached blocks, least-recently released first
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self.evictions = 0
+        # TIERED KV (inference/kv_tiering.py): the eviction hook. When
+        # set, every _evict reports (content key, block id) BEFORE the
+        # frame can be rewritten — the scheduler queues the pair and
+        # flushes a device→host spill ahead of the next executor write,
+        # so "evicted" stops meaning "gone" and starts meaning
+        # "demoted to the host tier". Pure notification: the pool's own
+        # accounting (and its never-add-backpressure contract) is
+        # unchanged whether or not anyone listens.
+        self.spill_sink = None
 
     # --- capacity: cached blocks are allocatable --------------------------
     @property
@@ -242,6 +251,8 @@ class PrefixCachingBlockPool(BlockPool):
         del self._index[key]
         self._lru.pop(bid, None)
         self.evictions += 1
+        if self.spill_sink is not None:
+            self.spill_sink(key, bid)
 
     def allocate(self, n: int) -> List[int]:
         """Pop ``n`` frames: free list first, then LRU eviction of cached

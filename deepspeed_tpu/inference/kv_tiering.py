@@ -1,0 +1,318 @@
+"""Tiered KV cache: host-RAM spillover tier for the prefix cache.
+
+The device-side prefix cache (:class:`~deepspeed_tpu.inference.kv_pool.
+PrefixCachingBlockPool`) retains zero-ref blocks on an LRU and reclaims
+them the moment admission or growth needs a frame — eviction is
+irrevocable, so reusable-prefix capacity is bounded by HBM. This module
+adds the SECOND tier: when the device LRU evicts a block, its KV frame
+is copied into host RAM keyed by the same chained-SHA content hash, and
+a later admission whose prefix misses the device index but hits here is
+restored by an async ``device_put`` into freshly claimed pool blocks
+ahead of its prefill — cache capacity becomes host-RAM-bound (10-100x
+the block count for multi-tenant system-prompt traffic) while the
+restored blocks land in the exact paged layout the attention kernels
+already consume (Ragged Paged Attention arXiv:2604.15464: the kernel
+path never learns the tier exists).
+
+Reference analogue: ZeRO-Infinity's heterogeneous-memory tiers
+(``runtime/swap_tensor/swapper.py`` is the in-tree disk incarnation) —
+:class:`HostKVTier` reuses its staging-arena idiom (stable host
+addresses from ``ContiguousMemoryAllocator``, plain-numpy fallback on
+overflow) and its CPU zero-copy alias discipline: frames handed to
+``device_put`` are always FRESH staging buffers (stacked per restore),
+never views of tier-owned storage, so a CPU backend aliasing the host
+buffer (swapper.py ``_to_device``) can never see a later eviction
+reusing the arena slot.
+
+The tier is PURE HOST state — content keys, numpy frames, byte
+accounting. Device transfers live in the serving executor
+(``engine.PagedServeExecutor.spill_blocks`` / ``begin_restore`` /
+``finish_restore`` over the jitted ``ops.paged_attention.
+gather_pool_blocks`` / ``scatter_pool_blocks`` entry points), and the
+spill/restore *lifecycle* — when a frame must be copied before its
+device block is rewritten, when a restore may overlap the previous
+decode chunk — is the scheduler's (``inference/scheduler.py``). That
+split keeps the tier unit-testable with fake executors
+(tests/unit/inference/test_kv_tiering.py) exactly like the block pool.
+
+Capacity semantics mirror the device cache's: the tier is strictly
+opportunistic and byte-capped — ``put`` evicts its own LRU to fit and
+simply declines frames larger than the whole cap, so the host tier can
+never block a device allocation or grow without bound
+(``serve.host_cache_gb`` is the cap; 0 disables the tier).
+"""
+
+import dataclasses
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RestoreHandle:
+    """One in-flight host→device restore (executor-owned).
+
+    ``staged`` holds the device arrays the executor ``device_put`` at
+    ``begin_restore`` time — dispatching the transfer is what lets it
+    overlap the next decode chunk; ``finish_restore`` scatters them into
+    the pool blocks ``block_ids`` one step later. ``entries`` keeps the
+    (content key, block id) pairs so the scheduler can register the
+    restored blocks in the device index on success."""
+
+    slot: int
+    entries: List[Any]                 # [(key, block_id), ...]
+    block_ids: np.ndarray              # int32 [N]
+    staged: Any                        # device pytree, [L, N, bs, ...] leaves
+    nbytes: int
+
+
+class HostKVTier:
+    """Byte-capped LRU store of KV block frames in host RAM.
+
+    One entry per content key: the frame list (one numpy array per pool
+    leaf — ``[L, block_size, ...]``, i.e. ``leaf[:, bid]`` of the device
+    pool) plus its byte size. Keys are the prefix cache's chained
+    content hashes, so tier entries are CONTENT-addressed, not
+    device-addressed: a frame stays valid across serving sessions, pool
+    rebuilds, even cache-off interludes — it only describes "the KV of
+    this exact token prefix under these weights", and the executor that
+    owns the tier is cached per params identity.
+
+    ``staging_mb`` > 0 backs frames with a
+    :class:`~deepspeed_tpu.runtime.zero.contiguous_memory_allocator.
+    ContiguousMemoryAllocator` arena (the swapper's staging idiom:
+    stable addresses, no per-spill allocator churn); oversized or
+    fragmented requests fall back to plain numpy per frame. Eviction
+    releases arena slots without defragmenting — compaction would
+    memmove under frames a restore may still be stacking from.
+
+    Counters are MONOTONIC (never reset by eviction) — they feed
+    ``prefix_cache_stats()`` and the bench artifact.
+    """
+
+    def __init__(self, capacity_bytes: int, staging_mb: int = 0):
+        if capacity_bytes < 0:
+            raise ValueError(
+                f"capacity_bytes={capacity_bytes}: must be >= 0")
+        self.capacity_bytes = int(capacity_bytes)
+        self.staging_mb = int(staging_mb)
+        # key -> frames, least-recently-used first
+        self._store: "OrderedDict[bytes, List[np.ndarray]]" = OrderedDict()
+        self._nbytes: Dict[bytes, int] = {}
+        self._handles: Dict[bytes, list] = {}
+        self.bytes_used = 0
+        self._arena = None
+        if staging_mb > 0:
+            from deepspeed_tpu.runtime.zero.contiguous_memory_allocator \
+                import ContiguousMemoryAllocator
+
+            self._arena = ContiguousMemoryAllocator(staging_mb << 20,
+                                                    np.uint8)
+        # monotonic counters (the satellite stats contract)
+        self.spills = 0                # frames copied in (bytes_spilled)
+        self.refreshes = 0             # put() of an already-present key
+        self.hits = 0                  # blocks served by lookup()
+        self.misses = 0                # lookup walks ended by absence
+        self.evictions = 0             # frames dropped by the byte cap
+        self.rejected = 0              # frames larger than the whole cap
+        self.bytes_spilled = 0
+        self.bytes_restored = 0
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._store
+
+    # --- staging arena (swapper idiom) -----------------------------------
+    def _alloc_frame(self, src: np.ndarray):
+        """(array, handle|None): an arena-backed copy when possible."""
+        if self._arena is None:
+            return np.array(src), None
+        nbytes = src.nbytes
+        padded = max(64, -(-nbytes // 64) * 64)   # 64B-aligned offsets
+        try:
+            # never defrag: sibling frames may be mid-stack in a restore
+            handle = self._arena.allocate(padded, allow_defrag=False)
+        except MemoryError:
+            return np.array(src), None
+        view = handle.view()[:nbytes].view(src.dtype).reshape(src.shape)
+        np.copyto(view, src)
+        return view, handle
+
+    def _free_frame_handles(self, key: bytes) -> None:
+        handles = self._handles.pop(key, None)
+        if handles and self._arena is not None:
+            for h in handles:
+                if h is not None:
+                    self._arena.release(h)
+
+    # --- spill side -------------------------------------------------------
+    def put(self, key: bytes, frames: Sequence[np.ndarray]) -> bool:
+        """Admit one evicted block's frames (copied — the caller's
+        buffers are not retained). Present keys just refresh their LRU
+        position (the device re-evicted content the tier still holds —
+        no bytes move). Returns True when the frames were (re)admitted;
+        a frame set larger than the whole cap is declined, and the LRU
+        is evicted as needed to fit everything else — the tier never
+        exceeds ``capacity_bytes`` and never signals pressure upward."""
+        if key in self._store:
+            self._store.move_to_end(key)
+            self.refreshes += 1
+            return True
+        nbytes = int(sum(int(f.nbytes) for f in frames))
+        if nbytes > self.capacity_bytes:
+            self.rejected += 1
+            return False
+        while self.bytes_used + nbytes > self.capacity_bytes:
+            self._evict_lru()
+        stored, handles = [], []
+        for f in frames:
+            arr, h = self._alloc_frame(np.asarray(f))
+            stored.append(arr)
+            handles.append(h)
+        self._store[key] = stored
+        self._nbytes[key] = nbytes
+        if any(h is not None for h in handles):
+            self._handles[key] = handles
+        self.bytes_used += nbytes
+        self.spills += 1
+        self.bytes_spilled += nbytes
+        return True
+
+    def _evict_lru(self) -> None:
+        key, _ = self._store.popitem(last=False)
+        self._free_frame_handles(key)
+        self.bytes_used -= self._nbytes.pop(key)
+        self.evictions += 1
+
+    def touch(self, key: bytes) -> bool:
+        """LRU-refresh a present key (a device re-eviction of content
+        the tier still holds — no bytes move). Returns presence."""
+        if key not in self._store:
+            return False
+        self._store.move_to_end(key)
+        self.refreshes += 1
+        return True
+
+    def drop(self, key: bytes) -> None:
+        """Forget one entry (explicit invalidation; absent keys no-op)."""
+        if key in self._store:
+            del self._store[key]
+            self._free_frame_handles(key)
+            self.bytes_used -= self._nbytes.pop(key)
+
+    # --- restore side -----------------------------------------------------
+    def lookup(self, keys: Sequence[bytes]) -> List[bytes]:
+        """Longest present prefix of ``keys`` (the host leg of the
+        scheduler's device-then-host admission lookup). Matched entries
+        move to MRU — they are about to be restored, and a concurrent
+        spill's cap eviction must eat colder content first.
+
+        Counters are BLOCK-denominated like the device cache's: every
+        requested key the walk does not serve counts as a miss (keys
+        past the break included — they get prefilled cold all the
+        same), so ``hits / (hits + misses)`` is hit blocks over
+        looked-up blocks, directly comparable to ``block_hit_rate``."""
+        out: List[bytes] = []
+        for k in keys:
+            if k not in self._store:
+                break
+            self._store.move_to_end(k)
+            out.append(k)
+        self.hits += len(out)
+        self.misses += len(keys) - len(out)
+        return out
+
+    def get(self, key: bytes) -> Optional[List[np.ndarray]]:
+        """Frames for ``key`` (LRU-touched), or None. The arrays are
+        TIER-OWNED storage (possibly arena views): callers must copy
+        into fresh staging before any ``device_put`` — on CPU backends
+        the transfer can zero-copy alias the host buffer (swapper.py
+        ``_to_device``), and a later eviction reusing the arena slot
+        would then mutate live device data."""
+        frames = self._store.get(key)
+        if frames is not None:
+            self._store.move_to_end(key)
+        return frames
+
+    def stage_frames(self, entries: Sequence) -> Optional[List[np.ndarray]]:
+        """Fresh per-leaf staging arrays ``[L, N, bs, ...]`` for the
+        (key, block id) ``entries`` of one restore — the layout
+        ``ops.paged_attention.scatter_pool_blocks`` consumes. Stacking
+        COPIES out of tier storage (the alias guard above); returns
+        None when any key is gone (evicted between lookup and restore —
+        the caller degrades to a cold prefill). Staging does NOT touch
+        ``bytes_restored``: the executor credits :meth:`note_restored`
+        only when the restore LANDS, so failed transfers never inflate
+        the stats."""
+        per_key = []
+        for key, _ in entries:
+            frames = self.get(key)
+            if frames is None:
+                return None
+            per_key.append(frames)
+        return [np.stack([frames[i] for frames in per_key], axis=1)
+                for i in range(len(per_key[0]))]
+
+    def note_restored(self, nbytes: int) -> None:
+        """Credit a LANDED restore (the executor's finish-restore
+        success path). Kept separate from :meth:`stage_frames` so a
+        restore that stages but then fails mid-transfer leaves
+        ``bytes_restored`` honest."""
+        self.bytes_restored += int(nbytes)
+
+    # --- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "capacity_bytes": self.capacity_bytes,
+            "bytes_used": self.bytes_used,
+            "entries": len(self._store),
+            "spills": self.spills,
+            "refreshes": self.refreshes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "rejected": self.rejected,
+            "bytes_spilled": self.bytes_spilled,
+            "bytes_restored": self.bytes_restored,
+        }
+
+    def audit(self) -> List[str]:
+        """Host-tier invariant sweep (the auditor's new tier): byte
+        accounting must agree with the store, every entry must have a
+        size, the cap must hold, and arena handles must describe live
+        entries only."""
+        v: List[str] = []
+        if set(self._store) != set(self._nbytes):
+            v.append("host tier store/size-map key mismatch: "
+                     f"store-only {len(set(self._store) - set(self._nbytes))}, "
+                     f"sizes-only {len(set(self._nbytes) - set(self._store))}")
+        total = sum(self._nbytes.values())
+        if total != self.bytes_used:
+            v.append(f"host tier byte accounting leak: bytes_used "
+                     f"{self.bytes_used} != sum of entries {total}")
+        if self.bytes_used > self.capacity_bytes:
+            v.append(f"host tier over capacity: {self.bytes_used} > "
+                     f"{self.capacity_bytes}")
+        stale = set(self._handles) - set(self._store)
+        if stale:
+            v.append(f"host tier arena handles for {len(stale)} evicted "
+                     f"entries (leaked staging)")
+        for key, frames in self._store.items():
+            got = int(sum(int(f.nbytes) for f in frames))
+            if got != self._nbytes.get(key):
+                v.append(f"host tier entry size drift: stored {got} vs "
+                         f"recorded {self._nbytes.get(key)}")
+                break                  # one report is enough to diagnose
+        return v
+
+
+def tier_from_gb(host_cache_gb: float,
+                 staging_mb: int = 0) -> Optional[HostKVTier]:
+    """``serve.host_cache_gb`` knob → tier (None when disabled)."""
+    if not host_cache_gb or host_cache_gb <= 0:
+        return None
+    return HostKVTier(int(host_cache_gb * (1 << 30)),
+                      staging_mb=staging_mb)

@@ -51,6 +51,24 @@ The deterministic seeded :class:`~deepspeed_tpu.inference.faults.
 FaultInjector` drives the chaos suite
 (tests/unit/inference/test_chaos.py) and ``bench.py --serve --chaos``.
 
+TIERED KV (inference/kv_tiering.py, docs/SERVING.md): with a
+``host_tier``, device-LRU eviction stops being the end of a prefix's
+life. The caching pool's eviction hook queues (content key, block id)
+pairs and the scheduler flushes a device→host SPILL before any executor
+call could rewrite the reclaimed frames; admission's prefix lookup then
+walks device-then-host — a host hit claims fresh pool blocks and
+dispatches an async host→device RESTORE (``begin_restore``) whose
+transfer overlaps the decode chunk of the SAME step, and the slot sits
+in a RESTORING state (admitted, blocks held, excluded from decode) until
+the next step boundary finishes the restore and prefills only the
+still-uncached tail. The tier is strictly opportunistic: it never blocks
+allocation (spills/restores are bounded host-RAM copies with their own
+byte-capped LRU), a cleanly failed restore DEGRADES that one request to
+a cold prefill (not a FAILED terminal, co-scheduled streams
+byte-identical — only a scatter that dies mid-flight on the donated
+pools escalates to the unattributed-error blast radius), and greedy
+outputs are exactly the untiered path's.
+
 The scheduler is pure host logic over an EXECUTOR protocol, so its
 admission/recycling/backpressure/growth behavior is unit-tested with a
 fake executor (tests/unit/inference/test_scheduler.py); the real
@@ -85,6 +103,23 @@ Executor protocol (duck-typed)::
         # rest). ``max_steps`` (int or None) caps n: the scheduler sets
         # it to the nearest slot completion while the queue holds work,
         # so chunking can never delay an admission past a free slot
+    spill_blocks(entries: List[Tuple[bytes, int]]) -> None
+        # tiered KV only: copy the device KV frames of the listed block
+        # ids into the host tier under their content keys. Called BEFORE
+        # any executor call that could rewrite the reclaimed frames
+    begin_restore(slot, entries: List[Tuple[bytes, int]]) -> handle|None
+        # tiered KV only: start the async host→device transfer of the
+        # tier frames for ``entries`` (fresh pool blocks the slot
+        # already holds). Returns an opaque handle, or None when the
+        # tier no longer has a key (the scheduler degrades to a cold
+        # prefill). Must NOT touch the pools yet — the transfer overlaps
+        # this step's decode chunk
+    finish_restore(handle) -> bool
+        # tiered KV only: land the staged frames in the pool blocks
+        # (the jitted scatter). False = CLEAN failure, pools untouched
+        # (the scheduler degrades that one request to a cold prefill);
+        # raising means the scatter consumed the DONATED pools and died
+        # — unknown pool state, unattributed-decode-error blast radius
 """
 
 import dataclasses
@@ -198,6 +233,26 @@ class _Slot:
         return self.req is None
 
 
+class _Restore:
+    """Restore-in-flight state for one admitted slot (tiered KV): the
+    executor's transfer handle plus the two possible prefill starts —
+    ``start`` when the staged frames land (prefill only the tail the
+    tiers don't cover), ``dev_start`` when the restore fails (cold
+    prefill of everything past the device-matched prefix; degrade, not
+    FAILED)."""
+
+    __slots__ = ("req", "handle", "entries", "start", "dev_start",
+                 "t_admit")
+
+    def __init__(self, req, handle, entries, start, dev_start, t_admit):
+        self.req = req
+        self.handle = handle
+        self.entries = entries
+        self.start = int(start)
+        self.dev_start = int(dev_start)
+        self.t_admit = t_admit
+
+
 class ContinuousBatchingScheduler:
     """FIFO request queue over ``num_slots`` decode slots + a block pool.
 
@@ -214,7 +269,8 @@ class ContinuousBatchingScheduler:
                  max_preemptions: int = 8,
                  queue_timeout_s: Optional[float] = None,
                  audit_every: int = 64,
-                 fault_injector: Optional[FaultInjector] = None):
+                 fault_injector: Optional[FaultInjector] = None,
+                 host_tier=None):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -238,6 +294,36 @@ class ContinuousBatchingScheduler:
         self.cache_hit_blocks = 0
         self.cache_hit_tokens = 0
         self.cache_prompt_tokens = 0
+        # TIERED KV (inference/kv_tiering.HostKVTier): a host-RAM second
+        # tier behind the device prefix cache. Device-LRU evictions
+        # spill (content key, frame) pairs into it; admission lookups
+        # walk device-then-host, and host hits restore into fresh pool
+        # blocks by async device_put overlapped with this step's decode
+        # chunk. Strictly additive: None = exactly the single-tier
+        # behavior, and the tier can never block allocation.
+        self.host_tier = host_tier
+        if host_tier is not None and not self.prefix_cache:
+            raise ValueError(
+                "host_tier requires prefix_cache=True — the tier is "
+                "keyed by the prefix cache's content hashes")
+        self._restores: Dict[int, _Restore] = {}
+        self._pending_spills: List = []
+        if host_tier is not None:
+            # the caching pool reports each eviction BEFORE the frame
+            # can be rewritten; the pairs queue here and flush as one
+            # spill ahead of the next executor write
+            pool.spill_sink = self._on_device_evict
+        elif getattr(pool, "spill_sink", None) is not None:
+            # a reused pool must not keep feeding a PREVIOUS session's
+            # scheduler (tier-on then tier-off on the same executor)
+            pool.spill_sink = None
+        self.host_restores = 0
+        self.host_hit_blocks = 0
+        self.host_hit_tokens = 0
+        self.host_restore_failures = 0
+        self.host_spill_failures = 0
+        self.last_restore_error: Optional[str] = None
+        self.last_spill_error: Optional[str] = None
         self.tables = SlotBlockTables(num_slots, table_width, pool)
         self.queue: Deque[Request] = deque()
         self.slots = [_Slot() for _ in range(num_slots)]
@@ -299,7 +385,42 @@ class ContinuousBatchingScheduler:
 
     @property
     def busy(self) -> bool:
-        return bool(self.queue) or bool(self.active.any())
+        return (bool(self.queue) or bool(self.active.any())
+                or bool(self._restores))
+
+    @property
+    def restoring(self) -> np.ndarray:
+        """Per-slot restore-in-flight mask, derived from ``_restores``
+        — the pending-restore map is the single source of truth, so the
+        mask can never desync from it."""
+        m = np.zeros(self.num_slots, bool)
+        if self._restores:
+            m[list(self._restores)] = True
+        return m
+
+    # --- tiered KV: spill / restore ------------------------------------------
+    def _on_device_evict(self, key: bytes, bid: int) -> None:
+        """Eviction hook (PrefixCachingBlockPool.spill_sink): the frame
+        behind ``bid`` is about to be handed to a new owner — queue it
+        for a device→host spill. Fires inside ``pool.allocate``, where
+        no device write can happen; the queue is flushed before the
+        next executor call that could touch the frame."""
+        self._pending_spills.append((key, bid))
+
+    def _flush_spills(self) -> None:
+        """Copy queued evicted frames to the host tier. MUST run before
+        any executor call that writes pool blocks (prefill, decode,
+        copy_blocks, finish_restore) — after that the frames belong to
+        their new owners. A spill failure only LOSES cache content
+        (those prefixes go cold); it never fails a request."""
+        if not self._pending_spills:
+            return
+        entries, self._pending_spills = self._pending_spills, []
+        try:
+            self.executor.spill_blocks(entries)
+        except Exception as e:
+            self.host_spill_failures += len(entries)
+            self.last_spill_error = str(e)
 
     def next_arrival(self) -> Optional[float]:
         """Earliest queued arrival_time, for idle waiting."""
@@ -442,6 +563,7 @@ class ContinuousBatchingScheduler:
             if self.reserve_upfront:
                 admit_tokens += req.max_new_tokens
             start, copy_pairs = 0, []
+            host_keys: List[bytes] = []
             if self.prefix_cache:
                 bs = self.pool.block_size
                 keys = block_content_keys(req.prompt, bs, self.pool.salt)
@@ -475,7 +597,76 @@ class ContinuousBatchingScheduler:
                 self.tables.assign(slot_id, admit_tokens)
             self.queue.popleft()
             t_admit = time.time()
-            try:
+            # allocation above may have evicted cached blocks — their
+            # frames must reach the host tier before ANY executor call
+            # can write pool blocks (CoW copy, prefill)
+            self._flush_spills()
+            if self.prefix_cache and self.host_tier is not None \
+                    and cow_src is None and len(matched) < len(keys):
+                # TIERED lookup: where the device index stops, the host
+                # tier continues (same chained keys, so the walk stays
+                # a contiguous prefix). Host hits restore into FRESH
+                # blocks below — private to this slot, so no CoW is
+                # ever needed on them. AFTER admission + spill flush:
+                # the tier's monotonic hit/miss counters see each
+                # request once (a queue-head retry under backpressure
+                # must not re-count), and frames this very allocation
+                # just evicted are already host-hittable.
+                host_keys = self.host_tier.lookup(keys[len(matched):])
+            if host_keys:
+                blocks = self.tables.blocks_of(slot_id)
+                targets = blocks[len(shared):len(shared) + len(host_keys)]
+                entries = list(zip(host_keys, targets))
+                covered = (len(shared) + len(host_keys)) * bs
+                handle = None
+                try:
+                    self.executor.set_slot(slot_id, req)
+                    handle = self.executor.begin_restore(slot_id, entries)
+                except Exception as e:
+                    # a restore that won't even start degrades to a cold
+                    # prefill below — never a request failure
+                    self.last_restore_error = f"begin_restore: {e}"
+                    handle = None
+                if handle is not None:
+                    # RESTORE-IN-FLIGHT: the slot is admitted (blocks
+                    # held, req bound) but sits out this step's decode —
+                    # the host→device transfer dispatched above overlaps
+                    # that chunk, and the next step boundary lands the
+                    # frames and prefills only the uncovered tail
+                    slot.req = req
+                    slot.t_admitted = t_admit
+                    slot.t_first = t_admit
+                    self._restores[slot_id] = _Restore(
+                        req=req, handle=handle, entries=entries,
+                        start=min(covered, len(req.prompt) - 1),
+                        dev_start=start, t_admit=t_admit)
+                    continue
+                self.host_restore_failures += 1
+            first, failed = self._prefill_slot(slot_id, req, start,
+                                               t_admit, bind=True,
+                                               copy_pairs=copy_pairs)
+            if failed is not None:
+                done.append(failed)
+                continue
+            done.extend(self._activate_slot(slot_id, req, first, t_admit))
+        return done
+
+    def _prefill_slot(self, slot_id: int, req: Request, start: int,
+                      t_admit: float, bind: bool = False,
+                      copy_pairs=None):
+        """Run the slot's prefill (tail-only when ``start``) under the
+        PER-REQUEST ISOLATION contract, shared by direct admission and
+        the finish-restore paths: any executor error resolves THIS
+        request FAILED — its blocks release (shared prefix blocks only
+        deref) and the slot is immediately admissible again, so
+        co-scheduled slots never see the fault. No prefix registration:
+        the KV behind a failed prefill is not trustworthy content.
+        ``bind`` runs the admission-path slot binding inside the same
+        isolation envelope (the finish-restore path bound its slot at
+        ``begin_restore`` time). Returns ``(first_token, None)`` on
+        success or ``(None, FAILED Completion)``."""
+        try:
+            if bind:
                 self.executor.set_slot(slot_id, req)
                 if copy_pairs:
                     # device-side CoW duplication BEFORE the slot's first
@@ -483,49 +674,132 @@ class ContinuousBatchingScheduler:
                     # source) — executors serving a prefix-cache scheduler
                     # must implement copy_blocks
                     self.executor.copy_blocks(copy_pairs)
-                if self.fault_injector is not None:
-                    self.fault_injector.before_prefill(
-                        self._step_idx, slot_id, req.rid)
-                first = int(
-                    self.executor.prefill(slot_id, req.prompt,
-                                          self.tables.table[slot_id],
-                                          start)
-                    if start else
-                    self.executor.prefill(slot_id, req.prompt,
-                                          self.tables.table[slot_id]))
+            if self.fault_injector is not None:
+                self.fault_injector.before_prefill(
+                    self._step_idx, slot_id, req.rid)
+            first = int(
+                self.executor.prefill(slot_id, req.prompt,
+                                      self.tables.table[slot_id],
+                                      start)
+                if start else
+                self.executor.prefill(slot_id, req.prompt,
+                                      self.tables.table[slot_id]))
+            return first, None
+        except Exception as e:
+            self.tables.release(slot_id)
+            self._clear_slot(slot_id)
+            return None, self._terminal_queued(
+                req, FAILED, f"executor prefill error: {e}",
+                time.time(), t_admitted=t_admit)
+
+    def _activate_slot(self, slot_id: int, req: Request, first: int,
+                       t_admit: float) -> List[Completion]:
+        """Post-prefill slot bring-up, shared by direct admission and
+        the finish-restore path: bind the slot state, EAGERLY register
+        the prompt's full blocks (requests sharing a prefix that are
+        admitted later THIS STEP — or any step while this slot still
+        decodes — already hit; registration only at completion would
+        miss every concurrent burst), then activate for decode or
+        retire immediately (1-token budgets, eos on the first token)."""
+        slot = self.slots[slot_id]
+        t_first = time.time()
+        slot.req = req
+        slot.seq_len = len(req.prompt)
+        slot.remaining = req.max_new_tokens - 1
+        slot.out = [first]
+        slot.t_admitted = t_admit
+        slot.t_first = t_first
+        self.seq_lens[slot_id] = slot.seq_len
+        self.last_tokens[slot_id] = first
+        self._register_slot_prefix(slot_id)
+        hit_eos = req.eos_id >= 0 and first == req.eos_id
+        if slot.remaining == 0 or hit_eos:
+            return [self._finish(slot_id, t_first)]
+        self.active[slot_id] = True
+        self.steps_left[slot_id] = slot.remaining
+        return []
+
+    def _finish_restores(self, now: float) -> List[Completion]:
+        """Land every restore dispatched on a PREVIOUS step: the staged
+        host→device transfer had that step's decode chunk to hide
+        behind, so finishing here (scatter + tail prefill) is the
+        overlap paying off. A failed restore (transfer error, tier
+        eviction race, injected fault) DEGRADES the request to a cold
+        prefill from its device-matched start — the blocks are already
+        private to the slot, the recompute overwrites whatever the
+        failed transfer left, and co-scheduled streams never notice.
+        Prefill errors keep the admission path's per-request isolation
+        (FAILED, blocks released, slot immediately admissible)."""
+        if not self._restores:
+            return []
+        done: List[Completion] = []
+        fi = self.fault_injector
+        for slot_id in sorted(self._restores):
+            st = self._restores.pop(slot_id)
+            req = st.req
+            self._flush_spills()       # frames must land before scatter
+            ok = False
+            try:
+                if fi is not None:
+                    delay = fi.restore_delay(self._step_idx, req.rid)
+                    if delay > 0:
+                        time.sleep(delay)
+                    fi.before_restore(self._step_idx, slot_id, req.rid)
+                ok = bool(self.executor.finish_restore(st.handle))
+            except RequestFault as e:
+                # attributed PRE-transfer failure (the injector's
+                # stand-in for a refused device_put): pools untouched,
+                # so this one request degrades to a cold prefill
+                self.last_restore_error = str(e)
+                ok = False
             except Exception as e:
-                # PER-REQUEST ISOLATION (mid-prefill): this request
-                # resolves FAILED; its blocks release (shared prefix
-                # blocks only deref) and the slot is immediately
-                # admissible again — co-scheduled slots never see the
-                # fault. No prefix registration: the KV behind a failed
-                # prefill is not trustworthy content.
+                # the jitted scatter consumed the DONATED pools and
+                # died — their state is unknown, exactly the
+                # unattributed-decode-error case: fail this request
+                # and every runnable slot; queued requests keep serving
+                self.last_restore_error = str(e)
+                self.host_restore_failures += 1
+                t_err = time.time()
                 self.tables.release(slot_id)
+                self._clear_slot(slot_id)
                 done.append(self._terminal_queued(
-                    req, FAILED, f"executor prefill error: {e}",
-                    time.time(), t_admitted=t_admit))
-                continue
-            t_first = time.time()
-            slot.req = req
-            slot.seq_len = len(req.prompt)
-            slot.remaining = req.max_new_tokens - 1
-            slot.out = [first]
-            slot.t_admitted = t_admit
-            slot.t_first = t_first
-            self.seq_lens[slot_id] = slot.seq_len
-            self.last_tokens[slot_id] = first
-            # EAGER registration: the prompt's full blocks are indexed the
-            # moment their KV exists, so requests sharing a prefix that
-            # are admitted later THIS STEP (or any step while this slot
-            # still decodes) already hit — registration only at
-            # completion would miss every concurrent burst
-            self._register_slot_prefix(slot_id)
-            hit_eos = req.eos_id >= 0 and first == req.eos_id
-            if slot.remaining == 0 or hit_eos:
-                done.append(self._finish(slot_id, t_first))
+                    req, FAILED, f"executor restore error: {e}", t_err,
+                    t_admitted=st.t_admit))
+                done.extend(self._on_decode_error(
+                    RuntimeError(f"restore scatter failed: {e}"),
+                    np.logical_and(self.active, ~self.stalled), t_err))
+                # the OTHER pending restores would land on those same
+                # unknown-state pools — their shared-prefix KV is just
+                # as suspect, so they join the blast radius instead of
+                # completing with silently corrupt context
+                for s2 in sorted(self._restores):
+                    st2 = self._restores[s2]
+                    self.host_restore_failures += 1
+                    self.tables.release(s2)
+                    self._clear_slot(s2)       # drops the handle
+                    done.append(self._terminal_queued(
+                        st2.req, FAILED,
+                        f"executor restore error: {e}", t_err,
+                        t_admitted=st2.t_admit))
+                break
+            if ok:
+                start = st.start
+                self.host_restores += 1
+                self.host_hit_blocks += len(st.entries)
+                self.host_hit_tokens += st.start - st.dev_start
+                # host-restored tokens skip prefill exactly like device
+                # hits — they count toward the same token hit-rate
+                self.cache_hit_tokens += st.start - st.dev_start
             else:
-                self.active[slot_id] = True
-                self.steps_left[slot_id] = slot.remaining
+                start = st.dev_start
+                self.host_restore_failures += 1
+            first, failed = self._prefill_slot(slot_id, req, start,
+                                               st.t_admit)
+            if failed is not None:
+                done.append(failed)
+                continue
+            done.extend(self._activate_slot(slot_id, req, first,
+                                            st.t_admit))
         return done
 
     # --- completion ----------------------------------------------------------
@@ -584,6 +858,10 @@ class ContinuousBatchingScheduler:
         self.steps_left[slot_id] = 0
         self.seq_lens[slot_id] = 0
         self.last_tokens[slot_id] = 0
+        # a cancelled/timed-out RESTORING slot drops its in-flight
+        # handle here — the staged transfer is simply never landed
+        # (finish_restore not called), so the pools are untouched
+        self._restores.pop(slot_id, None)
 
     # --- on-demand growth / preemption ----------------------------------------
     def _grow(self, slot_ids, horizon: int) -> None:
@@ -690,6 +968,11 @@ class ContinuousBatchingScheduler:
                 self.cancel(rid)
         # cancellation/deadline enforcement point: chunk boundaries only
         done = self._reap(now)
+        # land restores dispatched last step (their transfer overlapped
+        # that step's decode) BEFORE growth/admission: the finished slot
+        # joins this step's decode and its registered prefix is already
+        # hittable by this step's admissions
+        done.extend(self._finish_restores(now))
         chunk = max(1, int(getattr(self.executor, "decode_chunk", 1)))
         # growth FIRST: in-flight slots outrank the queue head for free
         # blocks — admitting ahead of mid-decode grows would convert
@@ -724,6 +1007,14 @@ class ContinuousBatchingScheduler:
         max_steps = None
         if self.queue:
             max_steps = int(self.steps_left[runnable].min())
+        if self._restores:
+            # a dispatched restore lands at the NEXT boundary, so the
+            # chunk length is the restored request's time-to-first-
+            # token: one decode step is all the overlap the transfer
+            # needs (the jitted scatter queues behind the device_put on
+            # the device timeline regardless), while a full chunk would
+            # hold that first token hostage to co-scheduled decode
+            max_steps = 1 if max_steps is None else min(max_steps, 1)
         # on-demand coverage cap: the program must not write KV past the
         # blocks granted this step (partial grows shorten the call; the
         # next step grows again)
@@ -733,6 +1024,9 @@ class ContinuousBatchingScheduler:
             max_steps = feasible
         eff_steps = self.steps_left.copy()
         eff_steps[self.stalled] = 0        # stalled slots must not write
+        # growth allocations above may have evicted cached blocks —
+        # spill their frames before the decode program writes the pool
+        self._flush_spills()
         try:
             if fi is not None:
                 delay = fi.chunk_delay(self._step_idx)
@@ -804,6 +1098,13 @@ class ContinuousBatchingScheduler:
         host sets) — the serving default runs it every
         ``audit_every`` chunks; chaos tests run it every chunk."""
         v = self.tables.audit()
+        for s in self._restores:
+            if self.active[s] or self.stalled[s]:
+                v.append(f"slot {s} both restoring and active/stalled")
+            if self.slots[s].req is None:
+                v.append(f"slot {s} restoring with no bound request")
+        if self.host_tier is not None:
+            v.extend(f"host tier: {x}" for x in self.host_tier.audit())
         for s, slot in enumerate(self.slots):
             if slot.req is None:
                 if self.tables.num_blocks_of(s):
@@ -856,7 +1157,7 @@ class ContinuousBatchingScheduler:
         while self.busy:
             done = self.step()
             yield from done
-            if not self.active.any() and self.queue:
+            if not self.active.any() and not self._restores and self.queue:
                 nxt = self.next_arrival()
                 if nxt is not None:
                     wait = nxt - time.time()
@@ -878,9 +1179,19 @@ class ContinuousBatchingScheduler:
         acceptance pins). Block hit-rate is over full prompt blocks
         looked up at admission; token hit-rate is prompt tokens whose
         prefill was skipped over all prompt tokens (the CoW recompute
-        token counts as a miss — it IS re-prefilled)."""
+        token counts as a miss — it IS re-prefilled). ``hit_blocks`` /
+        ``block_hit_rate`` stay DEVICE-index hits; host-tier restores
+        report separately (``host_*``) but their skipped tokens do fold
+        into ``token_hit_rate`` — both tiers skip the same prefill.
+        All counters are monotonic over the scheduler's life; eviction
+        visibility: ``device_evictions`` (device LRU reclaims — spilled
+        when a tier listens, gone otherwise), ``host_spills`` /
+        ``host_evictions`` / bytes from the tier itself."""
         lb, hb = self.cache_lookup_blocks, self.cache_hit_blocks
         tt, ht = self.cache_prompt_tokens, self.cache_hit_tokens
+        tier = self.host_tier
+        ts = tier.stats() if tier is not None else {}
+        h_hit, h_miss = ts.get("hits", 0), ts.get("misses", 0)
         return {
             "enabled": self.prefix_cache,
             "lookup_blocks": lb,
@@ -890,7 +1201,23 @@ class ContinuousBatchingScheduler:
             "hit_tokens": ht,
             "token_hit_rate": round(ht / tt, 4) if tt else 0.0,
             "evictions": getattr(self.pool, "evictions", 0),
+            "device_evictions": getattr(self.pool, "evictions", 0),
             "cached_blocks": getattr(self.pool, "num_cached", 0),
+            # --- host tier (inference/kv_tiering.py; zeros when off) ---
+            "host_tier_enabled": tier is not None,
+            "host_spills": ts.get("spills", 0),
+            "host_hits": self.host_hit_blocks,
+            "host_hit_tokens": self.host_hit_tokens,
+            "host_restores": self.host_restores,
+            "host_lookup_hit_rate": (round(h_hit / (h_hit + h_miss), 4)
+                                     if h_hit + h_miss else 0.0),
+            "host_evictions": ts.get("evictions", 0),
+            "host_restore_failures": self.host_restore_failures,
+            "host_spill_failures": self.host_spill_failures,
+            "host_bytes_spilled": ts.get("bytes_spilled", 0),
+            "host_bytes_restored": ts.get("bytes_restored", 0),
+            "host_bytes_used": ts.get("bytes_used", 0),
+            "host_entries": ts.get("entries", 0),
         }
 
 

@@ -122,6 +122,31 @@ def copy_pool_blocks(pools, src_ids: jnp.ndarray, dst_ids: jnp.ndarray):
         lambda a: a.at[:, dst_ids].set(a[:, src_ids]), pools)
 
 
+def gather_pool_blocks(pools, ids: jnp.ndarray):
+    """Extract whole pool blocks across every layer — the device side of
+    a host-tier SPILL (inference/kv_tiering.py): before an evicted
+    block's frame is rewritten by its new owner, this op pulls its KV
+    out of the pool so the executor can park it in host RAM. ``pools``
+    is any layer-stacked pool pytree ([L, num_blocks, ...] leaves — the
+    dense (k, v) pair or the int8 4-tuple with its scale pools); ``ids``
+    is int32 [N]. Returns the same pytree with [L, N, ...] leaves. A
+    pure read: the pool must SURVIVE the spill, so the jit wrapper
+    (engine.PagedServeExecutor) deliberately does not donate it."""
+    return jax.tree_util.tree_map(lambda a: a[:, ids], pools)
+
+
+def scatter_pool_blocks(pools, ids: jnp.ndarray, frames):
+    """Write previously spilled frames back into pool blocks — the
+    device side of a host-tier RESTORE: ``frames`` ([L, N, ...] leaves,
+    the :func:`gather_pool_blocks` layout, device-put from host staging)
+    land in the freshly claimed blocks ``ids`` (int32 [N]) across every
+    layer/pool array. Restored blocks are then byte-identical to the
+    frames the device LRU evicted, so the paged kernels read them
+    exactly as if the prefix had never left HBM."""
+    return jax.tree_util.tree_map(
+        lambda a, f: a.at[:, ids].set(f), pools, frames)
+
+
 def paged_gather(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
     """[nb, bs, ...] pool × [B, W] table → [B, W*bs, ...] per-slot view.
 

@@ -10,6 +10,12 @@ tiny Llama config:
   both attention arms,
 - a PREFILL bucket (``_build_prefill_fn(PROMPT_BUCKET)``),
 - the prefix-cache ``copy_pool_blocks`` program,
+- the tiered-KV spill/restore programs (``gather_pool_blocks`` /
+  ``scatter_pool_blocks``) over BOTH pool layouts (dense 2-tuple and
+  int8 4-tuple) — the async restore path in particular must stay free
+  of host-sync/callback primitives (the device_put happens OUTSIDE the
+  jit, at begin_restore; a device_put inside the scatter would
+  serialize the transfer the tier exists to overlap),
 
 and fails on:
 
@@ -138,6 +144,37 @@ def _abstract_serving_pieces(arm: str):
             copy_jit, copy_avals)
 
 
+def _tiering_pieces():
+    """[(name, jit_fn, avals)] for the tiered-KV spill/restore entry
+    points over dense and int8 pool layouts — arm-independent (no
+    attention in them), traced once alongside the reference arm like
+    copy_pool_blocks. Mirrors the engine's jit wrappers: spill is a
+    pure gather (nothing donated — the pool survives), restore donates
+    the pools exactly like decode/copy."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.paged_attention import (
+        gather_pool_blocks, init_paged_pool, scatter_pool_blocks,
+    )
+
+    sds = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    out = []
+    for tag, int8 in (("dense", False), ("int8", True)):
+        pools = jax.eval_shape(
+            lambda int8=int8: init_paged_pool(
+                2, _NUM_BLOCKS, _BLOCK, 2, 8, jnp.float32, int8=int8))
+        frames = jax.eval_shape(gather_pool_blocks, pools, sds((2,), i32))
+        spill_jit = jax.jit(gather_pool_blocks)
+        restore_jit = jax.jit(scatter_pool_blocks, donate_argnums=(0,))
+        out.append((f"spill_blocks/{tag}", spill_jit,
+                    (pools, sds((2,), i32))))
+        out.append((f"restore_blocks/{tag}", restore_jit,
+                    (pools, sds((2,), i32), frames)))
+    return out
+
+
 def _report(name: str, fn, avals) -> EntryReport:
     import jax
 
@@ -187,6 +224,8 @@ def trace_entry_points(arms: Optional[List[str]] = None
         if arm == "reference":
             reports["copy_pool_blocks"] = _report(
                 "copy_pool_blocks", copy_jit, copy_avals)
+            for name, fn, avals in _tiering_pieces():
+                reports[name] = _report(name, fn, avals)
     return reports
 
 

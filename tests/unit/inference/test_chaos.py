@@ -380,6 +380,68 @@ def test_chaos_faults_with_prefix_cache_keep_index_consistent():
     assert_quiescent(sched)
 
 
+# --- tiered KV: host-transfer faults -----------------------------------------
+
+def test_chaos_restore_fault_degrades_one_stream_only():
+    """Tiered-KV host-transfer fault site (docs/SERVING.md): a failed
+    restore (the injected device_put failure) DEGRADES its request to a
+    cold prefill — still COMPLETED with the exact fault-free stream —
+    while a co-scheduled stream restoring its own prefix at the same
+    time is byte-identical and the auditor (every chunk) plus the host
+    tier's own audit stay clean with the pool fully free."""
+    from deepspeed_tpu.inference.kv_tiering import HostKVTier
+    from tests.unit.inference.test_kv_tiering import TieredFakeExecutor
+
+    shared = np.arange(1, 9)                        # 2 full blocks
+
+    def tiered_sched(fi=None, tier_bytes=1 << 20):
+        tier = HostKVTier(tier_bytes)
+        ex = TieredFakeExecutor(tier)
+        pool = PrefixCachingBlockPool(11, 4)
+        sched = ContinuousBatchingScheduler(
+            ex, 2, pool, 8, prefix_cache=True, host_tier=tier,
+            audit_every=1, fault_injector=fi)
+        return sched, ex, pool
+
+    def run(fi):
+        sched, ex, pool = tiered_sched(fi)
+        all_comps = []
+        # warm the prefix, flood the pool so it spills to the tier
+        sched.submit(Request(rid=1, prompt=np.concatenate([shared, [91]]),
+                             max_new_tokens=4))
+        all_comps += drain(sched)
+        for i in range(3):
+            sched.submit(Request(rid=10 + i,
+                                 prompt=np.arange(100 + 20 * i,
+                                                  120 + 20 * i),
+                                 max_new_tokens=4))
+        all_comps += drain(sched)
+        # two same-prefix readmissions race through the restore path —
+        # rid 2 is the fault victim, rid 3 must be untouched
+        sched.submit(Request(rid=2, prompt=np.concatenate([shared,
+                                                           [81, 82]]),
+                             max_new_tokens=6))
+        sched.submit(Request(rid=3, prompt=np.concatenate([shared, [71]]),
+                             max_new_tokens=6))
+        all_comps += drain(sched)
+        return sched, by_rid(all_comps)
+
+    _, ref = run(None)
+    fi = FaultInjector([FaultSpec(site="restore", rid=2,
+                                  message="injected device_put failure"),
+                        FaultSpec(site="restore", rid=3,
+                                  seconds=0.001)])
+    sched, comps = run(fi)
+    fired = {e.get("kind") for e in fi.log if e["site"] == "restore"}
+    assert fired == {"fail", "slow"}                # both variants hit
+    assert sched.host_restore_failures >= 1
+    for rid in (1, 2, 3, 10, 11, 12):
+        assert comps[rid].status == COMPLETED
+        np.testing.assert_array_equal(comps[rid].tokens, ref[rid].tokens)
+    assert not sched.host_tier.audit()
+    assert_quiescent(sched)
+
+
 # --- auditor fails fast on real corruption -----------------------------------
 
 def test_chaos_auditor_detects_seeded_corruption():

@@ -1,0 +1,630 @@
+"""Tiered KV cache: host-RAM prefix spillover (inference/kv_tiering.py).
+
+Three layers, mirroring the prefix-cache suite's structure:
+
+- :class:`HostKVTier` accounting in isolation (byte cap, LRU, staging
+  layout, alias-guard copies, audit) — no jax, no scheduler;
+- the scheduler's spill/restore LIFECYCLE over a fake executor backed
+  by a real tier: spill-before-rewrite ordering, restore-in-flight
+  admission that overlaps decode, degrade-to-cold-prefill on every
+  restore failure mode, cancel mid-restore, stats;
+- the real compiled serving path: greedy streams byte-identical across
+  tier-on / tier-off / ``generate()`` on an eviction-forcing trace,
+  restore-fault injection, the ``serve.host_cache_gb`` knob.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+from deepspeed_tpu.inference.kv_pool import PrefixCachingBlockPool
+from deepspeed_tpu.inference.kv_tiering import HostKVTier, tier_from_gb
+from deepspeed_tpu.inference.scheduler import (
+    CANCELLED, COMPLETED, FAILED, ContinuousBatchingScheduler, Request,
+)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+from tests.unit.inference.test_scheduler import drain
+from tests.unit.inference.test_prefix_cache import PrefixFakeExecutor
+
+
+def frame(seed, shape=(2, 4, 3), dtype=np.float32):
+    return np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+
+
+# --- HostKVTier accounting ---------------------------------------------------
+
+def test_tier_put_get_lookup_and_bytes():
+    t = HostKVTier(1 << 20)
+    f0, f1 = frame(0), frame(1)
+    assert t.put(b"a", [f0, f1])
+    assert b"a" in t and len(t) == 1
+    assert t.bytes_used == f0.nbytes + f1.nbytes
+    got = t.get(b"a")
+    np.testing.assert_array_equal(got[0], f0)
+    np.testing.assert_array_equal(got[1], f1)
+    assert t.lookup([b"a", b"b"]) == [b"a"]      # contiguous prefix only
+    assert t.hits == 1 and t.misses == 1
+    # BLOCK-denominated misses: an all-miss walk charges every
+    # requested key (hits/(hits+misses) comparable to block_hit_rate)
+    assert t.lookup([b"x", b"y", b"z"]) == []
+    assert t.hits == 1 and t.misses == 4
+    assert not t.audit()
+
+
+def test_tier_put_copies_caller_buffers():
+    t = HostKVTier(1 << 20)
+    src = frame(0)
+    t.put(b"a", [src])
+    src[:] = -1.0                                # caller mutates after spill
+    assert float(t.get(b"a")[0].max()) != -1.0
+
+
+def test_tier_byte_cap_evicts_lru_and_declines_oversize():
+    one = frame(0, shape=(4, 4)).nbytes
+    t = HostKVTier(3 * one)
+    for i, k in enumerate([b"a", b"b", b"c"]):
+        t.put(k, [frame(i, shape=(4, 4))])
+    t.lookup([b"a"])                             # a → MRU
+    t.put(b"d", [frame(9, shape=(4, 4))])        # evicts b (coldest)
+    assert b"b" not in t and b"a" in t and t.evictions == 1
+    assert t.bytes_used <= t.capacity_bytes
+    # a frame set larger than the WHOLE cap is declined, nothing evicted
+    before = len(t)
+    assert not t.put(b"x", [frame(5, shape=(64, 64))])
+    assert t.rejected == 1 and len(t) == before
+    assert not t.audit()
+
+
+def test_tier_refresh_does_not_double_count():
+    t = HostKVTier(1 << 20)
+    t.put(b"a", [frame(0)])
+    used = t.bytes_used
+    assert t.put(b"a", [frame(1)])               # refresh: no bytes move
+    assert t.bytes_used == used and t.refreshes == 1 and t.spills == 1
+    np.testing.assert_array_equal(t.get(b"a")[0], frame(0))
+
+
+def test_tier_stage_frames_layout_and_alias_guard():
+    """stage_frames returns [L, N, bs, ...] staging (the
+    scatter_pool_blocks layout) that is a COPY — a later tier eviction
+    reusing the storage must never reach staged data (the swapper.py
+    CPU zero-copy discipline)."""
+    t = HostKVTier(1 << 20)
+    fa = [frame(0), frame(10)]
+    fb = [frame(1), frame(11)]
+    t.put(b"a", fa)
+    t.put(b"b", fb)
+    staged = t.stage_frames([(b"a", 5), (b"b", 7)])
+    assert [s.shape for s in staged] == [(2, 2, 4, 3), (2, 2, 4, 3)]
+    np.testing.assert_array_equal(staged[0][:, 0], fa[0])
+    np.testing.assert_array_equal(staged[0][:, 1], fb[0])
+    np.testing.assert_array_equal(staged[1][:, 1], fb[1])
+    t.get(b"a")[0][:] = -99.0                    # mutate tier storage
+    assert float(staged[0][:, 0].max()) != -99.0
+    # staging alone must NOT count as restored bytes — only a LANDED
+    # restore does (a stage-then-fail path would inflate the stats)
+    assert t.bytes_restored == 0
+    t.note_restored(sum(s.nbytes for s in staged))
+    assert t.bytes_restored == sum(s.nbytes for s in staged)
+    # a key evicted between lookup and restore → None (degrade signal)
+    assert t.stage_frames([(b"a", 5), (b"zzz", 7)]) is None
+
+
+def test_tier_arena_staging_roundtrip_and_release():
+    """staging_mb > 0: frames live in the contiguous arena (stable host
+    addresses, the swapper idiom) and eviction releases their slots for
+    reuse instead of leaking the arena."""
+    t = HostKVTier(1 << 16, staging_mb=1)
+    for i in range(4):
+        t.put(b"k%d" % i, [frame(i, shape=(16, 16))])
+    for i in range(4):
+        np.testing.assert_array_equal(t.get(b"k%d" % i)[0],
+                                      frame(i, shape=(16, 16)))
+    free0 = t._arena.total_free
+    t.drop(b"k0")
+    assert t._arena.total_free > free0           # slot actually released
+    assert not t.audit()
+    # churn far past the cap: arena slots recycle, accounting stays clean
+    for i in range(10, 40):
+        t.put(b"k%d" % i, [frame(i, shape=(16, 16))])
+    assert t.bytes_used <= t.capacity_bytes and not t.audit()
+
+
+def test_tier_audit_catches_corruption():
+    t = HostKVTier(1 << 20)
+    t.put(b"a", [frame(0)])
+    t.bytes_used += 7
+    assert any("byte accounting" in v for v in t.audit())
+
+
+def test_tier_from_gb_knob():
+    assert tier_from_gb(0) is None and tier_from_gb(0.0) is None
+    t = tier_from_gb(0.5)
+    assert t.capacity_bytes == 1 << 29
+
+
+# --- scheduler lifecycle over a fake executor --------------------------------
+
+class TieredFakeExecutor(PrefixFakeExecutor):
+    """PrefixFakeExecutor speaking the tiered-KV protocol extensions
+    against a REAL HostKVTier: spilled frames are fake content-addressed
+    payloads (derived from the key), restores stage through the tier
+    exactly like the engine. ``fail_restores`` makes finish_restore
+    report failure (the degrade path); ``calls`` records the executor
+    call ORDER so tests can pin spill-before-write."""
+
+    def __init__(self, tier):
+        super().__init__()
+        self.tier = tier
+        self.calls = []
+        self.restores = []
+        self.fail_restores = 0
+
+    def prefill(self, slot, prompt, block_row, start=0):
+        self.calls.append(("prefill", slot, int(start)))
+        return super().prefill(slot, prompt, block_row, start)
+
+    def decode(self, *a, **kw):
+        self.calls.append(("decode",))
+        return super().decode(*a, **kw)
+
+    def spill_blocks(self, entries):
+        self.calls.append(("spill", [b for _, b in entries]))
+        for key, _ in entries:
+            if not self.tier.touch(key):
+                self.tier.put(key, [np.frombuffer(key, np.uint8).copy()])
+
+    def begin_restore(self, slot, entries):
+        self.calls.append(("begin_restore", slot))
+        staged = self.tier.stage_frames(entries)
+        if staged is None:
+            return None
+        return ("handle", slot, list(entries), staged)
+
+    def finish_restore(self, handle):
+        self.calls.append(("finish_restore", handle[1]))
+        if self.fail_restores > 0:
+            self.fail_restores -= 1
+            return False
+        self.restores.append(handle[2])
+        self.tier.note_restored(sum(int(s.nbytes) for s in handle[3]))
+        return True
+
+
+def make_tsched(num_slots=2, num_blocks=11, block_size=4, width=8,
+                tier_bytes=1 << 20, **kw):
+    """tier_bytes=0 builds the TIER-LESS twin of the same scheduler —
+    the byte-identity reference for every degrade/parity assertion."""
+    tier = HostKVTier(tier_bytes) if tier_bytes else None
+    ex = TieredFakeExecutor(tier)
+    pool = PrefixCachingBlockPool(num_blocks, block_size)
+    sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
+                                        prefix_cache=True, host_tier=tier,
+                                        audit_every=1, **kw)
+    return sched, ex, pool, tier
+
+
+def preq(rid, prompt, gen=3, **kw):
+    return Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                   max_new_tokens=gen, **kw)
+
+
+def run_tier_trace(sched, shared, junk_count=3):
+    """Warm a 2-block prefix, flood the pool so its blocks evict (and
+    spill), then readmit the same prefix — the restore scenario."""
+    sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched)
+    for i in range(junk_count):
+        sched.submit(preq(10 + i, np.arange(100 + 20 * i, 120 + 20 * i),
+                          gen=4))
+    drain(sched)
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=4))
+    return drain(sched)
+
+
+def test_host_tier_requires_prefix_cache():
+    from deepspeed_tpu.inference.kv_pool import BlockPool
+
+    with pytest.raises(ValueError, match="host_tier requires"):
+        ContinuousBatchingScheduler(
+            TieredFakeExecutor(HostKVTier(1 << 20)), 2,
+            BlockPool(9, 4), 6, prefix_cache=False,
+            host_tier=HostKVTier(1 << 20))
+
+
+def test_every_eviction_spills_before_any_rewrite():
+    """Every device eviction reaches the spill flush (none are lost
+    between allocation and the next executor write), and flushes always
+    precede the write calls of their step."""
+    sched, ex, pool, tier = make_tsched()
+    shared = np.arange(1, 9)                     # 2 full blocks
+    run_tier_trace(sched, shared)
+    assert pool.evictions > 0
+    spilled = sum(len(c[1]) for c in ex.calls if c[0] == "spill")
+    assert spilled == pool.evictions
+    assert tier.spills + tier.refreshes == pool.evictions
+    assert not sched._pending_spills             # nothing stranded
+    sched.audit(context="post-trace")
+
+
+def test_restore_admission_skips_prefill_and_overlaps_decode():
+    """The readmitted prefix restores from the host tier: prefill starts
+    at the restored boundary (host tokens skipped), and the restore
+    lands one step AFTER begin (the decode-overlap window)."""
+    sched, ex, pool, tier = make_tsched()
+    shared = np.arange(1, 9)
+    comps = {c.rid: c for c in run_tier_trace(sched, shared)}
+    assert comps[2].status == COMPLETED
+    # >= because total-stall preemption under this tight pool ALSO
+    # restores: a preempted junk request's readmission host-hits its own
+    # spilled prefix — exactly the warm-restart the tier promises
+    assert sched.host_restores >= 1
+    assert sched.host_hit_blocks >= 2 and sched.host_hit_tokens >= 8
+    begin = next(i for i, c in enumerate(ex.calls)
+                 if c[0] == "begin_restore")
+    finish = next(i for i, c in enumerate(ex.calls)
+                  if c[0] == "finish_restore")
+    assert begin < finish
+    # rid 2's prefill came after the restore landed, at start=8
+    pf = [c for c in ex.calls if c[0] == "prefill"][-1]
+    assert pf[2] == 8
+    # tokens identical to a tier-less run of the same trace
+    sched2, ex2, _, _ = make_tsched(tier_bytes=0)
+    ref = {c.rid: c for c in run_tier_trace(sched2, shared)}
+    np.testing.assert_array_equal(comps[2].tokens, ref[2].tokens)
+    sched.audit(context="post-trace")
+
+
+def test_restore_failure_degrades_to_cold_prefill():
+    """finish_restore reporting failure must cost ONLY a cold prefill:
+    same terminal status, byte-identical tokens, failure counted."""
+    sched, ex, pool, tier = make_tsched()
+    shared = np.arange(1, 9)
+    sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched)
+    for i in range(3):
+        sched.submit(preq(10 + i, np.arange(100 + 20 * i, 120 + 20 * i),
+                          gen=4))
+    drain(sched)
+    fails_before = sched.host_restore_failures
+    ex.fail_restores = 10 ** 6                   # every restore from here
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=4))
+    comps = {c.rid: c for c in drain(sched)}
+    assert comps[2].status == COMPLETED
+    assert sched.host_restore_failures > fails_before
+    pf = [c for c in ex.calls if c[0] == "prefill"][-1]
+    assert pf[2] < 8                             # cold: device start only
+    sched2, _, _, _ = make_tsched(tier_bytes=0)
+    ref = {c.rid: c for c in run_tier_trace(sched2, shared)}
+    np.testing.assert_array_equal(comps[2].tokens, ref[2].tokens)
+    sched.audit(context="post-trace")
+
+
+def test_restore_scatter_exception_fails_runnable_slots():
+    """finish_restore RAISING (not returning False) means the jitted
+    scatter consumed the donated pools and died — unknown pool state,
+    so the scheduler must apply the unattributed-decode-error blast
+    radius: the restoring request, every runnable slot AND every other
+    pending restore (their shared-prefix KV lives in the same suspect
+    pools) FAIL; queued requests still serve, the pool drains free."""
+    sched, ex, pool, tier = make_tsched(num_slots=3, num_blocks=27)
+    shared = np.arange(1, 9)
+    sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched)
+    for i in range(4):                 # 28-token junk floods the pool
+        sched.submit(preq(10 + i,      # until the shared blocks spill
+                          np.arange(100 + 40 * i, 128 + 40 * i), gen=4))
+    drain(sched)
+
+    def exploding_finish(handle):
+        raise RuntimeError("transfer error mid-scatter")
+
+    ex.finish_restore = exploding_finish
+    # a decoding victim + TWO same-prefix restores in the same step,
+    # plus a queued request that must still be served afterwards
+    sched.submit(preq(30, np.arange(200, 215), gen=8))
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=4))
+    sched.submit(preq(3, np.concatenate([shared, [71]]), gen=4))
+    sched.submit(preq(31, np.arange(300, 312), gen=3))
+    comps = {c.rid: c for c in drain(sched)}
+    assert comps[2].status == FAILED
+    assert "restore" in comps[2].error
+    assert comps[30].status == FAILED            # runnable co-victim
+    assert comps[3].status == FAILED             # sibling restore: same
+    assert "restore" in comps[3].error           # suspect pools
+    assert comps[31].status == COMPLETED         # queued: still served
+    assert sched.host_restore_failures >= 2
+    assert tier.bytes_restored == 0              # nothing LANDED
+    assert pool.num_allocated == 0               # pool fully drained
+    assert pool.num_free == pool.num_blocks - 1
+    assert not {b: r for b, r in pool._refs.items() if r != 0}
+    sched.audit(context="post-drain")
+
+
+def test_restore_tier_eviction_race_degrades():
+    """begin_restore finding the key gone (tier evicted it between
+    lookup and staging) returns None — the admission degrades."""
+    sched, ex, pool, tier = make_tsched()
+    shared = np.arange(1, 9)
+
+    orig = ex.begin_restore
+
+    def racing_begin(slot, entries):
+        for key, _ in entries:
+            tier.drop(key)
+        return orig(slot, entries)
+
+    ex.begin_restore = racing_begin
+    comps = {c.rid: c for c in run_tier_trace(sched, shared)}
+    assert comps[2].status == COMPLETED
+    assert sched.host_restore_failures >= 1
+    sched.audit(context="post-trace")
+
+
+def test_cancel_mid_restore_releases_everything():
+    """A request cancelled while its restore is in flight resolves
+    CANCELLED, its blocks release, and the staged transfer is never
+    landed (no finish_restore for that slot)."""
+    sched, ex, pool, tier = make_tsched()
+    shared = np.arange(1, 9)
+    sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched)
+    for i in range(3):
+        sched.submit(preq(10 + i, np.arange(100 + 20 * i, 120 + 20 * i),
+                          gen=4))
+    drain(sched)
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=6))
+    sched.step()                                 # admits into restore
+    assert sched.restoring.any()
+    sched.cancel(2)
+    n_finish = sum(c[0] == "finish_restore" for c in ex.calls)
+    comps = {c.rid: c for c in drain(sched)}
+    assert comps[2].status == CANCELLED
+    # rid 2's staged transfer is never landed (earlier finishes — junk
+    # preemption restores — are someone else's)
+    assert sum(c[0] == "finish_restore" for c in ex.calls) == n_finish
+    assert pool.num_allocated == 0
+    sched.audit(context="post-cancel")
+
+
+def test_injected_restore_fault_degrades_one_request_only():
+    """FaultInjector 'restore' site: the victim degrades to a cold
+    prefill (still COMPLETED, byte-identical); a co-scheduled stream is
+    untouched; slow-restore specs only add latency."""
+    fi = FaultInjector([
+        FaultSpec(site="restore", rid=2, message="injected device_put"),
+        FaultSpec(site="restore", rid=3, seconds=0.001),
+    ])
+    sched, ex, pool, tier = make_tsched(fault_injector=fi)
+    shared = np.arange(1, 9)
+    sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched)
+    for i in range(3):
+        sched.submit(preq(10 + i, np.arange(100 + 20 * i, 120 + 20 * i),
+                          gen=4))
+    drain(sched)
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=4))
+    sched.submit(preq(3, np.concatenate([shared, [71]]), gen=4))
+    comps = {c.rid: c for c in drain(sched)}
+    assert comps[2].status == COMPLETED and comps[3].status == COMPLETED
+    assert sched.host_restore_failures == 1
+    kinds = {e.get("kind") for e in fi.log if e["site"] == "restore"}
+    assert kinds == {"fail", "slow"}
+    sched2, _, _, _ = make_tsched(tier_bytes=0)
+    sched2.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched2)
+    for i in range(3):
+        sched2.submit(preq(10 + i, np.arange(100 + 20 * i, 120 + 20 * i),
+                           gen=4))
+    drain(sched2)
+    sched2.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=4))
+    sched2.submit(preq(3, np.concatenate([shared, [71]]), gen=4))
+    ref = {c.rid: c for c in drain(sched2)}
+    for rid in (2, 3):
+        np.testing.assert_array_equal(comps[rid].tokens, ref[rid].tokens)
+    sched.audit(context="post-chaos")
+
+
+def test_tier_never_blocks_allocation():
+    """Backpressure-free contract: with the tier on, admission admits
+    exactly what the tier-less scheduler admits under the same pool
+    pressure (host state can never read as device pressure)."""
+    def admitted_after_one_step(tier_bytes):
+        sched, ex, pool, tier = make_tsched(num_blocks=9,
+                                            tier_bytes=tier_bytes)
+        for i in range(4):
+            sched.submit(preq(i, np.arange(1 + 10 * i, 9 + 10 * i),
+                              gen=8))
+        sched.step()
+        return int((~np.array([s.free for s in sched.slots])).sum())
+
+    assert admitted_after_one_step(1 << 20) == admitted_after_one_step(0)
+
+
+def test_stats_surface_tier_counters():
+    sched, ex, pool, tier = make_tsched()
+    run_tier_trace(sched, np.arange(1, 9))
+    s = sched.prefix_cache_stats()
+    assert s["host_tier_enabled"] and s["device_evictions"] > 0
+    assert s["host_spills"] > 0 and s["host_hits"] >= 2
+    assert s["host_restores"] >= 1 and s["host_restore_failures"] == 0
+    assert s["host_bytes_spilled"] > 0 and s["host_bytes_restored"] > 0
+    assert s["host_lookup_hit_rate"] > 0
+    # tier-less schedulers report the same keys, zeroed
+    sched2, _, _, _ = make_tsched(tier_bytes=0)
+    s2 = sched2.prefix_cache_stats()
+    assert not s2["host_tier_enabled"] and s2["host_spills"] == 0
+
+
+def test_shutdown_with_restore_in_flight():
+    sched, ex, pool, tier = make_tsched()
+    shared = np.arange(1, 9)
+    sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
+    drain(sched)
+    for i in range(3):
+        sched.submit(preq(10 + i, np.arange(100 + 20 * i, 120 + 20 * i),
+                          gen=4))
+    drain(sched)
+    sched.submit(preq(2, np.concatenate([shared, [81, 82]]), gen=6))
+    sched.step()
+    assert sched.restoring.any()
+    comps = sched.shutdown()
+    assert {c.status for c in comps} == {CANCELLED}
+    assert pool.num_allocated == 0 and not sched.busy
+
+
+# --- real compiled serving path ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def tier_engine():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params,
+        model_config=cfg)
+
+
+def eviction_trace():
+    """Persona trace sized so the persona's device blocks EVICT between
+    its uses (the tier's reason to exist): one warm-up, junk flood,
+    three re-uses."""
+    rng = np.random.default_rng(0)
+    persona = rng.integers(1, 256, 16)           # 4 full blocks at bs=4
+    reqs = [Request(rid=0, prompt=np.concatenate([persona,
+                                                  rng.integers(1, 256, 3)]),
+                    max_new_tokens=5)]
+    for i in range(4):
+        reqs.append(Request(rid=10 + i, prompt=rng.integers(1, 256, 18),
+                            max_new_tokens=5))
+    for i in range(1, 4):
+        reqs.append(Request(rid=i,
+                            prompt=np.concatenate(
+                                [persona, rng.integers(1, 256, 3)]),
+                            max_new_tokens=5))
+    return reqs
+
+
+def test_serve_tiered_greedy_identical_and_restores(tier_engine,
+                                                    serve_attn_kernel):
+    """Acceptance pin: greedy outputs byte-identical across tier-on /
+    tier-off / generate() on a trace that actually exercises
+    spill-then-restore, on both attention arms."""
+    kw = dict(num_slots=2, block_size=4, num_blocks=13,
+              attn_kernel=serve_attn_kernel)
+    tier_engine.reset_prefix_cache()
+    off = {c.rid: c.tokens for c in tier_engine.serve(eviction_trace(),
+                                                      **kw)}
+    tier_engine.reset_prefix_cache()
+    on = {c.rid: c.tokens
+          for c in tier_engine.serve(eviction_trace(),
+                                     host_cache_gb=0.01, **kw)}
+    stats = tier_engine.last_serve_scheduler.prefix_cache_stats()
+    assert stats["host_spills"] > 0, "trace never spilled — not tiered"
+    assert stats["host_restores"] > 0, "trace never restored"
+    assert sorted(on) == sorted(off)
+    for rid in off:
+        np.testing.assert_array_equal(on[rid], off[rid])
+    for c in tier_engine.serve(eviction_trace(), host_cache_gb=0.01,
+                               **kw):
+        ref = np.asarray(tier_engine.generate(
+            jnp.asarray(c.prompt)[None],
+            max_new_tokens=len(c.tokens)))[0]
+        np.testing.assert_array_equal(np.concatenate([c.prompt, c.tokens]),
+                                      ref)
+
+
+def test_serve_tiered_restore_fault_real_engine(tier_engine):
+    """Injected restore failure on the compiled path: the victim still
+    COMPLETES with byte-identical greedy tokens (cold prefill), the
+    auditor stays clean (audit_every=1)."""
+    kw = dict(num_slots=2, block_size=4, num_blocks=13,
+              attn_kernel="reference", audit_every=1)
+    tier_engine.reset_prefix_cache()
+    base = {c.rid: c.tokens
+            for c in tier_engine.serve(eviction_trace(),
+                                       host_cache_gb=0.01, **kw)}
+    tier_engine.reset_prefix_cache()
+    fi = FaultInjector([FaultSpec(site="restore", rid=1,
+                                  message="injected device_put failure")])
+    comps = {c.rid: c
+             for c in tier_engine.serve(eviction_trace(),
+                                        host_cache_gb=0.01,
+                                        fault_injector=fi, **kw)}
+    sched = tier_engine.last_serve_scheduler
+    assert comps[1].status == COMPLETED
+    assert sched.host_restore_failures >= 1
+    assert any(e["site"] == "restore" for e in fi.log)
+    for rid, toks in base.items():
+        np.testing.assert_array_equal(comps[rid].tokens, toks)
+
+
+def test_serve_host_cache_config_knob(tier_engine):
+    """serve.host_cache_gb flows from the config; the tier persists
+    across serve() calls (content-addressed), and host_cache_gb without
+    the prefix cache is refused loudly."""
+    kw = dict(num_slots=2, block_size=4, num_blocks=13,
+              attn_kernel="reference")
+    tier_engine.reset_prefix_cache()
+    tier_engine.serve(eviction_trace(), host_cache_gb=0.01, **kw)
+    executor = tier_engine._get_serve_executor(
+        2, 4, 13, 1, attn_kernel="reference")   # the cached serve shape
+    tier = executor._host_tier
+    assert tier is not None and tier.spills > 0
+    # second call reuses the SAME tier object (warm across calls)
+    tier_engine.serve(eviction_trace(), host_cache_gb=0.01, **kw)
+    assert executor._host_tier is tier
+    # resolved 0 drops it
+    tier_engine.serve(eviction_trace(), host_cache_gb=0, **kw)
+    assert executor._host_tier is None
+    with pytest.raises(ValueError, match="host_cache_gb"):
+        tier_engine.serve(eviction_trace(), host_cache_gb=0.01,
+                          prefix_cache=False, **kw)
+
+
+def test_serve_host_cache_from_config_section():
+    """No per-call override: the serve.host_cache_gb config section
+    alone turns the tier on."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), ids)["params"]
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "float32", "serve": {"host_cache_gb": 0.01}},
+        params=params, model_config=cfg)
+    engine.serve(eviction_trace(), num_slots=2, block_size=4,
+                 num_blocks=13, attn_kernel="reference")
+    stats = engine.last_serve_scheduler.prefix_cache_stats()
+    assert stats["host_tier_enabled"] and stats["host_spills"] > 0
+
+
+def test_serve_tiered_int8_kv_pools():
+    """The spill/restore entry points run on the int8 4-tuple pools
+    (payloads + scale pools round-trip through the host tier): greedy
+    tokens identical tier-on vs tier-off under quant.kv_cache."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(2), ids)["params"]
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32",
+                             "quant": {"kv_cache": True}},
+        params=params, model_config=cfg)
+    kw = dict(num_slots=2, block_size=4, num_blocks=13,
+              attn_kernel="reference")
+    engine.reset_prefix_cache()
+    off = {c.rid: c.tokens for c in engine.serve(eviction_trace(), **kw)}
+    engine.reset_prefix_cache()
+    on = {c.rid: c.tokens
+          for c in engine.serve(eviction_trace(), host_cache_gb=0.01,
+                                **kw)}
+    stats = engine.last_serve_scheduler.prefix_cache_stats()
+    assert stats["host_restores"] > 0
+    for rid in off:
+        np.testing.assert_array_equal(on[rid], off[rid])

@@ -175,3 +175,97 @@ def test_pipelined_swapper_release_then_prefetch(tmp_path):
     back = sw.acquire("g0")
     np.testing.assert_allclose(np.asarray(back["s"]), np.asarray(big) + 1.0)
     sw.close()
+
+
+# --- swapper × KV-pool trees (tiered-KV satellite coverage) ------------------
+
+def _int8_kv_pools(seed=0, L=2, nb=6, bs=4, n_kv=2, hd=8):
+    """A realistically-populated int8 4-tuple paged pool (payloads +
+    per-(token, head) f32 scales — ops/paged_attention.init_paged_pool
+    layout), NOT zeros: bit-exactness claims need entropy."""
+    rng = np.random.default_rng(seed)
+    shape = (L, nb, bs, n_kv, hd)
+    return (jnp.asarray(rng.integers(-127, 128, shape, dtype=np.int8)),
+            jnp.asarray(rng.standard_normal(shape[:-1]).astype(np.float32)),
+            jnp.asarray(rng.integers(-127, 128, shape, dtype=np.int8)),
+            jnp.asarray(rng.standard_normal(shape[:-1]).astype(np.float32)))
+
+
+def test_tensor_swapper_int8_kv_pool_tree_bit_exact(tmp_path):
+    """The int8 4-tuple KV pool round-trips through swap_out/swap_in
+    BIT-exact — mixed int8 payloads and f32 scale leaves in one pytree,
+    the shape the host tier's disk-backed future rides on."""
+    sw = AsyncTensorSwapper(str(tmp_path))
+    pools = _int8_kv_pools()
+    sw.swap_out("kv", pools)
+    back = sw.swap_in("kv")
+    assert jax.tree_util.tree_structure(back) == \
+        jax.tree_util.tree_structure(pools)
+    for a, b in zip(jax.tree_util.tree_leaves(pools),
+                    jax.tree_util.tree_leaves(back)):
+        assert np.asarray(b).dtype == np.asarray(a).dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    sw.close()
+
+
+def test_tensor_swapper_dense_kv_pool_tree_bit_exact(tmp_path):
+    """Dense 2-tuple pools too (the fp serving path)."""
+    rng = np.random.default_rng(1)
+    shape = (2, 6, 4, 2, 8)
+    pools = (jnp.asarray(rng.standard_normal(shape).astype(np.float32)),
+             jnp.asarray(rng.standard_normal(shape).astype(np.float32)))
+    sw = AsyncTensorSwapper(str(tmp_path))
+    sw.swap_out("kv", pools)
+    back = sw.swap_in("kv")
+    for a, b in zip(pools, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    sw.close()
+
+
+def test_swapper_alias_guard_mutation_after_restore(tmp_path):
+    """Pin the CPU zero-copy alias guard (swapper.py _to_device): on a
+    CPU backend, jax.device_put may ALIAS a 64B-aligned host buffer —
+    exactly what arena staging views are — so swap_in must hand the
+    device arrays copies. Regression shape: restore from the arena,
+    then overwrite the arena slots with a second swap_in; the first
+    restore's device values must NOT change. Without the guard this
+    fails with the second pool's bytes bleeding into the first arrays.
+    """
+    sw = AsyncTensorSwapper(str(tmp_path), staging_mb=4)
+    a = _int8_kv_pools(seed=2)
+    b = jax.tree_util.tree_map(lambda x: x[::-1], _int8_kv_pools(seed=3))
+    sw.swap_out("a", a)
+    sw.swap_out("b", b)
+    restored_a = sw.swap_in("a")        # staged through the arena
+    snapshot = [np.asarray(leaf).copy()
+                for leaf in jax.tree_util.tree_leaves(restored_a)]
+    sw.swap_in("b")                     # reuses the freed arena slots
+    for before, leaf in zip(snapshot,
+                            jax.tree_util.tree_leaves(restored_a)):
+        np.testing.assert_array_equal(before, np.asarray(leaf))
+    sw.close()
+
+
+def test_host_tier_staging_never_aliases_device_restore():
+    """The same discipline in the serving host tier
+    (inference/kv_tiering.py): frames staged for device_put are fresh
+    copies, so evicting/overwriting the tier entry after a restore has
+    been dispatched can never mutate the device-side arrays."""
+    from deepspeed_tpu.inference.kv_tiering import HostKVTier
+
+    t = HostKVTier(1 << 20, staging_mb=1)
+    rng = np.random.default_rng(4)
+    frames = [rng.integers(-127, 128, (2, 4, 2, 8), dtype=np.int8),
+              rng.standard_normal((2, 4, 2)).astype(np.float32)]
+    t.put(b"k", frames)
+    staged = t.stage_frames([(b"k", 3)])
+    dev = [jax.device_put(s) for s in staged]
+    jax.block_until_ready(dev)
+    t.drop(b"k")                        # arena slots free
+    for i in range(8):                  # and get churned through
+        t.put(b"j%d" % i, [rng.standard_normal((2, 4, 2, 8))
+                           .astype(np.float32)])
+    np.testing.assert_array_equal(np.asarray(dev[0]),
+                                  np.stack([frames[0]], axis=1))
+    np.testing.assert_array_equal(np.asarray(dev[1]),
+                                  np.stack([frames[1]], axis=1))

@@ -1843,6 +1843,54 @@ def autotune_main():
     }))
 
 
+def multichip_main(dryrun: bool = False):
+    """--multichip [--dryrun]: record the STATIC collective inventory —
+    every multi-chip entry point's collectives by mesh axis (count +
+    per-device wire bytes per step, the dstlint SPMD pass's abstract
+    trace) — into MULTICHIP_COMMS.json, so the perf trajectory carries
+    comms structure alongside step time. ``--dryrun`` additionally runs
+    the full 8-device parallelism dry run (__graft_entry__) first."""
+    if dryrun:
+        import __graft_entry__
+
+        __graft_entry__.dryrun_multichip(8)
+
+    from deepspeed_tpu.tools.dstlint.spmdpass import (
+        inventory_summary, trace_spmd_entry_points,
+    )
+
+    reports = trace_spmd_entry_points()
+    summary = inventory_summary(reports)
+    errors = sorted(n for n, rep in reports.items() if rep.error)
+    artifact = {
+        "source": "dstlint spmd pass (abstract meshes; "
+                  "comm/collective_cost.py wire arithmetic)",
+        "entries": summary,
+        "total_wire_bytes_per_step": sum(
+            e.get("total_wire_bytes", 0) for e in summary.values()),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_COMMS.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    per_axis = {}
+    for entry in summary.values():
+        for axes, rec in entry.get("per_axis", {}).items():
+            tot = per_axis.setdefault(axes, {"count": 0, "bytes": 0})
+            tot["count"] += rec["count"]
+            tot["bytes"] += rec["bytes"]
+    print(json.dumps({
+        "metric": "static_collective_inventory",
+        "entries": len(summary), "errors": errors,
+        "per_axis": per_axis,
+        "total_wire_bytes_per_step": artifact["total_wire_bytes_per_step"],
+        "artifact": "MULTICHIP_COMMS.json",
+    }))
+    if errors:
+        sys.exit(f"spmd trace errors: {errors}")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -2029,6 +2077,8 @@ if __name__ == "__main__":
                        decode_chunk=_intflag("--chunk"),
                        kernels=kernels,
                        trace_seed=_intflag("--trace-seed"))
+    elif "--multichip" in sys.argv:
+        multichip_main(dryrun="--dryrun" in sys.argv)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

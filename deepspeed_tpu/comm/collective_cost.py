@@ -1,0 +1,94 @@
+"""Per-device wire-byte arithmetic for collectives — ONE shared table.
+
+Both byte accountings in the tree route through here so they cannot
+drift apart:
+
+- the RUNTIME side: ``comm/comms_logging.py`` records each verb's wire
+  bytes when a collective is profiled (eager or trace-time), and
+- the STATIC side: the dstlint SPMD pass
+  (``tools/dstlint/spmdpass.py``) prices every collective equation it
+  finds in an abstract trace when building ``comms_budgets.json``.
+
+The model is the standard ring-algorithm cost on a ``n``-member group
+(TPU ICI is a torus; XLA's collectives are ring/tree hybrids, but the
+ring formula is the canonical per-device lower bound and is what every
+roofline in PAPERS.md uses):
+
+==============  =============================  =========================
+kind            payload_bytes meaning          per-device wire bytes
+==============  =============================  =========================
+psum            the reduced value (per device)  2 * p * (n-1) / n
+pmax / pmin     same as psum                    2 * p * (n-1) / n
+reduce_scatter  the full pre-scatter value      p * (n-1) / n
+all_gather      this device's input shard       p * (n-1)
+all_to_all      this device's full input        p * (n-1) / n
+ppermute        the permuted value              p
+broadcast       the value                       p
+shard/reshard   constraint boundary (no wire)   0
+==============  =============================  =========================
+
+``psum`` counts the reduce-scatter + all-gather phases of a ring
+all-reduce; ``all_gather`` is priced from the INPUT shard (each device
+receives n-1 foreign shards of that size); ``ppermute`` sends the whole
+value exactly once regardless of group size.
+"""
+
+from typing import Optional
+
+#: collective kinds the table prices; anything else costs 0 wire bytes
+REDUCTION_KINDS = ("psum", "pmax", "pmin", "reduce_scatter")
+WIRE_KINDS = REDUCTION_KINDS + ("all_gather", "all_to_all", "ppermute",
+                                "broadcast")
+
+#: jaxpr primitive name → canonical collective kind
+PRIMITIVE_KINDS = {
+    "psum": "psum",
+    "psum2": "psum",            # shard_map spelling on jax 0.4.x
+    "pmax": "pmax",
+    "pmin": "pmin",
+    "psum_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+    "pbroadcast": "broadcast",
+}
+
+
+def wire_bytes(kind: str, payload_bytes: int, group_size: int) -> int:
+    """Per-device bytes a ``kind`` collective moves over the interconnect
+    for a ``payload_bytes`` payload on a ``group_size``-member group.
+    See the module table for what ``payload_bytes`` means per kind."""
+    n = int(group_size)
+    p = int(payload_bytes)
+    if n <= 1 or p <= 0:
+        return 0
+    if kind in ("psum", "pmax", "pmin"):
+        return 2 * p * (n - 1) // n
+    if kind == "reduce_scatter":
+        return p * (n - 1) // n
+    if kind == "all_gather":
+        return p * (n - 1)
+    if kind == "all_to_all":
+        return p * (n - 1) // n
+    if kind == "ppermute":
+        return p
+    if kind == "broadcast":
+        return p
+    return 0
+
+
+def payload_bytes_from_shape(shape, dtype) -> int:
+    """bytes of one array — the shared shape×itemsize arithmetic."""
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def collective_kind(primitive_name: str) -> Optional[str]:
+    """Canonical kind for a jaxpr primitive name, or None when the
+    primitive is not a collective."""
+    return PRIMITIVE_KINDS.get(primitive_name)

@@ -123,9 +123,10 @@ def init_distributed(dist_backend: str = "xla-ici",
             try:
                 jax.config.update("jax_cpu_collectives_implementation",
                                   "gloo")
-            except Exception:
-                logger.warning("no gloo CPU collectives in this jax build; "
-                               "multi-process CPU collectives may hang")
+            except Exception as e:
+                logger.warning(f"no gloo CPU collectives in this jax build "
+                               f"({e}); multi-process CPU collectives may "
+                               f"hang")
         if verbose:
             logger.info(f"Initializing JAX distributed: coordinator={coordinator} {kwargs}")
         jax.distributed.initialize(coordinator_address=coordinator, **kwargs)
@@ -140,7 +141,7 @@ def get_world_size(group: Optional[AxisName] = None) -> int:
         return jax.device_count()
     try:
         return axis_size(group)  # inside shard_map/pmap trace
-    except (NameError, Exception):
+    except Exception:   # dstlint: disable=no-silent-except (probe: outside a trace axis_size raises; the mesh fallback below IS the outcome)
         mesh = _current_mesh()
         if mesh is not None:
             axes = (group,) if isinstance(group, str) else tuple(group)
@@ -177,18 +178,26 @@ def _current_mesh():
         m = get_abstract_mesh()
         if m is not None and m.axis_names:
             return m
-    except Exception:
+    except Exception:   # dstlint: disable=no-silent-except (probe: "no ambient mesh" is a normal state; None IS the outcome)
         pass
     return None
 
 
-def _profile(op_name: str, tensor) -> None:
+def _profile(op_name: str, tensor, kind: Optional[str] = None,
+             group: Optional[AxisName] = None) -> None:
     if comms_logger.should_profile(op_name):
         try:
             size = get_msg_size_from_shape(tensor.shape, tensor.dtype)
-        except Exception:
+        except Exception:   # dstlint: disable=no-silent-except (profiling must never break the collective; 0 is the explicit unknown-size record)
             size = 0
-        comms_logger.append(op_name, 0.0, size)
+        group_size = None
+        if kind is not None and group is not None:
+            try:
+                group_size = get_world_size(group)
+            except Exception:   # dstlint: disable=no-silent-except (probe: no ambient mesh/axis; payload-only record IS the outcome)
+                group_size = None
+        comms_logger.append(op_name, 0.0, size, kind=kind,
+                            group_size=group_size)
 
 
 # --------------------------------------------------------------------------
@@ -197,7 +206,7 @@ def _profile(op_name: str, tensor) -> None:
 
 def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
     """reference comm.py:430 all_reduce → lax.psum/pmax/pmin family."""
-    _profile("all_reduce", tensor)
+    _profile("all_reduce", tensor, "psum", group)
     if op in (ReduceOp.SUM, ReduceOp.AVG):
         out = lax.psum(tensor, group)
         if op == ReduceOp.AVG:
@@ -226,7 +235,7 @@ def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = 
 def all_gather(tensor, group: AxisName = "data", axis: int = 0, tiled: bool = True):
     """reference all_gather_into_tensor (comm/torch.py:78): concatenated
     gather along ``axis`` when tiled, stacked new leading dim otherwise."""
-    _profile("all_gather", tensor)
+    _profile("all_gather", tensor, "all_gather", group)
     return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
 
 
@@ -236,7 +245,7 @@ def all_gather_into_tensor(output_unused, tensor, group: AxisName = "data"):
 
 def reduce_scatter(tensor, group: AxisName = "data", axis: int = 0):
     """reference reduce_scatter_tensor → lax.psum_scatter (tiled)."""
-    _profile("reduce_scatter", tensor)
+    _profile("reduce_scatter", tensor, "reduce_scatter", group)
     return lax.psum_scatter(tensor, group, scatter_dimension=axis, tiled=True)
 
 
@@ -244,15 +253,19 @@ def all_to_all_single(tensor, group: AxisName = "data", split_axis: int = 0,
                       concat_axis: int = 0):
     """reference all_to_all_single (MoE dispatch). ``tensor`` must have its
     ``split_axis`` divisible by the group size."""
-    _profile("all_to_all", tensor)
+    _profile("all_to_all", tensor, "all_to_all", group)
     group_size = axis_size(group)
     return lax.all_to_all(tensor, group, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(tensor, src: int = 0, group: AxisName = "data"):
-    """reference comm.py:215 broadcast: every member gets src's value."""
-    _profile("broadcast", tensor)
+    """reference comm.py:215 broadcast: every member gets src's value.
+
+    Lowered as a masked psum, so that is what the wire accounting
+    prices (2p(n-1)/n, matching the static SPMD inventory and the
+    traffic XLA actually generates) — not an idealized p-byte tree."""
+    _profile("broadcast", tensor, "psum", group)
     idx = lax.axis_index(group)
     masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
     return lax.psum(masked, group)
@@ -302,7 +315,7 @@ def ppermute(tensor, perm, group: AxisName = "pipe"):
     """Ring/point-to-point transfer — the pipeline p2p primitive
     (reference runtime/pipe/p2p.py send/recv become a single collective
     permute over the pipe axis)."""
-    _profile("ppermute", tensor)
+    _profile("ppermute", tensor, "ppermute", group)
     return lax.ppermute(tensor, group, perm)
 
 
@@ -325,8 +338,10 @@ def barrier(group: Optional[AxisName] = None):
     for d in jax.devices():
         try:
             jax.device_put(0, d).block_until_ready()
-        except Exception:
-            pass
+        except Exception as e:
+            # a device that cannot sync means the barrier did NOT cover
+            # it — say so instead of silently weakening the guarantee
+            logger.warning(f"barrier: device {d} failed to sync: {e}")
 
 
 def monitored_barrier(group: Optional[AxisName] = None, timeout=None):
@@ -354,7 +369,9 @@ def eager_all_reduce_over_mesh(x, mesh, axis: str = "data", op: ReduceOp = Reduc
     out.block_until_ready()
     if comms_logger.should_profile("all_reduce"):
         comms_logger.append("all_reduce(eager)", (time.time() - t0) * 1e3,
-                            get_msg_size_from_shape(x.shape, x.dtype))
+                            get_msg_size_from_shape(x.shape, x.dtype),
+                            kind="psum",
+                            group_size=int(mesh.shape.get(axis, 1)))
     return out
 
 

@@ -7,8 +7,11 @@ jit. ``log_summary()`` aggregates like the reference (comm.py:409).
 """
 
 import math
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from deepspeed_tpu.comm.collective_cost import (
+    payload_bytes_from_shape, wire_bytes,
+)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -22,12 +25,10 @@ def convert_size(size_bytes: float) -> str:
 
 
 def get_msg_size_from_shape(shape, dtype) -> int:
-    import numpy as np
-
-    n = 1
-    for d in shape:
-        n *= int(d)
-    return n * np.dtype(dtype).itemsize
+    """Payload bytes of one array — shared dtype-size × element-count
+    arithmetic (comm/collective_cost.py), the same table the dstlint
+    SPMD pass prices static traces with."""
+    return payload_bytes_from_shape(shape, dtype)
 
 
 class CommsLogger:
@@ -38,7 +39,10 @@ class CommsLogger:
         self.prof_all = prof_all
         self.debug = debug
         self.prof_ops = prof_ops or []
-        # op name -> msg size -> [count, total_latency_ms, total_bytes]
+        # op name -> msg size -> [count, total_latency_ms, total_payload
+        # bytes, total_wire_bytes] (wire = per-device interconnect bytes
+        # per the shared collective_cost table; 0 when the op kind or
+        # group size was unknown at record time)
         self.comms_dict: Dict[str, Dict[int, List[float]]] = {}
 
     def configure(self, comms_config) -> None:
@@ -53,29 +57,57 @@ class CommsLogger:
             return False
         return self.prof_all or op_name in self.prof_ops
 
-    def append(self, op_name: str, latency_ms: float, msg_size: int) -> None:
+    def append(self, op_name: str, latency_ms: float, msg_size: int,
+               kind: Optional[str] = None,
+               group_size: Optional[int] = None) -> None:
+        """Record one collective. ``kind``/``group_size`` (when the verb
+        knows them) price the per-device wire bytes via the shared
+        :func:`collective_cost.wire_bytes` table — the SAME arithmetic
+        the dstlint SPMD pass applies to static traces, so runtime and
+        static accounting cannot disagree."""
         if op_name not in self.comms_dict:
             self.comms_dict[op_name] = {}
         sizes = self.comms_dict[op_name]
         if msg_size not in sizes:
-            sizes[msg_size] = [0, 0.0, 0.0]
+            sizes[msg_size] = [0, 0.0, 0.0, 0.0]
         rec = sizes[msg_size]
         rec[0] += 1
         rec[1] += latency_ms
         rec[2] += msg_size
+        if kind is not None and group_size is not None:
+            rec[3] += wire_bytes(kind, msg_size, group_size)
         if self.verbose:
             logger.info(
                 f"comm op: {op_name} | time (ms): {latency_ms:.2f} | "
                 f"msg size: {convert_size(msg_size)}"
             )
 
+    def wire_totals(self) -> Dict[str, Dict[str, float]]:
+        """{op: {count, payload_bytes, wire_bytes}} aggregated over all
+        message sizes — the runtime half of the static/runtime byte
+        cross-check (tests/unit/test_comm.py)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for op, sizes in self.comms_dict.items():
+            tot = {"count": 0, "payload_bytes": 0.0, "wire_bytes": 0.0}
+            for rec in sizes.values():
+                tot["count"] += rec[0]
+                tot["payload_bytes"] += rec[2]
+                tot["wire_bytes"] += rec[3]
+            out[op] = tot
+        return out
+
     def log_summary(self) -> str:
-        lines = [f"{'Op':<24}{'Message Size':<16}{'Count':<8}{'Total Latency(ms)':<20}{'Avg Latency(ms)':<18}"]
+        lines = [f"{'Op':<24}{'Message Size':<16}{'Count':<8}"
+                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<18}"
+                 f"{'Wire Bytes':<14}"]
         for op, sizes in sorted(self.comms_dict.items()):
-            for msg_size, (count, total_ms, _) in sorted(sizes.items()):
+            for msg_size, rec in sorted(sizes.items()):
+                count, total_ms, wire = rec[0], rec[1], rec[3]
                 avg = total_ms / count if count else 0.0
                 lines.append(
-                    f"{op:<24}{convert_size(msg_size):<16}{count:<8}{total_ms:<20.2f}{avg:<18.3f}"
+                    f"{op:<24}{convert_size(msg_size):<16}{count:<8}"
+                    f"{total_ms:<20.2f}{avg:<18.3f}"
+                    f"{convert_size(wire):<14}"
                 )
         summary = "\n".join(lines)
         logger.info("\n" + summary)

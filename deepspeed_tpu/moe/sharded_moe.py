@@ -172,6 +172,21 @@ def moe_dispatch_combine(x: jnp.ndarray, gate_logits: jnp.ndarray,
             spec = jax.sharding.PartitionSpec("expert", cap_axis)
         else:
             spec = jax.sharding.PartitionSpec(expert_shard_axis)
+    if spec is not None:
+        # Resolve the ambient mesh into the sharding NOW instead of
+        # handing XLA a bare PartitionSpec: a bare spec only resolves
+        # against a physical `with mesh:` context, so the constraint
+        # silently required one mesh spelling — and failed outright
+        # under an AbstractMesh (no devices), where the dstlint SPMD
+        # pass traces this program.
+        try:
+            from deepspeed_tpu.utils.jax_compat import get_abstract_mesh
+
+            mesh = get_abstract_mesh()
+            if mesh is not None:
+                spec = jax.sharding.NamedSharding(mesh, spec)
+        except Exception:
+            pass    # keep the bare spec; jit-with-mesh still resolves it
     expert_inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
     if spec is not None:
         expert_inputs = jax.lax.with_sharding_constraint(expert_inputs, spec)

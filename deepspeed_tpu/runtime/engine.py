@@ -44,7 +44,8 @@ from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.runtime.quantize import Quantizer
 from deepspeed_tpu.runtime.zero.stages import (
-    ZeroShardingPlan, opt_state_shardings, plan_zero_shardings,
+    COMM_DTYPES, ZeroShardingPlan, constrain_gradients, opt_state_shardings,
+    plan_zero_shardings,
 )
 from deepspeed_tpu.compression import (
     Compressor, CompressionScheduler, STEP_KEY, get_compression_config,
@@ -660,44 +661,28 @@ class DeepSpeedEngine:
         bspec = batch_spec(mesh)
         self._batch_sharding = NamedSharding(mesh, bspec)
 
-        # reference engine.py:776-788 reduction knobs:
-        # communication_data_type casts gradients at the sharding-constraint
-        # boundary — the seam where XLA places the cross-replica reduction
-        # for data-sharded grads; gradient_predivide_factor stages the
-        # averaging (1/f before the boundary, f after) so fp16 partial sums
-        # cannot overflow. Scope note: XLA may still pick its own internal
-        # accumulation dtype for the collective it synthesizes.
-        _comm_dtypes = {"fp16": jnp.float16, "float16": jnp.float16,
-                        "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                        "fp32": jnp.float32, "float32": jnp.float32}
+        # reference engine.py:776-788 reduction knobs. The boundary cast +
+        # constraint live in zero/stages.constrain_gradients — the shared
+        # seam the dstlint SPMD pass traces, so the comms the linter
+        # budgets are the comms this program emits. Scope note: XLA may
+        # still pick its own internal accumulation dtype for the
+        # collective it synthesizes.
         accum_dtype = ({"bfloat16": jnp.bfloat16, "float32": None}
                        [self._config.grad_accum_dtype]
                        if self._config.grad_accum_dtype else None)
         comm_dtype = None
         if self._config.communication_data_type:
             key = self._config.communication_data_type.lower()
-            if key not in _comm_dtypes:
+            if key not in COMM_DTYPES:
                 raise ValueError(
                     f"communication_data_type={key!r}: supported values "
-                    f"are {sorted(_comm_dtypes)}")
-            comm_dtype = _comm_dtypes[key]
+                    f"are {sorted(COMM_DTYPES)}")
+            comm_dtype = COMM_DTYPES[key]
         predivide = float(self._config.gradient_predivide_factor or 1.0)
 
         def constrain_grads(grads):
-            def c(g, s):
-                orig = g.dtype
-                if predivide != 1.0:
-                    g = g / predivide
-                if comm_dtype is not None:
-                    g = g.astype(comm_dtype)
-                g = jax.lax.with_sharding_constraint(g, s)
-                if comm_dtype is not None:
-                    g = g.astype(orig)
-                if predivide != 1.0:
-                    g = g * predivide
-                return g
-
-            return jax.tree_util.tree_map(c, grads, grad_shardings)
+            return constrain_gradients(grads, grad_shardings, comm_dtype,
+                                       predivide)
 
         def grad_step(params, batch, scale):
             def scaled_loss(p):

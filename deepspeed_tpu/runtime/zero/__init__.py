@@ -5,6 +5,9 @@ from deepspeed_tpu.runtime.zero.config import (
 )
 from deepspeed_tpu.runtime.zero.stages import (
     ZeroShardingPlan,
+    build_zero_train_step,
+    constrain_gradients,
+    grad_shardings_for,
     opt_state_shardings,
     plan_zero_shardings,
 )

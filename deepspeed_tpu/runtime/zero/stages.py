@@ -26,10 +26,17 @@ streams shards HBM<->host around the update.
 from typing import Any, NamedTuple, Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.parallel.partition import path_str, infer_param_spec
 from deepspeed_tpu.utils.logging import logger
+
+#: communication_data_type spellings → collective boundary dtypes
+#: (reference engine.py:776 communication_data_type knob)
+COMM_DTYPES = {"fp16": jnp.float16, "float16": jnp.float16,
+               "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+               "fp32": jnp.float32, "float32": jnp.float32}
 
 
 class ZeroShardingPlan(NamedTuple):
@@ -60,7 +67,7 @@ def _supports_host_memory(mesh: Mesh) -> bool:
         dev = mesh.devices.flat[0]
         kinds = {m.kind for m in dev.addressable_memories()}
         return "pinned_host" in kinds
-    except Exception:
+    except Exception:   # dstlint: disable=no-silent-except (capability probe: abstract/virtual meshes have no devices; False IS the outcome)
         return False
 
 
@@ -136,6 +143,70 @@ def plan_zero_shardings(params: Any, mesh: Mesh, zero_config, rules=None) -> Zer
     )
 
 
+def grad_shardings_for(plan: ZeroShardingPlan, mesh: Mesh) -> Any:
+    """NamedShardings for the gradient tree (the reduce boundary specs)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), plan.grad_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def constrain_gradients(grads: Any, grad_shardings: Any,
+                        comm_dtype=None, predivide: float = 1.0) -> Any:
+    """The gradient reduction boundary — THE seam where XLA places the
+    cross-replica reduction for data/mics-sharded gradients (reference
+    engine.py:776-788 reduction knobs). ``communication_data_type`` casts
+    at this boundary so the synthesized collective moves the configured
+    dtype; ``gradient_predivide_factor`` stages the averaging (1/f before
+    the boundary, f after) so fp16 partial sums cannot overflow. Shared
+    by the training engine's step programs and the dstlint SPMD pass's
+    abstract traces, so what the linter budgets is what the engine runs.
+    """
+    def c(g, s):
+        orig = g.dtype
+        if predivide != 1.0:
+            g = g / predivide
+        if comm_dtype is not None:
+            g = g.astype(comm_dtype)
+        g = jax.lax.with_sharding_constraint(g, s)
+        if comm_dtype is not None:
+            g = g.astype(orig)
+        if predivide != 1.0:
+            g = g * predivide
+        return g
+
+    return jax.tree_util.tree_map(c, grads, grad_shardings)
+
+
+def build_zero_train_step(loss_fn, optimizer, plan: ZeroShardingPlan,
+                          mesh, *, communication_data_type: Optional[str] = None,
+                          gradient_predivide_factor: float = 1.0):
+    """A minimal ZeRO train step over a sharding plan: value_and_grad →
+    the :func:`constrain_gradients` reduce boundary → optimizer update.
+
+    This is the abstract-traceable distillation of the engine's fused
+    step (runtime/engine.py ``_build_step_functions``) sharing the real
+    boundary code — the dstlint SPMD pass traces it per stage under an
+    AbstractMesh to budget the collectives XLA will synthesize (stage 1:
+    param all-gather epilogue; stage 2/3: grad reduce-scatter). The
+    engine itself keeps its richer program (loss scaling, finite guards,
+    offload transfers) built on the same ``constrain_gradients`` seam.
+    """
+    import optax
+
+    gshard = grad_shardings_for(plan, mesh)
+    comm_dtype = (COMM_DTYPES[communication_data_type.lower()]
+                  if communication_data_type else None)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = constrain_gradients(grads, gshard, comm_dtype,
+                                    float(gradient_predivide_factor))
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), new_opt
+
+    return train_step
+
+
 def opt_state_shardings(opt_state: Any, params: Any, plan: ZeroShardingPlan,
                         mesh: Mesh) -> Any:
     """Shardings for an optax opt_state: leaves shaped like a param pytree get
@@ -163,7 +234,7 @@ def opt_state_shardings(opt_state: Any, params: Any, plan: ZeroShardingPlan,
                     for l, p in zip(sub_flat, flat_params)):
                 return jax.tree_util.tree_unflatten(
                     sub_def, [plan.opt_sharding_fn(s) for s in flat_specs])
-        except Exception:
+        except Exception:   # dstlint: disable=no-silent-except (structural probe: non-params-shaped subtrees are expected; None routes them to the scalar walk)
             pass
         return None
 

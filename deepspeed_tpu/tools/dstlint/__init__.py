@@ -1,19 +1,28 @@
 """dstlint — the framework's JAX/TPU invariant checker.
 
-Two backends behind one finding stream:
+Three backends behind one finding stream:
 
 - **AST pass** (:mod:`.astpass`): framework-specific rules over the
   package source — the ``utils/jax_compat`` seam, host syncs inside
   jitted code, recompile hazards, Pallas kernel hygiene, in-place
   argument mutation, buffer-donation checks on the serving entry
-  points, and silently-swallowed exceptions in the serving fault
-  paths. Pure ``ast``, no jax import, runs in milliseconds.
+  points, and silently-swallowed exceptions in the serving/runtime/comm
+  fault paths. Pure ``ast``, no jax import, runs in milliseconds.
 - **jaxpr pass** (:mod:`.jaxprpass`): abstractly traces the registered
   serving entry points (paged decode step, prefill bucket,
   ``copy_pool_blocks``) and fails on callback/transfer primitives in
   their jaxprs, on a missing ``pallas_call`` in the Pallas arm (silent
   fallback to the reference gather), and on equation-count drift beyond
   the checked-in budgets (``tools/dstlint/jaxpr_budgets.json``).
+- **SPMD pass** (:mod:`.spmdpass`): traces the sharded training and
+  serving entry points under abstract multi-device meshes (no TPU
+  required), inventories every collective by mesh axis / dtype /
+  per-device wire bytes (the shared ``comm/collective_cost.py``
+  arithmetic), pins the inventory in
+  ``tools/dstlint/comms_budgets.json``, and fires on implicit
+  collectives, comms-budget drift, accidental full replication,
+  over-wide reduction dtypes, wrong-axis psums inside ``shard_map``
+  bodies, and unbudgeted collectives inside decode ``while_loop``s.
 
 CLI: ``bin/dst lint`` (see :mod:`.cli`); library entry:
 :func:`run_lint`. Rule catalog: ``docs/LINT.md``.

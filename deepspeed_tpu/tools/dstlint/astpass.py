@@ -245,6 +245,14 @@ class ModuleAnalyzer:
                 return self._callable_arg_to_info(arg.args[0], scope)
         return None
 
+    def _is_partial_jit(self, node: ast.AST) -> bool:
+        """functools.partial(jax.jit, ...) — a curried jit wrapper."""
+        return (isinstance(node, ast.Call)
+                and self.dotted(node.func) in ("functools.partial",
+                                               "partial")
+                and bool(node.args)
+                and self.dotted(node.args[0]) in JIT_WRAPPERS)
+
     def _mark_functions(self):
         # decorators
         for info in self.funcs:
@@ -266,9 +274,35 @@ class ModuleAnalyzer:
                     info.jit_calls.append(dec)
 
         # call sites: jax.jit(f), lax.while_loop(cond, body, ...),
-        # pl.pallas_call(kernel | functools.partial(kernel, ...), ...)
+        # pl.pallas_call(kernel | functools.partial(kernel, ...), ...),
+        # functools.partial(jax.jit, donate_argnums=...)(f) inline or
+        # through a local alias — the partial call carries the jit
+        # kwargs donation-check must read
+        partial_jit_aliases: Dict[str, ast.Call] = {}
         for node, scope in self._walk_with_scopes():
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and self._is_partial_jit(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        partial_jit_aliases[t.id] = node.value
+                continue
             if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Call) \
+                    and self._is_partial_jit(node.func) and node.args:
+                info = self._callable_arg_to_info(node.args[0], scope)
+                if info is not None:
+                    info.traced = True
+                    info.jit_calls.append(node.func)
+                continue
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in partial_jit_aliases and node.args:
+                info = self._callable_arg_to_info(node.args[0], scope)
+                if info is not None:
+                    info.traced = True
+                    info.jit_calls.append(
+                        partial_jit_aliases[node.func.id])
                 continue
             d = self.dotted(node.func)
             if d is None:
@@ -313,7 +347,9 @@ class ModuleAnalyzer:
         if self.relpath.startswith(("deepspeed_tpu/ops/",
                                     "deepspeed_tpu/inference/")):
             self._rule_arg_mutation()
-        if self.relpath.startswith("deepspeed_tpu/inference/"):
+        if self.relpath.startswith(("deepspeed_tpu/inference/",
+                                    "deepspeed_tpu/runtime/",
+                                    "deepspeed_tpu/comm/")):
             self._rule_silent_except()
         if self.relpath.endswith(DONATION_FILES):
             self._rule_donation()
@@ -515,9 +551,10 @@ class ModuleAnalyzer:
                 self.emit(
                     SILENT_EXCEPT, handler,
                     f"{what} swallows the exception silently in a "
-                    f"serving hot path — bind it (`except Exception as "
-                    f"e:`) and convert it to an explicit outcome "
-                    f"(terminal status, report), or re-raise")
+                    f"serving/training/comm path — bind it (`except "
+                    f"Exception as e:`) and convert it to an explicit "
+                    f"outcome (terminal status, log, report), or "
+                    f"re-raise")
 
     # donation-check ----------------------------------------------------------
     def _rule_donation(self):
@@ -534,12 +571,19 @@ class ModuleAnalyzer:
                 keywords = call.keywords if isinstance(call, ast.Call) \
                     else []
                 for k in keywords:
-                    if k.arg in ("donate_argnums", "donate_argnames"):
+                    if k.arg == "donate_argnums":
                         vals = _const_int_tuple(k.value)
                         if vals is None:     # dynamic spec: trust it
                             donated = set(buffer_pos)
                         else:
                             donated |= set(vals)
+                    elif k.arg == "donate_argnames":
+                        names = _const_str_tuple(k.value)
+                        if names is None:    # dynamic spec: trust it
+                            donated = set(buffer_pos)
+                        else:
+                            donated |= {i for i, p in enumerate(params)
+                                        if p in names}
                 missing = [params[i] for i in buffer_pos
                            if i not in donated]
                 if missing:
@@ -549,6 +593,20 @@ class ModuleAnalyzer:
                         f"buffer argument(s) {missing} — without "
                         f"donate_argnums the pool/cache is copied, "
                         f"doubling its HBM footprint per step")
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[tuple]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
 
 
 def _const_int_tuple(node: ast.AST) -> Optional[tuple]:

@@ -66,7 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to run (default all)")
     p.add_argument("--ignore", default="",
                    help="comma-separated rule ids to skip")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text",
+                   help="github emits workflow-command annotations "
+                        "(::error file=...) for CI")
     p.add_argument("--baseline", default=None,
                    help="baseline file (default "
                         "tools/dstlint/baseline.json)")
@@ -74,14 +77,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rewrite the baseline from current findings "
                         "(grandfather everything currently firing)")
     p.add_argument("--no-jaxpr", action="store_true",
-                   help="skip the jaxpr entry-point pass (no jax "
-                        "import; milliseconds instead of seconds)")
+                   help="skip the jaxpr AND spmd entry-point passes "
+                        "(no jax import; milliseconds instead of "
+                        "seconds)")
+    p.add_argument("--no-spmd", action="store_true",
+                   help="skip only the SPMD sharding/collective pass")
     p.add_argument("--budgets", default=None,
                    help="jaxpr equation-budget file (default "
                         "tools/dstlint/jaxpr_budgets.json)")
+    p.add_argument("--comms-budgets", default=None,
+                   help="SPMD collective-inventory budget file (default "
+                        "tools/dstlint/comms_budgets.json)")
     p.add_argument("--update-budgets", action="store_true",
-                   help="re-trace the entry points and rewrite the "
-                        "budget file")
+                   help="re-trace the entry points and rewrite BOTH "
+                        "budget files (jaxpr eqn counts + spmd comms)")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings covered by the baseline")
     return p
@@ -106,6 +115,8 @@ def _main(argv) -> int:
         root, "tools", "dstlint", "baseline.json")
     budgets_path = args.budgets or os.path.join(
         root, "tools", "dstlint", "jaxpr_budgets.json")
+    comms_budgets_path = args.comms_budgets or os.path.join(
+        root, "tools", "dstlint", "comms_budgets.json")
 
     config = core.LintConfig(
         select={r.strip() for r in args.select.split(",") if r.strip()}
@@ -113,8 +124,9 @@ def _main(argv) -> int:
         ignore={r.strip() for r in args.ignore.split(",") if r.strip()})
 
     if args.update_budgets:
-        from deepspeed_tpu.tools.dstlint import jaxprpass
+        from deepspeed_tpu.tools.dstlint import jaxprpass, spmdpass
 
+        rc = 0
         reports = jaxprpass.trace_entry_points()
         budgets = jaxprpass.budgets_from_reports(reports)
         os.makedirs(os.path.dirname(budgets_path), exist_ok=True)
@@ -128,8 +140,26 @@ def _main(argv) -> int:
                                   f"{rep.pallas_calls} pallas_call"
             print(f"  {name}: {status}")
         if any(r.error for r in reports.values()):
-            return 2
-        return 0
+            rc = 2
+
+        sreports = spmdpass.trace_spmd_entry_points()
+        sbudgets = spmdpass.budgets_from_reports(sreports)
+        with open(comms_budgets_path, "w") as f:
+            json.dump(sbudgets, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"dstlint: wrote {len(sbudgets['entries'])} comms budgets "
+              f"to {os.path.relpath(comms_budgets_path, root)}")
+        for name, rep in sorted(sreports.items()):
+            if rep.error:
+                status = rep.error
+            else:
+                inv = rep.inventory()
+                wire = sum(r["bytes"] for r in inv.values())
+                status = f"{len(inv)} collective keys, {wire} wire B"
+            print(f"  {name}: {status}")
+        if any(r.error for r in sreports.values()):
+            rc = 2
+        return rc
 
     files = _iter_py_files(args.paths or _default_targets(root), root)
     findings = core.run_lint(files, config)
@@ -140,6 +170,13 @@ def _main(argv) -> int:
         jf = [f for f in jaxprpass.run_jaxpr_pass(budgets_path)
               if config.rule_enabled(f.rule)]
         findings.extend(jf)
+
+    if not (args.no_jaxpr or args.no_spmd):
+        from deepspeed_tpu.tools.dstlint import spmdpass
+
+        sf = [f for f in spmdpass.run_spmd_pass(comms_budgets_path)
+              if config.rule_enabled(f.rule)]
+        findings.extend(sf)
 
     line_texts = core.collect_line_texts(files, findings)
     if args.update_baseline:
@@ -163,6 +200,21 @@ def _main(argv) -> int:
             "counts": {"active": len(active),
                        "baselined": len(findings) - len(active)},
         }, indent=1))
+    elif args.format == "github":
+        # GitHub Actions workflow commands: one ::error annotation per
+        # active finding (baselined → ::notice so they surface without
+        # failing annotations); messages are %-escaped per the spec
+        def esc(s: str) -> str:
+            return (s.replace("%", "%25").replace("\r", "%0D")
+                     .replace("\n", "%0A"))
+
+        for f in shown:
+            level = "notice" if f.baselined else "error"
+            print(f"::{level} file={esc(f.path)},line={f.line},"
+                  f"col={max(f.col, 1)},title=dstlint {esc(f.rule)}"
+                  f"::{esc(f.message)}")
+        print(f"dstlint: {len(files)} files, {len(active)} finding(s)"
+              f" ({len(findings) - len(active)} baselined)")
     else:
         for f in shown:
             print(f.render())

@@ -18,7 +18,7 @@ import jax
 from jax import lax as _lax
 
 __all__ = ["shard_map", "set_mesh", "varying_cast", "vma_of", "HAS_VMA",
-           "axis_size"]
+           "axis_size", "get_abstract_mesh", "abstract_mesh_context"]
 
 
 # --- shard_map: jax.shard_map (new) / jax.experimental.shard_map (old) -------
@@ -128,18 +128,47 @@ def pallas_tpu(placeholder: bool = False):
 
 # --- ambient mesh: jax.sharding.get_abstract_mesh (new) / thread mesh (old) --
 def get_abstract_mesh():
-    """The ambient mesh set by :func:`set_mesh`, or None. On pre-
-    abstract-mesh jax the `with mesh:` context registers a physical mesh
-    in thread resources; both expose .axis_names/.shape as used here."""
+    """The ambient mesh set by :func:`set_mesh` or
+    :func:`abstract_mesh_context`, or None. On pre-abstract-mesh jax the
+    `with mesh:` context registers a physical mesh in thread resources
+    and :func:`abstract_mesh_context` registers an AbstractMesh in the
+    internal mesh context; all expose .axis_names/.shape as used here."""
     try:
         from jax.sharding import get_abstract_mesh as _gam
 
-        return _gam()
+        m = _gam()
+        # newer jax returns an EMPTY AbstractMesh (not None) when no
+        # mesh context is set — normalize to the documented None
+        return m if m is not None and getattr(m, "axis_names", ()) \
+            else None
     except ImportError:  # pragma: no cover - exercised only on older jax
+        try:
+            from jax._src import mesh as _mesh_lib
+
+            m = _mesh_lib.get_abstract_mesh()
+            if m is not None and getattr(m, "axis_names", ()):
+                return m
+        except (ImportError, AttributeError):
+            pass
         from jax._src.mesh import thread_resources
 
         m = thread_resources.env.physical_mesh
         return m if m.axis_names else None
+
+
+def abstract_mesh_context(mesh):
+    """Context manager installing an ``AbstractMesh`` as the ambient mesh
+    for TRACING only (no devices behind it) — the dstlint SPMD pass uses
+    this to trace sharded entry points on hosts with no accelerator.
+    Values never execute under it; only ``get_abstract_mesh`` consumers
+    (sharding constraints keyed off the ambient mesh) observe it. On new
+    jax ``set_mesh`` accepts an AbstractMesh directly; 0.4.x routes
+    through the internal ``set_abstract_mesh`` context."""
+    if hasattr(jax, "set_mesh"):  # pragma: no cover - newer jax only
+        return jax.set_mesh(mesh)
+    from jax._src import mesh as _mesh_lib
+
+    return _mesh_lib.set_abstract_mesh(mesh)
 
 
 # shard_map kwargs for call sites that are vma-clean on current jax but

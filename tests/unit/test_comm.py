@@ -104,6 +104,65 @@ def test_comms_logger(dp8_mesh, rng):
     dist.comms_logger.enabled = False
 
 
+def test_static_runtime_wire_byte_cross_check(dp8_mesh, rng):
+    """The runtime comms logger and the dstlint SPMD pass price the SAME
+    program through ONE shared table (comm/collective_cost.py): tracing
+    each verb on an 8-device mesh, the logger's recorded payload/wire
+    bytes must equal the static inventory's, kind for kind.
+
+    ``broadcast`` is priced as the masked psum it lowers to — on BOTH
+    sides, so even the one verb whose name differs from its lowering
+    cannot drift.
+    """
+    cases = [
+        # (runtime op name, static kind, input shape, body)
+        ("all_reduce", "psum", (8, 16),
+         lambda t: dist.all_reduce(t, group="data")),
+        ("broadcast", "psum", (8, 16),
+         lambda t: dist.broadcast(t, src=3, group="data")),
+        ("all_gather", "all_gather", (8, 16),
+         lambda t: dist.all_gather(t, group="data")),
+        ("reduce_scatter", "reduce_scatter", (8, 16),
+         lambda t: dist.reduce_scatter(t[0], group="data")[None]),
+        ("all_to_all", "all_to_all", (8, 8, 4),
+         lambda t: dist.all_to_all_single(t[0], group="data")[None]),
+        ("ppermute", "ppermute", (8, 16),
+         lambda t: dist.send_forward(t, group="data")),
+    ]
+    from deepspeed_tpu.comm.comms_logging import CommsLogger
+    from deepspeed_tpu.tools.dstlint import spmdpass as sp
+
+    probe = CommsLogger(enabled=True)
+    real = dist.comms_logger
+    mesh_shape = dict(dp8_mesh.shape)
+    try:
+        dist.comm.comms_logger = probe
+        static = {}
+        for op, kind, shape, body in cases:
+            aval = jax.ShapeDtypeStruct(shape, jnp.float32)
+            out_spec = P("data") if len(shape) == 2 else P("data", None)
+            fn = shard_map(body, mesh=dp8_mesh, in_specs=(P("data"),),
+                           out_specs=out_spec)
+            closed = jax.make_jaxpr(fn)(aval)  # runtime logger fires here
+            report = sp.SpmdReport(op)
+            analyzer = sp.ProgramAnalyzer(mesh_shape, report)
+            analyzer.analyze(
+                closed, sp._flatten_specs(None, (aval,), dp8_mesh))
+            evs = [e for e in report.events if e.kind == kind]
+            assert len(evs) == 1, (op, report.events)
+            static[op] = evs[0]
+    finally:
+        dist.comm.comms_logger = real
+
+    runtime = probe.wire_totals()
+    for op, kind, _shape, _body in cases:
+        ev = static[op]
+        assert runtime[op]["count"] == ev.count == 1, op
+        assert runtime[op]["payload_bytes"] == ev.payload, op
+        assert runtime[op]["wire_bytes"] == ev.bytes, op
+        assert ev.bytes > 0, op
+
+
 def test_init_distributed_tpu_pod_discovery(monkeypatch):
     """TPU_WORKER_HOSTNAMES env (TPU pod metadata) resolves to a coordinator
     the way the reference discovers AzureML/SageMaker/MPI environments."""

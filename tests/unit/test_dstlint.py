@@ -4,7 +4,10 @@ Drives the real CLI in a subprocess and consumes its ``--format json``
 output — the same machine interface CI uses — so this test pins (a) the
 analyzer finding zero non-baselined violations in the tree, (b) the
 jaxpr entry-point budgets matching the checked-in
-``tools/dstlint/jaxpr_budgets.json``, and (c) the exit-code contract.
+``tools/dstlint/jaxpr_budgets.json``, (c) the SPMD collective
+inventories matching ``tools/dstlint/comms_budgets.json`` (a PR that
+changes collective structure without regenerating budgets fails here),
+and (d) the exit-code / output-format contract.
 """
 
 import json
@@ -46,6 +49,44 @@ def test_repo_has_zero_nonbaselined_findings(lint_json):
 def test_lint_walked_the_whole_package(lint_json):
     _, data = lint_json
     assert data["files_checked"] > 100   # the package, not a subdir
+
+
+def test_comms_budgets_in_sync_with_fresh_trace():
+    """The checked-in SPMD comms budgets must match a fresh abstract
+    trace of the real entry points — the guard that makes collective
+    structure a reviewed artifact."""
+    from deepspeed_tpu.tools.dstlint import spmdpass
+
+    path = os.path.join(REPO, "tools", "dstlint", "comms_budgets.json")
+    budgets = spmdpass.load_budgets(path)
+    assert budgets, "tools/dstlint/comms_budgets.json missing/unreadable"
+    entries = budgets["entries"]
+    # ≥5 real sharded entry points spanning training AND serving, with a
+    # non-empty overall inventory
+    assert len(entries) >= 5
+    assert any("zero_step" in n for n in entries)
+    assert any("pipeline" in n or "moe" in n for n in entries)
+    assert any("serve" in n for n in entries)
+    assert any(e["collectives"] for e in entries.values())
+
+    reports = spmdpass.trace_spmd_entry_points()
+    findings = spmdpass.check_reports(reports, budgets)
+    assert findings == [], "comms budgets out of sync — regen with " \
+        "`bin/dst lint --update-budgets`:\n" + "\n".join(
+            f"  {f.path}: {f.rule}: {f.message}" for f in findings)
+
+
+def test_format_github_emits_annotations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n"
+                   "def f(mesh):\n"
+                   "    return jax.set_mesh(mesh)\n")
+    proc = run_lint("--no-jaxpr", "--format", "github", str(bad))
+    assert proc.returncode == 1
+    line = next(l for l in proc.stdout.splitlines()
+                if l.startswith("::error "))
+    assert "title=dstlint jax-compat-seam" in line
+    assert ",line=4," in line
 
 
 def test_exit_code_1_on_findings_and_select_filter(tmp_path):

@@ -333,6 +333,86 @@ def test_donation_missing_on_bare_jit_decorator():
     assert rules_of(lint(src, ENGINE)) == ["donation-check"]
 
 
+def test_donation_partial_jit_spelling_recognized():
+    # functools.partial(jax.jit, ...) IS a jit entry point — both the
+    # inline application and the aliased one
+    inline = """
+        import functools
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        fn = functools.partial(jax.jit, static_argnums=())(step)
+    """
+    assert rules_of(lint(inline, ENGINE)) == ["donation-check"]
+    aliased = """
+        import functools
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        jit_step = functools.partial(jax.jit)
+        fn = jit_step(step)
+    """
+    assert rules_of(lint(aliased, ENGINE)) == ["donation-check"]
+    donated = """
+        import functools
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        fn = functools.partial(jax.jit, donate_argnums=(2,))(step)
+    """
+    assert lint(donated, ENGINE) == []
+
+
+def test_donation_argnames_parsed_not_trusted():
+    # donate_argnames naming the WRONG arg used to be trusted wholesale
+    # (false negative); only the named params are donated
+    wrong = """
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        fn = jax.jit(step, donate_argnames=("tokens",))
+    """
+    assert rules_of(lint(wrong, ENGINE)) == ["donation-check"]
+    right = """
+        import jax
+
+        def step(params, tokens, pools):
+            return tokens, pools
+
+        fn = jax.jit(step, donate_argnames=("pools",))
+    """
+    assert lint(right, ENGINE) == []
+
+
+def test_donation_partial_jit_decorator_with_argnames():
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnames="pools")
+        def step(params, tokens, pools):
+            return tokens, pools
+    """
+    assert lint(src, ENGINE) == []
+    undonated = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnames="tokens")
+        def step(params, tokens, pools):
+            return tokens, pools
+    """
+    assert rules_of(lint(undonated, ENGINE)) == ["donation-check"]
+
+
 def test_donation_satisfied_and_out_of_scope_file():
     src = """
         import jax
@@ -422,7 +502,8 @@ def test_silent_except_specific_types_and_other_paths_clean():
                 return None
     """
     assert lint(src, INFER) == []
-    # outside inference/ the rule does not apply
+    # the rule covers inference/, runtime/ and comm/ — but not ops/,
+    # models/, tools/ (probe-heavy numeric/codegen code)
     swallower = """
         def f():
             try:
@@ -430,7 +511,10 @@ def test_silent_except_specific_types_and_other_paths_clean():
             except Exception:
                 pass
     """
-    assert lint(swallower, ANY) == []
+    assert rules_of(lint(swallower, ANY)) == ["no-silent-except"]
+    assert rules_of(lint(swallower, "deepspeed_tpu/comm/comm.py")) == \
+        ["no-silent-except"]
+    assert lint(swallower, "deepspeed_tpu/models/llama.py") == []
     assert lint(swallower, OPS) == []
 
 

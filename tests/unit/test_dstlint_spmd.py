@@ -1,0 +1,328 @@
+"""dstlint SPMD-pass coverage: per-rule pos/neg fixtures.
+
+Two layers, mirroring the jaxpr-pass tests:
+
+- REAL tiny traces through :class:`ProgramAnalyzer` (abstract meshes,
+  ShapeDtypeStructs — runs on the CPU tier-1 host) proving the sharding
+  propagation itself catches / clears each violation class;
+- fabricated :class:`SpmdReport`s against :func:`check_reports` pinning
+  the budget arithmetic (drift tolerance, disappearance, not-traced)
+  without tracing.
+
+The analyzer-over-the-repo gate (budgets in sync with a fresh trace of
+the real entry points) lives in tests/unit/test_dstlint.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import AbstractMesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.tools.dstlint import spmdpass as sp
+from deepspeed_tpu.utils.jax_compat import LEGACY_SHARD_MAP_KW, shard_map
+
+MESH = AbstractMesh((("data", 8),))
+
+
+def trace(fn, avals, in_specs, out_specs=None, mesh=MESH, meta=None,
+          name="fixture"):
+    entry = sp.SpmdEntry(name, lambda: {
+        "fn": fn, "avals": avals, "in_specs": in_specs,
+        "out_specs": out_specs, "mesh": mesh, "meta": dict(meta or {})})
+    rep = sp.trace_spmd_entry_points([entry])[name]
+    assert rep.error is None, rep.error
+    return rep
+
+
+def check(rep, budgets=None):
+    reports = {rep.name: rep}
+    if budgets == "self":
+        budgets = sp.budgets_from_reports(reports)
+    return sp.check_reports(reports, budgets)
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+def x32():
+    return jax.ShapeDtypeStruct((8, 4), jnp.float32)
+
+
+# --- spmd-replication --------------------------------------------------------
+
+def collapse(x):
+    # sum over the sharded dim then broadcast back: the result is the
+    # same on every device — fully replicated despite the sharded input
+    return jnp.broadcast_to(jnp.sum(x, axis=0), x.shape)
+
+
+def test_replication_positive_collapsed_output():
+    rep = trace(collapse, (x32(),), (P("data"),), out_specs=P("data"))
+    assert len(rep.replication) == 1
+    assert "REPLICATED" in rep.replication[0]
+    assert "spmd-replication" in rules_of(check(rep, "self"))
+
+
+def test_replication_negative_with_sharding_constraint():
+    def constrained(x):
+        return jax.lax.with_sharding_constraint(
+            collapse(x), NamedSharding(MESH, P("data")))
+
+    rep = trace(constrained, (x32(),), (P("data"),), out_specs=P("data"))
+    assert rep.replication == []
+    assert check(rep, "self") == []
+
+
+def test_replication_negative_allow_replicated_meta():
+    # the scalar-loss convention: outputs listed in allow_replicated
+    # (or "all") are replicated BY DESIGN and never flagged
+    rep = trace(collapse, (x32(),), (P("data"),), out_specs=P("data"),
+                meta={"allow_replicated": [0]})
+    assert rep.replication == []
+
+
+def test_replication_negative_sharded_flow():
+    # a genuinely sharded computation must not fire (zero-FP bias),
+    # including through rank-equal implicit broadcasts (x - max(x))
+    def f(x):
+        return x - jnp.max(x, axis=1, keepdims=True)
+
+    rep = trace(f, (x32(),), (P("data"),), out_specs=P("data"))
+    assert rep.replication == []
+    assert check(rep, "self") == []
+
+
+# --- spmd-implicit-collective (the silent all-gather) ------------------------
+
+def degather(x):
+    # resharding a data-sharded buffer to replicated: XLA inserts an
+    # all-gather at this constraint
+    return jax.lax.with_sharding_constraint(
+        x * 2.0, NamedSharding(MESH, P()))
+
+
+def test_implicit_all_gather_positive_absent_from_budget():
+    rep = trace(degather, (x32(),), (P("data"),),
+                meta={"allow_replicated": "all"})
+    inv = rep.inventory()
+    assert "all_gather@data:float32" in inv
+    # per-device wire bytes: shard p=(8*4*4)/8=16B, n=8 → p*(n-1)=112
+    rec = inv["all_gather@data:float32"]
+    assert rec["bytes"] == 112 * rec["count"]
+    empty = {"version": 1, "entries": {rep.name: {"collectives": {}}}}
+    got = check(rep, empty)
+    assert "spmd-implicit-collective" in rules_of(got)
+    assert any("NOT in the checked-in comms budget" in f.message
+               for f in got)
+
+
+def test_implicit_all_gather_negative_budgeted():
+    rep = trace(degather, (x32(),), (P("data"),),
+                meta={"allow_replicated": "all"})
+    assert check(rep, "self") == []
+
+
+def test_no_budget_at_all_with_collectives_fires():
+    rep = trace(degather, (x32(),), (P("data"),),
+                meta={"allow_replicated": "all"})
+    got = check(rep, {"version": 1, "entries": {}})
+    assert rules_of(got) == ["spmd-comms-budget"]
+    assert "no checked-in comms budget" in got[0].message
+
+
+# --- spmd-collective-dtype (the EQuARX guardrail) -----------------------------
+
+def _grad_boundary(cast):
+    def f(x):
+        g = jnp.einsum("bd,be->de", x, x)   # contract the data dim →
+        if cast is not None:                # XLA synthesizes the reduce
+            g = g.astype(cast)
+        return jax.lax.with_sharding_constraint(
+            g, NamedSharding(MESH, P("data")))
+
+    return f
+
+
+def test_collective_dtype_positive_fp32_reduction_under_bf16_config():
+    rep = trace(_grad_boundary(None), (x32(),), (P("data"),),
+                meta={"reduction_dtype": "bfloat16",
+                      "allow_replicated": "all"})
+    # reduce immediately re-sharded over its own axis fuses into a
+    # reduce_scatter at the boundary dtype — fp32 here
+    assert "reduce_scatter@data:float32" in rep.inventory()
+    got = check(rep, "self")
+    assert rules_of(got) == ["spmd-collective-dtype"]
+    assert "wider float" in got[0].message
+
+
+def test_collective_dtype_negative_cast_at_boundary():
+    rep = trace(_grad_boundary(jnp.bfloat16), (x32(),), (P("data"),),
+                meta={"reduction_dtype": "bfloat16",
+                      "allow_replicated": "all"})
+    assert "reduce_scatter@data:bfloat16" in rep.inventory()
+    assert check(rep, "self") == []
+
+
+def test_collective_dtype_negative_param_all_gather_exempt():
+    # the optimizer's fp32 master-weight re-gather is budgeted but NOT
+    # dtype-audited: communication_data_type governs reductions
+    rep = trace(degather, (x32(),), (P("data"),),
+                meta={"reduction_dtype": "bfloat16",
+                      "allow_replicated": "all"})
+    assert "all_gather@data:float32" in rep.inventory()
+    assert check(rep, "self") == []
+
+
+# --- spmd-wrong-axis ----------------------------------------------------------
+
+MESH2 = AbstractMesh((("data", 4), ("tensor", 2)))
+
+
+def _smap(axis):
+    return shard_map(lambda a: jax.lax.psum(a, axis), mesh=MESH2,
+                     in_specs=(P("data"),), out_specs=P(),
+                     **LEGACY_SHARD_MAP_KW)
+
+
+def test_wrong_axis_positive_psum_over_unmapped_axis():
+    rep = trace(_smap("tensor"), (x32(),), (P("data"),),
+                meta={"allow_replicated": "all"}, mesh=MESH2)
+    assert len(rep.wrong_axis) == 1
+    assert "unmapped axis" in rep.wrong_axis[0]
+    assert "spmd-wrong-axis" in rules_of(check(rep, "self"))
+
+
+def test_wrong_axis_negative_psum_over_varying_axis():
+    rep = trace(_smap("data"), (x32(),), (P("data"),),
+                meta={"allow_replicated": "all"}, mesh=MESH2)
+    assert rep.wrong_axis == []
+    assert "spmd-wrong-axis" not in rules_of(check(rep, "self"))
+
+
+def test_wrong_axis_negative_axis_index_variance():
+    # the masked-psum broadcast idiom: no INPUT varies over the axis,
+    # but axis_index makes the masked value vary there — not a bug
+    def body(a):
+        idx = jax.lax.axis_index("tensor")
+        return jax.lax.psum(
+            jnp.where(idx == 0, a, jnp.zeros_like(a)), "tensor")
+
+    fn = shard_map(body, mesh=MESH2, in_specs=(P("data"),),
+                   out_specs=P("data"), **LEGACY_SHARD_MAP_KW)
+    rep = trace(fn, (x32(),), (P("data"),),
+                meta={"allow_replicated": "all"}, mesh=MESH2)
+    assert rep.wrong_axis == []
+
+
+# --- spmd-decode-collective (fabricated: while-loop context) ------------------
+
+def _decode_event(count):
+    return sp.CollectiveEvent(
+        kind="psum", axes=("tensor",), dtype="bfloat16", count=count,
+        bytes=256 * count, payload=256, group=2, origin="explicit",
+        context="while_loop")
+
+
+def _decode_report(count, allowance):
+    rep = sp.SpmdReport("serve_decode/fixture")
+    rep.meta = {"while_allowance": allowance}
+    rep.events.append(_decode_event(count))
+    return rep
+
+
+def test_decode_collective_positive_beyond_allowance():
+    rep = _decode_report(2, {})
+    got = check(rep, "self")
+    assert rules_of(got) == ["spmd-decode-collective"]
+    assert "while_loop" in got[0].message
+
+
+def test_decode_collective_negative_within_allowance():
+    rep = _decode_report(2, {"psum@tensor:bfloat16": 2})
+    assert check(rep, "self") == []
+
+
+def test_decode_collective_ignored_without_allowance_meta():
+    # training entries (no while_allowance meta) budget loop collectives
+    # through spmd-comms-budget only
+    rep = sp.SpmdReport("zero_step/fixture")
+    rep.events.append(_decode_event(4))
+    assert "spmd-decode-collective" not in rules_of(check(rep, "self"))
+
+
+# --- spmd-comms-budget (fabricated drift arithmetic) --------------------------
+
+def _inventory_report(name="zero_step/fixture", count=10, nbytes=1000):
+    rep = sp.SpmdReport(name)
+    rep.events.append(sp.CollectiveEvent(
+        kind="psum", axes=("data",), dtype="float32", count=count,
+        bytes=nbytes, payload=nbytes, group=8, origin="inferred",
+        context="top"))
+    return rep
+
+
+def _budget(name, key="psum@data:float32", count=10, nbytes=1000,
+            tol=25):
+    return {"version": 1, "entries": {
+        name: {"tolerance_pct": tol,
+               "collectives": {key: {"count": count, "bytes": nbytes}}}}}
+
+
+def test_budget_within_tolerance_is_clean():
+    rep = _inventory_report(count=11, nbytes=1200)
+    assert check(rep, _budget(rep.name)) == []
+
+
+def test_budget_drift_beyond_tolerance_fires():
+    rep = _inventory_report(count=20, nbytes=1000)
+    got = check(rep, _budget(rep.name))
+    assert rules_of(got) == ["spmd-comms-budget"]
+    assert "drifted" in got[0].message
+
+
+def test_budgeted_collective_disappearing_fires():
+    rep = sp.SpmdReport("zero_step/fixture")     # empty inventory
+    got = check(rep, _budget(rep.name))
+    assert rules_of(got) == ["spmd-comms-budget"]
+    assert "disappeared" in got[0].message
+
+
+def test_budgeted_entry_not_traced_fires():
+    got = sp.check_reports({}, _budget("zero_step/gone"))
+    assert rules_of(got) == ["spmd-comms-budget"]
+    assert "NOT traced" in got[0].message
+
+
+def test_trace_error_is_a_finding():
+    rep = sp.SpmdReport("zero_step/fixture", error="ValueError: boom")
+    got = check(rep, _budget(rep.name))
+    assert rules_of(got) == ["spmd-comms-budget"]
+    assert "failed to trace" in got[0].message
+
+
+# --- the shared wire-byte table -----------------------------------------------
+
+def test_wire_bytes_table():
+    from deepspeed_tpu.comm.collective_cost import wire_bytes
+
+    p, n = 1024, 8
+    assert wire_bytes("psum", p, n) == 2 * p * 7 // 8
+    assert wire_bytes("reduce_scatter", p, n) == p * 7 // 8
+    assert wire_bytes("all_gather", p, n) == p * 7
+    assert wire_bytes("all_to_all", p, n) == p * 7 // 8
+    assert wire_bytes("ppermute", p, n) == p
+    assert wire_bytes("psum", p, 1) == 0          # single-member group
+    assert wire_bytes("shard", p, n) == 0         # constraint, no wire
+
+
+# --- the real entry registry ---------------------------------------------------
+
+def test_entry_registry_spans_training_and_serving():
+    names = [e.name for e in sp.spmd_entry_points()]
+    assert len(names) >= 5
+    assert any("zero_step" in n for n in names)
+    assert any("pipeline" in n for n in names)
+    assert any("moe" in n for n in names)
+    assert any("serve_decode" in n for n in names)
+    assert any("serve_prefill" in n for n in names)

@@ -234,3 +234,34 @@ def test_expert_axis_engine_end_to_end(devices):
     for _ in range(5):
         losses.append(float(engine.train_batch({"x": x, "y": y})))
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_dispatch_constraint_traces_under_abstract_mesh():
+    """Regression (dstlint SPMD pass): the dispatch sharding constraint
+    used to hand XLA a bare PartitionSpec, which only resolves against a
+    physical mesh context — tracing under an AbstractMesh (no devices)
+    raised RuntimeError mid-trace. The constraint now resolves the
+    ambient mesh into a NamedSharding, so the same program traces on a
+    device-less host and runs unchanged under a real mesh."""
+    from jax.sharding import AbstractMesh
+
+    from deepspeed_tpu.moe.sharded_moe import moe_dispatch_combine
+    from deepspeed_tpu.utils.jax_compat import abstract_mesh_context
+
+    mesh = AbstractMesh((("data", 4), ("expert", 2)))
+    sds = jax.ShapeDtypeStruct
+    x = sds((32, 16), jnp.float32)
+    gl = sds((32, 8), jnp.float32)
+    w = sds((8, 16, 32), jnp.float32)
+
+    def fn(x, gate_logits, w):
+        def expert_fn(inp):
+            h = jnp.einsum("ecd,edf->ecf", inp, w)
+            return jnp.einsum("ecf,edf->ecd", jax.nn.relu(h), w)
+
+        return moe_dispatch_combine(x, gate_logits, expert_fn, k=2)
+
+    with abstract_mesh_context(mesh):
+        jaxpr = jax.make_jaxpr(fn)(x, gl, w)   # raised RuntimeError before
+    # the expert-axis constraint must still be IN the traced program
+    assert "sharding_constraint" in str(jaxpr)

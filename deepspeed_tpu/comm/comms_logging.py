@@ -96,6 +96,27 @@ class CommsLogger:
             out[op] = tot
         return out
 
+    def registry_section(self) -> Dict[str, float]:
+        """Flat ``wire_totals()`` view for a dstrace ``MetricsRegistry``
+        collector (``engine.metrics.snapshot()["comm"]``): per-op count
+        / payload / wire bytes plus all-op totals, priced by the SAME
+        ``collective_cost`` table the dstlint SPMD pass budgets with —
+        one arithmetic, three consumers (static lint, runtime log,
+        metrics snapshot), zero drift."""
+        out: Dict[str, float] = {"enabled": float(self.enabled)}
+        total_payload = total_wire = total_count = 0.0
+        for op, tot in self.wire_totals().items():
+            out[f"{op}.count"] = tot["count"]
+            out[f"{op}.payload_bytes"] = tot["payload_bytes"]
+            out[f"{op}.wire_bytes"] = tot["wire_bytes"]
+            total_count += tot["count"]
+            total_payload += tot["payload_bytes"]
+            total_wire += tot["wire_bytes"]
+        out["total.count"] = total_count
+        out["total.payload_bytes"] = total_payload
+        out["total.wire_bytes"] = total_wire
+        return out
+
     def log_summary(self) -> str:
         lines = [f"{'Op':<24}{'Message Size':<16}{'Count':<8}"
                  f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<18}"

@@ -150,6 +150,24 @@ class ServeConfig(DeepSpeedConfigModel):
     # O(pool blocks) of host set arithmetic — at the default cadence it
     # is noise next to one decode program dispatch
     audit_every: int = 64
+    # --- observability (dstrace: deepspeed_tpu/observability,
+    # docs/OBSERVABILITY.md) ----------------------------------------------
+    # per-request lifecycle tracing: QUEUED/PREFILL/DECODE-chunk/
+    # RESTORING spans + one terminal event per request, ring-buffered
+    # host-side at the scheduler's chunk boundaries (the compiled
+    # programs carry zero observability ops — dstlint's jaxpr budgets
+    # pin that). On by default: the ring is bounded memory and the
+    # emission cost is host dict appends between device calls (the
+    # serve bench records the on/off throughput ratio). Read with
+    # engine.export_trace() (Chrome/Perfetto trace-event JSON).
+    trace: bool = True
+    # when set, every generate_stream/serve drain auto-exports the
+    # accumulated trace to this path (Chrome trace-event JSON —
+    # load in https://ui.perfetto.dev)
+    trace_path: Optional[str] = None
+    # trace ring-buffer capacity in events; a long-running server
+    # overwrites its oldest spans instead of growing
+    trace_events: int = 65536
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

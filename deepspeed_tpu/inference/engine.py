@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.observability import MetricsRegistry, RequestTracer
 from deepspeed_tpu.parallel.mesh import make_mesh
 from deepspeed_tpu.parallel.partition import tree_shardings
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -789,6 +790,14 @@ class InferenceEngine:
                 self._quantize_params()
         self._model_times: List[float] = []
         self._profile_model_time = False
+        # --- dstrace observability (docs/OBSERVABILITY.md) -------------------
+        # one metrics registry per engine (serve counters/histograms +
+        # pull collectors — prefix-cache stats re-pointed at the live
+        # scheduler each serve() call) behind serve_metrics(); the
+        # lifecycle tracer is minted lazily at the first traced stream
+        # and persists across serve() calls (ring-buffered)
+        self.metrics = MetricsRegistry()
+        self.tracer: Optional[RequestTracer] = None
         log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}"
                  f"{', int8 weights' if self._quantized else ''}", ranks=[0])
 
@@ -1249,7 +1258,9 @@ class InferenceEngine:
                         queue_timeout_s: Optional[float] = None,
                         lease_timeout_s: Optional[float] = None,
                         audit_every: Optional[int] = None,
-                        fault_injector=None):
+                        fault_injector=None,
+                        trace: Optional[bool] = None,
+                        trace_path: Optional[str] = None):
         """Serve ``requests`` with continuous batching over a paged KV
         cache, yielding a ``Completion`` per request as it finishes.
 
@@ -1316,6 +1327,17 @@ class InferenceEngine:
         :class:`~deepspeed_tpu.inference.faults.FaultInjector`) drives
         deterministic chaos runs. Knob defaults come from the ``serve``
         config section.
+
+        OBSERVABILITY (docs/OBSERVABILITY.md): ``trace`` overrides
+        ``serve.trace`` — when on, the stream records per-request
+        lifecycle spans into the engine's ring-buffered
+        :class:`~deepspeed_tpu.observability.RequestTracer` (read with
+        :meth:`export_trace`); ``trace_path`` (default
+        ``serve.trace_path``) auto-exports Chrome/Perfetto trace-event
+        JSON when the stream closes. Serve counters/histograms land in
+        ``engine.metrics`` either way (:meth:`serve_metrics`). Both are
+        strictly host-side — the compiled programs are identical with
+        tracing on or off.
         """
         from deepspeed_tpu.inference.kv_pool import (
             BlockPool, PrefixCachingBlockPool, blocks_for,
@@ -1337,6 +1359,12 @@ class InferenceEngine:
             "serve() requires a model config (LlamaConfig/TransformerConfig)"
         attn_kernel = self._resolve_attn_kernel(attn_kernel)
         serve_cfg = getattr(self._config, "serve")
+        tr_on = serve_cfg.trace if trace is None else bool(trace)
+        if tr_on:
+            cap = int(serve_cfg.trace_events)
+            if self.tracer is None or self.tracer.capacity != cap:
+                self.tracer = RequestTracer(capacity=cap)
+        tracer = self.tracer if tr_on else None
 
         def rejected_completion(rid, prompt, reason):
             t = time.time()
@@ -1347,6 +1375,12 @@ class InferenceEngine:
                 # (its shape is part of WHY it was rejected)
                 reason = f"{reason}; prompt not int-array-like: {bad}"
                 prompt = np.zeros(0, np.int32)
+            # pre-admission rejections never reach the scheduler, so
+            # their terminal accounting lands here — the chaos contract
+            # (one terminal event per request) spans REJECTED too
+            self.metrics.inc(f"serve.completions.{REJECTED}")
+            if tracer is not None:
+                tracer.terminal(rid, REJECTED, tokens=0)
             return Completion(
                 rid=rid, prompt=prompt,
                 tokens=np.zeros(0, np.int32), t_submit=t, t_admitted=t,
@@ -1464,11 +1498,16 @@ class InferenceEngine:
             audit_every=(serve_cfg.audit_every if audit_every is None
                          else int(audit_every)),
             fault_injector=fault_injector,
-            host_tier=host_tier)
+            host_tier=host_tier, metrics=self.metrics, tracer=tracer)
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log
         self.last_serve_scheduler = scheduler
+        # snapshot() pulls the LIVE scheduler's cache/tier counters —
+        # re-pointed each stream so serve_metrics() always describes the
+        # current session's prefix cache (replacement semantics)
+        self.metrics.register_collector("serve.prefix_cache",
+                                        scheduler.prefix_cache_stats)
         for r in reqs:
             try:
                 scheduler.submit(r, now=r.arrival_time)
@@ -1500,6 +1539,15 @@ class InferenceEngine:
             lease.reclaim(error="stream closed before completion")
             if executor._lease is lease:
                 executor._lease = None
+            out_path = (serve_cfg.trace_path if trace_path is None
+                        else trace_path)
+            if tracer is not None and out_path:
+                try:
+                    tracer.export(out_path)
+                except OSError as e:
+                    # trace export must never fail the stream close
+                    logger.warning("trace export to %s failed: %s",
+                                   out_path, e)
 
     def serve(self, requests, **kwargs):
         """Drain :meth:`generate_stream`; returns completions in finish
@@ -1517,6 +1565,41 @@ class InferenceEngine:
         (the scheduler is only ever stepped by the stream's thread)."""
         sched = getattr(self, "last_serve_scheduler", None)
         return bool(sched is not None and sched.cancel(rid))
+
+    # --- observability (dstrace: docs/OBSERVABILITY.md) -----------------------
+    def serve_metrics(self) -> dict:
+        """One plain-dict snapshot of the engine's metrics registry:
+        serve counters (per-status completions, tokens, preemptions/
+        stalls/spills/restores), gauges (pool occupancy, slot states),
+        histograms (``serve.ttft_s``/``serve.tpot_s``/
+        ``serve.latency_s``/``serve.queue_wait_s`` → count/sum/p50/p95/
+        p99) and the live scheduler's prefix-cache/tier section.
+        ``bench.py --serve`` cross-checks these against its own external
+        measurement so the two can never silently diverge."""
+        return self.metrics.snapshot()
+
+    def export_trace(self, path: Optional[str] = None) -> dict:
+        """The accumulated request-lifecycle trace as a Chrome/Perfetto
+        trace-event JSON object (load in https://ui.perfetto.dev);
+        written to ``path`` when given. Raises if no stream ever ran
+        with tracing on (there is nothing to export — the silent empty
+        trace would read as 'no requests')."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "no trace recorded: run serve()/generate_stream() with "
+                "tracing on (serve.trace, default true) first")
+        if path:
+            return self.tracer.export(path)
+        return self.tracer.chrome()
+
+    def reset_serve_metrics(self) -> None:
+        """Zero the metrics registry and drop accumulated trace events —
+        benchmark isolation between a compile warm-up and the measured
+        run (engine-reported percentiles then describe exactly the
+        timed traffic)."""
+        self.metrics.reset()
+        if self.tracer is not None:
+            self.tracer.clear()
 
     def _get_serve_executor(self, num_slots, block_size, num_blocks,
                             decode_chunk, attn_kernel="reference"):

@@ -69,6 +69,21 @@ byte-identical — only a scatter that dies mid-flight on the donated
 pools escalates to the unattributed-error blast radius), and greedy
 outputs are exactly the untiered path's.
 
+OBSERVABILITY (deepspeed_tpu/observability, docs/OBSERVABILITY.md):
+with a ``tracer`` the scheduler emits per-request lifecycle spans at
+its existing host-call boundaries — ``QUEUED`` (submit→admission),
+``PREFILL``, per-chunk ``DECODE`` with slot/step attribution,
+``RESTORING``, and exactly ONE terminal event per request whose status
+matches the returned :class:`Completion` — plus instants for
+preemption/stall/spill/restore-degrade, auditor failures and injected
+chaos firings; with a ``metrics`` registry it maintains the serve
+counters/gauges/histograms (``serve.ttft_s``, ``serve.tpot_s``,
+``serve.queue_wait_s``, per-status completion counts, pool occupancy)
+behind ``engine.serve_metrics()``. Both are strictly host-side (span
+timestamps are ``time.monotonic()`` captured BETWEEN executor calls) —
+the compiled programs carry zero observability ops, which dstlint's
+jaxpr budgets pin.
+
 The scheduler is pure host logic over an EXECUTOR protocol, so its
 admission/recycling/backpressure/growth behavior is unit-tested with a
 fake executor (tests/unit/inference/test_scheduler.py); the real
@@ -242,15 +257,17 @@ class _Restore:
     FAILED)."""
 
     __slots__ = ("req", "handle", "entries", "start", "dev_start",
-                 "t_admit")
+                 "t_admit", "t_mono")
 
-    def __init__(self, req, handle, entries, start, dev_start, t_admit):
+    def __init__(self, req, handle, entries, start, dev_start, t_admit,
+                 t_mono=0.0):
         self.req = req
         self.handle = handle
         self.entries = entries
         self.start = int(start)
         self.dev_start = int(dev_start)
         self.t_admit = t_admit
+        self.t_mono = t_mono
 
 
 class ContinuousBatchingScheduler:
@@ -270,7 +287,7 @@ class ContinuousBatchingScheduler:
                  queue_timeout_s: Optional[float] = None,
                  audit_every: int = 64,
                  fault_injector: Optional[FaultInjector] = None,
-                 host_tier=None):
+                 host_tier=None, metrics=None, tracer=None):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -360,6 +377,74 @@ class ContinuousBatchingScheduler:
         self.occupancy_log: Optional[List[dict]] = \
             [] if record_occupancy else None
         self._submit_times = {}
+        # --- observability (deepspeed_tpu/observability) --------------------
+        # metrics: a MetricsRegistry absorbing the serve counters/
+        # histograms; tracer: a RequestTracer emitting lifecycle spans.
+        # Both optional and strictly host-side — every emission below
+        # sits at an existing host-call boundary, never inside jit.
+        self.metrics = metrics
+        self.tracer = tracer
+        # monotonic submit stamps for QUEUED spans (wall-clock
+        # _submit_times stays the Completion API timebase)
+        self._submit_mono: Dict[Any, float] = {}
+        # high-water mark into fault_injector.log already traced
+        self._fi_traced = 0
+
+    # --- observability emission helpers ---------------------------------------
+    def _trace_queued_end(self, rid: Any) -> None:
+        """Close ``rid``'s QUEUED span — at admission, or at a terminal
+        reached while still queued. Pops the monotonic submit stamp so
+        the span is emitted exactly once per queue residency (a
+        preemption re-stamps, giving the requeue its own span)."""
+        t0 = self._submit_mono.pop(rid, None)
+        tr = self.tracer
+        if tr is not None and t0 is not None:
+            tr.span("QUEUED", t0, tr.now(), rid=rid)
+
+    def _obs_terminal(self, comp: Completion) -> Completion:
+        """The one terminal emission every Completion passes through:
+        a per-status completion counter, latency/TPOT histograms, and
+        the trace's terminal event (chaos tests pin exactly one per
+        request, status matching)."""
+        m = self.metrics
+        if m is not None:
+            n = int(comp.tokens.size)
+            m.inc(f"serve.completions.{comp.status}")
+            m.inc("serve.tokens_generated", n)   # DELIVERED tokens
+            m.observe("serve.latency_s",
+                      max(0.0, comp.t_finish - comp.t_submit))
+            if n > 0:
+                # per-request latency breakdown lands HERE — once per
+                # request, from the same Completion fields the bench
+                # measures externally — so a preempted-and-regenerated
+                # request contributes exactly one TTFT/queue-wait
+                # sample (its final attempt's), never one per admission
+                m.observe("serve.ttft_s",
+                          max(0.0, comp.t_first_token - comp.t_submit))
+                m.observe("serve.queue_wait_s",
+                          max(0.0, comp.t_admitted - comp.t_submit))
+            if comp.status == COMPLETED and n > 1 \
+                    and comp.t_finish > comp.t_first_token:
+                # time-per-output-token over the decode phase (first
+                # token is TTFT's; the remaining n-1 are decode steps)
+                m.observe("serve.tpot_s",
+                          (comp.t_finish - comp.t_first_token) / (n - 1))
+        if self.tracer is not None:
+            self.tracer.terminal(comp.rid, comp.status,
+                                 tokens=int(comp.tokens.size))
+        return comp
+
+    def _trace_chaos(self) -> None:
+        """Mirror NEW fault-injector firings into the trace (the
+        injector's log is the source of truth; this just replays the
+        tail so auditor/chaos analysis lives in one timeline)."""
+        fi, tr = self.fault_injector, self.tracer
+        if fi is None or tr is None:
+            return
+        for entry in fi.log[self._fi_traced:]:
+            detail = {k: v for k, v in entry.items() if k != "site"}
+            tr.instant(f"CHAOS/{entry['site']}", cat="chaos", **detail)
+        self._fi_traced = len(fi.log)
 
     # --- queue ---------------------------------------------------------------
     def submit(self, req: Request, now: Optional[float] = None) -> None:
@@ -381,6 +466,15 @@ class ContinuousBatchingScheduler:
                 f"num_blocks")
         self._submit_times[req.rid] = (now if now is not None
                                        else time.time())
+        if self.tracer is not None:
+            # trace-replay submissions carry a future arrival: start the
+            # QUEUED span at the nominal arrival, not the bulk submit
+            t_m = self.tracer.now()
+            if now is not None:
+                t_m += max(0.0, now - time.time())
+            self._submit_mono[req.rid] = t_m
+        if self.metrics is not None:
+            self.metrics.inc("serve.requests_submitted")
         self.queue.append(req)
 
     @property
@@ -418,9 +512,20 @@ class ContinuousBatchingScheduler:
         entries, self._pending_spills = self._pending_spills, []
         try:
             self.executor.spill_blocks(entries)
+            if self.metrics is not None:
+                self.metrics.inc("serve.host_spill_blocks", len(entries))
+            if self.tracer is not None:
+                self.tracer.instant("SPILL", cat="tiering",
+                                    blocks=len(entries))
         except Exception as e:
             self.host_spill_failures += len(entries)
             self.last_spill_error = str(e)
+            if self.metrics is not None:
+                self.metrics.inc("serve.host_spill_failures",
+                                 len(entries))
+            if self.tracer is not None:
+                self.tracer.instant("SPILL_FAIL", cat="tiering",
+                                    blocks=len(entries), error=str(e))
 
     def next_arrival(self) -> Optional[float]:
         """Earliest queued arrival_time, for idle waiting."""
@@ -452,12 +557,13 @@ class ContinuousBatchingScheduler:
         t_sub = self._submit_times.pop(req.rid, now)
         self._cancelled.discard(req.rid)
         self._preempt_counts.pop(req.rid, None)
-        return Completion(
+        self._trace_queued_end(req.rid)
+        return self._obs_terminal(Completion(
             rid=req.rid, prompt=req.prompt,
             tokens=np.zeros(0, np.int32), t_submit=t_sub,
             t_admitted=now if t_admitted is None else t_admitted,
             t_first_token=now, t_finish=now,
-            status=status, error=error)
+            status=status, error=error))
 
     def _terminal_slot(self, slot_id: int, status: str, error: str,
                        now: float, register: bool = True) -> Completion:
@@ -470,12 +576,12 @@ class ContinuousBatchingScheduler:
         req = slot.req
         if register:
             self._register_slot_prefix(slot_id)
-        comp = Completion(
+        comp = self._obs_terminal(Completion(
             rid=req.rid, prompt=req.prompt,
             tokens=np.asarray(slot.out, np.int32),
             t_submit=self._submit_times.pop(req.rid, slot.t_admitted),
             t_admitted=slot.t_admitted, t_first_token=slot.t_first,
-            t_finish=now, status=status, error=error)
+            t_finish=now, status=status, error=error))
         self._cancelled.discard(req.rid)
         self._preempt_counts.pop(req.rid, None)
         self.tables.release(slot_id)
@@ -597,6 +703,12 @@ class ContinuousBatchingScheduler:
                 self.tables.assign(slot_id, admit_tokens)
             self.queue.popleft()
             t_admit = time.time()
+            self._trace_queued_end(req.rid)
+            if self.metrics is not None:
+                # operational counter (re-admissions after preemption
+                # count again); the per-request queue_wait_s histogram
+                # is observed once, at the terminal (_obs_terminal)
+                self.metrics.inc("serve.admissions")
             # allocation above may have evicted cached blocks — their
             # frames must reach the host tier before ANY executor call
             # can write pool blocks (CoW copy, prefill)
@@ -639,9 +751,15 @@ class ContinuousBatchingScheduler:
                     self._restores[slot_id] = _Restore(
                         req=req, handle=handle, entries=entries,
                         start=min(covered, len(req.prompt) - 1),
-                        dev_start=start, t_admit=t_admit)
+                        dev_start=start, t_admit=t_admit,
+                        t_mono=(self.tracer.now()
+                                if self.tracer is not None else 0.0))
+                    if self.metrics is not None:
+                        self.metrics.inc("serve.restores_dispatched")
                     continue
                 self.host_restore_failures += 1
+                if self.metrics is not None:
+                    self.metrics.inc("serve.host_restore_failures")
             first, failed = self._prefill_slot(slot_id, req, start,
                                                t_admit, bind=True,
                                                copy_pairs=copy_pairs)
@@ -665,6 +783,9 @@ class ContinuousBatchingScheduler:
         isolation envelope (the finish-restore path bound its slot at
         ``begin_restore`` time). Returns ``(first_token, None)`` on
         success or ``(None, FAILED Completion)``."""
+        tr = self.tracer
+        t0_m = tr.now() if tr is not None else 0.0
+        t0_w = time.time()
         try:
             if bind:
                 self.executor.set_slot(slot_id, req)
@@ -684,8 +805,19 @@ class ContinuousBatchingScheduler:
                 if start else
                 self.executor.prefill(slot_id, req.prompt,
                                       self.tables.table[slot_id]))
+            if tr is not None:
+                tr.span("PREFILL", t0_m, tr.now(),
+                        tid=1 + slot_id, rid=req.rid, slot=slot_id,
+                        start=int(start), tokens=len(req.prompt) - start)
+            if self.metrics is not None:
+                self.metrics.observe("serve.prefill_s",
+                                     time.time() - t0_w)
             return first, None
         except Exception as e:
+            if tr is not None:
+                tr.span("PREFILL", t0_m, tr.now(),
+                        tid=1 + slot_id, rid=req.rid, slot=slot_id,
+                        start=int(start), error=str(e))
             self.tables.release(slot_id)
             self._clear_slot(slot_id)
             return None, self._terminal_queued(
@@ -712,6 +844,13 @@ class ContinuousBatchingScheduler:
         self.seq_lens[slot_id] = slot.seq_len
         self.last_tokens[slot_id] = first
         self._register_slot_prefix(slot_id)
+        if self.metrics is not None:
+            # work-done counters (a preempted request's regenerated
+            # tokens count again — honest compute accounting); the
+            # DELIVERED-token counter and the per-request TTFT sample
+            # land once, at the terminal (_obs_terminal)
+            self.metrics.inc("serve.prefills")
+            self.metrics.inc("serve.tokens_sampled")
         hit_eos = req.eos_id >= 0 and first == req.eos_id
         if slot.remaining == 0 or hit_eos:
             return [self._finish(slot_id, t_first)]
@@ -734,6 +873,7 @@ class ContinuousBatchingScheduler:
             return []
         done: List[Completion] = []
         fi = self.fault_injector
+        tr = self.tracer
         for slot_id in sorted(self._restores):
             st = self._restores.pop(slot_id)
             req = st.req
@@ -759,6 +899,13 @@ class ContinuousBatchingScheduler:
                 # and every runnable slot; queued requests keep serving
                 self.last_restore_error = str(e)
                 self.host_restore_failures += 1
+                if self.metrics is not None:
+                    self.metrics.inc("serve.host_restore_failures")
+                if tr is not None:
+                    tr.span("RESTORING", st.t_mono, tr.now(),
+                            tid=1 + slot_id, rid=req.rid, slot=slot_id,
+                            blocks=len(st.entries), ok=False,
+                            error=str(e))
                 t_err = time.time()
                 self.tables.release(slot_id)
                 self._clear_slot(slot_id)
@@ -775,6 +922,17 @@ class ContinuousBatchingScheduler:
                 for s2 in sorted(self._restores):
                     st2 = self._restores[s2]
                     self.host_restore_failures += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("serve.host_restore_failures")
+                    if tr is not None:
+                        # the sibling's restore also ends here — close
+                        # its RESTORING span so the trace shows the
+                        # full interval, not admitted→terminal with a
+                        # hole exactly where the failure needs debugging
+                        tr.span("RESTORING", st2.t_mono, tr.now(),
+                                tid=1 + s2, rid=st2.req.rid, slot=s2,
+                                blocks=len(st2.entries), ok=False,
+                                error=str(e))
                     self.tables.release(s2)
                     self._clear_slot(s2)       # drops the handle
                     done.append(self._terminal_queued(
@@ -782,6 +940,13 @@ class ContinuousBatchingScheduler:
                         f"executor restore error: {e}", t_err,
                         t_admitted=st2.t_admit))
                 break
+            if tr is not None:
+                tr.span("RESTORING", st.t_mono, tr.now(),
+                        tid=1 + slot_id, rid=req.rid, slot=slot_id,
+                        blocks=len(st.entries), ok=bool(ok))
+            if self.metrics is not None:
+                self.metrics.inc("serve.host_restores" if ok
+                                 else "serve.host_restore_failures")
             if ok:
                 start = st.start
                 self.host_restores += 1
@@ -829,12 +994,12 @@ class ContinuousBatchingScheduler:
     def _finish(self, slot_id: int, t_finish: float) -> Completion:
         slot = self.slots[slot_id]
         req = slot.req
-        comp = Completion(
+        comp = self._obs_terminal(Completion(
             rid=req.rid, prompt=req.prompt,
             tokens=np.asarray(slot.out, np.int32),
             t_submit=self._submit_times.pop(req.rid, slot.t_admitted),
             t_admitted=slot.t_admitted, t_first_token=slot.t_first,
-            t_finish=t_finish)
+            t_finish=t_finish))
         self._cancelled.discard(req.rid)
         self._preempt_counts.pop(req.rid, None)
         # index full blocks (now including generated content — a future
@@ -887,7 +1052,17 @@ class ContinuousBatchingScheduler:
                         cur += take
             cap = cur * bs - slot.seq_len
             self._cap_steps[slot_id] = cap
-            self.stalled[slot_id] = cap <= 0
+            now_stalled = cap <= 0
+            if now_stalled and not self.stalled[slot_id]:
+                # transition INTO a stall — pool could not cover the
+                # slot's next write (the exhaustion ladder's first rung)
+                if self.metrics is not None:
+                    self.metrics.inc("serve.stalls")
+                if self.tracer is not None:
+                    self.tracer.instant(
+                        "STALL", tid=1 + slot_id, slot=slot_id,
+                        rid=slot.req.rid, seq_len=int(slot.seq_len))
+            self.stalled[slot_id] = now_stalled
 
     def _preempt_for_progress(self, now: float) -> Optional[Completion]:
         """Total-stall safety valve: every active slot needs a block and
@@ -917,6 +1092,11 @@ class ContinuousBatchingScheduler:
         self.preemptions += 1
         count = self._preempt_counts.get(req.rid, 0) + 1
         self._preempt_counts[req.rid] = count
+        if self.metrics is not None:
+            self.metrics.inc("serve.preemptions")
+        if self.tracer is not None:
+            self.tracer.instant("PREEMPT", tid=1 + victim, slot=victim,
+                                rid=req.rid, count=count)
         if count > self.max_preemptions:
             return self._terminal_slot(
                 victim, PREEMPTED_LIMIT,
@@ -930,6 +1110,11 @@ class ContinuousBatchingScheduler:
         self._register_slot_prefix(victim)
         self.tables.release(victim)
         self._clear_slot(victim)
+        if self.tracer is not None:
+            # the requeue opens a fresh QUEUED span (the wall-clock
+            # submit time — hence queue_wait/TTFT accounting — is the
+            # ORIGINAL one; the trace shows each residency separately)
+            self._submit_mono[req.rid] = self.tracer.now()
         self.queue.appendleft(req)     # keeps original submit time
         return None
 
@@ -1027,6 +1212,9 @@ class ContinuousBatchingScheduler:
         # growth allocations above may have evicted cached blocks —
         # spill their frames before the decode program writes the pool
         self._flush_spills()
+        tr = self.tracer
+        t_dec0 = tr.now() if tr is not None else 0.0
+        t_dec0_w = time.time()
         try:
             if fi is not None:
                 delay = fi.chunk_delay(self._step_idx)
@@ -1038,6 +1226,9 @@ class ContinuousBatchingScheduler:
                 self.seq_lens.copy(), runnable.copy(),
                 eff_steps, max_steps), np.int32)
         except Exception as e:
+            if tr is not None:
+                tr.span("DECODE", t_dec0, tr.now(), cat="executor",
+                        step=self._step_idx, error=str(e))
             # PER-REQUEST ISOLATION (mid-decode): the call failed as a
             # whole, so NO slot consumed tokens this step. A
             # slot-attributed RequestFault fails exactly that request;
@@ -1050,9 +1241,16 @@ class ContinuousBatchingScheduler:
         if toks.ndim == 1:
             toks = toks[:, None]
         t_now = time.time()
+        t_dec1 = tr.now() if tr is not None else 0.0
+        if self.metrics is not None:
+            self.metrics.inc("serve.decode_calls")
+            self.metrics.observe("serve.decode_chunk_s",
+                                 max(0.0, t_now - t_dec0_w))
         for slot_id, slot in enumerate(self.slots):
             if not runnable[slot_id]:
                 continue
+            rid = slot.req.rid
+            consumed = 0
             for tok in toks[slot_id]:
                 if slot.remaining <= 0:
                     break              # chunked executor overshoot: ignore
@@ -1060,21 +1258,55 @@ class ContinuousBatchingScheduler:
                 slot.out.append(tok)
                 slot.seq_len += 1      # the fed token's KV was written
                 slot.remaining -= 1
+                consumed += 1
                 self.last_tokens[slot_id] = tok
                 if (slot.req.eos_id >= 0 and tok == slot.req.eos_id):
                     slot.remaining = 0
             self.seq_lens[slot_id] = slot.seq_len
             self.steps_left[slot_id] = slot.remaining
+            if consumed:
+                if tr is not None:
+                    # one DECODE span per participating slot per chunk —
+                    # Perfetto then shows each slot lane's request
+                    # interleaving with per-chunk token attribution
+                    tr.span("DECODE", t_dec0, t_dec1, tid=1 + slot_id,
+                            rid=rid, slot=slot_id, step=self._step_idx,
+                            tokens=consumed)
+                if self.metrics is not None:
+                    self.metrics.inc("serve.tokens_sampled", consumed)
             if slot.remaining <= 0:
                 done.append(self._finish(slot_id, t_now))
         self._finish_step(now)
         return done
 
     def _finish_step(self, now: float) -> None:
-        """Common step epilogue: occupancy sample + auditor cadence."""
+        """Common step epilogue: occupancy sample, pool gauges, chaos
+        trace mirror + auditor cadence."""
         self._record_occupancy(now)
+        m = self.metrics
+        if m is not None:
+            m.set_gauge("serve.pool_blocks_allocated",
+                        self.pool.num_allocated)
+            m.set_gauge("serve.pool_blocks_free", self.pool.num_free)
+            m.set_gauge("serve.pool_blocks_cached",
+                        getattr(self.pool, "num_cached", 0))
+            m.set_gauge("serve.active_slots", int(self.active.sum()))
+            m.set_gauge("serve.stalled_slots", int(self.stalled.sum()))
+            m.set_gauge("serve.restoring_slots", len(self._restores))
+            m.set_gauge("serve.queued", len(self.queue))
+            m.set_gauge("serve.live_tokens", int(self.seq_lens.sum()))
+        self._trace_chaos()
         if self.audit_every > 0 and self._step_idx % self.audit_every == 0:
-            self.audit(context=f"step {self._step_idx}")
+            try:
+                self.audit(context=f"step {self._step_idx}")
+            except PoolAuditError:
+                if self.tracer is not None:
+                    self.tracer.instant(
+                        "AUDIT_FAIL", cat="audit",
+                        violations=list(self.last_audit_violations))
+                if m is not None:
+                    m.inc("serve.audit_failures")
+                raise
 
     def _on_decode_error(self, e: Exception, runnable: np.ndarray,
                          now: float) -> List[Completion]:

@@ -1,11 +1,27 @@
 """Metric event sinks (reference ``deepspeed/monitor/monitor.py:29``).
 
-``MonitorMaster`` fans out (name, value, step) events to TensorBoard, WandB,
-and CSV sinks, each config-gated. Event names keep the reference's contract
-(``Train/Samples/train_loss`` etc., SURVEY §8.6) so dashboards port
-unchanged. Only the JAX process 0 writes (reference checks rank 0).
+``MonitorMaster`` fans out (name, value, step) events to JSONL,
+TensorBoard, WandB, and CSV sinks, each config-gated. Event names keep
+the reference's contract (``Train/Samples/train_loss`` etc., SURVEY
+§8.6) so dashboards port unchanged. Only the JAX process 0 writes
+(reference checks rank 0).
+
+The JSONL sink is the DEFAULT backend: dependency-free (stdlib json to
+one append-only file), it activates automatically whenever monitoring
+is enabled — before it, a torch-free install with ``tensorboard:
+{enabled: true}`` silently lost every event. ``jsonl_monitor:
+{enabled: false}`` opts out; ``{enabled: true}`` turns monitoring on by
+itself.
+
+dstrace integration (docs/OBSERVABILITY.md): :meth:`MonitorMaster.
+write_registry` drains a ``MetricsRegistry`` snapshot into the same
+event stream (counters/gauges verbatim, histograms as their summary
+stats), so the training engine's registry — step timers, throughput,
+ZeRO reduction bytes, comms wire totals — reaches every configured
+dashboard without a second plumbing path.
 """
 
+import json
 import os
 from typing import List, Tuple
 
@@ -90,6 +106,39 @@ class csvMonitor(Monitor):
             self.filehandles[name].flush()
 
 
+class JSONLMonitor(Monitor):
+    """Dependency-free default sink: one append-only ``events.jsonl``
+    (``{"name", "value", "step"}`` per line) under
+    ``output_path/job_name``. ``config.enabled`` is tri-state: None =
+    AUTO (on whenever any monitoring is on — ``auto_enabled``), so a
+    stack with no torch/tensorboard/wandb still lands its events on
+    disk instead of silently dropping them."""
+
+    def __init__(self, config, auto_enabled: bool = False):
+        self.enabled = (auto_enabled if config.enabled is None
+                        else bool(config.enabled))
+        self._fh = None
+        if not self.enabled or jax.process_index() != 0:
+            return
+        out_dir = os.path.join(config.output_path or "./jsonl_logs",
+                               config.job_name)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            self.path = os.path.join(out_dir, "events.jsonl")
+            self._fh = open(self.path, "a")
+        except OSError as e:
+            logger.warning(f"jsonl monitor unusable ({e}); disabling")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._fh is None:
+            return
+        for name, value, step in event_list:
+            self._fh.write(json.dumps({"name": name, "value": float(value),
+                                       "step": int(step)}) + "\n")
+        self._fh.flush()
+
+
 class MonitorMaster(Monitor):
     """Fan-out master (reference monitor/monitor.py:29)."""
 
@@ -97,12 +146,48 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = csvMonitor(ds_config.csv_monitor)
-        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
-                        or self.csv_monitor.enabled)
+        # the dependency-free default: auto-on when anything above asked
+        # for monitoring (or when explicitly enabled by itself)
+        any_other = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                     or self.csv_monitor.enabled)
+        self.jsonl_monitor = JSONLMonitor(ds_config.jsonl_monitor,
+                                          auto_enabled=any_other)
+        self.enabled = any_other or self.jsonl_monitor.enabled
 
     def write_events(self, event_list: List[Event]) -> None:
         if jax.process_index() != 0:
             return
-        for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for sink in (self.tb_monitor, self.wandb_monitor,
+                     self.csv_monitor, self.jsonl_monitor):
             if sink.enabled:
                 sink.write_events(event_list)
+
+    def write_registry(self, registry, step: int,
+                       prefix: str = "Registry") -> None:
+        """Drain a dstrace ``MetricsRegistry`` snapshot into the event
+        stream: counters and gauges verbatim, histograms as their
+        summary statistics (count/sum/mean/p50/p95/p99) — the path by
+        which the training registry (timers, throughput, ZeRO reduction
+        bytes, comms wire totals) reaches every configured sink."""
+        snap = registry.snapshot()
+        events: List[Event] = []
+        for name, v in snap.get("counters", {}).items():
+            events.append((f"{prefix}/{name}", v, step))
+        for name, v in snap.get("gauges", {}).items():
+            events.append((f"{prefix}/{name}", v, step))
+        for name, stats in snap.get("histograms", {}).items():
+            for stat, v in stats.items():
+                events.append((f"{prefix}/{name}/{stat}", v, step))
+        # collector sections (comms wire totals, prefix-cache stats)
+        # sit at the snapshot's top level under their own names —
+        # drain their numeric leaves too, or the comm bytes the
+        # registry exists to absorb would never reach a dashboard
+        core = {"counters", "gauges", "histograms"}
+        for section, data in snap.items():
+            if section in core or not isinstance(data, dict):
+                continue
+            for name, v in data.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    events.append((f"{prefix}/{section}.{name}", v, step))
+        if events:
+            self.write_events(events)

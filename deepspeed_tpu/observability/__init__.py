@@ -1,0 +1,28 @@
+"""dstrace — unified observability for the serving and training stacks.
+
+One metrics registry (``MetricsRegistry``: counters, gauges, log-bucket
+histograms, pull collectors → a single ``snapshot()`` dict) plus one
+per-request lifecycle tracer (``RequestTracer``: ring-buffered spans at
+the scheduler's host-call boundaries, exported as Chrome/Perfetto
+trace-event JSON). Entry points:
+
+- serving: ``InferenceEngine.serve_metrics()`` /
+  ``engine.export_trace()`` / the ``serve.trace*`` knobs
+  (docs/OBSERVABILITY.md);
+- training: ``DeepSpeedEngine.metrics`` (timers, throughput, ZeRO
+  reduction bytes, comms wire totals), drained by ``monitor/`` sinks.
+
+Everything here is strictly host-side — dstlint's jaxpr budgets prove
+instrumentation adds zero traced equations to the compiled programs.
+"""
+
+from deepspeed_tpu.observability.metrics import (
+    Histogram, MetricsRegistry, default_registry,
+)
+from deepspeed_tpu.observability.tracer import (
+    RequestTracer, SCHEDULER_TID, slot_tid, validate_chrome_trace,
+)
+
+__all__ = ["Histogram", "MetricsRegistry", "default_registry",
+           "RequestTracer", "SCHEDULER_TID", "slot_tid",
+           "validate_chrome_trace"]

@@ -1,0 +1,240 @@
+"""dstrace metrics registry — lock-cheap in-process counters, gauges and
+log-bucketed histograms behind ONE ``snapshot()``.
+
+The serving and training stacks grew telemetry in five dialects
+(``prefix_cache_stats()`` counters, ``comms_logging`` wire totals,
+``utils/timer.py`` wall clocks, auditor/chaos events, ``monitor/``
+events); this registry is the common store they all land in. Design
+constraints, in order:
+
+1. **Hot-path cheap.** An ``inc``/``observe`` is a dict lookup plus an
+   int add — no locks on the update path (CPython's GIL makes the
+   single-writer scheduler/train loops safe; a lock guards only metric
+   CREATION, which happens once per name). Nothing here may sit inside
+   a jitted program: callers instrument at host-call boundaries only
+   (chunk boundaries in serving, step boundaries in training), which
+   dstlint's ``no-host-sync-in-jit`` + jaxpr-budget gates enforce.
+2. **Fixed memory.** A histogram is a fixed array of log-spaced bucket
+   counts (default 48 buckets/decade over 1e-6..1e5 — wide enough for
+   µs kernel dispatches and minute-long queue waits in one shape), so
+   unbounded traffic cannot grow the registry.
+3. **One plain-dict snapshot.** ``snapshot()`` returns counters, gauges,
+   histogram summaries (count/sum/min/max/mean + p50/p95/p99 from
+   geometric in-bucket interpolation, clamped to the observed range)
+   and every registered COLLECTOR section (pull-style adapters for
+   telemetry that already lives elsewhere — ``prefix_cache_stats()``,
+   ``comms_logger.wire_totals()`` — absorbed at read time instead of
+   double-written on the hot path).
+
+Counters are monotonic for the registry's life; ``reset()`` exists for
+benchmark isolation (bench.py re-zeros between the warm-up and the
+measured run so engine-reported percentiles describe exactly the timed
+traffic).
+"""
+
+import math
+import threading
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Histogram", "MetricsRegistry", "default_registry"]
+
+
+class Histogram:
+    """Fixed log-spaced-bucket histogram with percentile estimation.
+
+    Buckets are geometric: edge ``i`` is ``lo * ratio**i`` with
+    ``ratio = 10 ** (1 / buckets_per_decade)``; a value lands in the
+    first bucket whose upper edge covers it (below ``lo`` clamps into
+    bucket 0, above ``hi`` into the overflow bucket). At the default 48
+    buckets/decade one bucket spans ~4.9%, so an interpolated quantile
+    is within ~±2.5% of the exact order statistic — comfortably inside
+    the 5% engine-vs-bench TTFT agreement the serve bench asserts.
+    """
+
+    __slots__ = ("lo", "hi", "ratio", "_log_lo", "_log_ratio", "_counts",
+                 "count", "sum", "min", "max")
+
+    def __init__(self, lo: float = 1e-6, hi: float = 1e5,
+                 buckets_per_decade: int = 48):
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+        self.lo = float(lo)
+        self.hi = float(hi)
+        decades = math.log10(hi / lo)
+        n = max(1, int(round(decades * buckets_per_decade)))
+        self.ratio = (hi / lo) ** (1.0 / n)
+        self._log_lo = math.log(self.lo)
+        self._log_ratio = math.log(self.ratio)
+        # n bounded buckets + 1 overflow bucket
+        self._counts = [0] * (n + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= self.lo:
+            i = 0
+        elif v > self.hi:
+            i = len(self._counts) - 1
+        else:
+            # first edge covering v: lo * ratio**i >= v
+            i = math.ceil((math.log(v) - self._log_lo)
+                          / self._log_ratio - 1e-9)
+            i = min(max(i, 0), len(self._counts) - 1)
+        self._counts[i] += 1
+
+    def percentile(self, q: float) -> float:
+        """Estimated ``q``-quantile (0 < q <= 1): geometric interpolation
+        inside the covering bucket, clamped to [min, max] seen — so a
+        single-observation histogram reports the value exactly."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                frac = (target - cum) / c
+                upper = self.lo * self.ratio ** i
+                lower = upper / self.ratio if i > 0 else self.lo / self.ratio
+                if i == len(self._counts) - 1:
+                    # overflow bucket: everything here is > hi, bounded
+                    # above only by the observed max — interpolate
+                    # geometrically across [hi, max] so tail quantiles
+                    # track the tail instead of pinning at hi (which the
+                    # [min, max] clamp could then drag DOWN to min when
+                    # every sample overflowed)
+                    top = max(self.max, self.hi)
+                    est = self.hi * (top / self.hi) ** frac
+                else:
+                    est = lower * (upper / lower) ** frac
+                return min(max(est, self.min), self.max)
+            cum += c
+        return min(max(self.hi, self.min), self.max)
+
+    def summary(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "mean": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.sum / self.count,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+    @property
+    def bucket_counts(self) -> List[int]:
+        """Raw bucket counts (tests: bucket math, fixed memory)."""
+        return list(self._counts)
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms + pull collectors, one snapshot.
+
+    Update calls are safe from the single scheduler/train thread without
+    locking; the internal lock guards only first-touch creation of a
+    metric (and collector (re)registration), so concurrent readers of
+    ``snapshot()`` never see a dict mid-rehash."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._collectors: Dict[str, Callable[[], dict]] = {}
+
+    # --- counters -------------------------------------------------------------
+    def inc(self, name: str, n: float = 1) -> None:
+        """Add ``n`` (>= 0) to the monotonic counter ``name``."""
+        try:
+            self._counters[name] += n
+        except KeyError:
+            with self._lock:
+                self._counters[name] = self._counters.get(name, 0) + n
+
+    # --- gauges ---------------------------------------------------------------
+    def set_gauge(self, name: str, v: float) -> None:
+        self._gauges[name] = float(v)
+
+    # --- histograms -----------------------------------------------------------
+    def histogram(self, name: str, lo: float = 1e-6, hi: float = 1e5,
+                  buckets_per_decade: int = 48) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.get(name)
+                if h is None:
+                    h = Histogram(lo, hi, buckets_per_decade)
+                    self._hists[name] = h
+        return h
+
+    def observe(self, name: str, v: float) -> None:
+        self.histogram(name).observe(v)
+
+    # --- collectors -----------------------------------------------------------
+    def register_collector(self, name: str,
+                           fn: Callable[[], dict]) -> None:
+        """Register (or replace) a pull-style section: ``snapshot()``
+        calls ``fn()`` and merges the returned dict under ``name``.
+        Replacement semantics let a long-lived engine re-point a section
+        at its CURRENT scheduler each ``serve()`` call."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    # --- read side ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Everything, as one plain dict (JSON-serializable)."""
+        out = {
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "histograms": {name: h.summary()
+                           for name, h in self._hists.items()},
+        }
+        for name, fn in list(self._collectors.items()):
+            try:
+                out[name] = fn()
+            except Exception as e:
+                # a dead collector (e.g. a collected scheduler) must not
+                # take the whole snapshot down — surface the failure as
+                # data instead
+                out[name] = {"collector_error": str(e)}
+        return out
+
+    def reset(self) -> None:
+        """Zero every metric (bench isolation between warm-up and the
+        measured run). Collectors stay registered — their sources own
+        their own lifetimes."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """Process-global registry for code with no engine handle (ad-hoc
+    scripts, tools). Engines own per-instance registries — test
+    isolation and multi-engine processes need them separate."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _default_lock:
+            if _DEFAULT is None:
+                _DEFAULT = MetricsRegistry()
+    return _DEFAULT

@@ -1,0 +1,184 @@
+"""dstrace request-lifecycle tracer — ring-buffered spans, Chrome/Perfetto
+trace-event export.
+
+The tracer records what the continuous-batching scheduler already knows
+at its host-call boundaries: per-request lifecycle spans
+(``QUEUED`` → ``PREFILL`` → per-chunk ``DECODE`` → ``RESTORING`` →
+terminal) plus instant events for preemption/stall/spill/restore,
+auditor failures and injected chaos. Constraints:
+
+- **Host-side only.** Every emission happens between jitted program
+  calls (the scheduler's chunk boundaries); nothing here may touch a
+  traced value. dstlint's jaxpr budgets prove the compiled serving
+  programs carry zero observability equations.
+- **Monotonic clock.** Timestamps come from ``time.monotonic()`` — an
+  NTP step mid-serve must not fold a span negative. Wall-clock times
+  on ``Completion`` stay the API; the trace is a separate timebase.
+- **Bounded memory.** Events land in a ``deque(maxlen=capacity)``; a
+  long-running server overwrites its oldest spans instead of growing
+  (``dropped`` counts what the ring evicted).
+
+Export is Chrome trace-event JSON (the ``traceEvents`` array form) —
+loadable in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+Track layout: one pid, tid 0 is the scheduler, tid ``1 + slot`` is each
+decode slot, so Perfetto renders slot occupancy as lanes with request
+spans interleaving. ``validate_chrome_trace`` is the schema check the
+tier-1 tests and the serve bench run on every exported trace.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+__all__ = ["RequestTracer", "validate_chrome_trace",
+           "SCHEDULER_TID", "slot_tid"]
+
+#: tid of the scheduler track (queue/admission/terminal events)
+SCHEDULER_TID = 0
+
+_PID = 1
+
+
+def slot_tid(slot: int) -> int:
+    """tid of a decode slot's track."""
+    return 1 + int(slot)
+
+
+def _us(t: float) -> int:
+    return int(t * 1e6)
+
+
+class RequestTracer:
+    """Ring-buffered trace-event recorder (see module docstring).
+
+    Events are stored already in Chrome trace-event dict form, so
+    ``chrome()`` is a copy + metadata, not a conversion pass."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = int(capacity)
+        self.events: "deque[dict]" = deque(maxlen=self.capacity)
+        self._emitted = 0
+        # guards append vs read: a scrape thread calling chrome()/
+        # export() mid-stream must never hit "deque mutated during
+        # iteration". One uncontended acquire per event is noise next
+        # to the program dispatch each event brackets.
+        self._lock = threading.Lock()
+
+    # --- clock ----------------------------------------------------------------
+    @staticmethod
+    def now() -> float:
+        """Monotonic seconds — the tracer's one timebase."""
+        return time.monotonic()
+
+    # --- emission -------------------------------------------------------------
+    def _push(self, ev: dict) -> None:
+        with self._lock:
+            self._emitted += 1
+            self.events.append(ev)
+
+    def span(self, name: str, t0: float, t1: float, *,
+             cat: str = "serve", tid: int = SCHEDULER_TID,
+             **args: Any) -> None:
+        """Complete span [t0, t1] (monotonic seconds) on track ``tid``."""
+        self._push({"name": name, "cat": cat, "ph": "X",
+                    "ts": _us(t0), "dur": max(0, _us(t1) - _us(t0)),
+                    "pid": _PID, "tid": int(tid), "args": args})
+
+    def instant(self, name: str, t: Optional[float] = None, *,
+                cat: str = "serve", tid: int = SCHEDULER_TID,
+                **args: Any) -> None:
+        self._push({"name": name, "cat": cat, "ph": "i", "s": "t",
+                    "ts": _us(self.now() if t is None else t),
+                    "pid": _PID, "tid": int(tid), "args": args})
+
+    def terminal(self, rid: Any, status: str,
+                 t: Optional[float] = None, **args: Any) -> None:
+        """The one terminal event a request's lifecycle ends with —
+        chaos tests pin exactly one per request, status matching the
+        returned Completion."""
+        self.instant("END", t, cat="terminal", rid=rid, status=status,
+                     **args)
+
+    # --- read side ------------------------------------------------------------
+    @property
+    def dropped(self) -> int:
+        """Events the ring evicted (emitted minus retained)."""
+        return self._emitted - len(self.events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+            self._emitted = 0
+
+    def chrome(self) -> dict:
+        """Chrome trace-event JSON object (Perfetto-loadable)."""
+        with self._lock:
+            recorded = list(self.events)
+            dropped = self._emitted - len(recorded)
+        events: List[dict] = [
+            {"name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
+             "args": {"name": "deepspeed_tpu.serve"}}]
+        tids = sorted({e["tid"] for e in recorded})
+        for tid in tids:
+            label = "scheduler" if tid == SCHEDULER_TID \
+                else f"slot {tid - 1}"
+            events.append({"name": "thread_name", "ph": "M", "pid": _PID,
+                           "tid": tid, "args": {"name": label}})
+        events.extend(recorded)
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metadata": {"tracer": "dstrace",
+                             "clock": "monotonic",
+                             "dropped_events": dropped}}
+
+    def export(self, path: str) -> dict:
+        """Write the Chrome trace to ``path``; returns the object.
+        Non-JSON-native arg values (numpy ints in rids, exception
+        objects) serialize via ``str`` — an odd rid type must never
+        kill an export."""
+        obj = self.chrome()
+        with open(path, "w") as f:
+            json.dump(obj, f, default=str)
+        return obj
+
+
+_PHASES = {"X", "i", "I", "M", "C", "B", "E"}
+
+
+def validate_chrome_trace(obj: Any) -> List[str]:
+    """Schema check for an exported trace; returns problem strings
+    (empty == valid). Covers everything Perfetto's trace-event importer
+    requires of the array-form JSON: ``traceEvents`` list, per-event
+    ``name``/``ph``/``ts``/``pid``/``tid`` with the right types,
+    non-negative ``dur`` on complete events, dict ``args``."""
+    problems: List[str] = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be a dict with a 'traceEvents' list"]
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        return ["'traceEvents' must be a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not a dict")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            problems.append(f"event {i}: missing/empty 'name'")
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            problems.append(f"event {i}: bad phase {ph!r}")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"event {i}: bad 'ts' {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i}: complete event needs "
+                                f"non-negative 'dur', got {dur!r}")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                problems.append(f"event {i}: '{key}' must be an int")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            problems.append(f"event {i}: 'args' must be a dict")
+    return problems

@@ -129,6 +129,19 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class JSONLConfig(DeepSpeedConfigModel):
+    """Dependency-free JSONL event sink (monitor/monitor.py) — the
+    DEFAULT monitoring backend. ``enabled: None`` (the default) means
+    AUTO: the sink activates whenever monitoring is on at all, so a
+    torch-free install that asked for TensorBoard still gets its events
+    on disk instead of silently losing all monitoring; ``true`` turns
+    monitoring on by itself, ``false`` opts out of the fallback."""
+
+    enabled: Optional[bool] = None
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -334,6 +347,7 @@ class DeepSpeedConfig:
         self.tensorboard = TensorboardConfig(**p.get("tensorboard", {}))
         self.wandb = WandbConfig(**p.get("wandb", {}))
         self.csv_monitor = CSVConfig(**p.get("csv_monitor", {}))
+        self.jsonl_monitor = JSONLConfig(**p.get("jsonl_monitor", {}))
         self.comms_logger = CommsLoggerConfig(**p.get("comms_logger", {}))
         self.flops_profiler = FlopsProfilerConfig(**p.get("flops_profiler", {}))
         self.pipeline = PipelineConfig(**p.get("pipeline", {}))
@@ -360,7 +374,11 @@ class DeepSpeedConfig:
             self.quantize_training_config.get("enabled", False)
         self.curriculum_learning_legacy = p.get("curriculum_learning", {})
         self.monitor_config_enabled = (
-            self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+            self.tensorboard.enabled or self.wandb.enabled
+            or self.csv_monitor.enabled
+            # jsonl 'auto' (None) rides along with the sinks above;
+            # an explicit true turns monitoring on by itself
+            or self.jsonl_monitor.enabled is True
         )
 
         if self.fp16.enabled and self.bf16.enabled:

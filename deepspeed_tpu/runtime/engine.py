@@ -50,6 +50,7 @@ from deepspeed_tpu.runtime.zero.stages import (
 from deepspeed_tpu.compression import (
     Compressor, CompressionScheduler, STEP_KEY, get_compression_config,
 )
+from deepspeed_tpu.observability import MetricsRegistry
 from deepspeed_tpu.ops.optimizers import build_optimizer
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -400,10 +401,21 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self.skipped_steps = 0
         self._step_count = jnp.zeros((), jnp.int32)
-        self.timers = SynchronizedWallClockTimer()
+        # dstrace metrics registry (docs/OBSERVABILITY.md): step/fwd/
+        # bwd/optimizer timer histograms, train throughput, ZeRO
+        # reduction bytes, and — via the collector — the comms logger's
+        # wire totals, all behind one engine.metrics.snapshot(); the
+        # monitor sinks drain it at steps_per_print boundaries
+        self.metrics = MetricsRegistry()
+        from deepspeed_tpu.comm.comm import comms_logger
+        self.metrics.register_collector("comm",
+                                        comms_logger.registry_section)
+        self._zero_bytes_cache = None
+        self.timers = SynchronizedWallClockTimer(registry=self.metrics)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
-            steps_per_output=self._config.steps_per_print)
+            steps_per_output=self._config.steps_per_print,
+            registry=self.metrics)
         self.monitor = self._configure_monitor()
         self.losses = 0.0
         self._cached_grads = None
@@ -1257,9 +1269,44 @@ class DeepSpeedEngine:
                 f.write(text or "")
         return report
 
+    def _account_zero_reduction(self) -> None:
+        """Per-step gradient-reduction byte counters (dstrace): every
+        global step moves the full gradient tree through one
+        data-parallel reduction — reduce-scatter under ZeRO's sharded
+        grad layout (stage >= 1), ring all-reduce at stage 0 — at the
+        ``communication_data_type`` boundary dtype. The payload is
+        STATIC (param tree shape × comm itemsize), so the accounting is
+        host arithmetic computed once and accumulated per step, priced
+        by the same ``collective_cost`` table the dstlint SPMD pass
+        budgets and the runtime comms logger record with."""
+        params = getattr(self, "params", None)
+        if self.dp_world_size <= 1 or params is None:
+            return
+        if self._zero_bytes_cache is None:
+            from deepspeed_tpu.comm.collective_cost import wire_bytes
+
+            cdt = self._config.communication_data_type
+            dtype = COMM_DTYPES[cdt.lower()] if cdt else self.compute_dtype
+            itemsize = np.dtype(dtype).itemsize
+            n_elems = sum(int(np.prod(l.shape)) for l in
+                          jax.tree_util.tree_leaves(params)
+                          if hasattr(l, "shape"))
+            payload = n_elems * itemsize
+            kind = ("reduce_scatter" if self.zero_optimization()
+                    else "psum")
+            self._zero_bytes_cache = (
+                payload, wire_bytes(kind, payload, self.dp_world_size),
+                kind)
+        payload, wire, kind = self._zero_bytes_cache
+        self.metrics.inc("train.zero.reduce_payload_bytes", payload)
+        self.metrics.inc("train.zero.reduce_wire_bytes", wire)
+        self.metrics.set_gauge("train.zero.reduce_group_size",
+                               self.dp_world_size)
+
     def _after_step(self, finite, loss=None):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        self._account_zero_reduction()
         if self.compression_scheduler is not None:
             self.compression_scheduler.step(self.global_steps)
         if self.progressive_layer_drop is not None:
@@ -1292,6 +1339,9 @@ class DeepSpeedEngine:
                                float(self.scaler_state.scale),
                                self.global_samples))
             self.monitor.write_events(events)
+            # drain the dstrace registry (timers, throughput, ZeRO
+            # reduction bytes, comms wire totals) into the same sinks
+            self.monitor.write_registry(self.metrics, self.global_samples)
 
     def destroy(self):
         """Release engine-held native resources (AIO thread pools, pending

@@ -22,7 +22,9 @@ Seven rules (catalog with bad/good examples: ``docs/LINT.md``):
   ``runtime/engine.py`` taking pool/cache-sized buffers must donate
   them (``donate_argnums``) or double peak HBM for the workspace.
 - ``no-silent-except``   bare/``Exception``-broad handlers in the
-  ``inference/`` serving hot paths must handle the exception EXPLICITLY
+  serving/training/comm/monitoring paths (``inference/``, ``runtime/``,
+  ``comm/``, ``monitor/``, ``profiling/``, ``observability/``) must
+  handle the exception EXPLICITLY
   (bind it and use it — convert to a terminal status, log it — or
   re-raise); a swallowed exception in the fault-tolerance layer turns
   an isolatable failure into silent KV/bookkeeping corruption.
@@ -349,7 +351,10 @@ class ModuleAnalyzer:
             self._rule_arg_mutation()
         if self.relpath.startswith(("deepspeed_tpu/inference/",
                                     "deepspeed_tpu/runtime/",
-                                    "deepspeed_tpu/comm/")):
+                                    "deepspeed_tpu/comm/",
+                                    "deepspeed_tpu/monitor/",
+                                    "deepspeed_tpu/profiling/",
+                                    "deepspeed_tpu/observability/")):
             self._rule_silent_except()
         if self.relpath.endswith(DONATION_FILES):
             self._rule_donation()

@@ -32,6 +32,14 @@ and fails on:
   re-materialized per decode step) and silent fallback in either
   direction. Regenerate after intentional changes:
   ``bin/dst lint --update-budgets``.
+
+These entry points are the OBSERVABILITY gate too (docs/
+OBSERVABILITY.md): the dstrace tracer/metrics instrumentation drives
+exactly these builders from the scheduler's host side, so the budgets
+above prove tracing adds ZERO traced equations — and
+``tests/unit/test_observability.py`` pins the fresh trace equal to the
+checked-in numbers exactly (no tolerance), so even a one-equation leak
+of instrumentation into a compiled program fails tier-1.
 """
 
 import contextlib

@@ -18,7 +18,8 @@ import jax
 from jax import lax as _lax
 
 __all__ = ["shard_map", "set_mesh", "varying_cast", "vma_of", "HAS_VMA",
-           "axis_size", "get_abstract_mesh", "abstract_mesh_context"]
+           "axis_size", "get_abstract_mesh", "abstract_mesh_context",
+           "device_synchronize"]
 
 
 # --- shard_map: jax.shard_map (new) / jax.experimental.shard_map (old) -------
@@ -169,6 +170,23 @@ def abstract_mesh_context(mesh):
     from jax._src import mesh as _mesh_lib
 
     return _mesh_lib.set_abstract_mesh(mesh)
+
+
+# --- device_synchronize: barrier against outstanding async dispatch ----------
+def device_synchronize() -> None:
+    """Drain the async dispatch queue (the CUDA-event analogue used by
+    ``utils/timer.py`` so a timed interval covers device work, not just
+    Python time). jax has no stable public 'sync everything' call —
+    ``jax.effects_barrier`` only covers effects, and the historical
+    spellings moved — so the seam owns the idiom: transfer a trivial
+    computation's result, which cannot complete before previously
+    enqueued work on the same device. Never raises: a timer barrier
+    failing (no backend, torn-down runtime at interpreter exit) must
+    degrade to wall-clock timing, not kill the step."""
+    try:
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:  # pragma: no cover - torn-down/absent backend only
+        pass
 
 
 # shard_map kwargs for call sites that are vma-clean on current jax but

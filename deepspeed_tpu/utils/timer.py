@@ -4,12 +4,22 @@ TPU-native analogue of the reference's ``deepspeed/utils/timer.py``
 (``SynchronizedWallClockTimer`` :21, ``ThroughputTimer`` :137). CUDA-event
 timing becomes ``jax.block_until_ready`` barriers: a timer ``stop`` with
 ``synchronize=True`` drains the async dispatch queue so the interval covers
-device work, not just Python time.
+device work, not just Python time. The barrier itself lives behind the
+``jax_compat`` seam (``device_synchronize``) — one file to touch on a jax
+bump.
+
+REGISTRY-BACKED MODE (dstrace, docs/OBSERVABILITY.md): pass a
+``MetricsRegistry`` and every recorded interval also lands in a
+log-bucketed histogram (``<prefix>.<name>_s``), and ``ThroughputTimer``
+maintains ``train.samples`` / ``train.step_s`` / the
+``train.avg_samples_per_sec`` gauge — so train timing shows up in the
+same ``snapshot()`` as the serving metrics instead of only in log lines.
 """
 
 import time
 from typing import Dict, List, Optional
 
+from deepspeed_tpu.utils.jax_compat import device_synchronize
 from deepspeed_tpu.utils.logging import logger
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
@@ -22,23 +32,22 @@ TRAIN_BATCH_TIMER = "train_batch"
 
 
 def _device_synchronize() -> None:
-    """Barrier against outstanding async device work (CUDA-event analogue)."""
-    try:
-        import jax
-
-        # Cheap full-queue drain: transfer a trivial computation result.
-        (jax.device_put(0.0) + 0).block_until_ready()
-    except Exception:
-        pass
+    """Barrier against outstanding async device work (CUDA-event
+    analogue) — seam-routed (jax_compat.device_synchronize) so the
+    drain idiom is owned by the one-file-per-jax-bump module."""
+    device_synchronize()
 
 
 class Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, registry=None, metric: Optional[str] = None):
         self.name = name
         self.started = False
         self._start = 0.0
         self._elapsed = 0.0
         self._records: List[float] = []
+        # dstrace: recorded intervals also feed this registry histogram
+        self._registry = registry
+        self._metric = metric or f"train.timer.{name}_s"
 
     def start(self) -> None:
         assert not self.started, f"timer {self.name} already started"
@@ -53,6 +62,8 @@ class Timer:
         self._elapsed += interval
         if record:
             self._records.append(interval)
+            if self._registry is not None:
+                self._registry.observe(self._metric, interval)
         self.started = False
 
     def reset(self) -> None:
@@ -75,14 +86,20 @@ class Timer:
 
 
 class SynchronizedWallClockTimer:
-    """Named-timer registry (reference utils/timer.py:33)."""
+    """Named-timer registry (reference utils/timer.py:33). With a
+    metrics ``registry``, every timer it mints records its intervals
+    into ``<prefix>.<name>_s`` histograms as well."""
 
-    def __init__(self):
+    def __init__(self, registry=None, prefix: str = "train.timer"):
         self.timers: Dict[str, Timer] = {}
+        self._registry = registry
+        self._prefix = prefix
 
     def __call__(self, name: str) -> Timer:
         if name not in self.timers:
-            self.timers[name] = Timer(name)
+            self.timers[name] = Timer(
+                name, registry=self._registry,
+                metric=f"{self._prefix}.{name}_s")
         return self.timers[name]
 
     def has(self, name: str) -> bool:
@@ -110,15 +127,21 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPs reporting (reference utils/timer.py:137)."""
+    """Samples/sec + TFLOPs reporting (reference utils/timer.py:137).
+
+    With a metrics ``registry``, counted global steps also maintain
+    ``train.samples`` (counter), ``train.step_s`` (histogram) and the
+    ``train.avg_samples_per_sec`` / ``train.samples_per_sec`` gauges —
+    train throughput in the same ``snapshot()`` as everything else."""
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
-                 monitor_memory: bool = False, logging_fn=None):
+                 monitor_memory: bool = False, logging_fn=None, registry=None):
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or logger.info
+        self.registry = registry
         self.epoch_count = 0
         self.micro_step_count = 0
         self.global_step_count = 0
@@ -146,6 +169,17 @@ class ThroughputTimer:
         if self.global_step_count >= self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
+            if global_step and self.registry is not None:
+                # same warm-up discipline as avg_samples_per_sec: the
+                # registry sees only counted (post-start_step) steps, so
+                # its percentiles are not skewed by compile time
+                self.registry.inc("train.samples", self.batch_size)
+                self.registry.observe("train.step_s", duration)
+                self.registry.set_gauge(
+                    "train.samples_per_sec",
+                    self.batch_size / max(duration, 1e-9))
+                self.registry.set_gauge("train.avg_samples_per_sec",
+                                        self.avg_samples_per_sec())
             if global_step and report_speed and \
                     self.global_step_count % self.steps_per_output == 0:
                 self.logging(

@@ -29,6 +29,7 @@ from deepspeed_tpu.inference.scheduler import (
     TIMED_OUT, ContinuousBatchingScheduler, Request,
 )
 
+from deepspeed_tpu.observability import RequestTracer
 from tests.unit.inference.test_scheduler import FakeExecutor, drain, req
 from tests.unit.inference.test_prefix_cache import PrefixFakeExecutor
 
@@ -38,19 +39,51 @@ pytestmark = pytest.mark.chaos
 def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
                prefix=False, **kw):
     """Scheduler under test: auditor at EVERY chunk (the chaos-mode
-    cadence), deterministic fake executor."""
+    cadence), deterministic fake executor, and a dstrace tracer whose
+    terminal events ``assert_quiescent`` cross-checks against every
+    Completion the scheduler ever returned — every chaos scenario
+    therefore also pins the trace contract (exactly one terminal span
+    per request, status matching)."""
     ex = PrefixFakeExecutor() if prefix else FakeExecutor()
     pool = (PrefixCachingBlockPool(num_blocks, block_size) if prefix
             else BlockPool(num_blocks, block_size))
     kw.setdefault("audit_every", 1)
+    kw.setdefault("tracer", RequestTracer())
     sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
                                         prefix_cache=prefix, **kw)
+    # record every Completion any exit path ever hands back, so the
+    # trace cross-check sees the same population the scenario asserted
+    sched.comps_seen = []
+    for name in ("step", "shutdown"):
+        real = getattr(sched, name)
+
+        def wrapped(*a, _real=real, **k):
+            out = _real(*a, **k)
+            sched.comps_seen.extend(out)
+            return out
+
+        setattr(sched, name, wrapped)
     return sched, ex, pool
+
+
+def assert_terminal_spans(sched):
+    """dstrace contract under chaos: the trace holds EXACTLY ONE
+    terminal event per resolved request (per queue residency — a
+    resubmitted rid terminates once per submission), statuses matching
+    the returned Completions."""
+    seen = getattr(sched, "comps_seen", None)
+    if sched.tracer is None or seen is None:
+        return          # a scenario built its own un-traced scheduler
+    got = sorted((e["args"]["rid"], e["args"]["status"])
+                 for e in sched.tracer.events
+                 if e.get("cat") == "terminal")
+    want = sorted((c.rid, c.status) for c in seen)
+    assert got == want, f"terminal spans {got} != completions {want}"
 
 
 def assert_quiescent(sched):
     """Acceptance invariant: fully-free pool, zero outstanding
-    refcounts, auditor clean."""
+    refcounts, auditor clean, terminal spans matching completions."""
     pool = sched.pool
     assert pool.num_allocated == 0, \
         f"{pool.num_allocated} blocks still allocated"
@@ -59,6 +92,7 @@ def assert_quiescent(sched):
         bad = {b: r for b, r in pool._refs.items() if r != 0}
         assert not bad, f"outstanding refcounts {bad}"
     sched.audit(context="post-drain")          # raises on any violation
+    assert_terminal_spans(sched)
 
 
 def by_rid(comps):
@@ -400,7 +434,7 @@ def test_chaos_restore_fault_degrades_one_stream_only():
         pool = PrefixCachingBlockPool(11, 4)
         sched = ContinuousBatchingScheduler(
             ex, 2, pool, 8, prefix_cache=True, host_tier=tier,
-            audit_every=1, fault_injector=fi)
+            audit_every=1, fault_injector=fi, tracer=RequestTracer())
         return sched, ex, pool
 
     def run(fi):
@@ -424,6 +458,7 @@ def test_chaos_restore_fault_degrades_one_stream_only():
         sched.submit(Request(rid=3, prompt=np.concatenate([shared, [71]]),
                              max_new_tokens=6))
         all_comps += drain(sched)
+        sched.comps_seen = all_comps    # trace cross-check population
         return sched, by_rid(all_comps)
 
     _, ref = run(None)

@@ -311,7 +311,11 @@ def test_restore_scatter_exception_fails_runnable_slots():
     radius: the restoring request, every runnable slot AND every other
     pending restore (their shared-prefix KV lives in the same suspect
     pools) FAIL; queued requests still serve, the pool drains free."""
-    sched, ex, pool, tier = make_tsched(num_slots=3, num_blocks=27)
+    from deepspeed_tpu.observability import MetricsRegistry, RequestTracer
+
+    metrics, tracer = MetricsRegistry(), RequestTracer()
+    sched, ex, pool, tier = make_tsched(num_slots=3, num_blocks=27,
+                                        metrics=metrics, tracer=tracer)
     shared = np.arange(1, 9)
     sched.submit(preq(1, np.concatenate([shared, [91]]), gen=4))
     drain(sched)
@@ -338,6 +342,15 @@ def test_restore_scatter_exception_fails_runnable_slots():
     assert "restore" in comps[3].error           # suspect pools
     assert comps[31].status == COMPLETED         # queued: still served
     assert sched.host_restore_failures >= 2
+    # dstrace mirrors of the blast radius: hard failures land in the
+    # metrics counter too (not just the legacy attribute), and BOTH
+    # pending restores get a closed ok=False RESTORING span — the
+    # failure interval the trace exists to show
+    assert metrics.snapshot()["counters"]["serve.host_restore_failures"] \
+        == sched.host_restore_failures
+    bad_spans = {e["args"]["rid"] for e in tracer.events
+                 if e["name"] == "RESTORING" and not e["args"]["ok"]}
+    assert {2, 3} <= bad_spans
     assert tier.bytes_restored == 0              # nothing LANDED
     assert pool.num_allocated == 0               # pool fully drained
     assert pool.num_free == pool.num_blocks - 1

@@ -1,0 +1,148 @@
+"""Engine-level dstrace tests: the REAL compiled serving path must
+export a schema-valid Chrome/Perfetto trace covering every request's
+full lifecycle, report serve metrics that agree with the returned
+Completions, honor the trace knobs, and change the compiled programs by
+exactly nothing (tracing on == off byte-identical outputs)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.scheduler import COMPLETED, REJECTED, Request
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.observability import validate_chrome_trace
+
+pytestmark = pytest.mark.inference
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params,
+        model_config=cfg)
+
+
+def reqs(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    lens = [5, 9, 13, 7, 4, 11][:n]
+    gens = [6, 3, 9, 5, 4, 7][:n]
+    return [Request(rid=i, prompt=rng.integers(1, 256, L),
+                    max_new_tokens=g)
+            for i, (L, g) in enumerate(zip(lens, gens))]
+
+
+def events_for(trace, rid):
+    return [e for e in trace["traceEvents"]
+            if e.get("args", {}).get("rid") == rid]
+
+
+def test_serve_trace_covers_full_lifecycle(engine):
+    engine.reset_serve_metrics()
+    comps = engine.serve(reqs(), num_slots=2, block_size=4)
+    trace = engine.export_trace()
+    assert validate_chrome_trace(trace) == []
+    for c in comps:
+        evs = events_for(trace, c.rid)
+        names = [e["name"] for e in evs]
+        # full lifecycle: queued -> prefill -> decode chunks -> terminal
+        assert "QUEUED" in names and "PREFILL" in names, (c.rid, names)
+        decode = [e for e in evs if e["name"] == "DECODE"]
+        assert sum(e["args"]["tokens"] for e in decode) \
+            == len(c.tokens) - 1        # first token is the prefill's
+        terms = [e for e in evs if e.get("cat") == "terminal"]
+        assert len(terms) == 1
+        assert terms[0]["args"]["status"] == c.status == COMPLETED
+        # spans are ordered on the monotonic clock
+        q = next(e for e in evs if e["name"] == "QUEUED")
+        p = next(e for e in evs if e["name"] == "PREFILL")
+        assert q["ts"] <= p["ts"]
+        for d in decode:
+            assert p["ts"] + p["dur"] <= d["ts"] + 1
+        # slot spans live on slot tracks (tid >= 1), queue on scheduler
+        assert q["tid"] == 0 and p["tid"] >= 1
+
+
+def test_serve_metrics_agree_with_completions(engine):
+    engine.reset_serve_metrics()
+    comps = engine.serve(reqs(), num_slots=2, block_size=4)
+    snap = engine.serve_metrics()
+    c = snap["counters"]
+    assert c["serve.requests_submitted"] == len(comps)
+    assert c["serve.completions.COMPLETED"] == len(comps)
+    assert c["serve.tokens_generated"] == sum(len(x.tokens) for x in comps)
+    h = snap["histograms"]
+    assert h["serve.ttft_s"]["count"] == len(comps)
+    assert h["serve.latency_s"]["count"] == len(comps)
+    # engine-reported TTFT p50 tracks the completion-derived order
+    # statistics: at 4 samples the median is anything between the 2nd
+    # and 3rd sorted value — the histogram estimate must land there
+    # (± its ~5% bucket width; the bench asserts 5% at real sample
+    # counts where the order statistics coincide)
+    ttfts = sorted(x.t_first_token - x.t_submit for x in comps)
+    lo, hi = ttfts[len(ttfts) // 2 - 1], ttfts[len(ttfts) // 2]
+    assert 0.95 * lo <= h["serve.ttft_s"]["p50"] <= 1.05 * hi
+    # prefix-cache collector rides along in the same snapshot
+    assert "serve.prefix_cache" in snap
+    assert snap["serve.prefix_cache"]["enabled"] is True
+    # gauges settle at an idle pool
+    assert snap["gauges"]["serve.pool_blocks_allocated"] == 0
+    # counters stay monotonic across a second serve on the same engine
+    engine.serve(reqs(2, seed=1), num_slots=2, block_size=4)
+    c2 = engine.serve_metrics()["counters"]
+    assert c2["serve.requests_submitted"] == len(comps) + 2
+
+
+def test_trace_off_records_nothing_and_outputs_identical(engine):
+    engine.reset_serve_metrics()
+    on = engine.serve(reqs(3, seed=2), num_slots=2, block_size=4)
+    n_events = len(engine.tracer.events)
+    assert n_events > 0
+    off = engine.serve(reqs(3, seed=2), num_slots=2, block_size=4,
+                       trace=False)
+    assert len(engine.tracer.events) == n_events    # nothing recorded
+    for a, b in zip(sorted(on, key=lambda c: c.rid),
+                    sorted(off, key=lambda c: c.rid)):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+def test_trace_path_knob_writes_perfetto_json(engine, tmp_path):
+    path = tmp_path / "serve_trace.json"
+    engine.serve(reqs(2, seed=3), num_slots=2, block_size=4,
+                 trace_path=str(path))
+    obj = json.loads(path.read_text())
+    assert validate_chrome_trace(obj) == []
+    assert any(e.get("cat") == "terminal" for e in obj["traceEvents"])
+
+
+def test_rejected_request_still_gets_terminal_event(engine):
+    engine.reset_serve_metrics()
+    good = reqs(1, seed=4)[0]
+    comps = engine.serve(
+        [{"rid": "bad", "prompt": [], "max_new_tokens": 4},
+         {"rid": good.rid, "prompt": good.prompt,
+          "max_new_tokens": good.max_new_tokens}],
+        num_slots=2, block_size=4)
+    by_rid = {c.rid: c for c in comps}
+    assert by_rid["bad"].status == REJECTED
+    trace = engine.export_trace()
+    terms = {e["args"]["rid"]: e["args"]["status"]
+             for e in trace["traceEvents"] if e.get("cat") == "terminal"}
+    assert terms["bad"] == REJECTED
+    assert terms[good.rid] == COMPLETED
+    assert engine.serve_metrics()["counters"][
+        "serve.completions.REJECTED"] == 1
+
+
+def test_reset_serve_metrics_isolates_runs(engine):
+    engine.serve(reqs(2, seed=5), num_slots=2, block_size=4)
+    engine.reset_serve_metrics()
+    assert engine.serve_metrics()["counters"] == {}
+    assert len(engine.tracer.events) == 0

@@ -1,0 +1,332 @@
+"""dstrace observability unit tests: histogram bucket math, registry
+snapshot monotonicity, bounded ring-buffer eviction, Chrome-trace schema,
+the monitor JSONL default sink, registry-backed timers, and the
+zero-traced-ops gate (fresh jaxpr trace of the serving entry points must
+equal the checked-in budgets EXACTLY — instrumentation lives strictly at
+host boundaries)."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.observability import (
+    Histogram, MetricsRegistry, RequestTracer, default_registry,
+    validate_chrome_trace,
+)
+
+
+# --- histogram bucket math ----------------------------------------------------
+
+def test_histogram_buckets_are_log_spaced_and_fixed():
+    h = Histogram(lo=1e-3, hi=1e3, buckets_per_decade=10)
+    n = len(h.bucket_counts)
+    assert n == 61                      # 6 decades x 10 + overflow
+    # geometric edges: constant ratio
+    assert math.isclose(h.ratio, 10 ** 0.1, rel_tol=1e-12)
+    before = len(h.bucket_counts)
+    for v in np.geomspace(1e-4, 1e4, 500):
+        h.observe(v)
+    assert len(h.bucket_counts) == before          # fixed memory
+    assert h.count == 500
+    assert sum(h.bucket_counts) == 500
+
+
+def test_histogram_percentiles_within_bucket_tolerance():
+    h = Histogram()                     # default 48/decade
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(mean=-1.0, sigma=1.0, size=5000)
+    for v in vals:
+        h.observe(v)
+    s = h.summary()
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        exact = float(np.quantile(vals, q))
+        # one bucket spans ~4.9%; interpolated estimate must sit well
+        # inside the 5% engine-vs-bench agreement budget
+        assert abs(s[key] - exact) <= 0.05 * exact, (key, s[key], exact)
+    assert s["count"] == 5000
+    assert math.isclose(s["sum"], float(vals.sum()), rel_tol=1e-9)
+    assert s["min"] == float(vals.min()) and s["max"] == float(vals.max())
+
+
+def test_histogram_clamps_out_of_range_and_single_value_exact():
+    h = Histogram(lo=1e-2, hi=1e2)
+    h.observe(1e-9)                     # below lo -> bucket 0
+    h.observe(1e9)                      # above hi -> overflow bucket
+    assert h.bucket_counts[0] == 1 and h.bucket_counts[-1] == 1
+    # clamped estimates: the low tail reads at/below lo, the high tail
+    # at/above hi, and both stay inside the OBSERVED range
+    assert 1e-9 <= h.percentile(0.25) <= h.lo
+    assert h.hi <= h.percentile(0.99) <= 1e9
+    h2 = Histogram()
+    h2.observe(0.125)
+    # a single observation reports itself exactly (min/max clamp)
+    assert h2.summary()["p50"] == pytest.approx(0.125)
+    # all-overflow tails must track the tail, not pin at hi (or worse,
+    # clamp down to min): quantiles interpolate across [hi, max]
+    h3 = Histogram(lo=1e-3, hi=10)
+    for v in (20, 50, 90):
+        h3.observe(v)
+    s3 = h3.summary()
+    assert 10 < s3["p50"] < s3["p99"] <= 90
+
+
+def test_empty_histogram_summary_is_zeros():
+    assert Histogram().summary() == {
+        "count": 0, "sum": 0.0, "min": 0.0, "max": 0.0, "mean": 0.0,
+        "p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+
+# --- registry -----------------------------------------------------------------
+
+def test_registry_snapshot_monotonic_counters_and_collectors():
+    r = MetricsRegistry()
+    r.inc("a")
+    r.inc("a", 4)
+    r.set_gauge("g", 7.0)
+    r.observe("h", 0.5)
+    pulls = []
+    r.register_collector("section", lambda: pulls.append(1) or {"k": 1})
+    s1 = r.snapshot()
+    assert s1["counters"]["a"] == 5
+    assert s1["gauges"]["g"] == 7.0
+    assert s1["histograms"]["h"]["count"] == 1
+    assert s1["section"] == {"k": 1} and pulls == [1]
+    r.inc("a")
+    s2 = r.snapshot()
+    # counters are monotonic between snapshots; snapshots are plain
+    # dicts decoupled from later updates
+    assert s2["counters"]["a"] > s1["counters"]["a"]
+    assert s1["counters"]["a"] == 5
+    json.dumps(s2)                      # JSON-serializable contract
+    # collector replacement semantics (re-pointing at a new scheduler)
+    r.register_collector("section", lambda: {"k": 2})
+    assert r.snapshot()["section"] == {"k": 2}
+    # a dead collector degrades to data, never kills the snapshot
+    r.register_collector("section", lambda: 1 / 0)
+    assert "collector_error" in r.snapshot()["section"]
+
+
+def test_registry_reset_zeroes_everything_but_keeps_collectors():
+    r = MetricsRegistry()
+    r.inc("a")
+    r.observe("h", 1.0)
+    r.register_collector("s", lambda: {"k": 3})
+    r.reset()
+    s = r.snapshot()
+    assert s["counters"] == {} and s["histograms"] == {}
+    assert s["s"] == {"k": 3}
+
+
+def test_default_registry_is_a_singleton():
+    assert default_registry() is default_registry()
+
+
+# --- tracer -------------------------------------------------------------------
+
+def test_tracer_ring_buffer_eviction_is_bounded():
+    tr = RequestTracer(capacity=8)
+    for i in range(20):
+        tr.instant(f"e{i}")
+    assert len(tr.events) == 8
+    assert tr.dropped == 12
+    # oldest evicted, newest retained
+    assert [e["name"] for e in tr.events] == [f"e{i}" for i in range(12, 20)]
+    assert tr.chrome()["metadata"]["dropped_events"] == 12
+    tr.clear()
+    assert len(tr.events) == 0 and tr.dropped == 0
+
+
+def test_tracer_chrome_export_is_schema_valid(tmp_path):
+    tr = RequestTracer()
+    t0 = tr.now()
+    tr.span("PREFILL", t0, t0 + 0.25, tid=1, rid=7, slot=0)
+    tr.instant("STALL", tid=2, slot=1)
+    tr.terminal(7, "COMPLETED", tokens=3)
+    obj = tr.export(str(tmp_path / "trace.json"))
+    assert validate_chrome_trace(obj) == []
+    loaded = json.loads((tmp_path / "trace.json").read_text())
+    assert validate_chrome_trace(loaded) == []
+    spans = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+    assert spans[0]["dur"] == pytest.approx(0.25e6, rel=1e-3)
+    terms = [e for e in loaded["traceEvents"] if e.get("cat") == "terminal"]
+    assert len(terms) == 1
+    assert terms[0]["args"] == {"rid": 7, "status": "COMPLETED",
+                                "tokens": 3}
+    # thread metadata names every observed track
+    names = {e["args"]["name"] for e in loaded["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"scheduler", "slot 0", "slot 1"} <= names
+
+
+def test_validate_chrome_trace_rejects_malformed():
+    assert validate_chrome_trace([]) != []
+    assert validate_chrome_trace({"traceEvents": "nope"}) != []
+    bad = {"traceEvents": [{"name": "x", "ph": "X", "ts": -1,
+                            "pid": 1, "tid": 0}]}
+    problems = validate_chrome_trace(bad)
+    assert any("ts" in p for p in problems)
+    assert any("dur" in p for p in problems)
+
+
+# --- monitor JSONL default sink ----------------------------------------------
+
+def test_jsonl_monitor_is_dependency_free_default(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        # tensorboard asked for but torch-free installs can't build it:
+        # the JSONL default must still land events on disk
+        "tensorboard": {"enabled": True,
+                        "output_path": str(tmp_path / "tb")},
+        "jsonl_monitor": {"output_path": str(tmp_path)},
+    })
+    assert cfg.monitor_config_enabled
+    mm = MonitorMaster(cfg)
+    assert mm.jsonl_monitor.enabled     # auto: rides along
+    mm.write_events([("Train/Samples/train_loss", 2.5, 8)])
+    lines = [json.loads(x) for x in
+             open(mm.jsonl_monitor.path).read().splitlines()]
+    assert lines == [{"name": "Train/Samples/train_loss",
+                      "value": 2.5, "step": 8}]
+    # registry drain reaches the sink through the same fan-out —
+    # including COLLECTOR sections (the comms-wire-totals path)
+    r = MetricsRegistry()
+    r.inc("serve.tokens_generated", 42)
+    r.register_collector("comm", lambda: {"total.wire_bytes": 1024.0,
+                                          "note": "non-numeric skipped"})
+    mm.write_registry(r, 16)
+    lines = [json.loads(x) for x in
+             open(mm.jsonl_monitor.path).read().splitlines()]
+    assert {"name": "Registry/serve.tokens_generated",
+            "value": 42.0, "step": 16} in lines
+    assert {"name": "Registry/comm.total.wire_bytes",
+            "value": 1024.0, "step": 16} in lines
+    assert not any(x["name"] == "Registry/comm.note" for x in lines)
+
+
+def test_jsonl_monitor_explicit_enable_and_optout(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    on = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                          "jsonl_monitor": {"enabled": True,
+                                            "output_path": str(tmp_path)}})
+    assert on.monitor_config_enabled    # jsonl alone turns monitoring on
+    assert MonitorMaster(on).jsonl_monitor.enabled
+    off = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path)},
+        "jsonl_monitor": {"enabled": False}})
+    assert not MonitorMaster(off).jsonl_monitor.enabled
+    default = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
+    assert not default.monitor_config_enabled   # no surprise writes
+
+
+# --- registry-backed timers ---------------------------------------------------
+
+def test_timers_feed_registry_histograms():
+    from deepspeed_tpu.utils.timer import (
+        SynchronizedWallClockTimer, ThroughputTimer,
+    )
+
+    r = MetricsRegistry()
+    timers = SynchronizedWallClockTimer(registry=r)
+    timers("fwd").start()
+    timers("fwd").stop()
+    timers("fwd").start()
+    timers("fwd").stop(record=False)    # un-recorded interval stays out
+    assert r.snapshot()["histograms"]["train.timer.fwd_s"]["count"] == 1
+
+    tput = ThroughputTimer(batch_size=8, start_step=1, registry=r)
+    for _ in range(3):
+        tput.start()
+        tput.stop(global_step=True)
+    snap = r.snapshot()
+    assert snap["counters"]["train.samples"] == 24
+    assert snap["histograms"]["train.step_s"]["count"] == 3
+    assert snap["gauges"]["train.avg_samples_per_sec"] >= 0.0
+
+
+def test_device_synchronize_seam_routed():
+    """timer._device_synchronize must go through the jax_compat seam
+    (one-file jax bumps) and never raise."""
+    from deepspeed_tpu.utils import jax_compat, timer
+
+    assert "device_synchronize" in jax_compat.__all__
+    timer._device_synchronize()         # runs the real barrier
+
+
+# --- preemption single-counting ----------------------------------------------
+
+def test_preempted_request_counted_once_in_latency_histograms():
+    """Per-request histograms (ttft/queue_wait) and the delivered-token
+    counter are observed at the TERMINAL, not per admission — so a
+    preempted-and-regenerated request contributes exactly one sample
+    (its final attempt's), keeping engine-reported percentiles
+    comparable to the bench's one-sample-per-request accounting."""
+    from deepspeed_tpu.inference.kv_pool import BlockPool
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+    from tests.unit.inference.test_scheduler import (
+        FakeExecutor, drain, req,
+    )
+
+    r = MetricsRegistry()
+    # 2 usable blocks shared by 2 slots: both admit, both need growth,
+    # total stall -> preemption ladder (the chaos suite's scenario)
+    sched = ContinuousBatchingScheduler(
+        FakeExecutor(), 2, BlockPool(3, 4), 6, metrics=r,
+        tracer=RequestTracer())
+    sched.submit(req(1, plen=4, gen=4))
+    sched.submit(req(2, plen=4, gen=4))
+    comps = drain(sched)
+    assert sched.preemptions >= 1
+    snap = r.snapshot()
+    assert snap["histograms"]["serve.ttft_s"]["count"] == 2
+    assert snap["histograms"]["serve.queue_wait_s"]["count"] == 2
+    delivered = sum(len(c.tokens) for c in comps)
+    assert snap["counters"]["serve.tokens_generated"] == delivered
+    # work-done accounting exceeds delivered: the victim's first
+    # attempt sampled tokens that were discarded and regenerated
+    assert snap["counters"]["serve.tokens_sampled"] > delivered
+    assert snap["counters"]["serve.preemptions"] >= 1
+    # admissions count residencies; completions count requests
+    assert snap["counters"]["serve.admissions"] >= 3
+    assert snap["counters"]["serve.completions.COMPLETED"] == 2
+
+
+# --- zero-traced-ops gate -----------------------------------------------------
+
+def test_observability_adds_zero_traced_ops():
+    """The serving entry points the instrumented scheduler drives must
+    trace to EXACTLY the checked-in equation budgets — no tolerance.
+    The tracer/metrics hooks live at host boundaries only; a single
+    equation of instrumentation leaking into a compiled program shows
+    up here as an eqn-count drift."""
+    from deepspeed_tpu.tools.dstlint import jaxprpass
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    budgets = jaxprpass.load_budgets(
+        os.path.join(root, "tools", "dstlint", "jaxpr_budgets.json"))
+    assert budgets, "checked-in jaxpr budgets missing"
+    reports = jaxprpass.trace_entry_points(["reference"])
+    for name in ("decode_step/reference", "prefill_bucket/reference",
+                 "copy_pool_blocks", "spill_blocks/dense",
+                 "restore_blocks/dense"):
+        rep = reports[name]
+        assert rep.error is None, (name, rep.error)
+        want = budgets["entries"][name]["eqns"]
+        assert rep.eqns == want, (
+            f"{name}: traced {rep.eqns} eqns vs budget {want} — "
+            f"observability (or something else) changed the compiled "
+            f"serving program")
+        # and no host-callback/transfer primitive snuck in
+        for prim in rep.primitives:
+            assert "callback" not in prim and prim != "device_put", prim

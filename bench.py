@@ -393,9 +393,30 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
         return wall, lat, qwait, occ, preempt, ttft, obs
 
     arm_results = {}
+    # compile-window accounting (dstprof): the PR 3 bench-warmup lesson
+    # as a PERMANENT guard — after warm-up, the measured window must
+    # compile NOTHING (a mid-measurement compile once read as a
+    # prefix-cache slowdown). The CompileWatcher's program table
+    # survives reset_serve_metrics(), so warm-up vs window splits are
+    # exact even though the timed run zeroes the registry.
+    compile_windows = {}
+    prev_compiles = engine.compile_obs.compiles_total("serve")
     for kern in kernels:
         run_serve(timed=False, attn_kernel=kern)   # warm: compile programs
+        warmed = engine.compile_obs.compiles_total("serve")
         arm_results[kern] = run_serve(timed=True, attn_kernel=kern)
+        after = engine.compile_obs.compiles_total("serve")
+        in_window = after - warmed
+        assert in_window == 0, (
+            f"{in_window} serve-program compile(s) inside the measured "
+            f"window (arm {kern}) — warm-up missed a bucket; the timing "
+            f"measures XLA, not scheduling: "
+            f"{engine.compile_obs.section()}")
+        compile_windows[kern] = {
+            "warmup_compiles": warmed - prev_compiles,
+            "measured_window_compiles": in_window,
+        }
+        prev_compiles = after
     cb_wall = arm_results[kernels[0]][0]
     # tracing-overhead arm: the same first-kernel config re-timed with
     # the tracer off — the ratio is the artifact's evidence that span
@@ -551,8 +572,22 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
         json.dump(chrome_trace, f, default=str)
     n_events = len(chrome_trace["traceEvents"])
     stride = max(1, n_events // 400)    # bounded inline sample
+    compile_section = engine.compile_obs.section()
     detail["observability"] = {
         "metrics": snap,
+        # per-bucket compile seconds + the zero-compiles-in-window guard
+        # (asserted above): the compile-time breakdown the PR 3 warm-up
+        # incident needed and didn't have
+        "compile": {
+            "per_arm_windows": compile_windows,
+            "zero_compiles_in_measured_window": True,   # asserted above
+            "programs": {cache: progs
+                         for cache, progs in compile_section.items()
+                         if cache.startswith("serve")},
+            "gen_cache_compiles": sum(
+                e["compiles"]
+                for e in compile_section.get("gen", {}).values()),
+        },
         "ttft_p50_engine_s": round(eng_ttft_p50, 4),
         "ttft_p50_bench_s": round(bench_ttft_p50, 4),
         "ttft_p50_agreement_pct": round(agreement * 100, 2),

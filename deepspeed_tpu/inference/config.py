@@ -168,6 +168,20 @@ class ServeConfig(DeepSpeedConfigModel):
     # trace ring-buffer capacity in events; a long-running server
     # overwrites its oldest spans instead of growing
     trace_events: int = 65536
+    # --- dstprof (compile/memory/efficiency observability + export,
+    # docs/OBSERVABILITY.md) ----------------------------------------------
+    # optional stdlib-http.server Prometheus scrape endpoint: > 0 binds
+    # 127.0.0.1:<port> at the first serve()/generate_stream and serves
+    # /metrics (exposition text over the engine registry) + /metrics.json
+    # (the raw snapshot). 0 (default) = no listener — production scraping
+    # is opt-in, and engine.serve_metrics(format="prometheus") covers
+    # push/pull integrations that bring their own transport.
+    metrics_port: int = 0
+    # peak-FLOPs denominator override for MFU / achieved-vs-peak gauges,
+    # in TFLOP/s per device. None = resolve from the per-platform table
+    # (observability/efficiency.py; DST_PEAK_TFLOPS env also accepted) —
+    # pin it when your part's spec differs or for cross-run comparability.
+    peak_tflops: Optional[float] = None
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -24,7 +24,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.sampling import sample_logits
-from deepspeed_tpu.observability import MetricsRegistry, RequestTracer
+from deepspeed_tpu.observability import (
+    CompileWatcher, MetricsRegistry, RequestTracer, device_memory_section,
+    tree_device_bytes,
+)
 from deepspeed_tpu.parallel.mesh import make_mesh
 from deepspeed_tpu.parallel.partition import tree_shardings
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -184,7 +187,9 @@ def prompt_capacity(T: int, cfg=None) -> int:
 
 def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
                         max_new_tokens: int, params_fn=None,
-                        params_key=None, extra_key=(), builder=None):
+                        params_key=None, extra_key=(), builder=None,
+                        obs: Optional[CompileWatcher] = None,
+                        cache_name: str = "gen"):
     """Shared compiled-generation cache policy (used by InferenceEngine —
     plain and speculative variants — and the RLHF hybrid engine):
     capacity-bucketed keys, true LRU eviction. Returns ``(gen_fn, cap)``.
@@ -197,7 +202,15 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
     ``builder`` (default ``build_generate_fn``) constructs the program on a
     cache miss as ``builder(cap)``; ``extra_key`` tags variant programs
     (e.g. speculative decode knobs) so they never collide with the plain
-    generator at the same shapes."""
+    generator at the same shapes.
+
+    ``obs`` (a :class:`~deepspeed_tpu.observability.CompileWatcher`)
+    makes the cache's lifecycle observable: hit/miss counters, the
+    formerly-silent ``GEN_CACHE_MAX`` eviction (counted AND debug-logged
+    with the evicted key), and — because the built program is wrapped
+    for ahead-of-time compilation — a per-cache compile-latency
+    histogram with the program's cost analysis recorded at compile
+    time."""
     cap = gen_capacity(max_new_tokens)
     # params_fn identity is part of the program: a cached non-dequantizing
     # fn must not be reused if quantization is toggled between calls.
@@ -210,13 +223,25 @@ def get_or_build_gen_fn(cache: Dict[Any, Any], apply_fn, B: int, T: int,
         raise TypeError("gen cache must be an OrderedDict")
     if key in cache:
         cache.move_to_end(key)
+        if obs is not None:
+            obs.hit(cache_name, key)
     else:
+        if obs is not None:
+            obs.miss(cache_name, key)
         if len(cache) >= GEN_CACHE_MAX:
             # managing the caller-owned LRU IS this function's contract
-            cache.popitem(last=False)    # dstlint: disable=no-arg-mutation
-        cache[key] = (builder(cap) if builder is not None  # dstlint: disable=no-arg-mutation
-                      else build_generate_fn(apply_fn, B, T, cap,
-                                             params_fn=params_fn))
+            evicted, _ = cache.popitem(last=False)  # dstlint: disable=no-arg-mutation
+            if obs is not None:
+                obs.eviction(cache_name, evicted)
+            else:
+                logger.debug("gen cache evicted key %r at "
+                             "GEN_CACHE_MAX=%d", evicted, GEN_CACHE_MAX)
+        built = (builder(cap) if builder is not None
+                 else build_generate_fn(apply_fn, B, T, cap,
+                                        params_fn=params_fn))
+        if obs is not None:
+            built = obs.wrap(cache_name, key, built)
+        cache[key] = built               # dstlint: disable=no-arg-mutation
     return cache[key], cap
 
 
@@ -347,7 +372,7 @@ class PagedServeExecutor:
     """
 
     def __init__(self, paged_apply, params, pools, model_config, mesh_ctx,
-                 num_slots: int, decode_chunk: int = 1):
+                 num_slots: int, decode_chunk: int = 1, obs=None):
         self._apply = paged_apply
         self._params = params
         self._pools = pools
@@ -363,9 +388,19 @@ class PagedServeExecutor:
             np.asarray(jax.random.PRNGKey(i)) for i in range(num_slots)])
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fn = None
-        self._copy_fn = None
-        self._spill_fn = None
-        self._restore_fn = None
+        self._copy_fns: Dict[int, Any] = {}
+        self._spill_fns: Dict[int, Any] = {}
+        self._restore_fns: Dict[int, Any] = {}
+        # dstprof compile observability (observability/compile.py): each
+        # compiled-program cache above reports hit/miss/compile events
+        # through the engine's CompileWatcher; None (fake-executor unit
+        # tests, standalone use) keeps the uninstrumented plain-jit path
+        self._obs = obs
+        # decode-program cost (flops/bytes from compile-time cost
+        # analysis) — cached after the first decode, re-asserted into
+        # the registry gauges each call so a bench-style registry reset
+        # between warm-up and measurement cannot lose them
+        self._decode_cost: Optional[dict] = None
         # host-side prefix-cache pool pinned by the engine so the content
         # index survives across serve() calls on this executor (the
         # device pools it describes already do)
@@ -402,7 +437,12 @@ class PagedServeExecutor:
         fn = self._prefill_fns.get(T_cap)
         if fn is None:
             fn = self._build_prefill_fn(T_cap)
+            if self._obs is not None:
+                self._obs.miss("serve_prefill", T_cap)
+                fn = self._obs.wrap("serve_prefill", f"T{T_cap}", fn)
             self._prefill_fns[T_cap] = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_prefill", T_cap)
         tokens = np.zeros((1, T_cap), np.int32)
         tokens[0, :T] = prompt[start:]
         with self._ctx():
@@ -424,11 +464,18 @@ class PagedServeExecutor:
         slot's first write (scheduler contract)."""
         from deepspeed_tpu.ops.paged_attention import copy_pool_blocks
 
-        if self._copy_fn is None:
-            # one jit object; XLA's shape-keyed cache compiles per pair
-            # count (CoW is 1 pair per admission in practice)
-            self._copy_fn = jax.jit(copy_pool_blocks, donate_argnums=(0,))
-        fn = self._copy_fn
+        # keyed per pair count (the unit XLA's shape cache compiled at
+        # anyway — CoW is 1 pair per admission in practice), so each
+        # width is its own observable program
+        fn = self._copy_fns.get(len(pairs))
+        if fn is None:
+            fn = jax.jit(copy_pool_blocks, donate_argnums=(0,))
+            if self._obs is not None:
+                self._obs.miss("serve_copy", len(pairs))
+                fn = self._obs.wrap("serve_copy", f"pairs{len(pairs)}", fn)
+            self._copy_fns[len(pairs)] = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_copy", len(pairs))
         src = jnp.asarray([p[0] for p in pairs], jnp.int32)
         dst = jnp.asarray([p[1] for p in pairs], jnp.int32)
         with self._ctx():
@@ -449,18 +496,24 @@ class PagedServeExecutor:
         fresh = [(k, b) for k, b in entries if not tier.touch(k)]
         if not fresh:
             return
-        if self._spill_fn is None:
-            # a pure read — the pool must SURVIVE the spill, so nothing
-            # is donated (copy/restore donate because they REPLACE pools)
-            self._spill_fn = jax.jit(gather_pool_blocks)  # dstlint: disable=donation-check
         # pow2-bucketed batch: eviction bursts vary per allocation, and
         # a shape-keyed jit would recompile for every distinct length —
         # pad with the null block (a read nobody consumes below)
         ids = [b for _, b in fresh]
         ids += [0] * ((1 << (len(ids) - 1).bit_length()) - len(ids))
+        fn = self._spill_fns.get(len(ids))
+        if fn is None:
+            # a pure read — the pool must SURVIVE the spill, so nothing
+            # is donated (copy/restore donate because they REPLACE pools)
+            fn = jax.jit(gather_pool_blocks)  # dstlint: disable=donation-check
+            if self._obs is not None:
+                self._obs.miss("serve_spill", len(ids))
+                fn = self._obs.wrap("serve_spill", f"w{len(ids)}", fn)
+            self._spill_fns[len(ids)] = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_spill", len(ids))
         with self._ctx():
-            frames = self._spill_fn(self._pools,
-                                    jnp.asarray(ids, jnp.int32))
+            frames = fn(self._pools, jnp.asarray(ids, jnp.int32))
         host = jax.device_get(frames)
         leaves = jax.tree_util.tree_leaves(host)
         for i, (key, _) in enumerate(fresh):
@@ -528,11 +581,18 @@ class PagedServeExecutor:
         blast radius as an unattributed decode error."""
         from deepspeed_tpu.ops.paged_attention import scatter_pool_blocks
 
-        if self._restore_fn is None:
-            self._restore_fn = jax.jit(scatter_pool_blocks,
-                                       donate_argnums=(0,))
+        width = int(len(handle.block_ids))
+        fn = self._restore_fns.get(width)
+        if fn is None:
+            fn = jax.jit(scatter_pool_blocks, donate_argnums=(0,))
+            if self._obs is not None:
+                self._obs.miss("serve_restore", width)
+                fn = self._obs.wrap("serve_restore", f"w{width}", fn)
+            self._restore_fns[width] = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_restore", width)
         with self._ctx():
-            self._pools = self._restore_fn(
+            self._pools = fn(
                 self._pools, jnp.asarray(handle.block_ids), handle.staged)
         if self._host_tier is not None:
             self._host_tier.note_restored(handle.nbytes)
@@ -541,7 +601,15 @@ class PagedServeExecutor:
     def decode(self, tokens, block_tables, seq_lens, active, steps_left,
                max_steps=None):
         if self._decode_fn is None:
-            self._decode_fn = self._build_decode_fn(self.decode_chunk)
+            fn = self._build_decode_fn(self.decode_chunk)
+            if self._obs is not None:
+                self._obs.miss("serve_decode", self.decode_chunk)
+                fn = self._obs.wrap(
+                    "serve_decode",
+                    f"slots{self.num_slots}_chunk{self.decode_chunk}", fn)
+            self._decode_fn = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_decode", self.decode_chunk)
         n = self.decode_chunk if max_steps is None \
             else max(1, min(int(max_steps), self.decode_chunk))
         with self._ctx():
@@ -555,7 +623,82 @@ class PagedServeExecutor:
                 jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
                 jnp.asarray(self._eos_ids))
         self._rngs = np.array(new_rngs)
+        self._publish_decode_cost()
         return np.asarray(out)[:, :n]
+
+    # --- dstprof efficiency / memory accounting -------------------------------
+    def _publish_decode_cost(self) -> None:
+        """Re-assert the decode program's compile-time cost analysis as
+        registry gauges after every decode call (cheap dict writes):
+        FLOPs-per-token is the model work one sampled token costs — the
+        serving half of the MFU story. Survives a bench-style registry
+        reset because the cached cost is executor state, not registry
+        state. The while_loop body is costed at unit trip count, so the
+        figures are per decode STEP, not per chunk."""
+        obs = self._obs
+        if obs is None or obs.registry is None:
+            return
+        if self._decode_cost is None:
+            if getattr(self._decode_fn, "fell_back", False):
+                self._decode_cost = {}   # plain-jit fallback: no analysis
+                return
+            # THIS executor's program, by its own key — the watcher table
+            # is engine-wide and another serving config's decode program
+            # may sit first in it
+            entry = obs.section().get("serve_decode", {}).get(
+                f"slots{self.num_slots}_chunk{self.decode_chunk}")
+            if entry is None:
+                return                   # not compiled yet
+            cost = {}
+            flops = entry.get("flops")
+            nbytes = entry.get("bytes_accessed")
+            if flops:
+                cost["serve.decode_program_flops"] = flops
+                cost["serve.flops_per_token"] = flops / self.num_slots
+            if nbytes:
+                cost["serve.decode_program_bytes_accessed"] = nbytes
+            if flops and nbytes:
+                cost["serve.roofline_intensity_flops_per_byte"] = \
+                    flops / nbytes
+            self._decode_cost = cost
+        for name, v in self._decode_cost.items():
+            obs.registry.set_gauge(name, v)
+
+    def memory_section(self, pool=None) -> dict:
+        """Flat byte accounting for the ``serve.memory`` registry
+        collector: device-side pool/params bytes (exact — summed leaf
+        nbytes), per-block frame bytes, and — given the host-side
+        ``pool`` accounting object — allocated/cached/peak bytes plus
+        the host tier's live/spilled watermarks. This is the measured
+        form of README's two-tier sizing arithmetic."""
+        pool_bytes = tree_device_bytes(self._pools)
+        out = {
+            "pool_device_bytes": pool_bytes,
+            "params_device_bytes": tree_device_bytes(self._params),
+        }
+        num_blocks = 0
+        leaves = jax.tree_util.tree_leaves(self._pools)
+        if leaves and getattr(leaves[0], "ndim", 0) >= 2:
+            num_blocks = int(leaves[0].shape[1])
+        if num_blocks:
+            bpb = pool_bytes / num_blocks
+            out["block_bytes"] = int(bpb)
+            if pool is not None:
+                out["pool_bytes_allocated"] = int(pool.num_allocated * bpb)
+                out["pool_bytes_allocated_peak"] = int(
+                    getattr(pool, "peak_allocated", 0) * bpb)
+                out["pool_bytes_cached"] = int(
+                    getattr(pool, "num_cached", 0) * bpb)
+                out["pool_bytes_free"] = int(pool.num_free * bpb)
+        tier = self._host_tier
+        if tier is not None:
+            out["host_tier_capacity_bytes"] = tier.capacity_bytes
+            out["host_tier_bytes_used"] = tier.bytes_used
+            out["host_tier_bytes_used_peak"] = tier.bytes_used_peak
+            out["host_tier_bytes_spilled"] = tier.bytes_spilled
+            out["host_tier_bytes_restored"] = tier.bytes_restored
+            out["host_tier_entries"] = len(tier)
+        return out
 
     # --- program builders -----------------------------------------------------
     def _build_prefill_fn(self, T_cap: int):
@@ -790,7 +933,7 @@ class InferenceEngine:
                 self._quantize_params()
         self._model_times: List[float] = []
         self._profile_model_time = False
-        # --- dstrace observability (docs/OBSERVABILITY.md) -------------------
+        # --- dstrace/dstprof observability (docs/OBSERVABILITY.md) -----------
         # one metrics registry per engine (serve counters/histograms +
         # pull collectors — prefix-cache stats re-pointed at the live
         # scheduler each serve() call) behind serve_metrics(); the
@@ -798,6 +941,17 @@ class InferenceEngine:
         # and persists across serve() calls (ring-buffered)
         self.metrics = MetricsRegistry()
         self.tracer: Optional[RequestTracer] = None
+        # compile observability: every compiled-program cache this
+        # engine owns (gen LRU, serving executor buckets) reports
+        # hit/miss/eviction + compile latency/cost through one watcher;
+        # COMPILE spans land in whatever tracer is live at compile time
+        self.compile_obs = CompileWatcher(
+            self.metrics, tracer_fn=lambda: self.tracer)
+        self.metrics.register_collector("memory", device_memory_section)
+        self.metrics.register_collector("serve.efficiency",
+                                        self._efficiency_section)
+        # optional stdlib Prometheus scrape endpoint (serve.metrics_port)
+        self._metrics_server = None
         log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}"
                  f"{', int8 weights' if self._quantized else ''}", ranks=[0])
 
@@ -1184,7 +1338,8 @@ class InferenceEngine:
                 extra_key=(("pld", draft_len, prompt_lookup_ngram),),
                 builder=lambda cap: build_pld_generate_fn(
                     apply_fn, B, T_cap, cap, draft_len=draft_len,
-                    ngram=prompt_lookup_ngram, params_fn=params_fn))
+                    ngram=prompt_lookup_ngram, params_fn=params_fn),
+                obs=self.compile_obs)
             t0 = time.time() if self._profile_model_time else None
             with self._ctx():
                 tokens, self._kv_caches, mean_acc = pld_fn(
@@ -1200,7 +1355,8 @@ class InferenceEngine:
             return tokens
         gen_fn, cap = get_or_build_gen_fn(
             self._gen_cache, apply_fn, B, T_cap, max_new_tokens,
-            params_fn=params_fn, params_key=base_key)
+            params_fn=params_fn, params_key=base_key,
+            obs=self.compile_obs)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         t0 = time.time() if self._profile_model_time else None
@@ -1508,6 +1664,13 @@ class InferenceEngine:
         # current session's prefix cache (replacement semantics)
         self.metrics.register_collector("serve.prefix_cache",
                                         scheduler.prefix_cache_stats)
+        # byte-level pool/tier accounting for the SAME executor+pool this
+        # stream serves through (replacement semantics, like above)
+        self.metrics.register_collector(
+            "serve.memory",
+            lambda ex=executor, p=pool: ex.memory_section(p))
+        if serve_cfg.metrics_port and self._metrics_server is None:
+            self.start_metrics_server()
         for r in reqs:
             try:
                 scheduler.submit(r, now=r.arrival_time)
@@ -1566,17 +1729,105 @@ class InferenceEngine:
         sched = getattr(self, "last_serve_scheduler", None)
         return bool(sched is not None and sched.cancel(rid))
 
-    # --- observability (dstrace: docs/OBSERVABILITY.md) -----------------------
-    def serve_metrics(self) -> dict:
-        """One plain-dict snapshot of the engine's metrics registry:
-        serve counters (per-status completions, tokens, preemptions/
-        stalls/spills/restores), gauges (pool occupancy, slot states),
-        histograms (``serve.ttft_s``/``serve.tpot_s``/
-        ``serve.latency_s``/``serve.queue_wait_s`` → count/sum/p50/p95/
-        p99) and the live scheduler's prefix-cache/tier section.
-        ``bench.py --serve`` cross-checks these against its own external
-        measurement so the two can never silently diverge."""
-        return self.metrics.snapshot()
+    # --- observability (dstrace/dstprof: docs/OBSERVABILITY.md) ---------------
+    def serve_metrics(self, format: str = "dict"):
+        """The engine's metrics registry, in one of two shapes:
+
+        - ``format="dict"`` (default): the plain-dict ``snapshot()`` —
+          serve counters (per-status completions, tokens, preemptions/
+          stalls/spills/restores, compile hit/miss/evictions), gauges
+          (pool occupancy, slot states, per-device memory, FLOPs-per-
+          token), histograms (``serve.ttft_s``/``serve.tpot_s``/
+          ``serve.latency_s``/``serve.queue_wait_s``/
+          ``compile.*.compile_s`` → count/sum/p50/p95/p99) and the
+          collector sections (prefix cache, ``serve.memory`` byte
+          watermarks, ``serve.efficiency``, ``compile`` program table).
+          ``bench.py --serve`` cross-checks these against its own
+          external measurement so the two can never silently diverge.
+        - ``format="prometheus"``: the same registry as exposition
+          text (``observability/promexport.py`` — full
+          ``_bucket/_sum/_count`` histogram conventions), the payload
+          the ``serve.metrics_port`` endpoint scrapes."""
+        if format == "dict":
+            return self.metrics.snapshot()
+        if format == "prometheus":
+            from deepspeed_tpu.observability import prometheus_text
+
+            return prometheus_text(self.metrics)
+        raise ValueError(
+            f"serve_metrics(format={format!r}): expected 'dict' or "
+            f"'prometheus'")
+
+    def start_metrics_server(self, port: Optional[int] = None) -> int:
+        """Start the stdlib HTTP scrape endpoint (``/metrics``
+        Prometheus text, ``/metrics.json`` raw snapshot) on
+        ``port`` (default ``serve.metrics_port``; 0 binds an ephemeral
+        port). Idempotent; returns the bound port. The registry and
+        exporter renders from per-histogram snapshots and the tracer
+        is lock-guarded, so scrapes are safe mid-stream."""
+        if self._metrics_server is not None:
+            return self._metrics_server.port
+        from deepspeed_tpu.observability import (
+            MetricsHTTPServer, prometheus_text,
+        )
+
+        if port is None:
+            port = int(getattr(self._config, "serve").metrics_port)
+        self._metrics_server = MetricsHTTPServer(
+            lambda: prometheus_text(self.metrics),
+            json_fn=self.metrics.snapshot, port=port)
+        bound = self._metrics_server.start()
+        log_dist(f"dstprof metrics endpoint on :{bound}/metrics",
+                 ranks=[0])
+        return bound
+
+    def stop_metrics_server(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    def capture_profile(self, path: str):
+        """Context manager capturing a jax/XLA profiler trace of the
+        enclosed window into ``path`` (a directory; loads in
+        TensorBoard's profile plugin / xprof). On-demand and scoped —
+        the always-on dstrace layer stays host-side; this is the
+        escape hatch into what XLA actually did."""
+        from deepspeed_tpu.observability import capture_profile
+
+        return capture_profile(path)
+
+    def _efficiency_section(self) -> dict:
+        """``serve.efficiency`` registry collector: achieved model
+        FLOP/s and MFU from (a) the decode program's compile-time
+        FLOPs-per-token (gauge, republished per decode call) and (b)
+        the registry's own decode timing/token counters — achieved =
+        FLOPs/token x tokens sampled / decode seconds. Zeros mean "not
+        measured yet", never a fake utilization."""
+        from deepspeed_tpu.observability import mfu, peak_flops_per_device
+
+        serve_cfg = getattr(self._config, "serve")
+        peak = peak_flops_per_device(
+            getattr(serve_cfg, "peak_tflops", None))
+        n_dev = int(self.mesh.devices.size)
+        fpt = self.metrics.gauge("serve.flops_per_token")
+        tokens = self.metrics.counter("serve.tokens_sampled")
+        hists = self.metrics.histograms()
+        decode_s = (hists["serve.decode_chunk_s"].sum
+                    if "serve.decode_chunk_s" in hists else 0.0)
+        achieved = (fpt * tokens / decode_s) if (fpt and decode_s) else 0.0
+        return {
+            "model_flops_per_token": fpt,
+            "tokens_sampled": tokens,
+            "decode_seconds": decode_s,
+            "achieved_model_flops_per_sec": achieved,
+            "peak_flops_per_device": peak["flops"],
+            "peak_source": peak["source"],
+            "device_kind": str(peak["device_kind"]),
+            "n_devices": n_dev,
+            "mfu": mfu(fpt * tokens, decode_s, n_dev, peak["flops"]),
+            "roofline_intensity_flops_per_byte": self.metrics.gauge(
+                "serve.roofline_intensity_flops_per_byte"),
+        }
 
     def export_trace(self, path: Optional[str] = None) -> dict:
         """The accumulated request-lifecycle trace as a Chrome/Perfetto
@@ -1660,7 +1911,7 @@ class InferenceEngine:
                                int8=kv8)
         executor = PagedServeExecutor(
             paged_apply, serve_params, pools, cfg, self._ctx, num_slots,
-            decode_chunk=decode_chunk)
+            decode_chunk=decode_chunk, obs=self.compile_obs)
         while len(cache) >= SERVE_CACHE_MAX:
             cache.popitem(last=False)          # each entry pins K/V pools
         cache[key] = (self.params, executor)

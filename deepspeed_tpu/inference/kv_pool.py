@@ -96,6 +96,9 @@ class BlockPool:
         # first
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._allocated = set()
+        # monotonic high-watermark of blocks held at once (dstprof
+        # memory accounting: pool sizing is measured, not guessed)
+        self.peak_allocated = 0
 
     @property
     def num_free(self) -> int:
@@ -119,6 +122,7 @@ class BlockPool:
                 f"block pool exhausted: requested {n}, free {len(self._free)}")
         ids = [self._free.pop() for _ in range(n)]
         self._allocated.update(ids)
+        self.peak_allocated = max(self.peak_allocated, len(self._allocated))
         return ids
 
     def free(self, ids: Sequence[int]) -> None:
@@ -271,6 +275,7 @@ class PrefixCachingBlockPool(BlockPool):
                 self._evict(bid)
                 ids.append(bid)
         self._allocated.update(ids)
+        self.peak_allocated = max(self.peak_allocated, len(self._allocated))
         for b in ids:
             self._refs[b] = 1
         return ids
@@ -287,6 +292,8 @@ class PrefixCachingBlockPool(BlockPool):
                     f"cannot share block {bid}: neither held nor cached")
             self._lru.pop(bid, None)
             self._allocated.add(bid)
+            self.peak_allocated = max(self.peak_allocated,
+                                      len(self._allocated))
         self._refs[bid] = r + 1
 
     def release_blocks(self, ids: Sequence[int]) -> None:

@@ -102,6 +102,9 @@ class HostKVTier:
         self._nbytes: Dict[bytes, int] = {}
         self._handles: Dict[bytes, list] = {}
         self.bytes_used = 0
+        # monotonic high-watermark (dstprof: two-tier sizing is
+        # measured, not arithmetic in docs)
+        self.bytes_used_peak = 0
         self._arena = None
         if staging_mb > 0:
             from deepspeed_tpu.runtime.zero.contiguous_memory_allocator \
@@ -177,6 +180,7 @@ class HostKVTier:
         if any(h is not None for h in handles):
             self._handles[key] = handles
         self.bytes_used += nbytes
+        self.bytes_used_peak = max(self.bytes_used_peak, self.bytes_used)
         self.spills += 1
         self.bytes_spilled += nbytes
         return True
@@ -268,6 +272,7 @@ class HostKVTier:
         return {
             "capacity_bytes": self.capacity_bytes,
             "bytes_used": self.bytes_used,
+            "bytes_used_peak": self.bytes_used_peak,
             "entries": len(self._store),
             "spills": self.spills,
             "refreshes": self.refreshes,
@@ -296,6 +301,9 @@ class HostKVTier:
         if self.bytes_used > self.capacity_bytes:
             v.append(f"host tier over capacity: {self.bytes_used} > "
                      f"{self.capacity_bytes}")
+        if self.bytes_used_peak < self.bytes_used:
+            v.append(f"host tier watermark below live bytes: peak "
+                     f"{self.bytes_used_peak} < used {self.bytes_used}")
         stale = set(self._handles) - set(self._store)
         if stale:
             v.append(f"host tier arena handles for {len(stale)} evicted "

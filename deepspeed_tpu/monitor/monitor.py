@@ -139,6 +139,45 @@ class JSONLMonitor(Monitor):
         self._fh.flush()
 
 
+class PrometheusFileMonitor(Monitor):
+    """Prometheus TEXTFILE sink (dstprof, docs/OBSERVABILITY.md): each
+    registry drain rewrites ``output_path/job_name/metrics.prom`` with
+    the FULL exposition rendering of the engine's metrics registry —
+    counters/gauges and real ``_bucket/_sum/_count`` histograms, not
+    the flattened (name, value, step) events — for node-exporter's
+    textfile collector to pick up. Atomic replace (write + rename): a
+    collector must never read a half-written exposition. Plain events
+    (``write_events``) are ignored; this sink only speaks registry."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.path = None
+        if not self.enabled or jax.process_index() != 0:
+            self.enabled = False
+            return
+        out_dir = os.path.join(config.output_path or "./prometheus",
+                               config.job_name)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            self.path = os.path.join(out_dir, "metrics.prom")
+        except OSError as e:
+            logger.warning(f"prometheus monitor unusable ({e}); disabling")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        pass                            # registry-only sink
+
+    def write_registry_text(self, registry, step: int) -> None:
+        if not self.enabled or self.path is None:
+            return
+        from deepspeed_tpu.observability import prometheus_text
+
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(prometheus_text(registry))
+        os.replace(tmp, self.path)
+
+
 class MonitorMaster(Monitor):
     """Fan-out master (reference monitor/monitor.py:29)."""
 
@@ -146,10 +185,13 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self.prometheus_monitor = PrometheusFileMonitor(
+            ds_config.prometheus_monitor)
         # the dependency-free default: auto-on when anything above asked
         # for monitoring (or when explicitly enabled by itself)
         any_other = (self.tb_monitor.enabled or self.wandb_monitor.enabled
-                     or self.csv_monitor.enabled)
+                     or self.csv_monitor.enabled
+                     or self.prometheus_monitor.enabled)
         self.jsonl_monitor = JSONLMonitor(ds_config.jsonl_monitor,
                                           auto_enabled=any_other)
         self.enabled = any_other or self.jsonl_monitor.enabled
@@ -191,3 +233,6 @@ class MonitorMaster(Monitor):
                     events.append((f"{prefix}/{section}.{name}", v, step))
         if events:
             self.write_events(events)
+        # the prometheus sink renders the registry itself (exposition
+        # histograms need raw buckets the event tuples cannot carry)
+        self.prometheus_monitor.write_registry_text(registry, step)

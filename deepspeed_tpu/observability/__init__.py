@@ -1,16 +1,31 @@
-"""dstrace — unified observability for the serving and training stacks.
+"""dstrace + dstprof — unified observability for serving and training.
 
 One metrics registry (``MetricsRegistry``: counters, gauges, log-bucket
 histograms, pull collectors → a single ``snapshot()`` dict) plus one
 per-request lifecycle tracer (``RequestTracer``: ring-buffered spans at
 the scheduler's host-call boundaries, exported as Chrome/Perfetto
-trace-event JSON). Entry points:
+trace-event JSON), extended by the dstprof resource layer:
 
-- serving: ``InferenceEngine.serve_metrics()`` /
-  ``engine.export_trace()`` / the ``serve.trace*`` knobs
+- ``compile.py`` — every compiled-program cache watched (hit/miss/
+  eviction counters, exact AOT compile-latency histograms, per-program
+  cost analysis, recompile-storm detection, COMPILE tracer spans);
+- ``memory.py`` — per-device bytes (allocator stats or live-buffer
+  walk) and pool/tier byte accounting helpers;
+- ``efficiency.py`` — peak-FLOPs table + MFU/FLOPs-per-token math;
+- ``promexport.py`` — dependency-free Prometheus text exporter,
+  exposition checker, stdlib HTTP scrape endpoint;
+- ``profile.py`` — on-demand ``jax.profiler`` capture.
+
+Entry points:
+
+- serving: ``InferenceEngine.serve_metrics(format=...)`` /
+  ``engine.export_trace()`` / ``engine.capture_profile()`` / the
+  ``serve.trace*`` + ``serve.metrics_port`` knobs
   (docs/OBSERVABILITY.md);
 - training: ``DeepSpeedEngine.metrics`` (timers, throughput, ZeRO
-  reduction bytes, comms wire totals), drained by ``monitor/`` sinks.
+  reduction bytes, comms wire totals, train MFU), drained by
+  ``monitor/`` sinks (incl. the Prometheus textfile sink);
+- CLI: ``bin/dst prof`` one-shot report.
 
 Everything here is strictly host-side — dstlint's jaxpr budgets prove
 instrumentation adds zero traced equations to the compiled programs.
@@ -22,7 +37,21 @@ from deepspeed_tpu.observability.metrics import (
 from deepspeed_tpu.observability.tracer import (
     RequestTracer, SCHEDULER_TID, slot_tid, validate_chrome_trace,
 )
+from deepspeed_tpu.observability.compile import AOTProgram, CompileWatcher
+from deepspeed_tpu.observability.memory import (
+    device_memory_section, tree_device_bytes,
+)
+from deepspeed_tpu.observability.efficiency import mfu, peak_flops_per_device
+from deepspeed_tpu.observability.promexport import (
+    MetricsHTTPServer, check_exposition, prometheus_text,
+)
+from deepspeed_tpu.observability.profile import capture_profile
 
 __all__ = ["Histogram", "MetricsRegistry", "default_registry",
            "RequestTracer", "SCHEDULER_TID", "slot_tid",
-           "validate_chrome_trace"]
+           "validate_chrome_trace",
+           "AOTProgram", "CompileWatcher",
+           "device_memory_section", "tree_device_bytes",
+           "mfu", "peak_flops_per_device",
+           "MetricsHTTPServer", "check_exposition", "prometheus_text",
+           "capture_profile"]

@@ -1,0 +1,288 @@
+"""dstprof compile observability — the compiled-program caches, watched.
+
+Every long-lived compiled-program cache in the stack (the ``generate()``
+LRU in ``inference/engine.get_or_build_gen_fn``, the serving executor's
+per-bucket prefill / decode / copy / spill / restore programs, the
+train-step jit in ``runtime/engine.py``) compiles silently: a cold
+bucket mid-measurement once read as a prefix-cache slowdown (PR 3's
+bench warm-up lesson), and nothing distinguished "the model is slow"
+from "XLA was compiling". This module makes compilation a first-class
+registry citizen:
+
+- **hit/miss/eviction counters** per cache
+  (``compile.<cache>.hits`` / ``.misses`` / ``.evictions``) plus the
+  total ``compile.<cache>.compiles``;
+- **per-cache compile-latency histograms** (``compile.<cache>.compile_s``)
+  measured around the REAL ``lower().compile()`` — programs are
+  ahead-of-time compiled on their first call (:class:`AOTProgram`), so
+  the interval is XLA compile time, not first-call-includes-everything;
+- **per-program cost**: ``compiled.cost_analysis()`` FLOPs / bytes
+  recorded once at compile time (the ``flops_profiler`` numbers, fed
+  instead of dropped) — the efficiency layer derives MFU and
+  FLOPs-per-token from them;
+- **COMPILE spans** in the request tracer, so a TTFT p99 blown by a
+  cold bucket is visible in Perfetto next to the request it stalled;
+- a **recompile-storm detector**: the same cache key compiled
+  ``storm_threshold`` times inside ``storm_window_s`` raises a warning
+  counter (``compile.recompile_storms``) + structured log — the RUNTIME
+  complement of dstlint's static ``recompile-hazard`` rule.
+
+Everything here is host-side bookkeeping around compilation boundaries;
+the compiled programs themselves are byte-identical (the dstlint jaxpr
+budget gate pins exactly that).
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["CompileWatcher", "AOTProgram", "extract_cost"]
+
+
+def extract_cost(compiled) -> Dict[str, float]:
+    """{'flops', 'bytes_accessed'} from a ``jax.stages.Compiled`` —
+    normalized across the list/dict/None shapes ``cost_analysis()``
+    returns per backend (the ``flops_profiler.cost_analysis`` idiom)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:
+        # some backends expose no analysis; the program still serves
+        logger.debug("cost_analysis unavailable: %s", e)
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = dict(ca or {})
+    out = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out
+
+
+class AOTProgram:
+    """One jitted function, ahead-of-time compiled at its first call.
+
+    Wraps a ``jax.jit`` product whose call shapes are FIXED (each serving
+    bucket / batch width gets its own wrapper): the first call runs
+    ``lower(*args).compile()`` — the watcher times it, records its cost
+    analysis and emits the COMPILE span — and subsequent calls go
+    straight to the compiled executable. Donation/out_shardings declared
+    at ``jax.jit`` time are preserved by the AOT path.
+
+    If AOT lowering itself fails (an exotic arg the stages API refuses),
+    the wrapper falls back to calling the plain jitted function — the
+    program still compiles and runs through jit's own cache, only the
+    compile-latency attribution is lost (counted in
+    ``compile.<cache>.aot_fallbacks``). A failure while COMPILING is
+    real (the program is unbuildable) and propagates.
+    """
+
+    __slots__ = ("_jitted", "_compiled", "_alt", "_watcher", "cache",
+                 "key", "_fallback")
+
+    def __init__(self, jitted: Callable, watcher: "CompileWatcher",
+                 cache: str, key: str):
+        self._jitted = jitted
+        self._compiled = None
+        # previous executable, kept when input layouts drift: a program
+        # ALTERNATING between two layouts (first-call vs steady-state
+        # sharding, interleaved phases) then behaves like plain jit's
+        # two cached entries instead of recompiling every call
+        self._alt = None
+        self._watcher = watcher
+        self.cache = cache
+        self.key = key
+        self._fallback = False
+
+    @property
+    def compiled(self) -> bool:
+        """True once the AOT executable exists (False before the first
+        call AND on the plain-jit fallback path, which has no compile
+        attribution)."""
+        return self._compiled is not None and not self._fallback
+
+    @property
+    def fell_back(self) -> bool:
+        return self._fallback
+
+    def __getattr__(self, name):
+        # transparent proxy for introspection (tests poke the wrapped
+        # jit's _cache_size(); tools read __wrapped__-style attrs)
+        return getattr(self._jitted, name)
+
+    def __call__(self, *args):
+        fn = self._compiled
+        if fn is None:
+            fn = self._build(args)
+        try:
+            return fn(*args)
+        except ValueError as e:
+            # input sharding/layout drift (e.g. a train step whose
+            # first-call params were laid out differently from the
+            # steady state): plain jit silently recompiles here — do
+            # the same, but COUNTED, which is the whole point of this
+            # wrapper (the storm detector flags a pathological loop).
+            # Raised during argument validation, before any donated
+            # buffer is consumed, so retrying with another executable
+            # is safe.
+            if self._fallback or \
+                    "Compiled object called with input" not in str(e):
+                raise
+            alt = self._alt
+            if alt is not None:
+                try:
+                    out = alt(*args)
+                except ValueError as e2:
+                    if "Compiled object called with input" not in str(e2):
+                        raise
+                else:
+                    # MRU swap: alternating layouts ping-pong between
+                    # the two executables with zero further compiles
+                    self._alt, self._compiled = self._compiled, alt
+                    return out
+            self._alt = self._compiled
+            fn = self._build(args)
+            return fn(*args)
+
+    def _build(self, args):
+        w = self._watcher
+        try:
+            lowered = self._jitted.lower(*args)
+        except Exception as e:
+            # stages API refused the args — degrade to the plain jit
+            # call path (program still compiles, attribution lost)
+            self._fallback = True
+            self._compiled = self._jitted
+            w._note_fallback(self.cache, self.key, e)
+            return self._compiled
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        self._compiled = compiled
+        w.record_compile(self.cache, self.key, dt,
+                         cost=extract_cost(compiled))
+        return compiled
+
+
+class CompileWatcher:
+    """Per-engine compile observability over a ``MetricsRegistry``.
+
+    One watcher serves every cache of one engine. ``registry`` may be
+    None (all emission off — the hooks stay callable so call sites need
+    no branching); ``tracer_fn`` is a zero-arg callable returning the
+    CURRENT tracer or None (engines mint tracers lazily). The watcher
+    registers itself as the registry's ``compile`` collector section, a
+    per-program table of compile counts/seconds/FLOPs the snapshot
+    carries alongside the counters.
+    """
+
+    def __init__(self, registry=None, tracer_fn: Optional[Callable] = None,
+                 storm_threshold: int = 3, storm_window_s: float = 60.0):
+        self.registry = registry
+        self._tracer_fn = tracer_fn or (lambda: None)
+        self.storm_threshold = int(storm_threshold)
+        self.storm_window_s = float(storm_window_s)
+        # (cache, key) -> program stats; guarded: a scrape thread reads
+        # the section while the serving thread compiles
+        self._lock = threading.Lock()
+        self._programs: Dict[str, Dict[str, dict]] = {}
+        self._compile_times: Dict[Any, deque] = {}
+        self.storms = 0
+        if registry is not None:
+            registry.register_collector("compile", self.section)
+
+    # --- cache events ---------------------------------------------------------
+    def hit(self, cache: str, key: Any = None) -> None:
+        if self.registry is not None:
+            self.registry.inc(f"compile.{cache}.hits")
+
+    def miss(self, cache: str, key: Any = None) -> None:
+        if self.registry is not None:
+            self.registry.inc(f"compile.{cache}.misses")
+
+    def eviction(self, cache: str, key: Any = None) -> None:
+        """A compiled program fell off its LRU — the silent event the
+        gen cache used to swallow. Debug-logged with the key: a
+        recompile storm's root cause is usually visible right here."""
+        if self.registry is not None:
+            self.registry.inc(f"compile.{cache}.evictions")
+        logger.debug("compile cache %s evicted key %r", cache, key)
+
+    # --- program lifecycle ----------------------------------------------------
+    def wrap(self, cache: str, key: Any, jitted: Callable) -> AOTProgram:
+        """Wrap a fixed-shape jitted function for AOT compile
+        observation. ``key`` labels the program in the section table
+        (bucket size, batch width, params tag...)."""
+        return AOTProgram(jitted, self, cache, str(key))
+
+    def record_compile(self, cache: str, key: Any, seconds: float,
+                       cost: Optional[dict] = None) -> None:
+        """One program compiled: counters, latency histogram, section
+        table, COMPILE span, storm detection. Callable directly for
+        compiles that happen outside an :class:`AOTProgram` (a caller
+        timing its own ``lower().compile()``)."""
+        key = str(key)
+        cost = cost or {}
+        r = self.registry
+        if r is not None:
+            r.inc(f"compile.{cache}.compiles")
+            r.observe(f"compile.{cache}.compile_s", seconds)
+        with self._lock:
+            entry = self._programs.setdefault(cache, {}).setdefault(
+                key, {"compiles": 0, "seconds_total": 0.0, "last_s": 0.0})
+            entry["compiles"] += 1
+            entry["seconds_total"] = round(
+                entry["seconds_total"] + seconds, 6)
+            entry["last_s"] = round(seconds, 6)
+            entry.update({k: v for k, v in cost.items()})
+        tracer = self._tracer_fn()
+        if tracer is not None:
+            t1 = tracer.now()
+            tracer.span("COMPILE", t1 - seconds, t1, cat="compile",
+                        cache=cache, key=key)
+        self._detect_storm(cache, key)
+
+    def _detect_storm(self, cache: str, key: str) -> None:
+        now = time.monotonic()
+        q = self._compile_times.setdefault((cache, key), deque(maxlen=16))
+        q.append(now)
+        recent = [t for t in q if now - t <= self.storm_window_s]
+        if len(recent) >= self.storm_threshold:
+            self.storms += 1
+            if self.registry is not None:
+                self.registry.inc("compile.recompile_storms")
+            logger.warning(
+                "recompile storm: cache=%s key=%s compiled %d times in "
+                "%.1fs — a traced value is probably leaking into a cache "
+                "key or Python branch (dstlint: recompile-hazard)",
+                cache, key, len(recent), self.storm_window_s)
+            q.clear()           # one storm report per burst, not per compile
+
+    def _note_fallback(self, cache: str, key: str, err: Exception) -> None:
+        if self.registry is not None:
+            self.registry.inc(f"compile.{cache}.aot_fallbacks")
+        logger.debug("AOT lower failed for %s/%s (%s); falling back to "
+                     "the plain jit call path", cache, key, err)
+
+    # --- read side ------------------------------------------------------------
+    def section(self) -> dict:
+        """The registry's ``compile`` collector: per-program compile
+        counts, seconds and cost — survives ``registry.reset()`` (the
+        bench's warm-up/measured-window split reads it across resets)."""
+        with self._lock:
+            return {cache: {k: dict(v) for k, v in progs.items()}
+                    for cache, progs in self._programs.items()}
+
+    def compiles_total(self, prefix: str = "") -> int:
+        """Total compiles across caches whose name starts with
+        ``prefix`` — the bench's zero-compiles-in-measured-window guard
+        reads this before and after the timed run."""
+        with self._lock:
+            return sum(e["compiles"]
+                       for cache, progs in self._programs.items()
+                       if cache.startswith(prefix)
+                       for e in progs.values())

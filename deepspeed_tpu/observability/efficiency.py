@@ -1,0 +1,91 @@
+"""dstprof model-efficiency observability — MFU, FLOPs-per-token,
+roofline intensity.
+
+"DeepSpeed Inference" (PAPERS.md) frames serving efficiency as achieved
+vs peak throughput, and the Gemma-on-TPU comparison reports MFU as the
+headline cross-hardware number. Both need two ingredients this stack
+already has but never combined: exact per-program FLOPs/bytes from
+``compiled.cost_analysis()`` (recorded once at compile time by
+``observability.compile``) and wall-clock step/decode timings (the
+registry's histograms). This module supplies the third — a peak-FLOPs
+denominator per platform — and the arithmetic:
+
+- ``train MFU`` = model FLOPs per step / step seconds / (peak FLOPs x
+  participating devices);
+- ``serve FLOPs-per-token`` = decode-program FLOPs / slots (the model
+  work one sampled token costs at unit chunk);
+- ``roofline intensity`` = program FLOPs / bytes accessed — where the
+  program sits against the memory wall (decode is expected deep in the
+  bandwidth-bound regime; a drift toward compute-bound flags a kernel
+  regression).
+
+The peak table is deliberately small and overridable
+(``peak_tflops`` knob / ``DST_PEAK_TFLOPS`` env): peak numbers are
+marketing constants, and the honest posture is "a stated denominator
+you can pin", not hardware archaeology. Off-TPU the fallback is a
+nominal CPU figure flagged ``estimated`` — MFU there orders runs, it
+does not grade them.
+"""
+
+import os
+from typing import Optional, Tuple
+
+import jax
+
+__all__ = ["peak_flops_per_device", "mfu", "PEAK_FLOPS_BY_KIND"]
+
+# bf16 dense peak FLOP/s per chip (public spec sheets), matched by
+# substring against Device.device_kind (e.g. "TPU v4", "TPU v5 lite")
+PEAK_FLOPS_BY_KIND: Tuple[Tuple[str, float], ...] = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+# nominal single-socket CPU figure — flagged estimated; exists so the
+# MFU plumbing is testable on the CPU mesh, not so CPU MFU means much
+_CPU_PEAK = 1e11
+
+
+def peak_flops_per_device(override_tflops: Optional[float] = None) -> dict:
+    """{'flops': peak FLOP/s per device, 'source': ...,
+    'device_kind': ...}. Resolution order: explicit override knob >
+    ``DST_PEAK_TFLOPS`` env > the per-kind table > estimated fallback."""
+    if override_tflops:
+        return {"flops": float(override_tflops) * 1e12,
+                "source": "override", "device_kind": "user"}
+    env = os.environ.get("DST_PEAK_TFLOPS")
+    if env:
+        return {"flops": float(env) * 1e12, "source": "env",
+                "device_kind": "user"}
+    try:
+        kind = jax.local_devices()[0].device_kind
+    except Exception:   # dstlint: disable=no-silent-except (probe: a backend with no devices yet — "unknown" IS the outcome, routed to the estimated fallback)
+        kind = "unknown"
+    low = str(kind).lower()
+    for tag, flops in PEAK_FLOPS_BY_KIND:
+        if tag in low:
+            return {"flops": flops, "source": "table", "device_kind": kind}
+    return {"flops": _CPU_PEAK, "source": "estimated",
+            "device_kind": kind}
+
+
+def mfu(model_flops: float, seconds: float, n_devices: int = 1,
+        peak_flops: Optional[float] = None) -> float:
+    """Model-FLOPs utilization: achieved model FLOP/s over the
+    aggregate peak. Returns 0.0 whenever an ingredient is missing —
+    an absent cost analysis must read as "not measured", never as a
+    fake 100%."""
+    if not model_flops or not seconds or seconds <= 0:
+        return 0.0
+    peak = peak_flops if peak_flops else peak_flops_per_device()["flops"]
+    denom = peak * max(1, int(n_devices))
+    if denom <= 0:
+        return 0.0
+    return (model_flops / seconds) / denom

@@ -1,0 +1,97 @@
+"""dstprof memory observability — device HBM and host-side KV byte
+accounting as registry sections.
+
+Pool sizing is the serving stack's central resource decision (README's
+two-tier sizing arithmetic), yet nothing at runtime reported what the
+device actually holds. This module is the read side:
+
+- :func:`device_memory_section` — per-device bytes-in-use / peak /
+  limit from ``Device.memory_stats()`` where the platform exposes
+  allocator stats (TPU does), falling back to a live-buffer walk
+  (``jax.live_arrays()`` attributed per device through addressable
+  shards) where it does not (the CPU test mesh). The section is FLAT
+  (``device0.bytes_in_use``-style keys) so the monitor sinks and the
+  Prometheus exporter drain it without schema knowledge.
+- high-watermark helpers used by the pool/tier accounting
+  (``kv_pool.BlockPool.peak_allocated``,
+  ``kv_tiering.HostKVTier.bytes_used_peak``) so two-tier sizing is
+  measured, not arithmetic in docs.
+
+Pull-only: nothing here runs on the serving hot path — the registry
+calls the section function at ``snapshot()`` time.
+"""
+
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["device_memory_section", "live_buffer_bytes_by_device"]
+
+# memory_stats() keys worth surfacing verbatim when present
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_free_block_bytes", "pool_bytes")
+
+
+def live_buffer_bytes_by_device() -> Dict[int, int]:
+    """Fallback accounting: walk the process's live jax arrays and
+    attribute each addressable shard's bytes to its device. Costs
+    O(live arrays) — acceptable at snapshot cadence, not per step."""
+    out: Dict[int, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            shards = arr.addressable_shards
+        except Exception:   # dstlint: disable=no-silent-except (probe: a buffer deleted/donated mid-walk has no shards; skipping it IS the outcome)
+            continue
+        for sh in shards:
+            data = sh.data
+            if data is not None:
+                out[sh.device.id] = out.get(sh.device.id, 0) + int(data.nbytes)
+    return out
+
+
+def device_memory_section(devices=None) -> dict:
+    """Flat per-device memory section for a registry collector.
+
+    Keys: ``device<i>.bytes_in_use``, ``device<i>.peak_bytes_in_use``,
+    ``device<i>.bytes_limit`` (when known), plus ``devices`` and
+    ``source`` ("memory_stats" | "live_buffer_walk"). The live-buffer
+    walk has no allocator peak — only in-use bytes — so peak keys are
+    absent there rather than lying.
+    """
+    devs = list(devices if devices is not None else jax.local_devices())
+    out: dict = {"devices": len(devs)}
+    stats_by_dev = {}
+    have_stats = True
+    for d in devs:
+        try:
+            s = d.memory_stats() or {}
+        except Exception:   # dstlint: disable=no-silent-except (probe: platforms without allocator stats raise; the live-buffer fallback below IS the outcome)
+            s = {}
+        if "bytes_in_use" not in s:
+            have_stats = False
+            break
+        stats_by_dev[d.id] = s
+    if have_stats and devs:
+        out["source"] = "memory_stats"
+        for i, d in enumerate(devs):
+            s = stats_by_dev[d.id]
+            for k in _STAT_KEYS:
+                if k in s:
+                    out[f"device{i}.{k}"] = int(s[k])
+    else:
+        out["source"] = "live_buffer_walk"
+        live = live_buffer_bytes_by_device()
+        for i, d in enumerate(devs):
+            out[f"device{i}.bytes_in_use"] = int(live.get(d.id, 0))
+    return out
+
+
+def tree_device_bytes(tree) -> int:
+    """Total device bytes of a pytree of arrays (the executor's pool /
+    params accounting — sharded leaves count their full global bytes)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total

@@ -196,6 +196,20 @@ class MetricsRegistry:
             self._collectors[name] = fn
 
     # --- read side ------------------------------------------------------------
+    def counter(self, name: str, default: float = 0) -> float:
+        """Current value of a counter (absent -> ``default``)."""
+        return self._counters.get(name, default)
+
+    def gauge(self, name: str, default: float = 0.0) -> float:
+        """Current value of a gauge (absent -> ``default``)."""
+        return self._gauges.get(name, default)
+
+    def histograms(self) -> Dict[str, Histogram]:
+        """Live histogram objects by name — the Prometheus exporter
+        reads raw bucket counts here (``snapshot()`` only carries the
+        summaries; ``_bucket`` lines need the real distribution)."""
+        return dict(self._hists)
+
     def snapshot(self) -> dict:
         """Everything, as one plain dict (JSON-serializable)."""
         out = {

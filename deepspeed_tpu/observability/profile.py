@@ -1,0 +1,37 @@
+"""On-demand XLA profiling hooks (``engine.capture_profile``).
+
+The dstrace/dstprof layer answers "what did the system do" from host
+boundaries; when the question becomes "what did XLA do inside a step",
+the answer is a real device trace. This is a thin, dependency-light
+wrapper over ``jax.profiler`` so both engines expose the same
+one-liner:
+
+    with engine.capture_profile("/tmp/xprof"):
+        engine.train_batch(batch)          # or a serve() window
+
+The captured directory loads in TensorBoard's profile plugin /
+xprof / Perfetto (jax writes its standard trace layout). Profiling is
+strictly opt-in and scoped: the context manager guarantees the
+profiler stops even when the profiled window raises.
+"""
+
+import contextlib
+
+import jax
+
+__all__ = ["capture_profile"]
+
+
+@contextlib.contextmanager
+def capture_profile(path: str,
+                    profiler_start=None, profiler_stop=None):
+    """Context manager: capture a jax/XLA profiler trace into ``path``
+    (a directory). ``profiler_start``/``profiler_stop`` exist for
+    tests; defaults are ``jax.profiler.start_trace``/``stop_trace``."""
+    start = profiler_start or jax.profiler.start_trace
+    stop = profiler_stop or jax.profiler.stop_trace
+    start(path)
+    try:
+        yield path
+    finally:
+        stop()

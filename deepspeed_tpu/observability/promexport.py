@@ -1,0 +1,351 @@
+"""Prometheus text-format export over the dstrace ``MetricsRegistry``.
+
+Dependency-free (stdlib only) exposition of everything ``snapshot()``
+holds, in the text format every Prometheus-compatible scraper ingests
+(OpenMetrics-adjacent version 0.0.4):
+
+- counters → ``<name>_total`` with ``# TYPE ... counter``;
+- gauges → plain samples with ``# TYPE ... gauge``;
+- histograms → the full ``_bucket{le=...}/_sum/_count`` convention.
+  The registry's fine log-spaced buckets (48/decade) are COARSENED to a
+  fixed ``le`` ladder (default 2 edges/decade over the histogram's
+  range — ~23 buckets instead of ~530) by exact cumulative summation,
+  so bucket counts stay mathematically exact, just coarser;
+- collector sections (prefix-cache stats, memory, tier bytes) →
+  gauges named ``<section>_<key>``, numeric leaves only.
+
+Name sanitization maps the registry's dotted names onto the Prometheus
+grammar (``[a-zA-Z_:][a-zA-Z0-9_:]*``); label values escape backslash,
+double-quote and newline per the exposition spec. Two registry names
+that sanitize to the same metric name would silently merge series —
+:func:`prometheus_text` disambiguates with a numeric suffix and counts
+the event, and the tier-1 tests pin ZERO collisions on the real
+serving snapshot.
+
+:func:`check_exposition` is the format checker the tests and the serve
+bench run on every export; :class:`MetricsHTTPServer` is the optional
+stdlib ``http.server`` scrape endpoint behind ``serve.metrics_port``.
+"""
+
+import json
+import math
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["prometheus_text", "check_exposition", "parse_prometheus_text",
+           "sanitize_metric_name", "escape_label_value",
+           "MetricsHTTPServer"]
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
+    r"(?:\{(.*)\})?"                          # optional label block
+    r" ([^ ]+)"                               # value
+    r"(?: (-?\d+))?$")                        # optional timestamp
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Registry name → Prometheus metric name (dots and every other
+    illegal character become underscores; a leading digit gains one)."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    if not out or not re.match(r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+def escape_label_value(v) -> str:
+    """Exposition-format label-value escaping: backslash, double quote,
+    newline (in that order — escaping the escapes first)."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _coarse_edges(hist, per_decade: int) -> List[float]:
+    """Fixed ``le`` ladder: powers of 10^(1/per_decade) covering the
+    histogram's [lo, hi] range (lo itself is the first edge — clamped
+    below-range observations land in the fine bucket whose upper edge
+    is lo, so cumulative counts at le=lo stay exact)."""
+    lo_e = math.log10(hist.lo)
+    hi_e = math.log10(hist.hi)
+    n = max(1, int(round((hi_e - lo_e) * per_decade)))
+    return [10.0 ** (lo_e + k * (hi_e - lo_e) / n) for k in range(n + 1)]
+
+
+def _cumulative_counts(hist, counts: List[int],
+                       edges: List[float]) -> List[int]:
+    """Exact cumulative counts at each coarse edge, by summing the fine
+    buckets whose upper edge sits at/below it. The overflow bucket
+    (values > hi) is only ever counted at +Inf. ``counts`` is the
+    caller's one snapshot of the fine buckets — everything derives from
+    it, so the rendering is self-consistent even against a concurrent
+    writer."""
+    n_bounded = len(counts) - 1
+    # fine upper edges: lo * ratio**i
+    out, ci = [], 0
+    cum = 0
+    for e in edges:
+        while ci < n_bounded and hist.lo * (hist.ratio ** ci) <= e * (1 + 1e-12):
+            cum += counts[ci]
+            ci += 1
+        out.append(cum)
+    return out
+
+
+def prometheus_text(registry, labels: Optional[Dict[str, str]] = None,
+                    buckets_per_decade: int = 2) -> str:
+    """Render ``registry`` as Prometheus exposition text (see module
+    docstring). ``labels`` are attached to every sample (job/instance
+    tagging for textfile-collector setups)."""
+    labels = dict(labels or {})
+    lines: List[str] = []
+    used: Dict[str, str] = {}          # prom name -> registry name
+    collisions = 0
+
+    def unique(name: str, source: str) -> str:
+        nonlocal collisions
+        base = sanitize_metric_name(name)
+        out, i = base, 2
+        while out in used and used[out] != source:
+            out = f"{base}_{i}"
+            i += 1
+            collisions += 1
+        used[out] = source
+        return out
+
+    snap = registry.snapshot()
+    for name in sorted(snap.get("counters", {})):
+        pname = unique(f"{name}_total", f"counter:{name}")
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname}{_fmt_labels(labels)} "
+                     f"{_fmt_value(snap['counters'][name])}")
+    for name in sorted(snap.get("gauges", {})):
+        pname = unique(name, f"gauge:{name}")
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname}{_fmt_labels(labels)} "
+                     f"{_fmt_value(snap['gauges'][name])}")
+    for name, hist in sorted(registry.histograms().items()):
+        pname = unique(name, f"histogram:{name}")
+        lines.append(f"# TYPE {pname} histogram")
+        # ONE bucket snapshot per histogram: +Inf and _count derive from
+        # it, never from a second read of the live counters — a scrape
+        # racing the serving thread's observe() must not emit
+        # _count != +Inf or a bucket above _count (the registry's lock
+        # guards creation only; update-path reads are this snapshot)
+        counts = hist.bucket_counts
+        total = sum(counts)
+        edges = _coarse_edges(hist, buckets_per_decade)
+        for e, c in zip(edges, _cumulative_counts(hist, counts, edges)):
+            le_labels = dict(labels, le=_fmt_value(e))
+            lines.append(f"{pname}_bucket{_fmt_labels(le_labels)} {c}")
+        inf_labels = dict(labels, le="+Inf")
+        lines.append(f"{pname}_bucket{_fmt_labels(inf_labels)} {total}")
+        lines.append(f"{pname}_sum{_fmt_labels(labels)} "
+                     f"{_fmt_value(hist.sum)}")
+        lines.append(f"{pname}_count{_fmt_labels(labels)} {total}")
+    # collector sections: numeric leaves become gauges
+    core = {"counters", "gauges", "histograms"}
+    for section in sorted(k for k in snap if k not in core):
+        data = snap[section]
+        if not isinstance(data, dict):
+            continue
+        for key in sorted(data):
+            v = data[key]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            pname = unique(f"{section}.{key}", f"section:{section}.{key}")
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname}{_fmt_labels(labels)} {_fmt_value(v)}")
+    if collisions:
+        lines.append(f"# TYPE dstprof_export_name_collisions_total counter")
+        lines.append(f"dstprof_export_name_collisions_total{_fmt_labels(labels)} "
+                     f"{collisions}")
+    return "\n".join(lines) + "\n"
+
+
+# --- exposition checker / parser ---------------------------------------------
+
+def parse_prometheus_text(text: str):
+    """Parse exposition text → (samples, types, problems). ``samples``
+    is {metric name: [(labels dict, float value)]}; ``problems`` lists
+    every format violation found (empty == clean). Deliberately strict
+    about exactly what the exporter promises — this is the tier-1
+    format gate, not a general scrape client."""
+    samples: Dict[str, List[Tuple[dict, float]]] = {}
+    types: Dict[str, str] = {}
+    problems: List[str] = []
+    for i, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                if not _NAME_OK.match(parts[2]):
+                    problems.append(f"line {i}: bad TYPE name {parts[2]!r}")
+                elif parts[2] in types:
+                    problems.append(f"line {i}: duplicate TYPE for "
+                                    f"{parts[2]}")
+                else:
+                    types[parts[2]] = parts[3] if len(parts) > 3 else ""
+            elif len(parts) >= 2 and parts[1] not in ("HELP", "TYPE"):
+                problems.append(f"line {i}: unknown comment form {line!r}")
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            problems.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name, labelblock, value = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if labelblock:
+            consumed = 0
+            for lm in _LABEL.finditer(labelblock):
+                labels[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+            rest = labelblock[consumed:].strip(", ")
+            if rest:
+                problems.append(f"line {i}: bad label block {labelblock!r}")
+        try:
+            if value in ("+Inf", "-Inf", "NaN"):
+                fval = {"+Inf": math.inf, "-Inf": -math.inf,
+                        "NaN": math.nan}[value]
+            else:
+                fval = float(value)
+        except ValueError:
+            problems.append(f"line {i}: bad value {value!r}")
+            continue
+        samples.setdefault(name, []).append((labels, fval))
+    # histogram structure: cumulative buckets, _count == +Inf bucket
+    for name, kind in types.items():
+        if kind.strip() != "histogram":
+            continue
+        buckets = samples.get(f"{name}_bucket", [])
+        if not buckets:
+            problems.append(f"{name}: histogram with no _bucket samples")
+            continue
+        les, last = [], -1.0
+        for labels, v in buckets:
+            le = labels.get("le")
+            if le is None:
+                problems.append(f"{name}: bucket sample missing le")
+                continue
+            les.append((math.inf if le == "+Inf" else float(le), v))
+        les.sort(key=lambda t: t[0])
+        for le, v in les:
+            if v < last:
+                problems.append(
+                    f"{name}: bucket counts not cumulative at le={le}")
+            last = v
+        if les and les[-1][0] != math.inf:
+            problems.append(f"{name}: missing le=+Inf bucket")
+        count = samples.get(f"{name}_count")
+        if count and les and les[-1][0] == math.inf \
+                and count[0][1] != les[-1][1]:
+            problems.append(f"{name}: _count {count[0][1]} != +Inf bucket "
+                            f"{les[-1][1]}")
+    return samples, types, problems
+
+
+def check_exposition(text: str) -> List[str]:
+    """Problem strings for an exposition document (empty == valid)."""
+    return parse_prometheus_text(text)[2]
+
+
+# --- scrape endpoint ----------------------------------------------------------
+
+class MetricsHTTPServer:
+    """Optional stdlib scrape endpoint (``serve.metrics_port``).
+
+    Serves ``/metrics`` (Prometheus text) and ``/metrics.json`` (the
+    raw snapshot) from a daemon thread. ``text_fn``/``json_fn`` are
+    called per request — scrapes always see the current registry.
+    Mid-stream scrapes are safe: :func:`prometheus_text` renders each
+    histogram from ONE bucket snapshot (so ``_count == +Inf`` holds
+    structurally against a concurrent writer) and the tracer/collector
+    sections carry their own locks. ``port=0`` binds an ephemeral port
+    (tests); ``.port`` reports the bound one."""
+
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def __init__(self, text_fn: Callable[[], str],
+                 json_fn: Optional[Callable[[], dict]] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self._text_fn = text_fn
+        self._json_fn = json_fn
+        self._host = host
+        self._want_port = int(port)
+        self._httpd = None
+        self._thread = None
+        self.port: Optional[int] = None
+
+    def start(self) -> int:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        if self._httpd is not None:
+            return self.port
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    if self.path.startswith("/metrics.json"):
+                        if server._json_fn is None:
+                            self.send_error(404)
+                            return
+                        body = json.dumps(server._json_fn(),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/metrics"):
+                        body = server._text_fn().encode()
+                        ctype = MetricsHTTPServer.CONTENT_TYPE
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:
+                    # a scrape must see the failure, not a hung socket
+                    self.send_error(500, explain=str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass                     # scrapes must not spam stderr
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dstprof-metrics",
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

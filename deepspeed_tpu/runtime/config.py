@@ -142,6 +142,20 @@ class JSONLConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class PrometheusConfig(DeepSpeedConfigModel):
+    """Prometheus textfile sink (monitor/monitor.py, dstprof): at every
+    registry drain (``steps_per_print`` boundaries) the engine's full
+    metrics registry is rendered as exposition text into
+    ``output_path/job_name/metrics.prom`` — the node-exporter
+    textfile-collector handoff (no listener, no new dependency). For a
+    live scrape endpoint use the serving engine's
+    ``serve.metrics_port`` instead."""
+
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -348,6 +362,11 @@ class DeepSpeedConfig:
         self.wandb = WandbConfig(**p.get("wandb", {}))
         self.csv_monitor = CSVConfig(**p.get("csv_monitor", {}))
         self.jsonl_monitor = JSONLConfig(**p.get("jsonl_monitor", {}))
+        self.prometheus_monitor = PrometheusConfig(
+            **p.get("prometheus_monitor", {}))
+        # dstprof MFU denominator override (TFLOP/s per device); None =
+        # the per-platform table in observability/efficiency.py
+        self.peak_tflops: Optional[float] = p.get("peak_tflops")
         self.comms_logger = CommsLoggerConfig(**p.get("comms_logger", {}))
         self.flops_profiler = FlopsProfilerConfig(**p.get("flops_profiler", {}))
         self.pipeline = PipelineConfig(**p.get("pipeline", {}))
@@ -376,6 +395,7 @@ class DeepSpeedConfig:
         self.monitor_config_enabled = (
             self.tensorboard.enabled or self.wandb.enabled
             or self.csv_monitor.enabled
+            or self.prometheus_monitor.enabled
             # jsonl 'auto' (None) rides along with the sinks above;
             # an explicit true turns monitoring on by itself
             or self.jsonl_monitor.enabled is True

@@ -50,7 +50,9 @@ from deepspeed_tpu.runtime.zero.stages import (
 from deepspeed_tpu.compression import (
     Compressor, CompressionScheduler, STEP_KEY, get_compression_config,
 )
-from deepspeed_tpu.observability import MetricsRegistry
+from deepspeed_tpu.observability import (
+    CompileWatcher, MetricsRegistry, device_memory_section,
+)
 from deepspeed_tpu.ops.optimizers import build_optimizer
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -410,6 +412,15 @@ class DeepSpeedEngine:
         from deepspeed_tpu.comm.comm import comms_logger
         self.metrics.register_collector("comm",
                                         comms_logger.registry_section)
+        # dstprof (docs/OBSERVABILITY.md): compile observability over
+        # the train-step jits (hit once per program life — the thing
+        # watched here is compile latency + cost analysis, which the
+        # MFU gauge consumes) and per-device memory as a pull section
+        self.compile_obs = CompileWatcher(self.metrics)
+        self.metrics.register_collector("memory", device_memory_section)
+        self.metrics.register_collector("train.efficiency",
+                                        self._efficiency_section)
+        self._train_step_flops: Optional[float] = None
         self._zero_bytes_cache = None
         self.timers = SynchronizedWallClockTimer(registry=self.metrics)
         self.tput_timer = ThroughputTimer(
@@ -840,9 +851,10 @@ class DeepSpeedEngine:
                 apply_update, donate_argnums=(0, 1, 2),
                 out_shardings=(ts_out_sh[0], ts_out_sh[1], None, None)
                 if ts_out_sh is not None else None)
-            self._jit_train_batch = jax.jit(
-                train_batch_fn, donate_argnums=(0, 1, 2),
-                out_shardings=ts_out_sh)
+            self._jit_train_batch = self.compile_obs.wrap(
+                "train_step", "train_batch",
+                jax.jit(train_batch_fn, donate_argnums=(0, 1, 2),
+                        out_shardings=ts_out_sh))
             self._jit_accum = jax.jit(
                 lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g),
                 donate_argnums=(0,))
@@ -863,8 +875,9 @@ class DeepSpeedEngine:
                         plan.grad_specs,
                         is_leaf=lambda x: isinstance(x, PartitionSpec))
                     grads_out_sh = (None, ghost, None, None, None)
-                self._jit_grads_batch = jax.jit(grads_batch_fn,
-                                                out_shardings=grads_out_sh)
+                self._jit_grads_batch = self.compile_obs.wrap(
+                    "train_step", "grads_batch",
+                    jax.jit(grads_batch_fn, out_shardings=grads_out_sh))
                 self._jit_gnorm_finite = jax.jit(
                     lambda g: (optax.global_norm(jax.tree_util.tree_map(
                         lambda x: x.astype(jnp.float32), g)),
@@ -1303,6 +1316,56 @@ class DeepSpeedEngine:
         self.metrics.set_gauge("train.zero.reduce_group_size",
                                self.dp_world_size)
 
+    def _step_flops(self) -> float:
+        """Model FLOPs of one global step from the train-step program's
+        compile-time cost analysis (CompileWatcher records it when the
+        AOT wrapper compiles; 0.0 until then / when the backend exposes
+        no analysis). Cached — the program is compiled once."""
+        if self._train_step_flops is None:
+            progs = self.compile_obs.section().get("train_step", {})
+            flops = sum(e.get("flops", 0.0) for e in progs.values())
+            if not progs:
+                return 0.0               # nothing compiled yet: retry later
+            self._train_step_flops = flops
+            if flops:
+                self.metrics.set_gauge("train.flops_per_step", flops)
+                nbytes = sum(e.get("bytes_accessed", 0.0)
+                             for e in progs.values())
+                if nbytes:
+                    self.metrics.set_gauge(
+                        "train.roofline_intensity_flops_per_byte",
+                        flops / nbytes)
+        return self._train_step_flops
+
+    def _efficiency_section(self) -> dict:
+        """``train.efficiency`` registry collector: the MFU arithmetic
+        (model FLOPs per step x counted steps / elapsed vs peak) next to
+        its ingredients, so a dashboard can re-derive or re-denominate."""
+        from deepspeed_tpu.observability import mfu, peak_flops_per_device
+
+        peak = peak_flops_per_device(self._config.peak_tflops)
+        n_dev = int(self.mesh.devices.size)
+        flops = self._step_flops()
+        step_s = self.tput_timer.last_duration
+        return {
+            "model_flops_per_step": flops,
+            "last_step_seconds": step_s,
+            "peak_flops_per_device": peak["flops"],
+            "peak_source": peak["source"],
+            "device_kind": str(peak["device_kind"]),
+            "n_devices": n_dev,
+            "mfu": mfu(flops, step_s, n_dev, peak["flops"]),
+        }
+
+    def capture_profile(self, path: str):
+        """Context manager capturing a jax/XLA profiler trace of the
+        enclosed steps into ``path`` (loads in TensorBoard's profile
+        plugin / xprof) — the on-demand deep dive under the always-on
+        registry telemetry (docs/OBSERVABILITY.md)."""
+        from deepspeed_tpu.observability import capture_profile
+
+        return capture_profile(path)
+
     def _after_step(self, finite, loss=None):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
@@ -1321,6 +1384,24 @@ class DeepSpeedEngine:
                 log_dist(f"[loss scaling] overflow, skipping step "
                          f"(scale now {float(self.scaler_state.scale)})", ranks=[0])
         self.tput_timer.stop(global_step=True)
+        # step MFU: exact program FLOPs (compile-time cost analysis) over
+        # measured step wall clock and the platform peak — the headline
+        # achieved-vs-peak number (PAPERS.md: DeepSpeed-Inference /
+        # Gemma-on-TPU report efficiency exactly this way). Host
+        # arithmetic on already-recorded numbers; no device sync.
+        flops = self._step_flops()
+        if flops and self.tput_timer.last_duration > 0:
+            from deepspeed_tpu.observability import mfu, \
+                peak_flops_per_device
+
+            peak = peak_flops_per_device(self._config.peak_tflops)
+            self.metrics.set_gauge(
+                "train.mfu", mfu(flops, self.tput_timer.last_duration,
+                                 int(self.mesh.devices.size),
+                                 peak["flops"]))
+            self.metrics.set_gauge(
+                "train.model_flops_per_sec",
+                flops / self.tput_timer.last_duration)
         if (self.monitor is not None
                 and self.global_steps % self._config.steps_per_print == 0):
             # the reference's event contract (SURVEY §8.6; engine.py:

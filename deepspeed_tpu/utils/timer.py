@@ -147,6 +147,9 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        # wall seconds of the most recent stop()ed interval — the MFU
+        # gauge's denominator (dstprof: FLOPs/step over step seconds)
+        self.last_duration = 0.0
         self.started = False
         self._start_time = 0.0
 
@@ -166,6 +169,7 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         duration = time.time() - self._start_time
+        self.last_duration = duration
         if self.global_step_count >= self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration

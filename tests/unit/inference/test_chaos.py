@@ -29,7 +29,9 @@ from deepspeed_tpu.inference.scheduler import (
     TIMED_OUT, ContinuousBatchingScheduler, Request,
 )
 
-from deepspeed_tpu.observability import RequestTracer
+from deepspeed_tpu.observability import (
+    MetricsRegistry, RequestTracer, check_exposition, prometheus_text,
+)
 from tests.unit.inference.test_scheduler import FakeExecutor, drain, req
 from tests.unit.inference.test_prefix_cache import PrefixFakeExecutor
 
@@ -43,27 +45,62 @@ def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
     terminal events ``assert_quiescent`` cross-checks against every
     Completion the scheduler ever returned — every chaos scenario
     therefore also pins the trace contract (exactly one terminal span
-    per request, status matching)."""
+    per request, status matching) AND the dstprof gauge contract
+    (non-negative gauges, monotone watermarks, exporter serveable)."""
     ex = PrefixFakeExecutor() if prefix else FakeExecutor()
     pool = (PrefixCachingBlockPool(num_blocks, block_size) if prefix
             else BlockPool(num_blocks, block_size))
     kw.setdefault("audit_every", 1)
     kw.setdefault("tracer", RequestTracer())
+    kw.setdefault("metrics", MetricsRegistry())
     sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
                                         prefix_cache=prefix, **kw)
     # record every Completion any exit path ever hands back, so the
-    # trace cross-check sees the same population the scenario asserted
+    # trace cross-check sees the same population the scenario asserted;
+    # sample the pool/tier watermarks each step so monotonicity under
+    # faults is pinned per WINDOW, not just at quiescence
     sched.comps_seen = []
+    sched.watermark_log = []
     for name in ("step", "shutdown"):
         real = getattr(sched, name)
 
         def wrapped(*a, _real=real, **k):
             out = _real(*a, **k)
             sched.comps_seen.extend(out)
+            tier = sched.host_tier
+            sched.watermark_log.append(
+                (sched.pool.peak_allocated,
+                 tier.bytes_used_peak if tier is not None else 0))
             return out
 
         setattr(sched, name, wrapped)
     return sched, ex, pool
+
+
+def assert_gauges_consistent(sched):
+    """dstprof contract under chaos: every registry gauge/counter stays
+    non-negative through every fault scenario, the pool/tier
+    high-watermarks never move backwards (monotone across step
+    windows) and never sit below the live value, and the Prometheus
+    exporter renders a clean exposition document mid-wreckage."""
+    m = sched.metrics
+    if m is None:
+        return
+    snap = m.snapshot()
+    for name, v in snap["gauges"].items():
+        assert v >= 0, f"negative gauge {name}={v}"
+    for name, v in snap["counters"].items():
+        assert v >= 0, f"negative counter {name}={v}"
+    pool = sched.pool
+    assert pool.peak_allocated >= pool.num_allocated
+    log = getattr(sched, "watermark_log", [])
+    for prev, cur in zip(log, log[1:]):
+        assert cur[0] >= prev[0], "pool watermark moved backwards"
+        assert cur[1] >= prev[1], "tier watermark moved backwards"
+    tier = sched.host_tier
+    if tier is not None:
+        assert tier.bytes_used_peak >= tier.bytes_used
+    assert check_exposition(prometheus_text(m)) == []
 
 
 def assert_terminal_spans(sched):
@@ -83,7 +120,8 @@ def assert_terminal_spans(sched):
 
 def assert_quiescent(sched):
     """Acceptance invariant: fully-free pool, zero outstanding
-    refcounts, auditor clean, terminal spans matching completions."""
+    refcounts, auditor clean, terminal spans matching completions,
+    dstprof gauges consistent + exporter serveable."""
     pool = sched.pool
     assert pool.num_allocated == 0, \
         f"{pool.num_allocated} blocks still allocated"
@@ -93,6 +131,7 @@ def assert_quiescent(sched):
         assert not bad, f"outstanding refcounts {bad}"
     sched.audit(context="post-drain")          # raises on any violation
     assert_terminal_spans(sched)
+    assert_gauges_consistent(sched)
 
 
 def by_rid(comps):

@@ -1,0 +1,336 @@
+"""dstprof on the REAL compiled serving path (acceptance pins):
+``serve_metrics()`` exposes compile hit/miss/eviction counters and
+compile-latency histograms, per-device memory gauges, KV pool/tier byte
+watermarks, and serve FLOPs-per-token; the Prometheus export of a live
+snapshot parses cleanly with zero name collisions; the gen-cache LRU
+evicts observably; the scrape endpoint serves a live engine."""
+
+import math
+import urllib.request
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.engine import (
+    GEN_CACHE_MAX, get_or_build_gen_fn,
+)
+from deepspeed_tpu.inference.scheduler import Request
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.observability import (
+    CompileWatcher, MetricsRegistry, check_exposition,
+)
+from deepspeed_tpu.observability.promexport import parse_prometheus_text
+
+pytestmark = pytest.mark.inference
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params,
+        model_config=cfg)
+
+
+def reqs(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    lens = [5, 9, 13, 7, 4, 11][:n]
+    gens = [6, 3, 9, 5, 4, 7][:n]
+    return [Request(rid=i, prompt=rng.integers(1, 256, L),
+                    max_new_tokens=g)
+            for i, (L, g) in enumerate(zip(lens, gens))]
+
+
+def test_compile_counters_and_latency_on_real_path(engine):
+    engine.reset_serve_metrics()
+    engine.serve(reqs(), num_slots=2, block_size=4)
+    snap = engine.serve_metrics()
+    c = snap["counters"]
+    # cold executor: exactly one prefill bucket + one decode program
+    assert c["compile.serve_prefill.misses"] == 1
+    assert c["compile.serve_decode.misses"] == 1
+    assert c["compile.serve_prefill.compiles"] == 1
+    assert c["compile.serve_decode.compiles"] == 1
+    assert c["compile.serve_prefill.hits"] >= 1     # warm reuse
+    assert c["compile.serve_decode.hits"] >= 1
+    h = snap["histograms"]
+    assert h["compile.serve_prefill.compile_s"]["count"] == 1
+    assert h["compile.serve_decode.compile_s"]["count"] == 1
+    assert h["compile.serve_decode.compile_s"]["sum"] > 0
+    # program table: per-key seconds + cost analysis, and it SURVIVES a
+    # registry reset (the bench's warm-up/measured-window split)
+    progs = snap["compile"]
+    assert "serve_decode" in progs and "serve_prefill" in progs
+    (entry,) = progs["serve_decode"].values()
+    assert entry["compiles"] == 1 and entry["seconds_total"] > 0
+    engine.reset_serve_metrics()
+    assert engine.serve_metrics()["compile"]["serve_decode"]
+    # warm re-serve of the SAME trace (same shapes -> same cached
+    # executor): hits only, zero new compiles
+    engine.serve(reqs(), num_slots=2, block_size=4)
+    c2 = engine.serve_metrics()["counters"]
+    assert "compile.serve_decode.misses" not in c2
+    assert c2["compile.serve_decode.hits"] >= 1
+    # COMPILE spans land in the trace at cold-compile time — assert on
+    # a FRESH cold executor (the ring was cleared above)
+    engine.release_serve_workspace()
+    engine.serve(reqs(2, seed=2), num_slots=2, block_size=4)
+    trace = engine.export_trace()
+    spans = [e for e in trace["traceEvents"] if e.get("cat") == "compile"]
+    assert {e["args"]["cache"] for e in spans} >= {"serve_prefill",
+                                                   "serve_decode"}
+    assert all(e["dur"] > 0 for e in spans)
+
+
+def test_memory_gauges_and_pool_watermarks(engine):
+    engine.reset_serve_metrics()
+    engine.serve(reqs(), num_slots=2, block_size=4)
+    snap = engine.serve_metrics()
+    mem = snap["memory"]
+    assert mem["devices"] == len(jax.local_devices())
+    assert mem["source"] in ("memory_stats", "live_buffer_walk")
+    assert mem["device0.bytes_in_use"] > 0
+    sm = snap["serve.memory"]
+    assert sm["pool_device_bytes"] > 0
+    assert sm["params_device_bytes"] > 0
+    assert sm["block_bytes"] > 0
+    # watermark: blocks were held mid-serve, none at quiescence
+    assert sm["pool_bytes_allocated"] == 0
+    assert sm["pool_bytes_allocated_peak"] > 0
+    assert sm["pool_bytes_allocated_peak"] % sm["block_bytes"] == 0
+
+
+def test_host_tier_byte_watermarks_on_real_path(engine):
+    """Tiered serve on an eviction-forcing pool: the tier's live bytes
+    and high-watermark reach the serve.memory section."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 256, 12) for _ in range(3)]
+    trace = [Request(rid=i, prompt=prompts[i % 3], max_new_tokens=6,
+                     seed=7)
+             for i in range(6)]
+    engine.reset_serve_metrics()
+    engine.serve(trace, num_slots=2, block_size=4, num_blocks=13,
+                 host_cache_gb=0.01)
+    snap = engine.serve_metrics()
+    sm = snap["serve.memory"]
+    assert sm["host_tier_capacity_bytes"] == int(0.01 * (1 << 30))
+    assert sm["host_tier_bytes_used_peak"] >= sm["host_tier_bytes_used"]
+    pc = snap["serve.prefix_cache"]
+    if pc["host_spills"]:               # eviction pressure reached the tier
+        assert sm["host_tier_bytes_used_peak"] > 0
+        assert sm["host_tier_bytes_spilled"] > 0
+
+
+def test_flops_per_token_and_efficiency_section(engine):
+    engine.reset_serve_metrics()
+    engine.serve(reqs(), num_slots=2, block_size=4)
+    snap = engine.serve_metrics()
+    g = snap["gauges"]
+    assert g["serve.flops_per_token"] > 0
+    assert g["serve.decode_program_flops"] == pytest.approx(
+        g["serve.flops_per_token"] * 2)        # num_slots = 2
+    assert g["serve.roofline_intensity_flops_per_byte"] > 0
+    eff = snap["serve.efficiency"]
+    assert eff["model_flops_per_token"] == g["serve.flops_per_token"]
+    assert eff["achieved_model_flops_per_sec"] > 0
+    assert 0 < eff["mfu"] < 1
+    assert eff["peak_flops_per_device"] > 0
+    assert eff["peak_source"] in ("table", "estimated", "override", "env")
+    # gauges survive a mid-session registry reset: the executor
+    # republishes compile-time cost every decode call
+    engine.reset_serve_metrics()
+    engine.serve(reqs(2, seed=4), num_slots=2, block_size=4)
+    assert engine.serve_metrics()["gauges"]["serve.flops_per_token"] > 0
+
+
+def test_flops_per_token_tracks_the_active_executor(engine):
+    """Two serving configs on one engine: each executor must publish
+    ITS OWN decode program's cost (keyed lookup in the engine-wide
+    table), not whichever program compiled first."""
+    engine.release_serve_workspace()
+    engine.reset_serve_metrics()
+    engine.serve(reqs(), num_slots=2, block_size=4)
+    fpt2 = engine.serve_metrics()["gauges"]["serve.flops_per_token"]
+    engine.serve(reqs(), num_slots=4, block_size=4)
+    fpt4 = engine.serve_metrics()["gauges"]["serve.flops_per_token"]
+    progs = engine.compile_obs.section()["serve_decode"]
+    assert fpt2 == pytest.approx(progs["slots2_chunk1"]["flops"] / 2)
+    assert fpt4 == pytest.approx(progs["slots4_chunk1"]["flops"] / 4)
+    assert fpt2 != fpt4
+
+
+def test_aot_program_caches_alternating_input_layouts():
+    """Inputs whose layout/sharding alternates must ping-pong between
+    two cached executables (plain-jit behavior), not recompile every
+    call — each REAL recompile is counted, so the counter pins it."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    devs = jax.local_devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices for a sharding alternation")
+    mesh = jax.sharding.Mesh(np.array(devs[:2]), ("d",))
+    sharded = NamedSharding(mesh, PartitionSpec("d"))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    registry = MetricsRegistry()
+    obs = CompileWatcher(registry)
+    fn = obs.wrap("demo", "alt", jax.jit(lambda x: x * 2))
+    a = jax.device_put(jnp.arange(8.0), sharded)
+    b = jax.device_put(jnp.arange(8.0), replicated)
+    for _ in range(3):                   # alternate layouts repeatedly
+        np.testing.assert_allclose(np.asarray(fn(a))[:2], [0.0, 2.0])
+        np.testing.assert_allclose(np.asarray(fn(b))[:2], [0.0, 2.0])
+    compiles = registry.counter("compile.demo.compiles")
+    assert compiles <= 2, f"alternating layouts recompiled {compiles}x"
+
+
+def test_peak_tflops_override_changes_denominator(engine):
+    from deepspeed_tpu.observability import peak_flops_per_device
+
+    assert peak_flops_per_device(2.0) == {
+        "flops": 2.0e12, "source": "override", "device_kind": "user"}
+    serve_cfg = engine._config.serve
+    old = serve_cfg.peak_tflops
+    try:
+        serve_cfg.peak_tflops = 123.0
+        assert engine.serve_metrics()["serve.efficiency"][
+            "peak_flops_per_device"] == pytest.approx(123.0e12)
+    finally:
+        serve_cfg.peak_tflops = old
+
+
+def test_prometheus_roundtrip_of_live_snapshot(engine):
+    engine.reset_serve_metrics()
+    engine.release_serve_workspace()    # cold: compile histograms populate
+    engine.serve(reqs(), num_slots=2, block_size=4)
+    text = engine.serve_metrics(format="prometheus")
+    samples, types, problems = parse_prometheus_text(text)
+    assert problems == []
+    # zero name collisions on the real serving snapshot
+    assert "dstprof_export_name_collisions_total" not in samples
+    # the headline families all made it through
+    assert samples["serve_completions_COMPLETED_total"][0][1] == 4
+    assert "serve_ttft_s_bucket" in samples
+    assert "compile_serve_decode_compile_s_bucket" in samples
+    assert samples["serve_efficiency_model_flops_per_token"][0][1] > 0
+    assert "serve_memory_pool_device_bytes" in samples
+    # prom names are unique against the JSONL drain's flat event names:
+    # sanitizing the snapshot's own keys produces no duplicates either
+    snap = engine.serve_metrics()
+    from deepspeed_tpu.observability.promexport import (
+        sanitize_metric_name,
+    )
+
+    flat = ([f"{k}_total" for k in snap["counters"]]
+            + list(snap["gauges"]) + list(snap["histograms"]))
+    sanitized = [sanitize_metric_name(n) for n in flat]
+    assert len(sanitized) == len(set(sanitized))
+    with pytest.raises(ValueError, match="format"):
+        engine.serve_metrics(format="yaml")
+
+
+def test_metrics_port_scrapes_live_engine(engine):
+    port = engine.start_metrics_server(port=0)
+    try:
+        engine.serve(reqs(2, seed=5), num_slots=2, block_size=4)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+        assert check_exposition(body) == []
+        assert "serve_tokens_generated_total" in body
+        assert engine.start_metrics_server() == port   # idempotent
+    finally:
+        engine.stop_metrics_server()
+    assert engine._metrics_server is None
+
+
+def test_gen_cache_lru_eviction_accounting():
+    """Satellite pin: GEN_CACHE_MAX eviction is counted (and the
+    watcher's eviction hook sees the evicted key), hits/misses track
+    the LRU exactly."""
+    registry = MetricsRegistry()
+    evicted = []
+    obs = CompileWatcher(registry)
+    real_evict = obs.eviction
+    obs.eviction = lambda cache, key=None: (evicted.append(key),
+                                            real_evict(cache, key))[1]
+    cache = OrderedDict()
+    builder = lambda cap: (lambda *a: None)
+    first_key = None
+    for i in range(GEN_CACHE_MAX):
+        get_or_build_gen_fn(cache, None, 1, 32 + i, 8, builder=builder,
+                            obs=obs, cache_name="gen")
+        if first_key is None:
+            first_key = next(iter(cache))
+    assert len(cache) == GEN_CACHE_MAX
+    assert registry.counter("compile.gen.misses") == GEN_CACHE_MAX
+    # re-touch the first key: a hit, and it moves to MRU
+    get_or_build_gen_fn(cache, None, 1, 32, 8, builder=builder, obs=obs,
+                        cache_name="gen")
+    assert registry.counter("compile.gen.hits") == 1
+    # one more distinct key evicts the LRU (NOT the re-touched first)
+    get_or_build_gen_fn(cache, None, 1, 32 + GEN_CACHE_MAX, 8,
+                        builder=builder, obs=obs, cache_name="gen")
+    assert len(cache) == GEN_CACHE_MAX
+    assert registry.counter("compile.gen.evictions") == 1
+    # the LRU victim is the SECOND inserted key (the first was
+    # re-touched to MRU): (B, T, cap=gen_capacity(8)=32, params_key)
+    assert evicted == [(1, 33, 32, None)]
+    assert first_key in cache
+
+
+def test_generate_path_feeds_gen_compile_counters(engine):
+    engine.reset_serve_metrics()
+    rng = np.random.default_rng(6)
+    engine.generate(jnp.asarray(rng.integers(1, 256, (1, 6))),
+                    max_new_tokens=4)
+    engine.generate(jnp.asarray(rng.integers(1, 256, (1, 9))),
+                    max_new_tokens=4)       # same bucket: hit
+    c = engine.serve_metrics()["counters"]
+    assert c["compile.gen.misses"] >= 1
+    assert c["compile.gen.hits"] >= 1
+    assert engine.serve_metrics()["histograms"][
+        "compile.gen.compile_s"]["count"] >= 1
+
+
+def test_capture_profile_wraps_jax_profiler(engine, tmp_path,
+                                            monkeypatch):
+    calls = []
+    from deepspeed_tpu.observability import profile as prof_mod
+
+    with prof_mod.capture_profile(
+            str(tmp_path), profiler_start=lambda p: calls.append(("s", p)),
+            profiler_stop=lambda: calls.append(("e",))):
+        calls.append(("body",))
+    assert calls == [("s", str(tmp_path)), ("body",), ("e",)]
+    # stop runs even when the profiled window raises
+    calls.clear()
+    with pytest.raises(RuntimeError):
+        with prof_mod.capture_profile(
+                str(tmp_path),
+                profiler_start=lambda p: calls.append(("s", p)),
+                profiler_stop=lambda: calls.append(("e",))):
+            raise RuntimeError("boom")
+    assert calls[-1] == ("e",)
+    # both engines expose the hook
+    assert hasattr(engine, "capture_profile")
+
+
+def test_recompile_storm_detector_fires():
+    registry = MetricsRegistry()
+    obs = CompileWatcher(registry, storm_threshold=3, storm_window_s=60)
+    for _ in range(3):
+        obs.record_compile("serve_decode", "slots2", 0.01)
+    assert registry.counter("compile.recompile_storms") == 1
+    assert obs.storms == 1
+    # the burst was reported once; a fresh burst reports again
+    for _ in range(3):
+        obs.record_compile("serve_decode", "slots2", 0.01)
+    assert registry.counter("compile.recompile_storms") == 2

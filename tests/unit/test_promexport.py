@@ -1,0 +1,210 @@
+"""Prometheus exporter correctness (dstprof, observability/promexport):
+name/label escaping, exact bucket cumulativity over the registry's fine
+log-spaced histograms, terminal-bucket clamping without distorting
+``_count``/``_sum``, the exposition-format checker itself, and the
+stdlib HTTP scrape endpoint."""
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.observability import (
+    Histogram, MetricsHTTPServer, MetricsRegistry, check_exposition,
+    prometheus_text,
+)
+from deepspeed_tpu.observability.promexport import (
+    escape_label_value, parse_prometheus_text, sanitize_metric_name,
+)
+
+
+# --- escaping -----------------------------------------------------------------
+
+def test_metric_name_sanitization():
+    assert sanitize_metric_name("serve.ttft_s") == "serve_ttft_s"
+    assert sanitize_metric_name("serve.completions.COMPLETED") == \
+        "serve_completions_COMPLETED"
+    assert sanitize_metric_name("9lives") == "_9lives"
+    assert sanitize_metric_name("a-b c/d") == "a_b_c_d"
+    assert sanitize_metric_name("") == "_"
+
+
+def test_label_value_escaping_round_trips():
+    raw = 'quo"te\\slash\nnewline'
+    escaped = escape_label_value(raw)
+    assert "\n" not in escaped
+    r = MetricsRegistry()
+    r.set_gauge("g", 1.0)
+    text = prometheus_text(r, labels={"job": raw})
+    samples, _, problems = parse_prometheus_text(text)
+    assert problems == []
+    labels, v = samples["g"][0]
+    # parser keeps the escaped form; unescaping recovers the original
+    assert (labels["job"].replace(r"\n", "\n").replace(r"\"", '"')
+            .replace("\\\\", "\\")) == raw
+
+
+def test_colliding_names_get_disambiguated_not_merged():
+    r = MetricsRegistry()
+    r.set_gauge("a.b", 1.0)
+    r.set_gauge("a_b", 2.0)         # sanitizes identically
+    text = prometheus_text(r)
+    samples, _, problems = parse_prometheus_text(text)
+    assert problems == []
+    assert "a_b" in samples and "a_b_2" in samples
+    assert samples["dstprof_export_name_collisions_total"][0][1] == 1
+
+
+# --- histogram conventions ----------------------------------------------------
+
+def test_histogram_buckets_are_cumulative_and_exact():
+    r = MetricsRegistry()
+    vals = [1e-5, 3e-4, 3e-4, 0.02, 0.5, 7.0, 120.0]
+    for v in vals:
+        r.observe("lat_s", v)
+    text = prometheus_text(r)
+    samples, types, problems = parse_prometheus_text(text)
+    assert problems == []
+    assert types["lat_s"].strip() == "histogram"
+    buckets = sorted(((math.inf if l["le"] == "+Inf" else float(l["le"])), v)
+                     for l, v in samples["lat_s_bucket"])
+    # cumulativity + exactness at a few hand-checked edges
+    last = -1
+    for le, c in buckets:
+        assert c >= last
+        exact = sum(1 for v in vals if v <= le * (1 + 1e-9))
+        assert c == exact, (le, c, exact)
+        last = c
+    assert buckets[-1] == (math.inf, len(vals))
+    assert samples["lat_s_count"][0][1] == len(vals)
+    assert samples["lat_s_sum"][0][1] == pytest.approx(sum(vals))
+
+
+def test_out_of_range_values_clamp_into_terminal_buckets():
+    """Satellite pin: values below lo / above hi land in the terminal
+    buckets WITHOUT distorting _count/_sum — the histogram never drops
+    or re-values an observation."""
+    r = MetricsRegistry()
+    h = r.histogram("edge_s")               # default 1e-6 .. 1e5
+    for v in (1e-9, 2e-9, 1e9, 0.5):
+        h.observe(v)
+    text = prometheus_text(r)
+    samples, _, problems = parse_prometheus_text(text)
+    assert problems == []
+    buckets = sorted(((math.inf if l["le"] == "+Inf" else float(l["le"])), v)
+                     for l, v in samples["edge_s_bucket"])
+    # below-lo observations are already counted at the FIRST bucket
+    assert buckets[0][0] == pytest.approx(1e-6)
+    assert buckets[0][1] == 2
+    # the above-hi observation appears ONLY at +Inf (not at le=1e5)
+    le_hi = [c for le, c in buckets if le == pytest.approx(1e5)][0]
+    assert le_hi == 3
+    assert buckets[-1][1] == 4
+    assert samples["edge_s_count"][0][1] == 4
+    assert samples["edge_s_sum"][0][1] == pytest.approx(1e-9 + 2e-9 + 1e9
+                                                        + 0.5)
+    # raw-histogram view agrees: terminal fine buckets hold the clamps
+    assert h.bucket_counts[0] == 2 and h.bucket_counts[-1] == 1
+
+
+def test_counters_gauges_and_sections_render():
+    r = MetricsRegistry()
+    r.inc("serve.tokens_generated", 42)
+    r.set_gauge("serve.active_slots", 3)
+    r.register_collector("serve.memory",
+                         lambda: {"pool_bytes": 1024, "note": "skip",
+                                  "enabled": True})
+    text = prometheus_text(r)
+    samples, types, problems = parse_prometheus_text(text)
+    assert problems == []
+    assert samples["serve_tokens_generated_total"][0][1] == 42
+    assert types["serve_tokens_generated_total"].strip() == "counter"
+    assert samples["serve_active_slots"][0][1] == 3
+    assert samples["serve_memory_pool_bytes"][0][1] == 1024
+    # non-numeric and boolean section leaves are skipped, not mangled
+    assert "serve_memory_note" not in samples
+    assert "serve_memory_enabled" not in samples
+
+
+# --- the checker itself -------------------------------------------------------
+
+def test_checker_rejects_malformed_documents():
+    assert check_exposition("ok_metric 1\n") == []
+    assert check_exposition("bad metric name 1\n") != []
+    assert check_exposition('m{l="unclosed} 1\n') != []
+    assert check_exposition("m notanumber\n") != []
+    # non-cumulative buckets
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+           'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n')
+    assert any("cumulative" in p for p in check_exposition(bad))
+    # _count disagreeing with +Inf
+    bad2 = ("# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 4\n')
+    assert any("_count" in p for p in check_exposition(bad2))
+    # missing +Inf
+    bad3 = ("# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_sum 1\nh_count 5\n')
+    assert any("+Inf" in p for p in check_exposition(bad3))
+
+
+# --- HTTP scrape endpoint -----------------------------------------------------
+
+def test_metrics_http_server_scrapes_text_and_json():
+    r = MetricsRegistry()
+    r.inc("hits", 7)
+    r.observe("lat_s", 0.25)
+    srv = MetricsHTTPServer(lambda: prometheus_text(r),
+                            json_fn=r.snapshot, port=0)
+    try:
+        port = srv.start()
+        assert srv.start() == port          # idempotent
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert check_exposition(body) == []
+        assert "hits_total 7" in body
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=5) as resp:
+            snap = json.loads(resp.read())
+        assert snap["counters"]["hits"] == 7
+        # mid-scrape registry updates must not corrupt later scrapes
+        r.inc("hits")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            assert "hits_total 8" in resp.read().decode()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=5)
+    finally:
+        srv.stop()
+
+
+# --- prometheus monitor sink --------------------------------------------------
+
+def test_prometheus_file_monitor_writes_exposition(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "prometheus_monitor": {"enabled": True,
+                               "output_path": str(tmp_path)},
+        # the JSONL default would ride along into ./jsonl_logs — keep
+        # the test's writes inside tmp_path
+        "jsonl_monitor": {"enabled": False},
+    })
+    assert cfg.monitor_config_enabled      # the sink turns monitoring on
+    mm = MonitorMaster(cfg)
+    assert mm.prometheus_monitor.enabled
+    r = MetricsRegistry()
+    r.inc("train.samples", 16)
+    r.observe("train.step_s", 0.125)
+    mm.write_registry(r, step=4)
+    text = open(mm.prometheus_monitor.path).read()
+    assert check_exposition(text) == []
+    assert "train_samples_total 16" in text
+    assert "train_step_s_bucket" in text

@@ -1958,16 +1958,26 @@ def autotune_main():
     }))
 
 
-def multichip_main(dryrun: bool = False):
-    """--multichip [--dryrun]: record the STATIC collective inventory —
-    every multi-chip entry point's collectives by mesh axis (count +
-    per-device wire bytes per step, the dstlint SPMD pass's abstract
-    trace) — into MULTICHIP_COMMS.json, so the perf trajectory carries
-    comms structure alongside step time. ``--dryrun`` additionally runs
-    the full 8-device parallelism dry run (__graft_entry__) first."""
-    if dryrun:
-        import __graft_entry__
+def multichip_main(dryrun: bool = False, train_telemetry: bool = True):
+    """--multichip [--dryrun] [--no-train-telemetry]: record the STATIC
+    collective inventory — every multi-chip entry point's collectives by
+    mesh axis (count + per-device wire bytes per step, the dstlint SPMD
+    pass's abstract trace) — into MULTICHIP_COMMS.json, so the perf
+    trajectory carries comms structure alongside step time. By default
+    it also runs the MEASURED dsttrain telemetry leg: a real pipe=2 ×
+    data=4 1F1B train on the 8-device virtual mesh
+    (__graft_entry__.telemetry_multichip) collecting bubble fraction,
+    schedule efficiency, the grad-norm trajectory and MoE drop fraction
+    into the same artifact — with the engine-reported step time
+    cross-checked against the bench's external measurement within 5%
+    (the training twin of the serving bench's TTFT agreement guard).
+    ``--dryrun`` additionally runs the full 8-device parallelism dry
+    run (__graft_entry__) first."""
+    import tempfile
 
+    import __graft_entry__
+
+    if dryrun:
         __graft_entry__.dryrun_multichip(8)
 
     from deepspeed_tpu.tools.dstlint.spmdpass import (
@@ -1977,6 +1987,15 @@ def multichip_main(dryrun: bool = False):
     reports = trace_spmd_entry_points()
     summary = inventory_summary(reports)
     errors = sorted(n for n, rep in reports.items() if rep.error)
+    tele = None
+    if train_telemetry:
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tf:
+            tele_path = tf.name
+        __graft_entry__.telemetry_multichip(8, tele_path)
+        with open(tele_path) as f:
+            tele = json.load(f)
+        os.unlink(tele_path)
     artifact = {
         "source": "dstlint spmd pass (abstract meshes; "
                   "comm/collective_cost.py wire arithmetic)",
@@ -1984,6 +2003,10 @@ def multichip_main(dryrun: bool = False):
         "total_wire_bytes_per_step": sum(
             e.get("total_wire_bytes", 0) for e in summary.values()),
     }
+    if tele is not None:
+        # measured dsttrain leg rides the same artifact the static
+        # inventory lives in (the MULTICHIP_* series)
+        artifact["train_telemetry"] = tele
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "MULTICHIP_COMMS.json")
     with open(path, "w") as f:
@@ -1995,13 +2018,23 @@ def multichip_main(dryrun: bool = False):
             tot = per_axis.setdefault(axes, {"count": 0, "bytes": 0})
             tot["count"] += rec["count"]
             tot["bytes"] += rec["bytes"]
-    print(json.dumps({
+    out = {
         "metric": "static_collective_inventory",
         "entries": len(summary), "errors": errors,
         "per_axis": per_axis,
         "total_wire_bytes_per_step": artifact["total_wire_bytes_per_step"],
         "artifact": "MULTICHIP_COMMS.json",
-    }))
+    }
+    if tele is not None:
+        out["train_telemetry"] = {
+            "bubble_fraction": tele["bubble_fraction"],
+            "schedule_efficiency": tele["schedule_efficiency"],
+            "step_time_agreement": tele["step_time_crosscheck"][
+                "agreement"],
+            "moe_token_drop_fraction": tele["moe"].get(
+                "token_drop_fraction"),
+        }
+    print(json.dumps(out))
     if errors:
         sys.exit(f"spmd trace errors: {errors}")
 
@@ -2193,7 +2226,9 @@ if __name__ == "__main__":
                        kernels=kernels,
                        trace_seed=_intflag("--trace-seed"))
     elif "--multichip" in sys.argv:
-        multichip_main(dryrun="--dryrun" in sys.argv)
+        multichip_main(
+            dryrun="--dryrun" in sys.argv,
+            train_telemetry="--no-train-telemetry" not in sys.argv)
     elif "--rlhf" in sys.argv:
         rlhf_main()
     elif "--longseq" in sys.argv:

@@ -72,13 +72,20 @@ class MoE(nn.Module):
         if train and self.noisy_gate_policy == "RSample" and \
                 self.has_rng("gating"):
             noise_rng = self.make_rng("gating")
-        out, aux = moe_dispatch_combine(
+        out, aux, gate_stats = moe_dispatch_combine(
             tokens, gate_logits, experts, k=self.k,
             capacity_factor=self.capacity_factor if train else self.eval_capacity_factor,
             min_capacity=self.min_capacity, noise_rng=noise_rng,
             noisy_gate_policy=self.noisy_gate_policy,
             drop_tokens=self.drop_tokens,
-            expert_shard_axis=self.expert_shard_axis)
+            expert_shard_axis=self.expert_shard_axis,
+            return_stats=True)
+        # dsttrain gate telemetry: load entropy / drop fraction / aux
+        # loss as sown intermediates — apply(..., mutable=["intermediates"])
+        # surfaces them for the train_telemetry.loss_aux channel; a plain
+        # apply drops them and XLA eliminates the dead stats compute
+        self.sow("intermediates", "moe_stats", {**gate_stats,
+                                                "aux_loss": aux})
         out = out.reshape(B, S, D)
 
         if self.use_residual:

@@ -14,6 +14,7 @@ which keeps everything on the VPU/MXU.
 """
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -126,13 +127,42 @@ def top2_gating(logits: jnp.ndarray, capacity_factor: float, min_capacity: int,
     return aux_loss, combine, dispatch
 
 
+def gate_telemetry(dispatch: jnp.ndarray, k: int = 1):
+    """dsttrain MoE gate health, derived from the gating dispatch mask
+    (the [T, E, C] bool tensor ``top1/top2_gating`` already compute):
+
+    - ``expert_load_entropy``: entropy of the per-expert share of
+      dispatched slots, normalized to [0, 1] (1 = perfectly balanced
+      routing, →0 = collapse onto one expert);
+    - ``token_drop_fraction``: assignments lost to capacity —
+      ``1 - slots_assigned / (k * T)`` (the reference's dropped-token
+      accounting, made a per-step scalar).
+
+    Pure ``jnp`` scalars — rides the train step's stats pytree at zero
+    collective cost (observability/train.py)."""
+    T, E, _C = dispatch.shape
+    load = jnp.sum(dispatch.astype(jnp.float32), axis=(0, 2))   # [E]
+    total = jnp.maximum(jnp.sum(load), 1.0)
+    p = load / total
+    entropy = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+    # host math on the STATIC expert count — float(jnp.log(E)) would be
+    # a concretization error when this runs inside a jitted loss
+    norm = math.log(E) if E > 1 else 1.0
+    wanted = float(max(k, 1) * max(T, 1))
+    return {
+        "expert_load_entropy": entropy / norm,
+        "token_drop_fraction": 1.0 - jnp.sum(load) / wanted,
+    }
+
+
 def moe_dispatch_combine(x: jnp.ndarray, gate_logits: jnp.ndarray,
                          expert_fn, k: int = 1,
                          capacity_factor: float = 1.0, min_capacity: int = 4,
                          noise_rng: Optional[jax.Array] = None,
                          noisy_gate_policy: Optional[str] = None,
                          drop_tokens: bool = True,
-                         expert_shard_axis: Optional[str] = "auto"):
+                         expert_shard_axis: Optional[str] = "auto",
+                         return_stats: bool = False):
     """Dispatch tokens → run experts → combine. x: [T, D], logits: [T, E].
 
     ``expert_fn`` maps [E, C, D] → [E, C, D_out] (batched over experts).
@@ -194,4 +224,9 @@ def moe_dispatch_combine(x: jnp.ndarray, gate_logits: jnp.ndarray,
     if spec is not None:
         expert_outputs = jax.lax.with_sharding_constraint(expert_outputs, spec)
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
+    if return_stats:
+        # gate health (dsttrain): computed from the dispatch mask the
+        # gating already built — XLA dead-code-eliminates it when the
+        # caller drops the stats
+        return out, aux, gate_telemetry(dispatch, k)
     return out, aux

@@ -14,7 +14,12 @@ trace-event JSON), extended by the dstprof resource layer:
 - ``efficiency.py`` — peak-FLOPs table + MFU/FLOPs-per-token math;
 - ``promexport.py`` — dependency-free Prometheus text exporter,
   exposition checker, stdlib HTTP scrape endpoint;
-- ``profile.py`` — on-demand ``jax.profiler`` capture.
+- ``profile.py`` — on-demand ``jax.profiler`` capture;
+- ``train.py`` — dsttrain: in-graph train-step health stats
+  (grad norms / non-finite counts / MoE gate aux — comms-free,
+  budget-pinned), lag-one host publication with overflow escalation,
+  training step lanes + 1F1B microbatch lane reconstruction, and the
+  schedule-efficiency arithmetic.
 
 Entry points:
 
@@ -23,9 +28,13 @@ Entry points:
   ``serve.trace*`` + ``serve.metrics_port`` knobs
   (docs/OBSERVABILITY.md);
 - training: ``DeepSpeedEngine.metrics`` (timers, throughput, ZeRO
-  reduction bytes, comms wire totals, train MFU), drained by
-  ``monitor/`` sinks (incl. the Prometheus textfile sink);
-- CLI: ``bin/dst prof`` one-shot report.
+  reduction bytes, comms wire totals, train MFU) + the dsttrain layer
+  (``engine.train_metrics(format=...)``, ``export_train_trace()``,
+  ``flush_train_telemetry()``, the ``train_telemetry`` /
+  ``metrics_port`` knobs), drained by ``monitor/`` sinks (incl. the
+  Prometheus textfile sink);
+- CLI: ``bin/dst prof`` (serving) / ``bin/dst prof --train`` one-shot
+  reports.
 
 Everything here is strictly host-side — dstlint's jaxpr budgets prove
 instrumentation adds zero traced equations to the compiled programs.
@@ -46,6 +55,10 @@ from deepspeed_tpu.observability.promexport import (
     MetricsHTTPServer, check_exposition, prometheus_text,
 )
 from deepspeed_tpu.observability.profile import capture_profile
+from deepspeed_tpu.observability.train import (
+    make_train_tracer, pipeline_lane_spans, publish_train_stats,
+    schedule_efficiency, stage_tid, train_health_stats,
+)
 
 __all__ = ["Histogram", "MetricsRegistry", "default_registry",
            "RequestTracer", "SCHEDULER_TID", "slot_tid",
@@ -54,4 +67,7 @@ __all__ = ["Histogram", "MetricsRegistry", "default_registry",
            "device_memory_section", "tree_device_bytes",
            "mfu", "peak_flops_per_device",
            "MetricsHTTPServer", "check_exposition", "prometheus_text",
-           "capture_profile"]
+           "capture_profile",
+           "make_train_tracer", "pipeline_lane_spans",
+           "publish_train_stats", "schedule_efficiency", "stage_tid",
+           "train_health_stats"]

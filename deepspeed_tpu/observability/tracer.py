@@ -56,9 +56,18 @@ class RequestTracer:
     Events are stored already in Chrome trace-event dict form, so
     ``chrome()`` is a copy + metadata, not a conversion pass."""
 
-    def __init__(self, capacity: int = 65536):
+    def __init__(self, capacity: int = 65536, *,
+                 process_name: str = "deepspeed_tpu.serve",
+                 track_labeler=None):
         self.capacity = int(capacity)
         self.events: "deque[dict]" = deque(maxlen=self.capacity)
+        # export-time naming: the serving default labels tid 0
+        # "scheduler" and 1+slot "slot N"; the training tracer
+        # (observability/train.make_train_tracer) relabels tracks as
+        # the step lane + pipeline stage lanes without forking the
+        # recorder
+        self.process_name = process_name
+        self._track_labeler = track_labeler
         self._emitted = 0
         # guards append vs read: a scrape thread calling chrome()/
         # export() mid-stream must never hit "deque mutated during
@@ -119,11 +128,14 @@ class RequestTracer:
             dropped = self._emitted - len(recorded)
         events: List[dict] = [
             {"name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
-             "args": {"name": "deepspeed_tpu.serve"}}]
+             "args": {"name": self.process_name}}]
         tids = sorted({e["tid"] for e in recorded})
         for tid in tids:
-            label = "scheduler" if tid == SCHEDULER_TID \
-                else f"slot {tid - 1}"
+            if self._track_labeler is not None:
+                label = str(self._track_labeler(tid))
+            else:
+                label = "scheduler" if tid == SCHEDULER_TID \
+                    else f"slot {tid - 1}"
             events.append({"name": "thread_name", "ph": "M", "pid": _PID,
                            "tid": tid, "args": {"name": label}})
         events.extend(recorded)

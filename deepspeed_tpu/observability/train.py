@@ -1,0 +1,261 @@
+"""dsttrain — training-step health & schedule observability.
+
+The training-side twin of dstrace/dstprof (docs/OBSERVABILITY.md): the
+compiled train step returns a small auxiliary **stats pytree** — global
+and per-param-group gradient norms, non-finite-gradient counts, and an
+optional user ``aux`` dict (MoE gate telemetry rides this channel) —
+which the engine publishes host-side in ``_after_step`` as registry
+gauges/histograms, with NaN/Inf escalation to a structured warning and
+the ``train.overflow_steps`` counter. Design constraints, in order:
+
+1. **In-graph compute, host-side publication.** ``train_health_stats``
+   is pure ``jnp`` — it runs inside the jitted step and adds zero host
+   callbacks (the dstlint jaxpr budgets cover the train-step entry
+   points, and the SPMD comms pin asserts the stats pytree adds ZERO
+   new collectives to the budgeted train-step programs: the norms are
+   computed before the gradient-reduction boundary, where they are
+   semantically the global values and the static pass can prove no new
+   collective key appears).
+2. **Publication never stalls the dispatch pipeline.** The engine
+   publishes each step's stats one step LATE (lag-one): by the time
+   step N+1 has been dispatched, step N's scalars have materialized,
+   so the ``float()`` reads here do not drain the async queue the
+   fused train program relies on. ``flush_train_telemetry()`` forces
+   the pending step out (monitor drains and ``train_metrics()`` call
+   it).
+3. **Same trace format as serving.** Training spans land in a
+   :class:`~deepspeed_tpu.observability.tracer.RequestTracer` with a
+   train-specific track naming (tid 0 = the step lane, tid 1+s = pipe
+   stage lanes), exported as the same Perfetto-loadable Chrome JSON.
+   Pipeline microbatch lanes are reconstructed from the 1F1B schedule
+   arithmetic (``pipe/interpreter.tick_plan`` — exact and unit-tested)
+   scaled into the measured step window, so a trace shows per-stage
+   fill/steady/drain visually next to the measured host spans.
+
+Metric names (docs/OBSERVABILITY.md "Training"):
+
+- ``train.grad_norm``             histogram + gauge (finite steps only)
+- ``train.grad_norm.<group>``     per-param-group gauges
+- ``train.nonfinite_grads``       gauge (last step's non-finite count)
+- ``train.overflow_steps``        counter (non-finite step, update skipped)
+- ``train.loss_scale``            gauge (fp16)
+- ``train.aux.<key>``             gauges from the loss aux channel
+- ``train.phase.<name>_s``        histograms (DATA / FWD_BWD / OPTIM / CKPT)
+- ``train.pipeline.bubble_fraction`` / ``.schedule_efficiency`` gauges
+"""
+
+import math
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.observability.tracer import RequestTracer
+
+__all__ = ["train_health_stats", "publish_train_stats",
+           "make_train_tracer", "stage_tid", "pipeline_lane_spans",
+           "schedule_efficiency"]
+
+#: tid of the step lane in a training trace (STEP/DATA/FWD_BWD spans)
+STEP_TID = 0
+
+
+def stage_tid(stage: int) -> int:
+    """tid of a pipeline stage's microbatch lane."""
+    return 1 + int(stage)
+
+
+def _train_track_label(tid: int) -> str:
+    return "step" if tid == STEP_TID else f"stage {tid - 1}"
+
+
+def make_train_tracer(capacity: int = 65536) -> RequestTracer:
+    """A request tracer configured for training-step lanes."""
+    return RequestTracer(capacity, process_name="deepspeed_tpu.train",
+                         track_labeler=_train_track_label)
+
+
+# ---------------------------------------------------------------------------
+# in-graph stats (pure jnp — runs inside the compiled step)
+# ---------------------------------------------------------------------------
+
+def train_health_stats(grads: Any, aux: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """In-graph gradient-health stats pytree for one train step.
+
+    Returns a dict of fp32 scalars: ``grad_norm`` (global L2),
+    ``nonfinite_grads`` (count of non-finite elements — fp32 so huge
+    trees cannot overflow an int32), ``group_norm.<key>`` per top-level
+    param group when ``grads`` is a mapping, plus the caller's ``aux``
+    scalars verbatim under ``aux``. Pure ``jnp``; a NaN/Inf gradient
+    poisons the norm (by design — the host publisher escalates it and
+    keeps the histogram clean).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def subtree_stats(tree):
+        sumsq = jnp.zeros((), jnp.float32)
+        nonfinite = jnp.zeros((), jnp.float32)
+        for g in jax.tree_util.tree_leaves(tree):
+            g32 = g.astype(jnp.float32)
+            nonfinite = nonfinite + jnp.sum(
+                (~jnp.isfinite(g32)).astype(jnp.float32))
+            sumsq = sumsq + jnp.sum(jnp.square(g32))
+        return sumsq, nonfinite
+
+    stats: Dict[str, Any] = {}
+    if isinstance(grads, dict) and grads:
+        group_sq = {}
+        total_sq = jnp.zeros((), jnp.float32)
+        total_nf = jnp.zeros((), jnp.float32)
+        for key, sub in grads.items():
+            sq, nf = subtree_stats(sub)
+            group_sq[str(key)] = sq
+            total_sq = total_sq + sq
+            total_nf = total_nf + nf
+        stats["group_norm"] = {k: jnp.sqrt(v) for k, v in group_sq.items()}
+    else:
+        total_sq, total_nf = subtree_stats(grads)
+    stats["grad_norm"] = jnp.sqrt(total_sq)
+    stats["nonfinite_grads"] = total_nf
+    if aux:
+        stats["aux"] = aux
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# host-side publication (strictly at the engine's step boundary)
+# ---------------------------------------------------------------------------
+
+def publish_train_stats(registry, stats: Optional[Dict[str, Any]], *,
+                        step: int, tracer: Optional[RequestTracer] = None,
+                        finite: Optional[Any] = None,
+                        loss_scale: Optional[Any] = None,
+                        dynamic_scale: bool = False,
+                        loss: Optional[Any] = None,
+                        logger=None) -> Dict[str, float]:
+    """Publish one step's (already materialized) stats host-side.
+
+    ``stats`` is the device pytree from :func:`train_health_stats` (or
+    None for engine tiers that expose no gradient tree — only the
+    overflow/scale accounting runs then). Escalation contract: a
+    non-finite step increments ``train.overflow_steps``, emits an
+    ``OVERFLOW`` instant (and, under dynamic fp16 scaling, a ``SCALE``
+    instant carrying the post-update scale) and logs ONE structured
+    warning; the grad-norm histogram only ever sees finite values.
+    Returns the flat published values (tests/bench convenience)."""
+    out: Dict[str, float] = {}
+    step_ok = True
+    if finite is not None:
+        step_ok = bool(finite)
+    nonfinite = 0.0
+    gn = None
+    if stats is not None:
+        gn = float(stats["grad_norm"])
+        nonfinite = float(stats.get("nonfinite_grads", 0.0))
+        out["grad_norm"] = gn
+        registry.set_gauge("train.nonfinite_grads", nonfinite)
+        if math.isfinite(gn) and nonfinite == 0.0:
+            registry.observe("train.grad_norm", gn)
+            registry.set_gauge("train.grad_norm", gn)
+        for key, v in (stats.get("group_norm") or {}).items():
+            gv = float(v)
+            if math.isfinite(gv):
+                registry.set_gauge(f"train.grad_norm.{key}", gv)
+        for key, v in (stats.get("aux") or {}).items():
+            try:
+                av = float(v)
+            except (TypeError, ValueError):
+                continue
+            registry.set_gauge(f"train.aux.{key}", av)
+            out[f"aux.{key}"] = av
+    if loss is not None:
+        lv = float(loss)
+        out["loss"] = lv
+        if math.isfinite(lv):
+            registry.set_gauge("train.loss", lv)
+    scale_v = None
+    if loss_scale is not None:
+        scale_v = float(loss_scale)
+        registry.set_gauge("train.loss_scale", scale_v)
+        out["loss_scale"] = scale_v
+    # escalation covers the norm OVERFLOWING too: elements can all be
+    # finite while the sum of squares runs off the fp32 range — that is
+    # the divergence signal this layer exists to surface, not a value
+    # to silently drop
+    norm_blown = gn is not None and not math.isfinite(gn)
+    if not step_ok or nonfinite > 0.0 or norm_blown:
+        registry.inc("train.overflow_steps")
+        out["overflow"] = 1.0
+        if tracer is not None:
+            tracer.instant("OVERFLOW", tid=STEP_TID, cat="train",
+                           step=step, nonfinite=nonfinite,
+                           grad_norm=str(gn), skipped=not step_ok)
+            if dynamic_scale and scale_v is not None:
+                tracer.instant("SCALE", tid=STEP_TID, cat="train",
+                               step=step, scale=scale_v)
+        if logger is not None:
+            logger.warning(
+                "dsttrain: non-finite gradient health at global step %d "
+                "(grad_norm=%s, nonfinite_elements=%s, "
+                "update_skipped=%s%s) — see train.overflow_steps / "
+                "train.nonfinite_grads",
+                step, gn, int(nonfinite), not step_ok,
+                f", loss_scale now {scale_v}" if dynamic_scale
+                and scale_v is not None else "")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pipeline schedule lanes + efficiency
+# ---------------------------------------------------------------------------
+
+def pipeline_lane_spans(tracer: RequestTracer, t0: float, t1: float,
+                        num_micro: int, num_stages: int, *,
+                        step: Optional[int] = None) -> int:
+    """Emit per-stage microbatch lanes for one 1F1B step window.
+
+    The (tick → microbatch, direction) mapping is EXACT — it is the
+    same ``tick_plan`` arithmetic the SPMD interpreter executes — while
+    the per-tick times are schematic: the measured step window
+    ``[t0, t1]`` divided into the schedule's uniform ticks (individual
+    tick times are not host-observable inside one compiled program).
+    The rendered fill/steady/drain structure, idle slots and the
+    bubble they visualize are the schedule's real ones. Returns the
+    number of spans emitted."""
+    from deepspeed_tpu.runtime.pipe.interpreter import (
+        TICK_FWD, tick_plan,
+    )
+
+    T = 2 * (num_micro + num_stages - 1)
+    if T <= 0 or t1 <= t0:
+        return 0
+    dt = (t1 - t0) / T
+    emitted = 0
+    for s in range(num_stages):
+        tid = stage_tid(s)
+        for t in range(T):
+            mb, direction = tick_plan(t, s, num_micro, num_stages)
+            if mb < 0:
+                continue                    # idle tick: the bubble
+            name = f"F{mb}" if direction == TICK_FWD else f"B{mb}"
+            args = {"micro": int(mb), "stage": s, "tick": t}
+            if step is not None:
+                args["step"] = int(step)
+            tracer.span(name, t0 + t * dt, t0 + (t + 1) * dt,
+                        cat="pipe", tid=tid, **args)
+            emitted += 1
+    return emitted
+
+
+def schedule_efficiency(mfu_value: float, bubble_fraction: float) -> float:
+    """Measured step-time-vs-ideal schedule efficiency.
+
+    The ideal step moves the program's model FLOPs at platform peak
+    through the non-bubble fraction of the schedule:
+    ``t_ideal = flops / (n_dev * peak * (1 - bubble))``; efficiency is
+    ``t_ideal / t_measured = MFU / (1 - bubble_fraction)`` — how much
+    of the schedule-adjusted ceiling the measured step achieves. 0.0
+    when an ingredient is missing (never a fake ratio)."""
+    ceiling = 1.0 - float(bubble_fraction)
+    if ceiling <= 0.0 or not mfu_value:
+        return 0.0
+    return float(mfu_value) / ceiling

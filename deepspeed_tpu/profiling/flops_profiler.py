@@ -97,6 +97,32 @@ class FlopsProfiler:
     def stop_profile(self) -> None:
         self._started = False
 
+    def registry_section(self, module_depth: int = 2,
+                         top_modules: int = 3) -> Dict[str, float]:
+        """Flat numeric dict for the dsttrain ``profiling`` registry
+        pull section (docs/OBSERVABILITY.md): whole-program cost
+        analysis plus the top per-module rows when ``profile_modules``
+        ran — so the monitor sinks, ``dst prof --train`` and the
+        Prometheus exporter drain the profiler's output instead of it
+        living only in its own log lines."""
+        out: Dict[str, float] = {
+            "flops": self.flops,
+            "macs": self.macs,
+            "bytes_accessed": self.bytes_accessed,
+        }
+        if self.duration:
+            out["duration_s"] = self.duration
+            out["flops_per_sec"] = self.flops / self.duration
+        n_params = getattr(self, "n_params", None)
+        if n_params is None and self.params is not None:
+            n_params = count_params(self.params)
+        if n_params:
+            out["params"] = float(n_params)
+        if self.module_tree is not None:
+            out.update(self.module_tree.registry_rows(
+                depth=module_depth, top=top_modules))
+        return out
+
     def get_total_flops(self, as_string: bool = False):
         return _fmt(self.flops, "FLOPs") if as_string else self.flops
 

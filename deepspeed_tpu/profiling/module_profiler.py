@@ -218,6 +218,19 @@ class ModuleTree:
             visit(r, 0)
         return out
 
+    def registry_rows(self, depth: int = 2, top: int = 3) -> Dict[str, float]:
+        """Flat ``module.<scope>.flops/params`` dict of the top rows —
+        the shape the dsttrain ``profiling`` registry section carries
+        (bounded: ``depth``/``top`` keep a 32-layer model from turning
+        the metrics snapshot into a per-op dump)."""
+        out: Dict[str, float] = {}
+        for scope, flops, nparams in self.rows(depth=depth, top=top):
+            key = scope.replace("/", ".")
+            out[f"module.{key}.flops"] = float(flops)
+            if nparams:
+                out[f"module.{key}.params"] = float(nparams)
+        return out
+
     def format(self, depth: int = -1, top: int = 0) -> str:
         from deepspeed_tpu.profiling.flops_profiler import _fmt
 

@@ -367,6 +367,25 @@ class DeepSpeedConfig:
         # dstprof MFU denominator override (TFLOP/s per device); None =
         # the per-platform table in observability/efficiency.py
         self.peak_tflops: Optional[float] = p.get("peak_tflops")
+        # dsttrain (docs/OBSERVABILITY.md "Training"): in-graph
+        # grad/MoE health stats + step-lane tracing. Default ON — the
+        # stats ride the compiled step (comms-free, budget-pinned) and
+        # publication is lag-one so the async dispatch pipeline keeps
+        # its depth. ``loss_aux`` opts a custom loss_fn into returning
+        # ``(loss, {name: scalar})``; the scalars publish as
+        # ``train.aux.<name>`` gauges (the MoE gate-telemetry channel).
+        tele = p.get("train_telemetry", {})
+        if isinstance(tele, bool):
+            tele = {"enabled": tele}
+        self.train_telemetry_enabled: bool = bool(tele.get("enabled", True))
+        self.train_telemetry_trace: bool = bool(tele.get("trace", True))
+        self.train_telemetry_trace_capacity: int = int(
+            tele.get("trace_capacity", 65536))
+        self.train_telemetry_loss_aux: bool = bool(
+            tele.get("loss_aux", False))
+        # training twin of serve.metrics_port: >0 starts the stdlib
+        # Prometheus scrape endpoint over the engine's registry
+        self.metrics_port: int = int(p.get("metrics_port", 0) or 0)
         self.comms_logger = CommsLoggerConfig(**p.get("comms_logger", {}))
         self.flops_profiler = FlopsProfilerConfig(**p.get("flops_profiler", {}))
         self.pipeline = PipelineConfig(**p.get("pipeline", {}))

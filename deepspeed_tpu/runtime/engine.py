@@ -21,6 +21,7 @@ subclasses. fp16 keeps the reference's dynamic loss scaling
 (fp16/loss_scaler.py) as carried scaler state inside jit.
 """
 
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
@@ -52,6 +53,8 @@ from deepspeed_tpu.compression import (
 )
 from deepspeed_tpu.observability import (
     CompileWatcher, MetricsRegistry, device_memory_section,
+    make_train_tracer, pipeline_lane_spans, publish_train_stats,
+    schedule_efficiency, train_health_stats,
 )
 from deepspeed_tpu.ops.optimizers import build_optimizer
 from deepspeed_tpu.utils import groups
@@ -448,9 +451,38 @@ class DeepSpeedEngine:
 
         # flops profiler (reference profiling/flops_profiler; engine hooks
         # at engine.py:1692,2070-2081): print a cost-analysis report once at
-        # profile_step
+        # profile_step. Its output also lands in the registry as the
+        # ``profiling`` pull section (empty until profile_step fires), so
+        # `dst prof --train` and the Prometheus exporter see it instead
+        # of only its own log lines.
         self._flops_profiler_cfg = self._config.flops_profiler
         self._flops_profiled = False
+        self._flops_prof = None
+        self.metrics.register_collector("profiling", self._profiling_section)
+
+        # dsttrain (docs/OBSERVABILITY.md "Training"): in-graph gradient/
+        # MoE health stats riding the compiled step + step-lane tracing.
+        # Publication is lag-one (_publish_pending_train_stats): step N's
+        # scalars are read while step N+1 runs, so telemetry never drains
+        # the async dispatch queue the fused program relies on.
+        self._telemetry_on = bool(
+            getattr(self._config, "train_telemetry_enabled", True))
+        self.train_tracer = None
+        if self._telemetry_on and self._config.train_telemetry_trace:
+            self.train_tracer = make_train_tracer(
+                self._config.train_telemetry_trace_capacity)
+        self._pending_train_stats = None
+        # guards the pending-stats hand-off: a metrics-server scrape
+        # thread flushes concurrently with the training thread's
+        # _after_step — take-and-clear must be atomic or one step's
+        # stats publish twice (double-counted histograms/counters)
+        self._train_stats_lock = threading.Lock()
+        self._pipe_lane_info = None       # (num_micro, num_stages) on 1F1B
+        self._pipe_bubble = None          # static schedule bubble fraction
+        self._jit_health = None
+        self._metrics_server = None
+        if getattr(self._config, "metrics_port", 0):
+            self.start_metrics_server()
 
     def _ctx(self):
         """Scoped ambient-mesh context: PartitionSpec-based sharding
@@ -707,12 +739,28 @@ class DeepSpeedEngine:
             return constrain_gradients(grads, grad_shardings, comm_dtype,
                                        predivide)
 
-        def grad_step(params, batch, scale):
-            def scaled_loss(p):
-                loss = loss_fn(p, batch)
-                return loss * scale
+        telemetry = self._telemetry_on
+        loss_aux = self._config.train_telemetry_loss_aux
 
-            loss, grads = jax.value_and_grad(scaled_loss)(params)
+        def grad_step(params, batch, scale):
+            if loss_aux:
+                # train_telemetry.loss_aux: the loss_fn contract becomes
+                # (loss, {name: scalar}) — the aux dict rides the stats
+                # pytree out of the compiled step and publishes as
+                # train.aux.<name> gauges (the MoE gate-telemetry channel)
+                def scaled_loss(p):
+                    loss, aux = loss_fn(p, batch)
+                    return loss * scale, aux
+
+                (loss, aux), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True)(params)
+            else:
+                def scaled_loss(p):
+                    loss = loss_fn(p, batch)
+                    return loss * scale
+
+                loss, grads = jax.value_and_grad(scaled_loss)(params)
+                aux = {}
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
             grads = constrain_grads(grads)
             if accum_dtype is not None:
@@ -726,7 +774,7 @@ class DeepSpeedEngine:
                 # this one (reference keeps grad_accum_dtype storage-only)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(accum_dtype), grads)
-            return loss / scale, grads
+            return loss / scale, grads, aux
 
         def apply_update(params, opt_state, grads, scaler_state,
                          loss_ok=jnp.asarray(True)):
@@ -772,9 +820,9 @@ class DeepSpeedEngine:
             return new_params, new_opt, new_scaler, finite
 
         def accumulate_grads(params, scale, batch):
-            """All GAS micro-batches → (mean loss, mean grads); shared by
-            the fused and NVMe step programs so their trajectories cannot
-            desynchronize."""
+            """All GAS micro-batches → (mean loss, mean grads, mean aux);
+            shared by the fused and NVMe step programs so their
+            trajectories cannot desynchronize."""
             if gas == 1:
                 # no accumulator buffer needed — one fused fwd+bwd
                 mb = jax.tree_util.tree_map(lambda x: x[0], batch)
@@ -782,7 +830,7 @@ class DeepSpeedEngine:
 
             def micro(carry, mb):
                 acc, loss_sum = carry
-                loss, grads = grad_step(params, mb, scale)
+                loss, grads, aux = grad_step(params, mb, scale)
                 # the scan CARRY accumulates in fp32 even when
                 # grad_accum_dtype=bf16: each micro-grad arrives
                 # bf16-stored (grad_step's cast — the per-micro
@@ -792,42 +840,58 @@ class DeepSpeedEngine:
                 # (regression-pinned in tests/unit/test_engine.py)
                 acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                return (acc, loss_sum + loss), None
+                return (acc, loss_sum + loss), aux
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(
                     jnp.zeros(p.shape, jnp.float32), s),
                 params, grad_shardings)
-            (acc, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), batch)
+            (acc, loss_sum), auxs = jax.lax.scan(micro, (zero_grads, 0.0),
+                                                 batch)
             # the STORED tree keeps the configured accumulation dtype
             # (grad_accum_dtype is a storage knob — the NVMe/grouped
             # tiers bank this tree host-side)
             grads = jax.tree_util.tree_map(
                 lambda g: (g / gas).astype(accum_dtype)
                 if accum_dtype is not None else g / gas, acc)
-            return loss_sum / gas, grads
+            aux = jax.tree_util.tree_map(
+                lambda a: jnp.mean(a.astype(jnp.float32), axis=0), auxs)
+            return loss_sum / gas, grads, aux
 
         def train_batch_fn(params, opt_state, scaler_state, batch):
-            """(gas, micro_global, ...) batch → scan accumulate → update."""
-            loss, grads = accumulate_grads(params, scaler_state.scale, batch)
+            """(gas, micro_global, ...) batch → scan accumulate → update.
+            The trailing ``stats`` output is the dsttrain health pytree
+            (a few fp32 scalars off the accumulated grads — comms-free,
+            pinned by the SPMD budget gate on the zero-step seam)."""
+            loss, grads, aux = accumulate_grads(params, scaler_state.scale,
+                                                batch)
+            stats = train_health_stats(grads, aux=aux) if telemetry else {}
             # the guard checks the loss too (a finite-grad NaN loss is
             # possible with masked losses); it feeds the skip gate, so a
             # tripped check really does leave params/opt_state untouched
             loss_ok = (jnp.isfinite(loss) if numerics else jnp.asarray(True))
             new_params, new_opt, new_scaler, finite = apply_update(
                 params, opt_state, grads, scaler_state, loss_ok)
-            return new_params, new_opt, new_scaler, loss, finite
+            if telemetry and fp16:
+                # the post-update scale rides the stats pytree as its own
+                # output: the live scaler_state is DONATED to the next
+                # step, so the lag-one publisher cannot read it later
+                stats = dict(stats, loss_scale=new_scaler.scale)
+            return new_params, new_opt, new_scaler, loss, finite, stats
 
         def grads_batch_fn(params, scaler_state, batch):
             """NVMe path: the fused program minus the update — loss, grads,
-            global norm, and finiteness, all in one compiled program."""
-            loss, grads = accumulate_grads(params, scaler_state.scale, batch)
+            global norm, finiteness and the health stats, all in one
+            compiled program."""
+            loss, grads, aux = accumulate_grads(params, scaler_state.scale,
+                                                batch)
+            stats = train_health_stats(grads, aux=aux) if telemetry else {}
             gnorm = optax.global_norm(jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads))
             grads_ok = (grads_finite(grads) if (fp16 or numerics)
                         else jnp.asarray(True))
             loss_ok = (jnp.isfinite(loss) if numerics else jnp.asarray(True))
-            return loss, grads, gnorm, grads_ok, loss_ok
+            return loss, grads, gnorm, grads_ok, loss_ok, stats
 
         with set_mesh(mesh):
             self._jit_loss = jax.jit(lambda p, b: loss_fn(p, b))
@@ -846,11 +910,17 @@ class DeepSpeedEngine:
                              self._opt_shardings
                              if plan.offload_optimizer and self._nvme is None
                              else None,
-                             None, None, None)
+                             None, None, None, None)
             self._jit_apply = jax.jit(
                 apply_update, donate_argnums=(0, 1, 2),
                 out_shardings=(ts_out_sh[0], ts_out_sh[1], None, None)
                 if ts_out_sh is not None else None)
+            if telemetry:
+                # fwd/backward/step API path: stats off the accumulated
+                # grad tree at the GAS boundary (the fused path computes
+                # them inside train_batch_fn)
+                self._jit_health = jax.jit(
+                    lambda g: train_health_stats(g))
             self._jit_train_batch = self.compile_obs.wrap(
                 "train_step", "train_batch",
                 jax.jit(train_batch_fn, donate_argnums=(0, 1, 2),
@@ -874,7 +944,7 @@ class DeepSpeedEngine:
                                                 memory_kind="pinned_host"),
                         plan.grad_specs,
                         is_leaf=lambda x: isinstance(x, PartitionSpec))
-                    grads_out_sh = (None, ghost, None, None, None)
+                    grads_out_sh = (None, ghost, None, None, None, None)
                 self._jit_grads_batch = self.compile_obs.wrap(
                     "train_step", "grads_batch",
                     jax.jit(grads_batch_fn, out_shardings=grads_out_sh))
@@ -1009,6 +1079,7 @@ class DeepSpeedEngine:
         batch (micro*gas*dp) or already (gas, micro*dp, ...). With no batch,
         pulls the next one from ``training_dataloader`` (reference
         ``train_batch(data_iter)``, pipe/engine.py:286)."""
+        t_step0 = time.monotonic()
         if batch is None:
             batch = self.next_batch()
         gas = self.gradient_accumulation_steps()
@@ -1031,10 +1102,13 @@ class DeepSpeedEngine:
                 jnp.full((gas,), self.global_steps, jnp.int32),
                 NamedSharding(self.mesh, PartitionSpec()))
 
+        t_data1 = time.monotonic()
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         self._maybe_profile_flops(batch)
+        t_prog0 = time.monotonic()
+        stats = None
         if self._pnvme is not None:
             # param-NVMe interpreter (zero/param_nvme.py): LR from applied-
             # update count, like the optimizer-NVMe path (_nvme_apply)
@@ -1043,12 +1117,13 @@ class DeepSpeedEngine:
             with self._ctx():
                 loss, finite = self._pnvme.train_batch(batch, lr=lr)
         elif self._nvme is not None:
-            loss, finite = self._train_batch_nvme(batch)
+            loss, finite, stats = self._train_batch_nvme(batch)
         else:
             with self._ctx():
-                self.params, self.opt_state, self.scaler_state, loss, finite = \
-                    self._jit_train_batch(self.params, self.opt_state,
-                                          self.scaler_state, batch)
+                (self.params, self.opt_state, self.scaler_state, loss,
+                 finite, stats) = self._jit_train_batch(
+                    self.params, self.opt_state, self.scaler_state, batch)
+        t_prog1 = time.monotonic()
         if self.eigenvalue is not None or self.quantizer is not None:
             mb = None
             if self.eigenvalue is not None:  # only the eigenvalue path reads it
@@ -1056,8 +1131,9 @@ class DeepSpeedEngine:
                       for k, v in batch.items() if k != STEP_KEY}
             self._misc_runtime_step(mb, finite)
         self._numerics_raise_if_tripped(finite, timer=TRAIN_BATCH_TIMER)
-        self._after_step(finite, loss=loss)
+        self._after_step(finite, loss=loss, stats=stats)
         self.micro_steps += gas
+        self._trace_step_lanes(t_step0, t_data1, t_prog0, t_prog1)
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(synchronize=True)
         return loss
@@ -1080,8 +1156,19 @@ class DeepSpeedEngine:
             # advances on skipped steps too
             lr = (float(self._lr_schedule(self._nvme.count))
                   if self._lr_schedule else None)
+            t0 = time.monotonic()
             self.params = self._nvme.step(
                 self.params, grads, self._clip_scale(float(gnorm)), lr=lr)
+            # the swapped sub-group update is a REAL host boundary (the
+            # fused path's in-graph update has none) — an OPTIM span/
+            # histogram of its own
+            if self._telemetry_on:
+                t1 = time.monotonic()
+                self.metrics.observe("train.phase.optim_s", t1 - t0)
+                if self.train_tracer is not None:
+                    self.train_tracer.span("OPTIM", t0, t1, cat="train",
+                                           tid=0,
+                                           step=self.global_steps + 1)
         if self.fp16_enabled:
             cfg16 = self._config.fp16
             self.scaler_state = update_scaler(
@@ -1095,10 +1182,10 @@ class DeepSpeedEngine:
         """ZeRO-Infinity train step: one jitted grads program, then the
         pipelined per-sub-group swapped update (reference stage3.py:1775)."""
         with self._ctx():
-            loss, grads, gnorm, grads_ok, loss_ok = self._jit_grads_batch(
-                self.params, self.scaler_state, batch)
+            loss, grads, gnorm, grads_ok, loss_ok, stats = \
+                self._jit_grads_batch(self.params, self.scaler_state, batch)
             finite = self._nvme_apply(grads, gnorm, grads_ok, loss_ok)
-        return loss, finite
+        return loss, finite, stats
 
     def __call__(self, batch: Dict[str, Any]):
         return self.forward(batch)
@@ -1116,7 +1203,8 @@ class DeepSpeedEngine:
             batch = {**batch, STEP_KEY: jnp.asarray(self.global_steps, jnp.int32)}
         batch = self._shard_batch(batch)
         with self._ctx():
-            loss, grads = self._jit_grad(self.params, batch, self.scaler_state.scale)
+            loss, grads, _aux = self._jit_grad(self.params, batch,
+                                               self.scaler_state.scale)
         self._cached_grads = grads
         if self._config.numerics_check_enabled:
             # device-side loss-finiteness accumulator across micro-steps, so
@@ -1180,11 +1268,17 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         assert self._grad_acc is not None, "no accumulated gradients"
+        t0 = time.monotonic()
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
         loss_ok = (self._loss_ok_acc if self._loss_ok_acc is not None
                    else jnp.asarray(True))
+        stats = None
         with self._ctx():
+            # health stats BEFORE the apply program — it donates (and so
+            # invalidates) the accumulated gradient buffers
+            if self._jit_health is not None:
+                stats = self._jit_health(self._grad_acc)
             if self._nvme is not None:
                 gnorm, grads_ok = self._jit_gnorm_finite(self._grad_acc)
                 finite = self._nvme_apply(self._grad_acc, gnorm, grads_ok,
@@ -1197,7 +1291,11 @@ class DeepSpeedEngine:
         self._loss_ok_acc = None
         self._numerics_raise_if_tripped(finite, timer=STEP_GLOBAL_TIMER)
         self._misc_runtime_step(self._last_micro_batch, finite)
-        self._after_step(finite)
+        self._after_step(finite, stats=stats)
+        if self._telemetry_on and self.train_tracer is not None:
+            self.train_tracer.span("STEP", t0, time.monotonic(),
+                                   cat="train", tid=0,
+                                   step=self.global_steps)
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).stop(synchronize=True)
 
@@ -1268,6 +1366,10 @@ class DeepSpeedEngine:
         mb = {k: jax.tree_util.tree_map(lambda x: x[0], v)
               for k, v in batch.items()}
         report = prof.profile(self.loss_fn, self.params, mb, time_it=False)
+        prof.n_params = int(sum(
+            x.size for x in jax.tree_util.tree_leaves(self.params)
+            if hasattr(x, "size")))
+        self._flops_prof = prof     # feeds the 'profiling' registry section
         if cfg.detailed:
             try:
                 prof.profile_modules(self.loss_fn, self.params, mb)
@@ -1366,7 +1468,141 @@ class DeepSpeedEngine:
 
         return capture_profile(path)
 
-    def _after_step(self, finite, loss=None):
+    # --- dsttrain (docs/OBSERVABILITY.md "Training") --------------------------
+    def _profiling_section(self) -> dict:
+        """``profiling`` registry pull section: the flops-profiler's
+        cost-analysis output (empty until ``flops_profiler.profile_step``
+        fires) — so `dst prof --train`, the monitor sinks and the
+        Prometheus exporter see the profile instead of only a log line."""
+        if self._flops_prof is None:
+            return {}
+        return self._flops_prof.registry_section()
+
+    def _publish_pending_train_stats(self) -> None:
+        with self._train_stats_lock:
+            pending = self._pending_train_stats
+            self._pending_train_stats = None
+        if pending is None:
+            return
+        step, stats, finite, scale, loss = pending
+        publish_train_stats(
+            self.metrics, stats if stats else None, step=step,
+            tracer=self.train_tracer, finite=finite, loss_scale=scale,
+            dynamic_scale=self.fp16_enabled and self._dynamic_scale,
+            loss=loss, logger=logger)
+
+    def flush_train_telemetry(self) -> None:
+        """Publish the pending (lag-one) step's health stats now. Called
+        automatically at monitor drains and by :meth:`train_metrics`;
+        call it manually before reading ``engine.metrics`` right after a
+        step."""
+        if self._telemetry_on:
+            self._publish_pending_train_stats()
+
+    def _trace_step_lanes(self, t_step0, t_data1, t_prog0, t_prog1) -> None:
+        """Step-phase histograms + STEP/DATA/FWD_BWD spans for the step
+        that just completed (and pipeline microbatch lanes on 1F1B
+        engines). All host arithmetic; span boundaries are the engine's
+        real host boundaries — under async dispatch FWD_BWD is the
+        program's dispatch window, not its device occupancy (the
+        profiler capture is the escape hatch for that)."""
+        if not self._telemetry_on:
+            return
+        t_step1 = time.monotonic()
+        self.metrics.observe("train.phase.data_s",
+                             max(t_data1 - t_step0, 0.0))
+        self.metrics.observe("train.phase.fwd_bwd_s",
+                             max(t_prog1 - t_prog0, 0.0))
+        tr = self.train_tracer
+        if tr is None:
+            return
+        step = self.global_steps
+        tr.span("DATA", t_step0, t_data1, cat="train", tid=0, step=step)
+        tr.span("FWD_BWD", t_prog0, t_prog1, cat="train", tid=0, step=step)
+        tr.span("STEP", t_step0, t_step1, cat="train", tid=0, step=step)
+        if self._pipe_lane_info is not None:
+            pipeline_lane_spans(tr, t_prog0, t_prog1,
+                                *self._pipe_lane_info, step=step)
+
+    def train_metrics(self, format: str = "dict"):
+        """The training registry, in one of two shapes (the training
+        twin of ``InferenceEngine.serve_metrics``):
+
+        - ``format="dict"``: the plain ``snapshot()`` — step/phase
+          histograms, grad-norm health, throughput, MFU, ZeRO reduction
+          bytes, compile/memory/efficiency/profiling/comm sections.
+        - ``format="prometheus"``: the same registry as exposition text
+          (real ``_bucket/_sum/_count`` histograms), the payload the
+          ``metrics_port`` endpoint scrapes.
+
+        Flushes the pending lag-one step first, so the rendering always
+        reflects every completed step."""
+        self.flush_train_telemetry()
+        if format == "dict":
+            return self.metrics.snapshot()
+        if format == "prometheus":
+            from deepspeed_tpu.observability import prometheus_text
+
+            return prometheus_text(self.metrics)
+        raise ValueError(
+            f"train_metrics(format={format!r}): expected 'dict' or "
+            f"'prometheus'")
+
+    def start_metrics_server(self, port: Optional[int] = None) -> int:
+        """Start the stdlib HTTP scrape endpoint (``/metrics``
+        Prometheus text, ``/metrics.json`` raw snapshot) over the
+        training registry on ``port`` (default: the ``metrics_port``
+        config knob; 0 binds an ephemeral port). Idempotent; returns
+        the bound port."""
+        if self._metrics_server is not None:
+            return self._metrics_server.port
+        from deepspeed_tpu.observability import (
+            MetricsHTTPServer, prometheus_text,
+        )
+
+        if port is None:
+            port = int(getattr(self._config, "metrics_port", 0))
+
+        def render():
+            self.flush_train_telemetry()
+            return prometheus_text(self.metrics)
+
+        self._metrics_server = MetricsHTTPServer(
+            render, json_fn=self.metrics.snapshot, port=port)
+        bound = self._metrics_server.start()
+        log_dist(f"dsttrain metrics endpoint on :{bound}/metrics",
+                 ranks=[0])
+        return bound
+
+    def stop_metrics_server(self) -> None:
+        if getattr(self, "_metrics_server", None) is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    def _trace_ckpt(self, op: str, tag: str, t0: float) -> None:
+        """CKPT span + phase histogram for a save/load that just ran."""
+        if not getattr(self, "_telemetry_on", False):
+            return
+        t1 = time.monotonic()
+        self.metrics.observe("train.phase.ckpt_s", t1 - t0)
+        if self.train_tracer is not None:
+            self.train_tracer.span("CKPT", t0, t1, cat="train", tid=0,
+                                   op=op, tag=str(tag))
+
+    def export_train_trace(self, path: Optional[str] = None) -> dict:
+        """The accumulated training-step trace as a Chrome/Perfetto
+        trace-event JSON object (STEP/DATA/FWD_BWD/OPTIM/CKPT spans,
+        OVERFLOW/SCALE instants, pipeline microbatch lanes); written to
+        ``path`` when given. Raises when tracing is off."""
+        if self.train_tracer is None:
+            raise RuntimeError(
+                "no training trace recorded: train_telemetry.trace is "
+                "off (or train_telemetry.enabled is false)")
+        if path:
+            return self.train_tracer.export(path)
+        return self.train_tracer.chrome()
+
+    def _after_step(self, finite, loss=None, stats=None):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self._account_zero_reduction()
@@ -1395,15 +1631,42 @@ class DeepSpeedEngine:
                 peak_flops_per_device
 
             peak = peak_flops_per_device(self._config.peak_tflops)
-            self.metrics.set_gauge(
-                "train.mfu", mfu(flops, self.tput_timer.last_duration,
-                                 int(self.mesh.devices.size),
-                                 peak["flops"]))
+            mfu_v = mfu(flops, self.tput_timer.last_duration,
+                        int(self.mesh.devices.size), peak["flops"])
+            self.metrics.set_gauge("train.mfu", mfu_v)
             self.metrics.set_gauge(
                 "train.model_flops_per_sec",
                 flops / self.tput_timer.last_duration)
+            if self._pipe_bubble is not None:
+                # measured-step-vs-ideal: the fraction of the schedule-
+                # adjusted ceiling achieved (MFU / (1 - bubble)) — next
+                # to MFU so dashboards separate "schedule overhead" from
+                # "kernel efficiency" (docs/OBSERVABILITY.md)
+                self.metrics.set_gauge(
+                    "train.pipeline.schedule_efficiency",
+                    schedule_efficiency(mfu_v, self._pipe_bubble))
+        # dsttrain lag-one publication: push the PREVIOUS step's health
+        # stats out (its scalars materialized while this step ran — the
+        # host reads below never drain the dispatch queue), then bank
+        # this step's. flush_train_telemetry() forces the pending one.
+        if self._telemetry_on:
+            self._publish_pending_train_stats()
+            scale = None
+            if self.fp16_enabled:
+                # fused path: the scale snapshot inside the stats pytree
+                # (the live scaler buffer is donated next step); non-fused
+                # tiers update the scaler host-side, so the live value is
+                # stable
+                scale = (stats["loss_scale"]
+                         if stats and "loss_scale" in stats
+                         else self.scaler_state.scale)
+            self._pending_train_stats = (
+                self.global_steps, stats, finite, scale, loss)
         if (self.monitor is not None
                 and self.global_steps % self._config.steps_per_print == 0):
+            # print boundary: the registry is about to be drained into
+            # sinks — publish the pending step so the drain is current
+            self.flush_train_telemetry()
             # the reference's event contract (SURVEY §8.6; engine.py:
             # 1826-1834, 2045-2067). Emitted at steps_per_print boundaries:
             # float(loss) is a device sync, and syncing every step would
@@ -1426,7 +1689,9 @@ class DeepSpeedEngine:
 
     def destroy(self):
         """Release engine-held native resources (AIO thread pools, pending
-        async checkpoint). Idempotent; also runs at GC via finalizers."""
+        async checkpoint, metrics endpoint). Idempotent; also runs at GC
+        via finalizers."""
+        self.stop_metrics_server()
         if getattr(self, "_nvme", None) is not None:
             self._nvme_finalizer()      # weakref.finalize: at-most-once
             self._nvme = None
@@ -1480,6 +1745,7 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None, save_latest: bool = True):
+        t_ckpt0 = time.monotonic()
         engine = self.checkpoint_engine
         tag = tag or f"global_step{self.global_steps}"
         nvme_count = (self._pnvme.count if self._pnvme is not None
@@ -1512,12 +1778,14 @@ class DeepSpeedEngine:
             self._pnvme.save_files(
                 _os.path.join(save_dir, tag, "nvme_params"))
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+        self._trace_ckpt("save", tag, t_ckpt0)
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True):
         import os as _os
 
+        t_ckpt0 = time.monotonic()
         engine = self.checkpoint_engine
         engine.wait()   # a pending async save must land before 'latest'
         tag = engine.resolve_tag(load_dir, tag)
@@ -1544,6 +1812,7 @@ class DeepSpeedEngine:
             self.skipped_steps = meta.get("skipped_steps", 0)
             log_dist(f"loaded {self._interpreter_tier} checkpoint from "
                      f"{load_dir} (tag={tag})", ranks=[0])
+            self._trace_ckpt("load", tag, t_ckpt0)
             return load_dir, meta.get("client_state", {})
         nvme_dir = _os.path.join(load_dir, tag, "nvme_opt")
         ckpt_is_nvme = _os.path.isdir(nvme_dir)
@@ -1588,6 +1857,7 @@ class DeepSpeedEngine:
         self.micro_steps = meta.get("micro_steps", 0)
         self.skipped_steps = meta.get("skipped_steps", 0)
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag})", ranks=[0])
+        self._trace_ckpt("load", tag, t_ckpt0)
         return load_dir, meta.get("client_state", {})
 
     def load_universal_checkpoint(self, load_dir: str,

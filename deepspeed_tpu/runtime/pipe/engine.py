@@ -227,6 +227,18 @@ class PipelineEngine(DeepSpeedEngine):
 
         self.bubble_fraction = TrainSchedule(
             self.num_micro, self.num_stages, 0).bubble_fraction()
+        # dsttrain schedule observability (docs/OBSERVABILITY.md): the
+        # static bubble next to the measured schedule-efficiency gauge
+        # _after_step maintains, plus microbatch lanes in the step trace
+        # (reconstructed from tick_plan — 1F1B only; the gpipe fill-drain
+        # executes a different tick mapping, so no lanes are faked there)
+        self._pipe_bubble = self.bubble_fraction
+        self.metrics.set_gauge("train.pipeline.bubble_fraction",
+                               self.bubble_fraction)
+        self.metrics.set_gauge("train.pipeline.num_micro", self.num_micro)
+        self.metrics.set_gauge("train.pipeline.stages", self.num_stages)
+        if schedule == "1f1b":
+            self._pipe_lane_info = (self.num_micro, self.num_stages)
         log_dist(f"PipelineEngine: {self.num_stages} stages x "
                  f"{cfg.num_layers // self.num_stages} layers "
                  f"({schedule}, {self.num_micro} microbatches, "

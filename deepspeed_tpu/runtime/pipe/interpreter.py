@@ -68,6 +68,22 @@ def tick_plan(t: int, stage: int, num_micro: int, num_stages: int):
     return (mb_f, mb_b), (do_f, do_b)
 
 
+def schedule_bubble_fraction(num_micro: int, num_stages: int) -> float:
+    """Closed-form 1F1B bubble fraction derived by COUNTING
+    :func:`tick_plan` idle ticks — the cross-check the dsttrain gauge
+    ``train.pipeline.bubble_fraction`` is pinned against
+    (tests/unit/test_dsttrain.py): every stage does 2M useful ticks of
+    the 2(M+P-1) total, so the idle fraction is (P-1)/(M+P-1), exactly
+    ``TrainSchedule.bubble_fraction()``."""
+    T = 2 * (num_micro + num_stages - 1)
+    if T <= 0 or num_stages <= 0:
+        return 0.0
+    idle = sum(
+        1 for s in range(num_stages) for t in range(T)
+        if tick_plan(t, s, num_micro, num_stages)[1] == TICK_IDLE)
+    return idle / (T * num_stages)
+
+
 def exec_1f1b(embed_fn: Callable, block_fn: Callable, head_loss_fn: Callable,
               blocks_local: Any, rest: Any,
               input_ids: jnp.ndarray, labels: jnp.ndarray,

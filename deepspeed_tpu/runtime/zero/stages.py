@@ -179,7 +179,8 @@ def constrain_gradients(grads: Any, grad_shardings: Any,
 
 def build_zero_train_step(loss_fn, optimizer, plan: ZeroShardingPlan,
                           mesh, *, communication_data_type: Optional[str] = None,
-                          gradient_predivide_factor: float = 1.0):
+                          gradient_predivide_factor: float = 1.0,
+                          with_stats: bool = False):
     """A minimal ZeRO train step over a sharding plan: value_and_grad →
     the :func:`constrain_gradients` reduce boundary → optimizer update.
 
@@ -190,6 +191,16 @@ def build_zero_train_step(loss_fn, optimizer, plan: ZeroShardingPlan,
     param all-gather epilogue; stage 2/3: grad reduce-scatter). The
     engine itself keeps its richer program (loss scaling, finite guards,
     offload transfers) built on the same ``constrain_gradients`` seam.
+
+    ``with_stats`` mirrors the engine's dsttrain telemetry default: the
+    step additionally returns the in-graph health-stats pytree
+    (observability/train.train_health_stats). Stats are computed on the
+    raw gradients BEFORE the reduce boundary — semantically they are
+    the global values either way (the constraint is an identity modulo
+    the communication-dtype round-trip), and keeping the norm reduce
+    off the constrained (provably sharded) tree is what lets the SPMD
+    comms pin prove the stats pytree adds ZERO new collective keys to
+    the budgeted train-step programs (tests/unit/test_dsttrain.py).
     """
     import optax
 
@@ -199,10 +210,18 @@ def build_zero_train_step(loss_fn, optimizer, plan: ZeroShardingPlan,
 
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        stats = None
+        if with_stats:
+            from deepspeed_tpu.observability.train import train_health_stats
+
+            stats = train_health_stats(grads)
         grads = constrain_gradients(grads, gshard, comm_dtype,
                                     float(gradient_predivide_factor))
         updates, new_opt = optimizer.update(grads, opt_state, params)
-        return loss, optax.apply_updates(params, updates), new_opt
+        new_params = optax.apply_updates(params, updates)
+        if with_stats:
+            return loss, new_params, new_opt, stats
+        return loss, new_params, new_opt
 
     return train_step
 

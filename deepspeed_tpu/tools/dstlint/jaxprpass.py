@@ -183,6 +183,40 @@ def _tiering_pieces():
     return out
 
 
+def _train_step_pieces():
+    """[(name, fn, avals)] for the ZeRO train-step entry points (dsttrain
+    stats pytree ON — the engine's telemetry default), traced over an
+    abstract data-8 mesh like the SPMD pass. Budgeting their equation
+    counts catches telemetry leaking compute into the compiled step in
+    either direction (a stats regression that re-materializes the grad
+    tree, or stats silently dropping out of the program)."""
+    import jax
+    import optax
+    from jax.sharding import AbstractMesh
+
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.stages import (
+        build_zero_train_step, plan_zero_shardings,
+    )
+    from deepspeed_tpu.tools.dstlint.spmdpass import _tiny_lm_pieces
+
+    _cfg, loss_fn, params, batch = _tiny_lm_pieces()
+    opt = optax.adamw(1e-3)
+    opt_abs = jax.eval_shape(opt.init, params)
+    out = []
+    for stage in (1, 2, 3):
+        mesh = AbstractMesh((("data", 8),))
+        plan = plan_zero_shardings(params, mesh,
+                                   DeepSpeedZeroConfig(stage=stage))
+        step = build_zero_train_step(
+            loss_fn, opt, plan, mesh,
+            communication_data_type="bfloat16" if stage >= 2 else None,
+            with_stats=True)
+        out.append((f"train_step/stage{stage}", step,
+                    (params, opt_abs, batch)))
+    return out
+
+
 def _report(name: str, fn, avals) -> EntryReport:
     import jax
 
@@ -233,6 +267,8 @@ def trace_entry_points(arms: Optional[List[str]] = None
             reports["copy_pool_blocks"] = _report(
                 "copy_pool_blocks", copy_jit, copy_avals)
             for name, fn, avals in _tiering_pieces():
+                reports[name] = _report(name, fn, avals)
+            for name, fn, avals in _train_step_pieces():
                 reports[name] = _report(name, fn, avals)
     return reports
 

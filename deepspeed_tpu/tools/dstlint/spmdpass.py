@@ -1080,7 +1080,7 @@ def _tiny_lm_pieces():
     return cfg, loss_fn, params, batch
 
 
-def _zero_entry(stage: int):
+def _zero_entry(stage: int, with_stats: bool = True):
     import jax
     import optax
     from jax.sharding import AbstractMesh, NamedSharding
@@ -1104,14 +1104,22 @@ def _zero_entry(stage: int):
     # communication dtype (the quantized-collective arm of ROADMAP item
     # 3 will drop this to int8; the spmd-collective-dtype rule pins it)
     comm = "bfloat16" if stage >= 2 else None
+    # stats ON is the engine's dsttrain default; the budget gate plus
+    # the with/without-stats inventory pin (tests/unit/test_dsttrain.py)
+    # prove the health pytree adds ZERO new collective keys
     step = build_zero_train_step(loss_fn, opt, plan, mesh,
-                                 communication_data_type=comm)
+                                 communication_data_type=comm,
+                                 with_stats=with_stats)
     batch_specs = {"input_ids": P("data"), "labels": P("data")}
+    out_specs = [P(), plan.param_specs, opt_specs]
+    if with_stats:
+        stats_abs = jax.eval_shape(step, params, opt_abs, batch)[3]
+        out_specs.append(jax.tree_util.tree_map(lambda _: P(), stats_abs))
     return {
         "fn": step,
         "avals": (params, opt_abs, batch),
         "in_specs": (plan.param_specs, opt_specs, batch_specs),
-        "out_specs": (P(), plan.param_specs, opt_specs),
+        "out_specs": tuple(out_specs),
         "mesh": mesh,
         "meta": {"reduction_dtype": comm,
                  # the scalar loss is replicated by design

@@ -78,6 +78,129 @@ def build_report(requests: int = 6, host_cache_gb: float = 0.0) -> dict:
     }
 
 
+def build_train_report(steps: int = 3) -> dict:
+    """``--train``: run a tiny REAL train engine for a few steps and
+    collect what the dsttrain layer saw — step/phase timing, gradient
+    health, compile cost, MFU, and the flops-profiler registry section
+    (docs/OBSERVABILITY.md "Training")."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    rng = np.random.default_rng(0)
+
+    def batch(n):
+        t = rng.integers(0, 256, size=(n, 17))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    engine = deepspeed_tpu.initialize(
+        model=model, sample_batch=batch(2),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "flops_profiler": {"enabled": True, "profile_step": 1,
+                                   "top_modules": 3, "module_depth": 2},
+                "steps_per_print": 10_000})
+    for _ in range(max(int(steps), 1)):
+        loss = engine.train_batch(batch(engine.train_batch_size()))
+    loss = float(loss)
+    snap = engine.train_metrics()
+    hists = snap["histograms"]
+    return {
+        "backend": jax.default_backend(),
+        "steps": int(steps),
+        "loss": loss,
+        "step_s": hists.get("train.step_s", {}),
+        "phases": {k.split(".")[-1].removesuffix("_s"): v
+                   for k, v in hists.items()
+                   if k.startswith("train.phase.")},
+        "health": {
+            "grad_norm": hists.get("train.grad_norm", {}),
+            "grad_norm_by_group": {
+                k.split(".", 2)[2]: v for k, v in snap["gauges"].items()
+                if k.startswith("train.grad_norm.")},
+            "nonfinite_grads": snap["gauges"].get(
+                "train.nonfinite_grads", 0.0),
+            "overflow_steps": snap["counters"].get(
+                "train.overflow_steps", 0),
+        },
+        "compile": snap.get("compile", {}),
+        "efficiency": snap.get("train.efficiency", {}),
+        "profiling": snap.get("profiling", {}),
+        "zero_reduction": {k: v for k, v in snap["counters"].items()
+                           if k.startswith("train.zero.")},
+        "memory": snap.get("memory", {}),
+    }
+
+
+def render_train_text(report: dict) -> str:
+    lines = ["========================= dstprof train report "
+             "======================="]
+    lines.append(f"backend: {report['backend']}   steps: "
+                 f"{report['steps']}   final loss: {report['loss']:.4f}")
+    lines.append("")
+    lines.append("-- step & phases "
+                 "----------------------------------------------------")
+    rows = [("step", report.get("step_s", {}))]
+    rows += sorted(report.get("phases", {}).items())
+    lines.append(f"{'phase':<12}{'count':>7}{'mean_s':>10}{'p50_s':>10}"
+                 f"{'p95_s':>10}")
+    for name, h in rows:
+        if not h or not h.get("count"):
+            continue
+        lines.append(f"{name:<12}{h['count']:>7}{h['mean']:>10.4f}"
+                     f"{h['p50']:>10.4f}{h['p95']:>10.4f}")
+    lines.append("")
+    lines.append("-- gradient health "
+                 "--------------------------------------------------")
+    health = report.get("health", {})
+    gn = health.get("grad_norm", {})
+    if gn.get("count"):
+        lines.append(f"grad_norm: mean {gn['mean']:.4f}  p50 "
+                     f"{gn['p50']:.4f}  max {gn['max']:.4f}  "
+                     f"({gn['count']} finite steps)")
+    for grp, v in sorted(health.get("grad_norm_by_group", {}).items()):
+        lines.append(f"  {grp:<32}{v:>12.4f}")
+    lines.append(f"overflow_steps: {int(health.get('overflow_steps', 0))}"
+                 f"   nonfinite_grads(last): "
+                 f"{int(health.get('nonfinite_grads', 0))}")
+    lines.append("")
+    lines.append("-- compile & efficiency "
+                 "---------------------------------------------")
+    for cache in sorted(report.get("compile", {})):
+        for key, e in sorted(report["compile"][cache].items()):
+            lines.append(f"{cache + '/' + key:<34}"
+                         f"compiles={e.get('compiles', 0)} "
+                         f"last_s={e.get('last_s', 0.0):.3f} "
+                         f"flops={_fmt_num(e.get('flops', 0))}")
+    eff = report.get("efficiency", {})
+    if eff:
+        lines.append(f"mfu: {eff.get('mfu', 0.0):.4%}   "
+                     f"model_flops/step: "
+                     f"{_fmt_num(eff.get('model_flops_per_step', 0))}   "
+                     f"peak: {eff.get('peak_source', '?')}/"
+                     f"{eff.get('device_kind', '?')}")
+    zr = report.get("zero_reduction", {})
+    if zr:
+        lines.append("zero reduction: " + "  ".join(
+            f"{k.rsplit('.', 1)[1]}={_fmt_num(v)}"
+            for k, v in sorted(zr.items())))
+    prof = report.get("profiling", {})
+    if prof:
+        lines.append("")
+        lines.append("-- flops profiler (registry section) "
+                     "--------------------------------")
+        for k in sorted(prof):
+            lines.append(f"  {k:<44}{_fmt_num(prof[k]):>12}")
+    lines.append("=" * 69)
+    return "\n".join(lines)
+
+
 def render_text(report: dict) -> str:
     lines = ["=========================== dstprof report "
              "==========================="]
@@ -142,7 +265,18 @@ def main(argv=None) -> int:
                     help="requests to drive through the tiny engine")
     ap.add_argument("--host-cache-gb", type=float, default=0.0,
                     help="also exercise the host KV tier at this size")
+    ap.add_argument("--train", action="store_true",
+                    help="one-shot TRAINING-step report (dsttrain) from "
+                         "a tiny real train run instead of the serving "
+                         "report")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="train steps to run with --train")
     args = ap.parse_args(argv)
+    if args.train:
+        report = build_train_report(steps=args.steps)
+        print(json.dumps(report, indent=1, default=str) if args.json
+              else render_train_text(report))
+        return 0
     report = build_report(requests=args.requests,
                           host_cache_gb=args.host_cache_gb)
     if args.json:

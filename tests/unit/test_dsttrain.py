@@ -1,0 +1,446 @@
+"""dsttrain tests: training-step health & schedule observability.
+
+Pins the ISSUE-12 acceptance contract on the REAL compiled training
+path (CPU tier-1):
+
+- a tiny train run produces a schema-valid Perfetto trace with
+  STEP/phase spans, registry histograms for grad-norm and step phases,
+  and a clean Prometheus exposition of the training registry;
+- the pipeline engine's ``train.pipeline.bubble_fraction`` gauge
+  matches the closed-form 1F1B value derived from ``tick_plan``, and
+  microbatch lanes render per-stage fill/steady/drain;
+- fault injection: a NaN gradient increments the overflow counter,
+  halves the loss scale with a SCALE event in the trace, skips the
+  step without corrupting params, and training continues — with the
+  chaos suite's telemetry-consistency pins (non-negative counters,
+  exactly one STEP span per step);
+- the stats pytree is comms-free: the SPMD pass inventories of the
+  budgeted zero-step programs are IDENTICAL with and without stats,
+  and the train-step jaxpr budgets match a fresh trace exactly.
+"""
+
+import json
+import math
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.observability import (
+    check_exposition, validate_chrome_trace,
+)
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _lm_batch(rng, n, seq=16):
+    t = rng.integers(0, 256, size=(n, seq + 1))
+    return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+
+def _tiny_engine(extra_cfg=None, **kw):
+    model = LlamaModel(LlamaConfig.tiny(dtype=jnp.float32))
+    rng = np.random.default_rng(0)
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 1},
+           "steps_per_print": 10_000}
+    cfg.update(extra_cfg or {})
+    eng = deepspeed_tpu.initialize(model=model, config=cfg,
+                                   sample_batch=_lm_batch(rng, 2), **kw)
+    return eng, rng
+
+
+# --- acceptance: tiny real train run -----------------------------------------
+
+def test_train_run_trace_metrics_and_prometheus(tmp_path):
+    eng, rng = _tiny_engine()
+    for _ in range(3):
+        eng.train_batch(_lm_batch(rng, eng.train_batch_size()))
+    snap = eng.train_metrics()          # flushes the lag-one pending step
+
+    # registry histograms: grad-norm + step phases, one sample per step
+    assert snap["histograms"]["train.grad_norm"]["count"] == 3
+    assert snap["histograms"]["train.grad_norm"]["min"] > 0
+    for phase in ("train.phase.data_s", "train.phase.fwd_bwd_s"):
+        assert snap["histograms"][phase]["count"] == 3
+    g = snap["gauges"]
+    assert g["train.grad_norm"] > 0
+    # per-param-group norms cover the model's top-level groups
+    assert g["train.grad_norm.blocks"] > 0
+    assert g["train.grad_norm.embed_tokens"] > 0
+    assert g["train.nonfinite_grads"] == 0.0
+    assert math.isfinite(g["train.loss"])
+    assert snap["counters"].get("train.overflow_steps", 0) == 0
+
+    # schema-valid Perfetto trace with STEP/phase spans
+    path = tmp_path / "train_trace.json"
+    trace = eng.export_train_trace(str(path))
+    assert validate_chrome_trace(trace) == []
+    loaded = json.loads(path.read_text())
+    assert validate_chrome_trace(loaded) == []
+    by_name = {}
+    for ev in loaded["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    # exactly one STEP span per step (the chaos-suite pin, train side)
+    assert len(by_name["STEP"]) == 3
+    assert len(by_name["DATA"]) == 3 and len(by_name["FWD_BWD"]) == 3
+    for ev in by_name["STEP"]:
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+    steps = sorted(e["args"]["step"] for e in by_name["STEP"])
+    assert steps == [1, 2, 3]
+    tracks = {e["args"]["name"] for e in loaded["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "step" in tracks
+
+    # clean Prometheus exposition of the training registry
+    text = eng.train_metrics(format="prometheus")
+    assert check_exposition(text) == []
+    assert "train_grad_norm" in text
+
+
+def test_forward_backward_step_path_publishes_health():
+    eng, rng = _tiny_engine(extra_cfg={"gradient_accumulation_steps": 2})
+    for _ in range(2):
+        eng.forward(_lm_batch(rng, 8))
+        eng.backward()
+    eng.step()
+    eng.flush_train_telemetry()
+    snap = eng.metrics.snapshot()
+    assert snap["histograms"]["train.grad_norm"]["count"] == 1
+    assert snap["gauges"]["train.grad_norm"] > 0
+    trace = eng.export_train_trace()
+    steps = [e for e in trace["traceEvents"] if e["name"] == "STEP"]
+    assert len(steps) == 1
+
+
+def test_telemetry_off_is_silent():
+    eng, rng = _tiny_engine(extra_cfg={"train_telemetry": False})
+    eng.train_batch(_lm_batch(rng, eng.train_batch_size()))
+    eng.flush_train_telemetry()         # no-op, must not raise
+    snap = eng.metrics.snapshot()
+    assert "train.grad_norm" not in snap["histograms"]
+    assert "train.phase.fwd_bwd_s" not in snap["histograms"]
+    with pytest.raises(RuntimeError, match="trace"):
+        eng.export_train_trace()
+
+
+# --- pipeline schedule observability -----------------------------------------
+
+def test_schedule_bubble_closed_form_matches_train_schedule():
+    from deepspeed_tpu.runtime.pipe.interpreter import (
+        schedule_bubble_fraction,
+    )
+    from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+
+    for M, P in ((2, 2), (4, 2), (8, 4), (3, 3), (16, 4)):
+        tick = schedule_bubble_fraction(M, P)
+        sched = TrainSchedule(M, P, 0).bubble_fraction()
+        assert tick == pytest.approx(sched), (M, P)
+        assert tick == pytest.approx((P - 1) / (M + P - 1)), (M, P)
+
+
+def test_pipeline_engine_bubble_gauge_and_microbatch_lanes(devices):
+    from deepspeed_tpu.runtime.pipe.interpreter import (
+        schedule_bubble_fraction,
+    )
+
+    mesh = make_mesh(dims={"pipe": 2, "data": 4, "expert": 1,
+                           "sequence": 1, "tensor": 1})
+    cfg_model = LlamaConfig.tiny(dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    eng = deepspeed_tpu.initialize(
+        model=LlamaModel(cfg_model), model_config=cfg_model, mesh=mesh,
+        config={"train_batch_size": 16, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "mesh": {"pipe": 2, "data": 4},
+                "steps_per_print": 10_000},
+        sample_batch=_lm_batch(rng, 1))
+    assert eng.pipe_schedule == "1f1b"
+    for _ in range(2):
+        eng.train_batch(_lm_batch(rng, eng.train_batch_size()))
+    eng.flush_train_telemetry()
+    g = eng.metrics.snapshot()["gauges"]
+
+    # the acceptance pin: gauge == closed-form 1F1B value from tick_plan
+    closed = schedule_bubble_fraction(eng.num_micro, eng.num_stages)
+    assert g["train.pipeline.bubble_fraction"] == pytest.approx(closed)
+    assert g["train.pipeline.stages"] == eng.num_stages
+    # measured schedule efficiency sits next to MFU, both in (0, 1]
+    assert 0 < g["train.pipeline.schedule_efficiency"] <= 1
+    assert 0 < g["train.mfu"] < 1
+    assert g["train.pipeline.schedule_efficiency"] == pytest.approx(
+        g["train.mfu"] / (1 - closed))
+
+    trace = eng.export_train_trace()
+    assert validate_chrome_trace(trace) == []
+    lanes = [e for e in trace["traceEvents"] if e.get("cat") == "pipe"]
+    # 2 steps x 2 stages x 2M useful ticks (M=2) = 16 lane spans
+    assert len(lanes) == 2 * eng.num_stages * 2 * eng.num_micro
+    assert {e["name"] for e in lanes} == {"F0", "F1", "B0", "B1"}
+    # every stage has its own track, and per-stage lanes carry both
+    # directions for every microbatch (fill/steady/drain is complete)
+    tracks = {e["args"]["name"] for e in trace["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"stage 0", "stage 1"} <= tracks
+    for s in range(eng.num_stages):
+        names = sorted(e["name"] for e in lanes
+                       if e["args"]["stage"] == s
+                       and e["args"]["step"] == 1)
+        assert names == ["B0", "B1", "F0", "F1"]
+
+
+# --- fault injection: NaN gradient contract -----------------------------------
+
+def test_nan_gradient_overflow_contract():
+    params = {"w": np.ones((4,), np.float32)}
+
+    def loss_fn(p, batch, rngs=None):
+        return jnp.mean(batch["x"]) * jnp.sum(p["w"] ** 2)
+
+    eng = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, params=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                # hysteresis=1: the first overflow cuts the scale
+                "fp16": {"enabled": True, "initial_scale_power": 4,
+                         "hysteresis": 1},
+                "steps_per_print": 10_000})
+    world = eng.dp_world_size
+
+    def b(v):
+        return {"x": np.full((world, 4), v, np.float32)}
+
+    eng.train_batch(b(1.0))
+    w_before = np.asarray(eng.params["w"]).copy()
+    eng.train_batch(b(np.inf))          # forced non-finite gradients
+    # the step is skipped without corrupting params
+    assert np.array_equal(np.asarray(eng.params["w"]), w_before)
+    assert eng.skipped_steps == 1
+    # the loss scale halves (2^4 -> 2^3)
+    assert float(eng.scaler_state.scale) == pytest.approx(8.0)
+    eng.train_batch(b(1.0))             # training continues
+    assert not np.array_equal(np.asarray(eng.params["w"]), w_before)
+
+    eng.flush_train_telemetry()
+    snap = eng.metrics.snapshot()
+    # overflow counter incremented exactly once; histogram saw only the
+    # two finite steps (a NaN must never poison the percentiles)
+    assert snap["counters"]["train.overflow_steps"] == 1
+    assert snap["histograms"]["train.grad_norm"]["count"] == 2
+    assert snap["gauges"]["train.loss_scale"] == pytest.approx(8.0)
+    # telemetry consistent under the fault: non-negative counters,
+    # exactly one STEP span per step (the chaos-suite pins, train side)
+    for name, v in snap["counters"].items():
+        assert v >= 0, name
+    trace = eng.export_train_trace()
+    steps = [e for e in trace["traceEvents"] if e["name"] == "STEP"]
+    assert len(steps) == 3
+    # SCALE event in the trace at the overflow step with the new scale
+    scale_evs = [e for e in trace["traceEvents"] if e["name"] == "SCALE"]
+    assert len(scale_evs) == 1
+    assert scale_evs[0]["args"] == {"step": 2, "scale": 8.0}
+    over = [e for e in trace["traceEvents"] if e["name"] == "OVERFLOW"]
+    assert len(over) == 1 and over[0]["args"]["skipped"] is True
+
+
+def test_blown_norm_with_finite_elements_escalates():
+    """Finite elements whose sum of squares overflows fp32 (grad_norm =
+    inf, nonfinite_grads = 0) must escalate like an overflow — not
+    silently drop the one divergence signal this layer exists for."""
+    from deepspeed_tpu.observability import (
+        MetricsRegistry, make_train_tracer, publish_train_stats,
+    )
+
+    r = MetricsRegistry()
+    tr = make_train_tracer()
+    out = publish_train_stats(
+        r, {"grad_norm": float("inf"), "nonfinite_grads": 0.0},
+        step=7, tracer=tr, finite=True)
+    assert out["overflow"] == 1.0
+    snap = r.snapshot()
+    assert snap["counters"]["train.overflow_steps"] == 1
+    # the histogram stays clean (no inf sample)
+    assert "train.grad_norm" not in snap["histograms"]
+    over = [e for e in tr.events if e["name"] == "OVERFLOW"]
+    assert len(over) == 1 and over[0]["args"]["grad_norm"] == "inf"
+
+
+# --- MoE gate telemetry --------------------------------------------------------
+
+def test_gate_telemetry_collapse_and_balance():
+    from deepspeed_tpu.moe.sharded_moe import gate_telemetry, top1_gating
+
+    T, E = 8, 4
+    # collapse: every token wants expert 0, capacity 2 -> 6 of 8 dropped
+    logits = np.full((T, E), -10.0, np.float32)
+    logits[:, 0] = 10.0
+    _aux, _comb, dispatch = top1_gating(jnp.asarray(logits), 1.0, 2)
+    stats = gate_telemetry(dispatch, k=1)
+    assert float(stats["expert_load_entropy"]) == pytest.approx(0.0)
+    assert float(stats["token_drop_fraction"]) == pytest.approx(6 / 8)
+
+    # balanced: tokens round-robin the experts, nothing drops
+    logits = np.full((T, E), -10.0, np.float32)
+    for t in range(T):
+        logits[t, t % E] = 10.0
+    _aux, _comb, dispatch = top1_gating(jnp.asarray(logits), 1.0, 2)
+    stats = gate_telemetry(dispatch, k=1)
+    assert float(stats["expert_load_entropy"]) == pytest.approx(1.0)
+    assert float(stats["token_drop_fraction"]) == pytest.approx(0.0)
+
+
+def test_moe_layer_sows_gate_stats():
+    from deepspeed_tpu.moe.layer import MoE
+
+    moe = MoE(num_experts=4, hidden_size=8, intermediate_size=16, k=2,
+              capacity_factor=0.5, min_capacity=1, dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8, 8)),
+                    jnp.float32)
+    variables = moe.init(jax.random.PRNGKey(0), x)
+    (out, aux), inters = moe.apply({"params": variables["params"]}, x,
+                                   mutable=["intermediates"])
+    (stats,) = inters["intermediates"]["moe_stats"]
+    assert 0.0 <= float(stats["expert_load_entropy"]) <= 1.0
+    assert 0.0 <= float(stats["token_drop_fraction"]) <= 1.0
+    assert float(stats["aux_loss"]) == pytest.approx(float(aux))
+    # plain apply still works (stats dropped, not required)
+    out2, aux2 = moe.apply({"params": variables["params"]}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+    # and the layer (stats compute included) traces under jit — the
+    # entropy normalizer must be host math on the static expert count,
+    # not a float() of a traced value (regression: dryrun C)
+    out3, aux3 = jax.jit(
+        lambda p, x: moe.apply({"params": p}, x))(variables["params"], x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out3),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_loss_aux_channel_publishes_gauges():
+    params = {"w": np.ones((4,), np.float32)}
+
+    def loss_fn(p, batch, rngs=None):
+        loss = jnp.mean(batch["x"]) * jnp.sum(p["w"] ** 2)
+        return loss, {"moe.token_drop_fraction": jnp.asarray(0.25),
+                      "moe.aux_loss": loss * 0.01}
+
+    eng = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, params=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "train_telemetry": {"loss_aux": True},
+                "steps_per_print": 10_000})
+    b = {"x": np.ones((eng.train_batch_size(), 4), np.float32)}
+    eng.train_batch(b)
+    eng.flush_train_telemetry()
+    g = eng.metrics.snapshot()["gauges"]
+    # aux scalars ride the stats pytree out of the compiled (gas-scanned)
+    # step and publish as train.aux.* gauges
+    assert g["train.aux.moe.token_drop_fraction"] == pytest.approx(0.25)
+    assert g["train.aux.moe.aux_loss"] > 0
+
+
+# --- budgets: health telemetry is comms-free ----------------------------------
+
+def test_zero_step_stats_add_zero_collectives():
+    """The SPMD-pass inventory of each budgeted zero-step program is
+    IDENTICAL with and without the stats pytree — the health telemetry
+    adds zero new collective keys, counts, or bytes."""
+    from deepspeed_tpu.tools.dstlint.spmdpass import (
+        SpmdEntry, _zero_entry, trace_spmd_entry_points,
+    )
+
+    for stage in (1, 2, 3):
+        reps = trace_spmd_entry_points([
+            SpmdEntry("with_stats",
+                      lambda s=stage: _zero_entry(s, with_stats=True)),
+            SpmdEntry("without_stats",
+                      lambda s=stage: _zero_entry(s, with_stats=False)),
+        ])
+        for name, rep in reps.items():
+            assert rep.error is None, (stage, name, rep.error)
+        assert reps["with_stats"].inventory() == \
+            reps["without_stats"].inventory(), stage
+
+
+def test_train_step_jaxpr_budgets_pinned():
+    """Fresh traces of the train-step entry points must equal the
+    checked-in equation budgets EXACTLY (the serving zero-traced-ops
+    gate, extended to training): telemetry lives in the stats outputs
+    the budgets already cover — any drift is a program change."""
+    from deepspeed_tpu.tools.dstlint import jaxprpass
+
+    budgets = jaxprpass.load_budgets(
+        os.path.join(_ROOT, "tools", "dstlint", "jaxpr_budgets.json"))
+    assert budgets, "checked-in jaxpr budgets missing"
+    reports = {name: jaxprpass._report(name, fn, avals)
+               for name, fn, avals in jaxprpass._train_step_pieces()}
+    for stage in (1, 2, 3):
+        name = f"train_step/stage{stage}"
+        rep = reports[name]
+        assert rep.error is None, (name, rep.error)
+        assert name in budgets["entries"], name
+        assert rep.eqns == budgets["entries"][name]["eqns"], (
+            f"{name}: traced {rep.eqns} eqns vs budget "
+            f"{budgets['entries'][name]['eqns']} — the compiled train "
+            f"step changed; regen with `bin/dst lint --update-budgets`")
+        for prim in rep.primitives:
+            assert "callback" not in prim and prim != "device_put", prim
+
+
+# --- export parity -------------------------------------------------------------
+
+def test_profiling_collector_and_prometheus_surface():
+    eng, rng = _tiny_engine(extra_cfg={
+        "flops_profiler": {"enabled": True, "profile_step": 1,
+                           "top_modules": 2, "module_depth": 1}})
+    eng.train_batch(_lm_batch(rng, eng.train_batch_size()))
+    snap = eng.train_metrics()
+    prof = snap["profiling"]
+    # the siloed flops/module profiler output now rides the registry
+    assert prof["flops"] > 0 and prof["params"] > 0
+    assert any(k.startswith("module.") and k.endswith(".flops")
+               for k in prof)
+    text = eng.train_metrics(format="prometheus")
+    assert check_exposition(text) == []
+    assert "profiling_flops" in text
+
+
+def test_train_metrics_server_scrape():
+    eng, rng = _tiny_engine()
+    eng.train_batch(_lm_batch(rng, eng.train_batch_size()))
+    port = eng.start_metrics_server(port=0)
+    try:
+        assert port == eng.start_metrics_server()   # idempotent
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert check_exposition(text) == []
+        # the scrape flushed the pending step: health metrics are live
+        assert "train_grad_norm" in text
+    finally:
+        eng.stop_metrics_server()
+    assert eng._metrics_server is None
+
+
+def test_ckpt_span_recorded(tmp_path):
+    eng, rng = _tiny_engine()
+    eng.train_batch(_lm_batch(rng, eng.train_batch_size()))
+    eng.save_checkpoint(str(tmp_path / "ckpt"))
+    eng.load_checkpoint(str(tmp_path / "ckpt"))
+    trace = eng.export_train_trace()
+    ckpts = [e for e in trace["traceEvents"] if e["name"] == "CKPT"]
+    assert {e["args"]["op"] for e in ckpts} == {"save", "load"}
+    snap = eng.metrics.snapshot()
+    assert snap["histograms"]["train.phase.ckpt_s"]["count"] == 2

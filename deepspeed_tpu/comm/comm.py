@@ -53,6 +53,54 @@ comms_logger = CommsLogger()
 _INITIALIZED = False
 _COMM_BACKEND_NAME = "xla-ici"
 
+# dstfleet measured-collective sink: a MetricsRegistry that eager verbs
+# record real per-verb latency histograms (`comm.<verb>.latency_s`) and
+# measured wire-byte counters (`comm.<verb>.bytes`, priced by the SAME
+# collective_cost table the static SPMD budgets use) into. Engines
+# register their registry at init (last registration wins — one process
+# normally drives one engine's collectives; multi-engine processes can
+# re-point it around a call). None = registry recording off.
+_metrics_registry = None
+
+
+def set_metrics_registry(registry) -> None:
+    """Point measured-collective recording at ``registry`` (a dstrace
+    ``MetricsRegistry``; None disconnects)."""
+    global _metrics_registry
+    _metrics_registry = registry
+
+
+def get_metrics_registry():
+    return _metrics_registry
+
+
+def _record_measured(verb: str, latency_s: float, payload_bytes: int,
+                     kind: Optional[str], group_size: Optional[int],
+                     op_label: Optional[str] = None) -> None:
+    """One MEASURED collective: a host-boundary call whose wall time is
+    real (eager helpers, barriers — anything bracketed by
+    ``block_until_ready``). Lands in the comms logger as a TIMED sample
+    and in the registered metrics registry as latency histogram + byte
+    counters. In-graph collectives never reach here — their latency has
+    no host-visible wall time and is accounted as the per-step envelope
+    (``train.comm_fraction``) instead."""
+    from deepspeed_tpu.comm.collective_cost import wire_bytes
+
+    if comms_logger.should_profile(verb):
+        comms_logger.append(op_label or verb, latency_s * 1e3,
+                            payload_bytes, kind=kind,
+                            group_size=group_size)
+    reg = _metrics_registry
+    if reg is None:
+        return
+    reg.observe(f"comm.{verb}.latency_s", latency_s)
+    reg.inc(f"comm.{verb}.count")
+    if payload_bytes:
+        reg.inc(f"comm.{verb}.payload_bytes", payload_bytes)
+        if kind is not None and group_size:
+            reg.inc(f"comm.{verb}.bytes",
+                    wire_bytes(kind, payload_bytes, group_size))
+
 
 def is_initialized() -> bool:
     return _INITIALIZED
@@ -196,7 +244,10 @@ def _profile(op_name: str, tensor, kind: Optional[str] = None,
                 group_size = get_world_size(group)
             except Exception:   # dstlint: disable=no-silent-except (probe: no ambient mesh/axis; payload-only record IS the outcome)
                 group_size = None
-        comms_logger.append(op_name, 0.0, size, kind=kind,
+        # trace-time record: inside jit a collective has no host wall
+        # time — mark the sample UNTIMED (None) instead of appending a
+        # fabricated 0.0 that log_summary would average into latency
+        comms_logger.append(op_name, None, size, kind=kind,
                             group_size=group_size)
 
 
@@ -335,6 +386,7 @@ def send_backward(tensor, group: AxisName = "pipe"):
 
 def barrier(group: Optional[AxisName] = None):
     """Eager synchronization: drain outstanding device work."""
+    t0 = time.perf_counter()
     for d in jax.devices():
         try:
             jax.device_put(0, d).block_until_ready()
@@ -342,6 +394,9 @@ def barrier(group: Optional[AxisName] = None):
             # a device that cannot sync means the barrier did NOT cover
             # it — say so instead of silently weakening the guarantee
             logger.warning(f"barrier: device {d} failed to sync: {e}")
+    # no payload/kind: a barrier moves no data, only waits — the latency
+    # histogram is the signal (fleet collective-wait skew reads it)
+    _record_measured("barrier", time.perf_counter() - t0, 0, None, None)
 
 
 def monitored_barrier(group: Optional[AxisName] = None, timeout=None):
@@ -356,7 +411,7 @@ def eager_all_reduce_over_mesh(x, mesh, axis: str = "data", op: ReduceOp = Reduc
     """Run an all_reduce across a mesh axis on a sharded global array."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     fn = jax.jit(
         shard_map(
             lambda t: all_reduce(t, op, axis),
@@ -367,11 +422,12 @@ def eager_all_reduce_over_mesh(x, mesh, axis: str = "data", op: ReduceOp = Reduc
     )
     out = fn(x)
     out.block_until_ready()
-    if comms_logger.should_profile("all_reduce"):
-        comms_logger.append("all_reduce(eager)", (time.time() - t0) * 1e3,
-                            get_msg_size_from_shape(x.shape, x.dtype),
-                            kind="psum",
-                            group_size=int(mesh.shape.get(axis, 1)))
+    # a REAL measured latency (host-boundary, post-block_until_ready):
+    # timed comms-logger sample + registry histogram/byte counters
+    _record_measured("all_reduce", time.perf_counter() - t0,
+                     get_msg_size_from_shape(x.shape, x.dtype),
+                     "psum", int(mesh.shape.get(axis, 1)),
+                     op_label="all_reduce(eager)")
     return out
 
 

@@ -40,9 +40,12 @@ class CommsLogger:
         self.debug = debug
         self.prof_ops = prof_ops or []
         # op name -> msg size -> [count, total_latency_ms, total_payload
-        # bytes, total_wire_bytes] (wire = per-device interconnect bytes
-        # per the shared collective_cost table; 0 when the op kind or
-        # group size was unknown at record time)
+        # bytes, total_wire_bytes, timed_count] (wire = per-device
+        # interconnect bytes per the shared collective_cost table; 0
+        # when the op kind or group size was unknown at record time;
+        # timed_count counts only samples with a REAL measured latency —
+        # trace-time records are untimed and must not average fabricated
+        # zeros into the latency stats)
         self.comms_dict: Dict[str, Dict[int, List[float]]] = {}
 
     def configure(self, comms_config) -> None:
@@ -57,28 +60,38 @@ class CommsLogger:
             return False
         return self.prof_all or op_name in self.prof_ops
 
-    def append(self, op_name: str, latency_ms: float, msg_size: int,
-               kind: Optional[str] = None,
+    def append(self, op_name: str, latency_ms: Optional[float],
+               msg_size: int, kind: Optional[str] = None,
                group_size: Optional[int] = None) -> None:
         """Record one collective. ``kind``/``group_size`` (when the verb
         knows them) price the per-device wire bytes via the shared
         :func:`collective_cost.wire_bytes` table — the SAME arithmetic
         the dstlint SPMD pass applies to static traces, so runtime and
-        static accounting cannot disagree."""
+        static accounting cannot disagree.
+
+        ``latency_ms=None`` marks an UNTIMED sample — a trace-time
+        record (inside jit a collective has no host wall time). Untimed
+        samples count calls and bytes but are excluded from the latency
+        average, so :meth:`log_summary` never dilutes real measurements
+        with fabricated zeros."""
         if op_name not in self.comms_dict:
             self.comms_dict[op_name] = {}
         sizes = self.comms_dict[op_name]
         if msg_size not in sizes:
-            sizes[msg_size] = [0, 0.0, 0.0, 0.0]
+            sizes[msg_size] = [0, 0.0, 0.0, 0.0, 0]
         rec = sizes[msg_size]
         rec[0] += 1
-        rec[1] += latency_ms
+        if latency_ms is not None:
+            rec[1] += latency_ms
+            rec[4] += 1
         rec[2] += msg_size
         if kind is not None and group_size is not None:
             rec[3] += wire_bytes(kind, msg_size, group_size)
         if self.verbose:
+            shown = ("traced" if latency_ms is None
+                     else f"{latency_ms:.2f}")
             logger.info(
-                f"comm op: {op_name} | time (ms): {latency_ms:.2f} | "
+                f"comm op: {op_name} | time (ms): {shown} | "
                 f"msg size: {convert_size(msg_size)}"
             )
 
@@ -119,15 +132,18 @@ class CommsLogger:
 
     def log_summary(self) -> str:
         lines = [f"{'Op':<24}{'Message Size':<16}{'Count':<8}"
-                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<18}"
-                 f"{'Wire Bytes':<14}"]
+                 f"{'Timed':<7}{'Total Latency(ms)':<20}"
+                 f"{'Avg Latency(ms)':<18}{'Wire Bytes':<14}"]
         for op, sizes in sorted(self.comms_dict.items()):
             for msg_size, rec in sorted(sizes.items()):
                 count, total_ms, wire = rec[0], rec[1], rec[3]
-                avg = total_ms / count if count else 0.0
+                timed = rec[4] if len(rec) > 4 else count
+                # average over TIMED samples only — trace-time records
+                # carry no wall time and must not drag the average to 0
+                avg = f"{total_ms / timed:.3f}" if timed else "-"
                 lines.append(
                     f"{op:<24}{convert_size(msg_size):<16}{count:<8}"
-                    f"{total_ms:<20.2f}{avg:<18.3f}"
+                    f"{timed:<7}{total_ms:<20.2f}{avg:<18}"
                     f"{convert_size(wire):<14}"
                 )
         summary = "\n".join(lines)

@@ -182,6 +182,28 @@ class ServeConfig(DeepSpeedConfigModel):
     # (observability/efficiency.py; DST_PEAK_TFLOPS env also accepted) —
     # pin it when your part's spec differs or for cross-run comparability.
     peak_tflops: Optional[float] = None
+    # --- dstfleet + SLO/goodput (observability/fleet.py, slo.py,
+    # docs/OBSERVABILITY.md "Fleet" / "SLOs") ------------------------------
+    # declarative serving objectives: a dict with any of ttft_p95_s /
+    # tpot_p95_s (seconds), availability (fraction in (0,1)), windows_s
+    # (rolling windows, default [300, 3600]), breach_burn_rate (default
+    # 1.0), min_interval_s. When set, the scheduler ticks an SLOTracker
+    # at chunk boundaries: serve.goodput + serve.slo.<signal>.
+    # burn_rate.<window>s gauges, SLO_BREACH trace instants, and the
+    # serve.slo snapshot section. Unknown keys fail fast. None = only
+    # the always-on goodput gauge (delivered/sampled tokens).
+    slo: Optional[Dict[str, Any]] = None
+    # fleet snapshot-exchange directory (shared filesystem): when set,
+    # serve_metrics(fleet=True) (and every Prometheus scrape with
+    # fleet_publish on) atomically writes this replica's registry as
+    # rank<fleet_rank>.json there and merges all rank files into the
+    # labeled fleet view. The transport every deployment shape has —
+    # multi-host TPU jobs, data-parallel serve replicas, the virtual-CPU
+    # subprocess mesh — with zero collectives added to compiled code.
+    fleet_dir: Optional[str] = None
+    # this replica's rank in the fleet exchange; -1 = resolve from the
+    # DS_TPU_PROCESS_ID env (the launcher contract) else jax.process_index()
+    fleet_rank: int = -1
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -952,6 +952,14 @@ class InferenceEngine:
                                         self._efficiency_section)
         # optional stdlib Prometheus scrape endpoint (serve.metrics_port)
         self._metrics_server = None
+        # dstfleet SLO tracker (serve.slo) — minted lazily, persists
+        # across serve() calls so rolling burn-rate windows are real
+        self._slo_tracker = None
+        # measured-collective sink: eager comm verbs (barriers, eager
+        # reductions) record comm.<verb>.latency_s / .bytes here
+        from deepspeed_tpu import comm as _dist
+
+        _dist.set_metrics_registry(self.metrics)
         log_dist(f"InferenceEngine ready: tp={tp}, dtype={self._config.dtype}"
                  f"{', int8 weights' if self._quantized else ''}", ranks=[0])
 
@@ -1521,6 +1529,10 @@ class InferenceEngine:
             if self.tracer is None or self.tracer.capacity != cap:
                 self.tracer = RequestTracer(capacity=cap)
         tracer = self.tracer if tr_on else None
+        # SLO/goodput tracker (serve.slo config): one per engine so its
+        # rolling windows span serve() calls; the scheduler ticks it at
+        # chunk boundaries, the serve.slo collector refreshes at scrape
+        slo = self._get_slo_tracker(tracer)
 
         def rejected_completion(rid, prompt, reason):
             t = time.time()
@@ -1654,7 +1666,8 @@ class InferenceEngine:
             audit_every=(serve_cfg.audit_every if audit_every is None
                          else int(audit_every)),
             fault_injector=fault_injector,
-            host_tier=host_tier, metrics=self.metrics, tracer=tracer)
+            host_tier=host_tier, metrics=self.metrics, tracer=tracer,
+            slo=slo)
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log
@@ -1729,8 +1742,58 @@ class InferenceEngine:
         sched = getattr(self, "last_serve_scheduler", None)
         return bool(sched is not None and sched.cancel(rid))
 
-    # --- observability (dstrace/dstprof: docs/OBSERVABILITY.md) ---------------
-    def serve_metrics(self, format: str = "dict"):
+    # --- observability (dstrace/dstprof/dstfleet: docs/OBSERVABILITY.md) ------
+    def _get_slo_tracker(self, tracer=None):
+        """Engine-lifetime SLOTracker from the ``serve.slo`` config
+        (None when unconfigured). Registered as the ``serve.slo``
+        snapshot collector so scrapes refresh the rolling windows even
+        between chunks."""
+        slo_cfg = getattr(getattr(self._config, "serve"), "slo", None)
+        if not slo_cfg:
+            return None
+        if self._slo_tracker is None:
+            from deepspeed_tpu.observability import SLOConfig, SLOTracker
+
+            self._slo_tracker = SLOTracker(
+                self.metrics, SLOConfig.from_dict(dict(slo_cfg)),
+                tracer=tracer)
+            self.metrics.register_collector("serve.slo",
+                                            self._slo_tracker.section)
+        if tracer is not None:
+            self._slo_tracker.tracer = tracer
+        return self._slo_tracker
+
+    def _fleet_rank(self) -> int:
+        """This replica's rank in the fleet snapshot exchange
+        (``serve.fleet_rank`` → DS_TPU_PROCESS_ID → process index; the
+        chain lives in ONE place so serve and train replicas sharing a
+        fleet_dir cannot drift)."""
+        from deepspeed_tpu.observability.fleet import resolve_fleet_rank
+
+        return resolve_fleet_rank(
+            int(getattr(getattr(self._config, "serve"), "fleet_rank",
+                        -1)))
+
+    def fleet_metrics(self):
+        """Publish this replica's registry into ``serve.fleet_dir`` and
+        merge every rank snapshot there into one fleet-level
+        :class:`~deepspeed_tpu.observability.MetricsRegistry` (counters
+        summed, gauges per-host labeled + min/mean/max, histograms
+        merged bucket-wise losslessly)."""
+        serve_cfg = getattr(self._config, "serve")
+        if not serve_cfg.fleet_dir:
+            raise ValueError(
+                "fleet metrics need serve.fleet_dir — the shared "
+                "directory ranks exchange rank<k>.json snapshots in")
+        from deepspeed_tpu.observability import (
+            merge_fleet_dir, write_rank_snapshot,
+        )
+
+        write_rank_snapshot(serve_cfg.fleet_dir, self._fleet_rank(),
+                            self.metrics)
+        return merge_fleet_dir(serve_cfg.fleet_dir)
+
+    def serve_metrics(self, format: str = "dict", fleet: bool = False):
         """The engine's metrics registry, in one of two shapes:
 
         - ``format="dict"`` (default): the plain-dict ``snapshot()`` —
@@ -1747,24 +1810,40 @@ class InferenceEngine:
         - ``format="prometheus"``: the same registry as exposition
           text (``observability/promexport.py`` — full
           ``_bucket/_sum/_count`` histogram conventions), the payload
-          the ``serve.metrics_port`` endpoint scrapes."""
+          the ``serve.metrics_port`` endpoint scrapes.
+
+        ``fleet=True`` (requires ``serve.fleet_dir``) publishes this
+        replica's snapshot into the fleet exchange and renders the
+        MERGED fleet view instead — counters summed across hosts,
+        gauges as per-host ``host``-labeled series + min/mean/max,
+        histograms merged bucket-wise losslessly."""
+        registry = self.fleet_metrics() if fleet else self.metrics
         if format == "dict":
-            return self.metrics.snapshot()
+            return registry.snapshot()
         if format == "prometheus":
             from deepspeed_tpu.observability import prometheus_text
 
-            return prometheus_text(self.metrics)
+            return prometheus_text(registry)
         raise ValueError(
             f"serve_metrics(format={format!r}): expected 'dict' or "
             f"'prometheus'")
 
-    def start_metrics_server(self, port: Optional[int] = None) -> int:
+    def start_metrics_server(self, port: Optional[int] = None,
+                             extra_registries: Optional[dict] = None
+                             ) -> int:
         """Start the stdlib HTTP scrape endpoint (``/metrics``
         Prometheus text, ``/metrics.json`` raw snapshot) on
         ``port`` (default ``serve.metrics_port``; 0 binds an ephemeral
         port). Idempotent; returns the bound port. The registry and
         exporter renders from per-histogram snapshots and the tracer
-        is lock-guarded, so scrapes are safe mid-stream."""
+        is lock-guarded, so scrapes are safe mid-stream.
+
+        ``extra_registries`` ({section: registry-or-callable}) merges
+        additional registries into the SAME ``/metrics`` exposition —
+        one port for a process running a train engine next to this one
+        (``{"train": train_engine.metrics}``); metric names must not
+        collide (the multi-registry exporter disambiguates loudly if
+        they do, and tier-1 pins the two engines' registries disjoint)."""
         if self._metrics_server is not None:
             return self._metrics_server.port
         from deepspeed_tpu.observability import (
@@ -1773,9 +1852,15 @@ class InferenceEngine:
 
         if port is None:
             port = int(getattr(self._config, "serve").metrics_port)
-        self._metrics_server = MetricsHTTPServer(
-            lambda: prometheus_text(self.metrics),
-            json_fn=self.metrics.snapshot, port=port)
+        if extra_registries:
+            named = dict(extra_registries)
+            named["serve"] = self.metrics
+            self._metrics_server = MetricsHTTPServer.for_registries(
+                named, port=port)
+        else:
+            self._metrics_server = MetricsHTTPServer(
+                lambda: prometheus_text(self.metrics),
+                json_fn=self.metrics.snapshot, port=port)
         bound = self._metrics_server.start()
         log_dist(f"dstprof metrics endpoint on :{bound}/metrics",
                  ranks=[0])
@@ -1851,6 +1936,11 @@ class InferenceEngine:
         self.metrics.reset()
         if self.tracer is not None:
             self.tracer.clear()
+        if self._slo_tracker is not None:
+            # the tracker's rolling-window marks are cumulative-counter
+            # readings; after a registry reset they would subtract a
+            # pre-reset baseline from post-reset counters
+            self._slo_tracker.reset()
 
     def _get_serve_executor(self, num_slots, block_size, num_blocks,
                             decode_chunk, attn_kernel="reference"):

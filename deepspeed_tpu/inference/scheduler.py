@@ -287,7 +287,7 @@ class ContinuousBatchingScheduler:
                  queue_timeout_s: Optional[float] = None,
                  audit_every: int = 64,
                  fault_injector: Optional[FaultInjector] = None,
-                 host_tier=None, metrics=None, tracer=None):
+                 host_tier=None, metrics=None, tracer=None, slo=None):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -384,6 +384,10 @@ class ContinuousBatchingScheduler:
         # sits at an existing host-call boundary, never inside jit.
         self.metrics = metrics
         self.tracer = tracer
+        # slo: an observability.slo.SLOTracker ticked at chunk
+        # boundaries (rolling-window burn rates + goodput); optional,
+        # host-side, rate-limited internally
+        self.slo = slo
         # monotonic submit stamps for QUEUED spans (wall-clock
         # _submit_times stays the Completion API timebase)
         self._submit_mono: Dict[Any, float] = {}
@@ -411,6 +415,17 @@ class ContinuousBatchingScheduler:
             n = int(comp.tokens.size)
             m.inc(f"serve.completions.{comp.status}")
             m.inc("serve.tokens_generated", n)   # DELIVERED tokens
+            if comp.status == COMPLETED:
+                # goodput numerator: tokens delivered WITHIN deadline —
+                # deadline enforcement resolves late streams TIMED_OUT,
+                # so COMPLETED is exactly the in-deadline set. Dividing
+                # by serve.tokens_sampled (work done, incl. preemption
+                # regeneration) makes restart/timeout waste visible.
+                m.inc("serve.tokens_delivered", n)
+            sampled = m.counter("serve.tokens_sampled")
+            if sampled:
+                m.set_gauge("serve.goodput",
+                            m.counter("serve.tokens_delivered") / sampled)
             m.observe("serve.latency_s",
                       max(0.0, comp.t_finish - comp.t_submit))
             if n > 0:
@@ -1295,6 +1310,10 @@ class ContinuousBatchingScheduler:
             m.set_gauge("serve.restoring_slots", len(self._restores))
             m.set_gauge("serve.queued", len(self.queue))
             m.set_gauge("serve.live_tokens", int(self.seq_lens.sum()))
+        if self.slo is not None:
+            # burn-rate/goodput refresh (rate-limited inside the
+            # tracker; a clock read per chunk when nothing to do)
+            self.slo.tick()
         self._trace_chaos()
         if self.audit_every > 0 and self._step_idx % self.audit_every == 0:
             try:

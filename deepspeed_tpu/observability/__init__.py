@@ -19,7 +19,14 @@ trace-event JSON), extended by the dstprof resource layer:
   (grad norms / non-finite counts / MoE gate aux — comms-free,
   budget-pinned), lag-one host publication with overflow escalation,
   training step lanes + 1F1B microbatch lane reconstruction, and the
-  schedule-efficiency arithmetic.
+  schedule-efficiency arithmetic;
+- ``fleet.py`` — dstfleet: cross-process aggregation (atomic
+  ``rank<k>.json`` snapshot exchange over a shared ``fleet_dir``,
+  lossless ``MetricsRegistry.merge``) + per-host step-time /
+  collective-wait straggler detection;
+- ``slo.py`` — declarative serving SLOs (TTFT/TPOT p95, availability)
+  with rolling-window burn rates and goodput accounting over the
+  terminal-funnel telemetry.
 
 Entry points:
 
@@ -52,13 +59,19 @@ from deepspeed_tpu.observability.memory import (
 )
 from deepspeed_tpu.observability.efficiency import mfu, peak_flops_per_device
 from deepspeed_tpu.observability.promexport import (
-    MetricsHTTPServer, check_exposition, prometheus_text,
+    MetricsHTTPServer, check_exposition, multi_prometheus_text,
+    prometheus_text,
 )
 from deepspeed_tpu.observability.profile import capture_profile
 from deepspeed_tpu.observability.train import (
     make_train_tracer, pipeline_lane_spans, publish_train_stats,
     schedule_efficiency, stage_tid, train_health_stats,
 )
+from deepspeed_tpu.observability.fleet import (
+    FleetMonitor, StragglerDetector, merge_fleet_dir,
+    read_fleet_snapshots, write_rank_snapshot,
+)
+from deepspeed_tpu.observability.slo import SLOConfig, SLOTracker
 
 __all__ = ["Histogram", "MetricsRegistry", "default_registry",
            "RequestTracer", "SCHEDULER_TID", "slot_tid",
@@ -66,8 +79,12 @@ __all__ = ["Histogram", "MetricsRegistry", "default_registry",
            "AOTProgram", "CompileWatcher",
            "device_memory_section", "tree_device_bytes",
            "mfu", "peak_flops_per_device",
-           "MetricsHTTPServer", "check_exposition", "prometheus_text",
+           "MetricsHTTPServer", "check_exposition",
+           "multi_prometheus_text", "prometheus_text",
            "capture_profile",
            "make_train_tracer", "pipeline_lane_spans",
            "publish_train_stats", "schedule_efficiency", "stage_tid",
-           "train_health_stats"]
+           "train_health_stats",
+           "FleetMonitor", "StragglerDetector", "merge_fleet_dir",
+           "read_fleet_snapshots", "write_rank_snapshot",
+           "SLOConfig", "SLOTracker"]

@@ -1,0 +1,346 @@
+"""dstfleet — cross-process metric aggregation, snapshot exchange and
+straggler detection.
+
+dstrace/dstprof/dsttrain made every process deeply observable, but each
+``MetricsRegistry`` is strictly process-local while the repo already
+runs real multi-process meshes (``bench.py --multichip``: 8 ranks) and
+the ROADMAP's multi-replica serving / RLHF items are fleet-shaped. This
+module is the fleet view:
+
+- **Snapshot exchange** is file-based and transport-agnostic: every
+  rank atomically writes ``rank<k>.json`` (a
+  ``MetricsRegistry.fleet_snapshot`` — plain snapshot plus raw
+  histogram bucket states) into a shared ``fleet_dir`` at its monitor
+  drain boundary; rank 0 merges whatever rank files exist. A shared
+  filesystem is the one primitive every deployment shape has — the
+  virtual-CPU subprocess mesh, multi-host TPU pods (GCS fuse / NFS),
+  and future data-parallel serve replicas alike — and the exchange
+  never adds a collective to any compiled program.
+- **Merge semantics** live in :meth:`MetricsRegistry.merge` (counters
+  sum; gauges → per-host labeled series + min/mean/max; histograms
+  merge bucket-wise losslessly because every host uses the same fixed
+  log-spaced bucket edges).
+- **Straggler detection**: per-aggregation step-time / collective-wait
+  skew gauges (``fleet.step_time.skew``, slowest-host id) with ONE
+  structured warning + tracer instant when one host exceeds a
+  configurable multiple of the fleet median for N consecutive windows
+  — the runtime complement of the static pipeline-bubble gauge.
+
+Everything here is host-side file/dict arithmetic: no jax import, no
+device sync, nothing that could sit inside a trace.
+"""
+
+import json
+import math
+import os
+import re
+import statistics
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.observability.metrics import MetricsRegistry
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["write_rank_snapshot", "read_fleet_snapshots",
+           "merge_fleet_dir", "resolve_fleet_rank", "StragglerDetector",
+           "FleetMonitor", "host_step_time", "host_collective_wait"]
+
+_RANK_FILE = re.compile(r"^rank(\d+)\.json$")
+
+
+def resolve_fleet_rank(config_rank: int = -1) -> int:
+    """THE rank-resolution chain, shared by both engines so serve and
+    train replicas in one fleet_dir can never disagree on it: an
+    explicit config rank (>= 0) wins, else the launcher's
+    ``DS_TPU_PROCESS_ID`` env, else the jax process index (imported
+    lazily — the only jax touch in this module, and only when neither
+    explicit source resolves)."""
+    if config_rank is not None and int(config_rank) >= 0:
+        return int(config_rank)
+    env = os.environ.get("DS_TPU_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    import jax
+
+    return int(jax.process_index())
+
+
+def write_rank_snapshot(fleet_dir: str, rank: int, registry,
+                        host: Optional[str] = None) -> str:
+    """Atomically publish this rank's ``fleet_snapshot`` as
+    ``<fleet_dir>/rank<rank>.json`` (write to a tempfile in the same
+    directory, then ``os.replace`` — readers can never observe a
+    half-written file). ``registry`` is a :class:`MetricsRegistry` or an
+    already-built snapshot dict. Returns the file path."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    host = host if host is not None else f"rank{int(rank)}"
+    if isinstance(registry, MetricsRegistry):
+        snap = registry.fleet_snapshot(host=host)
+    else:
+        snap = dict(registry)
+        snap.setdefault("host", host)
+    path = os.path.join(fleet_dir, f"rank{int(rank)}.json")
+    fd, tmp = tempfile.mkstemp(prefix=f".rank{int(rank)}.",
+                               suffix=".tmp", dir=fleet_dir)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(snap, f, default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave tempfile litter for the next merge to trip on
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def read_fleet_snapshots(fleet_dir: str) -> Dict[str, dict]:
+    """Read every ``rank<k>.json`` in ``fleet_dir`` → ``{host:
+    snapshot}``, ordered by rank. A file that fails to parse is skipped
+    with a warning (a rank mid-crash must not take the fleet view down)
+    — the atomic-rename publish makes this an abnormal case, not a
+    routine race."""
+    out: Dict[str, dict] = {}
+    if not os.path.isdir(fleet_dir):
+        return out
+    ranks: List[Tuple[int, str]] = []
+    for name in os.listdir(fleet_dir):
+        m = _RANK_FILE.match(name)
+        if m:
+            ranks.append((int(m.group(1)), name))
+    for rank, name in sorted(ranks):
+        path = os.path.join(fleet_dir, name)
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning(f"fleet: skipping unreadable snapshot "
+                           f"{path}: {e}")
+            continue
+        out[str(snap.get("host", f"rank{rank}"))] = snap
+    return out
+
+
+def merge_fleet_dir(fleet_dir: str) -> MetricsRegistry:
+    """One-call merge of every rank snapshot in ``fleet_dir``."""
+    return MetricsRegistry.merge(read_fleet_snapshots(fleet_dir))
+
+
+# --- per-host signal extraction -----------------------------------------------
+
+#: gauge names consulted (in order) for a host's step time
+STEP_TIME_GAUGES = ("train.step_time_s",)
+#: histogram fallbacks: (name, use-mean) — serving replicas have no
+#: step gauge but their decode-chunk histogram mean is the same signal
+STEP_TIME_HISTS = ("train.timer.train_batch_s", "serve.decode_chunk_s")
+
+
+def host_step_time(snap: dict) -> Optional[float]:
+    """A host's representative step seconds from its snapshot: the
+    ``train.step_time_s`` gauge when present, else the mean of its
+    step/decode-chunk histogram. ``None`` when the host has recorded
+    neither (it then simply doesn't vote in the skew window)."""
+    gauges = snap.get("gauges", {})
+    for name in STEP_TIME_GAUGES:
+        v = gauges.get(name)
+        if v:
+            return float(v)
+    hists = snap.get("histogram_state", {})
+    for name in STEP_TIME_HISTS:
+        st = hists.get(name)
+        if st and st.get("count"):
+            return float(st["sum"]) / float(st["count"])
+    # merged-once snapshots carry summaries only
+    for name in STEP_TIME_HISTS:
+        st = snap.get("histograms", {}).get(name)
+        if st and st.get("count"):
+            return float(st["sum"]) / float(st["count"])
+    return None
+
+
+def host_collective_wait(snap: dict) -> Optional[float]:
+    """Total measured collective-wait seconds a host has accumulated
+    (the ``comm.<verb>.latency_s`` histogram sums the measured-comm
+    layer records at host boundaries). ``None`` when nothing measured."""
+    total, seen = 0.0, False
+    for src in (snap.get("histogram_state", {}),
+                snap.get("histograms", {})):
+        for name, st in src.items():
+            if name.startswith("comm.") and name.endswith(".latency_s") \
+                    and st.get("count"):
+                total += float(st["sum"])
+                seen = True
+        if seen:
+            break
+    return total if seen else None
+
+
+def _host_ordinal(host: str, fallback: int) -> int:
+    """Numeric id for a host name (gauges hold floats): the trailing
+    digits of ``rank7``/``host-3`` style names, else ``fallback``."""
+    m = re.search(r"(\d+)$", str(host))
+    return int(m.group(1)) if m else int(fallback)
+
+
+def _skew(per_host: Dict[str, float]) -> Tuple[float, str]:
+    """(slowest/median ratio, slowest host). Median of one host is
+    itself → skew 1.0."""
+    med = statistics.median(per_host.values())
+    slowest = max(per_host, key=lambda h: per_host[h])
+    if med <= 0:
+        return 1.0, slowest
+    return per_host[slowest] / med, slowest
+
+
+class StragglerDetector:
+    """N-consecutive-window skew detector over per-host scalars.
+
+    :meth:`update` takes one window's ``{host: value}`` (step seconds,
+    collective wait — any "bigger is slower" scalar), publishes
+    ``<prefix>.skew`` / ``<prefix>.slowest_host`` gauges, and fires
+    exactly ONE structured warning (+ ``STRAGGLER`` tracer instant,
+    ``fleet.straggler_warnings`` counter) when the same host exceeds
+    ``threshold`` × the fleet median for ``windows`` consecutive
+    updates. The episode re-arms only after that host drops back under
+    the threshold — a persistent straggler is one warning, not a log
+    flood."""
+
+    def __init__(self, threshold: float = 1.5, windows: int = 3, *,
+                 prefix: str = "fleet.step_time",
+                 metrics: Optional[MetricsRegistry] = None,
+                 tracer=None):
+        if threshold <= 1.0:
+            raise ValueError(f"straggler threshold must be > 1.0, "
+                             f"got {threshold}")
+        self.threshold = float(threshold)
+        self.windows = max(1, int(windows))
+        self.prefix = prefix
+        self.metrics = metrics
+        self.tracer = tracer
+        self._suspect: Optional[str] = None
+        self._consecutive = 0
+        self._fired = False
+        self.warnings: List[dict] = []
+
+    def update(self, per_host: Dict[str, float]) -> Optional[dict]:
+        per_host = {h: float(v) for h, v in per_host.items()
+                    if v is not None and math.isfinite(float(v))}
+        if not per_host:
+            return None
+        skew, slowest = _skew(per_host)
+        hosts = sorted(per_host)
+        if self.metrics is not None:
+            self.metrics.set_gauge(f"{self.prefix}.skew", skew)
+            self.metrics.set_gauge(
+                f"{self.prefix}.slowest_host",
+                _host_ordinal(slowest, hosts.index(slowest)))
+        over = skew > self.threshold
+        if not over or (self._suspect is not None
+                        and slowest != self._suspect):
+            # clean window, or the suspect changed: restart the episode
+            self._suspect = slowest if over else None
+            self._consecutive = 1 if over else 0
+            self._fired = False
+            return None
+        self._suspect = slowest
+        self._consecutive += 1
+        if self._consecutive < self.windows or self._fired:
+            return None
+        self._fired = True
+        warning = {
+            "event": "straggler",
+            "signal": self.prefix,
+            "host": slowest,
+            "skew": skew,
+            "threshold": self.threshold,
+            "windows": self._consecutive,
+            "value": per_host[slowest],
+            "fleet_median": statistics.median(per_host.values()),
+            "hosts": len(per_host),
+        }
+        self.warnings.append(warning)
+        logger.warning(f"dstfleet straggler: host {slowest} at "
+                       f"{skew:.2f}x the fleet median "
+                       f"({per_host[slowest]:.4f}s vs "
+                       f"{warning['fleet_median']:.4f}s) for "
+                       f"{self._consecutive} consecutive windows "
+                       f"[{json.dumps(warning, default=str)}]")
+        if self.metrics is not None:
+            self.metrics.inc("fleet.straggler_warnings")
+        if self.tracer is not None:
+            self.tracer.instant("STRAGGLER", cat="fleet", **warning)
+        return warning
+
+
+class FleetMonitor:
+    """One process's handle on the fleet exchange.
+
+    Every rank calls :meth:`publish` at its drain boundary (the train
+    engine wires this into the ``steps_per_print`` monitor drain; the
+    serving engine into ``serve_metrics(fleet=True)`` scrapes); rank 0
+    additionally calls :meth:`aggregate`, which merges all rank files,
+    runs straggler detection over per-host step time AND collective
+    wait, publishes the ``fleet.*`` gauges into the LOCAL registry (so
+    rank 0's ordinary scrape/monitor pipeline carries the fleet view),
+    and returns the merged registry."""
+
+    def __init__(self, fleet_dir: str, rank: int, *,
+                 metrics: MetricsRegistry,
+                 host: Optional[str] = None,
+                 tracer=None,
+                 straggler_threshold: float = 1.5,
+                 straggler_windows: int = 3):
+        self.fleet_dir = str(fleet_dir)
+        self.rank = int(rank)
+        self.metrics = metrics
+        self.host = host if host is not None else f"rank{self.rank}"
+        self.step_detector = StragglerDetector(
+            straggler_threshold, straggler_windows,
+            prefix="fleet.step_time", metrics=metrics, tracer=tracer)
+        self.wait_detector = StragglerDetector(
+            straggler_threshold, straggler_windows,
+            prefix="fleet.collective_wait", metrics=metrics,
+            tracer=tracer)
+        self.last_merged: Optional[MetricsRegistry] = None
+
+    def publish(self) -> str:
+        return write_rank_snapshot(self.fleet_dir, self.rank,
+                                   self.metrics, host=self.host)
+
+    def aggregate(self) -> MetricsRegistry:
+        snaps = read_fleet_snapshots(self.fleet_dir)
+        merged = MetricsRegistry.merge(snaps)
+        steps = {h: host_step_time(s) for h, s in snaps.items()}
+        steps = {h: v for h, v in steps.items() if v is not None}
+        if steps:
+            self.step_detector.update(steps)
+        waits = {h: host_collective_wait(s) for h, s in snaps.items()}
+        waits = {h: v for h, v in waits.items() if v is not None}
+        if waits:
+            self.wait_detector.update(waits)
+        # the fleet gauges land on the local registry (above); copy them
+        # onto the merged view too so a fleet exposition is self-
+        # contained
+        local_gauges = self.metrics.gauges()
+        for name in ("fleet.step_time.skew", "fleet.step_time.slowest_host",
+                     "fleet.collective_wait.skew",
+                     "fleet.collective_wait.slowest_host"):
+            if name in local_gauges:
+                merged.set_gauge(name, local_gauges[name])
+        # only rank 0 runs the detectors, so the TRUE fleet warning
+        # count is the local counter; the merge may already carry the
+        # value rank 0 PUBLISHED last window — top up the difference
+        # instead of adding the whole counter again (double-count)
+        local_warn = self.metrics.counter("fleet.straggler_warnings")
+        gap = local_warn - merged.counter("fleet.straggler_warnings")
+        if gap > 0:
+            merged.inc("fleet.straggler_warnings", gap)
+        self.last_merged = merged
+        return merged
+
+    def publish_and_aggregate(self) -> Optional[MetricsRegistry]:
+        """The per-drain call: every rank publishes; rank 0 merges."""
+        self.publish()
+        if self.rank == 0:
+            return self.aggregate()
+        return None

@@ -141,6 +141,67 @@ class Histogram:
         """Raw bucket counts (tests: bucket math, fixed memory)."""
         return list(self._counts)
 
+    # --- fleet merge (observability/fleet.py) ---------------------------------
+    def state(self) -> Dict:
+        """JSON-serializable full state — bucket counts plus the scalar
+        accumulators. Because every host constructs histograms from the
+        same (lo, hi, buckets_per_decade) defaults, bucket edges are
+        identical across hosts and :meth:`merge_state` is LOSSLESS: the
+        merged histogram is byte-equal to one that observed the union of
+        samples. ``min``/``max`` serialize as ``None`` when empty (JSON
+        has no infinities)."""
+        return {
+            "lo": self.lo,
+            "hi": self.hi,
+            "counts": list(self._counts),
+            "count": self.count,
+            "sum": self.sum,
+            "min": None if self.count == 0 else self.min,
+            "max": None if self.count == 0 else self.max,
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict) -> "Histogram":
+        """Reconstruct a histogram from :meth:`state` output."""
+        counts = list(state["counts"])
+        # n bounded buckets were derived from buckets_per_decade; rebuild
+        # with the exact bucket count instead of re-deriving from the
+        # decade density so an odd persisted shape round-trips verbatim
+        h = cls.__new__(cls)
+        h.lo = float(state["lo"])
+        h.hi = float(state["hi"])
+        n = len(counts) - 1
+        h.ratio = (h.hi / h.lo) ** (1.0 / max(n, 1))
+        h._log_lo = math.log(h.lo)
+        h._log_ratio = math.log(h.ratio)
+        h._counts = counts
+        h.count = int(state["count"])
+        h.sum = float(state["sum"])
+        h.min = math.inf if state["min"] is None else float(state["min"])
+        h.max = -math.inf if state["max"] is None else float(state["max"])
+        return h
+
+    def merge_state(self, state: Dict) -> None:
+        """Bucket-wise add another histogram's :meth:`state`. Exact by
+        construction (same edges on both sides — enforced), including
+        the min/max clamp carry-over percentile estimation depends on.
+        Raises ``ValueError`` on mismatched bucket layouts: silently
+        misaligning buckets would corrupt every percentile downstream."""
+        if (float(state["lo"]) != self.lo or float(state["hi"]) != self.hi
+                or len(state["counts"]) != len(self._counts)):
+            raise ValueError(
+                f"histogram merge layout mismatch: "
+                f"({state['lo']}, {state['hi']}, {len(state['counts'])}) "
+                f"vs ({self.lo}, {self.hi}, {len(self._counts)})")
+        for i, c in enumerate(state["counts"]):
+            self._counts[i] += int(c)
+        self.count += int(state["count"])
+        self.sum += float(state["sum"])
+        if state["min"] is not None:
+            self.min = min(self.min, float(state["min"]))
+        if state["max"] is not None:
+            self.max = max(self.max, float(state["max"]))
+
 
 class MetricsRegistry:
     """Named counters/gauges/histograms + pull collectors, one snapshot.
@@ -156,6 +217,10 @@ class MetricsRegistry:
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
         self._collectors: Dict[str, Callable[[], dict]] = {}
+        # per-host labeled gauge series (fleet merge output): name ->
+        # {host: value}. Empty on ordinary per-process registries; the
+        # Prometheus exporter renders these with a `host` label.
+        self._labeled: Dict[str, Dict[str, float]] = {}
 
     # --- counters -------------------------------------------------------------
     def inc(self, name: str, n: float = 1) -> None:
@@ -169,6 +234,19 @@ class MetricsRegistry:
     # --- gauges ---------------------------------------------------------------
     def set_gauge(self, name: str, v: float) -> None:
         self._gauges[name] = float(v)
+
+    def set_labeled_gauge(self, name: str, host: str, v: float) -> None:
+        """Per-host gauge series (one sample per host under one metric
+        name — the fleet-merge output shape)."""
+        try:
+            self._labeled[name][str(host)] = float(v)
+        except KeyError:
+            with self._lock:
+                self._labeled.setdefault(name, {})[str(host)] = float(v)
+
+    def labeled_gauges(self) -> Dict[str, Dict[str, float]]:
+        """Live per-host series by name (exporter read side)."""
+        return {k: dict(v) for k, v in self._labeled.items()}
 
     # --- histograms -----------------------------------------------------------
     def histogram(self, name: str, lo: float = 1e-6, hi: float = 1e5,
@@ -200,9 +278,17 @@ class MetricsRegistry:
         """Current value of a counter (absent -> ``default``)."""
         return self._counters.get(name, default)
 
+    def counters(self) -> Dict[str, float]:
+        """All counters, as a copy (read-side iteration safety)."""
+        return dict(self._counters)
+
     def gauge(self, name: str, default: float = 0.0) -> float:
         """Current value of a gauge (absent -> ``default``)."""
         return self._gauges.get(name, default)
+
+    def gauges(self) -> Dict[str, float]:
+        """All gauges, as a copy (read-side iteration safety)."""
+        return dict(self._gauges)
 
     def histograms(self) -> Dict[str, Histogram]:
         """Live histogram objects by name — the Prometheus exporter
@@ -218,6 +304,8 @@ class MetricsRegistry:
             "histograms": {name: h.summary()
                            for name, h in self._hists.items()},
         }
+        if self._labeled:
+            out["labeled_gauges"] = self.labeled_gauges()
         for name, fn in list(self._collectors.items()):
             try:
                 out[name] = fn()
@@ -236,6 +324,87 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._labeled.clear()
+
+    # --- fleet aggregation (observability/fleet.py) ---------------------------
+    #: snapshot keys that are NOT collector sections
+    CORE_KEYS = ("counters", "gauges", "histograms", "labeled_gauges",
+                 "host", "histogram_state")
+
+    def fleet_snapshot(self, host: Optional[str] = None) -> dict:
+        """:meth:`snapshot` plus the raw histogram bucket states and a
+        host id — the per-rank payload of the fleet snapshot exchange
+        (``fleet.write_rank_snapshot``). The summaries stay in for
+        human/JSON consumers; :meth:`merge` reads ``histogram_state`` so
+        the fleet merge is lossless instead of re-aggregating lossy
+        percentile summaries."""
+        out = self.snapshot()
+        out["histogram_state"] = {name: h.state()
+                                  for name, h in self._hists.items()}
+        if host is not None:
+            out["host"] = str(host)
+        return out
+
+    @classmethod
+    def merge(cls, snapshots) -> "MetricsRegistry":
+        """Merge per-host :meth:`fleet_snapshot` dicts into ONE registry
+        with explicit semantics (docs/OBSERVABILITY.md "Fleet"):
+
+        - **counters sum** — they are monotonic event counts, so the
+          fleet total is the sum of per-host totals;
+        - **gauges become per-host labeled series** (rendered with a
+          ``host`` label by the Prometheus exporter) **plus**
+          ``<name>.min`` / ``<name>.mean`` / ``<name>.max`` fleet
+          gauges — a last-value gauge has no meaningful sum;
+        - **histograms merge bucket-wise exactly** from the raw bucket
+          states (identical log-spaced edges on every host make the
+          merge lossless — pinned by the union-equality property test),
+          min/max clamps carrying over;
+        - **collector-section numeric leaves** are treated like gauges:
+          per-host labeled series named ``<section>.<key>``.
+
+        ``snapshots`` is a mapping ``{host: fleet_snapshot}`` or an
+        iterable of snapshots (host taken from each snapshot's ``host``
+        field, else its index)."""
+        if isinstance(snapshots, dict):
+            items = [(str(h), s) for h, s in snapshots.items()]
+        else:
+            items = [(str(s.get("host", i)), s)
+                     for i, s in enumerate(snapshots)]
+        merged = cls()
+        gauges: Dict[str, Dict[str, float]] = {}
+        for host, snap in items:
+            for name, v in snap.get("counters", {}).items():
+                merged.inc(name, v)
+            for name, v in snap.get("gauges", {}).items():
+                gauges.setdefault(name, {})[host] = float(v)
+                merged.set_labeled_gauge(name, host, v)
+            for name, state in snap.get("histogram_state", {}).items():
+                h = merged._hists.get(name)
+                if h is None:
+                    merged._hists[name] = Histogram.from_state(state)
+                else:
+                    h.merge_state(state)
+            # already-labeled series (merging a merged snapshot) pass
+            # through with their original host labels
+            for name, series in snap.get("labeled_gauges", {}).items():
+                for lhost, v in series.items():
+                    merged.set_labeled_gauge(name, lhost, v)
+            for section, data in snap.items():
+                if section in cls.CORE_KEYS or not isinstance(data, dict):
+                    continue
+                for key, v in data.items():
+                    if isinstance(v, bool) or not isinstance(v, (int,
+                                                                 float)):
+                        continue
+                    merged.set_labeled_gauge(f"{section}.{key}", host, v)
+        for name, series in gauges.items():
+            vals = list(series.values())
+            merged.set_gauge(f"{name}.min", min(vals))
+            merged.set_gauge(f"{name}.mean", sum(vals) / len(vals))
+            merged.set_gauge(f"{name}.max", max(vals))
+        merged.set_gauge("fleet.hosts", len(items))
+        return merged
 
 
 _DEFAULT: Optional[MetricsRegistry] = None

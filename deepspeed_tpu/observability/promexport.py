@@ -33,9 +33,9 @@ import re
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["prometheus_text", "check_exposition", "parse_prometheus_text",
-           "sanitize_metric_name", "escape_label_value",
-           "MetricsHTTPServer"]
+__all__ = ["prometheus_text", "multi_prometheus_text", "check_exposition",
+           "parse_prometheus_text", "sanitize_metric_name",
+           "escape_label_value", "MetricsHTTPServer"]
 
 _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _SAMPLE = re.compile(
@@ -113,10 +113,18 @@ def _cumulative_counts(hist, counts: List[int],
 
 
 def prometheus_text(registry, labels: Optional[Dict[str, str]] = None,
-                    buckets_per_decade: int = 2) -> str:
+                    buckets_per_decade: int = 2,
+                    name_prefix: str = "",
+                    skip_sections: Optional[set] = None,
+                    snapshot: Optional[dict] = None) -> str:
     """Render ``registry`` as Prometheus exposition text (see module
     docstring). ``labels`` are attached to every sample (job/instance
-    tagging for textfile-collector setups)."""
+    tagging for textfile-collector setups); ``name_prefix`` prepends
+    every metric name (:func:`multi_prometheus_text` uses it to
+    disambiguate colliding registries). Fleet-merged registries'
+    per-host labeled gauge series render as one metric with a ``host``
+    label per sample. ``snapshot`` (when the caller already took one)
+    avoids re-running the registry's collectors."""
     labels = dict(labels or {})
     lines: List[str] = []
     used: Dict[str, str] = {}          # prom name -> registry name
@@ -124,7 +132,7 @@ def prometheus_text(registry, labels: Optional[Dict[str, str]] = None,
 
     def unique(name: str, source: str) -> str:
         nonlocal collisions
-        base = sanitize_metric_name(name)
+        base = sanitize_metric_name(name_prefix + name)
         out, i = base, 2
         while out in used and used[out] != source:
             out = f"{base}_{i}"
@@ -133,7 +141,7 @@ def prometheus_text(registry, labels: Optional[Dict[str, str]] = None,
         used[out] = source
         return out
 
-    snap = registry.snapshot()
+    snap = registry.snapshot() if snapshot is None else snapshot
     for name in sorted(snap.get("counters", {})):
         pname = unique(f"{name}_total", f"counter:{name}")
         lines.append(f"# TYPE {pname} counter")
@@ -163,8 +171,21 @@ def prometheus_text(registry, labels: Optional[Dict[str, str]] = None,
         lines.append(f"{pname}_sum{_fmt_labels(labels)} "
                      f"{_fmt_value(hist.sum)}")
         lines.append(f"{pname}_count{_fmt_labels(labels)} {total}")
+    # per-host labeled series (fleet merge output): ONE metric name,
+    # one sample per host with a `host` label — the scrape shape every
+    # Prometheus fleet dashboard expects
+    get_labeled = getattr(registry, "labeled_gauges", None)
+    series = get_labeled() if callable(get_labeled) else {}
+    for name in sorted(series):
+        pname = unique(name, f"labeled:{name}")
+        lines.append(f"# TYPE {pname} gauge")
+        for host in sorted(series[name]):
+            host_labels = dict(labels, host=host)
+            lines.append(f"{pname}{_fmt_labels(host_labels)} "
+                         f"{_fmt_value(series[name][host])}")
     # collector sections: numeric leaves become gauges
-    core = {"counters", "gauges", "histograms"}
+    core = {"counters", "gauges", "histograms", "labeled_gauges",
+            "host", "histogram_state"} | set(skip_sections or ())
     for section in sorted(k for k in snap if k not in core):
         data = snap[section]
         if not isinstance(data, dict):
@@ -181,6 +202,91 @@ def prometheus_text(registry, labels: Optional[Dict[str, str]] = None,
         lines.append(f"dstprof_export_name_collisions_total{_fmt_labels(labels)} "
                      f"{collisions}")
     return "\n".join(lines) + "\n"
+
+
+#: collector sections that describe the PROCESS, not one registry's
+#: workload — identical on every registry in the process (per-device
+#: memory), so the merged exposition emits them once, from the first
+#: registry that carries them, instead of double-reporting the bytes
+SHARED_SECTIONS = ("memory",)
+
+
+def _type_blocks(text: str):
+    """Split exposition text into (metric name | None, [lines]) blocks
+    — a block is a ``# TYPE`` line plus the sample lines under it."""
+    name, lines = None, []
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            if lines:
+                yield name, lines
+            name, lines = line.split()[2], [line]
+        else:
+            lines.append(line)
+    if lines:
+        yield name, lines
+
+
+def multi_prometheus_text(named, labels: Optional[Dict[str, str]] = None,
+                          buckets_per_decade: int = 2) -> str:
+    """Render several named registries as ONE exposition document (the
+    unified ``/metrics`` endpoint a process running both a train and a
+    serve engine exposes on one port).
+
+    ``named`` is ``{section: registry-or-callable}`` (a callable is
+    invoked per render — engines use it to flush pending telemetry
+    before the scrape). Sections render in name order. Process-global
+    :data:`SHARED_SECTIONS` (device memory) are emitted once, from the
+    first registry carrying them. Any REMAINING metric name collision
+    across registries renames just that metric with a ``<section>_``
+    prefix and is counted (``dstfleet_export_registry_collisions_total``)
+    — the tier-1 suite pins ZERO collisions between the two engines'
+    real registries, so renaming is the loud fallback, not the steady
+    state."""
+    chunks: List[str] = []
+    seen: set = set()
+    emitted_shared: set = set()
+    collisions = 0
+    for section in sorted(named):
+        reg = named[section]
+        if callable(reg) and not hasattr(reg, "snapshot"):
+            reg = reg()
+        # ONE snapshot per registry per render: the shared-section probe
+        # and the exposition share it (collectors — telemetry flushes,
+        # SLO ticks — must not run twice per scrape)
+        snap = reg.snapshot()
+        present_shared = {s for s in SHARED_SECTIONS if s in snap}
+        text = prometheus_text(
+            reg, labels=labels, buckets_per_decade=buckets_per_decade,
+            skip_sections=emitted_shared & present_shared,
+            snapshot=snap)
+        emitted_shared |= present_shared
+        out: List[str] = []
+        for name, lines in _type_blocks(text):
+            if name is not None and name in seen:
+                collisions += 1
+                new = f"{sanitize_metric_name(section)}_{name}"
+                while new in seen:
+                    new = f"{new}_2"
+                fixed = []
+                for ln in lines:
+                    if ln.startswith("# TYPE "):
+                        fixed.append("# TYPE " + new
+                                     + ln[len("# TYPE ") + len(name):])
+                    elif ln.startswith(name):
+                        fixed.append(new + ln[len(name):])
+                    else:
+                        fixed.append(ln)
+                lines, name = fixed, new
+            if name is not None:
+                seen.add(name)
+            out.extend(lines)
+        chunks.append("\n".join(out).rstrip("\n"))
+    if collisions:
+        chunks.append(
+            "# TYPE dstfleet_export_registry_collisions_total counter\n"
+            f"dstfleet_export_registry_collisions_total"
+            f"{_fmt_labels(dict(labels or {}))} {collisions}")
+    return "\n".join(chunks) + "\n"
 
 
 # --- exposition checker / parser ---------------------------------------------
@@ -296,6 +402,27 @@ class MetricsHTTPServer:
         self._httpd = None
         self._thread = None
         self.port: Optional[int] = None
+
+    @classmethod
+    def for_registries(cls, named: Dict[str, object], port: int = 0,
+                       host: str = "127.0.0.1",
+                       labels: Optional[Dict[str, str]] = None
+                       ) -> "MetricsHTTPServer":
+        """One endpoint over several named registries: ``/metrics`` is
+        :func:`multi_prometheus_text` over all of them; ``/metrics.json``
+        nests each snapshot under its section name. Values may be
+        registries or zero-arg callables returning one (engines flush
+        pending telemetry inside the callable)."""
+        def resolve():
+            return {name: (reg() if callable(reg)
+                           and not hasattr(reg, "snapshot") else reg)
+                    for name, reg in named.items()}
+
+        return cls(
+            lambda: multi_prometheus_text(resolve(), labels=labels),
+            json_fn=lambda: {name: reg.snapshot()
+                             for name, reg in resolve().items()},
+            port=port, host=host)
 
     def start(self) -> int:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer

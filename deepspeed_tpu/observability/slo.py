@@ -1,0 +1,296 @@
+"""SLO / goodput accounting over the dstrace serving registry.
+
+Serving at scale is operated against service-level objectives, not raw
+percentiles: "TTFT p95 ≤ 2 s over the last hour", "99.9% of requests
+succeed", and — the Orca-style production number — **goodput**, the
+fraction of sampled tokens that were actually delivered inside their
+deadline (preemption restarts and timed-out streams burn device time
+that never reaches a user; throughput alone hides that waste). This
+module derives all three from telemetry the scheduler ALREADY records
+at its terminal funnel (``serve.ttft_s``/``serve.tpot_s`` histograms,
+per-status completion counters, delivered/sampled token counters) —
+no new hot-path instrumentation, just rolling-window arithmetic at
+drain/scrape boundaries.
+
+Burn rate follows the SRE-workbook definition: the rate at which the
+error budget is being consumed, i.e. ``observed bad fraction in the
+window ÷ allowed bad fraction``. A burn rate of 1.0 spends the budget
+exactly at the objective's rate; a sustained 14.4 on a 99.9%
+availability SLO exhausts a 30-day budget in ~2 days (the classic
+paging threshold). For a latency objective "p95 ≤ T" the allowed bad
+fraction is 0.05 and the observed one is the fraction of requests in
+the window with latency > T, counted from the registry histogram's
+fixed log-spaced buckets (resolution one bucket ≈ 4.9% in value — the
+count itself is exact for the bucket edge nearest T).
+
+Rolling windows are rings of cumulative-counter marks (one small dict
+per tick, bounded by ``window / min_interval_s``) — histograms stay
+cumulative and fixed-memory; the window math is mark subtraction.
+
+Everything is host-side; breaches emit one ``SLO_BREACH`` tracer
+instant per signal per episode (re-armed when the burn rate drops back
+under the threshold), never a log flood.
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from deepspeed_tpu.observability.metrics import Histogram, MetricsRegistry
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["SLOConfig", "SLOTracker", "count_over_threshold"]
+
+#: terminal statuses that count against the availability objective —
+#: server-caused failures. CANCELLED is client-initiated and COMPLETED
+#: is success; both consume no error budget.
+ERROR_STATUSES = ("FAILED", "TIMED_OUT", "REJECTED", "PREEMPTED_LIMIT")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Declarative serving objectives (``serve.slo`` config dict).
+
+    ``None`` disables a signal; ``windows_s`` are the rolling windows
+    burn rates are tracked over (the SRE-standard multi-window pair by
+    default); ``breach_burn_rate`` is the alerting threshold a signal
+    must cross to count as breaching."""
+
+    ttft_p95_s: Optional[float] = None
+    tpot_p95_s: Optional[float] = None
+    availability: Optional[float] = None
+    windows_s: Tuple[float, ...] = (300.0, 3600.0)
+    breach_burn_rate: float = 1.0
+    min_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.availability is not None \
+                and not (0.0 < self.availability < 1.0):
+            raise ValueError(f"availability target must be in (0, 1), "
+                             f"got {self.availability}")
+        for name in ("ttft_p95_s", "tpot_p95_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
+        if not self.windows_s or any(w <= 0 for w in self.windows_s):
+            raise ValueError(f"windows_s must be positive, "
+                             f"got {self.windows_s}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["SLOConfig"]:
+        """Parse the ``serve.slo`` knob; None/empty → no tracking.
+        Unknown keys fail fast (a typo'd objective silently tracking
+        nothing is the worst failure mode an SLO layer can have)."""
+        if not d:
+            return None
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(
+                f"serve.slo: unknown keys {sorted(extra)}; "
+                f"expected a subset of {sorted(known)}")
+        d = dict(d)
+        if "windows_s" in d:
+            d["windows_s"] = tuple(float(w) for w in d["windows_s"])
+        return cls(**d)
+
+
+def count_over_threshold(hist: Histogram, threshold: float) -> int:
+    """Observations STRICTLY above the bucket edge covering
+    ``threshold``. Exact at bucket-edge resolution (one bucket ≈ 4.9%
+    in value at the default density): every sample ≤ that edge lands in
+    a bucket at/below it by construction."""
+    counts = hist.bucket_counts
+    if threshold >= hist.hi:
+        return counts[-1]
+    below = 0
+    for i, c in enumerate(counts[:-1]):
+        edge = hist.lo * hist.ratio ** i
+        if edge > threshold * (1 + 1e-12):
+            break
+        below += c
+    return hist.count - below
+
+
+@dataclasses.dataclass
+class _Mark:
+    """Cumulative registry readings at one tick."""
+
+    t: float
+    requests: float
+    errors: float
+    ttft_count: int
+    ttft_bad: int
+    tpot_count: int
+    tpot_bad: int
+    delivered: float
+    sampled: float
+
+
+class SLOTracker:
+    """Rolling-window burn-rate + goodput tracker over one registry.
+
+    Call :meth:`tick` at any host boundary (the scheduler does, at its
+    chunk boundary; the engine also refreshes on scrape via the
+    ``serve.slo`` registry collector). Publishing goes to gauges —
+    ``serve.goodput``, ``serve.slo.<signal>.burn_rate.<window>s`` — and
+    to the collector :meth:`section` for the JSON snapshot."""
+
+    def __init__(self, metrics: MetricsRegistry, config: SLOConfig, *,
+                 tracer=None, clock=time.monotonic):
+        self.metrics = metrics
+        self.config = config
+        self.tracer = tracer
+        self.clock = clock
+        maxlen = int(max(config.windows_s) / max(config.min_interval_s,
+                                                 1e-3)) + 2
+        self._marks: "deque[_Mark]" = deque(maxlen=min(maxlen, 1 << 16))
+        self._last_tick = -float("inf")
+        self._breaching: Dict[str, bool] = {}
+
+    # --- reading the registry -------------------------------------------------
+    def _read_mark(self, t: float) -> _Mark:
+        m = self.metrics
+        hists = m.histograms()
+        requests = errors = 0.0
+        for name, v in m.counters().items():
+            if name.startswith("serve.completions."):
+                requests += v
+                if name.rsplit(".", 1)[1] in ERROR_STATUSES:
+                    errors += v
+        ttft = hists.get("serve.ttft_s")
+        tpot = hists.get("serve.tpot_s")
+        cfg = self.config
+        return _Mark(
+            t=t, requests=requests, errors=errors,
+            ttft_count=ttft.count if ttft else 0,
+            ttft_bad=(count_over_threshold(ttft, cfg.ttft_p95_s)
+                      if ttft and cfg.ttft_p95_s else 0),
+            tpot_count=tpot.count if tpot else 0,
+            tpot_bad=(count_over_threshold(tpot, cfg.tpot_p95_s)
+                      if tpot and cfg.tpot_p95_s else 0),
+            delivered=m.counter("serve.tokens_delivered"),
+            sampled=m.counter("serve.tokens_sampled"),
+        )
+
+    _ZERO = _Mark(t=0.0, requests=0, errors=0, ttft_count=0, ttft_bad=0,
+                  tpot_count=0, tpot_bad=0, delivered=0, sampled=0)
+
+    def _window_base(self, now: float, window: float) -> _Mark:
+        """Cumulative state at the window START: the newest mark at/
+        before ``now - window``. When tracking began inside the window,
+        the base is the zero mark — everything observed so far counts."""
+        base = self._ZERO
+        for mark in self._marks:
+            if mark.t > now - window:
+                break
+            base = mark
+        return base
+
+    # --- burn-rate arithmetic -------------------------------------------------
+    @staticmethod
+    def _burn(bad: float, total: float, allowed_fraction: float) -> float:
+        if total <= 0 or allowed_fraction <= 0:
+            return 0.0
+        return (bad / total) / allowed_fraction
+
+    def _signals(self, now: float, cur: _Mark) -> Dict[str, Dict]:
+        cfg = self.config
+        out: Dict[str, Dict] = {}
+        for window in cfg.windows_s:
+            base = self._window_base(now, window)
+            rates: Dict[str, float] = {}
+            if cfg.ttft_p95_s is not None:
+                rates["ttft"] = self._burn(
+                    cur.ttft_bad - base.ttft_bad,
+                    cur.ttft_count - base.ttft_count, 0.05)
+            if cfg.tpot_p95_s is not None:
+                rates["tpot"] = self._burn(
+                    cur.tpot_bad - base.tpot_bad,
+                    cur.tpot_count - base.tpot_count, 0.05)
+            if cfg.availability is not None:
+                rates["availability"] = self._burn(
+                    cur.errors - base.errors,
+                    cur.requests - base.requests,
+                    1.0 - cfg.availability)
+            out[f"{int(window)}s"] = rates
+        return out
+
+    # --- the tick -------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """Sample cumulative counters, refresh burn-rate/goodput gauges
+        and breach state. Rate-limited to ``min_interval_s`` so calling
+        it at every chunk boundary costs a clock read when idle."""
+        now = self.clock() if now is None else float(now)
+        if now - self._last_tick < self.config.min_interval_s:
+            return
+        self._last_tick = now
+        cur = self._read_mark(now)
+        self._marks.append(cur)
+        # evict marks older than the largest window, but always KEEP the
+        # newest mark at/before the horizon — it is the subtraction base
+        horizon = now - max(self.config.windows_s)
+        while len(self._marks) >= 2 and self._marks[1].t <= horizon:
+            self._marks.popleft()
+        m = self.metrics
+        goodput = (cur.delivered / cur.sampled) if cur.sampled else 0.0
+        m.set_gauge("serve.goodput", goodput)
+        by_window = self._signals(now, cur)
+        worst: Dict[str, float] = {}
+        for wname, rates in by_window.items():
+            for sig, rate in rates.items():
+                m.set_gauge(f"serve.slo.{sig}.burn_rate.{wname}", rate)
+                worst[sig] = max(worst.get(sig, 0.0), rate)
+        for sig, rate in worst.items():
+            breaching = rate >= self.config.breach_burn_rate
+            if breaching and not self._breaching.get(sig):
+                m.inc(f"serve.slo.{sig}.breaches")
+                logger.warning(
+                    f"SLO breach: {sig} burn rate {rate:.2f} >= "
+                    f"{self.config.breach_burn_rate} "
+                    f"(windows {by_window})")
+                if self.tracer is not None:
+                    self.tracer.instant("SLO_BREACH", cat="slo",
+                                        signal=sig, burn_rate=rate)
+            self._breaching[sig] = breaching
+
+    def reset(self) -> None:
+        """Drop rolling-window marks + breach state (bench isolation —
+        call alongside ``MetricsRegistry.reset()``: marks are cumulative
+        readings and would go negative against a reset registry)."""
+        self._marks.clear()
+        self._breaching.clear()
+        self._last_tick = -float("inf")
+
+    # --- collector ------------------------------------------------------------
+    def section(self) -> dict:
+        """``serve.slo`` registry collector: targets + current burn
+        rates + goodput, refreshed at read time (a scrape never shows a
+        stale window when traffic stopped)."""
+        self.tick()
+        cfg = self.config
+        m = self.metrics
+        out: Dict[str, float] = {
+            "goodput": m.gauge("serve.goodput"),
+            "tokens_delivered": m.counter("serve.tokens_delivered"),
+            "tokens_sampled": m.counter("serve.tokens_sampled"),
+            "breach_burn_rate": cfg.breach_burn_rate,
+        }
+        if cfg.ttft_p95_s is not None:
+            out["target.ttft_p95_s"] = cfg.ttft_p95_s
+        if cfg.tpot_p95_s is not None:
+            out["target.tpot_p95_s"] = cfg.tpot_p95_s
+        if cfg.availability is not None:
+            out["target.availability"] = cfg.availability
+        gauges = m.gauges()
+        for w in cfg.windows_s:
+            for sig in ("ttft", "tpot", "availability"):
+                name = f"serve.slo.{sig}.burn_rate.{int(w)}s"
+                if name in gauges:
+                    out[f"{sig}.burn_rate.{int(w)}s"] = gauges[name]
+        for sig in ("ttft", "tpot", "availability"):
+            c = m.counter(f"serve.slo.{sig}.breaches")
+            if c:
+                out[f"{sig}.breaches"] = c
+        return out

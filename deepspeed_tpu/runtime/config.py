@@ -386,6 +386,25 @@ class DeepSpeedConfig:
         # training twin of serve.metrics_port: >0 starts the stdlib
         # Prometheus scrape endpoint over the engine's registry
         self.metrics_port: int = int(p.get("metrics_port", 0) or 0)
+        # dstfleet (docs/OBSERVABILITY.md "Fleet"): cross-process metric
+        # aggregation over a shared directory. When ``dir`` is set,
+        # every rank atomically writes rank<k>.json at its monitor
+        # drain (steps_per_print boundaries) and rank 0 merges all rank
+        # files (counters sum, gauges per-host labeled + min/mean/max,
+        # histograms bucket-wise lossless) + runs straggler detection
+        # (fleet.step_time.skew / fleet.collective_wait.skew gauges, ONE
+        # structured warning when a host exceeds straggler_threshold x
+        # the fleet median for straggler_windows consecutive drains).
+        fleet = p.get("fleet", {})
+        if isinstance(fleet, str):
+            fleet = {"dir": fleet}
+        self.fleet_dir: Optional[str] = fleet.get("dir")
+        # -1 = resolve from DS_TPU_PROCESS_ID env else jax.process_index()
+        self.fleet_rank: int = int(fleet.get("rank", -1))
+        self.fleet_straggler_threshold: float = float(
+            fleet.get("straggler_threshold", 1.5))
+        self.fleet_straggler_windows: int = int(
+            fleet.get("straggler_windows", 3))
         self.comms_logger = CommsLoggerConfig(**p.get("comms_logger", {}))
         self.flops_profiler = FlopsProfilerConfig(**p.get("flops_profiler", {}))
         self.pipeline = PipelineConfig(**p.get("pipeline", {}))

@@ -412,9 +412,13 @@ class DeepSpeedEngine:
         # wire totals, all behind one engine.metrics.snapshot(); the
         # monitor sinks drain it at steps_per_print boundaries
         self.metrics = MetricsRegistry()
-        from deepspeed_tpu.comm.comm import comms_logger
+        from deepspeed_tpu.comm.comm import comms_logger, \
+            set_metrics_registry
         self.metrics.register_collector("comm",
                                         comms_logger.registry_section)
+        # measured-collective sink (dstfleet): eager comm verbs record
+        # real per-verb latency histograms + wire-byte counters here
+        set_metrics_registry(self.metrics)
         # dstprof (docs/OBSERVABILITY.md): compile observability over
         # the train-step jits (hit once per program life — the thing
         # watched here is compile latency + cost analysis, which the
@@ -483,6 +487,26 @@ class DeepSpeedEngine:
         self._metrics_server = None
         if getattr(self._config, "metrics_port", 0):
             self.start_metrics_server()
+        # dstfleet (docs/OBSERVABILITY.md "Fleet"): file-based fleet
+        # snapshot exchange — every rank publishes rank<k>.json at its
+        # monitor drain; rank 0 merges + runs straggler detection, so
+        # its scrape/monitor pipeline carries the fleet.* gauges
+        self.fleet_monitor = None
+        if getattr(self._config, "fleet_dir", None):
+            from deepspeed_tpu.observability import FleetMonitor
+            from deepspeed_tpu.observability.fleet import (
+                resolve_fleet_rank,
+            )
+
+            rank = resolve_fleet_rank(
+                int(getattr(self._config, "fleet_rank", -1)))
+            self.fleet_monitor = FleetMonitor(
+                self._config.fleet_dir, rank, metrics=self.metrics,
+                tracer=self.train_tracer,
+                straggler_threshold=float(getattr(
+                    self._config, "fleet_straggler_threshold", 1.5)),
+                straggler_windows=int(getattr(
+                    self._config, "fleet_straggler_windows", 3)))
 
     def _ctx(self):
         """Scoped ambient-mesh context: PartitionSpec-based sharding
@@ -1524,7 +1548,7 @@ class DeepSpeedEngine:
             pipeline_lane_spans(tr, t_prog0, t_prog1,
                                 *self._pipe_lane_info, step=step)
 
-    def train_metrics(self, format: str = "dict"):
+    def train_metrics(self, format: str = "dict", fleet: bool = False):
         """The training registry, in one of two shapes (the training
         twin of ``InferenceEngine.serve_metrics``):
 
@@ -1536,24 +1560,45 @@ class DeepSpeedEngine:
           ``metrics_port`` endpoint scrapes.
 
         Flushes the pending lag-one step first, so the rendering always
-        reflects every completed step."""
+        reflects every completed step.
+
+        ``fleet=True`` (requires the ``fleet.dir`` config) publishes
+        this rank's snapshot into the exchange and renders the MERGED
+        fleet registry instead — counters summed, gauges per-host
+        labeled + min/mean/max, histograms merged losslessly."""
         self.flush_train_telemetry()
+        registry = self.metrics
+        if fleet:
+            if self.fleet_monitor is None:
+                raise ValueError(
+                    "train_metrics(fleet=True) needs the fleet.dir "
+                    "config (the shared snapshot-exchange directory)")
+            self.fleet_monitor.publish()
+            registry = self.fleet_monitor.aggregate()
         if format == "dict":
-            return self.metrics.snapshot()
+            return registry.snapshot()
         if format == "prometheus":
             from deepspeed_tpu.observability import prometheus_text
 
-            return prometheus_text(self.metrics)
+            return prometheus_text(registry)
         raise ValueError(
             f"train_metrics(format={format!r}): expected 'dict' or "
             f"'prometheus'")
 
-    def start_metrics_server(self, port: Optional[int] = None) -> int:
+    def start_metrics_server(self, port: Optional[int] = None,
+                             extra_registries: Optional[dict] = None
+                             ) -> int:
         """Start the stdlib HTTP scrape endpoint (``/metrics``
         Prometheus text, ``/metrics.json`` raw snapshot) over the
         training registry on ``port`` (default: the ``metrics_port``
         config knob; 0 binds an ephemeral port). Idempotent; returns
-        the bound port."""
+        the bound port.
+
+        ``extra_registries`` ({section: registry-or-callable}) merges
+        more registries into the SAME ``/metrics`` exposition — one
+        port for a process that also runs a serving engine
+        (``{"serve": inf_engine.metrics}``); the tier-1 suite pins the
+        two engines' metric names collision-free."""
         if self._metrics_server is not None:
             return self._metrics_server.port
         from deepspeed_tpu.observability import (
@@ -1563,12 +1608,19 @@ class DeepSpeedEngine:
         if port is None:
             port = int(getattr(self._config, "metrics_port", 0))
 
-        def render():
+        def flushed():
             self.flush_train_telemetry()
-            return prometheus_text(self.metrics)
+            return self.metrics
 
-        self._metrics_server = MetricsHTTPServer(
-            render, json_fn=self.metrics.snapshot, port=port)
+        if extra_registries:
+            named = dict(extra_registries)
+            named["train"] = flushed
+            self._metrics_server = MetricsHTTPServer.for_registries(
+                named, port=port)
+        else:
+            self._metrics_server = MetricsHTTPServer(
+                lambda: prometheus_text(flushed()),
+                json_fn=self.metrics.snapshot, port=port)
         bound = self._metrics_server.start()
         log_dist(f"dsttrain metrics endpoint on :{bound}/metrics",
                  ranks=[0])
@@ -1620,6 +1672,11 @@ class DeepSpeedEngine:
                 log_dist(f"[loss scaling] overflow, skipping step "
                          f"(scale now {float(self.scaler_state.scale)})", ranks=[0])
         self.tput_timer.stop(global_step=True)
+        if self.tput_timer.last_duration > 0:
+            # per-host step-time gauge: the fleet merge's straggler
+            # signal (fleet.step_time.skew reads each rank's value)
+            self.metrics.set_gauge("train.step_time_s",
+                                   self.tput_timer.last_duration)
         # step MFU: exact program FLOPs (compile-time cost analysis) over
         # measured step wall clock and the platform peak — the headline
         # achieved-vs-peak number (PAPERS.md: DeepSpeed-Inference /
@@ -1637,6 +1694,19 @@ class DeepSpeedEngine:
             self.metrics.set_gauge(
                 "train.model_flops_per_sec",
                 flops / self.tput_timer.last_duration)
+            if peak["flops"]:
+                # measured per-step COMM ENVELOPE: in-graph collectives
+                # have no host-visible wall time, but (step time − AOT-
+                # costed ideal compute time) bounds everything that is
+                # not pure compute — communication, schedule bubbles,
+                # dispatch gaps. An upper bound on comm, not a
+                # measurement of it; trend + fleet skew is the signal.
+                ideal_s = flops / (peak["flops"]
+                                   * int(self.mesh.devices.size))
+                self.metrics.set_gauge(
+                    "train.comm_fraction",
+                    min(max(1.0 - ideal_s
+                            / self.tput_timer.last_duration, 0.0), 1.0))
             if self._pipe_bubble is not None:
                 # measured-step-vs-ideal: the fraction of the schedule-
                 # adjusted ceiling achieved (MFU / (1 - bubble)) — next
@@ -1686,6 +1756,14 @@ class DeepSpeedEngine:
             # drain the dstrace registry (timers, throughput, ZeRO
             # reduction bytes, comms wire totals) into the same sinks
             self.monitor.write_registry(self.metrics, self.global_samples)
+        if (self.fleet_monitor is not None
+                and self.global_steps % self._config.steps_per_print == 0):
+            # fleet snapshot exchange at the same drain cadence: every
+            # rank publishes its rank<k>.json; rank 0 merges + refreshes
+            # the fleet.* skew gauges (they then ride THIS registry's
+            # monitor/scrape pipeline like any other gauge)
+            self.flush_train_telemetry()
+            self.fleet_monitor.publish_and_aggregate()
 
     def destroy(self):
         """Release engine-held native resources (AIO thread pools, pending

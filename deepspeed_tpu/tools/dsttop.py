@@ -1,0 +1,273 @@
+"""``bin/dst top`` — live serving/fleet dashboard over a ``/metrics``
+endpoint.
+
+A ``top(1)``-shaped operator view of a running engine: polls the
+stdlib scrape endpoint (``serve.metrics_port`` / ``metrics_port`` /
+``MetricsHTTPServer``) and renders slots, tokens/s, TTFT/TPOT
+percentiles, goodput, SLO burn rates, and per-host fleet skew —
+entirely stdlib (urllib + optional curses), so it runs on any box that
+can reach the endpoint, with zero dependencies and zero load beyond
+one HTTP GET per refresh.
+
+Modes:
+
+- interactive (default): curses full-screen refresh every
+  ``--interval`` seconds (plain repainted text when curses/tty are
+  unavailable — CI logs, ``watch``-style wrappers);
+- ``--once``: one sample, print, exit — ``--json`` makes it a
+  machine-readable probe (the tier-1 smoke test and health checks use
+  exactly this).
+
+Reads ``/metrics.json`` (the raw registry snapshot — richer than the
+Prometheus text: histogram summaries and collector sections come
+pre-aggregated). Works against the single-registry endpoint and the
+multi-registry (train+serve on one port) shape alike.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+__all__ = ["fetch_snapshot", "build_sample", "render_text", "main"]
+
+
+def fetch_snapshot(url: str, timeout: float = 5.0) -> dict:
+    """GET ``<url>/metrics.json`` → one flat snapshot dict. A
+    multi-registry endpoint returns ``{section: snapshot}``; sections
+    are merged (their metric names are disjoint by the exporter's
+    collision pin)."""
+    base = url.rstrip("/")
+    if not base.endswith("/metrics.json"):
+        base += "/metrics.json"
+    with urllib.request.urlopen(base, timeout=timeout) as r:
+        raw = json.loads(r.read().decode())
+    if "counters" in raw:
+        return raw
+    # multi-registry: {"serve": {...}, "train": {...}} — merge flat
+    merged: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for sub in raw.values():
+        if not isinstance(sub, dict) or "counters" not in sub:
+            continue
+        for key in ("counters", "gauges", "histograms"):
+            merged[key].update(sub.get(key, {}))
+        for k, v in sub.items():
+            if k not in ("counters", "gauges", "histograms"):
+                merged.setdefault(k, v)
+    return merged
+
+
+def build_sample(snap: dict, prev: Optional[dict] = None,
+                 dt: Optional[float] = None) -> dict:
+    """One dashboard sample from a snapshot (pure — unit-testable
+    without HTTP). ``prev``/``dt`` (the previous snapshot and elapsed
+    seconds) enable the tokens/s rate; None → rate fields are null."""
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    h = snap.get("histograms", {})
+
+    def rate(name: str) -> Optional[float]:
+        if prev is None or not dt or dt <= 0:
+            return None
+        return max(0.0, (c.get(name, 0.0)
+                         - prev.get("counters", {}).get(name, 0.0))) / dt
+
+    def pct(name: str) -> dict:
+        s = h.get(name, {})
+        return {k: s.get(k, 0.0) for k in ("count", "p50", "p95", "p99",
+                                           "mean")}
+
+    completions = {k.rsplit(".", 1)[1]: v for k, v in c.items()
+                   if k.startswith("serve.completions.")}
+    burn = {k[len("serve.slo."):]: v for k, v in g.items()
+            if k.startswith("serve.slo.") and ".burn_rate." in k}
+    fleet = {k: v for k, v in g.items() if k.startswith("fleet.")}
+    hosts = snap.get("labeled_gauges", {})
+    per_host_step = dict(hosts.get("train.step_time_s", {}))
+    return {
+        "slots": {
+            "active": g.get("serve.active_slots", 0),
+            "stalled": g.get("serve.stalled_slots", 0),
+            "restoring": g.get("serve.restoring_slots", 0),
+            "queued": g.get("serve.queued", 0),
+        },
+        "pool": {
+            "allocated": g.get("serve.pool_blocks_allocated", 0),
+            "free": g.get("serve.pool_blocks_free", 0),
+            "cached": g.get("serve.pool_blocks_cached", 0),
+            "live_tokens": g.get("serve.live_tokens", 0),
+        },
+        "tokens": {
+            "generated": c.get("serve.tokens_generated", 0),
+            "sampled": c.get("serve.tokens_sampled", 0),
+            "delivered": c.get("serve.tokens_delivered", 0),
+            "per_sec": rate("serve.tokens_sampled"),
+            "delivered_per_sec": rate("serve.tokens_delivered"),
+        },
+        "latency": {"ttft_s": pct("serve.ttft_s"),
+                    "tpot_s": pct("serve.tpot_s"),
+                    "queue_wait_s": pct("serve.queue_wait_s")},
+        "goodput": g.get("serve.goodput"),
+        "burn_rates": burn,
+        "slo": snap.get("serve.slo", {}),
+        "completions": completions,
+        "fleet": fleet,
+        "hosts": per_host_step,
+        "train": {k: v for k, v in g.items()
+                  if k in ("train.step_time_s", "train.mfu",
+                           "train.comm_fraction", "train.grad_norm",
+                           "train.pipeline.bubble_fraction")},
+    }
+
+
+def _fmt(v, digits: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}f}"
+    return str(v)
+
+
+def render_text(sample: dict, width: int = 78) -> str:
+    """The dashboard as plain lines (curses and --once share it)."""
+    s, p, t = sample["slots"], sample["pool"], sample["tokens"]
+    lines: List[str] = []
+    lines.append("dst top — serving" + (" + fleet" if sample["fleet"]
+                                        else ""))
+    lines.append("-" * width)
+    lines.append(
+        f"slots  active {int(s['active'])}  stalled {int(s['stalled'])}"
+        f"  restoring {int(s['restoring'])}  queued {int(s['queued'])}"
+        f"   pool {int(p['allocated'])} used / {int(p['free'])} free"
+        f" / {int(p['cached'])} cached")
+    lines.append(
+        f"tokens sampled {int(t['sampled'])}  delivered "
+        f"{int(t['delivered'])}   tok/s {_fmt(t['per_sec'], 1)}"
+        f"   goodput {_fmt(sample['goodput'])}")
+    lat = sample["latency"]
+    for name, label in (("ttft_s", "TTFT"), ("tpot_s", "TPOT"),
+                        ("queue_wait_s", "queue")):
+        d = lat[name]
+        if d.get("count"):
+            lines.append(
+                f"{label:<6} p50 {_fmt(d['p50'])}s  p95 {_fmt(d['p95'])}s"
+                f"  p99 {_fmt(d['p99'])}s  (n={int(d['count'])})")
+    if sample["completions"]:
+        lines.append("done   " + "  ".join(
+            f"{k}={int(v)}" for k, v in sorted(
+                sample["completions"].items())))
+    if sample["burn_rates"]:
+        lines.append("burn   " + "  ".join(
+            f"{k}={_fmt(v, 2)}" for k, v in sorted(
+                sample["burn_rates"].items())))
+    if sample["fleet"]:
+        lines.append("fleet  " + "  ".join(
+            f"{k.removeprefix('fleet.')}={_fmt(v, 2)}"
+            for k, v in sorted(sample["fleet"].items())))
+    if sample["hosts"]:
+        lines.append("hosts  " + "  ".join(
+            f"{h}={_fmt(v)}s" for h, v in sorted(
+                sample["hosts"].items())))
+    if sample["train"]:
+        lines.append("train  " + "  ".join(
+            f"{k.removeprefix('train.')}={_fmt(v)}"
+            for k, v in sorted(sample["train"].items())))
+    lines.append("-" * width)
+    return "\n".join(lines)
+
+
+def _poll_loop(url: str, interval: float, plain: bool) -> int:
+    prev, prev_t = None, None
+
+    def one_sample():
+        nonlocal prev, prev_t
+        snap = fetch_snapshot(url)
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else None
+        sample = build_sample(snap, prev, dt)
+        prev, prev_t = snap, now
+        return sample
+
+    use_curses = not plain and sys.stdout.isatty()
+    if use_curses:
+        try:
+            import curses
+        except ImportError:
+            use_curses = False
+    if not use_curses:
+        try:
+            while True:
+                try:
+                    print(render_text(one_sample()), flush=True)
+                except OSError as e:
+                    # transient scrape failure (engine restarting, slow
+                    # endpoint) must not kill a long-running watch loop
+                    print(f"dst top: endpoint unreachable: {e}",
+                          flush=True)
+                time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+    def run(stdscr):
+        curses.curs_set(0)
+        stdscr.nodelay(True)
+        stdscr.timeout(int(interval * 1000))
+        while True:
+            try:
+                text = render_text(one_sample(),
+                                   width=max(stdscr.getmaxyx()[1] - 2,
+                                             40))
+            except OSError as e:
+                text = f"dst top: endpoint unreachable: {e}"
+            stdscr.erase()
+            rows, cols = stdscr.getmaxyx()
+            for i, line in enumerate(text.splitlines()[:rows - 1]):
+                stdscr.addnstr(i, 0, line, cols - 1)
+            stdscr.addnstr(min(rows - 1, text.count("\n") + 1), 0,
+                           f"refresh {interval}s — q quits", cols - 1)
+            stdscr.refresh()
+            ch = stdscr.getch()
+            if ch in (ord("q"), ord("Q")):
+                return 0
+
+    return curses.wrapper(run)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dst top",
+        description="live serving/fleet dashboard over a dst metrics "
+                    "endpoint (slots, tok/s, TTFT/TPOT, goodput, burn "
+                    "rates, per-host skew)")
+    ap.add_argument("--url", default=None,
+                    help="metrics endpoint base URL "
+                         "(default http://127.0.0.1:<port>)")
+    ap.add_argument("--port", type=int, default=9100,
+                    help="shorthand for --url http://127.0.0.1:<port>")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one sample and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable sample (with --once)")
+    ap.add_argument("--plain", action="store_true",
+                    help="never use curses (repaint plain text)")
+    args = ap.parse_args(argv)
+    url = args.url or f"http://127.0.0.1:{args.port}"
+    if args.once:
+        try:
+            snap = fetch_snapshot(url)
+        except OSError as e:
+            print(f"dst top: cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+        sample = build_sample(snap)
+        print(json.dumps(sample, indent=1, default=str, sort_keys=True)
+              if args.json else render_text(sample))
+        return 0
+    return _poll_loop(url, max(args.interval, 0.1), args.plain)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

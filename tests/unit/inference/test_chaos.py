@@ -587,3 +587,65 @@ def test_chaos_shutdown_releases_everything_and_is_idempotent():
     drain(sched)
     assert sched.cache_hit_blocks >= 1
     assert_quiescent(sched)
+
+
+# --- dstfleet: straggler host in a simulated fleet ---------------------------
+
+def test_chaos_straggler_host_surfaces_in_fleet_skew(tmp_path):
+    """dstfleet chaos scenario: two simulated serve hosts run the SAME
+    trace; one suffers injected slow chunks (FaultInjector ``slow``
+    site) that also push its deadlined requests over budget. The fleet
+    merge must surface the slow host in ``fleet.step_time.skew`` with
+    EXACTLY ONE structured straggler warning, its goodput must degrade
+    (sampled-but-undelivered timeout tokens) while the fast host's
+    stays 1.0, and both hosts' auditors stay clean (audit every
+    chunk)."""
+    from deepspeed_tpu.observability import (
+        FleetMonitor, write_rank_snapshot,
+    )
+
+    def reqs(deadline):
+        return [req(rid, plen=4, gen=8, deadline_s=deadline)
+                for rid in range(4)]
+
+    def run_host(slow):
+        kw = {}
+        if slow:
+            kw["fault_injector"] = FaultInjector(
+                [FaultSpec(site="slow", step=s, seconds=0.03)
+                 for s in range(1, 16)])
+        sched, _, _ = make_sched(num_slots=2, num_blocks=33, **kw)
+        # generous for the fast host, fatal under 0.03 s/chunk stalls
+        for r in reqs(deadline=0.06):
+            sched.submit(r)
+        comps = by_rid(drain(sched, max_steps=2000))
+        assert_quiescent(sched)                    # auditor clean
+        return sched, comps
+
+    fast, fast_comps = run_host(slow=False)
+    slow, slow_comps = run_host(slow=True)
+    assert all(c.status == COMPLETED for c in fast_comps.values())
+    assert any(c.status == TIMED_OUT for c in slow_comps.values()), \
+        "slow chunks never pushed a deadlined request over budget"
+    # goodput: the slow host burned sampled tokens it never delivered
+    assert fast.metrics.gauge("serve.goodput") == 1.0
+    assert slow.metrics.gauge("serve.goodput") < 1.0
+
+    d = str(tmp_path)
+    write_rank_snapshot(d, 1, slow.metrics, host="rank1")
+    mon = FleetMonitor(d, 0, metrics=fast.metrics,
+                       straggler_threshold=1.5, straggler_windows=2)
+    merged = None
+    for _ in range(3):                             # N consecutive drains
+        merged = mon.publish_and_aggregate()
+    assert merged.gauge("fleet.step_time.skew") > 1.5
+    assert merged.gauge("fleet.step_time.slowest_host") == 1
+    # exactly ONE structured warning for the persistent straggler
+    assert len(mon.step_detector.warnings) == 1
+    assert mon.step_detector.warnings[0]["host"] == "rank1"
+    assert fast.metrics.counter("fleet.straggler_warnings") == 1
+    # merge semantics held on the real chaos registries too
+    assert merged.counter("serve.tokens_sampled") == (
+        fast.metrics.counter("serve.tokens_sampled")
+        + slow.metrics.counter("serve.tokens_sampled"))
+    assert merged.labeled_gauges()["serve.goodput"]["rank1"] < 1.0

@@ -163,6 +163,74 @@ def test_static_runtime_wire_byte_cross_check(dp8_mesh, rng):
         assert ev.bytes > 0, op
 
 
+def test_traced_samples_are_untimed_not_zero_latency(dp8_mesh, rng):
+    """The PR-13 satellite fix: trace-time ``_profile`` records must be
+    UNTIMED (latency None, excluded from the average) — previously each
+    traced verb appended a fabricated 0.0 ms that log_summary averaged
+    into latency stats. A mixed history (one traced + one measured
+    sample) must average over the measured sample alone."""
+    from deepspeed_tpu.comm.comms_logging import CommsLogger
+
+    probe = CommsLogger(enabled=True)
+    real = dist.comms_logger
+    try:
+        dist.comm.comms_logger = probe
+        aval = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+        fn = shard_map(lambda t: dist.all_reduce(t, group="data"),
+                       mesh=dp8_mesh, in_specs=(P("data"),),
+                       out_specs=P("data"))
+        jax.make_jaxpr(fn)(aval)              # traced → untimed record
+        # _profile sees the per-device shard: (8,16)/8 = (1,16) fp32
+        rec = probe.comms_dict["all_reduce"][1 * 16 * 4]
+        assert rec[0] == 1 and rec[4] == 0    # counted, but NOT timed
+        assert rec[1] == 0.0
+        # an eager (measured) sample joins with a REAL latency
+        x = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+        x = jax.device_put(x, NamedSharding(dp8_mesh, P("data")))
+        dist.eager_all_reduce_over_mesh(x, dp8_mesh)
+        eager = probe.comms_dict["all_reduce(eager)"][8 * 4 * 4]
+        assert eager[4] == 1 and eager[1] > 0.0
+        summary = probe.log_summary()
+        # traced rows show "-" for avg latency instead of a fake 0.000
+        traced_row = [ln for ln in summary.splitlines()
+                      if ln.startswith("all_reduce ")][0]
+        assert "-" in traced_row.split()
+    finally:
+        dist.comm.comms_logger = real
+
+
+def test_measured_collectives_land_in_registry(dp8_mesh, rng):
+    """dstfleet measured-collective layer: an eager all_reduce records a
+    real latency histogram (comm.all_reduce.latency_s) and measured
+    wire-byte counters (comm.all_reduce.bytes) into the registered
+    MetricsRegistry, with wire bytes EQUAL to the static SPMD budget
+    pricing (same collective_cost table) on the verb both sides cover."""
+    from deepspeed_tpu.comm.collective_cost import wire_bytes
+    from deepspeed_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    prev = dist.get_metrics_registry()
+    try:
+        dist.set_metrics_registry(reg)
+        x = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+        x = jax.device_put(x, NamedSharding(dp8_mesh, P("data")))
+        dist.eager_all_reduce_over_mesh(x, dp8_mesh)
+        lat = reg.histograms()["comm.all_reduce.latency_s"]
+        assert lat.count == 1 and lat.sum > 0.0
+        payload = 8 * 4 * 4
+        assert reg.counter("comm.all_reduce.payload_bytes") == payload
+        assert reg.counter("comm.all_reduce.bytes") \
+            == wire_bytes("psum", payload, 8)
+        assert reg.counter("comm.all_reduce.count") == 1
+        # barrier: measured wait, no payload
+        dist.barrier()
+        bar = reg.histograms()["comm.barrier.latency_s"]
+        assert bar.count == 1 and bar.sum >= 0.0
+        assert reg.counter("comm.barrier.bytes", 0) == 0
+    finally:
+        dist.set_metrics_registry(prev)
+
+
 def test_init_distributed_tpu_pod_discovery(monkeypatch):
     """TPU_WORKER_HOSTNAMES env (TPU pod metadata) resolves to a coordinator
     the way the reference discovers AzureML/SageMaker/MPI environments."""

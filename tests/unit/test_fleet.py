@@ -1,0 +1,497 @@
+"""dstfleet: cross-process metric aggregation, snapshot exchange,
+straggler detection, the labeled fleet exposition gate, the unified
+multi-registry /metrics endpoint, and the `dst top` probe.
+
+The load-bearing test is the MERGE PROPERTY: bucket-wise merge of K
+snapshots must be EXACTLY equal — counts, count, min/max clamps,
+percentile estimates — to one histogram that observed the union of the
+samples. Every fleet number downstream (merged percentiles, skew,
+burn rates over merged traffic) rests on that losslessness.
+"""
+
+import json
+import math
+import os
+import random
+
+import pytest
+
+from deepspeed_tpu.observability import (
+    FleetMonitor, Histogram, MetricsHTTPServer, MetricsRegistry,
+    RequestTracer, StragglerDetector, check_exposition, merge_fleet_dir,
+    multi_prometheus_text, prometheus_text, read_fleet_snapshots,
+    write_rank_snapshot,
+)
+from deepspeed_tpu.observability.fleet import (
+    host_collective_wait, host_step_time,
+)
+
+
+# --- the merge property -------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("hosts", [2, 5])
+def test_histogram_merge_equals_union_observation(seed, hosts):
+    """Property: merge(K snapshots) == observe(union of samples),
+    exactly — including below-lo / above-hi clamp carry-over (samples
+    span 1e-8..1e7 against the default 1e-6..1e5 range) and percentile
+    estimates at every quantile the summary reports."""
+    rng = random.Random(seed)
+    regs = [MetricsRegistry() for _ in range(hosts)]
+    union = Histogram()
+    for reg in regs:
+        for _ in range(rng.randrange(1, 400)):
+            v = 10 ** rng.uniform(-8, 7)       # exercises both clamps
+            reg.observe("lat_s", v)
+            union.observe(v)
+    merged = MetricsRegistry.merge(
+        {f"rank{i}": r.fleet_snapshot(host=f"rank{i}")
+         for i, r in enumerate(regs)})
+    got = merged.histograms()["lat_s"]
+    assert got.bucket_counts == union.bucket_counts
+    assert got.count == union.count
+    assert got.min == union.min and got.max == union.max
+    assert got.sum == pytest.approx(union.sum, rel=1e-12)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+        assert got.percentile(q) == union.percentile(q), q
+    assert got.summary() == pytest.approx(union.summary())
+
+
+def test_histogram_merge_is_order_invariant_and_chainable():
+    rng = random.Random(7)
+    regs = [MetricsRegistry() for _ in range(3)]
+    for reg in regs:
+        for _ in range(100):
+            reg.observe("x", 10 ** rng.uniform(-5, 4))
+    snaps = [r.fleet_snapshot(host=f"h{i}") for i, r in enumerate(regs)]
+    a = MetricsRegistry.merge(snaps)
+    b = MetricsRegistry.merge(list(reversed(snaps)))
+    assert a.histograms()["x"].bucket_counts \
+        == b.histograms()["x"].bucket_counts
+    # merging a merged snapshot (fleet-of-fleets) keeps counts exact
+    c = MetricsRegistry.merge([a.fleet_snapshot(host="agg")])
+    assert c.histograms()["x"].count == sum(
+        r.histograms()["x"].count for r in regs)
+
+
+def test_histogram_state_round_trip_and_empty_minmax():
+    h = Histogram()
+    assert Histogram.from_state(h.state()).summary() == h.summary()
+    h.observe(3.0)
+    st = h.state()
+    assert st["min"] == 3.0
+    back = Histogram.from_state(
+        json.loads(json.dumps(st)))      # JSON round trip (rank files)
+    assert back.bucket_counts == h.bucket_counts
+    assert back.percentile(0.5) == h.percentile(0.5)
+
+
+def test_histogram_merge_layout_mismatch_raises():
+    a, b = Histogram(), Histogram(lo=1e-3, hi=1e3)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        a.merge_state(b.state())
+
+
+def test_merge_semantics_counters_gauges_sections():
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.inc("reqs", 10)
+    rb.inc("reqs", 32)
+    ra.set_gauge("occupancy", 0.2)
+    rb.set_gauge("occupancy", 0.8)
+    ra.register_collector("cache", lambda: {"hits": 5, "label": "x"})
+    merged = MetricsRegistry.merge(
+        {"r0": ra.fleet_snapshot(host="r0"),
+         "r1": rb.fleet_snapshot(host="r1")})
+    assert merged.counter("reqs") == 42          # counters SUM
+    # gauges: per-host labeled series + min/mean/max
+    assert merged.labeled_gauges()["occupancy"] == {"r0": 0.2, "r1": 0.8}
+    assert merged.gauge("occupancy.min") == 0.2
+    assert merged.gauge("occupancy.mean") == pytest.approx(0.5)
+    assert merged.gauge("occupancy.max") == 0.8
+    assert merged.gauge("fleet.hosts") == 2
+    # collector-section numeric leaves become labeled series too
+    assert merged.labeled_gauges()["cache.hits"] == {"r0": 5}
+
+
+# --- file-based snapshot exchange ---------------------------------------------
+
+def test_fleet_dir_round_trip_and_merge(tmp_path):
+    d = str(tmp_path)
+    regs = []
+    for i in range(3):
+        r = MetricsRegistry()
+        r.inc("tokens", 100 * (i + 1))
+        r.observe("step_s", 0.1 * (i + 1))
+        regs.append(r)
+        path = write_rank_snapshot(d, i, r)
+        assert os.path.basename(path) == f"rank{i}.json"
+    snaps = read_fleet_snapshots(d)
+    assert sorted(snaps) == ["rank0", "rank1", "rank2"]
+    merged = merge_fleet_dir(d)
+    assert merged.counter("tokens") == 600
+    assert merged.histograms()["step_s"].count == 3
+    # no tempfile litter from the atomic publish
+    assert all(f.startswith("rank") for f in os.listdir(d))
+    # re-publish overwrites in place (atomic replace, same rank file)
+    regs[0].inc("tokens", 1)
+    write_rank_snapshot(d, 0, regs[0])
+    assert merge_fleet_dir(d).counter("tokens") == 601
+
+
+def test_fleet_dir_skips_unreadable_rank_file(tmp_path):
+    d = str(tmp_path)
+    r = MetricsRegistry()
+    r.inc("c", 1)
+    write_rank_snapshot(d, 0, r)
+    with open(os.path.join(d, "rank1.json"), "w") as f:
+        f.write("{half a json")
+    snaps = read_fleet_snapshots(d)
+    assert sorted(snaps) == ["rank0"]            # bad file skipped loudly
+    assert merge_fleet_dir(str(tmp_path / "missing")).snapshot()[
+        "counters"] == {}
+
+
+# --- straggler detection ------------------------------------------------------
+
+def test_straggler_fires_exactly_once_after_n_windows():
+    m = MetricsRegistry()
+    tr = RequestTracer()
+    det = StragglerDetector(threshold=1.5, windows=3, metrics=m,
+                            tracer=tr)
+    fleet = {"rank0": 1.0, "rank1": 1.0, "rank2": 1.0, "rank3": 2.6}
+    assert det.update(fleet) is None             # window 1
+    assert det.update(fleet) is None             # window 2
+    w = det.update(fleet)                        # window 3: fires
+    assert w is not None and w["host"] == "rank3"
+    assert w["skew"] == pytest.approx(2.6)
+    # a PERSISTENT straggler stays one warning, not a flood
+    for _ in range(5):
+        assert det.update(fleet) is None
+    assert m.counter("fleet.straggler_warnings") == 1
+    assert m.gauge("fleet.step_time.skew") == pytest.approx(2.6)
+    assert m.gauge("fleet.step_time.slowest_host") == 3
+    instants = [e for e in tr.events if e["name"] == "STRAGGLER"]
+    assert len(instants) == 1
+    # recovery re-arms the episode
+    ok = {h: 1.0 for h in fleet}
+    det.update(ok)
+    for _ in range(3):
+        det.update(fleet)
+    assert m.counter("fleet.straggler_warnings") == 2
+
+
+def test_straggler_suspect_change_resets_episode():
+    det = StragglerDetector(threshold=1.5, windows=2)
+    det.update({"a": 1.0, "b": 1.0, "c": 3.0})
+    # the slow host CHANGES — not the same straggler, episode restarts
+    assert det.update({"a": 3.0, "b": 1.0, "c": 1.0}) is None
+    assert det.update({"a": 3.0, "b": 1.0, "c": 1.0}) is not None
+    assert det.warnings[0]["host"] == "a"
+
+
+def test_straggler_threshold_validation_and_single_host():
+    with pytest.raises(ValueError):
+        StragglerDetector(threshold=1.0)
+    det = StragglerDetector()
+    assert det.update({"only": 5.0}) is None     # skew vs itself = 1.0
+    assert det.update({}) is None
+    assert det.update({"a": float("nan")}) is None
+
+
+# --- FleetMonitor -------------------------------------------------------------
+
+def _rank_registry(step_s, comm_wait_s=None):
+    r = MetricsRegistry()
+    r.set_gauge("train.step_time_s", step_s)
+    r.inc("train.samples", 8)
+    r.observe("train.timer.train_batch_s", step_s)
+    if comm_wait_s is not None:
+        r.observe("comm.all_reduce.latency_s", comm_wait_s)
+    return r
+
+
+def test_fleet_monitor_publish_aggregate_and_skew(tmp_path):
+    d = str(tmp_path)
+    # ranks 1..3 publish from their own registries (equal collective
+    # waits: only the STEP-TIME signal should fire below)
+    for i, step in enumerate((0.1, 0.1, 0.35), start=1):
+        write_rank_snapshot(d, i, _rank_registry(step, 0.01))
+    local = _rank_registry(0.1, 0.01)
+    mon = FleetMonitor(d, 0, metrics=local, straggler_threshold=1.5,
+                       straggler_windows=1)
+    merged = mon.publish_and_aggregate()
+    assert merged is not None
+    assert merged.counter("train.samples") == 32
+    # skew gauges land on BOTH the local registry and the merged view
+    assert local.gauge("fleet.step_time.skew") == pytest.approx(3.5)
+    assert merged.gauge("fleet.step_time.skew") == pytest.approx(3.5)
+    assert local.gauge("fleet.step_time.slowest_host") == 3
+    assert local.counter("fleet.straggler_warnings") == 1
+    assert merged.counter("fleet.straggler_warnings") == 1
+    # collective-wait skew tracked independently (flat here)
+    assert local.gauge("fleet.collective_wait.skew") \
+        == pytest.approx(1.0)
+    # a LATER aggregation — after rank 0 published a snapshot already
+    # carrying the warning counter — must not double-count it
+    merged2 = mon.publish_and_aggregate()
+    assert merged2.counter("fleet.straggler_warnings") == 1
+    # non-zero ranks publish but do not aggregate
+    mon1 = FleetMonitor(d, 1, metrics=_rank_registry(0.1))
+    assert mon1.publish_and_aggregate() is None
+
+
+def test_host_signal_extraction_fallbacks():
+    r = MetricsRegistry()
+    assert host_step_time(r.fleet_snapshot()) is None
+    assert host_collective_wait(r.fleet_snapshot()) is None
+    r.observe("serve.decode_chunk_s", 0.2)
+    r.observe("serve.decode_chunk_s", 0.4)
+    assert host_step_time(r.fleet_snapshot()) == pytest.approx(0.3)
+    r.set_gauge("train.step_time_s", 0.7)        # gauge outranks hist
+    assert host_step_time(r.fleet_snapshot()) == pytest.approx(0.7)
+    r.observe("comm.barrier.latency_s", 0.05)
+    assert host_collective_wait(r.fleet_snapshot()) \
+        == pytest.approx(0.05)
+
+
+# --- labeled fleet exposition gate (CI satellite) -----------------------------
+
+def test_fleet_exposition_host_labels_and_no_collisions(tmp_path):
+    """Tier-1 gate: check_exposition on a REAL merged fleet exposition —
+    host labels present on every per-host series, zero name
+    collisions, histogram structure valid."""
+    d = str(tmp_path)
+    for i in range(4):
+        r = _rank_registry(0.1 * (i + 1), 0.02)
+        r.inc("serve.tokens_generated", 50 * i)
+        r.set_gauge("serve.goodput", 1.0 - 0.1 * i)
+        write_rank_snapshot(d, i, r)
+    merged = merge_fleet_dir(d)
+    text = prometheus_text(merged)
+    problems = check_exposition(text)
+    assert problems == [], problems
+    assert "dstprof_export_name_collisions_total" not in text
+    for i in range(4):
+        assert f'host="rank{i}"' in text
+    # per-host series render ONE TYPE line with one sample per host
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("serve_goodput{")]
+    assert len(lines) == 4
+    samples, _, _ = __import__(
+        "deepspeed_tpu.observability.promexport",
+        fromlist=["parse_prometheus_text"]
+    ).parse_prometheus_text(text)
+    hosts = {lbl["host"] for lbl, _ in samples["serve_goodput"]}
+    assert hosts == {f"rank{i}" for i in range(4)}
+
+
+# --- unified multi-registry endpoint (satellite) ------------------------------
+
+def test_multi_registry_exposition_disjoint_and_collision_paths():
+    serve, train = MetricsRegistry(), MetricsRegistry()
+    serve.inc("serve.tokens_generated", 5)
+    serve.observe("serve.ttft_s", 0.5)
+    train.inc("train.samples", 3)
+    train.observe("train.step_s", 0.1)
+    text = multi_prometheus_text({"serve": serve, "train": train})
+    assert check_exposition(text) == []
+    assert "serve_tokens_generated_total" in text
+    assert "train_samples_total" in text
+    assert "dstfleet_export_registry_collisions_total" not in text
+    # collision: the later section re-renders name-prefixed, loudly
+    text2 = multi_prometheus_text({"a": serve, "b": serve})
+    assert check_exposition(text2) == []
+    assert "b_serve_tokens_generated_total" in text2
+    assert "dstfleet_export_registry_collisions_total" in text2
+
+
+def test_multi_registry_http_server_and_callable_values():
+    serve, train = MetricsRegistry(), MetricsRegistry()
+    serve.inc("serve.tokens_generated", 7)
+    flushed = {"n": 0}
+
+    def train_fn():
+        flushed["n"] += 1
+        return train
+
+    srv = MetricsHTTPServer.for_registries(
+        {"serve": serve, "train": train_fn}, port=0)
+    try:
+        port = srv.start()
+        import urllib.request
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert check_exposition(text) == []
+        assert "serve_tokens_generated_total" in text
+        assert flushed["n"] >= 1                 # callable invoked per render
+        raw = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json",
+            timeout=5).read().decode())
+        assert raw["serve"]["counters"]["serve.tokens_generated"] == 7
+        assert "train" in raw
+    finally:
+        srv.stop()
+
+
+# --- dst top (CI smoke satellite) ---------------------------------------------
+
+def _top_registry():
+    r = MetricsRegistry()
+    r.inc("serve.tokens_sampled", 200)
+    r.inc("serve.tokens_delivered", 180)
+    r.inc("serve.completions.COMPLETED", 9)
+    r.inc("serve.completions.TIMED_OUT", 1)
+    r.set_gauge("serve.goodput", 0.9)
+    r.set_gauge("serve.active_slots", 4)
+    r.set_gauge("serve.slo.ttft.burn_rate.300s", 0.5)
+    r.set_gauge("fleet.step_time.skew", 1.4)
+    for v in (0.2, 0.4, 0.9):
+        r.observe("serve.ttft_s", v)
+    return r
+
+
+def test_dst_top_once_json_smoke(capsys):
+    """The CI smoke: `dst top --once --json` against a live /metrics
+    endpoint returns rc 0 and a parseable sample with the dashboard's
+    headline numbers."""
+    from deepspeed_tpu.tools.dsttop import main
+
+    srv = MetricsHTTPServer(lambda: prometheus_text(_top_registry()),
+                            json_fn=_top_registry().snapshot, port=0)
+    try:
+        port = srv.start()
+        rc = main(["--url", f"http://127.0.0.1:{port}", "--once",
+                   "--json"])
+        assert rc == 0
+        sample = json.loads(capsys.readouterr().out)
+        assert sample["goodput"] == 0.9
+        assert sample["slots"]["active"] == 4
+        assert sample["tokens"]["delivered"] == 180
+        assert sample["burn_rates"] == {"ttft.burn_rate.300s": 0.5}
+        assert sample["fleet"] == {"fleet.step_time.skew": 1.4}
+        assert sample["latency"]["ttft_s"]["count"] == 3
+    finally:
+        srv.stop()
+    # unreachable endpoint: clean non-zero exit, no traceback
+    assert main(["--url", "http://127.0.0.1:9", "--once"]) == 1
+
+
+def test_dst_top_sample_and_render_pure():
+    from deepspeed_tpu.tools.dsttop import build_sample, render_text
+
+    snap0 = _top_registry().snapshot()
+    reg = _top_registry()
+    reg.inc("serve.tokens_sampled", 50)
+    sample = build_sample(reg.snapshot(), prev=snap0, dt=2.0)
+    assert sample["tokens"]["per_sec"] == pytest.approx(25.0)
+    text = render_text(sample)
+    assert "goodput 0.900" in text and "TTFT" in text
+    assert "burn" in text and "fleet" in text
+    # no-rate mode (--once): rate fields null, still renders
+    assert build_sample(snap0)["tokens"]["per_sec"] is None
+    assert "tok/s -" in render_text(build_sample(snap0))
+
+
+# --- the two engines' registries stay collision-free (satellite pin) ----------
+
+def test_engine_registries_collision_free_on_one_port():
+    """A process running BOTH engines exposes one /metrics: pin that
+    the real serve and train registries produce a clean merged
+    exposition with ZERO cross-registry name collisions."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    inf = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params,
+        model_config=cfg)
+    rng = np.random.default_rng(0)
+    inf.serve([Request(rid=i, prompt=rng.integers(1, 256, 5),
+                       max_new_tokens=3) for i in range(2)],
+              num_slots=2, block_size=4)
+
+    def batch(n):
+        t = rng.integers(0, 256, size=(n, 17))
+        return {"input_ids": t[:, :-1], "labels": t[:, 1:]}
+
+    train = deepspeed_tpu.initialize(
+        model=LlamaModel(LlamaConfig.tiny(dtype=jnp.float32)),
+        sample_batch=batch(2),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 10_000})
+    train.train_batch(batch(train.train_batch_size()))
+    train.flush_train_telemetry()
+
+    text = multi_prometheus_text({"serve": inf.metrics,
+                                  "train": train.metrics})
+    assert check_exposition(text) == []
+    assert "dstfleet_export_registry_collisions_total" not in text, \
+        "serve and train registries grew a colliding metric name"
+    # one port for both engines, end to end
+    port = inf.start_metrics_server(port=0,
+                                    extra_registries={"train":
+                                                      train.metrics})
+    try:
+        import urllib.request
+
+        scraped = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert check_exposition(scraped) == []
+        assert "dstfleet_export_registry_collisions_total" not in scraped
+    finally:
+        inf.stop_metrics_server()
+
+
+def test_serve_metrics_fleet_end_to_end(tmp_path):
+    """serve_metrics(fleet=True): the engine publishes its own rank
+    snapshot and returns the merged labeled view; the exposition gate
+    runs on the result."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = deepspeed_tpu.init_inference(
+        model=model, params=params, model_config=cfg,
+        config={"dtype": "float32",
+                "serve": {"fleet_dir": str(tmp_path), "fleet_rank": 0}})
+    rng = np.random.default_rng(0)
+    eng.serve([Request(rid=i, prompt=rng.integers(1, 256, 5),
+                       max_new_tokens=3) for i in range(2)],
+              num_slots=2, block_size=4)
+    # a second replica's snapshot already sits in the exchange
+    other = MetricsRegistry()
+    other.inc("serve.tokens_generated", 11)
+    other.observe("serve.ttft_s", 0.2)
+    other.set_gauge("serve.goodput", 0.5)     # labeled series source
+    write_rank_snapshot(str(tmp_path), 1, other)
+
+    merged = eng.serve_metrics(fleet=True)
+    assert merged["counters"]["serve.tokens_generated"] \
+        == eng.metrics.counter("serve.tokens_generated") + 11
+    assert merged["gauges"]["fleet.hosts"] == 2
+    text = eng.serve_metrics(format="prometheus", fleet=True)
+    assert check_exposition(text) == []
+    assert 'host="rank0"' in text and 'host="rank1"' in text
+    # unconfigured fleet_dir fails fast
+    eng2_cfg = eng._config.serve
+    eng2_cfg.fleet_dir = None
+    with pytest.raises(ValueError, match="fleet_dir"):
+        eng.serve_metrics(fleet=True)

@@ -1,0 +1,235 @@
+"""SLO/goodput layer (observability/slo.py): config validation,
+rolling-window burn-rate math, goodput accounting at the scheduler's
+terminal funnel, and breach-instant semantics.
+
+Burn-rate arithmetic is tested against hand-counted fractions with an
+injected clock — no wall-clock sleeps; the scheduler-level tests run
+the real FakeExecutor path so goodput reflects genuine terminal
+accounting (timeouts and preemption waste), not synthetic counters.
+"""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.observability import (
+    Histogram, MetricsRegistry, RequestTracer, SLOConfig, SLOTracker,
+)
+from deepspeed_tpu.observability.slo import count_over_threshold
+
+
+# --- config -------------------------------------------------------------------
+
+def test_slo_config_parse_and_validation():
+    assert SLOConfig.from_dict(None) is None
+    assert SLOConfig.from_dict({}) is None
+    cfg = SLOConfig.from_dict({"ttft_p95_s": 2.0, "availability": 0.999,
+                               "windows_s": [60, 600]})
+    assert cfg.ttft_p95_s == 2.0
+    assert cfg.windows_s == (60.0, 600.0)
+    with pytest.raises(ValueError, match="unknown keys"):
+        SLOConfig.from_dict({"ttft_p95": 2.0})      # typo fails FAST
+    with pytest.raises(ValueError, match="availability"):
+        SLOConfig(availability=1.5)
+    with pytest.raises(ValueError, match="ttft_p95_s"):
+        SLOConfig(ttft_p95_s=-1.0)
+    with pytest.raises(ValueError, match="windows_s"):
+        SLOConfig(windows_s=())
+
+
+def test_count_over_threshold_bucket_edges():
+    h = Histogram()
+    for v in (0.5, 1.0, 2.0, 4.0, 100.0):
+        h.observe(v)
+    assert count_over_threshold(h, 50.0) == 1
+    assert count_over_threshold(h, 3.0) == 2
+    assert count_over_threshold(h, 0.01) == 5
+    assert count_over_threshold(h, 1e6) == 0        # above hi: overflow only
+    h2 = Histogram()
+    h2.observe(1e9)                                  # overflow bucket
+    assert count_over_threshold(h2, 1e6) == 1
+    assert count_over_threshold(h2, 1.0) == 1
+
+
+# --- burn-rate windows --------------------------------------------------------
+
+def make_tracker(reg, **cfg):
+    cfg.setdefault("windows_s", (10.0,))
+    cfg.setdefault("min_interval_s", 0.0)
+    clock = {"t": 0.0}
+    tr = SLOTracker(reg, SLOConfig(**cfg), clock=lambda: clock["t"])
+    return tr, clock
+
+
+def test_burn_rate_counts_bad_fraction_over_allowed():
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, ttft_p95_s=1.0)
+    # 10% of requests above the 1s target → bad 0.1 / allowed 0.05 = 2x
+    for i in range(100):
+        reg.observe("serve.ttft_s", 5.0 if i < 10 else 0.5)
+    tr.tick()
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") \
+        == pytest.approx(2.0)
+    # a clean follow-up window decays the rate once the bad marks age out
+    clock["t"] = 20.0
+    for _ in range(50):
+        reg.observe("serve.ttft_s", 0.5)
+    tr.tick()
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") == 0.0
+
+
+def test_availability_burn_rate_and_error_statuses():
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, availability=0.99)
+    reg.inc("serve.completions.COMPLETED", 96)
+    reg.inc("serve.completions.FAILED", 2)
+    reg.inc("serve.completions.TIMED_OUT", 1)
+    reg.inc("serve.completions.REJECTED", 1)
+    reg.inc("serve.completions.CANCELLED", 10)       # client-initiated
+    tr.tick()
+    # errors 4 / total 110 over allowed 0.01
+    assert reg.gauge("serve.slo.availability.burn_rate.10s") \
+        == pytest.approx((4 / 110) / 0.01)
+
+
+def test_multi_window_and_base_keeps_pre_horizon_mark():
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, ttft_p95_s=1.0,
+                             windows_s=(10.0, 100.0))
+    for _ in range(20):
+        reg.observe("serve.ttft_s", 5.0)             # all bad, early
+    tr.tick()
+    clock["t"] = 50.0
+    for _ in range(80):
+        reg.observe("serve.ttft_s", 0.5)             # all good, late
+    tr.tick()
+    # 10s window: only the late good traffic → 0; 100s window: all of it
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") == 0.0
+    assert reg.gauge("serve.slo.ttft.burn_rate.100s") \
+        == pytest.approx((20 / 100) / 0.05)
+    # marks far past every window evict, but the subtraction base stays
+    for t in (120.0, 130.0, 140.0, 260.0):
+        clock["t"] = t
+        tr.tick()
+    assert len(tr._marks) <= 4
+
+
+def test_goodput_gauge_and_breach_instants():
+    reg = MetricsRegistry()
+    tracer = RequestTracer()
+    tr, clock = make_tracker(reg, ttft_p95_s=1.0, breach_burn_rate=1.0)
+    tr.tracer = tracer
+    reg.inc("serve.tokens_sampled", 100)
+    reg.inc("serve.tokens_delivered", 70)
+    for _ in range(10):
+        reg.observe("serve.ttft_s", 9.0)             # 100% bad → burn 20
+    tr.tick()
+    assert reg.gauge("serve.goodput") == pytest.approx(0.7)
+    assert reg.counter("serve.slo.ttft.breaches") == 1
+    breaches = [e for e in tracer.events if e["name"] == "SLO_BREACH"]
+    assert len(breaches) == 1
+    # still breaching: no second instant (one per episode)
+    clock["t"] = 1.0
+    reg.observe("serve.ttft_s", 9.0)
+    tr.tick()
+    assert reg.counter("serve.slo.ttft.breaches") == 1
+    # recovery, then a new breach → second instant
+    clock["t"] = 30.0
+    tr.tick()                                        # window empty → burn 0
+    clock["t"] = 31.0
+    for _ in range(10):
+        reg.observe("serve.ttft_s", 9.0)
+    tr.tick()
+    assert reg.counter("serve.slo.ttft.breaches") == 2
+
+
+def test_tracker_reset_after_registry_reset():
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, ttft_p95_s=1.0)
+    for _ in range(10):
+        reg.observe("serve.ttft_s", 9.0)
+    tr.tick()
+    reg.reset()
+    tr.reset()
+    clock["t"] = 1.0
+    tr.tick()                                        # must not go negative
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") == 0.0
+
+
+def test_section_refreshes_and_reports_targets():
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, ttft_p95_s=2.0, availability=0.999)
+    reg.inc("serve.tokens_sampled", 10)
+    reg.inc("serve.tokens_delivered", 10)
+    sec = tr.section()                               # pull-time tick
+    assert sec["goodput"] == 1.0
+    assert sec["target.ttft_p95_s"] == 2.0
+    assert sec["target.availability"] == 0.999
+    assert "ttft.burn_rate.10s" in sec
+
+
+# --- scheduler integration (terminal-funnel goodput) --------------------------
+
+def test_scheduler_goodput_degrades_on_timeout_and_preemption():
+    """Real terminal accounting on the FakeExecutor path: a TIMED_OUT
+    request's sampled-but-undelivered tokens drag serve.goodput below
+    1.0, while an all-COMPLETED run pins it at exactly 1.0."""
+    from tests.unit.inference.test_scheduler import (
+        FakeExecutor, drain, req,
+    )
+    from deepspeed_tpu.inference.kv_pool import BlockPool
+    from deepspeed_tpu.inference.scheduler import (
+        COMPLETED, TIMED_OUT, ContinuousBatchingScheduler,
+    )
+
+    # clean run: goodput exactly 1
+    m = MetricsRegistry()
+    sched = ContinuousBatchingScheduler(FakeExecutor(), 2,
+                                        BlockPool(17, 4), 6, metrics=m)
+    for i in range(3):
+        sched.submit(req(i, plen=4, gen=3))
+    comps = drain(sched)
+    assert all(c.status == COMPLETED for c in comps)
+    assert m.gauge("serve.goodput") == 1.0
+    assert m.counter("serve.tokens_delivered") \
+        == m.counter("serve.tokens_generated")
+
+    # a request that times out MID-decode: its sampled tokens were work
+    # done but never delivered inside the deadline — a slow chunk (the
+    # chaos injector's site) pushes wall time past the deadline after
+    # the first decode chunk already sampled tokens
+    from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+
+    m2 = MetricsRegistry()
+    fi = FaultInjector([FaultSpec(site="slow", step=1, seconds=0.05)])
+    sched2 = ContinuousBatchingScheduler(FakeExecutor(), 2,
+                                         BlockPool(17, 4), 6, metrics=m2,
+                                         fault_injector=fi)
+    sched2.submit(req(0, plen=4, gen=4))
+    sched2.submit(req(1, plen=4, gen=16, deadline_s=0.02))
+    comps2 = {c.rid: c for c in drain(sched2)}
+    assert comps2[1].status == TIMED_OUT
+    assert m2.gauge("serve.goodput") < 1.0
+    assert m2.counter("serve.tokens_delivered") \
+        < m2.counter("serve.tokens_sampled")
+
+
+def test_scheduler_ticks_slo_tracker_at_chunk_boundaries():
+    from tests.unit.inference.test_scheduler import (
+        FakeExecutor, drain, req,
+    )
+    from deepspeed_tpu.inference.kv_pool import BlockPool
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    m = MetricsRegistry()
+    tr, clock = make_tracker(m, ttft_p95_s=10.0, availability=0.9)
+    sched = ContinuousBatchingScheduler(FakeExecutor(), 2,
+                                        BlockPool(17, 4), 6, metrics=m,
+                                        slo=tr)
+    for i in range(3):
+        sched.submit(req(i, plen=4, gen=3))
+    drain(sched)
+    assert len(tr._marks) >= 1                       # ticked during steps
+    assert m.gauge("serve.slo.availability.burn_rate.10s") == 0.0

@@ -630,6 +630,33 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
         f" x 0.05) diverges from bench recount {bench_bad_frac:.4f} by "
         f"{burn_agree:.3f} (> 0.05 abs) at target {slo_target:.4f}s")
 
+    # --- dstmem static-vs-measured memory cross-check (ISSUE 14) -------------
+    # the static serving-memory prediction (the same eval_shape sizing
+    # arithmetic the dstlint memory pass budgets) against dstprof's
+    # serve.memory gauges — the memory twin of the comms budgets'
+    # static==measured wire-byte pin. Pool AND param device bytes must
+    # agree within 10%.
+    from deepspeed_tpu.tools.dstlint import mempass
+
+    serve_mem = snap.get("serve.memory", {})
+    static_mem = mempass.predict_serve_memory(
+        cfg, num_slots=num_slots, block_size=block_size,
+        max_context=max(len(p) + g for p, g, _ in trace),
+        dtype=cfg.dtype, params=params)
+    mem_agree = {}
+    for quantity, cmp in mempass.compare_serve_memory(
+            static_mem, serve_mem).items():
+        assert cmp["agreement"] <= 0.10, (
+            f"measured {quantity} {cmp['measured']} diverges from the "
+            f"static prediction {cmp['static']} by "
+            f"{cmp['agreement']:.1%} (> 10%) — the sizing arithmetic "
+            f"and the device drifted apart")
+        mem_agree[quantity] = {
+            "static": cmp["static"],
+            "measured": cmp["measured"],
+            "agreement_pct": round(cmp["agreement"] * 100, 2),
+        }
+
     trace_file = "BENCH_TRACE.json"
     with open(trace_file, "w") as f:
         json.dump(chrome_trace, f, default=str)
@@ -650,6 +677,12 @@ def serve_main(num_slots=None, n_requests=None, decode_chunk=None,
             "gen_cache_compiles": sum(
                 e["compiles"]
                 for e in compile_section.get("gen", {}).values()),
+        },
+        "memory": {
+            "static_vs_measured": mem_agree,
+            "num_blocks": static_mem["num_blocks"],
+            "block_bytes": static_mem["block_bytes"],
+            "serve_memory_section": serve_mem,
         },
         "ttft_p50_engine_s": round(eng_ttft_p50, 4),
         "ttft_p50_bench_s": round(bench_ttft_p50, 4),

@@ -1,6 +1,6 @@
 """dstlint — the framework's JAX/TPU invariant checker.
 
-Three backends behind one finding stream:
+Four backends behind one finding stream:
 
 - **AST pass** (:mod:`.astpass`): framework-specific rules over the
   package source — the ``utils/jax_compat`` seam, host syncs inside
@@ -23,6 +23,13 @@ Three backends behind one finding stream:
   collectives, comms-budget drift, accidental full replication,
   over-wide reduction dtypes, wrong-axis psums inside ``shard_map``
   bodies, and unbudgeted collectives inside decode ``while_loop``s.
+- **memory pass** (:mod:`.mempass`): linear-scan liveness over the
+  same abstractly-traced entry points, computing deterministic
+  peak-live-bytes per program (donation aliasing, scan/while
+  carried-buffer reuse, per-shard input sizes) pinned in
+  ``tools/dstlint/mem_budgets.json``; a static per-``pallas_call``
+  VMEM estimator with dtype-tile alignment checks; a dead-donation
+  verifier; and a configurable per-device HBM OOM-risk cap.
 
 CLI: ``bin/dst lint`` (see :mod:`.cli`); library entry:
 :func:`run_lint`. Rule catalog: ``docs/LINT.md``.

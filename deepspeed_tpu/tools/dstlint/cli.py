@@ -10,10 +10,18 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import traceback
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from deepspeed_tpu.tools.dstlint import core
+from deepspeed_tpu.tools.dstlint.astpass import AST_RULES
+from deepspeed_tpu.tools.dstlint.jaxprpass import JAXPR_RULES
+from deepspeed_tpu.tools.dstlint.mempass import MEM_RULES
+from deepspeed_tpu.tools.dstlint.spmdpass import SPMD_RULES
+
+ALL_RULES = tuple(AST_RULES) + tuple(JAXPR_RULES) + tuple(SPMD_RULES) \
+    + tuple(MEM_RULES)
 
 
 def _repo_root() -> str:
@@ -55,17 +63,26 @@ def _iter_py_files(targets: List[str], root: str
 
 
 def build_parser() -> argparse.ArgumentParser:
+    rule_catalog = (
+        "rule ids — AST: " + ", ".join(AST_RULES) +
+        "; jaxpr: " + ", ".join(JAXPR_RULES) +
+        "; spmd: " + ", ".join(SPMD_RULES) +
+        "; mem: " + ", ".join(MEM_RULES))
     p = argparse.ArgumentParser(
         prog="dst lint",
         description="static analysis of the framework's JAX/TPU "
-                    "invariants (rule catalog: docs/LINT.md)")
+                    "invariants (rule catalog: docs/LINT.md)",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog=rule_catalog)
     p.add_argument("paths", nargs="*",
                    help="files/dirs to lint (default: the "
                         "deepspeed_tpu package)")
     p.add_argument("--select", default="",
-                   help="comma-separated rule ids to run (default all)")
+                   help="comma-separated rule ids to run (default all; "
+                        "see the full catalog at the bottom of --help)")
     p.add_argument("--ignore", default="",
-                   help="comma-separated rule ids to skip")
+                   help="comma-separated rule ids to skip (full catalog "
+                        "at the bottom of --help)")
     p.add_argument("--format", choices=("text", "json", "github"),
                    default="text",
                    help="github emits workflow-command annotations "
@@ -77,20 +94,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rewrite the baseline from current findings "
                         "(grandfather everything currently firing)")
     p.add_argument("--no-jaxpr", action="store_true",
-                   help="skip the jaxpr AND spmd entry-point passes "
-                        "(no jax import; milliseconds instead of "
-                        "seconds)")
+                   help="skip the jaxpr, SPMD AND memory entry-point "
+                        "passes (no jax import; milliseconds instead "
+                        "of seconds)")
     p.add_argument("--no-spmd", action="store_true",
                    help="skip only the SPMD sharding/collective pass")
+    p.add_argument("--no-mem", action="store_true",
+                   help="skip only the memory liveness/VMEM pass")
     p.add_argument("--budgets", default=None,
                    help="jaxpr equation-budget file (default "
                         "tools/dstlint/jaxpr_budgets.json)")
     p.add_argument("--comms-budgets", default=None,
                    help="SPMD collective-inventory budget file (default "
                         "tools/dstlint/comms_budgets.json)")
+    p.add_argument("--mem-budgets", default=None,
+                   help="peak-memory budget file (default "
+                        "tools/dstlint/mem_budgets.json)")
+    p.add_argument("--hbm-gb", type=float, default=None,
+                   help="per-device HBM cap in GiB for the "
+                        "mem-oom-risk rule (overrides the budget "
+                        "file's hbm_cap_bytes)")
     p.add_argument("--update-budgets", action="store_true",
-                   help="re-trace the entry points and rewrite BOTH "
-                        "budget files (jaxpr eqn counts + spmd comms)")
+                   help="re-trace the entry points and atomically "
+                        "rewrite ALL budget files (jaxpr eqn counts + "
+                        "spmd comms + peak memory)")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings covered by the baseline")
     return p
@@ -108,6 +135,94 @@ def main(argv=None) -> int:
         return 2
 
 
+def _write_budget_file(path: str, payload: dict, root: str) -> None:
+    """Atomic per-file rewrite (tmp + os.replace) with a
+    changed/unchanged summary line — an interrupted regen can never
+    leave the budget files mutually skewed, and the summary shows which
+    files a PR actually has to commit."""
+    text = json.dumps(payload, indent=1, sort_keys=True) + "\n"
+    old: Optional[str] = None
+    try:
+        with open(path) as f:
+            old = f.read()
+    except OSError:
+        pass
+    rel = os.path.relpath(path, root)
+    if old == text:
+        print(f"dstlint: {rel}: unchanged "
+              f"({len(payload.get('entries', {}))} entries)")
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               prefix=os.path.basename(path) + ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError as e:
+            print(f"dstlint: leaked tmp file {tmp}: {e}",
+                  file=sys.stderr)
+        raise
+    state = "rewritten" if old is not None else "created"
+    print(f"dstlint: {rel}: {state} "
+          f"({len(payload.get('entries', {}))} entries)")
+
+
+def _update_budgets(budgets_path, comms_budgets_path, mem_budgets_path,
+                    root) -> int:
+    from deepspeed_tpu.tools.dstlint import jaxprpass, mempass, spmdpass
+
+    rc = 0
+    # trace ALL THREE backends first, write only when every trace ran —
+    # a crash mid-regen then leaves all files at their previous
+    # (mutually consistent) state instead of a skewed mix
+    reports = jaxprpass.trace_entry_points()
+    sreports = spmdpass.trace_spmd_entry_points()
+    mreports = mempass.trace_mem_entry_points()
+
+    budgets = jaxprpass.budgets_from_reports(reports)
+    _write_budget_file(budgets_path, budgets, root)
+    for name, rep in sorted(reports.items()):
+        status = rep.error or f"{rep.eqns} eqns, " \
+                              f"{rep.pallas_calls} pallas_call"
+        print(f"  {name}: {status}")
+    if any(r.error for r in reports.values()):
+        rc = 2
+
+    sbudgets = spmdpass.budgets_from_reports(sreports)
+    _write_budget_file(comms_budgets_path, sbudgets, root)
+    for name, rep in sorted(sreports.items()):
+        if rep.error:
+            status = rep.error
+        else:
+            inv = rep.inventory()
+            wire = sum(r["bytes"] for r in inv.values())
+            status = f"{len(inv)} collective keys, {wire} wire B"
+        print(f"  {name}: {status}")
+    if any(r.error for r in sreports.values()):
+        rc = 2
+
+    # preserve operator-configured caps across regens (the HBM cap and
+    # a per-chip VMEM override are fleet facts, not trace outputs)
+    old_mem = mempass.load_budgets(mem_budgets_path) or {}
+    mbudgets = mempass.budgets_from_reports(mreports)
+    if old_mem.get("hbm_cap_bytes"):
+        mbudgets["hbm_cap_bytes"] = old_mem["hbm_cap_bytes"]
+    if old_mem.get("vmem_limit_bytes"):
+        mbudgets["vmem_limit_bytes"] = old_mem["vmem_limit_bytes"]
+    _write_budget_file(mem_budgets_path, mbudgets, root)
+    for name, rep in sorted(mreports.items()):
+        status = rep.error or f"peak {rep.peak_bytes} B, " \
+                              f"{len(rep.pallas)} pallas kernel(s)"
+        print(f"  {name}: {status}")
+    if any(r.error for r in mreports.values()):
+        rc = 2
+    return rc
+
+
 def _main(argv) -> int:
     args = build_parser().parse_args(argv)
     root = _repo_root()
@@ -117,6 +232,8 @@ def _main(argv) -> int:
         root, "tools", "dstlint", "jaxpr_budgets.json")
     comms_budgets_path = args.comms_budgets or os.path.join(
         root, "tools", "dstlint", "comms_budgets.json")
+    mem_budgets_path = args.mem_budgets or os.path.join(
+        root, "tools", "dstlint", "mem_budgets.json")
 
     config = core.LintConfig(
         select={r.strip() for r in args.select.split(",") if r.strip()}
@@ -124,45 +241,12 @@ def _main(argv) -> int:
         ignore={r.strip() for r in args.ignore.split(",") if r.strip()})
 
     if args.update_budgets:
-        from deepspeed_tpu.tools.dstlint import jaxprpass, spmdpass
-
-        rc = 0
-        reports = jaxprpass.trace_entry_points()
-        budgets = jaxprpass.budgets_from_reports(reports)
-        os.makedirs(os.path.dirname(budgets_path), exist_ok=True)
-        with open(budgets_path, "w") as f:
-            json.dump(budgets, f, indent=1, sort_keys=True)
-            f.write("\n")
-        print(f"dstlint: wrote {len(budgets['entries'])} entry budgets "
-              f"to {os.path.relpath(budgets_path, root)}")
-        for name, rep in sorted(reports.items()):
-            status = rep.error or f"{rep.eqns} eqns, " \
-                                  f"{rep.pallas_calls} pallas_call"
-            print(f"  {name}: {status}")
-        if any(r.error for r in reports.values()):
-            rc = 2
-
-        sreports = spmdpass.trace_spmd_entry_points()
-        sbudgets = spmdpass.budgets_from_reports(sreports)
-        with open(comms_budgets_path, "w") as f:
-            json.dump(sbudgets, f, indent=1, sort_keys=True)
-            f.write("\n")
-        print(f"dstlint: wrote {len(sbudgets['entries'])} comms budgets "
-              f"to {os.path.relpath(comms_budgets_path, root)}")
-        for name, rep in sorted(sreports.items()):
-            if rep.error:
-                status = rep.error
-            else:
-                inv = rep.inventory()
-                wire = sum(r["bytes"] for r in inv.values())
-                status = f"{len(inv)} collective keys, {wire} wire B"
-            print(f"  {name}: {status}")
-        if any(r.error for r in sreports.values()):
-            rc = 2
-        return rc
+        return _update_budgets(budgets_path, comms_budgets_path,
+                               mem_budgets_path, root)
 
     files = _iter_py_files(args.paths or _default_targets(root), root)
     findings = core.run_lint(files, config)
+    backends = ["ast"]
 
     if not args.no_jaxpr:
         from deepspeed_tpu.tools.dstlint import jaxprpass
@@ -170,6 +254,7 @@ def _main(argv) -> int:
         jf = [f for f in jaxprpass.run_jaxpr_pass(budgets_path)
               if config.rule_enabled(f.rule)]
         findings.extend(jf)
+        backends.append("jaxpr")
 
     if not (args.no_jaxpr or args.no_spmd):
         from deepspeed_tpu.tools.dstlint import spmdpass
@@ -177,6 +262,17 @@ def _main(argv) -> int:
         sf = [f for f in spmdpass.run_spmd_pass(comms_budgets_path)
               if config.rule_enabled(f.rule)]
         findings.extend(sf)
+        backends.append("spmd")
+
+    if not (args.no_jaxpr or args.no_mem):
+        from deepspeed_tpu.tools.dstlint import mempass
+
+        cap = int(args.hbm_gb * (1 << 30)) if args.hbm_gb else None
+        mf = [f for f in mempass.run_mem_pass(mem_budgets_path,
+                                              hbm_cap_bytes=cap)
+              if config.rule_enabled(f.rule)]
+        findings.extend(mf)
+        backends.append("mem")
 
     line_texts = core.collect_line_texts(files, findings)
     if args.update_baseline:
@@ -196,6 +292,7 @@ def _main(argv) -> int:
         print(json.dumps({
             "version": 1,
             "files_checked": len(files),
+            "backends": backends,
             "findings": [f.to_json() for f in findings],
             "counts": {"active": len(active),
                        "baselined": len(findings) - len(active)},

@@ -1,0 +1,813 @@
+"""dstlint memory pass — static peak-HBM liveness and Pallas VMEM
+budgets.
+
+On TPU the run-killing memory failure is discovered at compile-and-run
+time, minutes in: HBM is fixed per chip and VMEM is ~16 MB per core, so
+buffer liveness and kernel block shapes have to be right *statically*.
+The jaxpr pass budgets how much COMPUTE the hot programs trace to, the
+SPMD pass how much COMMUNICATION they imply — this pass budgets how
+much MEMORY they need:
+
+- **peak-live-bytes per program** from a linear-scan liveness analysis
+  over the same abstractly-traced entry points the jaxpr/SPMD passes
+  drive (paged decode/prefill, ``copy_pool_blocks``, tiered-KV
+  spill/restore, ZeRO stage-1/2/3 train steps, the 1F1B pipeline).
+  The scan honors ``donate_argnums`` aliasing (a donated input frees at
+  its last use instead of doubling the workspace), scan/while
+  carried-buffer reuse (loop bodies contribute only their transient
+  intermediates beyond the carried I/O), and per-shard input sizes
+  under the abstract meshes (a stage-3 parameter shard is 1/N of the
+  tree). Peaks are pinned in ``tools/dstlint/mem_budgets.json`` with
+  the same ±25% drift rule as the jaxpr/comms budgets — regenerate
+  with ``bin/dst lint --update-budgets``.
+- **per-``pallas_call`` VMEM footprint** estimated from the traced
+  GridMapping: block shape × dtype for every input/output (×2 for the
+  double-buffered pipeline when the grid has >1 step), plus scratch
+  and scalar-prefetch operands. Projected overflow of the per-core
+  VMEM budget fails statically instead of at Mosaic compile time.
+- **tiling alignment**: a BlockSpec that *partitions* an array dim on
+  a boundary misaligned to the dtype's native tile — (8,128) fp32,
+  (16,128) bf16, (32,128) int8/fp8 — forces strided relayouts on every
+  DMA. Dims the block covers whole are exempt (a full small array in
+  VMEM just pads).
+
+Rules (catalog: docs/LINT.md):
+
+- ``mem-budget-drift``    peak-live-bytes drifting beyond the
+  checked-in budget, a budgeted entry missing from the trace, or an
+  entry failing to trace.
+- ``pallas-vmem-budget``  projected VMEM footprint of a traced
+  ``pallas_call`` exceeding the per-core budget.
+- ``pallas-tile-misalign`` a BlockSpec partitioning an array on a
+  non-tile-aligned boundary for its dtype.
+- ``dead-donation``       a donated argument whose buffer provably
+  cannot alias any output — no output shares its shape/dtype, or the
+  value is still live when every same-shaped output has already been
+  created. The donation silently does nothing and peak doubles.
+- ``mem-oom-risk``        a traced program's static peak exceeding the
+  configured per-device HBM cap (``hbm_cap_bytes`` in the budget file,
+  or ``bin/dst lint --hbm-gb``); the serving entries carry their
+  pool/param byte split so the finding names what to shrink.
+
+The measured twin lives in dstprof (``serve.memory`` pool/param byte
+gauges): ``bench.py --serve`` and ``bin/dst prof`` cross-check the
+static prediction from :func:`predict_serve_memory` against the live
+gauges — the same static==measured pin the comms budgets enforce for
+wire bytes.
+"""
+
+import dataclasses
+import json
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.tools.dstlint.core import Finding
+
+MEM_RULES = ("mem-budget-drift", "pallas-vmem-budget",
+             "pallas-tile-misalign", "dead-donation", "mem-oom-risk")
+
+DEFAULT_TOLERANCE_PCT = 25
+
+#: per-core on-chip vector memory budget (the TPU VMEM size class every
+#: generation in the Pallas guide shares; override per-repo via the
+#: ``vmem_limit_bytes`` key in mem_budgets.json)
+VMEM_LIMIT_BYTES = 16 * (1 << 20)
+
+#: native tile second-to-last-dim size (sublanes) by dtype itemsize;
+#: the last dim is always 128 lanes
+_SUBLANES = {8: 8, 4: 8, 2: 16, 1: 32}
+_LANES = 128
+
+_CALL_PRIMS = {"pjit", "closed_call", "core_call", "xla_call", "remat",
+               "remat2", "checkpoint", "custom_jvp_call",
+               "custom_jvp_call_jaxpr", "custom_vjp_call",
+               "custom_vjp_call_jaxpr", "custom_lin"}
+
+#: single-input, size-preserving prims that keep their input's shard
+#: divisor (everything else conservatively becomes full-size)
+_DIV_CARRIERS = {"convert_element_type", "copy", "neg", "transpose",
+                 "reshape", "reduce_precision", "stop_gradient"}
+
+
+def _aval_nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(math.prod(int(d) for d in shape)) * dtype.itemsize
+    except (TypeError, ValueError):
+        return 0
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a pytree of arrays OR abstract values — the
+    static sizing arithmetic (eval_shape trees cost the same as the
+    concrete buffers they describe)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        total += int(nbytes) if nbytes is not None else _aval_nbytes(leaf)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# liveness
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PallasEstimate:
+    label: str                  # kernel name from the traced eqn
+    grid: Tuple[int, ...]
+    vmem_bytes: int
+    io_block_bytes: int         # double-buffered in/out blocks
+    scratch_bytes: int
+    prefetch_bytes: int
+    misaligned: List[str] = dataclasses.field(default_factory=list)
+    note: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _Meas:
+    peak: int
+    invar_bytes: int
+    outvar_bytes: int
+
+
+@dataclasses.dataclass
+class MemReport:
+    name: str
+    peak_bytes: int = 0
+    args_bytes: int = 0          # resident (non-donated) argument bytes
+    donated_bytes: int = 0       # argument bytes freed/aliased by donation
+    out_bytes: int = 0
+    eqns: int = 0
+    dead_donations: List[str] = dataclasses.field(default_factory=list)
+    pallas: List[PallasEstimate] = dataclasses.field(default_factory=list)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    error: Optional[str] = None
+
+
+def _is_literal(atom) -> bool:
+    import jax
+
+    return isinstance(atom, jax.core.Literal)
+
+
+def _sub_jaxpr(params):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in params:
+            return params[key]
+    return None
+
+
+def _closed(j):
+    return getattr(j, "jaxpr", j)
+
+
+def _nested_jaxprs(params):
+    out = []
+    stack = list(params.values())
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+        elif hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns"):
+            out.append(v)
+    return out
+
+
+class _LivenessAnalyzer:
+    """Linear-scan liveness over one traced program.
+
+    The model mirrors XLA buffer assignment at the granularity a budget
+    needs: non-donated entry arguments stay resident for the whole
+    program (the caller holds them), donated arguments free at their
+    last use (aliasing a matching output), intermediates free at their
+    last use, outputs stay resident through program end. Nested
+    programs (calls, scan/while bodies, cond branches) contribute only
+    their transient intermediates beyond the I/O the outer level
+    already counts — which is exactly the scan/while carried-buffer
+    reuse story: a loop's footprint is carry + invariants + one
+    iteration's transients, not length × anything.
+    """
+
+    def __init__(self, report: MemReport):
+        self.report = report
+
+    # -- sizes ---------------------------------------------------------------
+    def _size(self, var, divs) -> int:
+        return _aval_nbytes(var.aval) // max(divs.get(var, 1), 1)
+
+    # -- transient of one nested program -------------------------------------
+    def _transient(self, eqn, divs) -> int:
+        name = eqn.primitive.name
+        params = eqn.params
+
+        def inner_divs(inner, atoms):
+            invars = list(inner.invars)
+            d = {}
+            offset = len(invars) - len(atoms)
+            for i, v in enumerate(invars):
+                j = i - offset
+                if 0 <= j < len(atoms) and not _is_literal(atoms[j]):
+                    dv = divs.get(atoms[j], 1)
+                    if dv > 1:
+                        d[v] = dv
+            return d
+
+        def meas(inner, atoms, pinned_prefix=0):
+            inner = _closed(inner)
+            n = len(inner.invars)
+            freeable = [i >= pinned_prefix for i in range(n)]
+            return self._measure(inner, freeable,
+                                 inner_divs(inner, atoms), top=False)
+
+        def extra(m: _Meas) -> int:
+            return max(0, m.peak - m.invar_bytes - m.outvar_bytes)
+
+        if name in _CALL_PRIMS:
+            sub = _sub_jaxpr(params)
+            if sub is None:
+                return 0
+            return extra(meas(sub, list(eqn.invars)))
+        if name == "scan":
+            # consts are loop-invariant (resident across iterations);
+            # carry + per-iter slices free at last use inside one
+            # iteration — the carried-buffer reuse
+            n_consts = params.get("num_consts", 0)
+            return extra(meas(params["jaxpr"], list(eqn.invars),
+                              pinned_prefix=n_consts))
+        if name == "while":
+            cn = params.get("cond_nconsts", 0)
+            bn = params.get("body_nconsts", 0)
+            args = list(eqn.invars)
+            body = meas(params["body_jaxpr"], args[cn:],
+                        pinned_prefix=bn)
+            cond = meas(params["cond_jaxpr"], args[:cn] + args[cn + bn:],
+                        pinned_prefix=cn)
+            return max(extra(body), extra(cond))
+        if name == "cond":
+            branches = params.get("branches", ())
+            return max((extra(meas(b, list(eqn.invars[1:])))
+                        for b in branches), default=0)
+        if name == "pallas_call":
+            # the kernel's intermediates live in VMEM, not HBM — the
+            # VMEM estimator budgets them separately
+            self._handle_pallas(eqn)
+            return 0
+        # unknown prim with nested jaxprs: sweep them with the same
+        # transient formula so nothing escapes the accounting
+        subs = _nested_jaxprs(params)
+        if subs:
+            best = 0
+            for sub in subs:
+                m = self._measure(sub, [True] * len(sub.invars), {},
+                                  top=False)
+                best = max(best, extra(m))
+            return best
+        return 0
+
+    # -- donation aliasing ----------------------------------------------------
+    def _match_donations(self, jaxpr, freeable, last_use, produce, divs,
+                         n_eqns) -> Tuple[set, set]:
+        """(matched donated invars, dead donated invars). A donated
+        invar aliases an output with identical shape/dtype whose
+        producing equation is at/after the donor's last use; greedy
+        multiset matching, each output claimable once."""
+        donated = [v for v, f in zip(jaxpr.invars, freeable) if f]
+        out_slots: Dict[Tuple, List[Any]] = {}
+        for ov in jaxpr.outvars:
+            if _is_literal(ov):
+                continue
+            key = (tuple(getattr(ov.aval, "shape", ())),
+                   str(getattr(ov.aval, "dtype", "")))
+            out_slots.setdefault(key, []).append(ov)
+        matched, dead = set(), set()
+        for dv in donated:
+            key = (tuple(getattr(dv.aval, "shape", ())),
+                   str(getattr(dv.aval, "dtype", "")))
+            slots = out_slots.get(key, [])
+            pick = None
+            for ov in slots:
+                # an invar passed straight through produces "at start"
+                # and trivially aliases itself
+                p = n_eqns if ov is dv else produce.get(ov, -1)
+                if p >= last_use.get(dv, 0):
+                    pick = ov
+                    break
+            if pick is not None:
+                slots.remove(pick)
+                matched.add(dv)
+            else:
+                dead.add(dv)
+        return matched, dead
+
+    # -- the scan -------------------------------------------------------------
+    def _measure(self, jaxpr, freeable: List[bool], divs: Dict,
+                 top: bool = False) -> _Meas:
+        eqns = list(jaxpr.eqns)
+        n = len(eqns)
+        last_use: Dict[Any, int] = {}
+        produce: Dict[Any, int] = {}
+        for i, eqn in enumerate(eqns):
+            for a in eqn.invars:
+                if not _is_literal(a):
+                    last_use[a] = i
+            for v in eqn.outvars:
+                produce[v] = i
+        for ov in jaxpr.outvars:
+            if not _is_literal(ov):
+                last_use[ov] = n      # outputs resident through the end
+
+        matched, dead = self._match_donations(jaxpr, freeable, last_use,
+                                              produce, divs, n)
+        if top:
+            for dv in sorted(dead, key=str):
+                shape = list(getattr(dv.aval, "shape", ()))
+                self.report.dead_donations.append(
+                    f"donated argument {dv} "
+                    f"({getattr(dv.aval, 'dtype', '?')}{shape}, "
+                    f"{_aval_nbytes(dv.aval)} B) cannot alias any "
+                    f"output — no output matches its shape/dtype (or "
+                    f"the value is still live when every candidate is "
+                    f"created); the donation is dead and the buffer "
+                    f"stays resident, doubling its share of peak")
+
+        # residency classes
+        pinned_bytes = 0
+        live = 0
+        live_set = set()
+        for v in getattr(jaxpr, "constvars", ()):
+            pinned_bytes += self._size(v, divs)
+        invar_bytes = 0
+        for v, f in zip(jaxpr.invars, freeable):
+            sz = self._size(v, divs)
+            invar_bytes += sz
+            if f and v in matched:
+                live += sz
+                live_set.add(v)
+            elif f and v not in dead:
+                # nested level: freeable-at-last-use intermediate-like
+                live += sz
+                live_set.add(v)
+            else:
+                pinned_bytes += sz
+        live += pinned_bytes
+        peak = live
+
+        for i, eqn in enumerate(eqns):
+            # shard-divisor propagation: size-preserving single-input
+            # prims inherit; anything else is conservatively full-size
+            if eqn.primitive.name in _DIV_CARRIERS and \
+                    len(eqn.outvars) == 1:
+                srcs = [a for a in eqn.invars if not _is_literal(a)]
+                if len(srcs) == 1 and divs.get(srcs[0], 1) > 1 and \
+                        _aval_nbytes(eqn.outvars[0].aval) == \
+                        _aval_nbytes(srcs[0].aval):
+                    divs[eqn.outvars[0]] = divs[srcs[0]]
+            alloc = 0
+            for v in eqn.outvars:
+                if v not in live_set:
+                    alloc += self._size(v, divs)
+                    live_set.add(v)
+            live += alloc
+            peak = max(peak, live + self._transient(eqn, divs))
+            for v in {a for a in list(eqn.invars) + list(eqn.outvars)
+                      if not _is_literal(a)}:
+                if v in live_set and last_use.get(v, -1) <= i:
+                    live -= self._size(v, divs)
+                    live_set.discard(v)
+
+        out_bytes = 0
+        seen = set()
+        for ov in jaxpr.outvars:
+            if not _is_literal(ov) and ov not in seen:
+                seen.add(ov)
+                out_bytes += self._size(ov, divs)
+        peak = max(peak, live)
+        if top:
+            donated_ok = sum(self._size(v, divs) for v in matched)
+            self.report.args_bytes = invar_bytes - donated_ok
+            self.report.donated_bytes = donated_ok
+            self.report.out_bytes = out_bytes
+            self.report.peak_bytes = peak
+            self.report.eqns = sum(1 for _ in eqns)
+        return _Meas(peak=peak, invar_bytes=invar_bytes,
+                     outvar_bytes=out_bytes)
+
+    # -- pallas VMEM ----------------------------------------------------------
+    def _handle_pallas(self, eqn) -> None:
+        params = eqn.params
+        gm = params.get("grid_mapping")
+        label = str(params.get("name_and_src_info",
+                               params.get("name", "pallas_call")))
+        label = label.split(" ")[0].split("[")[0]
+        if gm is None:
+            self.report.pallas.append(PallasEstimate(
+                label=label, grid=(), vmem_bytes=0, io_block_bytes=0,
+                scratch_bytes=0, prefetch_bytes=0,
+                note="no grid_mapping on this jax version — VMEM "
+                     "unestimated"))
+            return
+        grid = tuple(int(g) for g in getattr(gm, "grid", ())
+                     if isinstance(g, int))
+        steps = math.prod(grid) if grid else 1
+        io_bytes = 0
+        misaligned: List[str] = []
+        for bm in getattr(gm, "block_mappings", ()):
+            asd = getattr(bm, "array_shape_dtype", None)
+            shape = tuple(getattr(asd, "shape", ()) or ())
+            dtype = getattr(asd, "dtype", None)
+            itemsize = getattr(dtype, "itemsize", 4) or 4
+            raw_block = tuple(getattr(bm, "block_shape", ()) or ())
+            block = tuple(int(d) if isinstance(d, int) else 1
+                          for d in raw_block)
+            per_block = math.prod(block) * itemsize if block else 0
+            # ×2: Pallas double-buffers each blocked operand so the next
+            # grid step's DMA overlaps compute
+            io_bytes += per_block * (2 if steps > 1 else 1)
+            misaligned += self._check_tiling(label, shape, block,
+                                             itemsize, dtype)
+        kernel = _closed(params.get("jaxpr"))
+        n_idx = int(getattr(gm, "num_index_operands", 0))
+        n_io = int(getattr(gm, "num_inputs", 0)) + \
+            int(getattr(gm, "num_outputs", 0))
+        kvars = list(getattr(kernel, "invars", ()))
+        prefetch_bytes = sum(_aval_nbytes(v.aval) for v in kvars[:n_idx])
+        scratch_bytes = sum(_aval_nbytes(v.aval)
+                            for v in kvars[n_idx + n_io:])
+        self.report.pallas.append(PallasEstimate(
+            label=label, grid=grid,
+            vmem_bytes=io_bytes + scratch_bytes + prefetch_bytes,
+            io_block_bytes=io_bytes, scratch_bytes=scratch_bytes,
+            prefetch_bytes=prefetch_bytes, misaligned=misaligned))
+
+    def _check_tiling(self, label, shape, block, itemsize,
+                      dtype) -> List[str]:
+        """Misalignment fires only where the block PARTITIONS the array
+        (block dim < array dim): a block covering a whole small dim
+        just pads to the tile, but a partition on a non-tile boundary
+        forces a strided relayout on every DMA."""
+        if len(block) < 2 or len(block) != len(shape):
+            return []
+        sub = _SUBLANES.get(int(itemsize), 8)
+        out = []
+        checks = ((-1, _LANES, "lane"), (-2, sub, "sublane"))
+        for dim, align, kind in checks:
+            b, a = int(block[dim]), int(shape[dim])
+            if b < a and b % align:
+                out.append(
+                    f"kernel '{label}': block shape {list(block)} "
+                    f"partitions array {list(shape)} ({dtype}) on dim "
+                    f"{len(block) + dim} at {b}, not a multiple of the "
+                    f"{align}-{kind} tile for this dtype — every DMA "
+                    f"pays a strided relayout; use "
+                    f"({sub},{_LANES})-aligned blocks")
+        return out
+
+
+def _unwrap_jit(closed, donated: List[bool], divs: List[int]):
+    """Peel single-pjit wrappers (``jax.make_jaxpr`` of a jitted fn
+    yields one pjit eqn), merging the pjit's recorded ``donated_invars``
+    into the explicit mask and remapping shard divisors, so the
+    liveness scan sees the real program with real donation flags."""
+    jaxpr = closed.jaxpr
+    while len(jaxpr.eqns) == 1 and \
+            jaxpr.eqns[0].primitive.name == "pjit" and \
+            not jaxpr.eqns[0].params.get("keep_unused", False):
+        eqn = jaxpr.eqns[0]
+        inner = eqn.params.get("jaxpr")
+        if inner is None or set(eqn.outvars) != \
+                {v for v in jaxpr.outvars if not _is_literal(v)}:
+            break
+        pjit_donated = eqn.params.get("donated_invars") or \
+            (False,) * len(eqn.invars)
+        outer_index = {v: i for i, v in enumerate(jaxpr.invars)}
+        new_donated, new_divs = [], []
+        for j, atom in enumerate(eqn.invars):
+            i = None if _is_literal(atom) else outer_index.get(atom)
+            new_donated.append(bool(pjit_donated[j]) or
+                               (i is not None and donated[i]))
+            new_divs.append(divs[i] if i is not None else 1)
+        closed, jaxpr = inner, inner.jaxpr
+        donated, divs = new_donated, new_divs
+    return closed, donated, divs
+
+
+def measure_entry(name: str, fn, avals,
+                  donate_argnums: Sequence[int] = (),
+                  in_specs=None, mesh=None,
+                  meta: Optional[dict] = None) -> MemReport:
+    """Trace ``fn`` abstractly and run the liveness scan. ``in_specs``
+    (a PartitionSpec tree aligned with ``avals``) + ``mesh`` turn input
+    sizes into per-shard sizes; ``donate_argnums`` marks donated
+    top-level arguments for entries that are not already jitted with
+    donation (the jitted ones carry ``donated_invars`` in their pjit
+    params, which :func:`_unwrap_jit` honors)."""
+    import jax
+
+    report = MemReport(name, meta=dict(meta or {}))
+    try:
+        closed = jax.make_jaxpr(fn)(*avals)
+    except Exception as e:
+        report.error = f"{type(e).__name__}: {e}"
+        return report
+    try:
+        flat_counts = [len(jax.tree_util.tree_leaves(a)) for a in avals]
+        donated: List[bool] = []
+        for i, c in enumerate(flat_counts):
+            donated.extend([i in set(donate_argnums)] * c)
+        n_in = len(closed.jaxpr.invars)
+        if len(donated) != n_in:
+            donated = [False] * n_in
+        divs = _flat_divisors(avals, in_specs, mesh, n_in)
+        closed, donated, divs = _unwrap_jit(closed, donated, divs)
+        analyzer = _LivenessAnalyzer(report)
+        div_map = {v: d for v, d in zip(closed.jaxpr.invars, divs)
+                   if d > 1}
+        analyzer._measure(closed.jaxpr, donated, div_map, top=True)
+    except Exception as e:
+        report.error = f"{type(e).__name__}: {e}"
+    return report
+
+
+def _flat_divisors(avals, in_specs, mesh, n_in) -> List[int]:
+    """Per-invar shard divisor: the product of mesh-axis sizes the
+    input's PartitionSpec shards it over (1 when unknown)."""
+    import jax
+
+    if in_specs is None or mesh is None:
+        return [1] * n_in
+    from deepspeed_tpu.tools.dstlint.spmdpass import (
+        UNKNOWN, _broadcast_spec_tree, _flatten_specs, _spec_axes,
+    )
+
+    mesh_shape = dict(getattr(mesh, "shape", {}) or {})
+    tree = _broadcast_spec_tree(in_specs, avals)
+    flat = _flatten_specs(tree, avals, mesh)
+    if len(flat) != n_in:
+        return [1] * n_in
+    out = []
+    for spec in flat:
+        if spec is UNKNOWN:
+            out.append(1)
+            continue
+        d = 1
+        for a in _spec_axes(spec):
+            d *= mesh_shape.get(a, 1)
+        out.append(max(d, 1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points — the same programs the jaxpr/SPMD passes trace
+# ---------------------------------------------------------------------------
+
+def trace_mem_entry_points(arms: Optional[List[str]] = None
+                           ) -> Dict[str, MemReport]:
+    from deepspeed_tpu.tools.dstlint import jaxprpass
+
+    reports: Dict[str, MemReport] = {}
+    for arm in (arms if arms is not None else jaxprpass.available_arms()):
+        try:
+            (decode_jit, decode_avals, prefill_jit, prefill_avals,
+             copy_jit, copy_avals) = \
+                jaxprpass._abstract_serving_pieces(arm)
+        except Exception as e:
+            reports[f"decode_step/{arm}"] = MemReport(
+                f"decode_step/{arm}",
+                error=f"{type(e).__name__}: {e}")
+            continue
+        serve_meta = {
+            "kind": "serve",
+            "pool_bytes": tree_bytes(decode_avals[2]),
+            "params_bytes": tree_bytes(decode_avals[0]),
+        }
+        reports[f"decode_step/{arm}"] = measure_entry(
+            f"decode_step/{arm}", decode_jit, decode_avals,
+            meta=serve_meta)
+        reports[f"prefill_bucket/{arm}"] = measure_entry(
+            f"prefill_bucket/{arm}", prefill_jit, prefill_avals,
+            meta=serve_meta)
+        if arm != "reference":
+            continue
+        reports["copy_pool_blocks"] = measure_entry(
+            "copy_pool_blocks", copy_jit, copy_avals,
+            meta={"kind": "serve"})
+        for name, fn, avals in jaxprpass._tiering_pieces():
+            reports[name] = measure_entry(name, fn, avals,
+                                          meta={"kind": "serve"})
+        for name, built in _train_entries():
+            reports[name] = measure_entry(
+                name, built["fn"], built["avals"],
+                donate_argnums=built.get("donate_argnums", ()),
+                in_specs=built.get("in_specs"), mesh=built.get("mesh"),
+                meta={"kind": "train"})
+    return reports
+
+
+def _train_entries():
+    """ZeRO stage-1/2/3 steps (params + opt donated, like the engine's
+    fused step — both are replaced every step) and the 1F1B pipeline,
+    reusing the SPMD pass's builders so the three passes can never
+    trace different programs."""
+    from deepspeed_tpu.tools.dstlint.spmdpass import (
+        _pipeline_entry, _zero_entry,
+    )
+
+    out = []
+    for stage in (1, 2, 3):
+        built = dict(_zero_entry(stage))
+        built["donate_argnums"] = (0, 1)
+        out.append((f"zero_step/stage{stage}", built))
+    out.append(("pipeline_1f1b/pp2dp2tp2", dict(_pipeline_entry())))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# budgets + rules
+# ---------------------------------------------------------------------------
+
+def load_budgets(path) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def budgets_from_reports(reports: Dict[str, MemReport],
+                         tolerance_pct: int = DEFAULT_TOLERANCE_PCT
+                         ) -> dict:
+    import jax
+
+    entries = {}
+    for name, rep in sorted(reports.items()):
+        if rep.error is None:
+            entries[name] = {"peak_bytes": rep.peak_bytes,
+                             "args_bytes": rep.args_bytes,
+                             "out_bytes": rep.out_bytes,
+                             "tolerance_pct": tolerance_pct}
+    return {"version": 1, "jax_version": jax.__version__,
+            "vmem_limit_bytes": VMEM_LIMIT_BYTES,
+            # per-device HBM cap for mem-oom-risk; null keeps the rule
+            # dormant until an operator configures the fleet's chip
+            # (or passes bin/dst lint --hbm-gb)
+            "hbm_cap_bytes": None,
+            "entries": entries}
+
+
+def check_reports(reports: Dict[str, MemReport],
+                  budgets: Optional[dict],
+                  hbm_cap_bytes: Optional[int] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    entries = (budgets or {}).get("entries", {})
+    vmem_limit = int((budgets or {}).get("vmem_limit_bytes")
+                     or VMEM_LIMIT_BYTES)
+    cap = hbm_cap_bytes if hbm_cap_bytes is not None else \
+        (budgets or {}).get("hbm_cap_bytes")
+
+    def emit(rule, name, msg):
+        findings.append(Finding(rule, f"<mem:{name}>", 1, 0, msg))
+
+    for name, rep in reports.items():
+        if rep.error is not None:
+            emit("mem-budget-drift", name,
+                 f"entry point failed to trace: {rep.error}")
+            continue
+        for msg in rep.dead_donations:
+            emit("dead-donation", name, msg)
+        for est in rep.pallas:
+            if est.note:
+                continue
+            if est.vmem_bytes > vmem_limit:
+                emit("pallas-vmem-budget", name,
+                     f"kernel '{est.label}' projects "
+                     f"{est.vmem_bytes} B of VMEM "
+                     f"({est.io_block_bytes} B double-buffered blocks "
+                     f"+ {est.scratch_bytes} B scratch + "
+                     f"{est.prefetch_bytes} B prefetch) over the "
+                     f"{vmem_limit} B per-core budget — shrink the "
+                     f"BlockSpec block shapes or drop buffers")
+            for msg in est.misaligned:
+                emit("pallas-tile-misalign", name, msg)
+        if cap:
+            total = rep.peak_bytes
+            if total > int(cap):
+                parts = ""
+                if rep.meta.get("pool_bytes"):
+                    parts = (f" (pool {rep.meta['pool_bytes']} B + "
+                             f"params {rep.meta['params_bytes']} B in "
+                             f"the peak)")
+                emit("mem-oom-risk", name,
+                     f"static peak {total} B exceeds the per-device "
+                     f"HBM cap {int(cap)} B{parts} — the program OOMs "
+                     f"before the first step; shrink the pool, shard "
+                     f"wider, or raise the cap")
+        budget = entries.get(name)
+        if budget is None:
+            emit("mem-budget-drift", name,
+                 f"no checked-in peak-memory budget for this entry "
+                 f"point (measured {rep.peak_bytes} B peak) — run "
+                 f"`bin/dst lint --update-budgets`")
+            continue
+        ref = budget.get("peak_bytes", 0)
+        tol = budget.get("tolerance_pct", DEFAULT_TOLERANCE_PCT)
+        if ref and abs(rep.peak_bytes - ref) * 100 > tol * ref:
+            emit("mem-budget-drift", name,
+                 f"peak-live-bytes drifted: {rep.peak_bytes} vs budget "
+                 f"{ref} (±{tol}%) — a liveness/donation regression, "
+                 f"or an intentional change (then run "
+                 f"`bin/dst lint --update-budgets`)")
+    for name in sorted(entries):
+        if name not in reports:
+            findings.append(Finding(
+                "mem-budget-drift", f"<mem:{name}>", 1, 0,
+                "budgeted memory entry point was NOT traced this run — "
+                "fix the entry registry or re-anchor with "
+                "`bin/dst lint --update-budgets`"))
+    return findings
+
+
+def run_mem_pass(budgets_path,
+                 hbm_cap_bytes: Optional[int] = None) -> List[Finding]:
+    return check_reports(trace_mem_entry_points(),
+                         load_budgets(budgets_path),
+                         hbm_cap_bytes=hbm_cap_bytes)
+
+
+# ---------------------------------------------------------------------------
+# static serving-memory prediction (the bench/dstprof cross-check)
+# ---------------------------------------------------------------------------
+
+def predict_serve_memory(cfg, *, num_slots: int, block_size: int,
+                         max_context: int, dtype,
+                         int8: bool = False,
+                         attn_kernel: str = "reference",
+                         params=None) -> Dict[str, int]:
+    """Static pool/param device-byte prediction for one serve() shape,
+    by the engine's own sizing arithmetic run over abstract trees —
+    ``blocks_for`` width (bucketed to 4), ``num_slots * width + 1``
+    blocks, the dispatch target's ``init_pools`` under ``eval_shape``.
+    The measured twin is the ``serve.memory`` registry section
+    (pool_device_bytes / params_device_bytes); bench.py --serve pins
+    the two within 10%."""
+    import jax
+
+    from deepspeed_tpu.inference.engine import resolve_paged_decoder
+    from deepspeed_tpu.ops.paged_attention import blocks_for
+
+    width = -(-blocks_for(int(max_context), int(block_size)) // 4) * 4
+    num_blocks = int(num_slots) * width + 1
+    _apply, init_pools, transform, _dec = resolve_paged_decoder(
+        cfg, attn_kernel=attn_kernel)
+    pools_abs = jax.eval_shape(
+        lambda: init_pools(cfg, num_blocks, block_size, dtype,
+                           int8=int8))
+    out = {
+        "width": width,
+        "num_blocks": num_blocks,
+        "pool_bytes": tree_bytes(pools_abs),
+        "block_bytes": tree_bytes(pools_abs) // num_blocks,
+    }
+    if params is not None:
+        params_abs = jax.eval_shape(lambda p: p, params)
+        if transform is not None:
+            params_abs = jax.eval_shape(transform, params_abs)
+        out["params_bytes"] = tree_bytes(params_abs)
+    return out
+
+
+def compare_serve_memory(pred: Dict[str, int],
+                         serve_mem: Dict[str, Any]) -> Dict[str, dict]:
+    """Static prediction (:func:`predict_serve_memory`) vs the measured
+    ``serve.memory`` section, ONE pairing + agreement formula for every
+    consumer (the bench assertion and the dst-prof report must stay the
+    same comparison): {quantity: {static, measured, agreement}} with
+    agreement as a fraction of the static value."""
+    out = {}
+    for quantity, gauge in (("pool_bytes", "pool_device_bytes"),
+                            ("params_bytes", "params_device_bytes")):
+        if quantity not in pred:
+            continue
+        static = int(pred[quantity])
+        measured = int(serve_mem.get(gauge, 0))
+        out[quantity] = {
+            "static": static,
+            "measured": measured,
+            "agreement": abs(static - measured) / max(static, 1),
+        }
+    return out
+
+
+def static_peak_table(budgets: Optional[dict]) -> Dict[str, int]:
+    """{entry: peak_bytes} from a loaded budget file — the compact form
+    ``bin/dst prof`` renders next to the measured gauges."""
+    return {name: int(e.get("peak_bytes", 0))
+            for name, e in sorted(
+                ((budgets or {}).get("entries", {})).items())}

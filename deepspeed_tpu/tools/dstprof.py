@@ -74,8 +74,48 @@ def build_report(requests: int = 6, host_cache_gb: float = 0.0) -> dict:
                              if k.startswith("compile.")},
         "memory": snap.get("memory", {}),
         "serve_memory": snap.get("serve.memory", {}),
+        "static_memory": _static_memory(cfg, reqs, params,
+                                        snap.get("serve.memory", {})),
+        "mem_budgets": _mem_budget_table(),
         "efficiency": snap.get("serve.efficiency", {}),
     }
+
+
+def _static_memory(cfg, reqs, params, serve_mem) -> dict:
+    """dstmem static prediction vs the measured ``serve.memory`` gauges
+    for THIS engine's serving shape — the budget-headroom columns."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.tools.dstlint import mempass
+
+    pred = mempass.predict_serve_memory(
+        cfg, num_slots=2, block_size=4,
+        max_context=max(len(r.prompt) + r.max_new_tokens for r in reqs),
+        dtype=jnp.float32, params=params)
+    return {
+        quantity: {
+            "static": cmp["static"],
+            "measured": cmp["measured"],
+            "agreement_pct": round(cmp["agreement"] * 100, 2),
+        }
+        for quantity, cmp in mempass.compare_serve_memory(
+            pred, serve_mem).items()
+    }
+
+
+def _mem_budget_table() -> dict:
+    """The checked-in static peak-bytes table (mem_budgets.json) —
+    rendered so operators see each budgeted program's footprint next to
+    the live gauges."""
+    import os
+
+    import deepspeed_tpu
+    from deepspeed_tpu.tools.dstlint import mempass
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(deepspeed_tpu.__file__)))
+    path = os.path.join(root, "tools", "dstlint", "mem_budgets.json")
+    return mempass.static_peak_table(mempass.load_budgets(path))
 
 
 def build_train_report(steps: int = 3) -> dict:
@@ -236,6 +276,26 @@ def render_text(report: dict) -> str:
     sm = report.get("serve_memory", {})
     for k in sorted(sm):
         lines.append(f"  serve.{k:<28}{_fmt_bytes(sm[k]):>14}")
+    static = report.get("static_memory", {})
+    if static:
+        lines.append("")
+        lines.append("-- static vs measured (dstmem) "
+                     "--------------------------------------")
+        lines.append(f"{'quantity':<20}{'static':>14}{'measured':>14}"
+                     f"{'agree':>9}")
+        for q in sorted(static):
+            e = static[q]
+            lines.append(f"{q:<20}{_fmt_bytes(e['static']):>14}"
+                         f"{_fmt_bytes(e['measured']):>14}"
+                         f"{e['agreement_pct']:>8.1f}%")
+    budgets = report.get("mem_budgets", {})
+    if budgets:
+        lines.append("")
+        lines.append("-- static peak budgets (tools/dstlint/"
+                     "mem_budgets.json) ------------")
+        for name in sorted(budgets):
+            lines.append(f"  {name:<36}"
+                         f"{_fmt_bytes(budgets[name]):>14}")
     lines.append("")
     lines.append("-- efficiency "
                  "-------------------------------------------------------")

@@ -2,12 +2,14 @@
 
 Drives the real CLI in a subprocess and consumes its ``--format json``
 output — the same machine interface CI uses — so this test pins (a) the
-analyzer finding zero non-baselined violations in the tree, (b) the
-jaxpr entry-point budgets matching the checked-in
-``tools/dstlint/jaxpr_budgets.json``, (c) the SPMD collective
-inventories matching ``tools/dstlint/comms_budgets.json`` (a PR that
-changes collective structure without regenerating budgets fails here),
-and (d) the exit-code / output-format contract.
+analyzer finding zero non-baselined violations in the tree across ALL
+FOUR backends (ast/jaxpr/spmd/mem), (b) the jaxpr entry-point budgets
+matching the checked-in ``tools/dstlint/jaxpr_budgets.json``, (c) the
+SPMD collective inventories matching
+``tools/dstlint/comms_budgets.json`` (a PR that changes collective
+structure without regenerating budgets fails here; the peak-memory
+twin gate lives in tests/unit/test_dstlint_mem.py), and (d) the
+exit-code / output-format contract.
 """
 
 import json
@@ -49,6 +51,14 @@ def test_repo_has_zero_nonbaselined_findings(lint_json):
 def test_lint_walked_the_whole_package(lint_json):
     _, data = lint_json
     assert data["files_checked"] > 100   # the package, not a subdir
+
+
+def test_all_four_backends_ran(lint_json):
+    """The repo smoke must cover every backend — a silently-skipped
+    pass (import failure, flag drift) would let its whole rule family
+    rot unchecked."""
+    _, data = lint_json
+    assert data["backends"] == ["ast", "jaxpr", "spmd", "mem"]
 
 
 def test_comms_budgets_in_sync_with_fresh_trace():

@@ -1,0 +1,362 @@
+"""dstlint memory-pass coverage: per-rule pos/neg fixtures.
+
+Three layers, mirroring the jaxpr/SPMD-pass tests:
+
+- REAL tiny traces through :func:`measure_entry` proving the liveness
+  scan itself (donation aliasing, scan/while carried-buffer reuse,
+  per-shard sizing) and the Pallas VMEM estimator catch / clear each
+  violation class;
+- fabricated :class:`MemReport`s against :func:`check_reports` pinning
+  the budget-drift / OOM-cap arithmetic without tracing;
+- the gate: ``tools/dstlint/mem_budgets.json`` in sync with a fresh
+  trace of the real entry points (the comms-budget gate pattern).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.tools.dstlint import mempass as mp
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+F32 = jnp.float32
+
+
+def sds(shape, dtype=F32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+def check(rep, budgets="self", **kw):
+    reports = {rep.name: rep}
+    if budgets == "self":
+        budgets = mp.budgets_from_reports(reports)
+    return mp.check_reports(reports, budgets, **kw)
+
+
+# --- liveness arithmetic -----------------------------------------------------
+
+def test_chain_peak_counts_live_intermediates():
+    # y = x*2; z = y+1: at z's creation x (resident arg), y and z are
+    # all live — peak is exactly 3 buffers
+    rep = mp.measure_entry("chain", lambda x: (x * 2.0) + 1.0,
+                           (sds((1024,)),))
+    assert rep.error is None
+    assert rep.peak_bytes == 3 * 1024 * 4
+    assert rep.args_bytes == 1024 * 4
+    assert rep.out_bytes == 1024 * 4
+
+
+def test_donation_lowers_peak_vs_undonated():
+    def g(x):
+        y = x + 1.0
+        return y * 2.0
+
+    av = (sds((1024,)),)
+    undonated = mp.measure_entry("nodon", jax.jit(g), av)
+    donated = mp.measure_entry("don", jax.jit(g, donate_argnums=(0,)), av)
+    assert undonated.error is None and donated.error is None
+    # donated x frees after its last use instead of staying resident
+    assert donated.peak_bytes == undonated.peak_bytes - 1024 * 4
+    assert donated.donated_bytes == 1024 * 4
+    assert donated.dead_donations == []
+
+
+def test_scan_carry_reuse_not_scaled_by_length():
+    def f(c):
+        def body(c, _):
+            return c * 1.0001 + 1.0, None
+
+        out, _ = jax.lax.scan(body, c, None, length=64)
+        return out
+
+    rep = mp.measure_entry("scan", jax.jit(f, donate_argnums=(0,)),
+                           (sds((4096,)),))
+    assert rep.error is None
+    carry = 4096 * 4
+    # carry + one iteration's transients — NOT 64 x anything
+    assert rep.peak_bytes <= 3 * carry
+
+
+def test_scan_stacked_ys_counted_in_full():
+    def f(c):
+        def body(c, _):
+            c = c + 1.0
+            return c, c
+
+        _, ys = jax.lax.scan(body, c, None, length=16)
+        return ys
+
+    rep = mp.measure_entry("scan_ys", jax.jit(f), (sds((256,)),))
+    assert rep.error is None
+    assert rep.out_bytes == 16 * 256 * 4      # the stacked output
+    assert rep.peak_bytes >= 17 * 256 * 4     # ys + carry at least
+
+
+def test_shard_divisor_scales_input_bytes():
+    from jax.sharding import AbstractMesh, PartitionSpec as P
+
+    mesh = AbstractMesh((("data", 8),))
+    av = (sds((64, 128)),)
+    full = mp.measure_entry("full", lambda x: x * 2.0, av)
+    shard = mp.measure_entry("shard", lambda x: x * 2.0, av,
+                             in_specs=(P("data"),), mesh=mesh)
+    assert full.error is None and shard.error is None
+    assert full.args_bytes == 64 * 128 * 4
+    assert shard.args_bytes == 64 * 128 * 4 // 8
+    # the divisor also rides through the size-preserving output
+    assert shard.peak_bytes < full.peak_bytes
+
+
+# --- dead-donation -----------------------------------------------------------
+
+def test_dead_donation_shape_mismatch_fires():
+    fn = jax.jit(lambda x, y: y * 2.0, donate_argnums=(0,))
+    rep = mp.measure_entry("dead", fn, (sds((8,)), sds((4,))))
+    assert rep.error is None
+    assert len(rep.dead_donations) == 1
+    findings = check(rep)
+    assert "dead-donation" in rules_of(findings)
+
+
+def test_dead_donation_dtype_mismatch_fires():
+    fn = jax.jit(lambda x, y: (y * 2.0).astype(jnp.float32),
+                 donate_argnums=(0,))
+    rep = mp.measure_entry("dead_dtype", fn,
+                           (sds((8,), jnp.int32), sds((8,))))
+    assert len(rep.dead_donations) == 1
+
+
+def test_live_donation_matching_output_is_clean():
+    fn = jax.jit(lambda pools, up: pools + up, donate_argnums=(0,))
+    rep = mp.measure_entry("alias", fn, (sds((16, 8)), sds((16, 8))))
+    assert rep.error is None
+    assert rep.dead_donations == []
+    assert "dead-donation" not in rules_of(check(rep))
+
+
+def test_donation_still_live_after_outputs_fires():
+    # the donated buffer's last use comes AFTER the only same-shaped
+    # output exists — XLA cannot alias, the donation is dead
+    def f(x, y):
+        out = y * 2.0            # the only (8,) f32 candidate
+        s = jnp.sum(out + x)     # x still live past out's creation
+        return out, s
+
+    rep = mp.measure_entry("late", jax.jit(f, donate_argnums=(0,)),
+                           (sds((8,)), sds((8,))))
+    assert rep.error is None
+    assert len(rep.dead_donations) == 1
+
+
+# --- pallas VMEM budget + tiling --------------------------------------------
+
+def _pallas_copy(array_shape, block_shape, grid, dtype=F32):
+    """A trivial blocked copy kernel — the fixture for the VMEM
+    estimator (block bytes x double-buffering) and the tile checker."""
+    from deepspeed_tpu.utils.jax_compat import pallas_tpu
+
+    pl, _pltpu = pallas_tpu()
+    if pl is None:
+        pytest.skip("pallas surface unavailable")
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec(block_shape,
+                                   lambda i: (i, 0))],
+            out_specs=pl.BlockSpec(block_shape, lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct(array_shape, dtype),
+            interpret=True,
+        )(x)
+
+    return mp.measure_entry("pallas_fix", fn, (sds(array_shape, dtype),))
+
+
+def test_vmem_overflow_fires():
+    # 2048x2048 f32 block = 16 MiB; x2 double-buffer x (in + out) blows
+    # any 16 MiB budget several times over
+    rep = _pallas_copy((4096, 2048), (2048, 2048), grid=(2,))
+    assert rep.error is None, rep.error
+    assert len(rep.pallas) == 1
+    est = rep.pallas[0]
+    assert est.vmem_bytes >= 4 * 2048 * 2048 * 4
+    assert "pallas-vmem-budget" in rules_of(check(rep))
+
+
+def test_vmem_within_budget_is_clean():
+    rep = _pallas_copy((1024, 128), (8, 128), grid=(128,))
+    assert rep.error is None, rep.error
+    assert len(rep.pallas) == 1
+    assert est_clean(rep)
+
+
+def est_clean(rep):
+    findings = check(rep)
+    return not any(r.startswith("pallas-") for r in rules_of(findings))
+
+
+def test_tile_misalign_fires_on_partitioning_boundary():
+    # blocks of 100 lanes partition a 200-lane dim: not a multiple of
+    # the 128-lane tile
+    rep = _pallas_copy((24, 200), (12, 100), grid=(2,))
+    assert rep.error is None, rep.error
+    assert rep.pallas[0].misaligned
+    assert "pallas-tile-misalign" in rules_of(check(rep))
+
+
+def test_tile_full_dim_block_is_exempt():
+    # the block covers the whole (small) array dims — padding, not a
+    # misaligned partition; the real decode kernel's tiny-trace shapes
+    # rely on this exemption
+    rep = _pallas_copy((4, 96), (4, 96), grid=(1,))
+    assert rep.error is None, rep.error
+    assert rep.pallas[0].misaligned == []
+    assert est_clean(rep)
+
+
+def test_real_decode_pallas_kernel_estimated_and_clean():
+    from deepspeed_tpu.tools.dstlint.jaxprpass import available_arms
+
+    if "pallas" not in available_arms():
+        pytest.skip("pallas arm unavailable on this toolchain")
+    reports = mp.trace_mem_entry_points(arms=["pallas"])
+    rep = reports["decode_step/pallas"]
+    assert rep.error is None, rep.error
+    assert len(rep.pallas) == 1
+    est = rep.pallas[0]
+    assert 0 < est.vmem_bytes < mp.VMEM_LIMIT_BYTES
+    assert est.misaligned == []
+    assert est.scratch_bytes > 0        # the online-softmax VMEM scratch
+
+
+# --- budget drift arithmetic (fabricated tables) -----------------------------
+
+def _fab(name="e", peak=1000):
+    return mp.MemReport(name, peak_bytes=peak, args_bytes=peak // 2,
+                        out_bytes=peak // 4)
+
+
+def test_budget_within_tolerance_is_clean():
+    budgets = {"entries": {"e": {"peak_bytes": 1000,
+                                 "tolerance_pct": 25}}}
+    assert mp.check_reports({"e": _fab(peak=1200)}, budgets) == []
+
+
+def test_budget_drift_beyond_tolerance_fires():
+    budgets = {"entries": {"e": {"peak_bytes": 1000,
+                                 "tolerance_pct": 25}}}
+    findings = mp.check_reports({"e": _fab(peak=1600)}, budgets)
+    assert rules_of(findings) == ["mem-budget-drift"]
+    assert "1600 vs budget 1000" in findings[0].message
+
+
+def test_missing_budget_entry_fires():
+    findings = mp.check_reports({"e": _fab()}, {"entries": {}})
+    assert rules_of(findings) == ["mem-budget-drift"]
+    assert "--update-budgets" in findings[0].message
+
+
+def test_budgeted_entry_not_traced_fires():
+    budgets = {"entries": {"gone": {"peak_bytes": 10}}}
+    findings = mp.check_reports({}, budgets)
+    assert rules_of(findings) == ["mem-budget-drift"]
+    assert "NOT traced" in findings[0].message
+
+
+def test_trace_error_is_a_finding():
+    rep = mp.MemReport("broken", error="ValueError: boom")
+    findings = mp.check_reports({"broken": rep}, {"entries": {}})
+    assert rules_of(findings) == ["mem-budget-drift"]
+    assert "failed to trace" in findings[0].message
+
+
+# --- mem-oom-risk ------------------------------------------------------------
+
+def test_oom_risk_fires_over_cap():
+    rep = _fab(peak=3 * (1 << 30))
+    rep.meta = {"kind": "serve", "pool_bytes": 2 * (1 << 30),
+                "params_bytes": 1 << 30}
+    findings = check(rep, hbm_cap_bytes=2 * (1 << 30))
+    assert "mem-oom-risk" in rules_of(findings)
+    assert "pool" in next(f for f in findings
+                          if f.rule == "mem-oom-risk").message
+
+
+def test_oom_risk_clean_under_cap_and_dormant_without():
+    rep = _fab(peak=1 << 20)
+    assert "mem-oom-risk" not in rules_of(
+        check(rep, hbm_cap_bytes=1 << 30))
+    assert "mem-oom-risk" not in rules_of(check(rep))   # no cap: dormant
+
+
+def test_budget_file_cap_activates_rule():
+    budgets = mp.budgets_from_reports({"e": _fab(peak=1000)})
+    budgets["hbm_cap_bytes"] = 500
+    findings = mp.check_reports({"e": _fab(peak=1000)}, budgets)
+    assert "mem-oom-risk" in rules_of(findings)
+
+
+# --- the serving static-prediction helper ------------------------------------
+
+def test_predict_serve_memory_matches_real_pool_bytes():
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.engine import resolve_paged_decoder
+
+    cfg = LlamaConfig.tiny(dtype=F32)
+    pred = mp.predict_serve_memory(cfg, num_slots=2, block_size=4,
+                                   max_context=23, dtype=F32)
+    # mirror the engine's sizing: width bucketed to 4, slots*width+1
+    assert pred["width"] == 8 and pred["num_blocks"] == 17
+    _a, init_pools, _t, _d = resolve_paged_decoder(cfg)
+    real = init_pools(cfg, pred["num_blocks"], 4, F32)
+    assert pred["pool_bytes"] == mp.tree_bytes(real)
+
+
+# --- the gate: checked-in budgets in sync with a fresh trace -----------------
+
+def test_mem_budgets_in_sync_with_fresh_trace():
+    """The checked-in peak-memory budgets must match a fresh abstract
+    trace of the real entry points — memory structure is a reviewed
+    artifact, like the comms budgets."""
+    path = os.path.join(REPO, "tools", "dstlint", "mem_budgets.json")
+    budgets = mp.load_budgets(path)
+    assert budgets, "tools/dstlint/mem_budgets.json missing/unreadable"
+    entries = budgets["entries"]
+    # serving + tiering + ZeRO stages + pipeline all covered
+    assert any(n.startswith("decode_step") for n in entries)
+    assert any(n.startswith("prefill_bucket") for n in entries)
+    assert any(n.startswith("spill_blocks") for n in entries)
+    assert any(n.startswith("restore_blocks") for n in entries)
+    assert {f"zero_step/stage{s}" for s in (1, 2, 3)} <= set(entries)
+    assert any(n.startswith("pipeline") for n in entries)
+    assert all(e["peak_bytes"] > 0 for e in entries.values())
+
+    reports = mp.trace_mem_entry_points()
+    findings = mp.check_reports(reports, budgets)
+    assert findings == [], "mem budgets out of sync — regen with " \
+        "`bin/dst lint --update-budgets`:\n" + "\n".join(
+            f"  {f.path}: {f.rule}: {f.message}" for f in findings)
+
+
+def test_cli_rule_lists_match_pass_modules():
+    """The jax-free rule catalog the CLI prints in --help must track
+    the pass modules' authoritative tuples."""
+    from deepspeed_tpu.tools.dstlint import cli, spmdpass
+
+    assert tuple(cli.SPMD_RULES) == tuple(spmdpass.SPMD_RULES)
+    assert tuple(cli.MEM_RULES) == tuple(mp.MEM_RULES)
+    help_text = cli.build_parser().format_help()
+    for rule in cli.ALL_RULES:
+        assert rule in help_text, f"--help missing rule id {rule}"

@@ -85,15 +85,39 @@ class ServeConfig(DeepSpeedConfigModel):
     """Continuous-batching serving knobs (``engine.serve`` /
     ``generate_stream``)."""
 
-    # paged-attention decode arm: "pallas" streams one live pool block
-    # at a time into VMEM (ragged iteration — per-step KV bytes track
-    # live context; ops/paged_attention_kernel.py), "reference" is the
-    # jnp gather path (pool[block_tables] materialized at max_context
-    # width). "auto" = pallas on TPU, reference elsewhere (off-TPU the
-    # kernel only exists in interpret mode — a correctness arm, not a
-    # fast path). Parity is pinned in tier-1 via interpret mode
+    # paged-attention arm: "pallas" is the UNIFIED ragged kernel —
+    # decode tokens, prefill chunks and mixed ragged batches in one
+    # pallas_call, streaming one live pool block at a time into VMEM
+    # (per-step KV bytes track live context;
+    # ops/paged_attention_kernel.py); "reference" is the jnp gather
+    # path (pool[block_tables] materialized at max_context width).
+    # "auto" = pallas on TPU, reference elsewhere (off-TPU the kernel
+    # only exists in interpret mode — a correctness arm, not a fast
+    # path). Parity is pinned in tier-1 via interpret mode
     # (tests/unit/inference/test_paged_attention.py).
     attn_kernel: str = "auto"
+    # CHUNKED PREFILL / token-budget scheduling (docs/SERVING.md): > 0
+    # splits every prompt into chunks of at most this many tokens and
+    # packs pending prefill chunks PLUS all runnable decode slots into
+    # ONE ragged executor call per scheduler step (the unified ragged
+    # kernel serves the mixed batch in a single launch). A long prompt
+    # then no longer stalls every decoding slot for its whole prefill —
+    # decode emits tokens at every chunk boundary (protected decode
+    # latency, Sarathi-style), TTFT of short requests improves under
+    # prompt-heavy traffic, and the executor compiles at most TWO
+    # program buckets (T_cap=chunk mixed steps + T_cap=1 decode steps)
+    # instead of one prefill program per prompt bucket plus a decode
+    # program. The value is both the per-slot chunk size and the
+    # per-step total NEW-prefill-token budget (concurrent prefills
+    # share it). Chunk boundaries are ordinary host step boundaries:
+    # deadlines, cancellation, preemption, restores, spills, tracing
+    # spans and the auditor keep their semantics. Greedy output is
+    # byte-identical with chunking on, off, and vs generate() (tier-1
+    # pins). 0 (default) = off — the legacy split prefill/decode
+    # programs. Sizing: bigger chunks amortize per-step overhead but
+    # lengthen the worst-case decode gap one chunk adds; 32-128 is the
+    # useful range (decode slots ride along either way).
+    prefill_chunk_tokens: int = 0
     # PREFIX CACHING (on|off): content-address full KV blocks by their
     # token ids so prompts sharing a block-aligned prefix (system
     # prompts, few-shot preambles, multi-turn histories) prefill it once

@@ -360,7 +360,12 @@ class PagedServeExecutor:
     Static shapes: ONE decode program per (num_slots, table_width,
     decode_chunk) serves the whole session regardless of traffic; prefill
     programs are bucketed by prompt capacity (PROMPT_BUCKET) exactly like
-    ``generate()``. Prompts are RIGHT-padded — pad writes land in the
+    ``generate()``. Under CHUNKED PREFILL (serve.prefill_chunk_tokens)
+    both collapse into the RAGGED-STEP program: one
+    ``[num_slots, T_cap]`` shape packs prefill chunks of any prompt
+    length plus all decode slots per call, so the session compiles at
+    most two serving programs instead of one per prompt bucket plus a
+    decode program. Prompts are RIGHT-padded — pad writes land in the
     null block, so no ``attn_start`` plumbing and no left-shift of
     positions. Pools are donated through every call, so the block pool
     lives in one set of device buffers for the session.
@@ -388,6 +393,13 @@ class PagedServeExecutor:
             np.asarray(jax.random.PRNGKey(i)) for i in range(num_slots)])
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fn = None
+        # unified RAGGED-STEP programs (chunked-prefill serving): keyed
+        # by query capacity T_cap — ONE shape serves prefill chunks of
+        # any prompt length plus all decode slots, so the whole session
+        # compiles at most two buckets (T_cap=chunk for mixed steps,
+        # T_cap=1 for pure-decode steps) instead of one prefill program
+        # per prompt bucket plus a separate decode program
+        self._ragged_fns: Dict[int, Any] = {}
         self._copy_fns: Dict[int, Any] = {}
         self._spill_fns: Dict[int, Any] = {}
         self._restore_fns: Dict[int, Any] = {}
@@ -598,6 +610,54 @@ class PagedServeExecutor:
             self._host_tier.note_restored(handle.nbytes)
         return True
 
+    def ragged_step(self, tokens, q_lens, block_tables, write_pos, emit,
+                    is_first):
+        """ONE program call over a MIXED ragged batch: per-slot query
+        segments (decode slots feed 1 token, prefill-chunk slots feed up
+        to T_cap prompt tokens, inactive slots 0) run the unified ragged
+        attention in a single launch — the scheduler's chunked-prefill
+        step (scheduler protocol extension; the legacy split
+        prefill/decode programs stay for unchunked sessions).
+
+        tokens: int32 [B, T_cap] right-padded per-slot segments;
+        q_lens: int32 [B] real tokens per slot; write_pos: int32 [B]
+        context length before this call; emit: bool [B] — slots whose
+        sampled token the scheduler will consume (decode slots and
+        FINAL prefill chunks); is_first: bool [B] — emitting slots
+        whose sample is a request's FIRST token (final prefill chunks;
+        selects the prefill-vs-decode rng-split half so seeded sampled
+        streams match the split programs exactly). Non-emitting slots
+        keep their rng state, so a chunked prefill advances the
+        per-slot stream exactly once — at the first sampled token, like
+        the unchunked path. Returns int32 [B] sampled tokens (garbage
+        where ``emit`` is False).
+        """
+        tokens = np.asarray(tokens, np.int32)
+        T_cap = int(tokens.shape[1])
+        fn = self._ragged_fns.get(T_cap)
+        if fn is None:
+            fn = self._build_ragged_fn(T_cap)
+            if self._obs is not None:
+                self._obs.miss("serve_ragged", T_cap)
+                fn = self._obs.wrap(
+                    "serve_ragged",
+                    f"slots{self.num_slots}_T{T_cap}", fn)
+            self._ragged_fns[T_cap] = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_ragged", T_cap)
+        with self._ctx():
+            out, self._pools, new_rngs = fn(
+                self._params, jnp.asarray(tokens), self._pools,
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(write_pos, jnp.int32),
+                jnp.asarray(q_lens, jnp.int32),
+                jnp.asarray(emit, bool),
+                jnp.asarray(is_first, bool),
+                jnp.asarray(self._rngs), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), jnp.asarray(self._top_ps))
+        self._rngs = np.array(new_rngs)
+        return np.asarray(out)
+
     def decode(self, tokens, block_tables, seq_lens, active, steps_left,
                max_steps=None):
         if self._decode_fn is None:
@@ -721,6 +781,47 @@ class PagedServeExecutor:
             return tok, key, pools
 
         return jax.jit(pf, donate_argnums=(2,))
+
+    def _build_ragged_fn(self, T_cap: int):
+        paged_apply = self._apply
+
+        def rg(params, tokens, pools, bt, write_pos, q_lens, emit,
+               is_first, rngs, temps, top_ks, top_ps):
+            from deepspeed_tpu.inference.sampling import (
+                sample_logits_per_slot,
+            )
+
+            # valid_len == q_lens: padded / inactive rows write their KV
+            # to the null block and their attention rows are dead — one
+            # static [B, T_cap] shape serves every mix of prefill chunks
+            # and decode tokens
+            logits, pools = paged_apply(params, tokens, pools, bt,
+                                        write_pos, q_lens)
+            idx = jnp.maximum(q_lens - 1, 0)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]     # [B, V]
+            split = jax.vmap(jax.random.split)(rngs)
+            # rng-half selection per slot, matching the SPLIT programs
+            # exactly so a seeded sampled stream is identical with
+            # chunking on or off: the prefill program samples with
+            # split[1] and carries split[0]; the decode program samples
+            # with split[0] and carries split[1]. ``is_first`` marks
+            # slots whose sample is a request's FIRST token (the final
+            # prefill chunk).
+            keys = jnp.where(is_first[:, None], split[:, 1],
+                             split[:, 0])
+            fresh = jnp.where(is_first[:, None], split[:, 0],
+                              split[:, 1])
+            nxt = sample_logits_per_slot(last, keys, temps, top_ks,
+                                         top_ps)
+            # mid-prefill chunks sample nothing the scheduler consumes —
+            # their rng must NOT advance, so the final chunk's first
+            # token draws from the same per-slot stream state the
+            # unchunked prefill would have used
+            new_rngs = jnp.where(emit[:, None], fresh, rngs)
+            return nxt, pools, new_rngs
+
+        return jax.jit(rg, donate_argnums=(2,))
 
     def _build_decode_fn(self, chunk: int):
         paged_apply = self._apply
@@ -1413,6 +1514,7 @@ class InferenceEngine:
                         max_context: Optional[int] = None,
                         decode_chunk: int = 1,
                         attn_kernel: Optional[str] = None,
+                        prefill_chunk_tokens: Optional[int] = None,
                         reserve_upfront: bool = False,
                         record_occupancy: bool = False,
                         prefix_cache: Optional[bool] = None,
@@ -1448,6 +1550,17 @@ class InferenceEngine:
         program call at the cost of coarser admission granularity.
         ``attn_kernel`` overrides ``serve.attn_kernel`` for this call
         ("pallas" ragged kernel | "reference" jnp gather).
+        ``prefill_chunk_tokens`` overrides ``serve.prefill_chunk_tokens``
+        (CHUNKED PREFILL / token-budget scheduling, docs/SERVING.md):
+        > 0 splits every prompt into chunks of at most that many tokens
+        and packs pending prefill chunks plus all runnable decode slots
+        into ONE ragged executor call per scheduler step — a long
+        prompt then no longer stalls every decoding slot for its whole
+        prefill, and the session compiles at most two ragged program
+        buckets instead of one prefill program per prompt bucket plus a
+        decode program. Greedy output is byte-identical with chunking
+        on, off, and vs ``generate()``; 0 keeps the legacy split
+        prefill/decode programs.
         ``record_occupancy`` keeps a per-step pool time series on
         ``engine.last_serve_occupancy`` (the bench artifact's source).
         ``prefix_cache`` overrides ``serve.prefix_cache``: when on,
@@ -1653,10 +1766,14 @@ class InferenceEngine:
             # drop it (next cached session starts cold, never stale)
             executor._host_pool = None
             pool = BlockPool(num_blocks, block_size)
+        chunk_tok = (serve_cfg.prefill_chunk_tokens
+                     if prefill_chunk_tokens is None
+                     else int(prefill_chunk_tokens))
         scheduler = ContinuousBatchingScheduler(
             executor, num_slots, pool, width,
             reserve_upfront=reserve_upfront,
             record_occupancy=record_occupancy, prefix_cache=pc,
+            prefill_chunk_tokens=chunk_tok,
             max_preemptions=(serve_cfg.max_preemptions
                              if max_preemptions is None
                              else int(max_preemptions)),

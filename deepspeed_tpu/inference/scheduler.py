@@ -15,9 +15,10 @@ Block allocation is ON-DEMAND (vLLM-style): admission allocates only the
 PROMPT's blocks, and each slot's table grows at decode-chunk boundaries
 just ahead of the KV it is about to write — pool capacity tracks live
 tokens, not the admission-time worst case ``prompt + max_new_tokens``,
-which is what lets a given pool admit MORE concurrent slots (the ragged
-Pallas decode kernel then keeps the per-step KV traffic proportional to
-the same live tokens; ops/paged_attention_kernel.py). When the pool
+which is what lets a given pool admit MORE concurrent slots (the
+unified ragged Pallas kernel then keeps the per-step KV traffic
+proportional to the same live tokens;
+ops/paged_attention_kernel.py). When the pool
 cannot supply a mid-decode grow, the slot STALLS — excluded from decode
 calls (its in-program writes are masked off), tables intact — and
 resumes the step blocks free. If every active slot is stalled at once
@@ -31,6 +32,24 @@ old reserve-everything-at-admission policy (no growth, no stalls) for
 A/B comparison. Note per-slot rng streams advance with decode program
 steps, so a stall can shift WHERE a sampled stream lands relative to an
 unstalled run; (prompt, seed) determinism at fixed pool pressure holds.
+
+CHUNKED PREFILL / TOKEN-BUDGET SCHEDULING (serve.prefill_chunk_tokens,
+docs/SERVING.md): with a chunk budget set, admission binds a slot but
+feeds NO tokens; each step assigns pending prompts chunks of at most
+``prefill_chunk_tokens`` new tokens (the per-step budget, fair-shared
+across concurrently-prefilling slots in admission order) and packs
+them plus every runnable decode slot into ONE
+``executor.ragged_step`` call — the
+unified ragged kernel serves the mixed batch in a single launch, so a
+long prompt no longer stalls decoding slots for its whole prefill: the
+worst gap it adds between two decode tokens is one chunk's model time.
+The FINAL chunk's sampled token is the request's first output token
+(mid-chunk samples advance nothing, including the slot's rng stream);
+greedy output is byte-identical with chunking on, off, and vs
+``generate()``. Chunk boundaries are ordinary step boundaries, so
+every contract below — deadlines, cancellation, preemption, restores,
+spills, tracing spans, the auditor — holds identically (the chaos
+suite runs every scenario in both modes).
 
 FAULT TOLERANCE (docs/SERVING.md): every submitted request resolves to
 exactly ONE terminal :class:`Completion` whose ``status`` is one of
@@ -118,6 +137,19 @@ Executor protocol (duck-typed)::
         # rest). ``max_steps`` (int or None) caps n: the scheduler sets
         # it to the nearest slot completion while the queue holds work,
         # so chunking can never delay an admission past a free slot
+    ragged_step(tokens, q_lens, block_tables, write_pos, emit,
+                is_first) -> np.ndarray
+        # chunked prefill only: ONE call over a MIXED ragged batch —
+        # [num_slots, T_cap] right-padded per-slot token segments
+        # (decode slots feed 1 token, prefill-chunk slots up to T_cap,
+        # inactive slots 0 via q_lens), [num_slots] int32 sampled
+        # tokens out. ``emit`` marks the slots whose sample the
+        # scheduler consumes (decode slots + FINAL prefill chunks);
+        # ``is_first`` marks the emitting subset whose sample is a
+        # request's FIRST token, so the executor can reproduce the
+        # split programs' rng-split convention exactly (seeded sampled
+        # streams identical chunked on/off); non-emitting slots must
+        # not advance their rng stream
     spill_blocks(entries: List[Tuple[bytes, int]]) -> None
         # tiered KV only: copy the device KV frames of the listed block
         # ids into the host tier under their content keys. Called BEFORE
@@ -287,10 +319,40 @@ class ContinuousBatchingScheduler:
                  queue_timeout_s: Optional[float] = None,
                  audit_every: int = 64,
                  fault_injector: Optional[FaultInjector] = None,
-                 host_tier=None, metrics=None, tracer=None, slo=None):
+                 host_tier=None, metrics=None, tracer=None, slo=None,
+                 prefill_chunk_tokens: int = 0):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
+        # CHUNKED PREFILL / token-budget scheduling
+        # (serve.prefill_chunk_tokens, docs/SERVING.md): > 0 switches
+        # every executor call to the unified RAGGED STEP — admission
+        # binds the slot but prefills NOTHING; each step assigns pending
+        # prompts chunks of at most ``prefill_chunk_tokens`` NEW tokens
+        # (the per-step budget, fair-shared across concurrently-
+        # prefilling slots) and packs them plus all runnable decode
+        # slots into one
+        # ``executor.ragged_step`` call. Decode therefore emits a token
+        # at every chunk boundary instead of stalling for a long
+        # prompt's whole prefill, and chunk boundaries are ordinary
+        # step boundaries — deadlines, cancellation, preemption,
+        # restores, spills, tracing and the auditor keep their
+        # semantics.
+        self.chunk_tokens = int(prefill_chunk_tokens)
+        if self.chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0, got "
+                f"{prefill_chunk_tokens}")
+        if self.chunk_tokens and not hasattr(executor, "ragged_step"):
+            raise ValueError(
+                "prefill_chunk_tokens > 0 needs an executor with a "
+                "ragged_step program (the unified mixed prefill+decode "
+                f"call) — {type(executor).__name__} lacks it")
+        # prefilling[s]: slot admitted, prompt KV partially written —
+        # excluded from decode consumption until its final chunk lands;
+        # _prefill_next[s] is the next prompt index to feed
+        self.prefilling = np.zeros(num_slots, bool)
+        self._prefill_next = np.zeros(num_slots, np.int64)
         # PREFIX CACHING: admission looks up the longest cached
         # block-aligned prefix of each prompt and claims only the
         # uncached tail (prefill starts at the first uncached token);
@@ -376,6 +438,11 @@ class ContinuousBatchingScheduler:
         # (BENCH_SERVE.json) — None disables recording
         self.occupancy_log: Optional[List[dict]] = \
             [] if record_occupancy else None
+        # per-step work split (decode tokens consumed / prefill tokens
+        # fed this step), sampled into the occupancy series — the
+        # decode-interference A/B's raw data
+        self._step_decode_tokens = 0
+        self._step_prefill_tokens = 0
         self._submit_times = {}
         # --- observability (deepspeed_tpu/observability) --------------------
         # metrics: a MetricsRegistry absorbing the serve counters/
@@ -495,7 +562,7 @@ class ContinuousBatchingScheduler:
     @property
     def busy(self) -> bool:
         return (bool(self.queue) or bool(self.active.any())
-                or bool(self._restores))
+                or bool(self.prefilling.any()) or bool(self._restores))
 
     @property
     def restoring(self) -> np.ndarray:
@@ -775,6 +842,16 @@ class ContinuousBatchingScheduler:
                 self.host_restore_failures += 1
                 if self.metrics is not None:
                     self.metrics.inc("serve.host_restore_failures")
+            if self.chunk_tokens:
+                # chunked prefill: bind the slot (CoW before the first
+                # write, same isolation envelope) but feed NO tokens yet
+                # — this step's ragged call assigns the first chunk
+                failed = self._begin_chunked_prefill(
+                    slot_id, req, start, t_admit, bind=True,
+                    copy_pairs=copy_pairs)
+                if failed is not None:
+                    done.append(failed)
+                continue
             first, failed = self._prefill_slot(slot_id, req, start,
                                                t_admit, bind=True,
                                                copy_pairs=copy_pairs)
@@ -783,6 +860,39 @@ class ContinuousBatchingScheduler:
                 continue
             done.extend(self._activate_slot(slot_id, req, first, t_admit))
         return done
+
+    def _begin_chunked_prefill(self, slot_id: int, req: Request,
+                               start: int, t_admit: float,
+                               bind: bool = False,
+                               copy_pairs=None) -> Optional[Completion]:
+        """Chunked-mode admission epilogue (and restore-landing
+        epilogue): bind the slot's executor state under the per-request
+        isolation contract and mark it PREFILLING from ``start`` — the
+        ragged step then feeds its prompt in chunks at step boundaries.
+        Returns a FAILED Completion when binding/CoW raised (blocks
+        released, slot immediately admissible), else None."""
+        slot = self.slots[slot_id]
+        try:
+            if bind:
+                self.executor.set_slot(slot_id, req)
+                if copy_pairs:
+                    self.executor.copy_blocks(copy_pairs)
+        except Exception as e:
+            self.tables.release(slot_id)
+            self._clear_slot(slot_id)
+            return self._terminal_queued(
+                req, FAILED, f"executor prefill error: {e}",
+                time.time(), t_admitted=t_admit)
+        slot.req = req
+        slot.out = []
+        slot.seq_len = int(start)
+        slot.remaining = req.max_new_tokens
+        slot.t_admitted = t_admit
+        slot.t_first = t_admit
+        self.seq_lens[slot_id] = int(start)
+        self.prefilling[slot_id] = True
+        self._prefill_next[slot_id] = int(start)
+        return None
 
     def _prefill_slot(self, slot_id: int, req: Request, start: int,
                       t_admit: float, bind: bool = False,
@@ -827,6 +937,7 @@ class ContinuousBatchingScheduler:
             if self.metrics is not None:
                 self.metrics.observe("serve.prefill_s",
                                      time.time() - t0_w)
+            self._step_prefill_tokens += len(req.prompt) - int(start)
             return first, None
         except Exception as e:
             if tr is not None:
@@ -973,6 +1084,16 @@ class ContinuousBatchingScheduler:
             else:
                 start = st.dev_start
                 self.host_restore_failures += 1
+            if self.chunk_tokens:
+                # the restored slot enters PREFILLING at its covered
+                # offset — the ragged step feeds the uncovered tail in
+                # chunks starting this very step (set_slot already ran
+                # at begin_restore time)
+                failed = self._begin_chunked_prefill(
+                    slot_id, req, start, st.t_admit)
+                if failed is not None:
+                    done.append(failed)
+                continue
             first, failed = self._prefill_slot(slot_id, req, start,
                                                st.t_admit)
             if failed is not None:
@@ -1035,6 +1156,8 @@ class ContinuousBatchingScheduler:
         slot.remaining = 0
         self.active[slot_id] = False
         self.stalled[slot_id] = False
+        self.prefilling[slot_id] = False
+        self._prefill_next[slot_id] = 0
         self.steps_left[slot_id] = 0
         self.seq_lens[slot_id] = 0
         self.last_tokens[slot_id] = 0
@@ -1144,6 +1267,7 @@ class ContinuousBatchingScheduler:
             for s in self.slots if s.req is not None)
         self.occupancy_log.append({
             "t": now,
+            "t_wall": time.time(),
             "blocks_allocated": self.pool.num_allocated,
             "blocks_reserved_equiv": reserved_equiv,
             "blocks_cached": getattr(self.pool, "num_cached", 0),
@@ -1151,7 +1275,13 @@ class ContinuousBatchingScheduler:
             "live_tokens": int(self.seq_lens.sum()),
             "active_slots": int(self.active.sum()),
             "stalled_slots": int(self.stalled.sum()),
+            "prefilling_slots": int(self.prefilling.sum()),
             "queued": len(self.queue),
+            # per-step work split — the decode-interference A/B's
+            # evidence that chunked prefill keeps decode emitting
+            # (bench.py --serve, detail.chunked_prefill_ab)
+            "decode_tokens": int(self._step_decode_tokens),
+            "prefill_tokens": int(self._step_prefill_tokens),
         })
 
     # --- one scheduling iteration --------------------------------------------
@@ -1162,6 +1292,8 @@ class ContinuousBatchingScheduler:
         terminals alike (possibly empty)."""
         now = time.time() if now is None else now
         self._step_idx += 1
+        self._step_decode_tokens = 0
+        self._step_prefill_tokens = 0
         fi = self.fault_injector
         if fi is not None:
             for rid in fi.cancels(self._step_idx):
@@ -1173,7 +1305,10 @@ class ContinuousBatchingScheduler:
         # joins this step's decode and its registered prefix is already
         # hittable by this step's admissions
         done.extend(self._finish_restores(now))
-        chunk = max(1, int(getattr(self.executor, "decode_chunk", 1)))
+        # chunked mode decodes exactly ONE step per ragged call (the
+        # mixed batch is the amortization), so its growth horizon is 1
+        chunk = 1 if self.chunk_tokens else \
+            max(1, int(getattr(self.executor, "decode_chunk", 1)))
         # growth FIRST: in-flight slots outrank the queue head for free
         # blocks — admitting ahead of mid-decode grows would convert
         # pool pressure into stalls of already-running requests
@@ -1183,6 +1318,11 @@ class ContinuousBatchingScheduler:
         pre_set = set(pre)
         self._grow([s for s in range(self.num_slots)
                     if self.active[s] and s not in pre_set], chunk)
+        if self.chunk_tokens:
+            if self.active.any() or self.prefilling.any():
+                done.extend(self._chunked_step(now))
+            self._finish_step(now)
+            return done
         if not self.active.any():
             self._finish_step(now)
             return done
@@ -1269,17 +1409,10 @@ class ContinuousBatchingScheduler:
             for tok in toks[slot_id]:
                 if slot.remaining <= 0:
                     break              # chunked executor overshoot: ignore
-                tok = int(tok)
-                slot.out.append(tok)
-                slot.seq_len += 1      # the fed token's KV was written
-                slot.remaining -= 1
+                self._consume_token(slot_id, int(tok))
                 consumed += 1
-                self.last_tokens[slot_id] = tok
-                if (slot.req.eos_id >= 0 and tok == slot.req.eos_id):
-                    slot.remaining = 0
-            self.seq_lens[slot_id] = slot.seq_len
-            self.steps_left[slot_id] = slot.remaining
             if consumed:
+                self._step_decode_tokens += consumed
                 if tr is not None:
                     # one DECODE span per participating slot per chunk —
                     # Perfetto then shows each slot lane's request
@@ -1292,6 +1425,195 @@ class ContinuousBatchingScheduler:
             if slot.remaining <= 0:
                 done.append(self._finish(slot_id, t_now))
         self._finish_step(now)
+        return done
+
+    def _consume_token(self, slot_id: int, tok: int) -> None:
+        """One sampled token into a slot's stream: output append,
+        KV/budget bookkeeping, eos retirement — the ONE place decode-
+        consumption semantics live. The legacy multi-token chunk loop
+        and the ragged step both consume through here, so the two
+        serving modes cannot drift."""
+        slot = self.slots[slot_id]
+        slot.out.append(tok)
+        slot.seq_len += 1              # the fed token's KV was written
+        slot.remaining -= 1
+        self.last_tokens[slot_id] = tok
+        if slot.req.eos_id >= 0 and tok == slot.req.eos_id:
+            slot.remaining = 0
+        self.seq_lens[slot_id] = slot.seq_len
+        self.steps_left[slot_id] = slot.remaining
+
+    # --- chunked prefill: the unified ragged step ----------------------------
+    def _assign_prefill_chunks(self) -> Dict[int, int]:
+        """{slot: chunk tokens} for this step, under the token budget:
+        the TOTAL new prefill tokens across slots is capped at
+        ``chunk_tokens`` (Sarathi-style budget — decode slots' 1-token
+        queries ride along on top), FAIR-SHARED across concurrently
+        prefilling slots in admission order (earlier slots take the
+        ceil share, and any slot whose remaining prompt is smaller
+        frees its share for the rest). A short prompt admitted behind a
+        long one therefore rides the SAME steps as the long prompt's
+        chunks instead of queueing behind its whole prefill — the
+        short-request TTFT protection chunked prefill exists for —
+        while a lone prompt still gets the full budget per step."""
+        assignments: Dict[int, int] = {}
+        budget = self.chunk_tokens
+        order = sorted(np.nonzero(self.prefilling)[0],
+                       key=lambda s: (self.slots[s].t_admitted, s))
+        for i, s in enumerate(order):
+            if budget <= 0:
+                break
+            slot = self.slots[s]
+            rem = len(slot.req.prompt) - int(self._prefill_next[s])
+            fair = -(-budget // (len(order) - i))      # ceil share
+            take = min(budget, fair, rem)
+            if take > 0:
+                assignments[int(s)] = int(take)
+                budget -= take
+        return assignments
+
+    def _chunked_step(self, now: float) -> List[Completion]:
+        """One token-budget scheduling iteration: pack this step's
+        prefill chunks plus every runnable decode slot into ONE
+        ``executor.ragged_step`` call, then consume — chunk slots
+        advance their prefill cursor (the FINAL chunk's sampled token is
+        the request's first output token), decode slots consume exactly
+        one token. A long prompt therefore never stalls decode for more
+        than one chunk's worth of work."""
+        done: List[Completion] = []
+        fi = self.fault_injector
+        tr = self.tracer
+        B = self.num_slots
+        runnable = np.logical_and(self.active, ~self.stalled)
+        assignments = self._assign_prefill_chunks()
+        if not runnable.any() and not assignments:
+            if not self.active.any():
+                return done            # only restores/queue left
+            # every active slot is stalled on an empty pool and no
+            # prefill work exists: the legacy preemption ladder applies
+            term = self._preempt_for_progress(now)
+            if term is not None:
+                done.append(term)
+            self._grow([s for s in range(self.num_slots)
+                        if self.active[s]], 1)
+            runnable = np.logical_and(self.active, ~self.stalled)
+            if not runnable.any():
+                return done
+        if fi is not None:
+            # injected PREFILL faults fire per chunk slot, before the
+            # combined call — per-request isolation exactly as on the
+            # legacy prefill path (that one request FAILS, its blocks
+            # release, the step's other work proceeds)
+            for s in sorted(assignments):
+                slot = self.slots[s]
+                try:
+                    fi.before_prefill(self._step_idx, s, slot.req.rid)
+                except Exception as e:
+                    req = slot.req
+                    t_admit = slot.t_admitted
+                    self.tables.release(s)
+                    self._clear_slot(s)
+                    done.append(self._terminal_queued(
+                        req, FAILED, f"executor prefill error: {e}",
+                        time.time(), t_admitted=t_admit))
+                    del assignments[s]
+            if not runnable.any() and not assignments:
+                return done
+        T_cap = self.chunk_tokens if assignments else 1
+        tokens = np.zeros((B, T_cap), np.int32)
+        q_lens = np.zeros(B, np.int32)
+        emit = np.zeros(B, bool)
+        is_first = np.zeros(B, bool)
+        write_pos = self.seq_lens.copy()
+        for s in range(B):
+            if runnable[s]:
+                tokens[s, 0] = self.last_tokens[s]
+                q_lens[s] = 1
+                emit[s] = True
+        for s, take in assignments.items():
+            pos = int(self._prefill_next[s])
+            prompt = self.slots[s].req.prompt
+            tokens[s, :take] = prompt[pos:pos + take]
+            q_lens[s] = take
+            emit[s] = pos + take == len(prompt)
+            is_first[s] = emit[s]      # final chunk: the FIRST token
+            write_pos[s] = self.slots[s].seq_len
+        # growth/admission allocations above may have evicted cached
+        # blocks — spill their frames before the program writes the pool
+        self._flush_spills()
+        t0_m = tr.now() if tr is not None else 0.0
+        t0_w = time.time()
+        try:
+            if fi is not None:
+                delay = fi.chunk_delay(self._step_idx)
+                if delay > 0:
+                    time.sleep(delay)
+                fi.before_decode(self._step_idx)
+            toks = np.asarray(self.executor.ragged_step(
+                tokens, q_lens, self.tables.table, write_pos, emit,
+                is_first), np.int32).reshape(-1)
+        except Exception as e:
+            if tr is not None:
+                tr.span("DECODE", t0_m, tr.now(), cat="executor",
+                        step=self._step_idx, error=str(e))
+            # PER-REQUEST ISOLATION: the combined call failed as a
+            # whole, so NO slot consumed tokens. A slot-attributed
+            # RequestFault fails exactly that request (decode OR
+            # prefill-chunk slot); an unattributed exception fails
+            # every slot IN the call — queued and restoring requests
+            # keep serving.
+            in_call = runnable.copy()
+            for s in assignments:
+                in_call[s] = True
+            done.extend(self._on_decode_error(e, in_call, now))
+            return done
+        t_now = time.time()
+        t1_m = tr.now() if tr is not None else 0.0
+        if self.metrics is not None:
+            self.metrics.inc("serve.decode_calls")
+            self.metrics.inc("serve.ragged_steps")
+            self.metrics.observe("serve.decode_chunk_s",
+                                 max(0.0, t_now - t0_w))
+        # consume prefill chunks: advance cursors, activate final chunks
+        for s in sorted(assignments):
+            take = assignments[s]
+            slot = self.slots[s]
+            start = int(self._prefill_next[s])
+            pos = start + take
+            self._prefill_next[s] = pos
+            slot.seq_len = pos         # the chunk's KV is written
+            self.seq_lens[s] = pos
+            if tr is not None:
+                tr.span("PREFILL", t0_m, t1_m, tid=1 + s,
+                        rid=slot.req.rid, slot=s, start=start,
+                        tokens=take)
+            if self.metrics is not None:
+                self.metrics.inc("serve.prefill_chunks")
+                self.metrics.inc("serve.prefill_chunk_tokens", take)
+            self._step_prefill_tokens += take
+            if emit[s]:
+                # FINAL chunk: its sampled token is the first output
+                # token — the slot graduates to decoding (eos /
+                # 1-token budgets retire immediately, exactly like the
+                # unchunked admission path)
+                self.prefilling[s] = False
+                done.extend(self._activate_slot(
+                    s, slot.req, int(toks[s]), slot.t_admitted))
+        # consume decode tokens (one per runnable slot)
+        for s in range(B):
+            if not runnable[s]:
+                continue
+            slot = self.slots[s]
+            self._consume_token(s, int(toks[s]))
+            self._step_decode_tokens += 1
+            if tr is not None:
+                tr.span("DECODE", t0_m, t1_m, tid=1 + s,
+                        rid=slot.req.rid, slot=s, step=self._step_idx,
+                        tokens=1)
+            if self.metrics is not None:
+                self.metrics.inc("serve.tokens_sampled")
+            if slot.remaining <= 0:
+                done.append(self._finish(s, t_now))
         return done
 
     def _finish_step(self, now: float) -> None:
@@ -1307,6 +1629,8 @@ class ContinuousBatchingScheduler:
                         getattr(self.pool, "num_cached", 0))
             m.set_gauge("serve.active_slots", int(self.active.sum()))
             m.set_gauge("serve.stalled_slots", int(self.stalled.sum()))
+            m.set_gauge("serve.prefilling_slots",
+                        int(self.prefilling.sum()))
             m.set_gauge("serve.restoring_slots", len(self._restores))
             m.set_gauge("serve.queued", len(self.queue))
             m.set_gauge("serve.live_tokens", int(self.seq_lens.sum()))
@@ -1356,13 +1680,24 @@ class ContinuousBatchingScheduler:
                 v.append(f"slot {s} restoring with no bound request")
         if self.host_tier is not None:
             v.extend(f"host tier: {x}" for x in self.host_tier.audit())
+        for s in np.nonzero(self.prefilling)[0]:
+            if self.slots[s].req is None:
+                v.append(f"slot {s} prefilling with no bound request")
+                continue
+            if self.active[s]:
+                v.append(f"slot {s} both prefilling and active")
+            if self._prefill_next[s] >= len(self.slots[s].req.prompt):
+                v.append(f"slot {s} prefilling past its prompt "
+                         f"({int(self._prefill_next[s])})")
         for s, slot in enumerate(self.slots):
             if slot.req is None:
                 if self.tables.num_blocks_of(s):
                     v.append(f"free slot {s} still holds blocks "
                              f"{self.tables.blocks_of(s)}")
-                if self.active[s] or self.stalled[s]:
-                    v.append(f"free slot {s} marked active/stalled")
+                if self.active[s] or self.stalled[s] \
+                        or self.prefilling[s]:
+                    v.append(f"free slot {s} marked "
+                             f"active/stalled/prefilling")
             else:
                 cap = self.tables.slot_capacity_tokens(s)
                 if slot.seq_len > cap:
@@ -1408,7 +1743,8 @@ class ContinuousBatchingScheduler:
         while self.busy:
             done = self.step()
             yield from done
-            if not self.active.any() and not self._restores and self.queue:
+            if not self.active.any() and not self.prefilling.any() \
+                    and not self._restores and self.queue:
                 nxt = self.next_arrival()
                 if nxt is not None:
                     wait = nxt - time.time()

@@ -807,8 +807,9 @@ class FusedLlamaDecoderModel:
         self.w8a8_decode = False
         # fused gated-MLP decode kernel (quant.fused_mlp; default off)
         self.fused_mlp = False
-        # paged decode arm (engine-plumbed from serve.attn_kernel):
-        # "pallas" routes T=1 apply_paged steps through the ragged
+        # paged attention arm (engine-plumbed from serve.attn_kernel):
+        # "pallas" routes EVERY apply_paged call — decode steps, prefill
+        # chunks and mixed ragged batches — through the unified ragged
         # Pallas kernel (ops/paged_attention_kernel.py) for both dense
         # and int8 pools; "reference" is the jnp gather path
         self.paged_attn_kernel = "reference"
@@ -1017,10 +1018,14 @@ class FusedLlamaDecoderModel:
             resolve_paged_attention,
         )
 
-        # ONE dispatch point for the serving attention arm: the Pallas
-        # ragged kernel streams live pool blocks (falling back to the
-        # reference for T > 1 prefill rows internally); the reference
-        # materializes the full-width gather
+        # ONE dispatch point for the serving attention arm: the unified
+        # ragged Pallas kernel streams live pool blocks for decode
+        # tokens, prefill chunks and mixed ragged batches alike (no
+        # T > 1 reference fallback anymore); the reference materializes
+        # the full-width gather. ``valid_len`` doubles as the per-slot
+        # query length (padded rows' writes already went to the null
+        # block; their attention rows return zeros / garbage nobody
+        # reads).
         attn_fn, attn_int8_fn = resolve_paged_attention(
             getattr(self, "paged_attn_kernel", "reference"))
 
@@ -1036,12 +1041,14 @@ class FusedLlamaDecoderModel:
                 vsp = paged_append_scales(vsp, vsc, block_tables,
                                           write_pos, valid_len)
                 a = attn_int8_fn(q, kqp, ksp, vqp, vsp,
-                                 block_tables, positions)
+                                 block_tables, positions,
+                                 q_lens=valid_len)
                 return a, (kqp, ksp, vqp, vsp)
             kp, vp = cache
             kp, vp = paged_append(kp, vp, k, v, block_tables, write_pos,
                                   valid_len)
-            a = attn_fn(q, kp, vp, block_tables, positions)
+            a = attn_fn(q, kp, vp, block_tables, positions,
+                        q_lens=valid_len)
             return a, (kp, vp)
 
         return self._forward(fused_params, input_ids, positions, kv_pools,

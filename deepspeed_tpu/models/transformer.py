@@ -201,12 +201,12 @@ class SelfAttention(nn.Module):
     use_bias: bool = False
     out_bias: Optional[bool] = None       # None → use_bias; GPT-Neo: qkv no, out yes
     attn_scale: Optional[float] = None    # None → 1/sqrt(head_dim); GPT-Neo: 1.0
-    # paged decode arm (serve.attn_kernel): "pallas" routes T=1 steps
-    # through the ragged Pallas kernel (one live pool block at a time in
-    # VMEM, GQA by indexing — ops/paged_attention_kernel.py); the
-    # reference path materializes the full-width pool gather. Prefill
-    # (T > 1) always takes the reference path — it is MXU-bound and
-    # happens once per request.
+    # paged decode arm (serve.attn_kernel): "pallas" routes EVERY paged
+    # step — decode tokens, prefill chunks and mixed ragged batches —
+    # through the unified ragged Pallas kernel (one live pool block at a
+    # time in VMEM, per-row causal masking, GQA by indexing —
+    # ops/paged_attention_kernel.py); the reference path materializes
+    # the full-width pool gather.
     paged_attn_kernel: str = "reference"
 
     @nn.compact
@@ -251,18 +251,23 @@ class SelfAttention(nn.Module):
             kp, vp = paged_append(kp, vp, k, v, block_tables, write_pos,
                                   valid_len)
             updated_cache = (kp, vp)
-            if self.paged_attn_kernel == "pallas" and S == 1:
-                # ragged Pallas decode: the kernel streams live pool
-                # blocks and applies the causal-context mask itself; the
-                # caller's mask rides along as additive extra terms
-                # (ALiBi, local windows) — its causal component is
-                # redundant with the kernel's own and its fully-masked
-                # entries stay consistent with the ragged skip. When the
-                # caller PROMISES a pure causal-context mask
-                # (assume_causal_mask — the paged llama blocks), skip the
-                # mask input entirely: streaming a [B, H, S] fp32 mask
-                # per step per layer is exactly the max_context-width
-                # traffic the ragged kernel exists to avoid
+            if self.paged_attn_kernel == "pallas":
+                # unified ragged Pallas attention (decode T=1, prefill
+                # chunks T>1, mixed ragged batches): the kernel streams
+                # live pool blocks and applies the per-row causal-context
+                # mask itself; the caller's mask rides along as additive
+                # extra terms (ALiBi, local windows) — its causal
+                # component is redundant with the kernel's own and its
+                # fully-masked entries stay consistent with the ragged
+                # skip. When the caller PROMISES a pure causal-context
+                # mask (assume_causal_mask — the paged llama blocks),
+                # skip the mask input entirely: streaming a [B, H, T, S]
+                # fp32 mask per step per layer is exactly the
+                # max_context-width traffic the ragged kernel exists to
+                # avoid. ``valid_len`` doubles as the per-slot query
+                # length: padded rows return zeros (their KV writes
+                # already went to the null block) and do not extend the
+                # streamed context.
                 from deepspeed_tpu.ops.paged_attention_kernel import (
                     paged_attention_pallas,
                 )
@@ -270,7 +275,7 @@ class SelfAttention(nn.Module):
                 extra = None if self.assume_causal_mask else mask
                 out = paged_attention_pallas(
                     q, kp, vp, block_tables, positions, mask_extra=extra,
-                    scale=self.attn_scale)
+                    scale=self.attn_scale, q_lens=valid_len)
             else:
                 k = paged_gather(kp, block_tables)
                 v = paged_gather(vp, block_tables)

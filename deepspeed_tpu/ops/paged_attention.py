@@ -171,19 +171,37 @@ def paged_context_mask(row_pos: jnp.ndarray, S: int) -> jnp.ndarray:
     return jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
 
 
+def _ragged_row_mask(q_lens: Optional[jnp.ndarray], B: int,
+                     T: int) -> Optional[jnp.ndarray]:
+    """[B, T] bool validity of query rows for a ragged batch — slot b's
+    rows at/past ``q_lens[b]`` are padding. None disables (all rows
+    real). The ragged contract zeroes invalid rows' output so the
+    Pallas kernel and this reference agree on the WHOLE array, not just
+    the rows a caller happens to read."""
+    if q_lens is None:
+        return None
+    return jnp.arange(T, dtype=jnp.int32)[None, :] < \
+        q_lens.astype(jnp.int32)[:, None]
+
+
 def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
                     block_tables: jnp.ndarray, row_pos: jnp.ndarray,
                     mask_extra: Optional[jnp.ndarray] = None,
-                    scale: Optional[float] = None) -> jnp.ndarray:
-    """Reference paged attention for one layer.
+                    scale: Optional[float] = None,
+                    q_lens: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Reference RAGGED paged attention for one layer.
 
     q: [B, T, H, hd] (already rotary-embedded); k_pool/v_pool:
     [nb, bs, n_kv, hd]; row_pos: [B, T] absolute positions of the query
-    tokens (= context length before this call + arange(T)). K/V heads are
-    broadcast to H when grouped (GQA). ``mask_extra`` ([B|1, H|1, T, S])
-    adds architecture terms (ALiBi, local windows) on top of the causal
-    context mask. Exact-match vs the dense path: same fp32-softmax core,
-    same mask values, only the K/V layout differs.
+    tokens (= context length before this call + arange(T)). Each slot
+    may carry a different REAL query length (``q_lens`` [B], None = all
+    T): decode tokens are T-slices of length 1, prefill chunks longer —
+    one signature serves the mixed batch, which is what the unified
+    ragged Pallas kernel mirrors. Rows past ``q_lens`` return zeros.
+    K/V heads are broadcast to H when grouped (GQA). ``mask_extra``
+    ([B|1, H|1, T, S]) adds architecture terms (ALiBi, local windows) on
+    top of the causal context mask. Exact-match vs the dense path: same
+    fp32-softmax core, same mask values, only the K/V layout differs.
     """
     k = paged_gather(k_pool, block_tables)       # [B, S, n_kv, hd]
     v = paged_gather(v_pool, block_tables)
@@ -198,19 +216,27 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
         mask = mask + mask_extra
     from deepspeed_tpu.models.transformer import dot_product_attention
 
-    return dot_product_attention(q, k, v, mask=mask, scale=scale)
+    out = dot_product_attention(q, k, v, mask=mask, scale=scale)
+    rows = _ragged_row_mask(q_lens, q.shape[0], q.shape[1])
+    if rows is not None:
+        out = out * rows[:, :, None, None].astype(out.dtype)
+    return out
 
 
 def paged_attention_int8(q: jnp.ndarray, kq_pool: jnp.ndarray,
                          ks_pool: jnp.ndarray, vq_pool: jnp.ndarray,
                          vs_pool: jnp.ndarray, block_tables: jnp.ndarray,
-                         row_pos: jnp.ndarray) -> jnp.ndarray:
-    """Paged attention over an int8 block pool (quant.kv_cache).
+                         row_pos: jnp.ndarray,
+                         q_lens: Optional[jnp.ndarray] = None
+                         ) -> jnp.ndarray:
+    """RAGGED paged attention over an int8 block pool (quant.kv_cache).
 
     Same math as the fused dense int8 path (FusedLlamaDecoderModel
     ``attn_int8``): per-(token, head) scales factor out of both dots over
     hd, so pool reads stay 1 byte/elem and dequant is a post-dot row
-    multiply; softmax stays fp32.
+    multiply; softmax stays fp32. ``q_lens`` carries the per-slot real
+    query lengths of a mixed ragged batch (rows past it return zeros),
+    exactly like :func:`paged_attention`.
     """
     kq = paged_gather(kq_pool, block_tables)     # [B, S, n_kv, hd] int8
     ks = paged_gather(ks_pool, block_tables)     # [B, S, n_kv] f32
@@ -232,4 +258,8 @@ def paged_attention_int8(q: jnp.ndarray, kq_pool: jnp.ndarray,
     scores = scores + mask
     weights = jax.nn.softmax(scores, axis=-1)
     weights = (weights * vs.transpose(0, 2, 1)[:, :, None, :]).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, vq.astype(q.dtype))
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, vq.astype(q.dtype))
+    rows = _ragged_row_mask(q_lens, q.shape[0], q.shape[1])
+    if rows is not None:
+        out = out * rows[:, :, None, None].astype(out.dtype)
+    return out

@@ -1,4 +1,4 @@
-"""Pallas TPU ragged paged-attention decode kernel.
+"""Pallas TPU unified ragged paged-attention kernel (prefill + decode).
 
 Drop-in for the jnp reference ops in ``ops/paged_attention.py``
 (:func:`paged_attention` / :func:`paged_attention_int8` signatures): where
@@ -10,21 +10,36 @@ each slot's LIVE context instead of ``max_context``
 (Ragged Paged Attention, arXiv:2604.15464; kernel-level serving
 optimization per DeepSpeed-Inference, arXiv:2207.00032).
 
+ONE kernel serves every serving shape: decode tokens (T == 1), prefill
+chunks (T > 1, causally masked against the slot's own in-flight chunk),
+and MIXED ragged batches where each slot brings its own query length —
+the single-``pallas_call`` design of Ragged Paged Attention. There is no
+jnp-reference fallback on the pallas arm anymore; the dstlint jaxpr pass
+pins a ``pallas_call`` equation in the decode, prefill-bucket AND
+ragged-step programs.
+
 Design (same pattern family as ops/flash_attention.py / int8_matmul.py):
 
 - grid ``(slot, kv_block)`` with the kv axis innermost; fp32 running
-  max / sum / accumulator live in VMEM scratch across kv steps.
-- block tables and per-slot context lengths ride SCALAR PREFETCH
+  max / sum / accumulator for all ``H*T`` query rows live in VMEM
+  scratch across kv steps.
+- block tables, per-slot WRITE POSITIONS (context before this call) and
+  per-slot QUERY LENGTHS ride SCALAR PREFETCH
   (``pltpu.PrefetchScalarGridSpec``): the index map dereferences
   ``table[slot, block]`` in SMEM, so each grid step's K/V DMA reads the
   mapped pool block directly — the gather never exists in HBM.
-- RAGGED iteration: table entries at/past a slot's context length are
-  not streamed. The grid is static ``(B, W)``, but dead steps remap
-  their DMA index to the slot's last live block (consecutive identical
-  block indices are not re-fetched by the pipeline) and skip all
-  compute via ``pl.when`` — the kv bytes moved track ``sum(ctx_i)``,
-  not ``B*W*bs``.
-- GQA broadcasts by INDEXING: q is viewed ``[n_kv, rep, hd]`` and
+- RAGGED iteration: table entries at/past a slot's attendable length
+  (``write_pos + q_len``) are not streamed. The grid is static
+  ``(B, W)``, but dead steps remap their DMA index to the slot's last
+  live block (consecutive identical block indices are not re-fetched by
+  the pipeline) and skip all compute via ``pl.when`` — the kv bytes
+  moved track ``sum(ctx_i + qlen_i)``, not ``B*W*bs``.
+- CAUSALITY is per query row: row ``t`` of slot ``b`` attends exactly
+  the logical columns ``<= write_pos[b] + t`` — for T == 1 this is the
+  old decode mask, for a prefill chunk it is causal masking against the
+  slot's earlier context AND its own in-flight chunk (whose KV the
+  caller appends before attention, exactly like the reference).
+- GQA broadcasts by INDEXING: q is viewed ``[n_kv, rep*T, hd]`` and
   batch-dotted against the shared kv head — no ``jnp.repeat``
   materialization of K/V.
 - int8 pools (``quant.kv_cache``): the kernel reads int8 payloads and
@@ -32,12 +47,18 @@ Design (same pattern family as ops/flash_attention.py / int8_matmul.py):
   the scales as post-dot row multiplies — the HBM read stays
   1 byte/elem with no converted copy (the XLA path materializes one;
   PERF_ANALYSIS round-4 kv8 note).
+- ``q_lens`` (optional int32 [B]) marks how many of the T query rows
+  are real per slot; rows past it produce ZERO output (the same
+  contract as the ragged jnp reference) and do not extend the streamed
+  context. None means all T rows are real.
+- QUERY TILING: scratch scales with ``H*T``, so query blocks longer
+  than :data:`Q_TILE` rows split into independent per-tile launches in
+  the wrapper — big unchunked prefill buckets stay inside the per-core
+  VMEM budget instead of failing at Mosaic compile.
 
-DECODE kernel: T == 1 queries (the serving decode step). Prefill calls
-(T > 1) fall back to the jnp reference inside the same wrappers, so
-callers route unconditionally. Off-TPU the kernel runs in interpret
-mode — the tier-1 parity tests pin it bit-close to the reference on the
-CPU mesh (tests/unit/inference/test_paged_attention.py).
+Off-TPU the kernel runs in interpret mode — the tier-1 parity tests pin
+it bit-close to the ragged reference on the CPU mesh
+(tests/unit/inference/test_paged_attention.py).
 """
 
 import functools
@@ -60,20 +81,31 @@ NEG_INF = -1e30
 # overflow to -inf — both sit far below any real score+bias)
 MASK_MASKED = -1e29
 
+# query-tile bound: a single launch's VMEM scratch is three
+# [H*T_tile, …] fp32 buffers, so T is capped per launch and longer
+# query blocks (big unchunked prefill buckets) split into row tiles in
+# the WRAPPER — at H=32/hd=128 a 64-row tile keeps scratch ~3 MB,
+# comfortably inside the ~16 MB/core budget the dstlint mempass gates,
+# where an untiled 1024-token prefill would want ~50 MB. Each tile is
+# self-contained (row masks depend only on the row's own position), so
+# the split is exact, and tiles stream only the KV their own rows can
+# attend (earlier tiles read fewer blocks).
+Q_TILE = 64
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
 def _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv_fn):
-    """One flash-style accumulation step over a ``[H, bs]`` score block.
+    """One flash-style accumulation step over a ``[H*T, bs]`` score block.
 
-    ``pv_fn(p)`` maps probabilities ``[H, bs]`` to the value contribution
-    ``[H, hd]`` (the dense and int8 kernels differ only in how scores and
-    values are scaled). Invalid columns are explicitly ZEROED in p — with
-    ragged masks a whole block can be dead while the running max is still
-    NEG_INF, where the usual exp(s - m) trick would contribute exp(0)=1
-    garbage rows."""
+    ``pv_fn(p)`` maps probabilities ``[H*T, bs]`` to the value
+    contribution ``[H*T, hd]`` (the dense and int8 kernels differ only in
+    how scores and values are scaled). Invalid columns are explicitly
+    ZEROED in p — with ragged masks a whole block (or a whole query row)
+    can be dead while the running max is still NEG_INF, where the usual
+    exp(s - m) trick would contribute exp(0)=1 garbage rows."""
     m_prev = m_scr[...]
     l_prev = l_scr[...]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -86,8 +118,26 @@ def _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv_fn):
     m_scr[...] = m_next
 
 
-def _dense_kernel(bt_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, n_kv,
-                  rep, sm_scale, num_w, has_mask):
+def _attendable_end(wp, ql, S):
+    """Furthest logical column any real query row of the slot attends:
+    the row at ``t = ql - 1`` sees ``wp + ql`` positions. Clamped to
+    [1, S] so inactive slots (q_len 0, stale positions, all-null
+    tables) stay in-bounds — they read the null block and their output
+    is zero / ignored, exactly like the reference gather."""
+    return jnp.clip(wp + jnp.maximum(ql, 1), 1, S)
+
+
+def _row_validity(s_rows, bs, T, w, wp, ql):
+    """(col <= wp + t) & (t < ql) over a flattened ``[H*T, bs]`` score
+    block whose row order is ``h * T + t`` — per-row causality against
+    the slot's context + its own chunk, and ragged row masking."""
+    col = w * bs + jax.lax.broadcasted_iota(jnp.int32, (s_rows, bs), 1)
+    t_row = jax.lax.broadcasted_iota(jnp.int32, (s_rows, bs), 0) % T
+    return jnp.logical_and(col <= wp + t_row, t_row < ql)
+
+
+def _dense_kernel(bt_ref, wp_ref, ql_ref, q_ref, k_ref, v_ref, *rest, bs,
+                  n_kv, rep, T, sm_scale, num_w, has_mask):
     if has_mask:
         mask_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -95,9 +145,11 @@ def _dense_kernel(bt_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, n_kv,
         o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     w = pl.program_id(1)
-    ctx = ctx_ref[b]
-    live = (ctx + bs - 1) // bs
+    wp = wp_ref[b]
+    ql = ql_ref[b]
+    live = (_attendable_end(wp, ql, num_w * bs) + bs - 1) // bs
     H = n_kv * rep
+    R = H * T
 
     @pl.when(w == 0)
     def _init():
@@ -107,46 +159,49 @@ def _dense_kernel(bt_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, n_kv,
 
     @pl.when(w < live)
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # [H, hd]
+        q = q_ref[0].astype(jnp.float32)            # [T, H, hd]
         k = k_ref[0].astype(jnp.float32)            # [bs, n_kv, hd]
         v = v_ref[0].astype(jnp.float32)
-        q3 = q.reshape(n_kv, rep, q.shape[-1])
+        # rows ordered h*T + t: head-major, then the slot's chunk axis
+        q3 = jnp.swapaxes(q, 0, 1).reshape(n_kv, rep * T, q.shape[-1])
         kT = jnp.swapaxes(k, 0, 1)                  # [n_kv, bs, hd]
         s3 = jax.lax.dot_general(q3, kT, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
-        s = s3.reshape(H, bs) * sm_scale
-        col = w * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
-        valid = col < ctx
+        s = s3.reshape(R, bs) * sm_scale
+        valid = _row_validity(R, bs, T, w, wp, ql)
         if has_mask:
-            mval = mask_ref[0].astype(jnp.float32)  # [H, bs]
+            mval = mask_ref[0].astype(jnp.float32).reshape(R, bs)
             valid = jnp.logical_and(valid, mval > MASK_MASKED)
             s = s + jnp.where(mval > MASK_MASKED, mval, 0.0)
         s = jnp.where(valid, s, NEG_INF)
         vT = jnp.swapaxes(v, 0, 1)                  # [n_kv, bs, hd]
 
         def pv(p):
-            p3 = p.reshape(n_kv, rep, bs)
+            p3 = p.reshape(n_kv, rep * T, bs)
             out = jax.lax.dot_general(
                 p3, vT, (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)
-            return out.reshape(H, out.shape[-1])
+            return out.reshape(R, out.shape[-1])
 
         _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv)
 
     @pl.when(w == num_w - 1)
     def _finalize():
         denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
-        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        out = (acc_scr[...] / denom).reshape(H, T, acc_scr.shape[-1])
+        o_ref[0] = jnp.swapaxes(out, 0, 1).astype(o_ref.dtype)
 
 
-def _int8_kernel(bt_ref, ctx_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
-                 o_ref, m_scr, l_scr, acc_scr, *, bs, n_kv, rep, sm_scale,
-                 num_w):
+def _int8_kernel(bt_ref, wp_ref, ql_ref, q_ref, kq_ref, ks_ref, vq_ref,
+                 vs_ref, o_ref, m_scr, l_scr, acc_scr, *, bs, n_kv, rep, T,
+                 sm_scale, num_w):
     b = pl.program_id(0)
     w = pl.program_id(1)
-    ctx = ctx_ref[b]
-    live = (ctx + bs - 1) // bs
+    wp = wp_ref[b]
+    ql = ql_ref[b]
+    live = (_attendable_end(wp, ql, num_w * bs) + bs - 1) // bs
     H = n_kv * rep
+    R = H * T
 
     @pl.when(w == 0)
     def _init():
@@ -156,68 +211,76 @@ def _int8_kernel(bt_ref, ctx_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
 
     @pl.when(w < live)
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # [H, hd]
+        q = q_ref[0].astype(jnp.float32)            # [T, H, hd]
         # int8 -> f32 IN VMEM: the HBM read was 1 byte/elem
         kq = kq_ref[0].astype(jnp.float32)          # [bs, n_kv, hd]
         vq = vq_ref[0].astype(jnp.float32)
         ksT = jnp.swapaxes(ks_ref[0].astype(jnp.float32), 0, 1)  # [n_kv, bs]
         vsT = jnp.swapaxes(vs_ref[0].astype(jnp.float32), 0, 1)
-        q3 = q.reshape(n_kv, rep, q.shape[-1])
+        q3 = jnp.swapaxes(q, 0, 1).reshape(n_kv, rep * T, q.shape[-1])
         kT = jnp.swapaxes(kq, 0, 1)                 # [n_kv, bs, hd]
         s3 = jax.lax.dot_general(q3, kT, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         # per-(token, head) K scales factor out of the dot over hd —
         # post-dot row multiply, same math as the jnp reference
         s3 = s3 * ksT[:, None, :]
-        s = s3.reshape(H, bs) * sm_scale
-        col = w * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
-        valid = col < ctx
+        s = s3.reshape(R, bs) * sm_scale
+        valid = _row_validity(R, bs, T, w, wp, ql)
         s = jnp.where(valid, s, NEG_INF)
         vT = jnp.swapaxes(vq, 0, 1)                 # [n_kv, bs, hd]
 
         def pv(p):
-            p3 = p.reshape(n_kv, rep, bs) * vsT[:, None, :]
+            p3 = p.reshape(n_kv, rep * T, bs) * vsT[:, None, :]
             out = jax.lax.dot_general(
                 p3, vT, (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)
-            return out.reshape(H, out.shape[-1])
+            return out.reshape(R, out.shape[-1])
 
         _online_softmax_update(s, valid, m_scr, l_scr, acc_scr, pv)
 
     @pl.when(w == num_w - 1)
     def _finalize():
         denom = jnp.maximum(l_scr[...][:, :1], 1e-30)
-        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        out = (acc_scr[...] / denom).reshape(H, T, acc_scr.shape[-1])
+        o_ref[0] = jnp.swapaxes(out, 0, 1).astype(o_ref.dtype)
 
 
-def _ragged_specs(B, W, bs, H, hd):
+def _ragged_specs(T, bs, H, hd, S):
     """(q_spec, page_map, out_spec, mask_map) for the (slot, kv_block)
     grid. ``page_map`` dereferences the prefetched block table; dead
     steps (block >= the slot's live count) remap to the last live block
     so the pipeline sees a repeated index and skips the re-fetch."""
 
-    def page_map(b, w, bt_ref, ctx_ref):
-        live = jnp.maximum((ctx_ref[b] + bs - 1) // bs, 1)
-        w_eff = jnp.minimum(w, live - 1)
+    def live_of(b, bt_ref, wp_ref, ql_ref):
+        end = _attendable_end(wp_ref[b], ql_ref[b], S)
+        return jnp.maximum((end + bs - 1) // bs, 1)
+
+    def page_map(b, w, bt_ref, wp_ref, ql_ref):
+        w_eff = jnp.minimum(w, live_of(b, bt_ref, wp_ref, ql_ref) - 1)
         return (bt_ref[b, w_eff], 0, 0, 0)
 
-    def mask_map(b, w, bt_ref, ctx_ref):
-        live = jnp.maximum((ctx_ref[b] + bs - 1) // bs, 1)
-        return (b, 0, jnp.minimum(w, live - 1))
+    def mask_map(b, w, bt_ref, wp_ref, ql_ref):
+        w_eff = jnp.minimum(w, live_of(b, bt_ref, wp_ref, ql_ref) - 1)
+        return (b, 0, 0, w_eff)
 
-    q_spec = pl.BlockSpec((1, H, hd), lambda b, w, bt_ref, ctx_ref: (b, 0, 0))
-    out_spec = pl.BlockSpec((1, H, hd),
-                            lambda b, w, bt_ref, ctx_ref: (b, 0, 0))
+    q_spec = pl.BlockSpec((1, T, H, hd),
+                          lambda b, w, bt_ref, wp_ref, ql_ref: (b, 0, 0, 0))
+    out_spec = pl.BlockSpec((1, T, H, hd),
+                            lambda b, w, bt_ref, wp_ref, ql_ref:
+                            (b, 0, 0, 0))
     return q_spec, page_map, out_spec, mask_map
 
 
-def _ctx_lengths(row_pos: jnp.ndarray, S: int) -> jnp.ndarray:
-    """Per-slot attendable length: the reference masks ``col <= row_pos``,
-    i.e. ``row_pos + 1`` logical positions. Clamped to [1, S] so inactive
-    slots (stale positions, all-null tables) stay in-bounds — they read
-    the null block and their output is ignored, exactly like the
-    reference gather."""
-    return jnp.clip(row_pos[:, 0].astype(jnp.int32) + 1, 1, S)
+def _prefetch_scalars(row_pos, q_lens, B, T):
+    """(write_pos [B], q_len [B]) int32 prefetch rows from the caller's
+    ``row_pos`` ([B, T] absolute positions, ``write_pos + arange(T)``)
+    and optional per-slot query lengths."""
+    wp = row_pos[:, 0].astype(jnp.int32)
+    if q_lens is None:
+        ql = jnp.full((B,), T, jnp.int32)
+    else:
+        ql = jnp.clip(q_lens.astype(jnp.int32), 0, T)
+    return wp, ql
 
 
 def paged_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -225,13 +288,18 @@ def paged_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
                            row_pos: jnp.ndarray,
                            mask_extra: Optional[jnp.ndarray] = None,
                            scale: Optional[float] = None,
-                           interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Pallas ragged decode behind the :func:`paged_attention` signature.
+                           interpret: Optional[bool] = None,
+                           q_lens: Optional[jnp.ndarray] = None
+                           ) -> jnp.ndarray:
+    """Pallas ragged attention behind the :func:`paged_attention`
+    signature — decode steps (T == 1), prefill chunks (T > 1) and mixed
+    ragged batches all run this ONE kernel.
 
-    q: [B, 1, H, hd] decode queries (T > 1 — prefill — falls back to the
-    jnp reference: prompt processing is MXU-bound and happens once per
-    request, while this kernel exists for the per-step KV traffic).
-    ``mask_extra`` ([B|1, H|1, 1, S]) adds architecture terms (ALiBi,
+    q: [B, T, H, hd] (already rotary-embedded); ``row_pos`` [B, T] are
+    the queries' absolute positions (``write_pos + arange(T)``);
+    ``q_lens`` (optional [B]) marks the real query rows per slot — rows
+    past it return zeros and do not extend the streamed context.
+    ``mask_extra`` ([B|1, H|1, T, S]) adds architecture terms (ALiBi,
     local windows) exactly as in the reference; entries <= -1e29 are
     treated as fully masked.
     """
@@ -240,45 +308,57 @@ def paged_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
             "the Pallas TPU surface is unavailable on this jax build — "
             "use serve.attn_kernel='reference'")
     B, T, H, hd = q.shape
-    if T != 1:
-        return _reference_attention(q, k_pool, v_pool, block_tables,
-                                    row_pos, mask_extra=mask_extra,
-                                    scale=scale)
+    if T > Q_TILE:
+        # query-row tiling: each tile is an independent launch with
+        # bounded VMEM scratch; rows mask by their own positions, so
+        # the split is exact (see Q_TILE)
+        outs = []
+        for t0 in range(0, T, Q_TILE):
+            t1 = min(t0 + Q_TILE, T)
+            outs.append(paged_attention_pallas(
+                q[:, t0:t1], k_pool, v_pool, block_tables,
+                row_pos[:, t0:t1],
+                mask_extra=(None if mask_extra is None
+                            else mask_extra[:, :, t0:t1]),
+                scale=scale, interpret=interpret,
+                q_lens=(None if q_lens is None
+                        else jnp.clip(q_lens - t0, 0, t1 - t0))))
+        return jnp.concatenate(outs, axis=1)
     nb, bs, n_kv, _ = k_pool.shape
     W = block_tables.shape[1]
     S = W * bs
     rep = H // n_kv
     sm_scale = float(scale) if scale is not None else float(hd) ** -0.5
-    ctx = _ctx_lengths(row_pos, S)
-    q_spec, page_map, out_spec, mask_map = _ragged_specs(B, W, bs, H, hd)
+    wp, ql = _prefetch_scalars(row_pos, q_lens, B, T)
+    q_spec, page_map, out_spec, mask_map = _ragged_specs(T, bs, H, hd, S)
     kv_spec = pl.BlockSpec((1, bs, n_kv, hd), page_map)
     in_specs = [q_spec, kv_spec, kv_spec]
-    inputs = [q[:, 0], k_pool, v_pool]
+    inputs = [q, k_pool, v_pool]
     has_mask = mask_extra is not None
     if has_mask:
         mask = jnp.broadcast_to(mask_extra.astype(jnp.float32),
-                                (B, H, 1, S))[:, :, 0, :]
-        in_specs.append(pl.BlockSpec((1, H, bs), mask_map))
+                                (B, H, T, S))
+        in_specs.append(pl.BlockSpec((1, H, T, bs), mask_map))
         inputs.append(mask)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, W),
         in_specs=in_specs,
         out_specs=out_spec,
         scratch_shapes=[
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.VMEM((H * T, 128), jnp.float32),
+            pltpu.VMEM((H * T, 128), jnp.float32),
+            pltpu.VMEM((H * T, hd), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_dense_kernel, bs=bs, n_kv=n_kv, rep=rep,
+        functools.partial(_dense_kernel, bs=bs, n_kv=n_kv, rep=rep, T=T,
                           sm_scale=sm_scale, num_w=W, has_mask=has_mask),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, T, H, hd), q.dtype),
         interpret=_use_interpret() if interpret is None else interpret,
-    )(block_tables.astype(jnp.int32), ctx, *inputs)
-    return out[:, None]
+    )(block_tables.astype(jnp.int32), wp, ql, *inputs)
+    return out
 
 
 def paged_attention_int8_pallas(q: jnp.ndarray, kq_pool: jnp.ndarray,
@@ -286,58 +366,71 @@ def paged_attention_int8_pallas(q: jnp.ndarray, kq_pool: jnp.ndarray,
                                 vs_pool: jnp.ndarray,
                                 block_tables: jnp.ndarray,
                                 row_pos: jnp.ndarray,
-                                interpret: Optional[bool] = None
+                                interpret: Optional[bool] = None,
+                                q_lens: Optional[jnp.ndarray] = None
                                 ) -> jnp.ndarray:
-    """Pallas ragged decode behind the :func:`paged_attention_int8`
+    """Pallas ragged attention behind the :func:`paged_attention_int8`
     signature (quant.kv_cache block pools): int8 payloads + per-(token,
-    head) scale pools, dequantized in VMEM as post-dot multiplies."""
+    head) scale pools, dequantized in VMEM as post-dot multiplies —
+    decode, prefill chunks and mixed ragged batches in one kernel."""
     if pl is None:
         raise RuntimeError(
             "the Pallas TPU surface is unavailable on this jax build — "
             "use serve.attn_kernel='reference'")
     B, T, H, hd = q.shape
-    if T != 1:
-        return _reference_attention_int8(q, kq_pool, ks_pool, vq_pool,
-                                         vs_pool, block_tables, row_pos)
+    if T > Q_TILE:
+        # query-row tiling — see the dense wrapper / Q_TILE
+        outs = []
+        for t0 in range(0, T, Q_TILE):
+            t1 = min(t0 + Q_TILE, T)
+            outs.append(paged_attention_int8_pallas(
+                q[:, t0:t1], kq_pool, ks_pool, vq_pool, vs_pool,
+                block_tables, row_pos[:, t0:t1], interpret=interpret,
+                q_lens=(None if q_lens is None
+                        else jnp.clip(q_lens - t0, 0, t1 - t0))))
+        return jnp.concatenate(outs, axis=1)
     nb, bs, n_kv, _ = kq_pool.shape
     W = block_tables.shape[1]
     S = W * bs
     rep = H // n_kv
-    ctx = _ctx_lengths(row_pos, S)
-    q_spec, page_map, out_spec, _ = _ragged_specs(B, W, bs, H, hd)
+    wp, ql = _prefetch_scalars(row_pos, q_lens, B, T)
+    q_spec, page_map, out_spec, _ = _ragged_specs(T, bs, H, hd, S)
 
-    def scale_map(b, w, bt_ref, ctx_ref):
-        live = jnp.maximum((ctx_ref[b] + bs - 1) // bs, 1)
+    def scale_map(b, w, bt_ref, wp_ref, ql_ref):
+        end = _attendable_end(wp_ref[b], ql_ref[b], S)
+        live = jnp.maximum((end + bs - 1) // bs, 1)
         return (bt_ref[b, jnp.minimum(w, live - 1)], 0, 0)
 
     kv_spec = pl.BlockSpec((1, bs, n_kv, hd), page_map)
     sc_spec = pl.BlockSpec((1, bs, n_kv), scale_map)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, W),
         in_specs=[q_spec, kv_spec, sc_spec, kv_spec, sc_spec],
         out_specs=out_spec,
         scratch_shapes=[
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.VMEM((H * T, 128), jnp.float32),
+            pltpu.VMEM((H * T, 128), jnp.float32),
+            pltpu.VMEM((H * T, hd), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_int8_kernel, bs=bs, n_kv=n_kv, rep=rep,
+        functools.partial(_int8_kernel, bs=bs, n_kv=n_kv, rep=rep, T=T,
                           sm_scale=float(hd) ** -0.5, num_w=W),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, T, H, hd), q.dtype),
         interpret=_use_interpret() if interpret is None else interpret,
-    )(block_tables.astype(jnp.int32), ctx, q[:, 0], kq_pool, ks_pool,
+    )(block_tables.astype(jnp.int32), wp, ql, q, kq_pool, ks_pool,
       vq_pool, vs_pool)
-    return out[:, None]
+    return out
 
 
 def resolve_paged_attention(kernel: Optional[str]):
     """(dense_fn, int8_fn) for a ``serve.attn_kernel`` arm. One dispatch
-    point shared by every paged decode path (fused llama, per-layer
-    llama, unified) so the kernel arm can never drift between them."""
+    point shared by every paged serving path (fused llama, per-layer
+    llama, unified) so the kernel arm can never drift between them —
+    decode steps, prefill buckets and the ragged mixed-batch step all
+    resolve here."""
     if kernel in (None, "reference"):
         return _reference_attention, _reference_attention_int8
     if kernel == "pallas":

@@ -9,6 +9,9 @@ tiny Llama config:
 - the paged DECODE step (``PagedServeExecutor._build_decode_fn``), on
   both attention arms,
 - a PREFILL bucket (``_build_prefill_fn(PROMPT_BUCKET)``),
+- the unified RAGGED STEP (``_build_ragged_fn`` — chunked-prefill
+  serving: mixed prefill-chunk + decode batches in one program), on
+  both arms and over BOTH pool layouts (dense and int8),
 - the prefix-cache ``copy_pool_blocks`` program,
 - the tiered-KV spill/restore programs (``gather_pool_blocks`` /
   ``scatter_pool_blocks``) over BOTH pool layouts (dense 2-tuple and
@@ -25,7 +28,10 @@ and fails on:
   DeepSpeed-Inference calls out as dominating serving latency);
 - ``jaxpr-kernel-arm``: the Pallas arm tracing WITHOUT a
   ``pallas_call`` equation — i.e. the kernel silently fell back to the
-  reference gather (wrapper dispatch drift, version-gated imports);
+  reference gather (wrapper dispatch drift, version-gated imports).
+  Applies to decode, prefill-bucket AND ragged-step programs: since
+  the unified ragged kernel landed there is no "prefill T>1 falls
+  back by design" exemption anymore;
 - ``jaxpr-budget``: total equation count drifting beyond the
   checked-in budget (``tools/dstlint/jaxpr_budgets.json``) — catches
   accidental de-dup regressions (e.g. a loop-invariant dequant
@@ -67,6 +73,9 @@ _WIDTH = 4
 _BLOCK = 8
 _NUM_BLOCKS = 9
 _CHUNK = 4
+# ragged-step query capacity (chunked prefill): > 1 so the traced
+# program exercises the mixed prefill-chunk + decode shape
+_RAGGED_T = 8
 
 
 @dataclasses.dataclass
@@ -150,6 +159,52 @@ def _abstract_serving_pieces(arm: str):
     copy_avals = (pools, sds((1,), i32), sds((1,), i32))
     return (decode_jit, decode_avals, prefill_jit, prefill_avals,
             copy_jit, copy_avals)
+
+
+def _ragged_serving_pieces(arm: str, int8: bool = False):
+    """(ragged_jit, avals) for the unified RAGGED-STEP program
+    (``PagedServeExecutor._build_ragged_fn`` — chunked-prefill
+    serving): ONE ``[B, T_cap]`` shape packs prefill chunks of any
+    prompt length plus every decode slot, so this entry point is the
+    whole chunked session's hot program. ``int8`` traces it over the
+    quant.kv_cache pool layout through the fused Llama path (the only
+    int8-KV-eligible decoder)."""
+    import contextlib as _ctx
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import (
+        PagedServeExecutor, resolve_paged_decoder,
+    )
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=int8)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    raw_params = jax.eval_shape(
+        lambda r, x: model.init(r, x)["params"], jax.random.PRNGKey(0),
+        ids)
+    paged_apply, init_pools, transform, _ = resolve_paged_decoder(
+        cfg, attn_kernel=arm)
+    params = raw_params if transform is None else \
+        jax.eval_shape(transform, raw_params)
+    pools = jax.eval_shape(
+        lambda: init_pools(cfg, _NUM_BLOCKS, _BLOCK, jnp.float32,
+                           int8=int8))
+    ex = PagedServeExecutor(paged_apply, None, None, cfg,
+                            _ctx.nullcontext, num_slots=_SLOTS,
+                            decode_chunk=_CHUNK)
+    ragged_jit = ex._build_ragged_fn(_RAGGED_T)
+    sds = jax.ShapeDtypeStruct
+    B, W = _SLOTS, _WIDTH
+    i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+    avals = (
+        params, sds((B, _RAGGED_T), i32), pools, sds((B, W), i32),
+        sds((B,), i32), sds((B,), i32), sds((B,), jnp.bool_),
+        sds((B,), jnp.bool_), sds((B, 2), u32), sds((B,), f32),
+        sds((B,), i32), sds((B,), f32))
+    return ragged_jit, avals
 
 
 def _tiering_pieces():
@@ -263,6 +318,19 @@ def trace_entry_points(arms: Optional[List[str]] = None
             f"decode_step/{arm}", decode_jit, decode_avals)
         reports[f"prefill_bucket/{arm}"] = _report(
             f"prefill_bucket/{arm}", prefill_jit, prefill_avals)
+        # the unified ragged-step program (chunked prefill), dense AND
+        # int8 pool layouts — the chunked session's only hot program,
+        # so a silent reference fallback here would cost every step
+        for tag, int8 in (("", False), ("_int8", True)):
+            name = f"ragged_step{tag}/{arm}"
+            try:
+                ragged_jit, ragged_avals = _ragged_serving_pieces(
+                    arm, int8=int8)
+            except Exception as e:
+                reports[name] = EntryReport(
+                    name, 0, {}, 0, error=f"{type(e).__name__}: {e}")
+                continue
+            reports[name] = _report(name, ragged_jit, ragged_avals)
         if arm == "reference":
             reports["copy_pool_blocks"] = _report(
                 "copy_pool_blocks", copy_jit, copy_avals)
@@ -295,10 +363,17 @@ def check_reports(reports: Dict[str, EntryReport],
                 emit("jaxpr-forbidden-primitive", name,
                      f"forbidden primitive '{prim}' x{n} in the "
                      f"serving jaxpr — host round-trip per step")
-        # only the DECODE step must contain the kernel: prefill (T>1)
-        # falls back to the reference in-wrapper by design
-        if name.startswith("decode_step") and name.endswith("/pallas") \
-                and rep.pallas_calls == 0:
+        # EVERY serving entry point on the pallas arm must contain the
+        # kernel: the unified ragged kernel serves decode steps,
+        # prefill buckets (T > 1 — the old "fallback by design"
+        # carve-out is retired) and the ragged mixed-batch step alike,
+        # so a missing pallas_call anywhere is a silent reference
+        # fallback
+        if name.endswith("/pallas") and rep.pallas_calls == 0 \
+                and name.split("/")[0] in ("decode_step",
+                                           "prefill_bucket",
+                                           "ragged_step",
+                                           "ragged_step_int8"):
             emit("jaxpr-kernel-arm", name,
                  "Pallas arm traced WITHOUT any pallas_call equation — "
                  "the kernel silently fell back to the reference "

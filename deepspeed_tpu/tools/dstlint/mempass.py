@@ -594,6 +594,24 @@ def trace_mem_entry_points(arms: Optional[List[str]] = None
         reports[f"prefill_bucket/{arm}"] = measure_entry(
             f"prefill_bucket/{arm}", prefill_jit, prefill_avals,
             meta=serve_meta)
+        # the unified ragged-step program (chunked prefill), dense +
+        # int8 pools: on the pallas arm its pallas_call flows through
+        # the VMEM estimator, so the new kernel's on-chip footprint is
+        # budget-gated statically like every other kernel
+        for tag, int8 in (("", False), ("_int8", True)):
+            name = f"ragged_step{tag}/{arm}"
+            try:
+                ragged_jit, ragged_avals = \
+                    jaxprpass._ragged_serving_pieces(arm, int8=int8)
+            except Exception as e:
+                reports[name] = MemReport(
+                    name, error=f"{type(e).__name__}: {e}")
+                continue
+            reports[name] = measure_entry(
+                name, ragged_jit, ragged_avals,
+                meta={"kind": "serve",
+                      "pool_bytes": tree_bytes(ragged_avals[2]),
+                      "params_bytes": tree_bytes(ragged_avals[0])})
         if arm != "reference":
             continue
         reports["copy_pool_blocks"] = measure_entry(

@@ -1236,10 +1236,17 @@ def _serve_entry(which: str):
         _abstract_serving_pieces,
     )
 
-    (decode_jit, decode_avals, prefill_jit, prefill_avals,
-     _c, _ca) = _abstract_serving_pieces("reference")
-    fn, avals = ((decode_jit, decode_avals) if which == "decode"
-                 else (prefill_jit, prefill_avals))
+    if which == "ragged":
+        from deepspeed_tpu.tools.dstlint.jaxprpass import (
+            _ragged_serving_pieces,
+        )
+
+        fn, avals = _ragged_serving_pieces("reference")
+    else:
+        (decode_jit, decode_avals, prefill_jit, prefill_avals,
+         _c, _ca) = _abstract_serving_pieces("reference")
+        fn, avals = ((decode_jit, decode_avals) if which == "decode"
+                     else (prefill_jit, prefill_avals))
     reps = jax.tree_util.tree_map(lambda _: P(), avals)
     return {
         "fn": fn,
@@ -1268,6 +1275,8 @@ def spmd_entry_points() -> List[SpmdEntry]:
                   lambda: _serve_entry("decode")),
         SpmdEntry("serve_prefill/reference",
                   lambda: _serve_entry("prefill")),
+        SpmdEntry("serve_ragged/reference",
+                  lambda: _serve_entry("ragged")),
     ]
 
 

@@ -37,6 +37,25 @@ from tests.unit.inference.test_prefix_cache import PrefixFakeExecutor
 
 pytestmark = pytest.mark.chaos
 
+# EVERY scenario runs twice: over the legacy split prefill/decode
+# executor calls AND over token-budget CHUNKED PREFILL
+# (serve.prefill_chunk_tokens — the unified ragged step). Chunk
+# boundaries are ordinary step boundaries, so the whole fault-tolerance
+# contract (isolation, release-on-every-exit, bounded preemption,
+# auditor-clean, one terminal per request) must hold identically; the
+# fake executors' ragged_step emits the same deterministic streams as
+# their split paths, so the byte-identical-stream cross-checks carry
+# over unchanged.
+_CHUNK_MODE = 0
+
+
+@pytest.fixture(autouse=True, params=[0, 3], ids=["legacy", "chunked"])
+def _prefill_chunk_mode(request):
+    global _CHUNK_MODE
+    _CHUNK_MODE = request.param
+    yield
+    _CHUNK_MODE = 0
+
 
 def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
                prefix=False, **kw):
@@ -50,6 +69,7 @@ def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
     ex = PrefixFakeExecutor() if prefix else FakeExecutor()
     pool = (PrefixCachingBlockPool(num_blocks, block_size) if prefix
             else BlockPool(num_blocks, block_size))
+    kw.setdefault("prefill_chunk_tokens", _CHUNK_MODE)
     kw.setdefault("audit_every", 1)
     kw.setdefault("tracer", RequestTracer())
     kw.setdefault("metrics", MetricsRegistry())
@@ -279,7 +299,14 @@ def test_chaos_cancel_burst_partial_tokens_and_isolation():
     for rid in (1, 3):
         c = comps[rid]
         assert c.status == CANCELLED
-        assert 0 < len(c.tokens) < 12               # partial stream
+        assert len(c.tokens) < 12                   # partial stream
+        if not _CHUNK_MODE:
+            # chunked mode: rid 3's prompt waits its turn in the shared
+            # chunk budget, so the step-4 cancel can land while it is
+            # STILL PREFILLING — zero tokens is then the correct
+            # partial; legacy admission prefills whole prompts, so a
+            # mid-stream cancel always finds tokens
+            assert len(c.tokens) > 0
         np.testing.assert_array_equal(c.tokens, ref[rid][:len(c.tokens)])
     assert comps[2].status == COMPLETED
     np.testing.assert_array_equal(comps[2].tokens, ref[2])
@@ -575,6 +602,10 @@ def test_chaos_shutdown_releases_everything_and_is_idempotent():
     sched, _, pool = make_sched(prefix=True)
     for rid in (1, 2, 3):
         sched.submit(req(rid, gen=20))
+    sched.step()
+    # second step so chunked mode has written rid 1's FULL first block
+    # (its step-1 chunk covers only 3 of block_size 4 tokens) — shutdown
+    # then parks a registerable prefix on the cache in both modes
     sched.step()
     assert pool.num_allocated > 0
     terms = sched.shutdown(error="client went away")

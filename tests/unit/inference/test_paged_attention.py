@@ -290,9 +290,11 @@ def test_pallas_decode_scale_override():
 
 
 @pallas
-def test_pallas_prefill_falls_back_to_reference():
-    """T > 1 (prefill rows) returns the jnp reference EXACTLY — the
-    kernel is a decode kernel; routing is unconditional at call sites."""
+def test_pallas_prefill_chunk_is_a_kernel_not_a_fallback():
+    """T > 1 (prefill chunks) runs the SAME unified ragged kernel — no
+    jnp-reference fallback on the pallas arm anymore (the dstlint
+    jaxpr pass pins a pallas_call in the prefill/ragged programs too).
+    Parity vs the ragged reference stays kernel-tight."""
     rng = np.random.default_rng(19)
     bs, n_kv, hd, W = 8, 2, 16, 2
     H, B, T = 4, 2, 5
@@ -303,9 +305,127 @@ def test_pallas_prefill_falls_back_to_reference():
     kp, vp = paged_append(kp, vp, k, k, bt, jnp.zeros(B, jnp.int32), None)
     q = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
     row_pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
-    out = paged_attention_pallas(q, kp, vp, bt, row_pos)
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, interpret=True)
     ref = paged_attention(q, kp, vp, bt, row_pos)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+# --- unified ragged kernel: mixed prefill-chunk + decode batches -------------
+def _mixed_ragged_case(seed, H, n_kv, hd, bs, W, wps, qls, int8=False):
+    """Pool + tables + preloaded per-slot context (``wps`` tokens) plus
+    an appended in-flight chunk of ``qls`` tokens per slot — the ragged
+    batch shape the unified serving step drives (decode slots ql=1,
+    prefill chunks ql>1, inactive slots ql=0)."""
+    from deepspeed_tpu.models.llama import quantize_kv_heads
+
+    rng = np.random.default_rng(seed)
+    B = len(wps)
+    T = max(max(qls), 1)
+    bt = jnp.asarray(1 + np.arange(B * W).reshape(B, W), jnp.int32)
+    S = W * bs
+    wp = jnp.asarray(wps, jnp.int32)
+    ql = jnp.asarray(qls, jnp.int32)
+    k_ctx = jnp.asarray(rng.normal(size=(B, S, n_kv, hd)), jnp.float32)
+    v_ctx = jnp.asarray(rng.normal(size=(B, S, n_kv, hd)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(B, T, n_kv, hd)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, T, n_kv, hd)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
+    row_pos = wp[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    z = jnp.zeros(B, jnp.int32)
+    if int8:
+        pools = init_paged_pool(1, B * W + 1, bs, n_kv, hd, int8=True)
+        kq, ks, vq, vs = (p[0] for p in pools)
+        for (kk, vv, pos, vl) in ((k_ctx, v_ctx, z, wp),
+                                  (k_new, v_new, wp, ql)):
+            kq8, ks8 = quantize_kv_heads(kk)
+            vq8, vs8 = quantize_kv_heads(vv)
+            kq, vq = paged_append(kq, vq, kq8, vq8, bt, pos, vl)
+            ks = paged_append_scales(ks, ks8, bt, pos, vl)
+            vs = paged_append_scales(vs, vs8, bt, pos, vl)
+        return q, (kq, ks, vq, vs), bt, row_pos, ql
+    kp, vp = init_paged_pool(1, B * W + 1, bs, n_kv, hd)
+    kp, vp = kp[0], vp[0]
+    kp, vp = paged_append(kp, vp, k_ctx, v_ctx, bt, z, wp)
+    kp, vp = paged_append(kp, vp, k_new, v_new, bt, wp, ql)
+    return q, (kp, vp), bt, row_pos, ql
+
+
+@pallas
+@pytest.mark.parametrize("bs", [8, 16, 32])
+@pytest.mark.parametrize("gqa", [1, 2, 4])
+def test_pallas_ragged_mixed_batch_parity(bs, gqa):
+    """THE unified-kernel pin: one launch serving a decode token
+    (ql=1), a short prefill chunk (ql=3), a full chunk (ql=8), a
+    chunk-boundary partial and an inactive slot (ql=0) — per-slot
+    causal masking against each slot's own in-flight chunk, parity
+    kernel-tight vs the ragged jnp reference across block sizes and
+    GQA ratios."""
+    n_kv, hd, W = 2, 16, 3
+    H = n_kv * gqa
+    # (context, chunk): decode / chunk offsets crossing block
+    # boundaries / cold-prompt chunk / boundary partial / inactive
+    wps = [2 * bs + bs // 2, bs - 3, 0, bs, 5]
+    qls = [1, 3, 8, bs // 2 + 1, 0]
+    q, (kp, vp), bt, row_pos, ql = _mixed_ragged_case(
+        100 + bs + gqa, H, n_kv, hd, bs, W, wps, qls)
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, q_lens=ql,
+                                 interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos, q_lens=ql)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+    # rows past a slot's query length are ZERO by contract (both arms)
+    np.testing.assert_array_equal(np.asarray(out)[4], 0.0)
+
+
+@pallas
+@pytest.mark.parametrize("bs", [8, 16, 32])
+def test_pallas_ragged_mixed_batch_parity_int8(bs):
+    """int8 pools through the SAME mixed ragged batch: in-VMEM post-dot
+    dequant == the jnp reference's math for decode + chunk + partial
+    rows alike."""
+    n_kv, hd, W = 2, 16, 3
+    wps = [2 * bs, bs - 2, 0, 3]
+    qls = [1, 3, 8, bs // 2 + 1]
+    q, pools, bt, row_pos, ql = _mixed_ragged_case(
+        200 + bs, 4, n_kv, hd, bs, W, wps, qls, int8=True)
+    out = paged_attention_int8_pallas(*(q,) + pools,
+                                      bt, row_pos, q_lens=ql,
+                                      interpret=True)
+    ref = paged_attention_int8(*(q,) + pools, bt, row_pos, q_lens=ql)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pallas
+def test_pallas_ragged_mask_extra_alibi_window():
+    """ALiBi slopes + a local window over a MIXED ragged batch: the
+    additive mask rides per query row (each chunk row has its own
+    window), including rows whose window fully masks interior live
+    blocks."""
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    bs, n_kv, hd, W = 8, 2, 16, 3
+    H = 4
+    wps = [2 * bs + 1, 4, 0]
+    qls = [1, 5, 3]
+    q, (kp, vp), bt, row_pos, ql = _mixed_ragged_case(
+        33, H, n_kv, hd, bs, W, wps, qls)
+    S = W * bs
+    col = jnp.arange(S)[None, None, None, :]
+    win = jnp.where(col > row_pos[:, None, :, None] - 6, 0.0,
+                    jnp.finfo(jnp.float32).min)
+    rel = (col[0, 0][None] - row_pos[:, :, None]).astype(jnp.float32)
+    ab = alibi_slopes(H)[None, :, None, None] * rel[:, None, :, :]
+    mask = ab + win
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, mask_extra=mask,
+                                 q_lens=ql, interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos, mask_extra=mask,
+                          q_lens=ql)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
 
 
 def test_resolve_paged_attention_arms():
@@ -316,3 +436,34 @@ def test_resolve_paged_attention_arms():
     assert pal == (paged_attention_pallas, paged_attention_int8_pallas)
     with pytest.raises(ValueError, match="attn_kernel"):
         resolve_paged_attention("cuda")
+
+
+@pallas
+def test_pallas_query_tiling_above_q_tile_is_exact():
+    """Query blocks longer than Q_TILE rows split into independent
+    per-tile launches (bounded VMEM scratch) — outputs exactly equal a
+    ragged batch computed through the reference, tile seams included."""
+    from deepspeed_tpu.ops.paged_attention_kernel import Q_TILE
+
+    rng = np.random.default_rng(41)
+    bs, n_kv, hd, W = 8, 2, 16, (2 * Q_TILE + 16) // 8
+    H, B = 4, 2
+    T = Q_TILE + 9                               # crosses one tile seam
+    kp, vp = init_paged_pool(1, B * W + 1, bs, n_kv, hd)
+    kp, vp = kp[0], vp[0]
+    bt = jnp.asarray(1 + np.arange(B * W).reshape(B, W), jnp.int32)
+    wp = jnp.asarray([5, 0], jnp.int32)
+    ql = jnp.asarray([T, Q_TILE - 3], jnp.int32)  # ragged across tiles
+    k_ctx = jnp.asarray(rng.normal(size=(B, W * bs, n_kv, hd)),
+                        jnp.float32)
+    kp, vp = paged_append(kp, vp, k_ctx, k_ctx, bt,
+                          jnp.zeros(B, jnp.int32), wp)
+    k_new = jnp.asarray(rng.normal(size=(B, T, n_kv, hd)), jnp.float32)
+    kp, vp = paged_append(kp, vp, k_new, k_new, bt, wp, ql)
+    q = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
+    row_pos = wp[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    out = paged_attention_pallas(q, kp, vp, bt, row_pos, q_lens=ql,
+                                 interpret=True)
+    ref = paged_attention(q, kp, vp, bt, row_pos, q_lens=ql)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)

@@ -24,6 +24,7 @@ class FakeExecutor:
         self.slot_history = []                   # (slot, rid) bind order
         self.prefills = []
         self.decode_calls = []
+        self.ragged_calls = []                   # chunked-prefill steps
 
     def set_slot(self, slot, req):
         self.slot_reqs[slot] = req
@@ -43,6 +44,28 @@ class FakeExecutor:
                 req = self.slot_reqs[s]
                 step = tokens[s] % 100 + 1
                 out[s, 0] = req.rid * 100 + step
+        return out
+
+    def ragged_step(self, tokens, q_lens, block_tables, write_pos, emit,
+                    is_first):
+        """Unified mixed prefill-chunk + decode call (chunked-prefill
+        scheduling): emits the SAME deterministic streams as the split
+        prefill/decode paths — rid*100 at the final prompt chunk, then
+        rid*100+step per decode token — so chunked-on runs are
+        byte-comparable to legacy runs of the same trace."""
+        self.ragged_calls.append((np.asarray(tokens).copy(),
+                                  np.asarray(q_lens).copy(),
+                                  np.asarray(write_pos).copy(),
+                                  np.asarray(emit).copy()))
+        out = np.zeros(len(tokens), np.int32)
+        for s in range(len(tokens)):
+            if not emit[s]:
+                continue
+            req = self.slot_reqs[s]
+            if write_pos[s] < len(req.prompt):   # final prefill chunk
+                out[s] = req.rid * 100
+            else:                                # one decode step
+                out[s] = req.rid * 100 + tokens[s][0] % 100 + 1
         return out
 
 
@@ -445,3 +468,146 @@ def test_occupancy_log_records_pool_series():
                for e in log)
     assert log[-1]["blocks_allocated"] == 0      # drained
     assert max(e["blocks_allocated"] for e in log) > 0
+
+
+# --- chunked prefill: token-budget scheduling over the ragged step ----------
+
+def make_chunked(chunk=3, num_slots=2, num_blocks=33, block_size=4,
+                 width=8):
+    ex = FakeExecutor()
+    pool = BlockPool(num_blocks, block_size)
+    sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
+                                        prefill_chunk_tokens=chunk)
+    return sched, ex, pool
+
+
+def test_chunked_requires_ragged_executor():
+    class NoRagged:
+        pass
+
+    with pytest.raises(ValueError, match="ragged_step"):
+        ContinuousBatchingScheduler(NoRagged(), 2, BlockPool(9, 4), 6,
+                                    prefill_chunk_tokens=4)
+
+
+def test_chunked_streams_match_legacy_exactly():
+    """THE chunked-scheduling pin: the same trace through token-budget
+    chunked prefill produces byte-identical streams to the legacy
+    split prefill/decode path — chunking is scheduling, not output."""
+    def run(chunk):
+        if chunk:
+            sched, ex, pool = make_chunked(chunk=chunk)
+        else:
+            sched, ex, pool = make_sched(num_blocks=33, width=8)
+        for r in (req(1, plen=7, gen=5), req(2, plen=4, gen=8),
+                  req(3, plen=11, gen=3)):
+            sched.submit(r)
+        comps = {c.rid: c for c in drain(sched)}
+        assert pool.num_allocated == 0
+        return comps
+
+    legacy = run(0)
+    for chunk in (1, 3, 4, 16):
+        chunked = run(chunk)
+        assert set(chunked) == set(legacy)
+        for rid, c in chunked.items():
+            assert c.status == "COMPLETED"
+            np.testing.assert_array_equal(c.tokens, legacy[rid].tokens)
+
+
+def test_chunked_prefill_splits_prompt_across_steps():
+    """An 11-token prompt under a 4-token budget prefills in 3 chunks
+    (4+4+3), the first output token arriving with the FINAL chunk."""
+    sched, ex, pool = make_chunked(chunk=4)
+    sched.submit(req(1, plen=11, gen=2))
+    sched.step()                                 # admit + chunk 1
+    assert sched.prefilling[0] and not sched.active[0]
+    assert sched.seq_lens[0] == 4
+    sched.step()                                 # chunk 2
+    assert sched.seq_lens[0] == 8
+    comps = sched.step()                         # final chunk: 3 tokens
+    assert not sched.prefilling[0] and sched.active[0]
+    assert not comps and sched.slots[0].out == [100]
+    chunk_lens = [int(ql[0]) for _, ql, _, _ in ex.ragged_calls]
+    assert chunk_lens == [4, 4, 3]
+    assert not ex.prefills                       # legacy path never ran
+    drain(sched)
+
+
+def test_chunked_decode_rides_along_with_prefill_chunks():
+    """Decode does NOT stall for a long prompt's prefill: while slot 1
+    chews through a 12-token prompt in 3-token chunks, slot 0 emits a
+    decode token at EVERY chunk boundary (the whole point of the
+    unified ragged step)."""
+    sched, ex, pool = make_chunked(chunk=3)
+    sched.submit(req(1, plen=4, gen=10))
+    drain_steps = 0
+    while not sched.active[0]:                   # rid 1 decoding
+        sched.step()
+        drain_steps += 1
+        assert drain_steps < 10
+    sched.submit(req(2, plen=12, gen=2))
+    before = len(sched.slots[0].out)
+    for _ in range(4):                           # admit + 4 chunks
+        sched.step()
+    # rid 2's prefill spanned >= 4 ragged calls; rid 1 decoded through
+    # every one of them
+    mixed = [(ql.copy(), em.copy()) for _, ql, _, em in ex.ragged_calls[-4:]]
+    assert any(ql[1] > 0 and ql[0] == 1 for ql, _ in mixed), mixed
+    assert len(sched.slots[0].out) >= before + 4
+    comps = {c.rid: c for c in drain(sched)}
+    np.testing.assert_array_equal(comps[1].tokens, 100 + np.arange(10))
+    np.testing.assert_array_equal(comps[2].tokens, 200 + np.arange(2))
+
+
+def test_chunked_token_budget_fair_shared_across_concurrent_prefills():
+    """Two prompts prefilling at once FAIR-SHARE the per-step budget
+    (earlier admission takes the ceil share): a short prompt behind a
+    long one rides the same steps as the long prompt's chunks instead
+    of queueing behind its whole prefill."""
+    sched, ex, pool = make_chunked(chunk=4, num_slots=2)
+    sched.submit(req(1, plen=8, gen=2))
+    sched.submit(req(2, plen=8, gen=2))
+    sched.step()                                 # both admitted
+    # each step splits the 4-token budget 2 + 2 across the two prompts
+    assert [int(q) for q in ex.ragged_calls[0][1]] == [2, 2]
+    sched.step()
+    assert [int(q) for q in ex.ragged_calls[1][1]] == [2, 2]
+    # a LONE prefilling prompt takes the whole budget per step
+    sched2, ex2, _ = make_chunked(chunk=4, num_slots=2)
+    sched2.submit(req(3, plen=8, gen=2))
+    sched2.step()
+    assert [int(q) for q in ex2.ragged_calls[0][1]] == [4, 0]
+    comps = {c.rid: c for c in drain(sched)}
+    np.testing.assert_array_equal(comps[1].tokens, 100 + np.arange(2))
+    np.testing.assert_array_equal(comps[2].tokens, 200 + np.arange(2))
+    drain(sched2)
+
+
+def test_chunked_mid_prefill_cancel_releases_blocks():
+    """Cancellation lands at a chunk boundary mid-prefill: CANCELLED
+    with zero tokens, every block back in the pool, neighbors clean."""
+    sched, ex, pool = make_chunked(chunk=3)
+    sched.submit(req(1, plen=12, gen=4))
+    sched.step()                                 # chunk 1 of 4
+    assert sched.prefilling[0]
+    assert sched.cancel(1) is True
+    comps = drain(sched)
+    assert [c.status for c in comps] == ["CANCELLED"]
+    assert comps[0].tokens.size == 0
+    assert pool.num_allocated == 0
+    sched.audit(context="post-cancel")
+
+
+def test_chunked_admission_is_fifo_under_backpressure():
+    """Chunked mode keeps strict-FIFO admission and backpressure: a
+    queue head that does not fit waits without being overtaken."""
+    sched, ex, pool = make_chunked(chunk=4, num_slots=2, num_blocks=4,
+                                   block_size=4, width=4)
+    sched.submit(req(1, plen=8, gen=4))          # 2+1 blocks on demand
+    sched.submit(req(2, plen=8, gen=4))          # 2 > 1 free: waits
+    sched.step()
+    assert sched.prefilling.sum() == 1 and len(sched.queue) == 1
+    comps = drain(sched)
+    assert [c.rid for c in comps] == [1, 2]      # FIFO held
+    assert pool.num_allocated == 0

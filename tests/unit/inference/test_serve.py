@@ -496,3 +496,143 @@ def test_serve_fault_injector_end_to_end(llama_engine):
     sched = llama_engine.last_serve_scheduler
     assert sched.pool.num_allocated == 0
     sched.audit(context="post-chaos")
+
+
+# --- chunked prefill (token-budget scheduling over the ragged step) ----------
+
+def test_serve_chunked_prefill_greedy_exact_vs_off_and_generate(
+        llama_engine, serve_attn_kernel):
+    """THE chunked-prefill greedy-exactness pin, on BOTH attention
+    arms: token-budget chunked prefill (prompts split at chunk
+    boundaries, including non-aligned partials) produces byte-identical
+    streams to the unchunked path and to generate()."""
+    reqs = mixed_requests(6, seed=21)
+    off = {c.rid: c for c in llama_engine.serve(
+        mixed_requests(6, seed=21), num_slots=2, block_size=4,
+        attn_kernel=serve_attn_kernel)}
+    on = {c.rid: c for c in llama_engine.serve(
+        reqs, num_slots=2, block_size=4, attn_kernel=serve_attn_kernel,
+        prefill_chunk_tokens=6)}
+    assert all(c.ok for c in on.values())
+    for rid, c in on.items():
+        np.testing.assert_array_equal(c.tokens, off[rid].tokens)
+    assert_greedy_parity(llama_engine, on.values())
+
+
+def test_serve_chunked_prefill_interleaves_decode(llama_engine):
+    """Decode-interference: while a LONG prompt prefills in chunks,
+    already-decoding slots keep emitting tokens — the per-step work
+    split in the occupancy series shows steps carrying BOTH prefill
+    and decode tokens (the legacy path serializes them: a whole-prompt
+    prefill step carries no decode output until it returns)."""
+    rng = np.random.default_rng(3)
+    reqs = [Request(rid=0, prompt=rng.integers(1, 256, 4),
+                    max_new_tokens=24),
+            Request(rid=1, prompt=rng.integers(1, 256, 40),
+                    max_new_tokens=4)]
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4,
+                               prefill_chunk_tokens=8,
+                               record_occupancy=True)
+    assert all(c.ok for c in comps)
+    occ = llama_engine.last_serve_occupancy
+    mixed_steps = [e for e in occ
+                   if e["decode_tokens"] and e["prefill_tokens"]]
+    # the 40-token prompt spans >= 5 chunks; rid 0 decoded through them
+    assert len(mixed_steps) >= 4, occ
+    assert_greedy_parity(llama_engine, comps)
+
+
+def test_serve_chunked_prefill_fewer_compile_buckets(llama_engine):
+    """The ragged executor compiles STRICTLY fewer program buckets than
+    the split prefill/decode caches serving the same traffic: mixed
+    prompt lengths mint one prefill program per prompt bucket plus a
+    decode program on the legacy path, while every chunked call lands
+    in at most two ragged buckets (T_cap=chunk mixed, T_cap=1
+    decode-only)."""
+    reqs = lambda: [Request(rid=i, prompt=np.arange(1, L + 1),
+                            max_new_tokens=4)
+                    for i, L in enumerate((5, 40, 70))]
+    # a dedicated executor config so this test counts its own programs
+    kw = dict(num_slots=3, block_size=8, decode_chunk=2)
+    assert all(c.ok for c in llama_engine.serve(reqs(), **kw))
+    ex = None
+    for (slots, _bs, _nb, _dc, _kv8, _arm), (_, cand) in \
+            llama_engine._serve_executors.items():
+        if slots == 3:
+            ex = cand
+    legacy_buckets = len(ex._prefill_fns) + (ex._decode_fn is not None)
+    assert legacy_buckets >= 3                   # >= 2 prompt buckets + 1
+    assert all(c.ok for c in llama_engine.serve(
+        reqs(), prefill_chunk_tokens=16, **kw))
+    assert len(ex._ragged_fns) < legacy_buckets
+    assert len(ex._ragged_fns) <= 2
+
+
+def test_serve_chunked_prefill_with_prefix_cache(llama_engine):
+    """Chunked prefill composes with the prefix cache: the second
+    admission's offset prefill starts MID-PROMPT (cached blocks
+    skipped) and still chunks the remaining tail — streams exactly
+    greedy, cache hits recorded."""
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 256, 24)
+    reqs = [Request(rid=i,
+                    prompt=np.concatenate([shared,
+                                           rng.integers(1, 256, 9 + i)]),
+                    max_new_tokens=5) for i in range(3)]
+    comps = llama_engine.serve(reqs, num_slots=2, block_size=4,
+                               prefill_chunk_tokens=8, prefix_cache=True)
+    assert all(c.ok for c in comps)
+    sched = llama_engine.last_serve_scheduler
+    assert sched.cache_hit_tokens > 0
+    assert_greedy_parity(llama_engine, comps)
+
+
+def test_serve_chunked_fault_injector_end_to_end(llama_engine):
+    """Chaos through the REAL compiled ragged serving path with
+    chunking on: an attributed fault fails one request, neighbors
+    match the fault-free chunked run byte-for-byte, auditor clean."""
+    from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+    from deepspeed_tpu.inference.scheduler import COMPLETED, FAILED
+
+    kw = dict(num_slots=2, block_size=4, prefill_chunk_tokens=6,
+              audit_every=1)
+    ref = {c.rid: c.tokens for c in llama_engine.serve(
+        mixed_requests(4, seed=13), **kw)}
+    fi = FaultInjector([FaultSpec(site="decode", step=4, slot=1,
+                                  message="injected")])
+    comps = llama_engine.serve(mixed_requests(4, seed=13),
+                               fault_injector=fi, **kw)
+    failed = [c for c in comps if c.status == FAILED]
+    assert len(failed) == 1
+    np.testing.assert_array_equal(
+        failed[0].tokens, ref[failed[0].rid][:len(failed[0].tokens)])
+    for c in comps:
+        if c.status == COMPLETED:
+            np.testing.assert_array_equal(c.tokens, ref[c.rid])
+    sched = llama_engine.last_serve_scheduler
+    assert sched.pool.num_allocated == 0
+    sched.audit(context="post-chaos")
+
+
+def test_serve_chunked_prefill_sampled_streams_match_unchunked(
+        llama_engine):
+    """Seeded SAMPLED streams (temperature > 0) are byte-identical
+    with chunking on and off: mid-chunk samples advance nothing and
+    the ragged program selects the prefill-vs-decode rng-split half
+    per slot, so the first token and every decode draw reproduce the
+    split programs exactly."""
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(1, 256, n) for n in (19, 5, 33)]
+
+    def reqs():
+        return [Request(rid=i, prompt=p, max_new_tokens=6,
+                        temperature=0.8, top_k=12, seed=100 + i)
+                for i, p in enumerate(prompts)]
+
+    off = {c.rid: c for c in llama_engine.serve(
+        reqs(), num_slots=2, block_size=4)}
+    on = {c.rid: c for c in llama_engine.serve(
+        reqs(), num_slots=2, block_size=4, prefill_chunk_tokens=7)}
+    assert all(c.ok for c in on.values())
+    for rid, c in on.items():
+        np.testing.assert_array_equal(c.tokens, off[rid].tokens)

@@ -605,11 +605,30 @@ def test_jaxpr_silent_fallback_to_reference_fails_loudly():
     assert "jaxpr-kernel-arm" in got
 
 
-def test_jaxpr_prefill_pallas_fallback_is_expected():
-    reports = {"prefill_bucket/pallas": EntryReport(
-        "prefill_bucket/pallas", 300, {}, pallas_calls=0)}
-    budgets = _budgets(**{"prefill_bucket/pallas": {"eqns": 300}})
-    assert check_reports(reports, budgets) == []
+def test_jaxpr_prefill_pallas_fallback_is_a_finding():
+    """The old 'prefill T>1 falls back by design' carve-out is RETIRED:
+    since the unified ragged kernel serves prefill chunks too, a
+    pallas-arm prefill (or ragged-step) trace without a pallas_call is
+    a silent reference fallback — the regression the kernel-arm rule
+    exists for."""
+    budgets = _budgets(**{"prefill_bucket/pallas": {"eqns": 300},
+                          "ragged_step/pallas": {"eqns": 700}})
+    reports = {
+        "prefill_bucket/pallas": EntryReport(
+            "prefill_bucket/pallas", 300, {}, pallas_calls=0),
+        "ragged_step/pallas": EntryReport(
+            "ragged_step/pallas", 700, {}, pallas_calls=0),
+    }
+    got = check_reports(reports, budgets)
+    assert sorted(f.rule for f in got) == ["jaxpr-kernel-arm"] * 2
+    # with the kernel present neither entry is a finding
+    ok = {
+        "prefill_bucket/pallas": EntryReport(
+            "prefill_bucket/pallas", 300, {}, pallas_calls=1),
+        "ragged_step/pallas": EntryReport(
+            "ragged_step/pallas", 700, {}, pallas_calls=2),
+    }
+    assert check_reports(ok, budgets) == []
 
 
 def test_jaxpr_forbidden_primitive_and_budget_drift():

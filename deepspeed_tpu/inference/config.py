@@ -118,6 +118,36 @@ class ServeConfig(DeepSpeedConfigModel):
     # lengthen the worst-case decode gap one chunk adds; 32-128 is the
     # useful range (decode slots ride along either way).
     prefill_chunk_tokens: int = 0
+    # SPECULATIVE DECODING on the serving path (docs/SERVING.md
+    # "Speculative decoding"): "prompt_lookup" turns on per-slot
+    # SELF-drafting — the scheduler proposes up to ``draft_len`` tokens
+    # per greedy decode slot from the slot's own token history (latest
+    # earlier occurrence of the trailing ``draft_ngram`` tokens, no
+    # draft model) and the executor verifies the whole draft in ONE
+    # ragged pass (a T=1+K row through the same unified ragged program
+    # that serves prefill chunks), accepting the longest prefix that
+    # matches greedy argmax. Accepted tokens multiply the
+    # bandwidth-bound decode ceiling; outputs stay byte-identical to
+    # non-speculative greedy (tier-1 pins). Draft tokens compete with
+    # chunked-prefill tokens for the same per-step token budget when
+    # ``prefill_chunk_tokens`` > 0. Sampled (temperature > 0) slots
+    # never speculate — they ride along as plain 1-token rows. On
+    # incompressible traffic acceptance ~0 and each verify pass costs a
+    # K-wide window to emit one token — a *structured-prompt* lever;
+    # watch serve.spec.acceptance before leaving it on (README knob
+    # table). None/"off" (default) = non-speculative serving.
+    speculative: Optional[str] = None
+    # max draft tokens proposed per slot per step (the K in the T=1+K
+    # verify row). Caps the speculative compile bucket (T_cap=1+K) and
+    # the over-allocation a rejection rolls back; 4-8 is the useful
+    # range — acceptance beyond 8 consecutive tokens is rare even on
+    # repetitive traffic and bigger K widens the wasted window when a
+    # draft dies early.
+    draft_len: int = 8
+    # tokens of trailing context matched against the slot's history to
+    # find a draft. 2 (default) fires often with decent precision;
+    # 3 proposes less but with higher acceptance on structured text.
+    draft_ngram: int = 2
     # PREFIX CACHING (on|off): content-address full KV blocks by their
     # token ids so prompts sharing a block-aligned prefix (system
     # prompts, few-shot preambles, multi-turn histories) prefill it once

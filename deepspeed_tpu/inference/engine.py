@@ -400,6 +400,14 @@ class PagedServeExecutor:
         # T_cap=1 for pure-decode steps) instead of one prefill program
         # per prompt bucket plus a separate decode program
         self._ragged_fns: Dict[int, Any] = {}
+        # speculative (draft-verify) ragged programs: same body as the
+        # ragged step plus per-row greedy argmax over every query
+        # position and the in-device longest-accepted-prefix count —
+        # kept as a SEPARATE cache so non-speculative sessions compile
+        # and budget exactly the programs they always did. Buckets:
+        # T_cap=1 (no drafts this step), T_cap=1+draft_len (drafted
+        # decode rows), T_cap=chunk (drafts mixed with prefill chunks).
+        self._ragged_verify_fns: Dict[int, Any] = {}
         self._copy_fns: Dict[int, Any] = {}
         self._spill_fns: Dict[int, Any] = {}
         self._restore_fns: Dict[int, Any] = {}
@@ -658,6 +666,66 @@ class PagedServeExecutor:
         self._rngs = np.array(new_rngs)
         return np.asarray(out)
 
+    def ragged_verify_step(self, tokens, q_lens, block_tables, write_pos,
+                           emit, is_first, spec_lens):
+        """:meth:`ragged_step` plus in-device draft verification — the
+        speculative-decoding program (scheduler protocol extension).
+
+        A drafted decode slot feeds ``1 + k`` tokens (its last sampled
+        token followed by ``k = spec_lens[slot]`` prompt-lookup draft
+        tokens) as one ragged row; per-row causal masking makes position
+        ``i``'s logits exactly what ``i`` sequential 1-token steps would
+        have produced, so greedy verification is argmax agreement.
+        Returns ``(nxt [B], verified [B, T_cap], accepts [B])``:
+
+        - ``verified[s, i]`` — the model's greedy continuation after
+          consuming row token ``i`` (argmax over position ``i``'s
+          logits). On acceptance ``a`` the scheduler consumes
+          ``verified[s, 0..a]`` — a accepted draft tokens plus the
+          model's own "bonus" token after them, all byte-identical to
+          the plain greedy stream;
+        - ``accepts[s]`` — longest draft prefix matching that greedy
+          continuation (0..k; 0 for undrafted rows);
+        - ``nxt[s]`` — the per-slot SAMPLED token at the row's last real
+          position (same rng discipline as ragged_step: emitting rows
+          advance their stream once per step). Undrafted rows
+          (``spec_lens == 0``: sampled slots riding along, prefill
+          chunks) consume ``nxt`` exactly as in the non-speculative
+          path, so mixed batches keep seeded sampled streams identical.
+
+        KV note: the row writes KV for all ``1 + k`` fed positions; on
+        a rejection at ``a < k`` the tail positions beyond the accepted
+        prefix hold stale KV that the ``col <= row_pos`` mask hides and
+        the next write overwrites — the scheduler only rolls back its
+        host-side write position and the over-allocated tail blocks.
+        """
+        tokens = np.asarray(tokens, np.int32)
+        T_cap = int(tokens.shape[1])
+        fn = self._ragged_verify_fns.get(T_cap)
+        if fn is None:
+            fn = self._build_ragged_verify_fn(T_cap)
+            if self._obs is not None:
+                self._obs.miss("serve_ragged_verify", T_cap)
+                fn = self._obs.wrap(
+                    "serve_ragged_verify",
+                    f"slots{self.num_slots}_T{T_cap}", fn)
+            self._ragged_verify_fns[T_cap] = fn
+        elif self._obs is not None:
+            self._obs.hit("serve_ragged_verify", T_cap)
+        with self._ctx():
+            nxt, verified, accepts, self._pools, new_rngs = fn(
+                self._params, jnp.asarray(tokens), self._pools,
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(write_pos, jnp.int32),
+                jnp.asarray(q_lens, jnp.int32),
+                jnp.asarray(emit, bool),
+                jnp.asarray(is_first, bool),
+                jnp.asarray(spec_lens, jnp.int32),
+                jnp.asarray(self._rngs), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), jnp.asarray(self._top_ps))
+        self._rngs = np.array(new_rngs)
+        return np.asarray(nxt), np.asarray(verified), np.asarray(accepts)
+
     def decode(self, tokens, block_tables, seq_lens, active, steps_left,
                max_steps=None):
         if self._decode_fn is None:
@@ -822,6 +890,51 @@ class PagedServeExecutor:
             return nxt, pools, new_rngs
 
         return jax.jit(rg, donate_argnums=(2,))
+
+    def _build_ragged_verify_fn(self, T_cap: int):
+        paged_apply = self._apply
+
+        def rgv(params, tokens, pools, bt, write_pos, q_lens, emit,
+                is_first, spec_lens, rngs, temps, top_ks, top_ps):
+            from deepspeed_tpu.inference.sampling import (
+                sample_logits_per_slot,
+            )
+
+            logits, pools = paged_apply(params, tokens, pools, bt,
+                                        write_pos, q_lens)
+            idx = jnp.maximum(q_lens - 1, 0)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]     # [B, V]
+            split = jax.vmap(jax.random.split)(rngs)
+            # identical rng discipline to _build_ragged_fn: a drafted
+            # row has emit=True so its stream advances once per step —
+            # exactly like the 1-token row it replaces — and sampled
+            # neighbors in the same batch see the streams they would
+            # have seen without speculation
+            keys = jnp.where(is_first[:, None], split[:, 1],
+                             split[:, 0])
+            fresh = jnp.where(is_first[:, None], split[:, 0],
+                              split[:, 1])
+            nxt = sample_logits_per_slot(last, keys, temps, top_ks,
+                                         top_ps)
+            new_rngs = jnp.where(emit[:, None], fresh, rngs)
+            # greedy verification: the model's argmax continuation at
+            # EVERY row position; a draft token at row position i+1 is
+            # accepted iff it equals the continuation after position i,
+            # and acceptance is the longest such prefix (cumprod)
+            verified = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if T_cap > 1:
+                pos = jnp.arange(T_cap - 1)[None, :]
+                match = jnp.logical_and(
+                    verified[:, :-1] == tokens[:, 1:],
+                    pos < spec_lens[:, None])
+                accepts = jnp.sum(
+                    jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            else:
+                accepts = jnp.zeros_like(spec_lens)
+            return nxt, verified, accepts, pools, new_rngs
+
+        return jax.jit(rgv, donate_argnums=(2,))
 
     def _build_decode_fn(self, chunk: int):
         paged_apply = self._apply
@@ -1520,6 +1633,8 @@ class InferenceEngine:
                         prefix_cache: Optional[bool] = None,
                         host_cache_gb: Optional[float] = None,
                         speculative: Optional[str] = None,
+                        draft_len: Optional[int] = None,
+                        draft_ngram: Optional[int] = None,
                         max_preemptions: Optional[int] = None,
                         queue_timeout_s: Optional[float] = None,
                         lease_timeout_s: Optional[float] = None,
@@ -1561,6 +1676,20 @@ class InferenceEngine:
         decode program. Greedy output is byte-identical with chunking
         on, off, and vs ``generate()``; 0 keeps the legacy split
         prefill/decode programs.
+        ``speculative`` overrides ``serve.speculative`` (SPECULATIVE
+        DECODING, docs/SERVING.md "Speculative decoding"):
+        "prompt_lookup" turns on per-slot self-drafting — each step the
+        scheduler proposes up to ``draft_len`` tokens per greedy decode
+        slot from the slot's own history (latest earlier occurrence of
+        its trailing ``draft_ngram`` tokens) and one ragged verify pass
+        accepts the longest prefix matching greedy argmax, so repetitive
+        traffic emits several tokens per weight-streaming pass. Greedy
+        output stays byte-identical to the non-speculative stream and
+        ``generate()``; sampled requests ride along unaffected. Drafts
+        share the chunked-prefill token budget; acceptance lands in the
+        ``serve.spec`` metrics section. "off" disables a config-enabled
+        default; unknown variants raise. ``draft_len``/``draft_ngram``
+        override their ``serve.*`` defaults per call.
         ``record_occupancy`` keeps a per-step pool time series on
         ``engine.last_serve_occupancy`` (the bench artifact's source).
         ``prefix_cache`` overrides ``serve.prefix_cache``: when on,
@@ -1623,14 +1752,21 @@ class InferenceEngine:
             REJECTED, Completion, ContinuousBatchingScheduler, Request,
         )
 
-        if speculative is not None:
-            # mirror the generate() guard: the paged serving path has no
-            # draft/verify arena — silently ignoring the flag would look
-            # like speculative serving while measuring nothing
+        # SPECULATIVE DECODING (serve.speculative; docs/SERVING.md
+        # "Speculative decoding"): resolve the per-call override against
+        # the config knob. "off"/"none"/"" explicitly disable a
+        # config-enabled default; anything other than "prompt_lookup"
+        # still raises — silently ignoring an unknown variant would look
+        # like speculative serving while measuring nothing.
+        spec = (getattr(self._config, "serve").speculative
+                if speculative is None else speculative)
+        if spec in (None, "", "off", "none"):
+            spec = None
+        elif spec != "prompt_lookup":
             raise ValueError(
-                f"speculative={speculative!r}: paged serving "
-                "(serve/generate_stream) is non-speculative — "
-                "prompt-lookup decoding runs through generate()")
+                f"speculative={spec!r}: only 'prompt_lookup' "
+                "(self-drafting) is implemented for serving — use "
+                "'prompt_lookup', or 'off' to disable")
         cfg = self.model_config
         assert cfg is not None, \
             "serve() requires a model config (LlamaConfig/TransformerConfig)"
@@ -1774,6 +1910,11 @@ class InferenceEngine:
             reserve_upfront=reserve_upfront,
             record_occupancy=record_occupancy, prefix_cache=pc,
             prefill_chunk_tokens=chunk_tok,
+            speculative=spec is not None,
+            draft_len=(serve_cfg.draft_len if draft_len is None
+                       else int(draft_len)),
+            draft_ngram=(serve_cfg.draft_ngram if draft_ngram is None
+                         else int(draft_ngram)),
             max_preemptions=(serve_cfg.max_preemptions
                              if max_preemptions is None
                              else int(max_preemptions)),
@@ -1794,6 +1935,11 @@ class InferenceEngine:
         # current session's prefix cache (replacement semantics)
         self.metrics.register_collector("serve.prefix_cache",
                                         scheduler.prefix_cache_stats)
+        # speculative acceptance counters for the CURRENT session (same
+        # replacement semantics; the section reports enabled=False with
+        # zero counters on non-speculative streams)
+        self.metrics.register_collector("serve.spec",
+                                        scheduler.spec_stats)
         # byte-level pool/tier accounting for the SAME executor+pool this
         # stream serves through (replacement semantics, like above)
         self.metrics.register_collector(

@@ -525,6 +525,27 @@ class SlotBlockTables:
         self.table[slot, need:] = 0
         return pairs
 
+    def trim(self, slot: int, keep_blocks: int) -> int:
+        """Release the slot's TAIL blocks past ``keep_blocks`` — the
+        speculative-decoding rollback: a rejected draft leaves the
+        blocks grown for its verify window past the accepted write
+        position, and under pool pressure they must not sit idle on a
+        slot that no longer covers them. Pure reference bookkeeping
+        (``release_blocks``, newest-first like :meth:`release`): a
+        block another slot or the prefix cache still references just
+        drops THIS slot's reference — no frame is ever rewritten.
+        Returns the number of blocks released (0 when ``keep_blocks``
+        already covers the slot)."""
+        ids = self._slot_blocks[slot]
+        keep_blocks = max(int(keep_blocks), 0)
+        if keep_blocks >= len(ids):
+            return 0
+        tail = ids[keep_blocks:]
+        self.pool.release_blocks(tail[::-1])
+        del ids[keep_blocks:]
+        self.table[slot, keep_blocks:] = 0
+        return len(tail)
+
     def release(self, slot: int) -> None:
         """Recycle a finished slot's blocks back into the pool (with a
         prefix-caching pool: drop this slot's references — shared/cached

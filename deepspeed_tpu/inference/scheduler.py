@@ -150,6 +150,20 @@ Executor protocol (duck-typed)::
         # split programs' rng-split convention exactly (seeded sampled
         # streams identical chunked on/off); non-emitting slots must
         # not advance their rng stream
+    ragged_verify_step(tokens, q_lens, block_tables, write_pos, emit,
+                       is_first, spec_lens) -> (nxt, verified, accepts)
+        # speculative decoding only: ragged_step plus in-device draft
+        # verification. A drafted decode slot feeds 1 + k tokens (its
+        # last sampled token, then k = spec_lens[slot] prompt-lookup
+        # draft tokens) as one ragged row. Returns [num_slots] sampled
+        # tokens (consumed exactly as ragged_step's for undrafted
+        # rows), [num_slots, T_cap] greedy-argmax continuations per
+        # fed position, and [num_slots] accepted-prefix lengths
+        # (0..k). For a drafted row the scheduler consumes
+        # verified[slot, 0..accepts[slot]] — accepted draft tokens
+        # plus the model's bonus token — and rolls back the rest; rng
+        # discipline is ragged_step's (a drafted row advances its
+        # stream once per step, like the 1-token row it replaces)
     spill_blocks(entries: List[Tuple[bytes, int]]) -> None
         # tiered KV only: copy the device KV frames of the listed block
         # ids into the host tier under their content keys. Called BEFORE
@@ -181,6 +195,7 @@ from deepspeed_tpu.inference.kv_pool import (
     BlockPool, PoolAuditError, PrefixCachingBlockPool, SlotBlockTables,
     block_content_keys, blocks_for,
 )
+from deepspeed_tpu.inference.speculative import propose_ngram_draft
 
 # --- terminal request statuses ----------------------------------------------
 #: the request ran its full course (eos or budget)
@@ -320,7 +335,9 @@ class ContinuousBatchingScheduler:
                  audit_every: int = 64,
                  fault_injector: Optional[FaultInjector] = None,
                  host_tier=None, metrics=None, tracer=None, slo=None,
-                 prefill_chunk_tokens: int = 0):
+                 prefill_chunk_tokens: int = 0,
+                 speculative: bool = False, draft_len: int = 8,
+                 draft_ngram: int = 2):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -348,6 +365,44 @@ class ContinuousBatchingScheduler:
                 "prefill_chunk_tokens > 0 needs an executor with a "
                 "ragged_step program (the unified mixed prefill+decode "
                 f"call) — {type(executor).__name__} lacks it")
+        # SPECULATIVE DECODING (serve.speculative="prompt_lookup",
+        # docs/SERVING.md): each step the scheduler proposes up to
+        # ``draft_len`` prompt-lookup draft tokens per runnable GREEDY
+        # decode slot from the slot's host-side history (prompt + out —
+        # no extra state to checkpoint: preemption's restart-from-prompt
+        # discards drafts for free) and submits the slot as a T=1+k
+        # ragged row through ``executor.ragged_verify_step``; the
+        # longest draft prefix matching the model's greedy argmax is
+        # consumed in one step, plus the model's own bonus token.
+        # Drafts compete with chunked-prefill tokens for the same
+        # per-step token budget; rejection trims the over-grown tail
+        # blocks back to the pool (SlotBlockTables.trim). Routing: spec
+        # forces the ragged path even when prefill_chunk_tokens == 0
+        # (legacy prefill programs still do admission; decode rows go
+        # ragged), and ``decode_chunk`` is ignored — one verify round
+        # per scheduler step.
+        self.spec = bool(speculative)
+        self.draft_len = int(draft_len)
+        self.draft_ngram = int(draft_ngram)
+        if self.spec:
+            if not hasattr(executor, "ragged_verify_step"):
+                raise ValueError(
+                    "speculative decoding needs an executor with a "
+                    "ragged_verify_step program (the draft-verify "
+                    f"ragged call) — {type(executor).__name__} lacks it")
+            if self.draft_len < 1:
+                raise ValueError(
+                    f"draft_len must be >= 1, got {draft_len}")
+            if self.draft_ngram < 1:
+                raise ValueError(
+                    f"draft_ngram must be >= 1, got {draft_ngram}")
+        # speculative accounting (bench artifact / serve.spec collector):
+        # drafted/accepted token totals, verify rounds that carried a
+        # draft, and rows decoded without one (sampled slots, no match)
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rounds = 0
+        self.spec_plain_rows = 0
         # prefilling[s]: slot admitted, prompt KV partially written —
         # excluded from decode consumption until its final chunk lands;
         # _prefill_next[s] is the next prompt index to feed
@@ -1202,6 +1257,30 @@ class ContinuousBatchingScheduler:
                         rid=slot.req.rid, seq_len=int(slot.seq_len))
             self.stalled[slot_id] = now_stalled
 
+    def _trim_spec_tail(self, slot_id: int) -> None:
+        """Speculative ROLLBACK, block side: after a verify round the
+        slot's true write position is ``seq_len`` (accepted prefix +
+        bonus token); blocks grown to cover the rejected part of the
+        1+K window go straight back to the pool so a wrong draft never
+        holds capacity a neighbor (or the queue head) needs. The tail
+        blocks are this step's fresh ``grow`` allocations — private
+        (ref 1) and unregistered mid-decode — so the release frees them
+        outright and can never rewrite a shared frame; under
+        ``reserve_upfront`` the slot's full-horizon claim is its
+        admission contract and nothing trims. The KV written into the
+        rejected positions is stale-by-construction: ``col <= row_pos``
+        masks it and the next accepted write overwrites it (the same
+        invariant chunked prefill relies on)."""
+        if self.reserve_upfront:
+            return
+        slot = self.slots[slot_id]
+        keep = blocks_for(slot.seq_len, self.pool.block_size)
+        freed = self.tables.trim(slot_id, keep)
+        if freed:
+            # the freed coverage is gone — next step's _grow re-extends
+            self._cap_steps[slot_id] = keep * self.pool.block_size \
+                - slot.seq_len
+
     def _preempt_for_progress(self, now: float) -> Optional[Completion]:
         """Total-stall safety valve: every active slot needs a block and
         the pool has none (possible only with >= 2 slots — submit()
@@ -1306,9 +1385,16 @@ class ContinuousBatchingScheduler:
         # hittable by this step's admissions
         done.extend(self._finish_restores(now))
         # chunked mode decodes exactly ONE step per ragged call (the
-        # mixed batch is the amortization), so its growth horizon is 1
-        chunk = 1 if self.chunk_tokens else \
-            max(1, int(getattr(self.executor, "decode_chunk", 1)))
+        # mixed batch is the amortization), so its growth horizon is 1;
+        # a speculative step can consume up to 1+K tokens per slot, so
+        # its horizon covers the whole verify window (a partial grant
+        # just clips the draft — the slot still decodes its 1 token)
+        if self.spec:
+            chunk = 1 + self.draft_len
+        elif self.chunk_tokens:
+            chunk = 1
+        else:
+            chunk = max(1, int(getattr(self.executor, "decode_chunk", 1)))
         # growth FIRST: in-flight slots outrank the queue head for free
         # blocks — admitting ahead of mid-decode grows would convert
         # pool pressure into stalls of already-running requests
@@ -1318,7 +1404,12 @@ class ContinuousBatchingScheduler:
         pre_set = set(pre)
         self._grow([s for s in range(self.num_slots)
                     if self.active[s] and s not in pre_set], chunk)
-        if self.chunk_tokens:
+        if self.chunk_tokens or self.spec:
+            # the ragged path: chunked prefill and/or speculative verify
+            # rows ride ONE executor call per step. In legacy-prefill
+            # speculative sessions (chunk_tokens == 0) admission still
+            # runs the split prefill programs, so ``prefilling`` is
+            # never set and _chunked_step reduces to decode/verify rows.
             if self.active.any() or self.prefilling.any():
                 done.extend(self._chunked_step(now))
             self._finish_step(now)
@@ -1519,17 +1610,66 @@ class ContinuousBatchingScheduler:
                     del assignments[s]
             if not runnable.any() and not assignments:
                 return done
-        T_cap = self.chunk_tokens if assignments else 1
+        # speculative drafts: per runnable GREEDY decode slot, look up a
+        # prompt-lookup continuation of its history (prompt + out). The
+        # draft rides the slot's ragged row as k extra query tokens and
+        # COMPETES with prefill chunks for the same per-step token
+        # budget — prefill keeps admission-order priority (TTFT), drafts
+        # take what is left. k also clips to the slot's granted block
+        # coverage (the verify row writes KV through seq_len + k; a
+        # partial grow just shortens the draft) and to remaining - 1
+        # (a draft can never propose past the token budget).
+        drafts: Dict[int, np.ndarray] = {}
+        if self.spec:
+            budget_left = None
+            if self.chunk_tokens:
+                budget_left = self.chunk_tokens - sum(assignments.values())
+            for s in range(B):
+                if not runnable[s]:
+                    continue
+                slot = self.slots[s]
+                if slot.req.temperature != 0.0 or slot.remaining <= 1:
+                    continue           # sampled slots ride as plain rows
+                k_cap = min(self.draft_len, slot.remaining - 1,
+                            int(self._cap_steps[s]) - 1)
+                if assignments:
+                    # mixed step: the row must fit the chunk bucket
+                    k_cap = min(k_cap, self.chunk_tokens - 1)
+                if budget_left is not None:
+                    k_cap = min(k_cap, budget_left)
+                if k_cap < 1:
+                    continue
+                d = propose_ngram_draft(
+                    np.concatenate([np.asarray(slot.req.prompt, np.int64),
+                                    np.asarray(slot.out, np.int64)]),
+                    k_cap, self.draft_ngram)
+                if d.size:
+                    drafts[s] = d
+                    if budget_left is not None:
+                        budget_left -= int(d.size)
+        if assignments:
+            T_cap = self.chunk_tokens
+        elif drafts:
+            # ONE speculative bucket (T_cap = 1 + draft_len) regardless
+            # of this step's actual k's — no per-k compile buckets
+            T_cap = 1 + self.draft_len
+        else:
+            T_cap = 1
         tokens = np.zeros((B, T_cap), np.int32)
         q_lens = np.zeros(B, np.int32)
         emit = np.zeros(B, bool)
         is_first = np.zeros(B, bool)
+        spec_lens = np.zeros(B, np.int32)
         write_pos = self.seq_lens.copy()
         for s in range(B):
             if runnable[s]:
                 tokens[s, 0] = self.last_tokens[s]
                 q_lens[s] = 1
                 emit[s] = True
+        for s, d in drafts.items():
+            tokens[s, 1:1 + d.size] = d
+            q_lens[s] = 1 + d.size
+            spec_lens[s] = d.size
         for s, take in assignments.items():
             pos = int(self._prefill_next[s])
             prompt = self.slots[s].req.prompt
@@ -1549,9 +1689,17 @@ class ContinuousBatchingScheduler:
                 if delay > 0:
                     time.sleep(delay)
                 fi.before_decode(self._step_idx)
-            toks = np.asarray(self.executor.ragged_step(
-                tokens, q_lens, self.tables.table, write_pos, emit,
-                is_first), np.int32).reshape(-1)
+            if self.spec:
+                nxt, verified, accepts = self.executor.ragged_verify_step(
+                    tokens, q_lens, self.tables.table, write_pos, emit,
+                    is_first, spec_lens)
+                toks = np.asarray(nxt, np.int32).reshape(-1)
+                verified = np.asarray(verified, np.int32)
+                accepts = np.asarray(accepts, np.int32)
+            else:
+                toks = np.asarray(self.executor.ragged_step(
+                    tokens, q_lens, self.tables.table, write_pos, emit,
+                    is_first), np.int32).reshape(-1)
         except Exception as e:
             if tr is not None:
                 tr.span("DECODE", t0_m, tr.now(), cat="executor",
@@ -1599,19 +1747,48 @@ class ContinuousBatchingScheduler:
                 self.prefilling[s] = False
                 done.extend(self._activate_slot(
                     s, slot.req, int(toks[s]), slot.t_admitted))
-        # consume decode tokens (one per runnable slot)
+        # consume decode tokens: one per plain runnable slot; a drafted
+        # slot consumes its accepted prefix PLUS the model's bonus token
+        # (all byte-identical to the sequential greedy stream), then
+        # rolls its over-grown tail blocks back to the pool
         for s in range(B):
             if not runnable[s]:
                 continue
             slot = self.slots[s]
-            self._consume_token(s, int(toks[s]))
-            self._step_decode_tokens += 1
+            k = int(spec_lens[s]) if self.spec else 0
+            if k > 0:
+                a = int(accepts[s])
+                consumed = 0
+                for i in range(a + 1):
+                    if slot.remaining <= 0:
+                        break          # eos inside the accepted prefix
+                    self._consume_token(s, int(verified[s, i]))
+                    consumed += 1
+                self.spec_rounds += 1
+                self.spec_drafted_tokens += k
+                self.spec_accepted_tokens += a
+                if self.metrics is not None:
+                    self.metrics.inc("serve.spec.drafted_tokens", k)
+                    self.metrics.inc("serve.spec.accepted_tokens", a)
+                    self.metrics.inc("serve.spec.rejected_tokens", k - a)
+                    self.metrics.observe("serve.spec.acceptance", a / k)
+                # rollback: blocks grown for the verify window beyond
+                # the accepted write position return to the pool —
+                # fresh tail blocks are private (ref 1, unregistered),
+                # so this never touches a shared frame
+                self._trim_spec_tail(s)
+            else:
+                self._consume_token(s, int(toks[s]))
+                consumed = 1
+                if self.spec:
+                    self.spec_plain_rows += 1
+            self._step_decode_tokens += consumed
             if tr is not None:
                 tr.span("DECODE", t0_m, t1_m, tid=1 + s,
                         rid=slot.req.rid, slot=s, step=self._step_idx,
-                        tokens=1)
+                        tokens=consumed)
             if self.metrics is not None:
-                self.metrics.inc("serve.tokens_sampled")
+                self.metrics.inc("serve.tokens_sampled", consumed)
             if slot.remaining <= 0:
                 done.append(self._finish(s, t_now))
         return done
@@ -1805,6 +1982,35 @@ class ContinuousBatchingScheduler:
             "host_bytes_restored": ts.get("bytes_restored", 0),
             "host_bytes_used": ts.get("bytes_used", 0),
             "host_entries": ts.get("entries", 0),
+        }
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding effectiveness counters (the
+        ``serve.spec`` registry section / bench artifact).
+        ``acceptance_rate`` is accepted over drafted tokens — the
+        number to watch: near 0 every verify round paid a 1+K-wide
+        pass to emit one token (turn speculation off for that
+        traffic); ``mean_accepted_per_round`` + 1 bounds the per-step
+        speedup on the drafted rows. ``plain_rows`` counts decode rows
+        that ran without a draft (sampled slots, no n-gram match, no
+        budget/coverage room) — the bench's engine-vs-recount
+        cross-check derives delivered decode tokens as
+        ``plain_rows + rounds + accepted`` and must agree with the
+        stream byte counts within 5%. Monotonic over the scheduler's
+        life."""
+        d, a = self.spec_drafted_tokens, self.spec_accepted_tokens
+        r = self.spec_rounds
+        return {
+            "enabled": self.spec,
+            "draft_len": self.draft_len,
+            "draft_ngram": self.draft_ngram,
+            "drafted_tokens": d,
+            "accepted_tokens": a,
+            "rejected_tokens": d - a,
+            "rounds": r,
+            "plain_rows": self.spec_plain_rows,
+            "acceptance_rate": round(a / d, 4) if d else 0.0,
+            "mean_accepted_per_round": round(a / r, 4) if r else 0.0,
         }
 
 

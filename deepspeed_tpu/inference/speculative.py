@@ -27,6 +27,81 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def ngram_lookup(buf: jnp.ndarray, count: jnp.ndarray, k: int,
+                 ngram: int):
+    """TRACED prompt-lookup: latest earlier occurrence of the trailing
+    ``ngram`` tokens in ``buf[:count]`` and its ``k``-token continuation.
+
+    buf: [BUF] int32 token history, count: valid length (traced scalar);
+    ``k``/``ngram`` are static. Returns ``(found, draft [k])`` — when not
+    found the draft is garbage the caller must gate on ``found``. A match
+    whose continuation runs into the history end implies the tail is
+    PERIODIC with period ``count - start``; the draft keeps copying that
+    cycle (modular gather), so a constant or short-looped tail fills all
+    ``k`` slots instead of clipping to the one real token left — on loopy
+    traffic that is the difference between 1-token and full-K drafts.
+    Greedy verification still gates every speculative token, so a wrong
+    periodic guess costs the same as any wrong draft.
+
+    Shared by the batch-1 ``generate()`` loop (build_pld_generate_fn)
+    and mirrored on the host by :func:`propose_ngram_draft` for the
+    per-slot serving proposer — one lookup semantics, two residences.
+    """
+    BUF = buf.shape[0]
+    tail = jax.lax.dynamic_slice(buf, (count - ngram,), (ngram,))
+    idx = jnp.arange(BUF)
+    # window match at j: buf[j:j+ngram] == tail, ending before the tail
+    hits = jnp.ones((BUF,), bool)
+    for d in range(ngram):
+        rolled = jnp.roll(buf, -d)
+        hits = jnp.logical_and(hits, rolled == tail[d])
+    valid = idx < jnp.maximum(count - ngram, 0)       # strictly earlier
+    hits = jnp.logical_and(hits, valid)
+    j = jnp.max(jnp.where(hits, idx, -1))
+    found = j >= 0
+    start = j + ngram                                 # <= count - 1
+    period = jnp.maximum(count - start, 1)
+    pos = start + jnp.arange(k) % period              # periodic extension
+    draft = jnp.take(buf, jnp.clip(pos, 0, BUF - 1))
+    return found, draft
+
+
+def propose_ngram_draft(history, k: int, ngram: int = 2) -> np.ndarray:
+    """HOST-side prompt-lookup draft proposal (numpy) — the serving
+    scheduler's per-slot proposer.
+
+    Same match semantics as :func:`ngram_lookup` (latest earlier
+    occurrence of the trailing ``ngram``, periodic extension past the
+    history end): an int32 array of ``k`` draft tokens, EMPTY when no
+    earlier occurrence exists (or the history is too short to have one)
+    — an empty draft means the slot decodes as a plain 1-token row this
+    step, it is never an error.
+    """
+    hist = np.asarray(history, dtype=np.int32).reshape(-1)
+    n = int(hist.size)
+    if k < 1 or ngram < 1 or n <= ngram:
+        return np.zeros(0, np.int32)
+    tail = hist[n - ngram:]
+    # candidate starts j in [0, n - ngram): windows strictly before the
+    # tail's own window; vectorized ngram-wide compare
+    m = np.ones(n - ngram, bool)
+    for d in range(ngram):
+        m &= hist[d:d + n - ngram] == tail[d]
+    matches = np.nonzero(m)[0]
+    if matches.size == 0:
+        return np.zeros(0, np.int32)
+    start = int(matches[-1]) + ngram                  # latest occurrence
+    avail = hist[start:]
+    if avail.size >= k:
+        return avail[:k].copy()
+    # the match continuation ran into the history end: the tail is
+    # periodic with period ``n - start`` — keep copying the cycle, so a
+    # constant or looped tail drafts all k slots instead of clipping to
+    # the one real token left (verification gates a wrong guess anyway)
+    return np.resize(avail, k)
 
 
 def build_pld_generate_fn(apply_fn: Callable, B: int, T: int,
@@ -45,24 +120,6 @@ def build_pld_generate_fn(apply_fn: Callable, B: int, T: int,
     # K slots of slack so the K-wide verify window never clips at the end
     # (the KV arena must cover T + max_new + K too — engine sizes it)
     BUF = T + max_new_tokens + K
-
-    def lookup_draft(buf, count):
-        """Latest earlier occurrence of the trailing ``ngram`` + its
-        continuation. buf: [BUF] int32, count: valid length."""
-        tail = jax.lax.dynamic_slice(buf, (count - ngram,), (ngram,))
-        idx = jnp.arange(BUF)
-        # window match at j: buf[j:j+ngram] == tail, ending before the tail
-        hits = jnp.ones((BUF,), bool)
-        for d in range(ngram):
-            rolled = jnp.roll(buf, -d)
-            hits = jnp.logical_and(hits, rolled == tail[d])
-        valid = idx < jnp.maximum(count - ngram, 0)   # strictly earlier
-        hits = jnp.logical_and(hits, valid)
-        j = jnp.max(jnp.where(hits, idx, -1))
-        found = j >= 0
-        start = jnp.clip(j + ngram, 0, BUF - K)
-        draft = jax.lax.dynamic_slice(buf, (start,), (K,))
-        return found, draft
 
     def gen(params, input_ids, caches, eos_id, n_steps, attn_start):
         if params_fn is not None:
@@ -86,7 +143,7 @@ def build_pld_generate_fn(apply_fn: Callable, B: int, T: int,
         def body(c):
             count, caches, finished, rounds, accepted_sum, buf = c
             t_cur = buf[count - 1]
-            _, draft = lookup_draft(buf, count)
+            _, draft = ngram_lookup(buf, count, K, ngram)
             # verify window: current token + first K-1 draft tokens
             window = jnp.concatenate([t_cur[None], draft[:K - 1]])[None, :]
             cache_idx = count - 1                     # t_cur's KV slot

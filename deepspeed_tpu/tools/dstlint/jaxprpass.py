@@ -161,14 +161,18 @@ def _abstract_serving_pieces(arm: str):
             copy_jit, copy_avals)
 
 
-def _ragged_serving_pieces(arm: str, int8: bool = False):
+def _ragged_serving_pieces(arm: str, int8: bool = False,
+                           verify: bool = False):
     """(ragged_jit, avals) for the unified RAGGED-STEP program
     (``PagedServeExecutor._build_ragged_fn`` — chunked-prefill
     serving): ONE ``[B, T_cap]`` shape packs prefill chunks of any
     prompt length plus every decode slot, so this entry point is the
     whole chunked session's hot program. ``int8`` traces it over the
     quant.kv_cache pool layout through the fused Llama path (the only
-    int8-KV-eligible decoder)."""
+    int8-KV-eligible decoder). ``verify`` traces the SPECULATIVE
+    variant instead (``_build_ragged_verify_fn`` — same attention body
+    plus in-device draft verification; one extra ``spec_lens`` [B]
+    operand), the hot program of a speculation-enabled session."""
     import contextlib as _ctx
 
     import jax
@@ -195,14 +199,16 @@ def _ragged_serving_pieces(arm: str, int8: bool = False):
     ex = PagedServeExecutor(paged_apply, None, None, cfg,
                             _ctx.nullcontext, num_slots=_SLOTS,
                             decode_chunk=_CHUNK)
-    ragged_jit = ex._build_ragged_fn(_RAGGED_T)
+    ragged_jit = (ex._build_ragged_verify_fn if verify
+                  else ex._build_ragged_fn)(_RAGGED_T)
     sds = jax.ShapeDtypeStruct
     B, W = _SLOTS, _WIDTH
     i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+    spec = (sds((B,), i32),) if verify else ()     # spec_lens operand
     avals = (
         params, sds((B, _RAGGED_T), i32), pools, sds((B, W), i32),
         sds((B,), i32), sds((B,), i32), sds((B,), jnp.bool_),
-        sds((B,), jnp.bool_), sds((B, 2), u32), sds((B,), f32),
+        sds((B,), jnp.bool_), *spec, sds((B, 2), u32), sds((B,), f32),
         sds((B,), i32), sds((B,), f32))
     return ragged_jit, avals
 
@@ -331,6 +337,20 @@ def trace_entry_points(arms: Optional[List[str]] = None
                     name, 0, {}, 0, error=f"{type(e).__name__}: {e}")
                 continue
             reports[name] = _report(name, ragged_jit, ragged_avals)
+        # the speculative ragged-verify variant (serve.speculative):
+        # same attention body plus in-device greedy draft verification
+        # — a speculation-enabled session's only hot program, budgeted
+        # over both pool layouts just like ragged_step
+        for tag, int8 in (("", False), ("_int8", True)):
+            name = f"ragged_verify{tag}/{arm}"
+            try:
+                verify_jit, verify_avals = _ragged_serving_pieces(
+                    arm, int8=int8, verify=True)
+            except Exception as e:
+                reports[name] = EntryReport(
+                    name, 0, {}, 0, error=f"{type(e).__name__}: {e}")
+                continue
+            reports[name] = _report(name, verify_jit, verify_avals)
         if arm == "reference":
             reports["copy_pool_blocks"] = _report(
                 "copy_pool_blocks", copy_jit, copy_avals)
@@ -373,7 +393,9 @@ def check_reports(reports: Dict[str, EntryReport],
                 and name.split("/")[0] in ("decode_step",
                                            "prefill_bucket",
                                            "ragged_step",
-                                           "ragged_step_int8"):
+                                           "ragged_step_int8",
+                                           "ragged_verify",
+                                           "ragged_verify_int8"):
             emit("jaxpr-kernel-arm", name,
                  "Pallas arm traced WITHOUT any pallas_call equation — "
                  "the kernel silently fell back to the reference "

@@ -612,6 +612,24 @@ def trace_mem_entry_points(arms: Optional[List[str]] = None
                 meta={"kind": "serve",
                       "pool_bytes": tree_bytes(ragged_avals[2]),
                       "params_bytes": tree_bytes(ragged_avals[0])})
+        # the speculative ragged-verify variant — one draft_len-wide
+        # logits/verification tail on top of the ragged body, so its
+        # peak is budgeted separately from ragged_step
+        for tag, int8 in (("", False), ("_int8", True)):
+            name = f"ragged_verify{tag}/{arm}"
+            try:
+                verify_jit, verify_avals = \
+                    jaxprpass._ragged_serving_pieces(arm, int8=int8,
+                                                     verify=True)
+            except Exception as e:
+                reports[name] = MemReport(
+                    name, error=f"{type(e).__name__}: {e}")
+                continue
+            reports[name] = measure_entry(
+                name, verify_jit, verify_avals,
+                meta={"kind": "serve",
+                      "pool_bytes": tree_bytes(verify_avals[2]),
+                      "params_bytes": tree_bytes(verify_avals[0])})
         if arm != "reference":
             continue
         reports["copy_pool_blocks"] = measure_entry(

@@ -1236,12 +1236,13 @@ def _serve_entry(which: str):
         _abstract_serving_pieces,
     )
 
-    if which == "ragged":
+    if which in ("ragged", "ragged_verify"):
         from deepspeed_tpu.tools.dstlint.jaxprpass import (
             _ragged_serving_pieces,
         )
 
-        fn, avals = _ragged_serving_pieces("reference")
+        fn, avals = _ragged_serving_pieces(
+            "reference", verify=which == "ragged_verify")
     else:
         (decode_jit, decode_avals, prefill_jit, prefill_avals,
          _c, _ca) = _abstract_serving_pieces("reference")
@@ -1277,6 +1278,8 @@ def spmd_entry_points() -> List[SpmdEntry]:
                   lambda: _serve_entry("prefill")),
         SpmdEntry("serve_ragged/reference",
                   lambda: _serve_entry("ragged")),
+        SpmdEntry("serve_ragged_verify/reference",
+                  lambda: _serve_entry("ragged_verify")),
     ]
 
 

@@ -65,6 +65,15 @@ def build_report(requests: int = 6, host_cache_gb: float = 0.0) -> dict:
     comps = engine.serve(reqs, num_slots=2, block_size=4,
                          host_cache_gb=host_cache_gb or None)
     snap = engine.serve_metrics()
+    # a short speculative session on repetitive prompts so the
+    # serve.spec acceptance counters carry real numbers in the report
+    spec_reqs = [Request(rid=100 + i,
+                         prompt=np.tile(rng.integers(1, 256, 3 + i % 3), 4),
+                         max_new_tokens=12)
+                 for i in range(4)]
+    engine.serve(spec_reqs, num_slots=2, block_size=4,
+                 speculative="prompt_lookup", draft_len=4, draft_ngram=2)
+    spec_snap = engine.serve_metrics().get("serve.spec", {})
     return {
         "backend": jax.default_backend(),
         "requests": len(comps),
@@ -78,6 +87,7 @@ def build_report(requests: int = 6, host_cache_gb: float = 0.0) -> dict:
                                         snap.get("serve.memory", {})),
         "mem_budgets": _mem_budget_table(),
         "efficiency": snap.get("serve.efficiency", {}),
+        "speculative": spec_snap,
     }
 
 
@@ -310,6 +320,23 @@ def render_text(report: dict) -> str:
     lines.append(f"  {'peak source / device kind':<38}"
                  f"{eff.get('peak_source', '?')} / "
                  f"{eff.get('device_kind', '?')}")
+    sp = report.get("speculative", {})
+    if sp:
+        lines.append("")
+        lines.append("-- speculative decoding (prompt-lookup) "
+                     "-----------------------------")
+        lines.append(
+            f"  drafted={int(sp.get('drafted_tokens', 0))}  "
+            f"accepted={int(sp.get('accepted_tokens', 0))}  "
+            f"rejected={int(sp.get('rejected_tokens', 0))}  "
+            f"rounds={int(sp.get('rounds', 0))}  "
+            f"plain_rows={int(sp.get('plain_rows', 0))}")
+        lines.append(
+            f"  acceptance_rate={sp.get('acceptance_rate', 0.0):.4f}  "
+            f"mean_accepted_per_round="
+            f"{sp.get('mean_accepted_per_round', 0.0):.4f}  "
+            f"(draft_len={int(sp.get('draft_len', 0))}, "
+            f"ngram={int(sp.get('draft_ngram', 0))})")
     lines.append("=" * 69)
     return "\n".join(lines)
 

@@ -37,28 +37,38 @@ from tests.unit.inference.test_prefix_cache import PrefixFakeExecutor
 
 pytestmark = pytest.mark.chaos
 
-# EVERY scenario runs twice: over the legacy split prefill/decode
+# EVERY scenario runs FOUR ways: over the legacy split prefill/decode
 # executor calls AND over token-budget CHUNKED PREFILL
-# (serve.prefill_chunk_tokens — the unified ragged step). Chunk
-# boundaries are ordinary step boundaries, so the whole fault-tolerance
-# contract (isolation, release-on-every-exit, bounded preemption,
-# auditor-clean, one terminal per request) must hold identically; the
-# fake executors' ragged_step emits the same deterministic streams as
-# their split paths, so the byte-identical-stream cross-checks carry
-# over unchanged.
+# (serve.prefill_chunk_tokens — the unified ragged step), each with
+# SPECULATIVE decoding off and on. Chunk boundaries are ordinary step
+# boundaries, so the whole fault-tolerance contract (isolation,
+# release-on-every-exit, bounded preemption, auditor-clean, one
+# terminal per request) must hold identically; the fake executors'
+# ragged_step emits the same deterministic streams as their split
+# paths, so the byte-identical-stream cross-checks carry over
+# unchanged. In the spec modes every decode round flows through
+# ragged_verify_step with the 1+K growth horizon live — the base
+# fake's strictly-advancing streams never repeat an n-gram, so these
+# arms pin that merely ENABLING speculation perturbs nothing under
+# faults (the accepting-draft fault cases get dedicated scenarios
+# below with the cycling fake).
 _CHUNK_MODE = 0
+_SPEC_MODE = False
 
 
-@pytest.fixture(autouse=True, params=[0, 3], ids=["legacy", "chunked"])
+@pytest.fixture(autouse=True,
+                params=[(0, False), (3, False), (0, True), (3, True)],
+                ids=["legacy", "chunked", "legacy-spec", "chunked-spec"])
 def _prefill_chunk_mode(request):
-    global _CHUNK_MODE
-    _CHUNK_MODE = request.param
+    global _CHUNK_MODE, _SPEC_MODE
+    _CHUNK_MODE, _SPEC_MODE = request.param
     yield
     _CHUNK_MODE = 0
+    _SPEC_MODE = False
 
 
 def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
-               prefix=False, **kw):
+               prefix=False, executor=None, **kw):
     """Scheduler under test: auditor at EVERY chunk (the chaos-mode
     cadence), deterministic fake executor, and a dstrace tracer whose
     terminal events ``assert_quiescent`` cross-checks against every
@@ -66,10 +76,16 @@ def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6,
     therefore also pins the trace contract (exactly one terminal span
     per request, status matching) AND the dstprof gauge contract
     (non-negative gauges, monotone watermarks, exporter serveable)."""
-    ex = PrefixFakeExecutor() if prefix else FakeExecutor()
+    if executor is None:
+        executor = PrefixFakeExecutor() if prefix else FakeExecutor()
+    ex = executor
     pool = (PrefixCachingBlockPool(num_blocks, block_size) if prefix
             else BlockPool(num_blocks, block_size))
     kw.setdefault("prefill_chunk_tokens", _CHUNK_MODE)
+    if _SPEC_MODE:
+        kw.setdefault("speculative", True)
+        kw.setdefault("draft_len", 4)
+        kw.setdefault("draft_ngram", 2)
     kw.setdefault("audit_every", 1)
     kw.setdefault("tracer", RequestTracer())
     kw.setdefault("metrics", MetricsRegistry())
@@ -184,7 +200,12 @@ def test_chaos_pool_exhaustion_window_stalls_then_recovers():
                 req(3, plen=4, gen=6)]
 
     ref = fault_free(reqs, num_blocks=17)
-    fi = FaultInjector([FaultSpec(site="pool", step=2, duration=4)])
+    # Speculative mode front-loads growth (the 1+K horizon claims the
+    # whole-request coverage at step 1), so the window that catches an
+    # allocation shifts to the third request's admission.
+    spec = FaultSpec(site="pool", step=5, duration=6) if _SPEC_MODE \
+        else FaultSpec(site="pool", step=2, duration=4)
+    fi = FaultInjector([spec])
     sched, _, _ = make_sched(num_blocks=17, fault_injector=fi)
     for r in reqs():
         sched.submit(r)
@@ -199,13 +220,23 @@ def test_chaos_pool_exhaustion_window_stalls_then_recovers():
 def test_chaos_pool_exhaustion_total_stall_preempts_and_recovers():
     """Freeze with every slot needing growth: total stall → bounded
     preemption → restart-from-prompt, outputs still exact."""
+    # Speculative mode's 1+K horizon claims gen=8's whole coverage at
+    # step 1 — use a longer generation so BOTH slots still hit a
+    # mid-decode growth step together inside the freeze window.
+    gen = 16 if _SPEC_MODE else 8
+
     def reqs():
-        return [req(1, plen=4, gen=8), req(2, plen=4, gen=8)]
+        return [req(1, plen=4, gen=gen), req(2, plen=4, gen=gen)]
 
     ref = fault_free(reqs, num_blocks=17)
-    # freeze exactly when both slots must claim their 3rd block (seq 8
-    # at step ~5): every active slot stalls at once → preemption ladder
-    fi = FaultInjector([FaultSpec(site="pool", step=5, duration=4)])
+    # freeze exactly when both slots must claim their next block at
+    # once: every active slot stalls together → preemption ladder.
+    # (Speculative growth is opportunistic — a denied grow only stalls
+    # a slot once seq+1 outruns its already-claimed coverage, so the
+    # window must span the denied grow attempts AND the exhaustion.)
+    spec = FaultSpec(site="pool", step=3, duration=7) if _SPEC_MODE \
+        else FaultSpec(site="pool", step=5, duration=4)
+    fi = FaultInjector([spec])
     sched, _, pool = make_sched(num_blocks=17, fault_injector=fi)
     for r in reqs():
         sched.submit(r)
@@ -419,8 +450,12 @@ def test_chaos_preempt_rotation_spreads_victims():
     the SAME request is not evicted every round — with a per-request cap
     of 1 the whole trace still completes (naive youngest-first would
     push one rid over any cap or starve it)."""
-    sched, _, _ = make_sched(num_slots=3, num_blocks=5, width=6,
-                             max_preemptions=3)
+    # Speculative mode grants growth partially (a clipped horizon still
+    # decodes 1 token), easing stalls — a one-block-tighter pool
+    # restores the sustained pressure the rotation property needs.
+    sched, _, _ = make_sched(num_slots=3,
+                             num_blocks=4 if _SPEC_MODE else 5,
+                             width=6, max_preemptions=3)
     for rid in (1, 2, 3):
         sched.submit(req(rid, plen=4, gen=8))       # 3 blocks each at peak
     comps = by_rid(drain(sched, max_steps=2000))
@@ -680,3 +715,48 @@ def test_chaos_straggler_host_surfaces_in_fleet_skew(tmp_path):
         fast.metrics.counter("serve.tokens_sampled")
         + slow.metrics.counter("serve.tokens_sampled"))
     assert merged.labeled_gauges()["serve.goodput"]["rank1"] < 1.0
+
+
+# --- speculative verify rounds under faults ----------------------------------
+
+def test_chaos_spec_mid_verify_preemption_and_cancel():
+    """ACCEPTING speculative traffic under pool pressure: slots whose
+    prompt-lookup drafts really land (the cycling fake) are preempted
+    while holding their 1+K over-allocation mid-verify, and a cancel
+    lands between verify rounds — streams stay byte-exact against the
+    closed-form continuation (restart-from-prompt re-drafts from
+    scratch), and every speculative block, accepted AND rejected tail,
+    returns to the pool."""
+    from tests.unit.inference.test_scheduler import PeriodicFake
+
+    GEN = 24
+    want = np.arange(GEN) % 4 + 1      # the fake's cycling continuation
+
+    def cycle_req(rid):
+        return Request(rid=rid, prompt=np.tile(np.arange(1, 5), 2),
+                       max_new_tokens=GEN)
+
+    sched, ex, pool = make_sched(executor=PeriodicFake(period=4),
+                                 num_blocks=9, width=8,
+                                 speculative=True, draft_len=4,
+                                 draft_ngram=2)
+    for rid in (1, 2, 3):              # 3rd waits: 2 slots
+        sched.submit(cycle_req(rid))
+    comps = {}
+    # in chunked modes the 8-token prompts prefill over several budget
+    # steps first — step to a point where verify rounds are live
+    cancel_step = 8 if _CHUNK_MODE else 3
+    for _ in range(cancel_step):
+        comps.update({c.rid: c for c in sched.step()})
+    assert sched.cancel(1) is True     # active mid-stream
+    comps.update({c.rid: c for c in drain(sched)})
+    assert comps[1].status == CANCELLED
+    np.testing.assert_array_equal(comps[1].tokens,
+                                  want[:len(comps[1].tokens)])
+    for rid in (2, 3):
+        assert comps[rid].status == COMPLETED, comps[rid].error
+        np.testing.assert_array_equal(comps[rid].tokens, want)
+    st = sched.spec_stats()
+    assert st["accepted_tokens"] > 0   # drafts really flowed
+    assert sched.preemptions >= 1      # eviction mid-verify exercised
+    assert_quiescent(sched)

@@ -25,6 +25,18 @@ class FakeExecutor:
         self.prefills = []
         self.decode_calls = []
         self.ragged_calls = []                   # chunked-prefill steps
+        self.verify_calls = []                   # speculative steps
+
+    def _next(self, slot, t):
+        """The fake 'model': the deterministic greedy continuation
+        after consuming token ``t`` in this slot's stream. A PURE
+        function of the fed token, so speculative verify rounds emit
+        byte-identical streams to sequential 1-token decode."""
+        return self.slot_reqs[slot].rid * 100 + t % 100 + 1
+
+    def _first(self, slot):
+        """First sampled token of a request (the prefill output)."""
+        return self.slot_reqs[slot].rid * 100
 
     def set_slot(self, slot, req):
         self.slot_reqs[slot] = req
@@ -32,7 +44,7 @@ class FakeExecutor:
 
     def prefill(self, slot, prompt, block_row):
         self.prefills.append((slot, len(prompt), block_row.copy()))
-        return self.slot_reqs[slot].rid * 100
+        return self._first(slot)
 
     def decode(self, tokens, block_tables, seq_lens, active, steps_left,
                max_steps=None):
@@ -41,9 +53,7 @@ class FakeExecutor:
         out = np.zeros((len(tokens), 1), np.int32)
         for s in range(len(tokens)):
             if active[s]:
-                req = self.slot_reqs[s]
-                step = tokens[s] % 100 + 1
-                out[s, 0] = req.rid * 100 + step
+                out[s, 0] = self._next(s, int(tokens[s]))
         return out
 
     def ragged_step(self, tokens, q_lens, block_tables, write_pos, emit,
@@ -63,10 +73,58 @@ class FakeExecutor:
                 continue
             req = self.slot_reqs[s]
             if write_pos[s] < len(req.prompt):   # final prefill chunk
-                out[s] = req.rid * 100
+                out[s] = self._first(s)
             else:                                # one decode step
-                out[s] = req.rid * 100 + tokens[s][0] % 100 + 1
+                out[s] = self._next(s, int(np.asarray(tokens[s])[0]))
         return out
+
+    def ragged_verify_step(self, tokens, q_lens, block_tables, write_pos,
+                           emit, is_first, spec_lens):
+        """Speculative protocol: the greedy continuation per fed
+        position from the same deterministic rule, verified exactly as
+        the real executor verifies (longest draft prefix matching the
+        model stream)."""
+        tokens = np.asarray(tokens)
+        self.verify_calls.append((tokens.copy(),
+                                  np.asarray(q_lens).copy(),
+                                  np.asarray(spec_lens).copy()))
+        out = self.ragged_step(tokens, q_lens, block_tables, write_pos,
+                               emit, is_first)
+        B, T = tokens.shape
+        verified = np.zeros((B, T), np.int32)
+        accepts = np.zeros(B, np.int32)
+        for s in range(B):
+            req = self.slot_reqs.get(s)
+            if not emit[s] or req is None \
+                    or write_pos[s] < len(req.prompt):
+                continue                         # prefill rows never draft
+            for i in range(int(q_lens[s])):
+                verified[s, i] = self._next(s, int(tokens[s][i]))
+            a = 0
+            while a < int(spec_lens[s]) \
+                    and verified[s, a] == tokens[s][a + 1]:
+                a += 1
+            accepts[s] = a
+        return out, verified, accepts
+
+
+class PeriodicFake(FakeExecutor):
+    """Fake whose greedy stream CYCLES ``1..period`` regardless of rid —
+    a prompt tiled from the same cycle makes prompt-lookup drafts
+    CORRECT, so full-acceptance multi-token consumption is exercised
+    deterministically (and a prompt with a misleading repeat exercises
+    rejection: the draft copies the repeat, the model stream departs
+    from it)."""
+
+    def __init__(self, period=4):
+        super().__init__()
+        self.period = int(period)
+
+    def _next(self, slot, t):
+        return t % self.period + 1
+
+    def _first(self, slot):
+        return self._next(slot, int(self.slot_reqs[slot].prompt[-1]))
 
 
 def make_sched(num_slots=2, num_blocks=17, block_size=4, width=6):
@@ -611,3 +669,186 @@ def test_chunked_admission_is_fifo_under_backpressure():
     comps = drain(sched)
     assert [c.rid for c in comps] == [1, 2]      # FIFO held
     assert pool.num_allocated == 0
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (per-slot prompt-lookup drafts through the ragged
+# verify program).
+# ---------------------------------------------------------------------------
+
+
+def make_spec(executor=None, chunk=0, num_slots=2, num_blocks=33,
+              block_size=4, width=8, draft_len=4, ngram=2):
+    ex = FakeExecutor() if executor is None else executor
+    pool = BlockPool(num_blocks, block_size)
+    sched = ContinuousBatchingScheduler(ex, num_slots, pool, width,
+                                        prefill_chunk_tokens=chunk,
+                                        speculative=True,
+                                        draft_len=draft_len,
+                                        draft_ngram=ngram)
+    return sched, ex, pool
+
+
+def test_spec_requires_verify_executor():
+    class NoVerify:
+        def ragged_step(self, *a):
+            pass
+
+    with pytest.raises(ValueError, match="ragged_verify_step"):
+        ContinuousBatchingScheduler(NoVerify(), 2, BlockPool(9, 4), 6,
+                                    speculative=True)
+
+
+def test_spec_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="draft_len"):
+        make_spec(draft_len=0)
+    with pytest.raises(ValueError, match="draft_ngram"):
+        make_spec(ngram=0)
+
+
+@pytest.mark.parametrize("chunk", [0, 3], ids=["legacy", "chunked"])
+def test_spec_no_match_behaves_as_plain(chunk):
+    """Incompressible history (the base fake's strictly-advancing
+    stream never revisits an n-gram) must propose NOTHING: zero drafted
+    tokens, every decode a plain 1-token row, streams untouched."""
+    sched, ex, pool = make_spec(chunk=chunk)
+    sched.submit(req(1, plen=4, gen=5))
+    sched.submit(req(2, plen=6, gen=4))
+    comps = {c.rid: c for c in drain(sched)}
+    np.testing.assert_array_equal(comps[1].tokens,
+                                  [100, 101, 102, 103, 104])
+    np.testing.assert_array_equal(comps[2].tokens, [200, 201, 202, 203])
+    st = sched.spec_stats()
+    assert st["drafted_tokens"] == 0 and st["rounds"] == 0
+    # Decode rows only: each request's first token comes from prefill.
+    assert st["plain_rows"] == (5 - 1) + (4 - 1)
+    assert pool.num_allocated == 0
+    sched.audit(context="post-spec-nomatch")
+
+
+def _cycle_req(rid, period=4, reps=2, gen=10, **kw):
+    """Prompt tiled from the PeriodicFake cycle: every prompt-lookup
+    draft is the true continuation, so acceptance is full."""
+    prompt = np.tile(np.arange(1, period + 1), reps)
+    return Request(rid=rid, prompt=prompt, max_new_tokens=gen, **kw)
+
+
+@pytest.mark.parametrize("chunk", [0, 4], ids=["legacy", "chunked"])
+def test_spec_full_acceptance_matches_plain(chunk):
+    """THE speculative pin at the scheduler layer: a fully-accepting
+    trace emits byte-identical streams to the non-speculative run of
+    the same fake, while consuming multiple tokens per verify round
+    (fewer executor rounds than tokens delivered)."""
+    def run(spec):
+        ex = PeriodicFake(period=4)
+        pool = BlockPool(33, 4)
+        sched = ContinuousBatchingScheduler(
+            ex, 2, pool, 10, prefill_chunk_tokens=chunk,
+            speculative=spec, draft_len=4, draft_ngram=2)
+        sched.submit(_cycle_req(1, gen=10))
+        sched.submit(_cycle_req(2, gen=9))
+        comps = {c.rid: c.tokens for c in drain(sched)}
+        assert pool.num_allocated == 0
+        sched.audit(context="post-spec-accept")
+        return comps, sched, ex
+
+    plain, _, _ = run(False)
+    spec, sched, ex = run(True)
+    for rid in (1, 2):
+        np.testing.assert_array_equal(spec[rid], plain[rid])
+    st = sched.spec_stats()
+    assert st["accepted_tokens"] > 0
+    assert st["acceptance_rate"] > 0.5
+    # Multi-token rounds: fewer verify calls than tokens delivered.
+    delivered = sum(len(t) for t in spec.values())
+    assert len(ex.verify_calls) < delivered
+    # Bookkeeping identity the bench cross-checks: every delivered
+    # decode token is a plain row, a round's own next-token, or an
+    # accepted draft token (prefill first-tokens are not decode rows).
+    decode_tokens = delivered - 2
+    assert decode_tokens == (st["plain_rows"] + st["rounds"]
+                             + st["accepted_tokens"])
+
+
+def test_spec_rejection_rolls_back_and_trims():
+    """A misleading repeat in the prompt makes the first draft WRONG:
+    the round accepts zero draft tokens, the stream stays byte-exact,
+    and the speculative tail blocks are returned to the pool the same
+    step (rollback is a trim, not a leak)."""
+    prompt = np.array([1, 2, 3, 7, 1, 2])        # trailing [1,2] repeats,
+                                                 # but model departs at 7
+    def run(spec):
+        ex = PeriodicFake(period=4)
+        pool = BlockPool(17, 4)
+        sched = ContinuousBatchingScheduler(
+            ex, 1, pool, 8, speculative=spec, draft_len=4, draft_ngram=2)
+        sched.submit(Request(rid=1, prompt=prompt, max_new_tokens=8))
+        return sched, ex, pool
+
+    sched, ex, pool = run(True)
+    sched.step()                # prefill + first verify round (merged)
+    st = sched.spec_stats()
+    assert st["rounds"] == 1 and st["rejected_tokens"] == st["drafted_tokens"]
+    assert st["drafted_tokens"] >= 1
+    # Rollback trimmed the speculative tail the same step: only the
+    # blocks covering the true sequence remain allocated.
+    seq = len(prompt) + 1                        # prompt + 1 verified token
+    assert pool.num_allocated == blocks_for(seq, 4)
+    spec_tokens = drain(sched)[0].tokens
+
+    sched2, _, pool2 = run(False)
+    plain_tokens = drain(sched2)[0].tokens
+    np.testing.assert_array_equal(spec_tokens, plain_tokens)
+    assert pool.num_allocated == 0 and pool2.num_allocated == 0
+    sched.audit(context="post-spec-reject")
+
+
+def test_spec_sampled_slots_never_draft():
+    """temperature > 0 slots ride as plain 1-token rows — drafting is
+    greedy-only (verification is argmax). A repetitive prompt that
+    WOULD draft under greedy proposes nothing when sampled."""
+    ex = PeriodicFake(period=4)
+    sched, ex, pool = make_spec(executor=ex)
+    sched.submit(_cycle_req(1, gen=6, temperature=0.7))
+    drain(sched)
+    st = sched.spec_stats()
+    assert st["drafted_tokens"] == 0 and st["plain_rows"] == 5
+    for tokens, q_lens, spec_lens in ex.verify_calls:
+        assert int(spec_lens.sum()) == 0 and int(q_lens.max()) == 1
+
+
+def test_spec_drafts_compete_with_prefill_budget():
+    """Chunked mode: while a prefill is consuming the whole token
+    budget, co-resident decode slots get NO draft allowance (their
+    rows stay 1 token); drafting resumes once the budget frees up."""
+    ex = PeriodicFake(period=4)
+    sched, ex, pool = make_spec(executor=ex, chunk=4, num_slots=2)
+    sched.submit(_cycle_req(1, gen=8))
+    sched.submit(_cycle_req(2, reps=3, gen=4))   # 12-token prompt: 3 chunks
+    # Step until rid 2 finishes prefilling, watching rid 1's rows.
+    while sched.prefilling.any():
+        sched.step()
+    # Every verify round that carried a prefill assignment must have
+    # zero speculative length on ALL rows (budget fully consumed).
+    for tokens, q_lens, spec_lens in ex.verify_calls:
+        if tokens.shape[1] == 4:                 # prefill-chunk bucket
+            assert int(spec_lens.sum()) == 0
+    drain(sched)
+    st = sched.spec_stats()
+    assert st["drafted_tokens"] > 0              # resumed after prefill
+    assert pool.num_allocated == 0
+    sched.audit(context="post-spec-budget")
+
+
+def test_spec_row_width_capped_at_draft_len():
+    """Verify rounds without prefill assignments use the 1+draft_len
+    bucket — never wider — and every row's q_len fits it."""
+    ex = PeriodicFake(period=4)
+    sched, ex, pool = make_spec(executor=ex, draft_len=3)
+    sched.submit(_cycle_req(1, gen=9))
+    drain(sched)
+    assert len(ex.verify_calls) > 0
+    for tokens, q_lens, spec_lens in ex.verify_calls:
+        assert tokens.shape[1] in (1, 1 + 3)
+        assert int(q_lens.max()) <= 1 + 3
+        assert int(spec_lens.max()) <= 3

@@ -180,13 +180,93 @@ def test_generate_stream_yields_in_finish_order(llama_engine):
     assert finishes == sorted(finishes)
 
 
-def test_serve_speculative_raises(llama_engine):
-    """serve()/generate_stream() + speculative= must fail LOUDLY (the
-    paged path has no draft arena) — mirroring the generate() guard —
-    instead of silently serving non-speculatively."""
-    with pytest.raises(ValueError, match="non-speculative"):
+def test_serve_speculative_unknown_variant_raises(llama_engine):
+    """serve()/generate_stream() + an UNKNOWN speculative= variant must
+    fail LOUDLY, naming the supported variant — never silently serve
+    non-speculatively."""
+    with pytest.raises(ValueError, match="prompt_lookup"):
         llama_engine.serve(mixed_requests(1), num_slots=2, block_size=4,
-                           speculative="prompt_lookup")
+                           speculative="medusa")
+
+
+def repetitive_requests(n=4, seed=0):
+    """Prompts tiled from short unit patterns — prompt-lookup finds the
+    trailing n-gram repeatedly, so greedy continuations of the tiny
+    model get real (nonzero-acceptance) drafts; one mixed-entropy
+    prompt rides along as a low-acceptance control."""
+    rng = np.random.default_rng(seed)
+    units = [[5, 9, 17, 3, 11, 42, 7, 19], [23, 8, 61], [2, 4, 6, 8, 10]]
+    reqs = [Request(rid=i, prompt=np.tile(np.asarray(u, np.int32), 3),
+                    max_new_tokens=8)
+            for i, u in enumerate(units[:max(n - 1, 1)])]
+    if n > 1:
+        reqs.append(Request(rid=n - 1, prompt=rng.integers(1, 256, 11),
+                            max_new_tokens=6))
+    return reqs
+
+
+@pytest.mark.parametrize("chunk", [0, 6], ids=["legacy", "chunked"])
+def test_serve_speculative_greedy_exact_vs_off_and_generate(
+        llama_engine, serve_attn_kernel, chunk):
+    """THE speculative pin, on BOTH attention arms and BOTH prefill
+    modes: prompt-lookup drafts verified through the ragged program
+    emit byte-identical streams to the speculative-off run and to
+    generate() — speculation is scheduling, not output — while the
+    acceptance counters show real drafting happened."""
+    kw = dict(num_slots=2, block_size=4, attn_kernel=serve_attn_kernel,
+              prefill_chunk_tokens=chunk)
+    off = {c.rid: c for c in llama_engine.serve(
+        repetitive_requests(), **kw)}
+    on = {c.rid: c for c in llama_engine.serve(
+        repetitive_requests(), speculative="prompt_lookup", draft_len=4,
+        **kw)}
+    assert all(c.ok for c in on.values())
+    for rid, c in on.items():
+        np.testing.assert_array_equal(c.tokens, off[rid].tokens)
+    assert_greedy_parity(llama_engine, on.values())
+    st = llama_engine.last_serve_scheduler.spec_stats()
+    assert st["enabled"] and st["drafted_tokens"] > 0
+    assert st["accepted_tokens"] > 0
+    # Delivered-token bookkeeping identity (what bench cross-checks).
+    decode_tokens = sum(len(c.tokens) for c in on.values()) - len(on)
+    assert decode_tokens == (st["plain_rows"] + st["rounds"]
+                             + st["accepted_tokens"])
+
+
+def test_serve_speculative_sampled_neighbors_unperturbed(llama_engine):
+    """A seeded SAMPLED request co-scheduled with speculating greedy
+    slots streams byte-identically to the speculative-off run: sampled
+    slots never draft, ride as plain 1-token rows in the widened
+    bucket, and their rng advances once per emitted token."""
+    def reqs():
+        r = repetitive_requests(3, seed=9)
+        r.append(Request(rid=3, prompt=np.tile([13, 44, 7], 4),
+                         max_new_tokens=6, temperature=0.8, top_k=12,
+                         seed=123))
+        return r
+
+    off = {c.rid: c for c in llama_engine.serve(
+        reqs(), num_slots=2, block_size=4)}
+    on = {c.rid: c for c in llama_engine.serve(
+        reqs(), num_slots=2, block_size=4, speculative="prompt_lookup")}
+    assert all(c.ok for c in on.values())
+    for rid, c in on.items():
+        np.testing.assert_array_equal(c.tokens, off[rid].tokens)
+    st = llama_engine.last_serve_scheduler.spec_stats()
+    assert st["drafted_tokens"] > 0    # greedy slots did speculate
+
+
+def test_serve_speculative_off_spellings_serve_plainly(llama_engine):
+    """'off'/'none'/'' and None all disable speculation (no verify
+    program is built) while serving the exact greedy streams."""
+    for spelling in ("off", "none", "", None):
+        comps = llama_engine.serve(
+            repetitive_requests(2), num_slots=2, block_size=4,
+            speculative=spelling)
+        assert all(c.ok for c in comps)
+        sched = llama_engine.last_serve_scheduler
+        assert not sched.spec
+    assert_greedy_parity(llama_engine, comps)
 
 
 def test_serve_rejects_unknown_attn_kernel(llama_engine):

@@ -9,7 +9,70 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu import init_inference
+from deepspeed_tpu.inference.speculative import (ngram_lookup,
+                                                 propose_ngram_draft)
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+class TestNgramLookupHelper:
+    """The shared lookup used by BOTH the batch-1 traced loop and the
+    serving scheduler's host proposer — semantics pinned directly."""
+
+    def test_found_latest_occurrence(self):
+        # tail [1, 2] occurs at j=0 and j=3 — the LATEST match wins
+        hist = [1, 2, 7, 1, 2, 8, 1, 2]
+        d = propose_ngram_draft(hist, k=2, ngram=2)
+        np.testing.assert_array_equal(d, [8, 1])
+
+    def test_not_found_returns_empty(self):
+        assert propose_ngram_draft([1, 2, 3, 4, 5], k=4, ngram=2).size == 0
+
+    def test_history_too_short_returns_empty(self):
+        assert propose_ngram_draft([7, 7], k=4, ngram=2).size == 0
+        assert propose_ngram_draft([], k=4, ngram=2).size == 0
+        assert propose_ngram_draft([1, 2, 3], k=0, ngram=2).size == 0
+
+    def test_periodic_extension_near_history_end(self):
+        # match continuation runs into the history end — the tail is
+        # periodic with period n - start, and the draft keeps copying
+        # the cycle to fill all k slots (a constant/looped tail would
+        # otherwise never draft more than the one real token left)
+        hist = [5, 9, 3, 5, 9]
+        d = propose_ngram_draft(hist, k=6, ngram=2)
+        np.testing.assert_array_equal(d, [3, 5, 9, 3, 5, 9])
+
+    def test_constant_tail_drafts_full_k(self):
+        # the degenerate loop: trailing [7,7] matches one step back, so
+        # the period is 1 and the whole draft is 7s
+        d = propose_ngram_draft([3, 7, 7, 7], k=5, ngram=2)
+        np.testing.assert_array_equal(d, [7, 7, 7, 7, 7])
+
+    def test_ngram_3(self):
+        hist = [4, 5, 6, 1, 4, 5, 6, 9, 4, 5, 6]
+        d = propose_ngram_draft(hist, k=2, ngram=3)
+        # latest strictly-earlier [4,5,6] is at j=4 -> continuation [9, 4]
+        np.testing.assert_array_equal(d, [9, 4])
+        # ngram=2 tail [5,6] also matches at j=5 -> continuation [9, 4]
+        np.testing.assert_array_equal(
+            propose_ngram_draft(hist, k=2, ngram=2), [9, 4])
+
+    def test_traced_matches_host_on_found(self):
+        hist = np.array([1, 2, 7, 1, 2, 8, 1, 2, 0, 0, 0, 0], np.int32)
+        count = 8
+        found, draft = jax.jit(ngram_lookup, static_argnums=(2, 3))(
+            jnp.asarray(hist), jnp.asarray(count, jnp.int32), 3, 2)
+        assert bool(found)
+        host = propose_ngram_draft(hist[:count], k=3, ngram=2)
+        # both residences now share the full semantics including the
+        # periodic extension, so the drafts are EQUAL on found
+        np.testing.assert_array_equal(np.asarray(draft), host)
+
+    def test_traced_not_found_flag(self):
+        hist = np.zeros(10, np.int32)
+        hist[:5] = [3, 1, 4, 1, 5]
+        found, _ = jax.jit(ngram_lookup, static_argnums=(2, 3))(
+            jnp.asarray(hist), jnp.asarray(5, jnp.int32), 4, 2)
+        assert not bool(found)
 
 
 @pytest.fixture(scope="module")

@@ -1291,6 +1291,391 @@ def serve_prefix_main(num_slots=None, trace_seed=None,
     return result
 
 
+def _serve_multichip_impl(n_devices, out_path):
+    """Child body of ``--serve --multichip`` (spawned by
+    ``__graft_entry__.serve_multichip`` onto an ``n_devices`` virtual
+    CPU mesh — same subprocess bootstrap as the training telemetry
+    bench). Three legs, one process:
+
+    - **TP=2 fp32**: the shard_map'd serving executor
+      (inference/tp_shard.py — heads + KV pools on the ``tensor`` axis,
+      row/column-parallel MLP, one psum per residual boundary) serves a
+      greedy trace; its token streams must be BYTE-IDENTICAL to a
+      single-device engine on the same weights/trace.
+    - **TP=2 int8**: the quantized-collective arm
+      (``serve.tp_collective="int8"``): greedy streams are compared to
+      fp32 per request (longest-common-prefix fraction), and an eager
+      wire-byte A/B cross-checks the measured ``comm.*.bytes`` counters
+      against the static ``collective_cost`` table — the same
+      ``quantized_psum`` entry the dstlint SPMD budgets price.
+    - **DP=2 replica group**: a :class:`ReplicaGroup` behind ONE
+      admission queue on a hot-prefix-family trace sized so a single
+      replica's pool cannot cache the full working set (device-LRU
+      thrash -> full re-prefill per request) while prefix-affinity
+      routing lands each family on one replica whose pool CAN hold its
+      half (tail-only prefill). The aggregate-throughput win is real
+      prefill compute skipped — measurable even on a single host core,
+      where replicas timeshare the CPU and pure compute replication
+      nets ~1.0x. On real multi-chip hosts compute parallelism
+      multiplies on top; the artifact records ``host_cpus`` so readers
+      can tell which regime they're looking at.
+
+    Writes the leg results as JSON to ``out_path`` and asserts the
+    acceptance gates (parity, wire ratio, DP speedup) in-process.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.comm.collective_cost import wire_bytes
+    from deepspeed_tpu.inference.replica import ReplicaGroup
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    from deepspeed_tpu.observability.metrics import MetricsRegistry
+    from deepspeed_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    assert len(devs) >= 2, f"need >=2 virtual devices, got {devs}"
+    # the --serve CPU bench model, scan_layers=True: the TP executor
+    # shards the FUSED scan stack (one stacked qkv/gateup per layer
+    # group), and scan keeps all arms on the same compiled structure
+    cfg = LlamaConfig(
+        vocab_size=4096, hidden_size=512, intermediate_size=1024,
+        num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=512,
+        dtype=jnp.float32, scan_layers=True)
+    block_size = 8
+    model = LlamaModel(cfg)
+    params = jax.jit(
+        lambda r: model.init(
+            r, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(0))
+
+    one_chip = {"pipe": 1, "data": 1, "expert": 1, "sequence": 1,
+                "tensor": 1}
+
+    def single_engine(dev):
+        return deepspeed_tpu.init_inference(
+            model=model, params=params, model_config=cfg,
+            config={"dtype": "float32"},
+            mesh=make_mesh(dims=dict(one_chip), devices=[dev]))
+
+    # ---- leg 1+2: TP=2 vs single-device, fp32 and int8 collectives ------
+    tp_rng = np.random.default_rng(11)
+    tp_trace = [(tp_rng.integers(1, cfg.vocab_size,
+                                 (6, 10, 17, 25)[i % 4]),
+                 (8, 12)[i % 2]) for i in range(8)]
+
+    def run_tp_arm(engine, timed):
+        reqs = [Request(rid=i, prompt=p, max_new_tokens=g)
+                for i, (p, g) in enumerate(tp_trace)]
+        t0 = time.time()
+        comps = engine.serve(reqs, num_slots=2, block_size=block_size,
+                             decode_chunk=8, attn_kernel="reference")
+        wall = time.time() - t0
+        toks = {c.rid: [int(t) for t in np.asarray(c.tokens)]
+                for c in comps}
+        # the scheduler degrades trace-time errors into empty
+        # completions — parity MUST compare token content, so empty
+        # streams are a hard failure, not a vacuous pass
+        assert all(len(v) > 0 for v in toks.values()), \
+            f"empty token streams: { {k: len(v) for k, v in toks.items()} }"
+        assert all(c.status == "COMPLETED" for c in comps)
+        return toks, wall, sum(len(v) for v in toks.values())
+
+    arms = {}
+    eng_1dev = single_engine(devs[0])
+    run_tp_arm(eng_1dev, timed=False)                    # compile warm
+    toks_1dev, wall_1dev, ntok_1dev = run_tp_arm(eng_1dev, timed=True)
+    arms["single_device"] = {"wall_s": round(wall_1dev, 3),
+                             "tok_s": round(ntok_1dev / wall_1dev, 1)}
+
+    eng_tp = deepspeed_tpu.init_inference(
+        model=model, params=params, model_config=cfg,
+        config={"dtype": "float32", "tensor_parallel": {"tp_size": 2}})
+    run_tp_arm(eng_tp, timed=False)
+    toks_tp, wall_tp, ntok_tp = run_tp_arm(eng_tp, timed=True)
+    fp32_identical = toks_tp == toks_1dev
+    assert fp32_identical, (
+        "TP=2 fp32 greedy streams diverged from single-device: "
+        f"{ {r: (toks_1dev[r], toks_tp[r]) for r in toks_1dev if toks_1dev[r] != toks_tp.get(r)} }")
+    arms["tp2_fp32"] = {"wall_s": round(wall_tp, 3),
+                        "tok_s": round(ntok_tp / wall_tp, 1),
+                        "greedy_identical_to_single_device": True}
+
+    eng_int8 = deepspeed_tpu.init_inference(
+        model=model, params=params, model_config=cfg,
+        config={"dtype": "float32", "tensor_parallel": {"tp_size": 2},
+                "serve": {"tp_collective": "int8"}})
+    run_tp_arm(eng_int8, timed=False)
+    toks_int8, wall_int8, ntok_int8 = run_tp_arm(eng_int8, timed=True)
+
+    def lcp_frac(a, b):
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        return n / max(len(a), len(b), 1)
+    agree = [lcp_frac(toks_tp[r], toks_int8[r]) for r in sorted(toks_tp)]
+    mean_agree = float(np.mean(agree))
+    # int8 rounding perturbs logits by ~1e-2 at this scale; greedy
+    # argmax flips only where the fp32 margin is that small, so long
+    # common prefixes are the expected shape — a LOW mean means the
+    # quantized ring is broken, not merely noisy
+    assert mean_agree >= 0.5, f"int8 greedy agreement collapsed: {agree}"
+    arms["tp2_int8"] = {"wall_s": round(wall_int8, 3),
+                        "tok_s": round(ntok_int8 / wall_int8, 1),
+                        "greedy_prefix_agreement_vs_fp32": {
+                            "mean": round(mean_agree, 3),
+                            "min": round(min(agree), 3),
+                            "per_request": [round(a, 3) for a in agree]}}
+
+    # ---- wire bytes: measured counters vs the static table --------------
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    reg = MetricsRegistry()
+    prev_reg = comm.get_metrics_registry()
+    comm.set_metrics_registry(reg)
+    try:
+        mesh = eng_tp.mesh
+        x = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(3), (4, 512),
+                              jnp.float32),
+            NamedSharding(mesh, PartitionSpec("tensor")))
+        out_fp = comm.eager_all_reduce_over_mesh(x, mesh, axis="tensor")
+        out_q = comm.eager_quantized_all_reduce_over_mesh(
+            x, mesh, axis="tensor")
+        a = np.asarray(out_fp, np.float64).ravel()
+        b = np.asarray(out_q, np.float64).ravel()
+        cosine = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+        max_abs_err = float(np.abs(a - b).max())
+        counters = reg.counters()
+    finally:
+        comm.set_metrics_registry(prev_reg)
+    payload = 4 * 512 * 4
+    static_fp = wire_bytes("psum", payload, 2)
+    static_q = wire_bytes("quantized_psum", payload, 2)
+    measured_fp = int(counters["comm.all_reduce.bytes"])
+    measured_q = int(counters["comm.quantized_all_reduce.bytes"])
+    assert measured_fp == static_fp, (measured_fp, static_fp)
+    assert measured_q == static_q, (measured_q, static_q)
+    ratio = measured_q / measured_fp
+    assert ratio <= 0.30, f"int8 wire ratio {ratio} > 0.30"
+    assert cosine >= 0.999, cosine
+
+    # per-decode-step budget cross-ref: the dstlint SPMD pass pins the
+    # same numbers for the traced TP decode step (serve_decode_tp2/*)
+    budgets = {}
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tools", "dstlint",
+                               "comms_budgets.json")) as f:
+            allb = json.load(f).get("entries", {})
+        budgets = {k: v for k, v in allb.items()
+                   if isinstance(k, str) and k.startswith("serve_decode_tp2")}
+    except (OSError, ValueError):
+        pass
+    assert {"serve_decode_tp2/fp32", "serve_decode_tp2/int8"} <= set(budgets), \
+        sorted(budgets)
+    collectives = {
+        "payload_bytes": payload,
+        "fp32": {"measured_wire_bytes": measured_fp,
+                 "static_wire_bytes": static_fp},
+        "int8": {"measured_wire_bytes": measured_q,
+                 "static_wire_bytes": static_q},
+        "wire_ratio_int8_vs_fp32": round(ratio, 4),
+        "measured_equals_static": True,
+        "numerics": {"cosine_vs_fp32": round(cosine, 6),
+                     "max_abs_err": round(max_abs_err, 6)},
+        "spmd_decode_budgets": budgets,
+    }
+
+    # ---- leg 3: DP replica group vs one replica (same per-replica cfg) --
+    # 4 hot prefix families / 44-block pool: a family's 12 cached prefix
+    # blocks survive only until the pool needs them — with 4 families
+    # rotating through 2 slots, the 3 intervening full prefills (~45
+    # blocks) evict a parked family before its next request (miss ->
+    # full 104-token prefill). Affinity routing gives each group replica
+    # 2 families (<= its slot count): the completion->admission handoff
+    # keeps both prefixes resident (hit -> 8-token tail prefill).
+    n_fam, n_cont, persona_len, suffix_len, gen_len = 4, 5, 96, 8, 8
+    dp_kwargs = dict(num_slots=2, block_size=block_size, num_blocks=44,
+                     decode_chunk=16, attn_kernel="reference",
+                     prefix_cache=True)
+    fam_rng = np.random.default_rng(7)
+    personas = [fam_rng.integers(1, cfg.vocab_size, persona_len)
+                for _ in range(n_fam)]
+    dp_reqs_spec = []
+    for c in range(n_cont):
+        for f in range(n_fam):                     # strict A,B,C,D rotation
+            dp_reqs_spec.append(np.concatenate(
+                [personas[f],
+                 fam_rng.integers(1, cfg.vocab_size, suffix_len)]))
+
+    def dp_requests():
+        return [Request(rid=i, prompt=p, max_new_tokens=gen_len)
+                for i, p in enumerate(dp_reqs_spec)]
+
+    def run_dp(serve_fn, engines, timed):
+        for e in engines:
+            e.reset_prefix_cache()                 # every run starts COLD
+        t0 = time.time()
+        comps = serve_fn(dp_requests())
+        wall = time.time() - t0
+        toks = {c.rid: [int(t) for t in np.asarray(c.tokens)]
+                for c in comps}
+        assert all(c.status == "COMPLETED" for c in comps)
+        assert all(len(v) > 0 for v in toks.values())
+        stats = [e.last_serve_scheduler.prefix_cache_stats()
+                 for e in engines]
+        return toks, wall, sum(len(v) for v in toks.values()), stats
+
+    eng_base = single_engine(devs[0])
+    base_serve = lambda reqs: eng_base.serve(reqs, **dp_kwargs)
+    run_dp(base_serve, [eng_base], timed=False)    # warm (cold buckets)
+    run_dp(base_serve, [eng_base], timed=False)    # warm (hit-tail bucket)
+    toks_base, wall_base, ntok_base, stats_base = run_dp(
+        base_serve, [eng_base], timed=True)
+
+    fleet_dir = tempfile.mkdtemp(prefix="bench_serve_fleet_")
+    group = ReplicaGroup([single_engine(devs[0]), single_engine(devs[1])],
+                         fleet_dir=fleet_dir)
+    grp_serve = lambda reqs: group.serve(reqs, **dp_kwargs)
+    run_dp(grp_serve, group.engines, timed=False)
+    run_dp(grp_serve, group.engines, timed=False)
+    toks_grp, wall_grp, ntok_grp, stats_grp = run_dp(
+        grp_serve, group.engines, timed=True)
+
+    # routing must be a pure perf layer: greedy streams byte-identical
+    assert toks_grp == toks_base, "DP routing changed greedy outputs"
+    speedup = (ntok_grp / wall_grp) / (ntok_base / wall_base)
+    assignment = [len(a) for a in group.last_assignment]
+    assert len(assignment) >= 2 and min(assignment) > 0, assignment
+    try:
+        host_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        host_cpus = os.cpu_count() or 1
+    parallel_host = host_cpus >= 2
+    base_hit = stats_base[0]["block_hit_rate"]
+    grp_hit = min(s["block_hit_rate"] for s in stats_grp)
+    per_replica = {}
+    for i, assigned in enumerate(group.last_assignment):
+        rids = [r.rid for r in assigned]
+        t = sum(len(toks_grp[r]) for r in rids)
+        per_replica[f"replica{i}"] = {
+            "requests": len(rids), "tokens": t,
+            "tok_s": round(t / wall_grp, 1),
+            "cache_stats": stats_grp[i]}
+    merged = group.fleet_view()
+    snap = merged.snapshot() if hasattr(merged, "snapshot") else {}
+    replicas = {
+        "n_replicas": len(group.engines),
+        "single_replica": {"wall_s": round(wall_base, 3),
+                           "tok_s": round(ntok_base / wall_base, 1),
+                           "cache_stats": stats_base[0]},
+        "group": {"wall_s": round(wall_grp, 3),
+                  "tok_s": round(ntok_grp / wall_grp, 1),
+                  "per_replica": per_replica},
+        "aggregate_speedup_x": round(speedup, 3),
+        "greedy_identical_to_single_replica": True,
+        "fleet": {k: v for k, v in snap.get("gauges", {}).items()
+                  if k.startswith("fleet.")},
+        "replica_labels": snap.get("labeled_gauges", {}).get(
+            "fleet.replica", {}),
+        "mechanism": (
+            "aggregate KV/prefix-cache capacity + affinity routing: the "
+            "single replica's device LRU evicts each prefix family "
+            "between uses (full re-prefill); each group replica holds "
+            "its routed families resident (tail-only prefill). On a "
+            "multi-core host compute replication adds on top."),
+        "host_cpus": host_cpus,
+        "serialized_host": not parallel_host,
+        "prefill_tokens_saved_x": round(
+            max(stats_base[0]["prompt_tokens"]
+                - stats_base[0]["hit_tokens"], 1)
+            / max(sum(s["prompt_tokens"] - s["hit_tokens"]
+                      for s in stats_grp), 1), 2),
+    }
+    # the capacity-relief mechanism must engage regardless of host shape:
+    # the lone replica thrashes (low hit rate, forced evictions), every
+    # group replica's working set stays resident, and routing never
+    # regresses throughput
+    assert grp_hit >= 0.6 and base_hit <= 0.35, (grp_hit, base_hit)
+    assert stats_base[0]["evictions"] > 0
+    assert all(s["evictions"] == 0 for s in stats_grp), stats_grp
+    assert speedup >= 0.95, f"DP routing regressed throughput: {speedup:.2f}x"
+    if parallel_host:
+        # replicas genuinely overlap only when the host has cores to
+        # run them on; a 1-CPU host timeshares every dispatch, so the
+        # aggregate criterion applies to parallel hosts and the
+        # artifact records the serialized measurement transparently
+        assert speedup > 1.5, (
+            f"DP aggregate speedup {speedup:.2f}x <= 1.5x "
+            f"(base {ntok_base / wall_base:.1f} tok/s, "
+            f"group {ntok_grp / wall_grp:.1f} tok/s)")
+
+    result = {
+        "n_devices": len(devs),
+        "backend": jax.default_backend(),
+        "model": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
+                  "heads": cfg.num_heads, "scan_layers": True},
+        "tp": arms,
+        "collectives": collectives,
+        "replicas": replicas,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
+def serve_multichip_main(out_path="BENCH_SERVE.json"):
+    """--serve --multichip: tensor-parallel + data-parallel serving on
+    a 2-virtual-chip CPU mesh. The measurement runs in a subprocess
+    with ``--xla_force_host_platform_device_count=2`` (the same
+    ``__graft_entry__`` bootstrap the training multichip bench uses);
+    see :func:`_serve_multichip_impl` for the three legs. Results merge
+    into BENCH_SERVE.json under ``detail.serve_multichip`` (the
+    single-chip serve sections stay), and the raw child artifact lands
+    in BENCH_SERVE_MULTICHIP.json."""
+    import __graft_entry__ as g
+
+    child_out = "BENCH_SERVE_MULTICHIP.json"
+    g.serve_multichip(2, child_out)
+    with open(child_out) as f:
+        res = json.load(f)
+    # the child already asserted; re-check the headline gates so a stale
+    # artifact can't masquerade as a pass
+    assert res["tp"]["tp2_fp32"]["greedy_identical_to_single_device"]
+    assert res["collectives"]["wire_ratio_int8_vs_fp32"] <= 0.30
+    assert res["collectives"]["measured_equals_static"]
+    assert res["replicas"]["n_replicas"] >= 2
+    if not res["replicas"]["serialized_host"]:
+        assert res["replicas"]["aggregate_speedup_x"] > 1.5
+    assert res["replicas"]["aggregate_speedup_x"] >= 0.95
+    result = {
+        "metric": "serve_multichip_dp_aggregate_speedup_x",
+        "value": res["replicas"]["aggregate_speedup_x"],
+        "unit": "x",
+        "vs_baseline": res["collectives"]["wire_ratio_int8_vs_fp32"],
+        "detail": res,
+    }
+    print(json.dumps(result))
+    if out_path:
+        artifact = {}
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            pass
+        artifact.setdefault("detail", {})["serve_multichip"] = res
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return result
+
+
 def serve_speculative_main(num_slots=None, trace_seed=None, kernel=None,
                            out_path="BENCH_SERVE.json"):
     """--serve --speculative: prompt-lookup speculative decoding A/B on
@@ -2765,7 +3150,9 @@ if __name__ == "__main__":
                 sys.exit("--kernel requires reference|pallas|both, e.g. "
                          "bench.py --serve --kernel pallas")
             kernels = None if arm == "both" else [arm]
-        if "--chaos" in sys.argv:
+        if "--multichip" in sys.argv:
+            serve_multichip_main()
+        elif "--chaos" in sys.argv:
             serve_chaos_main(seed=_intflag("--seed"))
         elif "--speculative" in sys.argv:
             serve_speculative_main(num_slots=_intflag("--slots"),

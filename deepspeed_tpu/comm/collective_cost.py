@@ -24,6 +24,7 @@ all_gather      this device's input shard       p * (n-1)
 all_to_all      this device's full input        p * (n-1) / n
 ppermute        the permuted value              p
 broadcast       the value                       p
+quantized_psum  the fp32 reduced value          see below
 shard/reshard   constraint boundary (no wire)   0
 ==============  =============================  =========================
 
@@ -31,9 +32,23 @@ shard/reshard   constraint boundary (no wire)   0
 all-reduce; ``all_gather`` is priced from the INPUT shard (each device
 receives n-1 foreign shards of that size); ``ppermute`` sends the whole
 value exactly once regardless of group size.
+
+``quantized_psum`` is the EQuARX-style int8 quantized ring all-reduce
+(``comm.quantized_all_reduce``): 2(n-1) point-to-point hops per device,
+each carrying the per-shard int8 payload plus one fp32 scale per
+``QUANT_CHUNK``-element chunk. Its jaxpr decomposes into plain
+``ppermute`` equations, so the SPMD pass prices the hops individually;
+:func:`quantized_ring_wire_bytes` is the closed form the two accountings
+share (the sum of those hop prices), exposed through ``wire_bytes`` for
+the measured side.
 """
 
 from typing import Optional
+
+#: elements per quantization chunk (one fp32 scale per chunk) — shared
+#: by the runtime collective and the static pricing so the overhead
+#: term (4/chunk per element) cannot drift between the two accountings
+QUANT_CHUNK = 256
 
 #: collective kinds the table prices; anything else costs 0 wire bytes
 REDUCTION_KINDS = ("psum", "pmax", "pmin", "reduce_scatter")
@@ -75,7 +90,33 @@ def wire_bytes(kind: str, payload_bytes: int, group_size: int) -> int:
         return p
     if kind == "broadcast":
         return p
+    if kind == "quantized_psum":
+        return quantized_ring_wire_bytes(p, n)
     return 0
+
+
+def quantized_ring_wire_bytes(payload_bytes: int, group_size: int,
+                              chunk: int = QUANT_CHUNK,
+                              elem_bytes: int = 4,
+                              scale_bytes: int = 4) -> int:
+    """Per-device wire bytes of the int8 quantized ring all-reduce for a
+    ``payload_bytes`` fp32 value on a ``group_size``-member group.
+
+    The ring pads the flat value to ``n`` equal shards of a ``chunk``
+    multiple, then runs n-1 reduce-scatter hops + n-1 all-gather hops;
+    every hop moves the int8 shard (1 byte/element) plus one fp32 scale
+    per chunk: ``2(n-1) * per * (1 + scale_bytes/chunk)`` vs the fp32
+    ring's ``2 * p * (n-1)/n`` — a ~(1+4/chunk)/elem_bytes ≈ 0.25x
+    payload ratio at chunk=256."""
+    n = int(group_size)
+    p = int(payload_bytes)
+    if n <= 1 or p <= 0:
+        return 0
+    elems = max(-(-p // elem_bytes), 1)
+    per = -(-elems // n)                 # ceil: elements per shard
+    per = -(-per // chunk) * chunk       # rounded up to a chunk multiple
+    hop = per + (per // chunk) * scale_bytes
+    return 2 * (n - 1) * hop
 
 
 def payload_bytes_from_shape(shape, dtype) -> int:

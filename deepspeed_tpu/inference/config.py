@@ -258,6 +258,20 @@ class ServeConfig(DeepSpeedConfigModel):
     # this replica's rank in the fleet exchange; -1 = resolve from the
     # DS_TPU_PROCESS_ID env (the launcher contract) else jax.process_index()
     fleet_rank: int = -1
+    # data-parallel replica id this engine serves as (the DP grouping in
+    # the fleet view, distinct from fleet_rank which may number TP group
+    # members): when set, fleet snapshots carry a `replica` label so
+    # `bin/dst top` separates TP groups from DP replicas in the merged
+    # view. None = not a replica-group member (no label).
+    fleet_replica: Optional[int] = None
+    # --- tensor-parallel serving (docs/SERVING.md "Multi-chip serving") --
+    # residual-boundary all-reduce arm when the engine mesh has a tensor
+    # axis > 1: "fp32" = exact lax.psum; "int8" = the EQuARX-style
+    # per-chunk quantized ring (comm.quantized_all_reduce) — ~0.25x the
+    # wire bytes at a bounded numerics cost (the A/B thresholds live in
+    # bench.py --serve --multichip; the dtype boundary is allow-listed
+    # in the dstlint SPMD budgets, not exempted).
+    tp_collective: str = "fp32"
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -2053,7 +2053,9 @@ class InferenceEngine:
         )
 
         write_rank_snapshot(serve_cfg.fleet_dir, self._fleet_rank(),
-                            self.metrics)
+                            self.metrics,
+                            replica=getattr(serve_cfg, "fleet_replica",
+                                            None))
         return merge_fleet_dir(serve_cfg.fleet_dir)
 
     def serve_metrics(self, format: str = "dict", fleet: bool = False):
@@ -2220,8 +2222,10 @@ class InferenceEngine:
         """
         cfg = self.model_config
         kv8 = self._config.quant.kv_cache
+        tp = int(self.mesh.shape.get("tensor", 1))
+        tp_collective = self._config.serve.tp_collective
         key = (num_slots, block_size, num_blocks, decode_chunk, kv8,
-               attn_kernel)
+               attn_kernel, tp, tp_collective)
         cache = getattr(self, "_serve_executors", None)
         if cache is None:
             cache = self._serve_executors = OrderedDict()
@@ -2252,16 +2256,56 @@ class InferenceEngine:
         if self._pre_quantized or self._pre_fused:
             # offline trees are already in the fused layout
             transform = None
+        if tp > 1:
+            # tensor-parallel serving (inference/tp_shard.py): Megatron
+            # head/contraction split of the fused decoder, activations
+            # replicated, two all-reduces per layer at the residual
+            # boundaries. Fused scan-Llama dense weights only.
+            from deepspeed_tpu.inference import tp_shard
+
+            if decoder is None:
+                raise ValueError(
+                    "tensor-parallel serving requires the fused "
+                    "scan-Llama decode path (a scan-stacked LlamaConfig "
+                    "model)")
+            if self._quantized or self._pre_quantized:
+                raise ValueError(
+                    "tensor-parallel serving does not compose with int8 "
+                    "weight quantization (quant.enabled) — the sharded "
+                    "decoder streams dense weights; disable one of the "
+                    "two")
+            tp_shard.check_tp_compatible(cfg, tp)
         params_fn, _ = self._decode_params_fn(transform)
         cache_dtype = getattr(cfg, "dtype", None) or self.dtype
         with self._ctx():
             # materialize the decode tree ONCE for the session — serving
             # runs many small programs, so a per-call transform (the
             # generate() pattern) would re-fuse/dequantize every step
-            serve_params = (self.params if params_fn is None
-                            else jax.jit(params_fn)(self.params))
-            pools = init_pools(cfg, num_blocks, block_size, cache_dtype,
-                               int8=kv8)
+            if tp > 1:
+                base_fn = params_fn if params_fn is not None else (
+                    lambda p: p)
+                perm_fn = lambda p: tp_shard.permute_fused_params_for_tp(
+                    base_fn(p), cfg, tp)
+                abstract = jax.eval_shape(perm_fn, self.params)
+                specs = tp_shard.fused_param_specs(abstract)
+                serve_params = jax.jit(
+                    perm_fn,
+                    out_shardings=tp_shard.tp_shardings(self.mesh, specs),
+                )(self.params)
+                pools = init_pools(cfg, num_blocks, block_size,
+                                   cache_dtype, int8=kv8)
+                pools = tuple(
+                    jax.device_put(p, s)
+                    for p, s in zip(pools, tp_shard.tp_shardings(
+                        self.mesh, tp_shard.pool_specs(pools))))
+                paged_apply = tp_shard.make_tp_paged_apply(
+                    decoder, self.mesh, tp, collective=tp_collective,
+                    param_specs=specs)
+            else:
+                serve_params = (self.params if params_fn is None
+                                else jax.jit(params_fn)(self.params))
+                pools = init_pools(cfg, num_blocks, block_size,
+                                   cache_dtype, int8=kv8)
         executor = PagedServeExecutor(
             paged_apply, serve_params, pools, cfg, self._ctx, num_slots,
             decode_chunk=decode_chunk, obs=self.compile_obs)

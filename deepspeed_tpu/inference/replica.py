@@ -1,0 +1,183 @@
+"""Data-parallel serving replica groups: N continuous-batching replicas
+behind ONE admission queue.
+
+Tensor parallelism (inference/tp_shard.py) scales a single decode step
+across chips; this module scales *request throughput* across engines —
+the DeepSpeed-Inference serving story's outer loop. Each replica is a
+full continuous-batching engine (its own executor, KV pool, scheduler,
+metrics registry); the group owns admission:
+
+- **Routing** is host-side and deterministic: a request goes to the
+  replica with the longest *prefix-affinity* hit (its prompt's leading
+  content-addressed KV blocks — ``kv_pool.block_content_keys``, the
+  same chained hashes the prefix cache indexes — were last routed
+  there), falling back to the least-loaded replica (outstanding
+  prompt+generation tokens). Affinity keeps shared-prefix traffic on
+  the replica whose prefix cache already holds the blocks; load keeps
+  the pools balanced when nothing is shared.
+- **Observability** rides the dstfleet exchange: after (and during) a
+  drain each replica's registry is published as ``rank<i>.json`` with
+  the ``replica`` label, so ``merge_fleet_dir`` / ``bin/dst top``
+  render per-replica goodput, skew and straggler warnings with zero
+  new collectives — the merge layer and straggler detector were built
+  to consume exactly these snapshots.
+
+The group is in-process (threads drive the per-replica schedulers;
+device programs release the GIL) — the shape the chaos tests and the
+virtual-CPU bench exercise. Multi-process replicas compose the same
+way: run one engine per process with ``serve.fleet_rank``/
+``serve.fleet_replica`` set and share the ``fleet_dir``.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["route_requests", "ReplicaGroup"]
+
+
+def route_requests(requests: Sequence, n_replicas: int,
+                   block_size: int = 16,
+                   affinity: Optional[List[set]] = None,
+                   loads: Optional[List[int]] = None,
+                   ) -> List[List[Any]]:
+    """Assign ``requests`` to ``n_replicas`` buckets by prefix affinity
+    then load (see module doc). Pure and deterministic — unit-testable
+    without engines. ``affinity``/``loads`` are per-replica state
+    (mutated in place) so successive admission waves keep their history;
+    None starts cold."""
+    from deepspeed_tpu.inference.kv_pool import block_content_keys
+
+    if n_replicas <= 0:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    affinity = affinity if affinity is not None else [
+        set() for _ in range(n_replicas)]
+    loads = loads if loads is not None else [0] * n_replicas
+    out: List[List[Any]] = [[] for _ in range(n_replicas)]
+    for r in requests:
+        prompt = getattr(r, "prompt", None)
+        if prompt is None and isinstance(r, dict):
+            prompt = r.get("prompt")
+        keys = (block_content_keys([int(t) for t in prompt], block_size)
+                if prompt is not None else [])
+        hits = []
+        for i in range(n_replicas):
+            n = 0
+            for k in keys:
+                if k not in affinity[i]:
+                    break
+                n += 1
+            hits.append(n)
+        best_hit = max(hits) if hits else 0
+        if best_hit > 0:
+            # longest shared prefix wins; ties go to the lighter replica
+            idx = min((i for i in range(n_replicas)
+                       if hits[i] == best_hit), key=lambda i: loads[i])
+        else:
+            idx = min(range(n_replicas), key=lambda i: loads[i])
+        out[idx].append(r)
+        affinity[idx].update(keys)
+        gen = getattr(r, "max_new_tokens", None)
+        if gen is None and isinstance(r, dict):
+            gen = r.get("max_new_tokens", 0)
+        loads[idx] += (len(keys) * block_size) + int(gen or 0)
+    return out
+
+
+class ReplicaGroup:
+    """N serving engines behind one admission queue (see module doc).
+
+    ``engines`` is a list of :class:`InferenceEngine` — typically built
+    from the same params/config (they may share the params pytree; each
+    builds its own serving executor and pool). ``fleet_dir`` turns on
+    the snapshot exchange: per-replica registries publish as
+    ``rank<i>.json`` tagged ``replica=i``."""
+
+    def __init__(self, engines: Sequence, fleet_dir: Optional[str] = None,
+                 hosts: Optional[Sequence[str]] = None):
+        if not engines:
+            raise ValueError("ReplicaGroup needs at least one engine")
+        self.engines = list(engines)
+        self.fleet_dir = fleet_dir
+        self.hosts = (list(hosts) if hosts is not None
+                      else [f"replica{i}" for i in range(len(engines))])
+        if len(self.hosts) != len(self.engines):
+            raise ValueError(
+                f"hosts ({len(self.hosts)}) must match engines "
+                f"({len(self.engines)})")
+        # routing state persists across serve() waves so prefix
+        # affinity survives between admission batches
+        self._affinity: List[set] = [set() for _ in self.engines]
+        self._loads: List[int] = [0] * len(self.engines)
+
+    def publish(self) -> None:
+        """Write every replica's registry snapshot into the fleet dir
+        (atomic rank files, ``replica``-labeled)."""
+        if not self.fleet_dir:
+            return
+        from deepspeed_tpu.observability.fleet import write_rank_snapshot
+
+        for i, (eng, host) in enumerate(zip(self.engines, self.hosts)):
+            write_rank_snapshot(self.fleet_dir, i, eng.metrics,
+                                host=host, replica=i)
+
+    def fleet_view(self):
+        """Publish + merge: the group's fleet-level registry."""
+        if not self.fleet_dir:
+            raise ValueError("fleet_view needs fleet_dir")
+        from deepspeed_tpu.observability.fleet import merge_fleet_dir
+
+        self.publish()
+        return merge_fleet_dir(self.fleet_dir)
+
+    def serve(self, requests: Sequence,
+              per_replica_kwargs: Optional[Dict[int, dict]] = None,
+              **serve_kwargs) -> List[Any]:
+        """Route ``requests`` across the replicas and drain them
+        concurrently (one thread per replica — scheduler work is
+        host-side; device programs release the GIL, and multi-process
+        deployments get true parallelism from the same routing).
+        Returns all completions in global finish order.
+
+        ``per_replica_kwargs`` overlays per-replica overrides on
+        ``serve_kwargs`` — the chaos harness injects a
+        ``fault_injector`` into one replica this way."""
+        block_size = int(serve_kwargs.get("block_size", 16))
+        assignment = route_requests(requests, len(self.engines),
+                                    block_size=block_size,
+                                    affinity=self._affinity,
+                                    loads=self._loads)
+        self.last_assignment = assignment
+        results: List[List[Any]] = [[] for _ in self.engines]
+        errors: List[Tuple[int, BaseException]] = []
+
+        def drain(i: int) -> None:
+            if not assignment[i]:
+                return
+            kw = dict(serve_kwargs)
+            if per_replica_kwargs and i in per_replica_kwargs:
+                kw.update(per_replica_kwargs[i])
+            try:
+                results[i] = self.engines[i].serve(assignment[i], **kw)
+            except BaseException as e:       # noqa: BLE001 — re-raised below
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=drain, args=(i,),
+                                    name=f"replica{i}", daemon=True)
+                   for i in range(len(self.engines))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.publish()
+        if errors:
+            i, e = errors[0]
+            if len(errors) > 1:
+                logger.error(
+                    f"replica group: {len(errors)} replicas failed; "
+                    f"raising the first (replica {i})")
+            raise e
+        done = [c for rs in results for c in rs]
+        done.sort(key=lambda c: getattr(c, "t_finish", 0.0))
+        return done

@@ -813,6 +813,15 @@ class FusedLlamaDecoderModel:
         # Pallas kernel (ops/paged_attention_kernel.py) for both dense
         # and int8 pools; "reference" is the jnp gather path
         self.paged_attn_kernel = "reference"
+        # tensor-parallel degree: >1 means this instance computes the
+        # Megatron shard of every layer — q/kv heads and MLP columns
+        # divided by tp_size (weights pre-permuted+sliced by
+        # inference/tp_shard.py), activations replicated — and
+        # ``tp_reduce`` (an all-reduce over the tensor axis, fp32 psum
+        # or comm.quantized_all_reduce) closes each layer's two
+        # row-parallel matmuls at the residual boundary
+        self.tp_size = 1
+        self.tp_reduce = None
 
     def _rms(self, x, scale):
         cfg = self.cfg
@@ -1064,8 +1073,17 @@ class FusedLlamaDecoderModel:
         cfg = self.cfg
         assert cfg.scan_layers, "fused decode expects scan-stacked params"
         B, T = input_ids.shape
-        n_kv = cfg.num_kv_heads or cfg.num_heads
+        # tensor parallelism: this body computes 1/tp of the heads and
+        # MLP columns (weights pre-sliced on those axes); activations
+        # (x, h) are replicated, and `reduce` closes the two row-parallel
+        # matmuls per layer so the residual stream stays replicated —
+        # everything downstream (norms, head, sampling) is unchanged
+        tp = self.tp_size
+        n_heads = cfg.num_heads // tp
+        n_kv = (cfg.num_kv_heads or cfg.num_heads) // tp
         hd = cfg.hidden_size // cfg.num_heads
+        reduce = self.tp_reduce if self.tp_reduce is not None else (
+            lambda y: y)
         emb = fused_params["embed_tokens"]["embedding"]
         x = emb[input_ids].astype(cfg.dtype)
         mm, rms = self._mm, self._rms
@@ -1075,15 +1093,15 @@ class FusedLlamaDecoderModel:
         def block(x, layer):
             h = rms(x, layer["input_norm"]["scale"])
             qkv = mm(h, layer["qkv_proj"])
-            q_sz = cfg.num_heads * hd
-            q = qkv[..., :q_sz].reshape(B, T, cfg.num_heads, hd)
+            q_sz = n_heads * hd
+            q = qkv[..., :q_sz].reshape(B, T, n_heads, hd)
             k = qkv[..., q_sz:q_sz + n_kv * hd].reshape(B, T, n_kv, hd)
             v = qkv[..., q_sz + n_kv * hd:].reshape(B, T, n_kv, hd)
             q = rotary_embedding(q, positions, cfg.rope_base)
             k = rotary_embedding(k, positions, cfg.rope_base)
             a, new_cache = attn_core(q, k, v, layer["_cache"])
             a = a.reshape(B, T, q_sz)
-            x = x + mm(a, layer["o_proj"])
+            x = x + reduce(mm(a, layer["o_proj"]))
             h = rms(x, layer["post_attn_norm"]["scale"])
             guw, dw = layer["gateup_proj"], layer["down_proj"]
             # B*T bound sized by the kernel's VMEM h-scratch
@@ -1109,11 +1127,11 @@ class FusedLlamaDecoderModel:
                 y = int8_mlp_fused(
                     h.reshape(B * T, h.shape[-1]), guw["q"], guw["scale"],
                     dw["q"], dw["scale"], out_dtype=cfg.dtype)
-                x = x + y.reshape(B, T, -1)
+                x = x + reduce(y.reshape(B, T, -1))
             else:
                 gu = mm(h, guw)
                 g, u = jnp.split(gu, 2, axis=-1)
-                x = x + mm(nn.silu(g) * u, dw)
+                x = x + reduce(mm(nn.silu(g) * u, dw))
             return x, new_cache
 
         def scan_body(x, layer_and_cache):

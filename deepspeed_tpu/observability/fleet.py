@@ -66,19 +66,25 @@ def resolve_fleet_rank(config_rank: int = -1) -> int:
 
 
 def write_rank_snapshot(fleet_dir: str, rank: int, registry,
-                        host: Optional[str] = None) -> str:
+                        host: Optional[str] = None,
+                        replica: Optional[int] = None) -> str:
     """Atomically publish this rank's ``fleet_snapshot`` as
     ``<fleet_dir>/rank<rank>.json`` (write to a tempfile in the same
     directory, then ``os.replace`` — readers can never observe a
     half-written file). ``registry`` is a :class:`MetricsRegistry` or an
-    already-built snapshot dict. Returns the file path."""
+    already-built snapshot dict. ``replica`` tags the snapshot with its
+    data-parallel replica id (see ``MetricsRegistry.fleet_snapshot``) so
+    the merged view can distinguish DP replicas from TP group members.
+    Returns the file path."""
     os.makedirs(fleet_dir, exist_ok=True)
     host = host if host is not None else f"rank{int(rank)}"
     if isinstance(registry, MetricsRegistry):
-        snap = registry.fleet_snapshot(host=host)
+        snap = registry.fleet_snapshot(host=host, replica=replica)
     else:
         snap = dict(registry)
         snap.setdefault("host", host)
+        if replica is not None:
+            snap.setdefault("replica", int(replica))
     path = os.path.join(fleet_dir, f"rank{int(rank)}.json")
     fd, tmp = tempfile.mkstemp(prefix=f".rank{int(rank)}.",
                                suffix=".tmp", dir=fleet_dir)

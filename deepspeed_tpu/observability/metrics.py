@@ -329,20 +329,30 @@ class MetricsRegistry:
     # --- fleet aggregation (observability/fleet.py) ---------------------------
     #: snapshot keys that are NOT collector sections
     CORE_KEYS = ("counters", "gauges", "histograms", "labeled_gauges",
-                 "host", "histogram_state")
+                 "host", "histogram_state", "replica")
 
-    def fleet_snapshot(self, host: Optional[str] = None) -> dict:
+    def fleet_snapshot(self, host: Optional[str] = None,
+                       replica: Optional[int] = None) -> dict:
         """:meth:`snapshot` plus the raw histogram bucket states and a
         host id — the per-rank payload of the fleet snapshot exchange
         (``fleet.write_rank_snapshot``). The summaries stay in for
         human/JSON consumers; :meth:`merge` reads ``histogram_state`` so
         the fleet merge is lossless instead of re-aggregating lossy
-        percentile summaries."""
+        percentile summaries.
+
+        ``replica`` tags the snapshot with its data-parallel replica id
+        (``serve.fleet_replica``): the merged view then carries a
+        host-labeled ``fleet.replica`` series, which is how ``bin/dst
+        top`` tells DP replicas apart from TP group members sharing a
+        fleet_dir (TP members share a replica id; DP replicas each get
+        their own)."""
         out = self.snapshot()
         out["histogram_state"] = {name: h.state()
                                   for name, h in self._hists.items()}
         if host is not None:
             out["host"] = str(host)
+        if replica is not None:
+            out["replica"] = int(replica)
         return out
 
     @classmethod
@@ -390,6 +400,12 @@ class MetricsRegistry:
             for name, series in snap.get("labeled_gauges", {}).items():
                 for lhost, v in series.items():
                     merged.set_labeled_gauge(name, lhost, v)
+            # replica tag → a per-host labeled series (+ distinct count
+            # below), so the merged view separates DP replicas from TP
+            # group members that share a replica id
+            if snap.get("replica") is not None:
+                merged.set_labeled_gauge("fleet.replica", host,
+                                         float(snap["replica"]))
             for section, data in snap.items():
                 if section in cls.CORE_KEYS or not isinstance(data, dict):
                     continue
@@ -404,6 +420,10 @@ class MetricsRegistry:
             merged.set_gauge(f"{name}.mean", sum(vals) / len(vals))
             merged.set_gauge(f"{name}.max", max(vals))
         merged.set_gauge("fleet.hosts", len(items))
+        replicas = {int(s.get("replica")) for _, s in items
+                    if s.get("replica") is not None}
+        if replicas:
+            merged.set_gauge("fleet.replicas", len(replicas))
         return merged
 
 

@@ -33,10 +33,14 @@ from deepspeed_tpu.parallel.partition import path_str, infer_param_spec
 from deepspeed_tpu.utils.logging import logger
 
 #: communication_data_type spellings → collective boundary dtypes
-#: (reference engine.py:776 communication_data_type knob)
+#: (reference engine.py:776 communication_data_type knob). "int8" is
+#: the quantized-collective arm (comm.quantize_dequant_int8): the
+#: gradient crosses the reduce boundary through the EQuARX per-chunk
+#: int8 wire transform rather than a plain cast.
 COMM_DTYPES = {"fp16": jnp.float16, "float16": jnp.float16,
                "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-               "fp32": jnp.float32, "float32": jnp.float32}
+               "fp32": jnp.float32, "float32": jnp.float32,
+               "int8": "int8"}
 
 
 class ZeroShardingPlan(NamedTuple):
@@ -165,7 +169,15 @@ def constrain_gradients(grads: Any, grad_shardings: Any,
         orig = g.dtype
         if predivide != 1.0:
             g = g / predivide
-        if comm_dtype is not None:
+        if comm_dtype == "int8":
+            # quantized collective arm: the per-chunk int8 round-trip
+            # (scale + payload) IS the wire transform the EQuARX ring
+            # applies — numerics match an int8 reduction while XLA still
+            # synthesizes the collective from the sharding constraint
+            from deepspeed_tpu.comm.comm import quantize_dequant_int8
+
+            g = quantize_dequant_int8(g)
+        elif comm_dtype is not None:
             g = g.astype(comm_dtype)
         g = jax.lax.with_sharding_constraint(g, s)
         if comm_dtype is not None:

@@ -213,6 +213,56 @@ def _ragged_serving_pieces(arm: str, int8: bool = False,
     return ragged_jit, avals
 
 
+def _tp_serving_pieces(collective: str = "fp32", tp: int = 2):
+    """(decode_jit, avals, mesh, param_specs, pool_specs) for the
+    TENSOR-PARALLEL paged decode step: the fused scan-Llama decoder
+    wrapped by ``inference.tp_shard.make_tp_paged_apply`` over an
+    abstract ``tensor``-axis mesh, on the chosen residual-boundary
+    collective arm (``fp32`` psum or the ``int8`` EQuARX quantized
+    ring). This is the multi-chip serving hot program — the SPMD pass
+    budgets exactly the per-decode-step collectives it is allowed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh
+
+    from deepspeed_tpu.inference import tp_shard
+    from deepspeed_tpu.inference.engine import (
+        PagedServeExecutor, resolve_paged_decoder,
+    )
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    raw_params = jax.eval_shape(
+        lambda r, x: model.init(r, x)["params"], jax.random.PRNGKey(0),
+        ids)
+    _apply, init_pools, transform, decoder = resolve_paged_decoder(
+        cfg, attn_kernel="reference")
+    permuted = jax.eval_shape(
+        lambda p: tp_shard.permute_fused_params_for_tp(
+            transform(p), cfg, tp), raw_params)
+    param_specs = tp_shard.fused_param_specs(permuted)
+    mesh = AbstractMesh((("tensor", tp),))
+    tp_apply = tp_shard.make_tp_paged_apply(
+        decoder, mesh, tp, collective=collective, param_specs=param_specs)
+    pools = jax.eval_shape(
+        lambda: init_pools(cfg, _NUM_BLOCKS, _BLOCK, jnp.float32))
+    ex = PagedServeExecutor(tp_apply, None, None, cfg,
+                            contextlib.nullcontext, num_slots=_SLOTS,
+                            decode_chunk=_CHUNK)
+    decode_jit = ex._build_decode_fn(_CHUNK)
+    sds = jax.ShapeDtypeStruct
+    B, W = _SLOTS, _WIDTH
+    i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+    avals = (
+        permuted, sds((B,), i32), pools, sds((B, W), i32), sds((B,), i32),
+        sds((B,), i32), sds((), i32), sds((B, 2), u32), sds((B,), f32),
+        sds((B,), i32), sds((B,), f32), sds((B,), i32))
+    return (decode_jit, avals, mesh, param_specs,
+            tp_shard.pool_specs(pools))
+
+
 def _tiering_pieces():
     """[(name, jit_fn, avals)] for the tiered-KV spill/restore entry
     points over dense and int8 pool layouts — arm-independent (no

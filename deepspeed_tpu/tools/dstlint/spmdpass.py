@@ -49,15 +49,21 @@ rules:
   propagated sharding provably collapsed to fully-replicated with no
   ``with_sharding_constraint`` re-sharding it — the whole buffer
   materializes on every device before XLA re-slices it.
-- ``spmd-collective-dtype``   a reduction boundary moving a wider float
-  than the entry's configured communication dtype (the EQuARX guardrail:
-  an fp32 decode/grad all-reduce where the config says bf16/int8).
+- ``spmd-collective-dtype``   a reduction boundary — or, when the entry
+  declares a ``reduction_dtype``, an explicit decode-loop collective —
+  moving a wider float than the configured communication dtype (the
+  EQuARX guardrail: an fp32 decode/grad all-reduce where the config
+  says bf16/int8). The quantized ring's fp32 *scale* hops are allow-
+  listed by exact key (``collective_dtype_allow``), not exempted.
 - ``spmd-wrong-axis``   a collective inside a ``shard_map`` body over a
   mesh axis none of the body's inputs vary over (psum over a replicated
   value multiplies it by the axis size — a silent numerics bug).
 - ``spmd-decode-collective``   collectives inside a serving
-  ``while_loop`` decode body beyond the entry's per-step allowance (the
-  TP decode hot path must stay at its budgeted per-step collective set).
+  ``while_loop`` decode body beyond the entry's per-step allowance. The
+  single-replica executors keep a zero allowance; the TP entries
+  (``serve_decode_tp2/fp32``, ``serve_decode_tp2/int8``) carry the real
+  per-step budget — 2 residual-boundary all-reduces per layer, as psums
+  or as the quantized ring's ppermute hops.
 """
 
 import dataclasses
@@ -81,6 +87,11 @@ DEFAULT_TOLERANCE_PCT = 25
 #: reduction comms; the optimizer's param all-gather epilogue re-gathers
 #: fp32 master weights by design and is budgeted, not dtype-audited)
 _BOUNDARY_DTYPE_KINDS = set(REDUCTION_KINDS) | {"shard", "reshard"}
+
+#: explicit collective kinds audited inside a decode while_loop when the
+#: entry declares a reduction_dtype — the TP serving hot path (psum, and
+#: the quantized ring's ppermute hops)
+_WHILE_DTYPE_KINDS = set(REDUCTION_KINDS) | {"ppermute"}
 
 _FLOAT_BITS = {"bfloat16": 16, "float16": 16, "float32": 32,
                "float64": 64}
@@ -1255,10 +1266,60 @@ def _serve_entry(which: str):
         "in_specs": reps,
         "out_specs": None,     # single-replica: everything replicated
         "mesh": AbstractMesh((("tensor", 2),)),
-        # the serving executors are single-replica today: ANY collective
-        # is an implicit insertion, and the decode while_loop body has a
-        # per-step allowance of zero until the TP serve arm lands
+        # the SINGLE-replica serving executors: ANY collective is an
+        # implicit insertion, and the decode while_loop body keeps a
+        # per-step allowance of zero — the TP serve arm has its own
+        # entries (serve_decode_tp2/*) carrying the real budget
         "meta": {"allow_replicated": "all", "while_allowance": {}},
+    }
+
+
+def _serve_tp_entry(collective: str):
+    """The tensor-parallel decode step (TP=2, fused scan-Llama wrapped
+    in ``tp_shard.make_tp_paged_apply``) — the entry that graduates
+    ``spmd-decode-collective`` from "zero allowed" to a real per-step
+    budget: two residual-boundary all-reduces per layer inside the layer
+    scan, so the fp32 arm budgets ``2·L`` psums per decode step and the
+    int8 EQuARX arm budgets the quantized ring's ``ppermute`` hops
+    (per all-reduce: ``2·(n-1)`` int8 payload hops + ``2·(n-1)`` fp32
+    scale hops). The int8 entry also pins the wire DTYPE via
+    ``reduction_dtype`` — a decode all-reduce regressing to a plain
+    fp32 psum fires ``spmd-collective-dtype``, with the fp32 *scale*
+    hops (metadata, ~1.6% of the payload) explicitly allow-listed by
+    exact key rather than exempted wholesale."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.tools.dstlint.jaxprpass import _tp_serving_pieces
+    from deepspeed_tpu.utils.jax_compat import abstract_mesh_context
+
+    tp = 2
+    fn, avals, mesh, param_specs, pspec = _tp_serving_pieces(
+        collective, tp=tp)
+    L = LlamaConfig.tiny().num_layers
+    rest = tuple(P() for _ in range(len(avals) - 3))
+    if collective == "int8":
+        # 2 all-reduces/layer × 2 phases × (n-1) hops, per wire dtype
+        hops = 2 * 2 * (tp - 1) * L
+        allowance = {"ppermute@tensor:int8": hops,
+                     "ppermute@tensor:float32": hops}
+        dtype_meta = {"reduction_dtype": "int8",
+                      "collective_dtype_allow":
+                          ["ppermute@tensor:float32"]}
+    else:
+        allowance = {"psum@tensor:float32": 2 * L}
+        dtype_meta = {}
+    return {
+        "fn": fn,
+        "avals": avals,
+        "in_specs": (param_specs, P(), pspec) + rest,
+        "out_specs": None,   # logits replicated by construction (parity
+        # tests pin it); pools come back head-sharded via out_names
+        "mesh": mesh,
+        "meta": {"allow_replicated": "all",
+                 "while_allowance": allowance,
+                 "trace_ctx": lambda: abstract_mesh_context(mesh),
+                 **dtype_meta},
     }
 
 
@@ -1280,6 +1341,10 @@ def spmd_entry_points() -> List[SpmdEntry]:
                   lambda: _serve_entry("ragged")),
         SpmdEntry("serve_ragged_verify/reference",
                   lambda: _serve_entry("ragged_verify")),
+        SpmdEntry("serve_decode_tp2/fp32",
+                  lambda: _serve_tp_entry("fp32")),
+        SpmdEntry("serve_decode_tp2/int8",
+                  lambda: _serve_tp_entry("int8")),
     ]
 
 
@@ -1480,9 +1545,19 @@ def check_reports(reports: Dict[str, SpmdReport],
         expect = rep.meta.get("reduction_dtype")
         if expect:
             want_bits = _FLOAT_BITS.get(expect, 8)
+            allow_keys = set(rep.meta.get("collective_dtype_allow") or ())
             wide: Dict[str, int] = Counter()
             for ev in rep.events:
-                if not ev.boundary or ev.kind not in _BOUNDARY_DTYPE_KINDS:
+                # two audited surfaces: reduction BOUNDARIES (the ZeRO
+                # gradient path), and explicit decode-loop collectives
+                # (the TP serving path — the quantized ring's wire dtype
+                # is the int8 payload; its fp32 scale hops are allow-
+                # listed by exact key, never by dropping the audit)
+                audited = (ev.boundary
+                           and ev.kind in _BOUNDARY_DTYPE_KINDS) or (
+                    ev.context == "while_loop" and ev.origin == "explicit"
+                    and ev.kind in _WHILE_DTYPE_KINDS)
+                if not audited or ev.key() in allow_keys:
                     continue
                 got_bits = _FLOAT_BITS.get(ev.dtype)
                 if got_bits is not None and got_bits > want_bits:

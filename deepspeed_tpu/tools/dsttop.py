@@ -86,6 +86,11 @@ def build_sample(snap: dict, prev: Optional[dict] = None,
     fleet = {k: v for k, v in g.items() if k.startswith("fleet.")}
     hosts = snap.get("labeled_gauges", {})
     per_host_step = dict(hosts.get("train.step_time_s", {}))
+    # DP replica membership (merged fleet view): host → replica id from
+    # the `replica`-tagged snapshots — TP group members share an id,
+    # DP replicas each have their own
+    replicas = {h: int(v)
+                for h, v in hosts.get("fleet.replica", {}).items()}
     return {
         "slots": {
             "active": g.get("serve.active_slots", 0),
@@ -115,6 +120,7 @@ def build_sample(snap: dict, prev: Optional[dict] = None,
         "completions": completions,
         "fleet": fleet,
         "hosts": per_host_step,
+        "replicas": replicas,
         "train": {k: v for k, v in g.items()
                   if k in ("train.step_time_s", "train.mfu",
                            "train.comm_fraction", "train.grad_norm",
@@ -170,6 +176,12 @@ def render_text(sample: dict, width: int = 78) -> str:
         lines.append("hosts  " + "  ".join(
             f"{h}={_fmt(v)}s" for h, v in sorted(
                 sample["hosts"].items())))
+    if sample.get("replicas"):
+        by_rep: Dict[int, List[str]] = {}
+        for h, r in sorted(sample["replicas"].items()):
+            by_rep.setdefault(r, []).append(h)
+        lines.append("replica " + "  ".join(
+            f"{r}:[{','.join(hs)}]" for r, hs in sorted(by_rep.items())))
     if sample["train"]:
         lines.append("train  " + "  ".join(
             f"{k.removeprefix('train.')}={_fmt(v)}"

@@ -1,0 +1,172 @@
+"""Data-parallel serving replica groups (inference/replica.py): the
+pure prefix-affinity/load router, greedy-parity through ReplicaGroup's
+one admission queue, the dstfleet chaos scenario (one slow replica
+surfaces in fleet skew, the healthy replica's goodput stays 1.0), and
+`bin/dst top` replica labels."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+from deepspeed_tpu.inference.replica import ReplicaGroup, route_requests
+from deepspeed_tpu.inference.scheduler import COMPLETED, TIMED_OUT, Request
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+_ONE_CHIP = {"pipe": 1, "data": 1, "expert": 1, "sequence": 1, "tensor": 1}
+
+
+# --- the pure router ----------------------------------------------------------
+
+def _req(prompt, gen=4):
+    return {"prompt": list(prompt), "max_new_tokens": gen}
+
+
+def test_route_requests_balances_by_load():
+    reqs = [_req(range(i * 7 + 1, i * 7 + 9)) for i in range(6)]
+    out = route_requests(reqs, 2, block_size=4)
+    assert [len(b) for b in out] == [3, 3]
+
+
+def test_route_requests_prefix_affinity_sticks():
+    fam_a, fam_b = [1] * 8, [2] * 8
+    reqs = []
+    for i in range(3):
+        reqs.append(_req(fam_a + [10 + i]))
+        reqs.append(_req(fam_b + [20 + i]))
+    out = route_requests(reqs, 2, block_size=4)
+    # each family lands whole on one replica (first by load, rest by
+    # longest-shared-prefix affinity)
+    assert [r["prompt"][0] for r in out[0]] == [1, 1, 1]
+    assert [r["prompt"][0] for r in out[1]] == [2, 2, 2]
+
+
+def test_route_requests_affinity_persists_across_waves():
+    affinity = [set(), set()]
+    loads = [0, 0]
+    w1 = route_requests([_req([1] * 8 + [9])], 2, block_size=4,
+                        affinity=affinity, loads=loads)
+    home = 0 if w1[0] else 1
+    # a later admission wave with the same prefix follows the history
+    w2 = route_requests([_req([1] * 8 + [7]), _req([1] * 8 + [8])], 2,
+                        block_size=4, affinity=affinity, loads=loads)
+    assert len(w2[home]) == 2 and not w2[1 - home]
+
+
+def test_route_requests_validation():
+    with pytest.raises(ValueError, match="n_replicas"):
+        route_requests([], 0)
+    with pytest.raises(ValueError, match="at least one engine"):
+        ReplicaGroup([])
+    with pytest.raises(ValueError, match="hosts"):
+        ReplicaGroup([object()], hosts=["a", "b"])
+
+
+# --- replica groups over real engines ----------------------------------------
+
+@pytest.fixture(scope="module")
+def engines():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    devs = jax.devices()
+    return [deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params,
+        model_config=cfg,
+        mesh=make_mesh(dims=dict(_ONE_CHIP), devices=[devs[i]]))
+        for i in range(2)]
+
+
+def _trace(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    lens = [5, 9, 13, 7, 4, 11][:n]
+    gens = [6, 3, 8, 5, 4, 7][:n]
+    return [Request(rid=i, prompt=rng.integers(1, 256, L),
+                    max_new_tokens=g)
+            for i, (L, g) in enumerate(zip(lens, gens))]
+
+
+_SERVE_KW = dict(num_slots=2, block_size=4, decode_chunk=2,
+                 attn_kernel="reference")
+
+
+def test_replica_group_greedy_matches_single_engine(engines):
+    ref = {c.rid: list(c.tokens)
+           for c in engines[0].serve(_trace(), **_SERVE_KW)}
+    group = ReplicaGroup(engines)
+    comps = group.serve(_trace(), **_SERVE_KW)
+    got = {c.rid: list(c.tokens) for c in comps}
+    assert sorted(got) == list(range(6))
+    assert all(got[r] for r in got)
+    assert got == ref, "replica routing changed greedy outputs"
+    # admission actually spread across both replicas
+    assert min(len(a) for a in group.last_assignment) >= 1
+
+
+def test_replica_group_chaos_straggler_skew_and_goodput(engines, tmp_path):
+    """One replica suffers injected slow chunks: its deadlined requests
+    time out (goodput < 1) and the fleet merge surfaces it as the
+    skew straggler, while the healthy replica stays at goodput 1.0."""
+    from deepspeed_tpu.observability.fleet import (
+        StragglerDetector, host_step_time, read_fleet_snapshots,
+    )
+
+    group = ReplicaGroup(engines, fleet_dir=str(tmp_path))
+    # warm both executors at the chaos wave's chunking so deadlines
+    # below measure scheduling, not compilation
+    group.serve(_trace(seed=3), **dict(_SERVE_KW, decode_chunk=1))
+    for eng in engines:      # isolate the chaos wave's chunk timings
+        eng.reset_serve_metrics()
+    slow = FaultInjector([FaultSpec(site="slow", step=s, seconds=0.3)
+                          for s in range(1, 40)])
+    reqs = [Request(rid=r.rid, prompt=r.prompt,
+                    max_new_tokens=r.max_new_tokens, deadline_s=1.0)
+            for r in _trace(seed=3)]
+    # decode_chunk=1: every token is a chunk boundary, so the 0.3 s
+    # stalls pile past the deadline well before the streams finish
+    comps = group.serve(reqs, per_replica_kwargs={0: {
+        "fault_injector": slow}}, **dict(_SERVE_KW, decode_chunk=1))
+    assert min(len(a) for a in group.last_assignment) >= 1
+    slow_rids = {r.rid for r in group.last_assignment[0]}
+    by_rid = {c.rid: c for c in comps}
+    assert any(by_rid[r].status == TIMED_OUT for r in slow_rids), \
+        "slow chunks never pushed a deadlined request over budget"
+    assert all(by_rid[r].status == COMPLETED
+               for r in by_rid if r not in slow_rids)
+    # healthy replica delivered everything in deadline; the straggler
+    # burned sampled tokens it never delivered
+    assert engines[1].metrics.gauge("serve.goodput") == 1.0
+    assert engines[0].metrics.gauge("serve.goodput") < 1.0
+
+    merged = group.fleet_view()
+    per_host = {h: host_step_time(s)
+                for h, s in read_fleet_snapshots(str(tmp_path)).items()}
+    det = StragglerDetector(threshold=1.5, windows=1, metrics=merged)
+    warning = det.update(per_host)
+    assert warning is not None and warning["host"] == "replica0"
+    assert merged.gauge("fleet.step_time.skew") > 1.5
+    # merge semantics held: fleet totals are the per-replica sums
+    assert merged.counter("serve.tokens_sampled") == (
+        engines[0].metrics.counter("serve.tokens_sampled")
+        + engines[1].metrics.counter("serve.tokens_sampled"))
+    assert merged.labeled_gauges()["serve.goodput"]["replica0"] < 1.0
+
+
+def test_dsttop_renders_replica_labels(engines, tmp_path):
+    """`bin/dst top` distinguishes DP replicas: the merged fleet view's
+    `fleet.replica` labels become the dashboard's replica line."""
+    from deepspeed_tpu.tools.dsttop import build_sample, render_text
+
+    group = ReplicaGroup(engines, fleet_dir=str(tmp_path))
+    group.serve(_trace(n=4, seed=5), **_SERVE_KW)
+    merged = group.fleet_view()
+    snap = {"counters": merged.counters(), "gauges": merged.gauges(),
+            "histograms": {}, "labeled_gauges": merged.labeled_gauges()}
+    sample = build_sample(snap)
+    assert sample["replicas"] == {"replica0": 0, "replica1": 1}
+    text = render_text(sample)
+    assert "replica 0:[replica0]  1:[replica1]" in text

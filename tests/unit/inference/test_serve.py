@@ -636,7 +636,7 @@ def test_serve_chunked_prefill_fewer_compile_buckets(llama_engine):
     kw = dict(num_slots=3, block_size=8, decode_chunk=2)
     assert all(c.ok for c in llama_engine.serve(reqs(), **kw))
     ex = None
-    for (slots, _bs, _nb, _dc, _kv8, _arm), (_, cand) in \
+    for (slots, _bs, _nb, _dc, _kv8, _arm, _tp, _tpc), (_, cand) in \
             llama_engine._serve_executors.items():
         if slots == 3:
             ex = cand

@@ -251,6 +251,53 @@ def test_decode_collective_ignored_without_allowance_meta():
     assert "spmd-decode-collective" not in rules_of(check(rep, "self"))
 
 
+# --- spmd-collective-dtype on the TP decode loop (the int8 ring) --------------
+
+def _ring_event(dtype, count=8, payload=256):
+    return sp.CollectiveEvent(
+        kind="ppermute", axes=("tensor",), dtype=dtype, count=count,
+        bytes=payload * count, payload=payload, group=2,
+        origin="explicit", context="while_loop")
+
+
+def _tp_int8_report(allow):
+    """A serve_decode_tp2-shaped entry: int8 payload hops + fp32 scale
+    hops inside the decode while_loop, communication dtype int8."""
+    rep = sp.SpmdReport("serve_decode_tp2/fixture")
+    rep.meta = {"reduction_dtype": "int8",
+                "while_allowance": {"ppermute@tensor:int8": 8,
+                                    "ppermute@tensor:float32": 8}}
+    if allow is not None:
+        rep.meta["collective_dtype_allow"] = allow
+    rep.events.append(_ring_event("int8"))
+    rep.events.append(_ring_event("float32", payload=4))  # the scale hops
+    return rep
+
+
+def test_collective_dtype_positive_unallowed_fp32_ring_hops():
+    # without the exact-key allow list, the quantized ring's fp32 scale
+    # hops read as a wider-than-configured wire dtype
+    got = check(_tp_int8_report(None), "self")
+    assert rules_of(got) == ["spmd-collective-dtype"]
+    assert "ppermute@tensor:float32" in got[0].message
+
+
+def test_collective_dtype_negative_scale_hops_allow_listed():
+    # the budgeted escape hatch: the fp32 per-chunk scales are part of
+    # the int8 wire format — allow-listed by exact key, never by
+    # dropping the audit
+    assert check(_tp_int8_report(["ppermute@tensor:float32"]),
+                 "self") == []
+
+
+def test_collective_dtype_int8_payload_hops_clean():
+    rep = sp.SpmdReport("serve_decode_tp2/fixture")
+    rep.meta = {"reduction_dtype": "int8",
+                "while_allowance": {"ppermute@tensor:int8": 8}}
+    rep.events.append(_ring_event("int8"))
+    assert check(rep, "self") == []
+
+
 # --- spmd-comms-budget (fabricated drift arithmetic) --------------------------
 
 def _inventory_report(name="zero_step/fixture", count=10, nbytes=1000):

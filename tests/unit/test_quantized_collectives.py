@@ -1,0 +1,197 @@
+"""Int8 quantized collectives (EQuARX-style): the local round-trip, the
+ring all-reduce's numerics + replication invariant, the wire-byte
+accounting (measured == static), and the ZeRO
+``communication_data_type: int8`` reduce boundary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.comm.collective_cost import (
+    QUANT_CHUNK, quantized_ring_wire_bytes, wire_bytes,
+)
+from deepspeed_tpu.observability.metrics import MetricsRegistry
+from deepspeed_tpu.parallel.mesh import make_mesh
+from deepspeed_tpu.utils.jax_compat import LEGACY_SHARD_MAP_KW, shard_map
+
+
+def tensor2_mesh(devices):
+    return make_mesh(dims={"pipe": 1, "data": 1, "expert": 1,
+                           "sequence": 1, "tensor": 2},
+                     devices=devices[:2])
+
+
+# --- local int8 round-trip ----------------------------------------------------
+
+def test_quantize_dequant_int8_deterministic(rng):
+    x = jnp.asarray(rng.normal(size=(3, 515)).astype(np.float32))
+    a = np.asarray(comm.quantize_dequant_int8(x))
+    b = np.asarray(comm.quantize_dequant_int8(x))
+    assert a.shape == x.shape and a.dtype == np.float32
+    np.testing.assert_array_equal(a, b)
+
+
+def test_quantize_dequant_int8_error_bound(rng):
+    """Per-chunk worst case: |x - qdq(x)| <= chunk_absmax / 254 (half a
+    quantization step of scale = absmax/127)."""
+    chunk = 64
+    x = rng.normal(size=(4 * chunk,)).astype(np.float32)
+    x[chunk] = 50.0                       # one chunk with a big outlier
+    y = np.asarray(comm.quantize_dequant_int8(jnp.asarray(x), chunk=chunk))
+    for c in range(4):
+        seg_x = x[c * chunk:(c + 1) * chunk]
+        seg_y = y[c * chunk:(c + 1) * chunk]
+        bound = np.abs(seg_x).max() / 254.0 + 1e-6
+        assert np.abs(seg_x - seg_y).max() <= bound, (c, bound)
+
+
+def test_quantize_dequant_int8_pads_ragged_sizes():
+    x = jnp.arange(QUANT_CHUNK + 7, dtype=jnp.float32) / 13.0
+    y = comm.quantize_dequant_int8(x)
+    assert y.shape == x.shape
+    # padding zeros must not leak into the tail chunk's values
+    assert np.abs(np.asarray(y - x)).max() <= float(jnp.abs(x).max()) / 254.0 + 1e-6
+
+
+# --- the quantized ring all-reduce -------------------------------------------
+
+def _ring_outputs(devices, x, chunk=None):
+    mesh = tensor2_mesh(devices)
+    xs = jax.device_put(x, NamedSharding(mesh, P("tensor")))
+    fn_q = jax.jit(shard_map(
+        lambda t: comm.quantized_all_reduce(t, "tensor", chunk),
+        mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"),
+        **LEGACY_SHARD_MAP_KW))
+    fn_f = jax.jit(shard_map(
+        lambda t: jax.lax.psum(t, "tensor"),
+        mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor")))
+    return np.asarray(fn_q(xs)), np.asarray(fn_f(xs))
+
+
+def test_quantized_all_reduce_matches_fp32_psum(devices, rng):
+    x = rng.normal(size=(4, 512)).astype(np.float32)
+    got_q, got_f = _ring_outputs(devices, x)
+    # worst case: one quantized hop per phase, each within half a step
+    # of its chunk's absmax — bound loosely by the global magnitudes
+    bound = 2.0 * max(np.abs(x).max(), np.abs(got_f).max()) / 127.0
+    assert np.abs(got_q - got_f).max() <= bound
+    cos = float(np.dot(got_q.ravel(), got_f.ravel())
+                / (np.linalg.norm(got_q) * np.linalg.norm(got_f)))
+    assert cos >= 0.999
+
+
+def test_quantized_all_reduce_replicas_bitwise_identical(devices, rng):
+    """Phase 2 forwards the SAME (q, scale) payload and every device
+    dequantizes it — the copies must be bitwise identical (the
+    invariant TP greedy decoding relies on)."""
+    x = rng.normal(size=(4, 512)).astype(np.float32)
+    got_q, _ = _ring_outputs(devices, x)
+    np.testing.assert_array_equal(got_q[:2], got_q[2:])
+
+
+def test_quantized_all_reduce_deterministic(devices, rng):
+    x = rng.normal(size=(2, 768)).astype(np.float32)
+    a, _ = _ring_outputs(devices, x)
+    b, _ = _ring_outputs(devices, x)
+    np.testing.assert_array_equal(a, b)
+
+
+# --- wire-byte accounting: measured == static --------------------------------
+
+def test_quantized_wire_bytes_closed_form():
+    payload = 4 * 512 * 4                          # (4, 512) fp32 = 8192 B
+    assert wire_bytes("psum", payload, 2) == payload
+    q = quantized_ring_wire_bytes(payload, 2)
+    assert q == wire_bytes("quantized_psum", payload, 2)
+    # 2(n-1) hops x per-shard int8 + one fp32 scale per chunk:
+    # per = 1024 elems -> 2 * 1 * (1024 + 4 * 1024/256) = 2080 bytes
+    assert q == 2080
+    assert q / payload <= 0.30
+
+
+def test_eager_quantized_all_reduce_counters_match_static(devices, rng):
+    mesh = tensor2_mesh(devices)
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(4, 512)).astype(np.float32)),
+        NamedSharding(mesh, P("tensor")))
+    payload = 4 * 512 * 4
+    reg = MetricsRegistry()
+    comm.set_metrics_registry(reg)
+    try:
+        comm.eager_all_reduce_over_mesh(x, mesh, axis="tensor")
+        comm.eager_quantized_all_reduce_over_mesh(x, mesh, axis="tensor")
+    finally:
+        comm.set_metrics_registry(None)
+    c = reg.counters()
+    assert c["comm.all_reduce.bytes"] == wire_bytes("psum", payload, 2)
+    assert c["comm.quantized_all_reduce.bytes"] == \
+        wire_bytes("quantized_psum", payload, 2)
+    assert (c["comm.quantized_all_reduce.bytes"]
+            / c["comm.all_reduce.bytes"]) <= 0.30
+
+
+# --- ZeRO communication_data_type: int8 --------------------------------------
+
+def _zero_step_run(dp8_mesh, comm_dtype, n_steps=2):
+    """Build a stage-matrix {1, 2} int8/fp32 train step and run it;
+    returns the final params + losses (all pulled to host)."""
+    import optax
+
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.stages import (
+        build_zero_train_step, plan_zero_shardings,
+    )
+
+    k = jax.random.PRNGKey(3)
+    params = {"w": jax.random.normal(k, (8, 16), jnp.float32),
+              "b": jnp.zeros((16,), jnp.float32)}
+    xb = jax.random.normal(jax.random.PRNGKey(4), (16, 8), jnp.float32)
+    yb = jax.random.normal(jax.random.PRNGKey(5), (16, 16), jnp.float32)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    out = {}
+    for stage in (1, 2):
+        plan = plan_zero_shardings(params, dp8_mesh,
+                                   DeepSpeedZeroConfig(stage=stage))
+        opt = optax.sgd(0.1)
+        step = jax.jit(build_zero_train_step(
+            loss_fn, opt, plan, dp8_mesh,
+            communication_data_type=comm_dtype))
+        p, o = params, opt.init(params)
+        losses = []
+        for _ in range(n_steps):
+            loss, p, o = step(p, o, (xb, yb))
+            losses.append(float(loss))
+        out[stage] = (jax.tree_util.tree_map(np.asarray, p), losses)
+    return out
+
+
+def test_zero_int8_comm_dtype_byte_stable_across_runs(dp8_mesh):
+    """ZeRO stage-1/2 with ``communication_data_type: int8``: two
+    independent builds from identical inits produce byte-identical
+    params and losses (the quantized boundary is deterministic)."""
+    a = _zero_step_run(dp8_mesh, "int8")
+    b = _zero_step_run(dp8_mesh, "int8")
+    for stage in (1, 2):
+        pa, la = a[stage]
+        pb, lb = b[stage]
+        assert la == lb and all(np.isfinite(la))
+        for k in pa:
+            np.testing.assert_array_equal(pa[k], pb[k])
+
+
+def test_zero_int8_comm_dtype_engages_boundary(dp8_mesh):
+    """The int8 arm must actually round-trip the gradients — its params
+    diverge from the fp32 arm's (while staying close)."""
+    p8, _ = _zero_step_run(dp8_mesh, "int8")[2]
+    p32, _ = _zero_step_run(dp8_mesh, None)[2]
+    assert any(not np.array_equal(p8[k], p32[k]) for k in p8), \
+        "int8 boundary was a no-op"
+    for k in p8:
+        np.testing.assert_allclose(p8[k], p32[k], atol=0.05)

@@ -181,6 +181,31 @@ class ServeConfig(DeepSpeedConfigModel):
     # allocator churn); frames the arena cannot fit fall back to numpy
     # per frame, so this is a perf knob, never a capacity limit.
     host_staging_mb: int = 0
+    # PREFILL/DECODE DISAGGREGATION (docs/SERVING.md "Disaggregated
+    # serving"): give ReplicaGroup replicas roles. Prefill-role
+    # replicas run prompt prefill only (chunked, through the ragged
+    # path) and publish the finished KV blocks as content-addressed
+    # frames into a shared host transfer tier; decode-role replicas
+    # admit the handed-off request through the tiered-KV restore
+    # machinery and land it already-prefilled, so long prompts stop
+    # stealing decode steps' token budget (TPOT p99 under long-prompt
+    # floods — bench.py --serve --disagg measures the A/B). A transfer
+    # that fails cleanly (frame evicted, restore error) degrades that
+    # one request to a cold prefill on the decode side; outputs stay
+    # byte-identical to colocated serving (tier-1 pins). Off (default)
+    # = every replica is a full colocated engine. Turning it on makes
+    # ReplicaGroup default to roles ["prefill", "decode", ...] when
+    # none are given (needs >= 2 replicas). Requires prefix_cache.
+    disaggregate: bool = False
+    # routing threshold for disaggregation, in prompt tokens: requests
+    # with prompts at least this long (and no full prefix-cache hit on
+    # a decode replica) route to the prefill pool; shorter prompts and
+    # full-hit follow-ups go straight to decode admission, where their
+    # prefill is too small to matter. Sizing: a prompt is "long" when
+    # its prefill would steal more than a few chunks' worth of decode
+    # budget — a small multiple of prefill_chunk_tokens (or of
+    # block_size * 8 when chunking is off) is the useful range.
+    prefill_role_threshold_tokens: int = 256
     # --- fault tolerance (docs/SERVING.md) -------------------------------
     # bounded preemption: restart-from-prompt retries per request before
     # it resolves PREEMPTED_LIMIT deterministically (victim selection is

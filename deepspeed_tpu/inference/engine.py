@@ -553,21 +553,20 @@ class PagedServeExecutor:
         tier = self._host_tier
         if tier is None or not entries:
             return None
-        staged_np = tier.stage_frames(entries)
-        if staged_np is None:
-            return None
-        nbytes = int(sum(int(a.nbytes) for a in staged_np))
         # pow2-bucket the restore width like the spill side (one
         # compiled scatter per bucket, not per hit length): pad lanes
-        # write zeros into the null block — the masked-write sink
+        # write zeros into the null block — the masked-write sink. The
+        # tier stages AT the padded width (no post-hoc concatenate),
+        # which also makes staging shapes repeat per bucket, so the
+        # tier's reusable scratch slot actually hits.
         n = len(entries)
         cap = 1 << (n - 1).bit_length()
-        if cap != n:
-            staged_np = [
-                np.concatenate(
-                    [s, np.zeros(s.shape[:1] + (cap - n,) + s.shape[2:],
-                                 s.dtype)], axis=1)
-                for s in staged_np]
+        staged_np = tier.stage_frames(entries, pad_to=cap)
+        if staged_np is None:
+            return None
+        # real lanes only — pad lanes are transport filler, and the
+        # tier's bytes_restored must stay honest
+        nbytes = int(sum(int(a[:, :n].nbytes) for a in staged_np))
         # rebuild the pools' pytree structure so finish_restore's
         # tree_map pairs frames with their pool leaves, and place each
         # staged leaf with its pool leaf's sharding: an unsharded
@@ -585,7 +584,7 @@ class PagedServeExecutor:
             slot=slot, entries=list(entries),
             block_ids=np.asarray([b for _, b in entries]
                                  + [0] * (cap - n), np.int32),
-            staged=staged, nbytes=nbytes)
+            staged=staged, nbytes=nbytes, staging=staged_np)
 
     def finish_restore(self, handle) -> bool:
         """Land a restore: scatter the staged frames into their claimed
@@ -614,8 +613,20 @@ class PagedServeExecutor:
         with self._ctx():
             self._pools = fn(
                 self._pools, jnp.asarray(handle.block_ids), handle.staged)
-        if self._host_tier is not None:
-            self._host_tier.note_restored(handle.nbytes)
+        tier = self._host_tier
+        if tier is not None:
+            tier.note_restored(handle.nbytes)
+            staging = getattr(handle, "staging", None)
+            if staging is not None:
+                # the restore was consumed synchronously in this
+                # handoff: once the scatter's output pools exist,
+                # nothing in flight can still read the host staging (a
+                # CPU device_put may zero-copy alias it), so the
+                # buffers go back to the tier for the next restore to
+                # reuse. Failed restores never reach here — their
+                # staging is simply never recycled (the alias guard).
+                jax.block_until_ready(self._pools)
+                tier.release_staging(staging)
         return True
 
     def ragged_step(self, tokens, q_lens, block_tables, write_pos, emit,
@@ -1632,6 +1643,9 @@ class InferenceEngine:
                         record_occupancy: bool = False,
                         prefix_cache: Optional[bool] = None,
                         host_cache_gb: Optional[float] = None,
+                        host_tier=None,
+                        publish_kv: Optional[bool] = None,
+                        handoff=None,
                         speculative: Optional[str] = None,
                         draft_len: Optional[int] = None,
                         draft_ngram: Optional[int] = None,
@@ -1715,6 +1729,20 @@ class InferenceEngine:
         cold prefill). The tier is pinned per executor and, being
         content-addressed, stays warm across serve() calls; resolved 0
         drops any pinned tier (frees the host RAM).
+        ``host_tier`` passes a :class:`~deepspeed_tpu.inference.
+        kv_tiering.HostKVTier` OBJECT instead of a size — the
+        disaggregated-serving transfer tier, SHARED between a
+        prefill-role and decode-role engine (overrides
+        ``host_cache_gb``; requires the prefix cache). ``publish_kv``
+        makes this stream a PREFILL role: every completed request's
+        full prompt blocks are pushed into the tier at finish time,
+        before its completion surfaces. ``handoff`` (a
+        :class:`~deepspeed_tpu.inference.scheduler.HandoffQueue`) makes
+        it a DECODE role: the scheduler drains the channel at step
+        boundaries and handed-off requests land already-prefilled
+        through the tier restore path (degrading to a cold prefill when
+        the transfer fails cleanly). ``ReplicaGroup`` wires all three —
+        see docs/SERVING.md "Disaggregated serving".
 
         FAULT TOLERANCE (docs/SERVING.md): every request resolves to
         exactly one ``Completion`` with a terminal ``status`` —
@@ -1827,12 +1855,20 @@ class InferenceEngine:
                 rejected.append(rejected_completion(r.rid, r.prompt, e))
                 continue
             reqs.append(r)
-        if not reqs:
+        if not reqs and handoff is None:
             # nothing admissible: emit the rejections without minting an
             # executor (each executor pins a full KV pool in HBM)
             yield from rejected
             return
         if max_context is None:
+            if not reqs:
+                # a pure handoff-fed decode role has no requests to
+                # derive program shapes from — the group passes the
+                # fleet-wide bound explicitly
+                raise ValueError(
+                    "generate_stream with only handoff requests needs "
+                    "an explicit max_context (program shapes are sized "
+                    "before the handoffs arrive)")
             max_context = max(len(r.prompt) + r.max_new_tokens
                               for r in reqs)
         width = blocks_for(max_context, block_size)
@@ -1859,28 +1895,42 @@ class InferenceEngine:
             executor._lease = None
         pc = (serve_cfg.prefix_cache
               if prefix_cache is None else bool(prefix_cache))
-        gb = (serve_cfg.host_cache_gb
-              if host_cache_gb is None else float(host_cache_gb))
-        if gb > 0 and not pc:
-            raise ValueError(
-                "host_cache_gb > 0 requires the prefix cache — the host "
-                "tier is keyed by its content hashes (enable "
-                "prefix_cache, or set host_cache_gb: 0)")
-        host_tier = None
-        if pc and gb > 0:
-            from deepspeed_tpu.inference.kv_tiering import tier_from_gb
+        if host_tier is not None:
+            # disaggregated serving: a SHARED tier object (the transfer
+            # tier) overrides the size knob — both roles must address
+            # the same store, so nothing is minted here
+            if not pc:
+                raise ValueError(
+                    "host_tier requires the prefix cache — the tier is "
+                    "keyed by its content hashes")
+        else:
+            gb = (serve_cfg.host_cache_gb
+                  if host_cache_gb is None else float(host_cache_gb))
+            if gb > 0 and not pc:
+                raise ValueError(
+                    "host_cache_gb > 0 requires the prefix cache — the "
+                    "host tier is keyed by its content hashes (enable "
+                    "prefix_cache, or set host_cache_gb: 0)")
+            if pc and gb > 0:
+                from deepspeed_tpu.inference.kv_tiering import \
+                    tier_from_gb
 
-            # reuse the pinned tier when its cap matches: frames are
-            # content-addressed, so they stay valid for this executor's
-            # params regardless of what happened to the device index in
-            # between (even cache-off sessions — unlike _host_pool,
-            # which binds keys to device block ids and must drop)
-            smb = int(serve_cfg.host_staging_mb)
-            host_tier = executor._host_tier
-            if host_tier is None \
-                    or host_tier.capacity_bytes != int(gb * (1 << 30)) \
-                    or host_tier.staging_mb != smb:
-                host_tier = tier_from_gb(gb, staging_mb=smb)
+                # reuse the pinned tier when its cap matches: frames are
+                # content-addressed, so they stay valid for this
+                # executor's params regardless of what happened to the
+                # device index in between (even cache-off sessions —
+                # unlike _host_pool, which binds keys to device block
+                # ids and must drop)
+                smb = int(serve_cfg.host_staging_mb)
+                host_tier = executor._host_tier
+                if host_tier is None \
+                        or host_tier.capacity_bytes != int(gb * (1 << 30)) \
+                        or host_tier.staging_mb != smb:
+                    host_tier = tier_from_gb(gb, staging_mb=smb)
+        if publish_kv and host_tier is None:
+            raise ValueError(
+                "publish_kv=True needs a tier to publish into — pass "
+                "host_tier (the shared transfer tier) or host_cache_gb")
         # resolved 0 drops any pinned tier (frees the host RAM)
         executor._host_tier = host_tier
         if pc:
@@ -1925,7 +1975,7 @@ class InferenceEngine:
                          else int(audit_every)),
             fault_injector=fault_injector,
             host_tier=host_tier, metrics=self.metrics, tracer=tracer,
-            slo=slo)
+            slo=slo, handoff=handoff, publish_prefixes=bool(publish_kv))
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log

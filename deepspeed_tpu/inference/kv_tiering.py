@@ -40,9 +40,26 @@ opportunistic and byte-capped — ``put`` evicts its own LRU to fit and
 simply declines frames larger than the whole cap, so the host tier can
 never block a device allocation or grow without bound
 (``serve.host_cache_gb`` is the cap; 0 disables the tier).
+
+The tier doubles as the KV TRANSFER tier for disaggregated serving
+(docs/SERVING.md "Disaggregated serving"): a prefill-role replica
+publishes finished prompt blocks with ``put`` and a decode-role replica
+admits them through the same ``lookup``/``stage_frames``/restore path —
+the content addressing makes publish and spill indistinguishable, so
+the decode side needs no new machinery to land a handed-off request
+already-prefilled. That is why the tier is thread-safe (an RLock
+around every store operation): prefill and decode replicas share ONE
+instance across threads. The transfer-tier *interface* is exactly the
+public surface here — ``put`` / ``touch`` / ``lookup`` /
+``stage_frames`` / ``note_restored`` / ``release_staging`` / ``stats``
+/ ``audit`` — deliberately free of host-RAM assumptions, so a
+device-to-device ICI transport can slot in behind the same methods
+later (publish becomes a remote DMA, stage becomes a receive) without
+touching the scheduler or the replica group.
 """
 
 import dataclasses
+import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -65,6 +82,10 @@ class RestoreHandle:
     block_ids: np.ndarray              # int32 [N]
     staged: Any                        # device pytree, [L, N, bs, ...] leaves
     nbytes: int
+    # host-side staging arrays backing ``staged`` — returned to the
+    # tier (``release_staging``) once the scatter that consumes them
+    # has synced, so the next restore reuses the buffers
+    staging: Any = None
 
 
 class HostKVTier:
@@ -121,12 +142,30 @@ class HostKVTier:
         self.rejected = 0              # frames larger than the whole cap
         self.bytes_spilled = 0
         self.bytes_restored = 0
+        self.stage_copies = 0          # frame copies made by stage_frames
+        self.bytes_staged = 0          # bytes copied into staging
+        self.staging_reuses = 0        # restores that reused the scratch
+        # one reusable staging slot: the buffers of the LAST completed
+        # restore (returned via release_staging once its scatter synced)
+        # are reused by the next stage_frames when shapes match — the
+        # pow2 lane bucketing upstream makes matches the common case.
+        # Until release, every restore gets FRESH buffers, so the
+        # CPU-alias guard (see ``get``) holds throughout.
+        self._stage_scratch: Optional[List[np.ndarray]] = None
+        self._stage_handles: Optional[list] = None
+        # id(staging[0]) -> arena handles of a live (unreleased) staging
+        self._staging_live: Dict[int, list] = {}
+        # prefill/decode disaggregation shares one tier across replica
+        # threads — every public store operation locks
+        self._lock = threading.RLock()
 
     def __len__(self) -> int:
-        return len(self._store)
+        with self._lock:
+            return len(self._store)
 
     def __contains__(self, key: bytes) -> bool:
-        return key in self._store
+        with self._lock:
+            return key in self._store
 
     # --- staging arena (swapper idiom) -----------------------------------
     def _alloc_frame(self, src: np.ndarray):
@@ -160,30 +199,32 @@ class HostKVTier:
         a frame set larger than the whole cap is declined, and the LRU
         is evicted as needed to fit everything else — the tier never
         exceeds ``capacity_bytes`` and never signals pressure upward."""
-        if key in self._store:
-            self._store.move_to_end(key)
-            self.refreshes += 1
+        with self._lock:
+            if key in self._store:
+                self._store.move_to_end(key)
+                self.refreshes += 1
+                return True
+            nbytes = int(sum(int(f.nbytes) for f in frames))
+            if nbytes > self.capacity_bytes:
+                self.rejected += 1
+                return False
+            while self.bytes_used + nbytes > self.capacity_bytes:
+                self._evict_lru()
+            stored, handles = [], []
+            for f in frames:
+                arr, h = self._alloc_frame(np.asarray(f))
+                stored.append(arr)
+                handles.append(h)
+            self._store[key] = stored
+            self._nbytes[key] = nbytes
+            if any(h is not None for h in handles):
+                self._handles[key] = handles
+            self.bytes_used += nbytes
+            self.bytes_used_peak = max(self.bytes_used_peak,
+                                       self.bytes_used)
+            self.spills += 1
+            self.bytes_spilled += nbytes
             return True
-        nbytes = int(sum(int(f.nbytes) for f in frames))
-        if nbytes > self.capacity_bytes:
-            self.rejected += 1
-            return False
-        while self.bytes_used + nbytes > self.capacity_bytes:
-            self._evict_lru()
-        stored, handles = [], []
-        for f in frames:
-            arr, h = self._alloc_frame(np.asarray(f))
-            stored.append(arr)
-            handles.append(h)
-        self._store[key] = stored
-        self._nbytes[key] = nbytes
-        if any(h is not None for h in handles):
-            self._handles[key] = handles
-        self.bytes_used += nbytes
-        self.bytes_used_peak = max(self.bytes_used_peak, self.bytes_used)
-        self.spills += 1
-        self.bytes_spilled += nbytes
-        return True
 
     def _evict_lru(self) -> None:
         key, _ = self._store.popitem(last=False)
@@ -194,18 +235,20 @@ class HostKVTier:
     def touch(self, key: bytes) -> bool:
         """LRU-refresh a present key (a device re-eviction of content
         the tier still holds — no bytes move). Returns presence."""
-        if key not in self._store:
-            return False
-        self._store.move_to_end(key)
-        self.refreshes += 1
-        return True
+        with self._lock:
+            if key not in self._store:
+                return False
+            self._store.move_to_end(key)
+            self.refreshes += 1
+            return True
 
     def drop(self, key: bytes) -> None:
         """Forget one entry (explicit invalidation; absent keys no-op)."""
-        if key in self._store:
-            del self._store[key]
-            self._free_frame_handles(key)
-            self.bytes_used -= self._nbytes.pop(key)
+        with self._lock:
+            if key in self._store:
+                del self._store[key]
+                self._free_frame_handles(key)
+                self.bytes_used -= self._nbytes.pop(key)
 
     # --- restore side -----------------------------------------------------
     def lookup(self, keys: Sequence[bytes]) -> List[bytes]:
@@ -219,15 +262,16 @@ class HostKVTier:
         past the break included — they get prefilled cold all the
         same), so ``hits / (hits + misses)`` is hit blocks over
         looked-up blocks, directly comparable to ``block_hit_rate``."""
-        out: List[bytes] = []
-        for k in keys:
-            if k not in self._store:
-                break
-            self._store.move_to_end(k)
-            out.append(k)
-        self.hits += len(out)
-        self.misses += len(keys) - len(out)
-        return out
+        with self._lock:
+            out: List[bytes] = []
+            for k in keys:
+                if k not in self._store:
+                    break
+                self._store.move_to_end(k)
+                out.append(k)
+            self.hits += len(out)
+            self.misses += len(keys) - len(out)
+            return out
 
     def get(self, key: bytes) -> Optional[List[np.ndarray]]:
         """Frames for ``key`` (LRU-touched), or None. The arrays are
@@ -236,59 +280,153 @@ class HostKVTier:
         the transfer can zero-copy alias the host buffer (swapper.py
         ``_to_device``), and a later eviction reusing the arena slot
         would then mutate live device data."""
-        frames = self._store.get(key)
-        if frames is not None:
-            self._store.move_to_end(key)
-        return frames
+        with self._lock:
+            frames = self._store.get(key)
+            if frames is not None:
+                self._store.move_to_end(key)
+            return frames
 
-    def stage_frames(self, entries: Sequence) -> Optional[List[np.ndarray]]:
-        """Fresh per-leaf staging arrays ``[L, N, bs, ...]`` for the
+    def stage_frames(self, entries: Sequence,
+                     pad_to: Optional[int] = None,
+                     ) -> Optional[List[np.ndarray]]:
+        """Per-leaf staging arrays ``[L, N, bs, ...]`` for the
         (key, block id) ``entries`` of one restore — the layout
-        ``ops.paged_attention.scatter_pool_blocks`` consumes. Stacking
+        ``ops.paged_attention.scatter_pool_blocks`` consumes. Staging
         COPIES out of tier storage (the alias guard above); returns
         None when any key is gone (evicted between lookup and restore —
-        the caller degrades to a cold prefill). Staging does NOT touch
+        the caller degrades to a cold prefill). ``pad_to`` widens the
+        lane axis to that many lanes, zero-filling the pad (the
+        executor's pow2 program buckets) — cheaper than a post-hoc
+        concatenate, and it makes shapes repeat so the scratch slot
+        below gets reuse hits.
+
+        Buffers come from the reusable scratch slot when the previous
+        restore has released it (``release_staging``) and shapes match;
+        otherwise a fresh allocation (arena-backed when configured).
+        Either way the caller holds the ONLY live staging for these
+        buffers until it releases them. Staging does NOT touch
         ``bytes_restored``: the executor credits :meth:`note_restored`
         only when the restore LANDS, so failed transfers never inflate
         the stats."""
-        per_key = []
-        for key, _ in entries:
-            frames = self.get(key)
-            if frames is None:
-                return None
-            per_key.append(frames)
-        return [np.stack([frames[i] for frames in per_key], axis=1)
-                for i in range(len(per_key[0]))]
+        with self._lock:
+            per_key = []
+            for key, _ in entries:
+                frames = self._store.get(key)
+                if frames is None:
+                    return None
+                self._store.move_to_end(key)
+                per_key.append(frames)
+            n = len(per_key)
+            lanes = n if pad_to is None else max(int(pad_to), n)
+            leaves = per_key[0]
+            shapes = [(f.shape[0], lanes) + f.shape[1:] for f in leaves]
+            dtypes = [f.dtype for f in leaves]
+            out, handles = self._claim_staging(shapes, dtypes)
+            for i, arr in enumerate(out):
+                for j, frames in enumerate(per_key):
+                    np.copyto(arr[:, j], frames[i])
+                if lanes > n:
+                    arr[:, n:] = 0
+            self.stage_copies += n * len(leaves)
+            self.bytes_staged += int(sum(a.nbytes for a in out))
+            self._staging_live[id(out[0])] = handles
+            # stagings whose restore failed are never released — prune
+            # the oldest bookkeeping so the map stays bounded (their
+            # arena slots are deliberately not recycled: a dropped
+            # handle's device arrays may still alias the buffers)
+            while len(self._staging_live) > 8:
+                self._staging_live.pop(next(iter(self._staging_live)))
+            return out
+
+    def _claim_staging(self, shapes, dtypes):
+        """(arrays, arena handles): the released scratch when its
+        shapes match, else fresh buffers (arena-backed when possible)."""
+        scratch = self._stage_scratch
+        if (scratch is not None and len(scratch) == len(shapes)
+                and all(a.shape == s and a.dtype == d
+                        for a, s, d in zip(scratch, shapes, dtypes))):
+            self._stage_scratch = None
+            handles = self._stage_handles
+            self._stage_handles = None
+            self.staging_reuses += 1
+            return scratch, handles
+        out, handles = [], []
+        for shape, dtype in zip(shapes, dtypes):
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            arr, h = None, None
+            if self._arena is not None:
+                padded = max(64, -(-nbytes // 64) * 64)
+                try:
+                    h = self._arena.allocate(padded, allow_defrag=False)
+                    arr = (h.view()[:nbytes].view(dtype).reshape(shape))
+                except MemoryError:
+                    h = None
+            if arr is None:
+                arr = np.empty(shape, dtype)
+            out.append(arr)
+            handles.append(h)
+        return out, handles
+
+    def release_staging(self, staging: Sequence[np.ndarray]) -> None:
+        """Hand one restore's staging buffers back for reuse. ONLY safe
+        once nothing can still read them — the executor calls this
+        after blocking on the scatter that consumed the frames (a CPU
+        ``device_put`` may zero-copy alias the buffers, so releasing
+        early would let the next restore scribble over in-flight data).
+        The buffers become the scratch slot the next ``stage_frames``
+        reuses; the newest release wins (its shapes are the likeliest
+        to repeat) and the displaced buffers' arena handles go back to
+        the arena instead of stacking up."""
+        if not staging:
+            return
+        with self._lock:
+            handles = self._staging_live.pop(id(staging[0]), None)
+            old_handles = self._stage_handles
+            self._stage_scratch = list(staging)
+            self._stage_handles = handles
+            if old_handles and self._arena is not None:
+                for h in old_handles:
+                    if h is not None:
+                        self._arena.release(h)
 
     def note_restored(self, nbytes: int) -> None:
         """Credit a LANDED restore (the executor's finish-restore
         success path). Kept separate from :meth:`stage_frames` so a
         restore that stages but then fails mid-transfer leaves
         ``bytes_restored`` honest."""
-        self.bytes_restored += int(nbytes)
+        with self._lock:
+            self.bytes_restored += int(nbytes)
 
     # --- introspection ----------------------------------------------------
     def stats(self) -> dict:
-        return {
-            "capacity_bytes": self.capacity_bytes,
-            "bytes_used": self.bytes_used,
-            "bytes_used_peak": self.bytes_used_peak,
-            "entries": len(self._store),
-            "spills": self.spills,
-            "refreshes": self.refreshes,
-            "hits": self.hits,
-            "misses": self.misses,
-            "evictions": self.evictions,
-            "rejected": self.rejected,
-            "bytes_spilled": self.bytes_spilled,
-            "bytes_restored": self.bytes_restored,
-        }
+        with self._lock:
+            return {
+                "capacity_bytes": self.capacity_bytes,
+                "bytes_used": self.bytes_used,
+                "bytes_used_peak": self.bytes_used_peak,
+                "entries": len(self._store),
+                "spills": self.spills,
+                "refreshes": self.refreshes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "rejected": self.rejected,
+                "bytes_spilled": self.bytes_spilled,
+                "bytes_restored": self.bytes_restored,
+                "stage_copies": self.stage_copies,
+                "bytes_staged": self.bytes_staged,
+                "staging_reuses": self.staging_reuses,
+            }
 
     def audit(self) -> List[str]:
         """Host-tier invariant sweep (the auditor's new tier): byte
         accounting must agree with the store, every entry must have a
         size, the cap must hold, and arena handles must describe live
         entries only."""
+        with self._lock:
+            return self._audit_locked()
+
+    def _audit_locked(self) -> List[str]:
         v: List[str] = []
         if set(self._store) != set(self._nbytes):
             v.append("host tier store/size-map key mismatch: "

@@ -15,6 +15,20 @@ metrics registry); the group owns admission:
   prompt+generation tokens). Affinity keeps shared-prefix traffic on
   the replica whose prefix cache already holds the blocks; load keeps
   the pools balanced when nothing is shared.
+- **Disaggregation** (``roles=``, docs/SERVING.md): replicas split into
+  a prefill pool and a decode pool. Long prompts (>=
+  ``serve.prefill_role_threshold_tokens``) without a full decode-side
+  prefix hit route to a prefill replica, which runs the prompt through
+  the normal chunked-prefill path with ``publish_kv=True`` — the
+  finished KV blocks land as content-addressed frames in the SHARED
+  transfer tier (``HostKVTier`` today; an ICI device-to-device
+  transport slots behind the same put/lookup/stage interface). The
+  request is then handed to its decode replica, whose admission lookup
+  restores the frames via ``begin_restore`` — it lands
+  already-prefilled, and decode slots never donate step budget to cold
+  prefill for routed-long prompts. Every transfer failure (evicted
+  frame, refused/failed restore, prefill-role death) degrades to cold
+  prefill on the decode side — a latency loss, never a request loss.
 - **Observability** rides the dstfleet exchange: after (and during) a
   drain each replica's registry is published as ``rank<i>.json`` with
   the ``replica`` label, so ``merge_fleet_dir`` / ``bin/dst top``
@@ -29,59 +43,115 @@ way: run one engine per process with ``serve.fleet_rank``/
 ``serve.fleet_replica`` set and share the ``fleet_dir``.
 """
 
+import dataclasses
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["route_requests", "ReplicaGroup"]
+
+_ROLES = ("prefill", "decode")
+
+
+def _prompt_of(r):
+    prompt = getattr(r, "prompt", None)
+    if prompt is None and isinstance(r, dict):
+        prompt = r.get("prompt")
+    return prompt
+
+
+def _gen_of(r):
+    gen = getattr(r, "max_new_tokens", None)
+    if gen is None and isinstance(r, dict):
+        gen = r.get("max_new_tokens", 0)
+    return int(gen or 0)
+
+
+def _best_replica(keys, candidates: Sequence[int],
+                  affinity: List[set], loads: List[int]) -> int:
+    """Longest contiguous prefix-affinity hit among ``candidates``,
+    ties (and the no-hit case) to the least-loaded. The ONE placement
+    rule — wave routing and per-request decode-target picks must agree,
+    or a handed-off request restores on a replica whose affinity the
+    router never learned."""
+    hits = {}
+    for i in candidates:
+        n = 0
+        for k in keys:
+            if k not in affinity[i]:
+                break
+            n += 1
+        hits[i] = n
+    best = max(hits.values()) if hits else 0
+    if best > 0:
+        return min((i for i in candidates if hits[i] == best),
+                   key=lambda i: loads[i])
+    return min(candidates, key=lambda i: loads[i])
 
 
 def route_requests(requests: Sequence, n_replicas: int,
                    block_size: int = 16,
                    affinity: Optional[List[set]] = None,
                    loads: Optional[List[int]] = None,
+                   roles: Optional[Sequence[str]] = None,
+                   prefill_threshold_tokens: int = 0,
                    ) -> List[List[Any]]:
     """Assign ``requests`` to ``n_replicas`` buckets by prefix affinity
     then load (see module doc). Pure and deterministic — unit-testable
     without engines. ``affinity``/``loads`` are per-replica state
     (mutated in place) so successive admission waves keep their history;
-    None starts cold."""
+    None starts cold.
+
+    ``roles`` switches on shape-aware disaggregated routing: a prompt of
+    >= ``prefill_threshold_tokens`` tokens whose blocks are NOT already
+    fully affine to some decode replica goes to the prefill pool
+    (affinity-then-load within the pool, so shared long prefixes reuse
+    the prefill replica's own prefix cache); everything else — short
+    prompts, follow-ups riding a full prefix hit — goes straight to
+    decode admission."""
     from deepspeed_tpu.inference.kv_pool import block_content_keys
 
     if n_replicas <= 0:
         raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    prefill_idx: List[int] = []
+    decode_idx: List[int] = list(range(n_replicas))
+    if roles is not None:
+        if len(roles) != n_replicas:
+            raise ValueError(
+                f"roles ({len(roles)}) must match n_replicas "
+                f"({n_replicas})")
+        bad = sorted(set(roles) - set(_ROLES))
+        if bad:
+            raise ValueError(
+                f"unknown roles {bad}: expected {list(_ROLES)}")
+        prefill_idx = [i for i, r in enumerate(roles) if r == "prefill"]
+        decode_idx = [i for i, r in enumerate(roles) if r == "decode"]
+        if not decode_idx:
+            raise ValueError("roles need at least one decode replica — "
+                             "every request finishes on one")
     affinity = affinity if affinity is not None else [
         set() for _ in range(n_replicas)]
     loads = loads if loads is not None else [0] * n_replicas
     out: List[List[Any]] = [[] for _ in range(n_replicas)]
     for r in requests:
-        prompt = getattr(r, "prompt", None)
-        if prompt is None and isinstance(r, dict):
-            prompt = r.get("prompt")
+        prompt = _prompt_of(r)
         keys = (block_content_keys([int(t) for t in prompt], block_size)
                 if prompt is not None else [])
-        hits = []
-        for i in range(n_replicas):
-            n = 0
-            for k in keys:
-                if k not in affinity[i]:
-                    break
-                n += 1
-            hits.append(n)
-        best_hit = max(hits) if hits else 0
-        if best_hit > 0:
-            # longest shared prefix wins; ties go to the lighter replica
-            idx = min((i for i in range(n_replicas)
-                       if hits[i] == best_hit), key=lambda i: loads[i])
-        else:
-            idx = min(range(n_replicas), key=lambda i: loads[i])
+        candidates = decode_idx
+        if prefill_idx and prompt is not None \
+                and len(prompt) >= prefill_threshold_tokens:
+            # a decode replica already affine to the WHOLE prompt serves
+            # it from its prefix cache cheaper than any transfer could
+            full_hit = bool(keys) and any(
+                all(k in affinity[i] for k in keys) for i in decode_idx)
+            if not full_hit:
+                candidates = prefill_idx
+        idx = _best_replica(keys, candidates, affinity, loads)
         out[idx].append(r)
         affinity[idx].update(keys)
-        gen = getattr(r, "max_new_tokens", None)
-        if gen is None and isinstance(r, dict):
-            gen = r.get("max_new_tokens", 0)
-        loads[idx] += (len(keys) * block_size) + int(gen or 0)
+        loads[idx] += (len(keys) * block_size) + _gen_of(r)
     return out
 
 
@@ -92,10 +162,21 @@ class ReplicaGroup:
     from the same params/config (they may share the params pytree; each
     builds its own serving executor and pool). ``fleet_dir`` turns on
     the snapshot exchange: per-replica registries publish as
-    ``rank<i>.json`` tagged ``replica=i``."""
+    ``rank<i>.json`` tagged ``replica=i``.
+
+    ``roles`` (one of ``"prefill"``/``"decode"`` per engine) turns on
+    disaggregated serving; None reads ``serve.disaggregate`` from the
+    first engine's config and, when set, defaults to one prefill replica
+    plus decode replicas. ``transfer_tier`` is the shared
+    :class:`HostKVTier` both pools address; None builds one from the
+    config's ``host_cache_gb`` (1 GB floor — the transfer tier must
+    hold at least a window of in-flight prompts)."""
 
     def __init__(self, engines: Sequence, fleet_dir: Optional[str] = None,
-                 hosts: Optional[Sequence[str]] = None):
+                 hosts: Optional[Sequence[str]] = None,
+                 roles: Optional[Sequence[str]] = None,
+                 prefill_threshold_tokens: Optional[int] = None,
+                 transfer_tier=None):
         if not engines:
             raise ValueError("ReplicaGroup needs at least one engine")
         self.engines = list(engines)
@@ -106,6 +187,46 @@ class ReplicaGroup:
             raise ValueError(
                 f"hosts ({len(self.hosts)}) must match engines "
                 f"({len(self.engines)})")
+        serve_cfg = getattr(getattr(self.engines[0], "_config", None),
+                            "serve", None)
+        if roles is None and serve_cfg is not None \
+                and getattr(serve_cfg, "disaggregate", False):
+            if len(self.engines) < 2:
+                raise ValueError(
+                    "serve.disaggregate needs >= 2 replicas (one "
+                    "prefill + one decode)")
+            roles = ["prefill"] + ["decode"] * (len(self.engines) - 1)
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != len(self.engines):
+                raise ValueError(
+                    f"roles ({len(roles)}) must match engines "
+                    f"({len(self.engines)})")
+            bad = sorted(set(roles) - set(_ROLES))
+            if bad:
+                raise ValueError(
+                    f"unknown roles {bad}: expected {list(_ROLES)}")
+            if "prefill" in roles and "decode" not in roles:
+                raise ValueError("roles need at least one decode "
+                                 "replica — every request finishes on "
+                                 "one")
+        self.roles = roles
+        if prefill_threshold_tokens is None:
+            prefill_threshold_tokens = int(getattr(
+                serve_cfg, "prefill_role_threshold_tokens", 256)
+                if serve_cfg is not None else 256)
+        self.prefill_threshold_tokens = int(prefill_threshold_tokens)
+        self.transfer_tier = transfer_tier
+        if self.transfer_tier is None and roles is not None \
+                and "prefill" in roles:
+            from deepspeed_tpu.inference.kv_tiering import tier_from_gb
+
+            gb = float(getattr(serve_cfg, "host_cache_gb", 0.0) or 0.0
+                       if serve_cfg is not None else 0.0)
+            smb = int(getattr(serve_cfg, "host_staging_mb", 0)
+                      if serve_cfg is not None else 0)
+            self.transfer_tier = tier_from_gb(max(gb, 1.0),
+                                              staging_mb=smb)
         # routing state persists across serve() waves so prefix
         # affinity survives between admission batches
         self._affinity: List[set] = [set() for _ in self.engines]
@@ -131,6 +252,34 @@ class ReplicaGroup:
         self.publish()
         return merge_fleet_dir(self.fleet_dir)
 
+    @staticmethod
+    def _failed_completions(reqs: Sequence, replica: int,
+                            err: BaseException) -> List[Any]:
+        """Structured terminals for a replica whose drain RAISED: every
+        routed request still resolves to exactly one completion (the
+        fault-tolerance contract), carrying the replica and the error
+        instead of surfacing at join time and vaporizing its siblings'
+        finished results."""
+        from deepspeed_tpu.inference.scheduler import FAILED, Completion
+        import numpy as np
+
+        t = time.time()
+        out = []
+        for j, r in enumerate(reqs):
+            rid = getattr(r, "rid", None)
+            if rid is None and isinstance(r, dict):
+                rid = r.get("rid", j)
+            try:
+                prompt = np.asarray(_prompt_of(r), np.int32).reshape(-1)
+            except (TypeError, ValueError):
+                prompt = np.zeros(0, np.int32)
+            out.append(Completion(
+                rid=rid, prompt=prompt, tokens=np.zeros(0, np.int32),
+                t_submit=t, t_admitted=t, t_first_token=t, t_finish=t,
+                status=FAILED,
+                error=f"replica {replica} raised: {err!r}"))
+        return out
+
     def serve(self, requests: Sequence,
               per_replica_kwargs: Optional[Dict[int, dict]] = None,
               **serve_kwargs) -> List[Any]:
@@ -142,7 +291,15 @@ class ReplicaGroup:
 
         ``per_replica_kwargs`` overlays per-replica overrides on
         ``serve_kwargs`` — the chaos harness injects a
-        ``fault_injector`` into one replica this way."""
+        ``fault_injector`` into one replica this way. With prefill
+        roles configured the drain runs disaggregated (see module doc);
+        a replica whose drain raises resolves its routed requests as
+        FAILED completions instead of poisoning the join."""
+        if self.roles is not None and "prefill" in self.roles \
+                and requests:
+            return self._serve_disaggregated(requests,
+                                             per_replica_kwargs,
+                                             serve_kwargs)
         block_size = int(serve_kwargs.get("block_size", 16))
         assignment = route_requests(requests, len(self.engines),
                                     block_size=block_size,
@@ -150,7 +307,6 @@ class ReplicaGroup:
                                     loads=self._loads)
         self.last_assignment = assignment
         results: List[List[Any]] = [[] for _ in self.engines]
-        errors: List[Tuple[int, BaseException]] = []
 
         def drain(i: int) -> None:
             if not assignment[i]:
@@ -160,8 +316,9 @@ class ReplicaGroup:
                 kw.update(per_replica_kwargs[i])
             try:
                 results[i] = self.engines[i].serve(assignment[i], **kw)
-            except BaseException as e:       # noqa: BLE001 — re-raised below
-                errors.append((i, e))
+            except BaseException as e:       # noqa: BLE001 — resolved below
+                logger.error(f"replica {i} drain failed: {e!r}")
+                results[i] = self._failed_completions(assignment[i], i, e)
 
         threads = [threading.Thread(target=drain, args=(i,),
                                     name=f"replica{i}", daemon=True)
@@ -171,13 +328,211 @@ class ReplicaGroup:
         for t in threads:
             t.join()
         self.publish()
-        if errors:
-            i, e = errors[0]
-            if len(errors) > 1:
-                logger.error(
-                    f"replica group: {len(errors)} replicas failed; "
-                    f"raising the first (replica {i})")
-            raise e
         done = [c for rs in results for c in rs]
+        done.sort(key=lambda c: getattr(c, "t_finish", 0.0))
+        return done
+
+    # --- disaggregated serving (docs/SERVING.md) --------------------------
+
+    def _serve_disaggregated(self, requests: Sequence,
+                             per_replica_kwargs: Optional[Dict[int, dict]],
+                             serve_kwargs: dict) -> List[Any]:
+        """Prefill-pool / decode-pool drain over the shared transfer
+        tier. Long prompts run a 1-token prefill leg on their prefill
+        replica (``publish_kv=True`` spills every finished prompt block
+        into the tier), then hand off to a decode replica's
+        ``HandoffQueue``; its admission restores the frames and the
+        stream lands already-prefilled. The leg's single sampled token
+        is DISCARDED — the decode side recomputes the last prompt
+        position, so its logits (and every later token) are
+        byte-identical to a colocated serve. Transfer failures degrade
+        to cold prefill on the decode side; the prefill leg dying hands
+        the raw request over, which is the same degrade."""
+        from deepspeed_tpu.inference.kv_pool import block_content_keys
+        from deepspeed_tpu.inference.scheduler import (
+            CANCELLED, REJECTED, TIMED_OUT, HandoffQueue, Request,
+        )
+
+        if serve_kwargs.get("prefix_cache") is False:
+            raise ValueError(
+                "disaggregated serving requires the prefix cache — the "
+                "transfer tier is keyed by its content hashes")
+        if serve_kwargs.get("handoff") is not None \
+                or serve_kwargs.get("publish_kv"):
+            raise ValueError(
+                "handoff/publish_kv are owned by the group in "
+                "disaggregated serving — don't pass them to serve()")
+        tier = self.transfer_tier
+        block_size = int(serve_kwargs.get("block_size", 16))
+        n = len(self.engines)
+        prefill_idx = [i for i, r in enumerate(self.roles)
+                       if r == "prefill"]
+        decode_idx = [i for i, r in enumerate(self.roles)
+                      if r == "decode"]
+
+        # dict requests normalize HERE (the engine would do it anyway):
+        # the prefill leg is a field-level clone, so it needs the
+        # dataclass. Malformed ones route to decode admission as-is and
+        # resolve REJECTED there — same contract as colocated.
+        norm: List[Any] = []
+        for j, r in enumerate(requests):
+            if isinstance(r, dict):
+                try:
+                    r = Request(**dict({"rid": j}, **r))
+                except (TypeError, ValueError):
+                    pass
+            norm.append(r)
+        valid = [r for r in norm if isinstance(r, Request)]
+        # one fleet-wide context bound: decode replicas size their
+        # programs BEFORE the first handoff arrives
+        max_context = serve_kwargs.get("max_context")
+        if max_context is None and valid:
+            max_context = max(len(r.prompt) + r.max_new_tokens
+                              for r in valid)
+
+        assignment = route_requests(
+            norm, n, block_size=block_size, affinity=self._affinity,
+            loads=self._loads, roles=self.roles,
+            prefill_threshold_tokens=self.prefill_threshold_tokens)
+        # a malformed request (dict that failed to normalize) can't run
+        # a prefill leg — it goes straight to a decode replica, which
+        # resolves it REJECTED on its own stream slot
+        for i in prefill_idx:
+            bad = [r for r in assignment[i]
+                   if not isinstance(r, Request)]
+            if bad:
+                assignment[i] = [r for r in assignment[i]
+                                 if isinstance(r, Request)]
+                jdx = min(decode_idx, key=lambda j: self._loads[j])
+                assignment[jdx].extend(bad)
+        self.last_assignment = assignment
+
+        # pick each routed-long request's decode target NOW (same
+        # placement rule as the router, over the decode pool only) so
+        # its queue can expect the handoff before any thread starts —
+        # expected>0 keeps the decode stream draining until the
+        # prefill leg resolves one way or the other
+        handoffs: Dict[int, HandoffQueue] = {
+            j: HandoffQueue() for j in decode_idx}
+        target: Dict[Any, int] = {}
+        t_pub: Dict[Any, float] = {}
+        for i in prefill_idx:
+            for r in assignment[i]:
+                keys = block_content_keys(
+                    [int(t) for t in r.prompt], block_size)
+                jdx = _best_replica(keys, decode_idx, self._affinity,
+                                    self._loads)
+                self._affinity[jdx].update(keys)
+                self._loads[jdx] += (len(keys) * block_size
+                                     + r.max_new_tokens)
+                target[r.rid] = jdx
+                handoffs[jdx].expect(1)
+
+        results: List[List[Any]] = [[] for _ in self.engines]
+        surfaced: List[Any] = []
+
+        def overlay(i: int) -> dict:
+            kw = dict(serve_kwargs)
+            if per_replica_kwargs and i in per_replica_kwargs:
+                kw.update(per_replica_kwargs[i])
+            kw["max_context"] = max_context
+            kw["host_tier"] = tier
+            kw["prefix_cache"] = True       # validated not-False above
+            kw.pop("host_cache_gb", None)   # the tier object rules
+            return kw
+
+        def prefill_drain(i: int) -> None:
+            bucket = assignment[i]
+            if not bucket:
+                return
+            by_rid = {r.rid: r for r in bucket}
+            pending = dict(by_rid)
+            kw = overlay(i)
+            try:
+                legs = [dataclasses.replace(r, max_new_tokens=1)
+                        for r in bucket]
+                for comp in self.engines[i].generate_stream(
+                        legs, publish_kv=True, **kw):
+                    orig = pending.pop(comp.rid, None)
+                    if orig is None:
+                        continue
+                    jdx = target[comp.rid]
+                    if comp.status in (TIMED_OUT, CANCELLED, REJECTED):
+                        # the leg's terminal IS the request's terminal:
+                        # a deadline/cancel/reject outcome must not be
+                        # laundered into a fresh decode attempt
+                        surfaced.append(comp)
+                        handoffs[jdx].abandon(1)
+                        continue
+                    # COMPLETED (published) or FAILED/preempted (frames
+                    # may be partial): hand off either way — decode's
+                    # tiered lookup restores whatever the tier holds
+                    # and cold-prefills the rest (counted as a degrade
+                    # when short)
+                    t_pub[comp.rid] = time.time()
+                    handoffs[jdx].put(dataclasses.replace(
+                        orig, routed_prefill=True))
+            except BaseException as e:   # noqa: BLE001 — degraded below
+                logger.error(f"prefill replica {i} died: {e!r}")
+            finally:
+                # prefill-role death with queued handoffs: whatever
+                # never resolved hands over RAW — the decode replica
+                # cold-prefills it (degrade, not loss)
+                for rid, orig in pending.items():
+                    t_pub.pop(rid, None)
+                    handoffs[target[rid]].put(dataclasses.replace(
+                        orig, routed_prefill=True))
+
+        def decode_drain(j: int) -> None:
+            kw = overlay(j)
+            if max_context is None:
+                # no valid requests anywhere (so no legs and no
+                # handoffs): a decode stream can't size programs — let
+                # the engine resolve the malformed leftovers colocated
+                kw.pop("max_context")
+                kw.pop("host_tier")
+            try:
+                results[j] = list(self.engines[j].generate_stream(
+                    assignment[j],
+                    handoff=(handoffs[j] if max_context is not None
+                             else None),
+                    **kw))
+            except BaseException as e:   # noqa: BLE001 — resolved below
+                logger.error(f"decode replica {j} drain failed: {e!r}")
+                handoffs[j].close()
+                leftovers = handoffs[j].drain()
+                results[j] = self._failed_completions(
+                    list(assignment[j]) + leftovers, j, e)
+
+        threads = [threading.Thread(target=prefill_drain, args=(i,),
+                                    name=f"prefill{i}", daemon=True)
+                   for i in prefill_idx]
+        threads += [threading.Thread(target=decode_drain, args=(j,),
+                                     name=f"decode{j}", daemon=True)
+                    for j in decode_idx]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # a decode drain that died AFTER its prefill legs queued more
+        # work still owes those requests terminals
+        for j in decode_idx:
+            stranded = handoffs[j].drain()
+            if stranded:
+                results[j] += self._failed_completions(
+                    stranded, j, RuntimeError("decode drain exited with "
+                                              "handoffs queued"))
+        # handoff latency: publish (leg finished, frames in the tier) →
+        # decode admission — observed into the DECODE replica's registry
+        # so `bin/dst top` and the fleet merge see it per-serving-shard
+        for j in decode_idx:
+            for comp in results[j]:
+                t0 = t_pub.get(comp.rid)
+                if t0 is not None and comp.t_admitted >= t0:
+                    self.engines[j].metrics.observe(
+                        "serve.disagg.handoff_latency_s",
+                        comp.t_admitted - t0)
+        self.publish()
+        done = surfaced + [c for rs in results for c in rs]
         done.sort(key=lambda c: getattr(c, "t_finish", 0.0))
         return done

@@ -184,6 +184,7 @@ Executor protocol (duck-typed)::
 """
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from typing import Any, Deque, Dict, Iterable, List, Optional, Set
@@ -235,6 +236,12 @@ class Request:
     arrival_time: Optional[float] = None
     deadline_s: Optional[float] = None
     queue_timeout_s: Optional[float] = None
+    # disaggregated serving (docs/SERVING.md): True marks a request a
+    # prefill-role replica already prefilled and PUBLISHED into the
+    # shared transfer tier — the decode-side scheduler expects its
+    # admission lookup to cover the whole prompt, and counts/traces a
+    # DISAGG_DEGRADE when it has to cold-prefill instead
+    routed_prefill: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -317,6 +324,64 @@ class _Restore:
         self.t_mono = t_mono
 
 
+class HandoffQueue:
+    """Thread-safe prefill→decode handoff channel (disaggregated
+    serving, docs/SERVING.md). A prefill-role replica ``put``s each
+    request the moment its prompt KV is published into the shared
+    transfer tier; the decode-role scheduler ``drain``s at every step
+    boundary and submits the requests into its own queue — admission's
+    tiered lookup then finds the published frames and the request lands
+    already-prefilled through the ordinary restore machinery.
+
+    ``expect(n)`` pre-registers handoffs still to come, so the decode
+    scheduler's ``busy`` stays True (and its serve loop keeps stepping)
+    while the prefill leg is still working; ``abandon(n)`` retracts
+    expectations whose request will never arrive (the prefill leg
+    surfaced a terminal itself). The publish ALWAYS happens before the
+    ``put`` — the channel carries only requests whose frames are
+    already lookup-able, so there is no publish/admit race to order."""
+
+    def __init__(self, expected: int = 0):
+        self._lock = threading.Lock()
+        self._q: Deque[Request] = deque()
+        self._expected = int(expected)
+
+    def expect(self, n: int = 1) -> None:
+        with self._lock:
+            self._expected += int(n)
+
+    def abandon(self, n: int = 1) -> None:
+        with self._lock:
+            self._expected = max(0, self._expected - int(n))
+
+    def put(self, req: Request) -> None:
+        with self._lock:
+            self._q.append(req)
+            self._expected = max(0, self._expected - 1)
+
+    def drain(self) -> List[Request]:
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def done(self) -> bool:
+        """Nothing queued and nothing further expected."""
+        with self._lock:
+            return self._expected <= 0 and not self._q
+
+    def close(self) -> None:
+        """Retract ALL outstanding expectations (prefill-role death:
+        whatever was never handed off stops blocking the decode loop).
+        Queued requests stay drainable."""
+        with self._lock:
+            self._expected = 0
+
+
 class ContinuousBatchingScheduler:
     """FIFO request queue over ``num_slots`` decode slots + a block pool.
 
@@ -337,7 +402,9 @@ class ContinuousBatchingScheduler:
                  host_tier=None, metrics=None, tracer=None, slo=None,
                  prefill_chunk_tokens: int = 0,
                  speculative: bool = False, draft_len: int = 8,
-                 draft_ngram: int = 2):
+                 draft_ngram: int = 2,
+                 handoff: Optional[HandoffQueue] = None,
+                 publish_prefixes: bool = False):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -458,6 +525,27 @@ class ContinuousBatchingScheduler:
         self.host_spill_failures = 0
         self.last_restore_error: Optional[str] = None
         self.last_spill_error: Optional[str] = None
+        # DISAGGREGATED SERVING (docs/SERVING.md): ``handoff`` makes
+        # this a DECODE-role scheduler — the channel is drained at every
+        # step boundary and its requests submit into the ordinary queue,
+        # where admission's tiered lookup finds the frames the prefill
+        # role published. ``publish_prefixes`` makes it a PREFILL-role
+        # scheduler — every COMPLETED request's full prompt blocks are
+        # pushed into the host tier at finish time, BEFORE the
+        # completion is surfaced, so the handoff that follows can never
+        # race the publish. Both ride the tier machinery above; neither
+        # changes colocated behavior when unset.
+        self.handoff = handoff
+        self.publish_prefixes = bool(publish_prefixes)
+        if self.publish_prefixes and host_tier is None:
+            raise ValueError(
+                "publish_prefixes=True needs a host_tier — published "
+                "frames ARE the transfer")
+        self.disagg_handoffs = 0
+        self.disagg_degrades = 0
+        self.disagg_restored = 0
+        self.published_requests = 0
+        self.published_blocks = 0
         self.tables = SlotBlockTables(num_slots, table_width, pool)
         self.queue: Deque[Request] = deque()
         self.slots = [_Slot() for _ in range(num_slots)]
@@ -617,7 +705,9 @@ class ContinuousBatchingScheduler:
     @property
     def busy(self) -> bool:
         return (bool(self.queue) or bool(self.active.any())
-                or bool(self.prefilling.any()) or bool(self._restores))
+                or bool(self.prefilling.any()) or bool(self._restores)
+                or (self.handoff is not None
+                    and not self.handoff.done()))
 
     @property
     def restoring(self) -> np.ndarray:
@@ -663,6 +753,75 @@ class ContinuousBatchingScheduler:
             if self.tracer is not None:
                 self.tracer.instant("SPILL_FAIL", cat="tiering",
                                     blocks=len(entries), error=str(e))
+
+    # --- disaggregated serving: handoff / publish / degrade ------------------
+    def _drain_handoffs(self, now: float) -> List[Completion]:
+        """Admit requests a prefill-role replica handed off (their
+        published frames are already in the shared tier — the put
+        happens after the publish). Validation failures resolve
+        REJECTED exactly like ``generate_stream``'s pre-submit checks:
+        a handed-off request still gets its one terminal Completion."""
+        done: List[Completion] = []
+        for req in self.handoff.drain():
+            self.disagg_handoffs += 1
+            if self.metrics is not None:
+                self.metrics.inc("serve.disagg.handoffs")
+            if self.tracer is not None:
+                self.tracer.instant("DISAGG_HANDOFF", cat="disagg",
+                                    rid=req.rid,
+                                    prompt_tokens=len(req.prompt))
+            try:
+                self.submit(req, now=now)
+            except ValueError as e:
+                done.append(self._obs_terminal(Completion(
+                    rid=req.rid, prompt=req.prompt,
+                    tokens=np.zeros(0, np.int32), t_submit=now,
+                    t_admitted=now, t_first_token=now, t_finish=now,
+                    status=REJECTED, error=str(e))))
+        return done
+
+    def _note_disagg_degrade(self, req: Request, reason: str) -> None:
+        """A routed-prefill request is about to cold-prefill on the
+        decode side — the transfer failed CLEANLY (frames evicted
+        between publish and restore, restore refused/failed). Counted
+        and traced, never a terminal: degrade-to-cold-prefill is the
+        contract, the stream stays byte-identical."""
+        self.disagg_degrades += 1
+        if self.metrics is not None:
+            self.metrics.inc("serve.disagg.degrades")
+        if self.tracer is not None:
+            self.tracer.instant("DISAGG_DEGRADE", cat="disagg",
+                                rid=req.rid, reason=reason)
+
+    def _publish_slot_prefix(self, slot_id: int) -> None:
+        """PREFILL-role finish hook: push the slot's full prompt blocks
+        into the host tier NOW (before the blocks release), making the
+        tier the transfer — a decode-role admission that looks these
+        keys up after the completion surfaces is guaranteed to find
+        them (modulo the tier's own capacity eviction, which the decode
+        side degrades through). Runs after ``_register_slot_prefix``,
+        so the executor's spill gather dedups against frames the tier
+        already holds via ``touch``."""
+        slot = self.slots[slot_id]
+        bs = self.pool.block_size
+        blocks = self.tables.blocks_of(slot_id)
+        n_full = min(slot.seq_len // bs, len(blocks))
+        if n_full < 1:
+            return
+        stream = np.concatenate(
+            [slot.req.prompt, np.asarray(slot.out, np.int32)])
+        keys = block_content_keys(stream[:n_full * bs], bs,
+                                  self.pool.salt)
+        self._pending_spills.extend(zip(keys, blocks[:n_full]))
+        self._flush_spills()
+        self.published_requests += 1
+        self.published_blocks += n_full
+        if self.metrics is not None:
+            self.metrics.inc("serve.disagg.published_requests")
+            self.metrics.inc("serve.disagg.published_blocks", n_full)
+        if self.tracer is not None:
+            self.tracer.instant("DISAGG_PUBLISH", cat="disagg",
+                                rid=slot.req.rid, blocks=n_full)
 
     def next_arrival(self) -> Optional[float]:
         """Earliest queued arrival_time, for idle waiting."""
@@ -862,6 +1021,20 @@ class ContinuousBatchingScheduler:
                 # must not re-count), and frames this very allocation
                 # just evicted are already host-hittable.
                 host_keys = self.host_tier.lookup(keys[len(matched):])
+            if req.routed_prefill:
+                # the prefill role published this prompt — anything the
+                # two-tier walk fails to cover will cold-prefill here,
+                # which is exactly the degrade contract (frames evicted
+                # between publish and restore, tier capacity, etc.)
+                if not self.prefix_cache:
+                    self._note_disagg_degrade(
+                        req, "decode replica has no prefix cache")
+                else:
+                    covered_blocks = len(matched) + len(host_keys)
+                    if covered_blocks < len(keys):
+                        self._note_disagg_degrade(
+                            req, f"transfer covers {covered_blocks}/"
+                            f"{len(keys)} prompt blocks")
             if host_keys:
                 blocks = self.tables.blocks_of(slot_id)
                 targets = blocks[len(shared):len(shared) + len(host_keys)]
@@ -897,6 +1070,9 @@ class ContinuousBatchingScheduler:
                 self.host_restore_failures += 1
                 if self.metrics is not None:
                     self.metrics.inc("serve.host_restore_failures")
+                if req.routed_prefill:
+                    self._note_disagg_degrade(
+                        req, "begin_restore refused the transfer")
             if self.chunk_tokens:
                 # chunked prefill: bind the slot (CoW before the first
                 # write, same isolation envelope) but feed NO tokens yet
@@ -1136,9 +1312,18 @@ class ContinuousBatchingScheduler:
                 # host-restored tokens skip prefill exactly like device
                 # hits — they count toward the same token hit-rate
                 self.cache_hit_tokens += st.start - st.dev_start
+                if req.routed_prefill:
+                    # the handed-off request landed already-prefilled —
+                    # the disaggregation payoff, counted per request
+                    self.disagg_restored += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("serve.disagg.restored")
             else:
                 start = st.dev_start
                 self.host_restore_failures += 1
+                if req.routed_prefill:
+                    self._note_disagg_degrade(
+                        req, "restore failed on the decode side")
             if self.chunk_tokens:
                 # the restored slot enters PREFILLING at its covered
                 # offset — the ragged step feeds the uncovered tail in
@@ -1199,6 +1384,10 @@ class ContinuousBatchingScheduler:
         # at ref 0 registered blocks park on the cache LRU, unregistered
         # ones free
         self._register_slot_prefix(slot_id)
+        if self.publish_prefixes:
+            # prefill role: the prompt's frames reach the transfer tier
+            # before this completion can trigger the decode-side handoff
+            self._publish_slot_prefix(slot_id)
         self.tables.release(slot_id)   # blocks recycle to the pool
         self._clear_slot(slot_id)
         return comp
@@ -1377,8 +1566,12 @@ class ContinuousBatchingScheduler:
         if fi is not None:
             for rid in fi.cancels(self._step_idx):
                 self.cancel(rid)
+        # handed-off requests join the queue FIRST so this very step's
+        # admission can restore them (their frames are already published)
+        done = (self._drain_handoffs(now)
+                if self.handoff is not None else [])
         # cancellation/deadline enforcement point: chunk boundaries only
-        done = self._reap(now)
+        done.extend(self._reap(now))
         # land restores dispatched last step (their transfer overlapped
         # that step's decode) BEFORE growth/admission: the finished slot
         # joins this step's decode and its registered prefix is already
@@ -1811,6 +2004,9 @@ class ContinuousBatchingScheduler:
             m.set_gauge("serve.restoring_slots", len(self._restores))
             m.set_gauge("serve.queued", len(self.queue))
             m.set_gauge("serve.live_tokens", int(self.seq_lens.sum()))
+            if self.handoff is not None:
+                m.set_gauge("serve.disagg.handoff_queue_depth",
+                            self.handoff.depth())
         if self.slo is not None:
             # burn-rate/goodput refresh (rate-limited inside the
             # tracker; a clock read per chunk when nothing to do)
@@ -1920,8 +2116,9 @@ class ContinuousBatchingScheduler:
         while self.busy:
             done = self.step()
             yield from done
-            if not self.active.any() and not self.prefilling.any() \
-                    and not self._restores and self.queue:
+            idle = (not self.active.any() and not self.prefilling.any()
+                    and not self._restores)
+            if idle and self.queue:
                 nxt = self.next_arrival()
                 if nxt is not None:
                     wait = nxt - time.time()
@@ -1933,6 +2130,11 @@ class ContinuousBatchingScheduler:
                     # construction (finishing slots free blocks), but do
                     # not spin silently if an executor misbehaves
                     time.sleep(poll_interval)
+            elif idle and not self.queue and self.handoff is not None \
+                    and not self.handoff.done():
+                # decode role waiting on the prefill leg: yield the core
+                # instead of hot-stepping — the put lands between sleeps
+                time.sleep(poll_interval)
 
     def run(self, poll_interval: float = 0.001) -> List[Completion]:
         """Drain to completion; all completions in finish order."""
@@ -1982,6 +2184,23 @@ class ContinuousBatchingScheduler:
             "host_bytes_restored": ts.get("bytes_restored", 0),
             "host_bytes_used": ts.get("bytes_used", 0),
             "host_entries": ts.get("entries", 0),
+        }
+
+    def disagg_stats(self) -> dict:
+        """Disaggregated-serving counters for ONE scheduler's role
+        (bench artifact / acceptance pins). A prefill-role scheduler
+        moves the ``published_*`` numbers; a decode-role one moves
+        ``handoffs``/``restored``/``degrades`` — ``restored +
+        degrades`` accounts for every routed-prefill request that
+        reached admission. Monotonic over the scheduler's life."""
+        return {
+            "prefill_role": self.publish_prefixes,
+            "decode_role": self.handoff is not None,
+            "handoffs": self.disagg_handoffs,
+            "restored": self.disagg_restored,
+            "degrades": self.disagg_degrades,
+            "published_requests": self.published_requests,
+            "published_blocks": self.published_blocks,
         }
 
     def spec_stats(self) -> dict:

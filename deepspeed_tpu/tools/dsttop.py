@@ -118,6 +118,13 @@ def build_sample(snap: dict, prev: Optional[dict] = None,
         "burn_rates": burn,
         "slo": snap.get("serve.slo", {}),
         "completions": completions,
+        "disagg": {
+            "handoffs": c.get("serve.disagg.handoffs", 0),
+            "restored": c.get("serve.disagg.restored", 0),
+            "degrades": c.get("serve.disagg.degrades", 0),
+            "queue_depth": g.get("serve.disagg.handoff_queue_depth", 0),
+            "handoff_s": pct("serve.disagg.handoff_latency_s"),
+        },
         "fleet": fleet,
         "hosts": per_host_step,
         "replicas": replicas,
@@ -164,6 +171,16 @@ def render_text(sample: dict, width: int = 78) -> str:
         lines.append("done   " + "  ".join(
             f"{k}={int(v)}" for k, v in sorted(
                 sample["completions"].items())))
+    dg = sample.get("disagg") or {}
+    if dg.get("handoffs") or dg.get("degrades"):
+        lat_s = dg.get("handoff_s", {})
+        lines.append(
+            f"disagg handoffs={int(dg['handoffs'])}"
+            f"  restored={int(dg['restored'])}"
+            f"  degrades={int(dg['degrades'])}"
+            f"  queued={int(dg['queue_depth'])}"
+            + (f"  handoff p99 {_fmt(lat_s.get('p99'))}s"
+               if lat_s.get("count") else ""))
     if sample["burn_rates"]:
         lines.append("burn   " + "  ".join(
             f"{k}={_fmt(v, 2)}" for k, v in sorted(

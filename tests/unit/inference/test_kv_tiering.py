@@ -114,6 +114,61 @@ def test_tier_stage_frames_layout_and_alias_guard():
     assert t.stage_frames([(b"a", 5), (b"zzz", 7)]) is None
 
 
+def test_tier_stage_frames_pad_to_zero_fills_and_counts():
+    """pad_to stages DIRECTLY at the padded lane width (no post-hoc
+    concat copy) with zeroed pad lanes, and the staged-bytes/copy
+    counters see every stage."""
+    t = HostKVTier(1 << 20)
+    fa, fb = [frame(0), frame(10)], [frame(1), frame(11)]
+    t.put(b"a", fa)
+    t.put(b"b", fb)
+    staged = t.stage_frames([(b"a", 5), (b"b", 7)], pad_to=4)
+    assert [s.shape for s in staged] == [(2, 4, 4, 3), (2, 4, 4, 3)]
+    np.testing.assert_array_equal(staged[0][:, 0], fa[0])
+    np.testing.assert_array_equal(staged[1][:, 1], fb[1])
+    assert float(np.abs(staged[0][:, 2:]).max()) == 0.0
+    assert float(np.abs(staged[1][:, 2:]).max()) == 0.0
+    st = t.stats()
+    assert st["stage_copies"] == 4          # 2 frames × 2 leaves
+    assert st["bytes_staged"] == sum(s.nbytes for s in staged)
+    # the alias guard holds at padded widths too
+    t.get(b"a")[0][:] = -99.0
+    assert float(staged[0][:, 0].max()) != -99.0
+
+
+def test_tier_staging_scratch_reuse_and_release_discipline():
+    """A released staging becomes the scratch slot and the NEXT
+    same-shape stage reuses it (the synchronous-handoff fast path); a
+    stage while the previous staging is still un-released must mint
+    fresh buffers (the restore may still be reading them)."""
+    t = HostKVTier(1 << 20, staging_mb=1)
+    t.put(b"a", [frame(0)])
+    t.put(b"b", [frame(1)])
+    s1 = t.stage_frames([(b"a", 5)], pad_to=2)
+    # un-released: a concurrent stage must NOT alias the live staging
+    s2 = t.stage_frames([(b"b", 6)], pad_to=2)
+    assert s2[0] is not s1[0]
+    assert t.stats()["staging_reuses"] == 0
+    t.release_staging(s2)
+    s3 = t.stage_frames([(b"a", 5)], pad_to=2)
+    assert s3[0] is s2[0]                    # scratch slot reused
+    np.testing.assert_array_equal(s3[0][:, 0], frame(0))
+    assert t.stats()["staging_reuses"] == 1
+    # newest-wins: releasing two stagings keeps the LATER one as
+    # scratch; the displaced one's arena slots free (no leak)
+    t.release_staging(s1)
+    t.release_staging(s3)
+    free_before = t._arena.total_free if t._arena is not None else None
+    s4 = t.stage_frames([(b"b", 6)], pad_to=2)
+    assert s4[0] is s3[0]
+    np.testing.assert_array_equal(s4[0][:, 0], frame(1))
+    assert t.stats()["staging_reuses"] == 2
+    if free_before is not None:
+        assert (t._arena.total_free if t._arena is not None
+                else 0) <= free_before
+    assert not t.audit()
+
+
 def test_tier_arena_staging_roundtrip_and_release():
     """staging_mb > 0: frames live in the contiguous arena (stable host
     addresses, the swapper idiom) and eviction releases their slots for

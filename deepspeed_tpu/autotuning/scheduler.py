@@ -104,11 +104,20 @@ class ResourceManager:
         self.nodes = [Node(h, s) for h, s in hosts.items()]
         self.results_dir = results_dir
         self.exec_fn = exec_fn or _default_exec_fn
+        # queue/running/_seen/experiment_count live on the dispatch
+        # thread only — _run_one workers never touch them (they get
+        # their exp by argument and report through
+        # finished_experiments, which IS cross-thread and locked)
+        # dstlint: benign-race=dispatch-thread only; workers get exp by arg
         self.experiment_queue: List[Dict[str, Any]] = []
+        # dstlint: benign-race=dispatch-thread only; reaped on dispatch
         self.running: Dict[int, tuple] = {}
-        self.finished_experiments: Dict[int, tuple] = {}
+        # dstlint: benign-race=dispatch-thread only
         self.experiment_count = 0
+        # dstlint: benign-race=dispatch-thread only
         self._seen = set()
+        self._lock = threading.Lock()
+        self.finished_experiments: Dict[int, tuple] = {}
 
     # -- queueing ----------------------------------------------------------
     def schedule_experiments(self, exps: List[Dict[str, Any]]) -> None:
@@ -138,7 +147,8 @@ class ResourceManager:
                 # pool stay valid when the search resumes on a smaller one
                 logger.info(f"autotuning scheduler: skipping {exp['name']} "
                             f"(results exist)")
-                self.finished_experiments[exp["exp_id"]] = (exp, None)
+                with self._lock:
+                    self.finished_experiments[exp["exp_id"]] = (exp, None)
                 continue
             # an unsatisfiable request would head-of-line-block run()
             # forever at POLL_S — record it as failed instead of queueing.
@@ -153,8 +163,9 @@ class ResourceManager:
                     f"{exp['num_slots_per_node']} slots but only {capable} "
                     f"of {len(self.nodes)} node(s) have that many slots — "
                     f"recording as failed")
-                self.finished_experiments[exp["exp_id"]] = (
-                    exp, "infeasible resource request for this pool")
+                with self._lock:
+                    self.finished_experiments[exp["exp_id"]] = (
+                        exp, "infeasible resource request for this pool")
                 continue
             self.experiment_queue.append(exp)
 
@@ -187,7 +198,8 @@ class ResourceManager:
         except Exception as e:      # noqa: BLE001 — any failure is a result
             err = str(e)
             logger.warning(f"autotuning scheduler: {exp['name']} failed: {e}")
-        self.finished_experiments[exp["exp_id"]] = (exp, err)
+        with self._lock:
+            self.finished_experiments[exp["exp_id"]] = (exp, err)
 
     def _reap(self) -> None:
         done = [eid for eid, (t, _, _) in self.running.items()
@@ -227,7 +239,9 @@ class ResourceManager:
         """Best (exp, value) over finished experiments' metric files
         (reference scheduler.py parse_results)."""
         best, best_v = None, float("-inf")
-        for exp, err in self.finished_experiments.values():
+        with self._lock:
+            finished = list(self.finished_experiments.values())
+        for exp, err in finished:
             if err:
                 continue
             mf = exp["ds_config"]["autotuning"]["metric_path"]
@@ -250,7 +264,8 @@ class ResourceManager:
             for r in reservations:
                 r.restore_slots()
         self.running = {}
-        self.finished_experiments = {}
+        with self._lock:
+            self.finished_experiments = {}
         self._seen = set()
 
 

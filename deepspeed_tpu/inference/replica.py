@@ -228,9 +228,16 @@ class ReplicaGroup:
             self.transfer_tier = tier_from_gb(max(gb, 1.0),
                                               staging_mb=smb)
         # routing state persists across serve() waves so prefix
-        # affinity survives between admission batches
+        # affinity survives between admission batches; serve() is
+        # callable from concurrent client threads (and the disagg path
+        # picks decode targets while its own drains run), so every
+        # read-pick-update of the affinity/load tables happens under
+        # one lock — the route decision and the load bump it implies
+        # must be atomic (dstlint: conc-unguarded-shared-state)
+        self._route_lock = threading.Lock()
         self._affinity: List[set] = [set() for _ in self.engines]
         self._loads: List[int] = [0] * len(self.engines)
+        self.last_assignment: Optional[List[List[Any]]] = None
 
     def publish(self) -> None:
         """Write every replica's registry snapshot into the fleet dir
@@ -301,11 +308,12 @@ class ReplicaGroup:
                                              per_replica_kwargs,
                                              serve_kwargs)
         block_size = int(serve_kwargs.get("block_size", 16))
-        assignment = route_requests(requests, len(self.engines),
-                                    block_size=block_size,
-                                    affinity=self._affinity,
-                                    loads=self._loads)
-        self.last_assignment = assignment
+        with self._route_lock:
+            assignment = route_requests(requests, len(self.engines),
+                                        block_size=block_size,
+                                        affinity=self._affinity,
+                                        loads=self._loads)
+            self.last_assignment = assignment
         results: List[List[Any]] = [[] for _ in self.engines]
 
         def drain(i: int) -> None:
@@ -390,43 +398,46 @@ class ReplicaGroup:
             max_context = max(len(r.prompt) + r.max_new_tokens
                               for r in valid)
 
-        assignment = route_requests(
-            norm, n, block_size=block_size, affinity=self._affinity,
-            loads=self._loads, roles=self.roles,
-            prefill_threshold_tokens=self.prefill_threshold_tokens)
-        # a malformed request (dict that failed to normalize) can't run
-        # a prefill leg — it goes straight to a decode replica, which
-        # resolves it REJECTED on its own stream slot
-        for i in prefill_idx:
-            bad = [r for r in assignment[i]
-                   if not isinstance(r, Request)]
-            if bad:
-                assignment[i] = [r for r in assignment[i]
-                                 if isinstance(r, Request)]
-                jdx = min(decode_idx, key=lambda j: self._loads[j])
-                assignment[jdx].extend(bad)
-        self.last_assignment = assignment
-
-        # pick each routed-long request's decode target NOW (same
-        # placement rule as the router, over the decode pool only) so
-        # its queue can expect the handoff before any thread starts —
-        # expected>0 keeps the decode stream draining until the
-        # prefill leg resolves one way or the other
         handoffs: Dict[int, HandoffQueue] = {
             j: HandoffQueue() for j in decode_idx}
         target: Dict[Any, int] = {}
         t_pub: Dict[Any, float] = {}
-        for i in prefill_idx:
-            for r in assignment[i]:
-                keys = block_content_keys(
-                    [int(t) for t in r.prompt], block_size)
-                jdx = _best_replica(keys, decode_idx, self._affinity,
-                                    self._loads)
-                self._affinity[jdx].update(keys)
-                self._loads[jdx] += (len(keys) * block_size
-                                     + r.max_new_tokens)
-                target[r.rid] = jdx
-                handoffs[jdx].expect(1)
+        # route + pick each routed-long request's decode target NOW
+        # (same placement rule as the router, over the decode pool
+        # only) so its queue can expect the handoff before any thread
+        # starts — expected>0 keeps the decode stream draining until
+        # the prefill leg resolves one way or the other. The whole
+        # read-pick-update runs under the route lock: a concurrent
+        # serve() wave must see the load bumps this wave implies.
+        with self._route_lock:
+            assignment = route_requests(
+                norm, n, block_size=block_size, affinity=self._affinity,
+                loads=self._loads, roles=self.roles,
+                prefill_threshold_tokens=self.prefill_threshold_tokens)
+            # a malformed request (dict that failed to normalize) can't
+            # run a prefill leg — it goes straight to a decode replica,
+            # which resolves it REJECTED on its own stream slot
+            for i in prefill_idx:
+                bad = [r for r in assignment[i]
+                       if not isinstance(r, Request)]
+                if bad:
+                    assignment[i] = [r for r in assignment[i]
+                                     if isinstance(r, Request)]
+                    jdx = min(decode_idx,
+                              key=lambda j: self._loads[j])
+                    assignment[jdx].extend(bad)
+            self.last_assignment = assignment
+            for i in prefill_idx:
+                for r in assignment[i]:
+                    keys = block_content_keys(
+                        [int(t) for t in r.prompt], block_size)
+                    jdx = _best_replica(keys, decode_idx,
+                                        self._affinity, self._loads)
+                    self._affinity[jdx].update(keys)
+                    self._loads[jdx] += (len(keys) * block_size
+                                         + r.max_new_tokens)
+                    target[r.rid] = jdx
+                    handoffs[jdx].expect(1)
 
         results: List[List[Any]] = [[] for _ in self.engines]
         surfaced: List[Any] = []

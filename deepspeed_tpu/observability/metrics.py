@@ -213,13 +213,23 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # Single-writer hot path (class docstring): update calls mutate
+        # these dicts bare — dict ops are GIL-atomic, the lock guards
+        # only first-touch creation (double-checked) and reset(), and
+        # every reader copies before iterating. The benign-race
+        # annotations record that contract for the dstlint conc pass.
+        # dstlint: benign-race=GIL-atomic update; lock guards creation only
         self._counters: Dict[str, float] = {}
+        # dstlint: benign-race=GIL-atomic update; lock guards creation only
         self._gauges: Dict[str, float] = {}
+        # dstlint: benign-race=double-checked create; 1-writer observe
         self._hists: Dict[str, Histogram] = {}
+        # dstlint: benign-race=locked registration; snapshot copies it
         self._collectors: Dict[str, Callable[[], dict]] = {}
         # per-host labeled gauge series (fleet merge output): name ->
         # {host: value}. Empty on ordinary per-process registries; the
         # Prometheus exporter renders these with a `host` label.
+        # dstlint: benign-race=GIL-atomic update; lock guards creation only
         self._labeled: Dict[str, Dict[str, float]] = {}
 
     # --- counters -------------------------------------------------------------

@@ -399,6 +399,11 @@ class MetricsHTTPServer:
         self._json_fn = json_fn
         self._host = host
         self._want_port = int(port)
+        # start/stop are callable from any thread (engine teardown vs
+        # signal handlers vs tests): the lifecycle lock makes both
+        # idempotent — double-stop and stop-racing-start are no-ops,
+        # never AttributeError on a half-nulled handle
+        self._lifecycle_lock = threading.Lock()
         self._httpd = None
         self._thread = None
         self.port: Optional[int] = None
@@ -427,6 +432,12 @@ class MetricsHTTPServer:
     def start(self) -> int:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+        with self._lifecycle_lock:
+            return self._start_locked(BaseHTTPRequestHandler,
+                                      ThreadingHTTPServer)
+
+    def _start_locked(self, BaseHTTPRequestHandler,
+                      ThreadingHTTPServer) -> int:
         if self._httpd is not None:
             return self.port
         server = self
@@ -471,8 +482,18 @@ class MetricsHTTPServer:
         return self.port
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        """Idempotent, join-safe shutdown: detach the handles under the
+        lock, then block OUTSIDE it — ``shutdown()`` waits for the
+        serve_forever loop (and ``join`` for the thread), and holding
+        the lifecycle lock across that wait would stall every
+        concurrent start()/stop() behind a scrape in flight."""
+        with self._lifecycle_lock:
+            httpd, thread = self._httpd, self._thread
             self._httpd = None
             self._thread = None
+            self.port = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)

@@ -113,8 +113,12 @@ class RequestTracer:
     # --- read side ------------------------------------------------------------
     @property
     def dropped(self) -> int:
-        """Events the ring evicted (emitted minus retained)."""
-        return self._emitted - len(self.events)
+        """Events the ring evicted (emitted minus retained). Read under
+        the lock: a concurrent ``_push`` bumps ``_emitted`` before the
+        ring grows, so the bare difference could go transiently
+        negative mid-scrape."""
+        with self._lock:
+            return self._emitted - len(self.events)
 
     def clear(self) -> None:
         with self._lock:

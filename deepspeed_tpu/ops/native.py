@@ -31,6 +31,10 @@ def _build(src_rel: str, out_name: str, extra_flags=()) -> str:
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", *extra_flags,
                src, "-o", out]
         logger.info(f"building native lib: {' '.join(cmd)}")
+        # blocking here is the POINT of the lock: concurrent callers of
+        # the same lib must wait for one compile, not race g++ on the
+        # same output file
+        # dstlint: benign-race=build serialization is the lock's purpose
         subprocess.run(cmd, check=True, capture_output=True)
     return out
 

@@ -475,6 +475,8 @@ class DeepSpeedEngine:
         if self._telemetry_on and self._config.train_telemetry_trace:
             self.train_tracer = make_train_tracer(
                 self._config.train_telemetry_trace_capacity)
+        # dstlint: benign-race=constructor-time write; the engine has
+        # not escaped to any other thread yet
         self._pending_train_stats = None
         # guards the pending-stats hand-off: a metrics-server scrape
         # thread flushes concurrently with the training thread's
@@ -1730,8 +1732,12 @@ class DeepSpeedEngine:
                 scale = (stats["loss_scale"]
                          if stats and "loss_scale" in stats
                          else self.scaler_state.scale)
-            self._pending_train_stats = (
-                self.global_steps, stats, finite, scale, loss)
+            # banked under the same lock the scrape-thread flush takes:
+            # the pair (publish previous, bank current) must never let a
+            # concurrent flush observe-and-clear a half-swapped tuple
+            with self._train_stats_lock:
+                self._pending_train_stats = (
+                    self.global_steps, stats, finite, scale, loss)
         if (self.monitor is not None
                 and self.global_steps % self._config.steps_per_print == 0):
             # print boundary: the registry is about to be drained into

@@ -105,9 +105,14 @@ class ContiguousMemoryAllocator:
             self.largest_contiguous = self.size
 
     def print_allocation(self, resolution: int = 200) -> str:
-        """Occupancy map string (reference ``print_allocation``)."""
+        """Occupancy map string (reference ``print_allocation``).
+        Locked: iterating ``_live`` against a concurrent
+        defrag/allocate would raise (dict mutated mid-iteration) or
+        render torn offsets."""
         cells = ["."] * resolution
-        for off, numel in self._live.values():
+        with self._lock:
+            live = list(self._live.values())
+        for off, numel in live:
             lo = off * resolution // self.size
             hi = max(lo + 1, (off + numel) * resolution // self.size)
             for i in range(lo, min(hi, resolution)):

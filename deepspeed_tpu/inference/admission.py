@@ -1,0 +1,253 @@
+"""SLO-driven admission control for the serving path (docs/SERVING.md
+"Admission control & self-healing").
+
+The :class:`AdmissionController` is the policy half of the self-healing
+control plane: it watches the signals the observability stack already
+produces — ``serve.slo.<signal>.burn_rate.<window>s`` gauges from the
+:class:`~deepspeed_tpu.observability.slo.SLOTracker`, the scheduler's
+queue depth, and KV-pool occupancy — and decides, per admission wave,
+whether queued work should be SHED. Shedding is always structured: the
+scheduler resolves victims as ``REJECTED`` terminal completions (one
+per request, through the same ``_obs_terminal`` path as every other
+outcome), never as exceptions, and never touches in-flight slots.
+
+Design points:
+
+- **Hysteresis, not flapping.** Shedding ENTERS when any configured
+  signal crosses its ``*_high`` threshold and EXITS only once every
+  signal is back under its ``*_low`` threshold. The band between the
+  two is sticky — a burn rate oscillating around a single threshold
+  cannot toggle the controller every step.
+- **Priority classes.** Victims are chosen worst-first: lowest
+  ``Request.priority``, then longest prompt (the admission that would
+  hold the most KV blocks for the least progress). High-priority short
+  prompts are kept.
+- **Shed-to-target, not shed-all.** One shed pass trims the queue to
+  the low-water target (``queue_depth_low``, or ``keep_fraction`` of
+  the queue when no depth band is configured); later passes only trim
+  new overflow. The controller degrades service, it does not refuse it.
+
+The controller itself is engine-agnostic: the scheduler calls
+``shed()`` at the top of its admit phase, ``ReplicaGroup`` consults
+the same object when re-routing around unhealthy replicas, and the
+``serve.admission`` metrics section makes every decision auditable.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Thresholds for the admission controller. Every band is a
+    (high, low) hysteresis pair: shedding starts at ``high``, stops
+    only when the signal is back under ``low``. A ``high`` of 0 (or
+    0.0) disables that signal entirely."""
+
+    # worst burn rate across all serve.slo.*.burn_rate.* gauges; 1.0
+    # means "erring at exactly the budgeted rate" (slo.py)
+    burn_rate_high: float = 0.0
+    burn_rate_low: float = 0.5
+    # scheduler queue length (requests waiting for a slot)
+    queue_depth_high: int = 0
+    queue_depth_low: int = 0
+    # free KV-block fraction: shedding starts when the pool's free
+    # fraction drops TO or BELOW pool_free_low, stops once it recovers
+    # above pool_free_high (note the inverted sense: low free = bad)
+    pool_free_low: float = 0.0
+    pool_free_high: float = 0.25
+    # while shedding with no queue-depth band configured, keep the
+    # best-ranked ceil(len * keep_fraction) queued requests per pass
+    keep_fraction: float = 0.5
+
+    def __post_init__(self):
+        if not 0.0 < self.keep_fraction <= 1.0:
+            raise ValueError(
+                f"keep_fraction must be in (0, 1], got {self.keep_fraction}")
+        if self.burn_rate_high and self.burn_rate_low > self.burn_rate_high:
+            raise ValueError("burn_rate_low must be <= burn_rate_high")
+        if self.queue_depth_high and \
+                self.queue_depth_low > self.queue_depth_high:
+            raise ValueError("queue_depth_low must be <= queue_depth_high")
+        if self.pool_free_low and self.pool_free_high < self.pool_free_low:
+            raise ValueError("pool_free_high must be >= pool_free_low")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AdmissionConfig":
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown admission config keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        return cls(**d)
+
+    @property
+    def enabled_signals(self) -> Tuple[str, ...]:
+        out = []
+        if self.burn_rate_high:
+            out.append("burn_rate")
+        if self.queue_depth_high:
+            out.append("queue_depth")
+        if self.pool_free_low:
+            out.append("pool_free")
+        return tuple(out)
+
+
+class AdmissionController:
+    """Hysteresis-banded load shedder consulted at every admit wave.
+
+    Thread-safety: the shedding flag and episode counters are read by
+    the scheduler thread, ``ReplicaGroup`` router threads, and metric
+    scrapes concurrently — all mutable state is guarded by ``_lock``.
+    """
+
+    def __init__(self, config: Optional[AdmissionConfig] = None, *,
+                 metrics=None, slo=None, tracer=None,
+                 clock=time.monotonic):
+        self.config = config or AdmissionConfig()
+        self.metrics = metrics
+        self.slo = slo
+        self.tracer = tracer
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._shedding = False
+        self._reason = ""
+        self._episodes = 0
+        self._shed_total = 0
+        self._admitted_total = 0
+
+    # --- signal evaluation ----------------------------------------------
+
+    def _worst_burn(self) -> float:
+        """Worst live burn rate across every signal/window gauge the
+        SLOTracker publishes; 0.0 when no SLO is configured."""
+        if self.metrics is None:
+            return 0.0
+        worst = 0.0
+        for name, val in self.metrics.gauges().items():
+            if name.startswith("serve.slo.") and ".burn_rate." in name:
+                worst = max(worst, float(val))
+        return worst
+
+    def update(self, *, queue_depth: int = 0,
+               pool_free_frac: float = 1.0, storm: bool = False,
+               now: Optional[float] = None) -> bool:
+        """Re-evaluate the hysteresis state machine; returns the new
+        shedding flag. Also the admission-decision SLO tick: burn-rate
+        windows decay here even when the engine is otherwise idle."""
+        if self.slo is not None:
+            self.slo.tick(now)
+        cfg = self.config
+        burn = self._worst_burn()
+        over, under = [], True
+        if cfg.burn_rate_high:
+            if burn >= cfg.burn_rate_high:
+                over.append(f"burn_rate={burn:.2f}")
+            if burn >= cfg.burn_rate_low:
+                under = False
+        if cfg.queue_depth_high:
+            if queue_depth >= cfg.queue_depth_high:
+                over.append(f"queue_depth={queue_depth}")
+            if queue_depth > cfg.queue_depth_low:
+                under = False
+        if cfg.pool_free_low:
+            if pool_free_frac <= cfg.pool_free_low:
+                over.append(f"pool_free={pool_free_frac:.2f}")
+            if pool_free_frac < cfg.pool_free_high:
+                under = False
+        if storm:
+            over.append("admission_storm")
+            under = False
+        with self._lock:
+            was = self._shedding
+            if not was and over:
+                self._shedding, self._reason = True, ",".join(over)
+                self._episodes += 1
+                if self.metrics is not None:
+                    self.metrics.inc("serve.admission.shed_episodes")
+                if self.tracer is not None:
+                    self.tracer.instant("ADMISSION/shed_start",
+                                        cat="admission",
+                                        reason=self._reason)
+            elif was and under:
+                self._shedding, self._reason = False, ""
+                if self.tracer is not None:
+                    self.tracer.instant("ADMISSION/shed_stop",
+                                        cat="admission")
+            shedding = self._shedding
+        if self.metrics is not None:
+            self.metrics.set_gauge("serve.admission.shedding",
+                                   1.0 if shedding else 0.0)
+        return shedding
+
+    # --- victim selection -----------------------------------------------
+
+    def shed(self, requests: Sequence, *, queue_depth: int,
+             pool_free_frac: float = 1.0, storm: bool = False,
+             now: Optional[float] = None) -> List[Tuple[Any, str]]:
+        """One admission wave: re-evaluate the bands, then — while
+        shedding — pick the queued victims to resolve ``REJECTED``.
+        Returns ``[(request, reason), ...]``; empty while admitting.
+
+        Victims are the worst-ranked overflow past the low-water
+        target: rank keeps high ``priority`` first, short prompts
+        first, so the shed set is longest-prompt / lowest-priority.
+        """
+        shedding = self.update(queue_depth=queue_depth,
+                               pool_free_frac=pool_free_frac,
+                               storm=storm, now=now)
+        if not shedding or not requests:
+            with self._lock:
+                self._admitted_total += len(requests)
+            return []
+        cfg = self.config
+        if cfg.queue_depth_high:
+            target = int(cfg.queue_depth_low)
+        else:
+            target = int(math.ceil(len(requests) * cfg.keep_fraction))
+        n_shed = max(0, len(requests) - target)
+        if n_shed == 0:
+            with self._lock:
+                self._admitted_total += len(requests)
+            return []
+        def _plen(r: Any) -> int:
+            p = getattr(r, "prompt", None)
+            return 0 if p is None else len(p)
+
+        ranked = sorted(
+            requests,
+            key=lambda r: (-int(getattr(r, "priority", 0)), _plen(r)))
+        victims = ranked[len(requests) - n_shed:]
+        with self._lock:
+            reason = (f"admission shed ({self._reason})"
+                      if self._reason else "admission shed")
+            self._shed_total += n_shed
+            self._admitted_total += len(requests) - n_shed
+        if self.metrics is not None:
+            self.metrics.inc("serve.admission.shed", n_shed)
+        return [(r, reason) for r in victims]
+
+    # --- introspection ---------------------------------------------------
+
+    @property
+    def shedding(self) -> bool:
+        with self._lock:
+            return self._shedding
+
+    def section(self) -> Dict[str, Any]:
+        """``serve.admission`` metrics section (register_collector)."""
+        with self._lock:
+            return {
+                "shedding": self._shedding,
+                "reason": self._reason,
+                "episodes": self._episodes,
+                "shed": self._shed_total,
+                "admitted": self._admitted_total,
+                "signals": list(self.config.enabled_signals),
+            }

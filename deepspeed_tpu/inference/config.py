@@ -229,6 +229,21 @@ class ServeConfig(DeepSpeedConfigModel):
     # O(pool blocks) of host set arithmetic — at the default cadence it
     # is noise next to one decode program dispatch
     audit_every: int = 64
+    # retried restores (docs/SERVING.md "Retry with backoff"): a failed
+    # tiered-KV restore is re-dispatched up to this many times with
+    # bounded exponential backoff + deterministic jitter before the
+    # degrade-to-cold-prefill path fires. 0 (default) = degrade
+    # immediately (the pre-retry behaviour).
+    restore_retries: int = 0
+    # base backoff for retried restores, seconds: attempt k waits
+    # retry_backoff_s * 2**k * (1 + jitter) with jitter in [0, 0.5)
+    # derived deterministically from (rid, attempt)
+    retry_backoff_s: float = 0.05
+    # opt-in bounded readmission: a request whose slot dies mid-decode
+    # (executor fault) is restarted from its prompt up to this many
+    # times instead of resolving FAILED — greedy streams are
+    # byte-identical on retry success. 0 (default) = fail immediately.
+    readmit_failed: int = 0
     # --- observability (dstrace: deepspeed_tpu/observability,
     # docs/OBSERVABILITY.md) ----------------------------------------------
     # per-request lifecycle tracing: QUEUED/PREFILL/DECODE-chunk/
@@ -272,6 +287,16 @@ class ServeConfig(DeepSpeedConfigModel):
     # serve.slo snapshot section. Unknown keys fail fast. None = only
     # the always-on goodput gauge (delivered/sampled tokens).
     slo: Optional[Dict[str, Any]] = None
+    # SLO-driven admission control (inference/admission.py, docs/
+    # SERVING.md "Admission control & self-healing"): a dict with any
+    # of burn_rate_high / burn_rate_low (hysteresis band over the worst
+    # serve.slo.*.burn_rate gauge), queue_depth_high / queue_depth_low
+    # (scheduler queue length), pool_free_low / pool_free_high (free
+    # KV-block fraction), keep_fraction. While shedding, queued work is
+    # resolved as structured REJECTED completions — longest-prompt /
+    # lowest-priority first, never exceptions, never in-flight slots.
+    # Unknown keys fail fast. None = no admission control.
+    admission: Optional[Dict[str, Any]] = None
     # fleet snapshot-exchange directory (shared filesystem): when set,
     # serve_metrics(fleet=True) (and every Prometheus scrape with
     # fleet_publish on) atomically writes this replica's registry as

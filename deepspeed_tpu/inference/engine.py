@@ -1180,6 +1180,7 @@ class InferenceEngine:
         # dstfleet SLO tracker (serve.slo) — minted lazily, persists
         # across serve() calls so rolling burn-rate windows are real
         self._slo_tracker = None
+        self._admission_controller = None
         # measured-collective sink: eager comm verbs (barriers, eager
         # reductions) record comm.<verb>.latency_s / .bytes here
         from deepspeed_tpu import comm as _dist
@@ -1654,6 +1655,10 @@ class InferenceEngine:
                         lease_timeout_s: Optional[float] = None,
                         audit_every: Optional[int] = None,
                         fault_injector=None,
+                        admission=None,
+                        restore_retries: Optional[int] = None,
+                        retry_backoff_s: Optional[float] = None,
+                        readmit_failed: Optional[int] = None,
                         trace: Optional[bool] = None,
                         trace_path: Optional[str] = None):
         """Serve ``requests`` with continuous batching over a paged KV
@@ -1810,6 +1815,12 @@ class InferenceEngine:
         # rolling windows span serve() calls; the scheduler ticks it at
         # chunk boundaries, the serve.slo collector refreshes at scrape
         slo = self._get_slo_tracker(tracer)
+        # SLO-driven admission control (serve.admission config or the
+        # ``admission`` kwarg — a config dict or a caller-shared
+        # controller): consulted by the scheduler at every admit wave,
+        # shedding queued work as structured REJECTED completions
+        admission_ctrl = self._get_admission_controller(
+            tracer, override=admission)
 
         def rejected_completion(rid, prompt, reason):
             t = time.time()
@@ -1975,7 +1986,17 @@ class InferenceEngine:
                          else int(audit_every)),
             fault_injector=fault_injector,
             host_tier=host_tier, metrics=self.metrics, tracer=tracer,
-            slo=slo, handoff=handoff, publish_prefixes=bool(publish_kv))
+            slo=slo, handoff=handoff, publish_prefixes=bool(publish_kv),
+            admission=admission_ctrl,
+            restore_retries=(serve_cfg.restore_retries
+                             if restore_retries is None
+                             else int(restore_retries)),
+            retry_backoff_s=(serve_cfg.retry_backoff_s
+                             if retry_backoff_s is None
+                             else float(retry_backoff_s)),
+            readmit_failed=(serve_cfg.readmit_failed
+                            if readmit_failed is None
+                            else int(readmit_failed)))
         # the log list is mutated in place by the scheduler, so callers
         # can read it after draining the stream (bench.py --serve)
         self.last_serve_occupancy = scheduler.occupancy_log
@@ -2075,6 +2096,41 @@ class InferenceEngine:
         if tracer is not None:
             self._slo_tracker.tracer = tracer
         return self._slo_tracker
+
+    def _get_admission_controller(self, tracer=None, override=None):
+        """Engine-lifetime AdmissionController from the
+        ``serve.admission`` config (None when unconfigured) — its
+        hysteresis state must span serve() calls exactly like the SLO
+        windows it reads. ``override`` (generate_stream's ``admission``
+        kwarg) may be a ready-made controller, a config dict, or None.
+        Registered as the ``serve.admission`` snapshot collector."""
+        from deepspeed_tpu.inference.admission import (
+            AdmissionConfig, AdmissionController)
+
+        if override is not None and not isinstance(override, dict):
+            # a caller-owned controller (e.g. shared across a
+            # ReplicaGroup): use it, don't cache it
+            self.metrics.register_collector("serve.admission",
+                                            override.section)
+            return override
+        adm_cfg = (override if override is not None else
+                   getattr(getattr(self._config, "serve"), "admission",
+                           None))
+        if not adm_cfg:
+            return None
+        if self._admission_controller is None:
+            self._admission_controller = AdmissionController(
+                AdmissionConfig.from_dict(dict(adm_cfg)),
+                metrics=self.metrics, slo=self._slo_tracker,
+                tracer=tracer)
+            self.metrics.register_collector(
+                "serve.admission", self._admission_controller.section)
+        ctrl = self._admission_controller
+        if tracer is not None:
+            ctrl.tracer = tracer
+        if ctrl.slo is None:
+            ctrl.slo = self._slo_tracker
+        return ctrl
 
     def _fleet_rank(self) -> int:
         """This replica's rank in the fleet snapshot exchange

@@ -51,8 +51,9 @@ class RequestFault(RuntimeError):
         self.rid = rid
 
 
-#: injector hook sites (scheduler call boundaries)
-SITES = ("pool", "prefill", "decode", "cancel", "slow", "restore")
+#: injector hook sites (scheduler + replica-group call boundaries)
+SITES = ("pool", "prefill", "decode", "cancel", "slow", "restore",
+         "replica_kill", "replica_stall", "admission_storm")
 
 
 @dataclasses.dataclass
@@ -81,8 +82,20 @@ class FaultSpec:
                      prefill, never a FAILED terminal, with co-scheduled
                      streams untouched (match by ``rid``; ``step``
                      optional extra gate)
-    ``times`` bounds how often a prefill/decode spec fires (pool windows
-    are range-gated, not counted).
+      - ``replica_kill``   kill ``replica``'s drain thread mid-wave
+                     (ReplicaGroup boundary): its queued requests all
+                     resolve as structured FAILED terminals, siblings
+                     stay byte-identical, and the fleet controller is
+                     notified (→ DRAINING → respawn)
+      - ``replica_stall``  stall ``replica``'s drain thread ``seconds``
+                     before serving (a stuck replica: no progress while
+                     busy — the SUSPECT/DRAINING watermark path)
+      - ``admission_storm``  force the admission controller's storm
+                     signal for scheduler steps ``[step, step +
+                     duration)`` — a synthetic burn-rate spike driving
+                     the shed path regardless of real SLO state
+    ``times`` bounds how often a prefill/decode/replica spec fires
+    (pool and storm windows are range-gated, not counted).
     """
 
     site: str
@@ -90,6 +103,7 @@ class FaultSpec:
     rid: Any = None
     rids: Sequence[Any] = ()
     slot: Optional[int] = None
+    replica: Optional[int] = None
     duration: int = 1
     seconds: float = 0.0
     times: int = 1
@@ -120,6 +134,11 @@ class FaultInjector:
             for f in plan]
         self._remaining = [max(0, int(f.times)) for f in self.plan]
         self.log: List[dict] = []
+        # CHAOS/<site> tracer-mirroring watermark: log entries below
+        # this index were already emitted as tracer instants. Shared
+        # between the scheduler chunk loop and ReplicaGroup so a firing
+        # is mirrored exactly once whichever consumer sees it first.
+        self.traced = 0
 
     # --- plan generation ----------------------------------------------------
     @classmethod
@@ -258,6 +277,51 @@ class FaultInjector:
             self._record(step, "slow", seconds=f.seconds)
             total += float(f.seconds)
         return total
+
+    def kill_replica(self, replica: int) -> Optional[str]:
+        """Fault message when a ``replica_kill`` spec is armed for this
+        replica's next drain wave, else None. The ReplicaGroup drain
+        thread raises it as a RuntimeError — the same boundary a real
+        executor crash surfaces at — so every queued request on the
+        replica resolves FAILED and the fleet controller is told."""
+        for i, f in enumerate(self.plan):
+            if f.site != "replica_kill" or self._remaining[i] <= 0:
+                continue
+            if f.replica is not None and f.replica != replica:
+                continue
+            self._remaining[i] -= 1
+            self._record(0, "replica_kill", replica=replica)
+            return f.message
+        return None
+
+    def replica_stall(self, replica: int) -> float:
+        """Seconds to stall ``replica``'s drain thread before it serves
+        (the stuck-replica / no-progress scenario)."""
+        total = 0.0
+        for i, f in enumerate(self.plan):
+            if f.site != "replica_stall" or self._remaining[i] <= 0:
+                continue
+            if f.replica is not None and f.replica != replica:
+                continue
+            self._remaining[i] -= 1
+            self._record(0, "replica_stall", replica=replica,
+                         seconds=f.seconds)
+            total += float(f.seconds)
+        return total
+
+    def admission_storm(self, step: int) -> bool:
+        """True while an ``admission_storm`` window covers ``step`` —
+        the admission controller must treat the SLO as burning and
+        shed (range-gated like ``pool``, log-deduped per step)."""
+        for f in self.plan:
+            if f.site == "admission_storm" and f.step is not None \
+                    and f.step <= step < f.step + max(1, f.duration):
+                if not any(e["site"] == "admission_storm"
+                           and e["step"] == step for e in self.log):
+                    self._record(step, "admission_storm",
+                                 until=f.step + f.duration)
+                return True
+        return False
 
     def summary(self) -> dict:
         """Firing log rollup for the chaos bench artifact."""

@@ -1,0 +1,340 @@
+"""Replica health / drain / respawn for ``ReplicaGroup`` serving
+(docs/SERVING.md "Admission control & self-healing").
+
+The :class:`FleetController` is the actuator half of the self-healing
+control plane. It derives per-replica health from a *last-progress
+watermark* — each replica's cumulative work counters
+(``serve.tokens_sampled`` + terminal completions, read straight from
+the replica engine's own metrics registry, no hot-path hooks) — plus
+explicit failure reports from the ``ReplicaGroup`` drain threads, and
+walks each replica through a four-state machine:
+
+    HEALTHY ──(busy, no progress > suspect_after_s)──▶ SUSPECT
+    SUSPECT ──(still no progress > drain_after_s)────▶ DRAINING
+    any ─────(drain-thread failure report)───────────▶ DRAINING
+    DRAINING ─(idle, or drain_timeout_s + cancel)────▶ RESPAWNING
+    RESPAWNING ─(workspace dropped, optional warm)───▶ HEALTHY
+
+While a replica is SUSPECT it still serves (routing deprioritises it
+only on failure); DRAINING and RESPAWNING replicas are excluded from
+``healthy_indices()``, so the ``ReplicaGroup`` router sends new work
+to siblings (re-route-before-shed) and in-flight work finishes or
+times out where it is. Respawn drops the replica engine's cached
+serving executors (``release_serve_workspace()``) so the next
+admission rebuilds them — and optionally re-warms compile buckets via
+a user ``warm`` callable.
+
+The controller runs either as a daemon thread (``start()``/``stop()``,
+a dstlint concpass thread root) or fully deterministically via
+``poll()`` from tests. All mutable state is guarded by ``_lock``;
+reads of replica engine registries are lock-free snapshots of
+monotonic counters (benign).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+HEALTHY = "HEALTHY"
+SUSPECT = "SUSPECT"
+DRAINING = "DRAINING"
+RESPAWNING = "RESPAWNING"
+
+#: states eligible for new admissions (SUSPECT still serves: suspicion
+#: is a grace period, not a verdict)
+SERVING_STATES = (HEALTHY, SUSPECT)
+
+#: counters whose sum is a replica's progress watermark — any of them
+#: moving means the replica is doing work
+_PROGRESS_COUNTERS = (
+    "serve.tokens_sampled",
+    "serve.completions.COMPLETED",
+    "serve.completions.FAILED",
+    "serve.completions.REJECTED",
+    "serve.completions.CANCELLED",
+    "serve.completions.TIMED_OUT",
+    "serve.completions.PREEMPTED_LIMIT",
+)
+
+
+@dataclass(frozen=True)
+class FleetControllerConfig:
+    """Health-machine timing knobs, all in seconds of *no progress
+    while busy* (an idle replica is never suspect)."""
+
+    suspect_after_s: float = 2.0     # HEALTHY -> SUSPECT
+    drain_after_s: float = 5.0       # SUSPECT -> DRAINING
+    drain_timeout_s: float = 30.0    # DRAINING -> forced cancel
+    poll_interval_s: float = 0.2     # background thread cadence
+    respawn: bool = True             # False = drain only, stay DRAINING
+
+    def __post_init__(self):
+        if self.suspect_after_s <= 0 or self.drain_after_s <= 0:
+            raise ValueError("health thresholds must be positive")
+        if self.drain_after_s < self.suspect_after_s:
+            raise ValueError("drain_after_s must be >= suspect_after_s")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FleetControllerConfig":
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown fleet controller config keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        return cls(**d)
+
+
+class FleetController:
+    """Watches a :class:`~deepspeed_tpu.inference.replica.ReplicaGroup`
+    and drives the HEALTHY→SUSPECT→DRAINING→RESPAWNING machine."""
+
+    def __init__(self, group, config: Optional[FleetControllerConfig] = None,
+                 *, clock=time.monotonic, metrics=None, tracer=None,
+                 warm: Optional[Callable[[int], None]] = None):
+        self.group = group
+        self.config = config or FleetControllerConfig()
+        self.metrics = metrics
+        self.tracer = tracer
+        self.warm = warm
+        self._clock = clock
+        n = len(group.engines)
+        self._lock = threading.Lock()
+        self._states: List[str] = [HEALTHY] * n
+        self._watermark: List[float] = [clock()] * n
+        self._progress: List[float] = [self._progress_of(i)
+                                       for i in range(n)]
+        self._failures: List[int] = [0] * n
+        self._respawns: List[int] = [0] * n
+        self._drain_since: List[Optional[float]] = [None] * n
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # the group consults us for routing (re-route-before-shed)
+        group._controller = self
+
+    # --- progress sampling ------------------------------------------------
+
+    def _progress_of(self, i: int) -> float:
+        """Cumulative work counter for replica ``i`` — a lock-free read
+        of monotonic counters from the replica engine's registry."""
+        eng = self.group.engines[i]
+        reg = getattr(eng, "metrics", None)
+        if reg is None:
+            return 0.0
+        # dstlint: benign-race=read-only sum of monotonic counters from
+        # another engine's registry; staleness only delays a transition
+        counters = reg.snapshot()["counters"]
+        return float(sum(counters.get(c, 0) for c in _PROGRESS_COUNTERS))
+
+    def _busy(self, i: int) -> bool:
+        """Replica has in-flight or queued work (group bookkeeping)."""
+        live = getattr(self.group, "live_rids", None)
+        if callable(live):
+            return bool(live(i))
+        loads = getattr(self.group, "_loads", None)
+        return bool(loads and loads[i])
+
+    # --- event inputs -----------------------------------------------------
+
+    def note_progress(self, i: int, now: Optional[float] = None) -> None:
+        """Explicit progress report (a drain thread finished a wave)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._watermark[i] = now
+            if self._states[i] == SUSPECT:
+                self._transition(i, HEALTHY, "progress")
+
+    def note_failure(self, i: int, err: Optional[BaseException] = None,
+                     now: Optional[float] = None) -> None:
+        """A drain thread died on replica ``i``: straight to DRAINING
+        (its queued work was already resolved FAILED by the group)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._failures[i] += 1
+            if self._states[i] not in (DRAINING, RESPAWNING):
+                self._transition(i, DRAINING,
+                                 f"failure: {err}" if err else "failure")
+                self._drain_since[i] = now
+        if self.metrics is not None:
+            self.metrics.inc("fleet.controller.failures")
+
+    # --- the state machine ------------------------------------------------
+
+    def _transition(self, i: int, state: str, why: str) -> None:
+        """Must hold ``_lock``."""
+        prev = self._states[i]
+        if prev == state:
+            return
+        self._states[i] = state
+        if self.tracer is not None:
+            self.tracer.instant(f"FLEET/{state}", cat="fleet",
+                                replica=i, prev=prev, why=why)
+
+    def poll(self, now: Optional[float] = None) -> List[str]:
+        """One deterministic health-machine iteration; returns the
+        post-iteration state vector (a copy)."""
+        now = self._clock() if now is None else now
+        cfg = self.config
+        n = len(self._states)  # dstlint: benign-race=replica count is fixed at construction
+        # sample OUTSIDE the lock: _progress_of walks another registry's
+        # snapshot(), whose collectors may include our own section()
+        progs = [self._progress_of(i) for i in range(n)]
+        busy = [self._busy(i) for i in range(n)]
+        to_respawn = []
+        with self._lock:
+            for i in range(n):
+                prog = progs[i]
+                if prog > self._progress[i]:
+                    self._progress[i] = prog
+                    self._watermark[i] = now
+                    if self._states[i] == SUSPECT:
+                        self._transition(i, HEALTHY, "progress")
+                stale = now - self._watermark[i]
+                st = self._states[i]
+                if st == HEALTHY and busy[i] \
+                        and stale > cfg.suspect_after_s:
+                    self._transition(i, SUSPECT, f"stale {stale:.1f}s")
+                elif st == SUSPECT and stale > cfg.drain_after_s:
+                    self._transition(i, DRAINING, f"stale {stale:.1f}s")
+                    self._drain_since[i] = now
+                if self._states[i] == DRAINING:
+                    since = self._drain_since[i]
+                    timed_out = (since is not None
+                                 and now - since > cfg.drain_timeout_s)
+                    if timed_out:
+                        self._cancel_inflight(i)
+                    if not self._busy(i) or timed_out:
+                        if cfg.respawn:
+                            self._transition(i, RESPAWNING, "drained")
+                            to_respawn.append(i)
+            states = list(self._states)
+        for i in to_respawn:
+            self.respawn(i, now=now)
+        self._publish()
+        with self._lock:
+            return list(self._states)
+
+    def _cancel_inflight(self, i: int) -> None:
+        """Drain timed out: cooperatively cancel the replica's live
+        requests so its drain thread can resolve them (CANCELLED
+        terminals) instead of holding the slot forever."""
+        cancel = getattr(self.group, "cancel_replica", None)
+        if callable(cancel):
+            try:
+                cancel(i)
+            except Exception as e:
+                # the drain keeps waiting; next poll retries the cancel
+                logger.warning(
+                    "fleet controller: cancel_replica(%d) raised: %r",
+                    i, e)
+
+    def respawn(self, i: int, now: Optional[float] = None) -> None:
+        """Rebuild replica ``i``: drop its cached serving executors so
+        the next admission recompiles/rebuilds, optionally re-warm,
+        then return it to HEALTHY. Idempotent — respawning an already
+        HEALTHY replica is a no-op."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self._states[i] == HEALTHY:
+                return
+            self._transition(i, RESPAWNING, "respawn")
+        eng = self.group.engines[i]
+        release = getattr(eng, "release_serve_workspace", None)
+        if callable(release):
+            release()
+        if self.warm is not None:
+            try:
+                self.warm(i)
+            except Exception as e:       # warm-up is best-effort
+                logger.warning(
+                    "fleet controller: warm(%d) raised (replica still "
+                    "respawns cold): %r", i, e)
+        prog = self._progress_of(i)      # outside _lock (see poll())
+        with self._lock:
+            self._respawns[i] += 1
+            self._progress[i] = prog
+            self._watermark[i] = now
+            self._drain_since[i] = None
+            self._transition(i, HEALTHY, "respawned")
+        if self.metrics is not None:
+            self.metrics.inc("fleet.controller.respawns")
+
+    # --- routing view -----------------------------------------------------
+
+    def healthy_indices(self) -> List[int]:
+        """Replicas eligible for new admissions."""
+        with self._lock:
+            return [i for i, s in enumerate(self._states)
+                    if s in SERVING_STATES]
+
+    def states(self) -> List[str]:
+        with self._lock:
+            return list(self._states)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background poll thread. Idempotent."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            # a fresh Event per thread generation: a racing
+            # start() after stop() can never resurrect the old
+            # thread's loop by clearing a shared flag
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, args=(self._stop,),
+                name="fleet-controller", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the background thread and join it. Idempotent; safe
+        from any thread, including racing stop() calls."""
+        with self._lock:
+            t, ev = self._thread, self._stop
+            self._thread = None
+        ev.set()
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10)
+
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.wait(self.config.poll_interval_s):
+            try:
+                self.poll()
+            except Exception as e:
+                # the control plane must never take serving down;
+                # a poll error is logged and retried next tick
+                logger.warning(
+                    "fleet controller: poll raised: %r", e)
+
+    # --- observability ----------------------------------------------------
+
+    def _publish(self) -> None:
+        if self.metrics is None:
+            return
+        with self._lock:
+            states = list(self._states)
+        counts = {s: 0 for s in (HEALTHY, SUSPECT, DRAINING, RESPAWNING)}
+        for s in states:
+            counts[s] += 1
+        for s, n in counts.items():
+            self.metrics.set_gauge(f"fleet.controller.{s.lower()}",
+                                   float(n))
+
+    def section(self) -> Dict[str, Any]:
+        """``fleet.controller`` metrics section (register_collector)."""
+        with self._lock:
+            return {
+                "states": list(self._states),
+                "failures": list(self._failures),
+                "respawns": list(self._respawns),
+                "watermarks": [round(self._clock() - w, 3)
+                               for w in self._watermark],
+                "running": bool(self._thread is not None
+                                and self._thread.is_alive()),
+            }

@@ -97,6 +97,7 @@ def route_requests(requests: Sequence, n_replicas: int,
                    loads: Optional[List[int]] = None,
                    roles: Optional[Sequence[str]] = None,
                    prefill_threshold_tokens: int = 0,
+                   candidates: Optional[Sequence[int]] = None,
                    ) -> List[List[Any]]:
     """Assign ``requests`` to ``n_replicas`` buckets by prefix affinity
     then load (see module doc). Pure and deterministic — unit-testable
@@ -110,13 +111,23 @@ def route_requests(requests: Sequence, n_replicas: int,
     (affinity-then-load within the pool, so shared long prefixes reuse
     the prefill replica's own prefix cache); everything else — short
     prompts, follow-ups riding a full prefix hit — goes straight to
-    decode admission."""
+    decode admission.
+
+    ``candidates`` restricts routing to a subset of replica indices
+    (the fleet controller's healthy set — re-route-before-shed): a
+    pool whose restriction would be EMPTY keeps its full membership
+    (routing somewhere beats routing nowhere; the caller sheds when
+    truly nothing is healthy)."""
     from deepspeed_tpu.inference.kv_pool import block_content_keys
 
     if n_replicas <= 0:
         raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
     prefill_idx: List[int] = []
     decode_idx: List[int] = list(range(n_replicas))
+    if candidates is not None:
+        healthy = [i for i in decode_idx if i in set(candidates)]
+        if healthy:
+            decode_idx = healthy
     if roles is not None:
         if len(roles) != n_replicas:
             raise ValueError(
@@ -131,6 +142,16 @@ def route_requests(requests: Sequence, n_replicas: int,
         if not decode_idx:
             raise ValueError("roles need at least one decode replica — "
                              "every request finishes on one")
+        if candidates is not None:
+            cset = set(candidates)
+            # an all-unhealthy prefill pool routes its long prompts to
+            # decode replicas instead (cold prefill there — a latency
+            # degrade, never a loss); decode keeps full membership only
+            # when no decode replica is healthy (caller sheds instead)
+            prefill_idx = [i for i in prefill_idx if i in cset]
+            healthy_dec = [j for j in decode_idx if j in cset]
+            if healthy_dec:
+                decode_idx = healthy_dec
     affinity = affinity if affinity is not None else [
         set() for _ in range(n_replicas)]
     loads = loads if loads is not None else [0] * n_replicas
@@ -238,6 +259,12 @@ class ReplicaGroup:
         self._affinity: List[set] = [set() for _ in self.engines]
         self._loads: List[int] = [0] * len(self.engines)
         self.last_assignment: Optional[List[List[Any]]] = None
+        # self-healing (inference/fleet_controller.py): a
+        # FleetController attaches itself here; routing then restricts
+        # itself to its healthy_indices() (re-route-before-shed) and
+        # drain threads report progress/failures into it. None = every
+        # replica is always routable (the pre-controller behavior).
+        self._controller = None
 
     def publish(self) -> None:
         """Write every replica's registry snapshot into the fleet dir
@@ -258,6 +285,82 @@ class ReplicaGroup:
 
         self.publish()
         return merge_fleet_dir(self.fleet_dir)
+
+    # --- self-healing plumbing (inference/fleet_controller.py) -----------
+
+    def _healthy(self) -> List[int]:
+        """Replica indices routable right now: the attached controller's
+        view, or everyone when no controller is attached."""
+        ctrl = self._controller
+        if ctrl is None:
+            return list(range(len(self.engines)))
+        return ctrl.healthy_indices()
+
+    def live_rids(self, i: int) -> List[Any]:
+        """rids queued or in flight on replica ``i``'s current serve
+        session (the controller's busy/drain probe)."""
+        sched = getattr(self.engines[i], "last_serve_scheduler", None)
+        if sched is None or not sched.busy:
+            return []
+        # dstlint: benign-race=read-only snapshot of another thread's
+        # live-rid dict; staleness only delays a controller transition
+        return list(sched._submit_times.keys())
+
+    def cancel_replica(self, i: int) -> int:
+        """Cooperatively cancel every live request on replica ``i``
+        (the controller's drain-timeout escalation): each resolves
+        CANCELLED on its own stream at the next chunk boundary.
+        Returns how many cancels landed."""
+        eng = self.engines[i]
+        n = 0
+        for rid in self.live_rids(i):
+            if eng.cancel_request(rid):
+                n += 1
+        return n
+
+    def _shed_all(self, requests: Sequence, reason: str) -> List[Any]:
+        """Structured REJECTED completions for a wave that cannot route
+        anywhere (no healthy replica) — shedding is never an
+        exception, and every request still gets exactly one terminal."""
+        from deepspeed_tpu.inference.scheduler import REJECTED, Completion
+        import numpy as np
+
+        t = time.time()
+        out = []
+        for j, r in enumerate(requests):
+            rid = getattr(r, "rid", None)
+            if rid is None and isinstance(r, dict):
+                rid = r.get("rid", j)
+            try:
+                prompt = np.asarray(_prompt_of(r), np.int32).reshape(-1)
+            except (TypeError, ValueError):
+                prompt = np.zeros(0, np.int32)
+            out.append(Completion(
+                rid=rid, prompt=prompt, tokens=np.zeros(0, np.int32),
+                t_submit=t, t_admitted=t, t_first_token=t, t_finish=t,
+                status=REJECTED, error=reason))
+        m = getattr(self.engines[0], "metrics", None)
+        if m is not None:
+            m.inc("serve.admission.shed", len(out))
+            m.inc(f"serve.completions.{REJECTED}", len(out))
+        return out
+
+    @staticmethod
+    def _mirror_chaos(fi, tracer) -> None:
+        """Replay the injector log's untraced tail as CHAOS/<site>
+        instants (same timeline contract as the scheduler's
+        ``_trace_chaos``; the shared ``fi.traced`` watermark keeps the
+        two consumers from double-emitting)."""
+        if fi is None or tracer is None:
+            return
+        # dstlint: benign-race=watermark shared with the scheduler on
+        # the same drain thread; cross-replica sharing only risks a
+        # duplicated trace instant, never lost log entries
+        for entry in fi.log[getattr(fi, "traced", 0):]:
+            detail = {k: v for k, v in entry.items() if k != "site"}
+            tracer.instant(f"CHAOS/{entry['site']}", cat="chaos",
+                           **detail)
+        fi.traced = len(fi.log)
 
     @staticmethod
     def _failed_completions(reqs: Sequence, replica: int,
@@ -307,14 +410,23 @@ class ReplicaGroup:
             return self._serve_disaggregated(requests,
                                              per_replica_kwargs,
                                              serve_kwargs)
+        healthy = self._healthy()
+        if not healthy:
+            # re-route-before-shed has nowhere left to route: the whole
+            # wave sheds as structured REJECTED terminals (never an
+            # exception — the self-healing contract)
+            return self._shed_all(requests,
+                                  "admission shed: no healthy replica")
         block_size = int(serve_kwargs.get("block_size", 16))
         with self._route_lock:
             assignment = route_requests(requests, len(self.engines),
                                         block_size=block_size,
                                         affinity=self._affinity,
-                                        loads=self._loads)
+                                        loads=self._loads,
+                                        candidates=healthy)
             self.last_assignment = assignment
         results: List[List[Any]] = [[] for _ in self.engines]
+        ctrl = self._controller
 
         def drain(i: int) -> None:
             if not assignment[i]:
@@ -322,11 +434,28 @@ class ReplicaGroup:
             kw = dict(serve_kwargs)
             if per_replica_kwargs and i in per_replica_kwargs:
                 kw.update(per_replica_kwargs[i])
+            fi = kw.get("fault_injector")
             try:
+                if fi is not None:
+                    stall = fi.replica_stall(i)
+                    if stall > 0:
+                        # a stuck replica: busy, no progress — the
+                        # controller's watermark path sees exactly this
+                        time.sleep(stall)
+                    msg = fi.kill_replica(i)
+                    if msg is not None:
+                        raise RuntimeError(msg)
                 results[i] = self.engines[i].serve(assignment[i], **kw)
+                if ctrl is not None:
+                    ctrl.note_progress(i)
             except BaseException as e:       # noqa: BLE001 — resolved below
                 logger.error(f"replica {i} drain failed: {e!r}")
                 results[i] = self._failed_completions(assignment[i], i, e)
+                if ctrl is not None:
+                    ctrl.note_failure(i, e)
+            finally:
+                self._mirror_chaos(fi, getattr(self.engines[i],
+                                               "tracer", None))
 
         threads = [threading.Thread(target=drain, args=(i,),
                                     name=f"replica{i}", daemon=True)
@@ -377,6 +506,14 @@ class ReplicaGroup:
                        if r == "prefill"]
         decode_idx = [i for i, r in enumerate(self.roles)
                       if r == "decode"]
+        healthy = self._healthy()
+        live_decode = [j for j in decode_idx if j in healthy]
+        if not live_decode:
+            # every request finishes on a decode replica; none healthy
+            # means the wave sheds (structured REJECTED, never a raise)
+            return self._shed_all(
+                requests, "admission shed: no healthy decode replica")
+        live_prefill = [i for i in prefill_idx if i in healthy]
 
         # dict requests normalize HERE (the engine would do it anyway):
         # the prefill leg is a field-level clone, so it needs the
@@ -413,7 +550,8 @@ class ReplicaGroup:
             assignment = route_requests(
                 norm, n, block_size=block_size, affinity=self._affinity,
                 loads=self._loads, roles=self.roles,
-                prefill_threshold_tokens=self.prefill_threshold_tokens)
+                prefill_threshold_tokens=self.prefill_threshold_tokens,
+                candidates=healthy)
             # a malformed request (dict that failed to normalize) can't
             # run a prefill leg — it goes straight to a decode replica,
             # which resolves it REJECTED on its own stream slot
@@ -423,7 +561,7 @@ class ReplicaGroup:
                 if bad:
                     assignment[i] = [r for r in assignment[i]
                                      if isinstance(r, Request)]
-                    jdx = min(decode_idx,
+                    jdx = min(live_decode,
                               key=lambda j: self._loads[j])
                     assignment[jdx].extend(bad)
             self.last_assignment = assignment
@@ -431,7 +569,7 @@ class ReplicaGroup:
                 for r in assignment[i]:
                     keys = block_content_keys(
                         [int(t) for t in r.prompt], block_size)
-                    jdx = _best_replica(keys, decode_idx,
+                    jdx = _best_replica(keys, live_decode,
                                         self._affinity, self._loads)
                     self._affinity[jdx].update(keys)
                     self._loads[jdx] += (len(keys) * block_size
@@ -452,6 +590,8 @@ class ReplicaGroup:
             kw.pop("host_cache_gb", None)   # the tier object rules
             return kw
 
+        ctrl = self._controller
+
         def prefill_drain(i: int) -> None:
             bucket = assignment[i]
             if not bucket:
@@ -459,7 +599,15 @@ class ReplicaGroup:
             by_rid = {r.rid: r for r in bucket}
             pending = dict(by_rid)
             kw = overlay(i)
+            fi = kw.get("fault_injector")
             try:
+                if fi is not None:
+                    stall = fi.replica_stall(i)
+                    if stall > 0:
+                        time.sleep(stall)
+                    msg = fi.kill_replica(i)
+                    if msg is not None:
+                        raise RuntimeError(msg)
                 legs = [dataclasses.replace(r, max_new_tokens=1)
                         for r in bucket]
                 for comp in self.engines[i].generate_stream(
@@ -483,8 +631,12 @@ class ReplicaGroup:
                     t_pub[comp.rid] = time.time()
                     handoffs[jdx].put(dataclasses.replace(
                         orig, routed_prefill=True))
+                if ctrl is not None:
+                    ctrl.note_progress(i)
             except BaseException as e:   # noqa: BLE001 — degraded below
                 logger.error(f"prefill replica {i} died: {e!r}")
+                if ctrl is not None:
+                    ctrl.note_failure(i, e)
             finally:
                 # prefill-role death with queued handoffs: whatever
                 # never resolved hands over RAW — the decode replica
@@ -493,6 +645,8 @@ class ReplicaGroup:
                     t_pub.pop(rid, None)
                     handoffs[target[rid]].put(dataclasses.replace(
                         orig, routed_prefill=True))
+                self._mirror_chaos(fi, getattr(self.engines[i],
+                                               "tracer", None))
 
         def decode_drain(j: int) -> None:
             kw = overlay(j)
@@ -502,25 +656,40 @@ class ReplicaGroup:
                 # the engine resolve the malformed leftovers colocated
                 kw.pop("max_context")
                 kw.pop("host_tier")
+            fi = kw.get("fault_injector")
             try:
+                if fi is not None:
+                    stall = fi.replica_stall(j)
+                    if stall > 0:
+                        time.sleep(stall)
+                    msg = fi.kill_replica(j)
+                    if msg is not None:
+                        raise RuntimeError(msg)
                 results[j] = list(self.engines[j].generate_stream(
                     assignment[j],
                     handoff=(handoffs[j] if max_context is not None
                              else None),
                     **kw))
+                if ctrl is not None:
+                    ctrl.note_progress(j)
             except BaseException as e:   # noqa: BLE001 — resolved below
                 logger.error(f"decode replica {j} drain failed: {e!r}")
                 handoffs[j].close()
                 leftovers = handoffs[j].drain()
                 results[j] = self._failed_completions(
                     list(assignment[j]) + leftovers, j, e)
+                if ctrl is not None:
+                    ctrl.note_failure(j, e)
+            finally:
+                self._mirror_chaos(fi, getattr(self.engines[j],
+                                               "tracer", None))
 
         threads = [threading.Thread(target=prefill_drain, args=(i,),
                                     name=f"prefill{i}", daemon=True)
-                   for i in prefill_idx]
+                   for i in live_prefill]
         threads += [threading.Thread(target=decode_drain, args=(j,),
                                      name=f"decode{j}", daemon=True)
-                    for j in decode_idx]
+                    for j in live_decode]
         for t in threads:
             t.start()
         for t in threads:

@@ -186,6 +186,7 @@ Executor protocol (duck-typed)::
 import dataclasses
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Deque, Dict, Iterable, List, Optional, Set
 
@@ -242,6 +243,10 @@ class Request:
     # admission lookup to cover the whole prompt, and counts/traces a
     # DISAGG_DEGRADE when it has to cold-prefill instead
     routed_prefill: bool = False
+    # admission-control class (inference/admission.py): under overload
+    # the controller sheds lowest-priority / longest-prompt first, so
+    # higher values survive longer. 0 = default class.
+    priority: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -311,10 +316,10 @@ class _Restore:
     FAILED)."""
 
     __slots__ = ("req", "handle", "entries", "start", "dev_start",
-                 "t_admit", "t_mono")
+                 "t_admit", "t_mono", "attempt", "retry_at")
 
     def __init__(self, req, handle, entries, start, dev_start, t_admit,
-                 t_mono=0.0):
+                 t_mono=0.0, attempt=0, retry_at=0.0):
         self.req = req
         self.handle = handle
         self.entries = entries
@@ -322,6 +327,8 @@ class _Restore:
         self.dev_start = int(dev_start)
         self.t_admit = t_admit
         self.t_mono = t_mono
+        self.attempt = int(attempt)    # failed-restore retries so far
+        self.retry_at = float(retry_at)  # backoff: not ready before this
 
 
 class HandoffQueue:
@@ -404,7 +411,10 @@ class ContinuousBatchingScheduler:
                  speculative: bool = False, draft_len: int = 8,
                  draft_ngram: int = 2,
                  handoff: Optional[HandoffQueue] = None,
-                 publish_prefixes: bool = False):
+                 publish_prefixes: bool = False,
+                 admission=None, restore_retries: int = 0,
+                 retry_backoff_s: float = 0.05,
+                 readmit_failed: int = 0):
         self.executor = executor
         self.num_slots = int(num_slots)
         self.pool = pool
@@ -523,6 +533,20 @@ class ContinuousBatchingScheduler:
         self.host_hit_tokens = 0
         self.host_restore_failures = 0
         self.host_spill_failures = 0
+        # RETRY WITH BACKOFF (docs/SERVING.md "Admission control &
+        # self-healing"): a failed restore is re-dispatched up to
+        # ``restore_retries`` times with bounded exponential backoff +
+        # deterministic jitter (hash of (rid, attempt)) before the
+        # degrade-to-cold path fires; 0 = degrade immediately
+        self.restore_retries = int(restore_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.restore_retry_count = 0
+        # opt-in bounded READMISSION: a slot-attributed decode fault
+        # restarts the request from its prompt (like preemption) up to
+        # ``readmit_failed`` times before resolving FAILED
+        self.readmit_failed = int(readmit_failed)
+        self.readmissions = 0
+        self._readmit_counts: Dict[Any, int] = {}
         self.last_restore_error: Optional[str] = None
         self.last_spill_error: Optional[str] = None
         # DISAGGREGATED SERVING (docs/SERVING.md): ``handoff`` makes
@@ -598,6 +622,11 @@ class ContinuousBatchingScheduler:
         # boundaries (rolling-window burn rates + goodput); optional,
         # host-side, rate-limited internally
         self.slo = slo
+        # admission: an inference.admission.AdmissionController
+        # consulted at the top of every admit wave — under overload it
+        # picks queued victims that resolve as structured REJECTED
+        # completions (never exceptions, never in-flight slots)
+        self.admission = admission
         # monotonic submit stamps for QUEUED spans (wall-clock
         # _submit_times stays the Completion API timebase)
         self._submit_mono: Dict[Any, float] = {}
@@ -662,14 +691,20 @@ class ContinuousBatchingScheduler:
     def _trace_chaos(self) -> None:
         """Mirror NEW fault-injector firings into the trace (the
         injector's log is the source of truth; this just replays the
-        tail so auditor/chaos analysis lives in one timeline)."""
+        tail so auditor/chaos analysis lives in one timeline). The
+        watermark lives ON the injector (``fi.traced``) so a
+        ReplicaGroup sharing the injector can mirror replica-site
+        firings without double-emitting the scheduler's."""
         fi, tr = self.fault_injector, self.tracer
         if fi is None or tr is None:
             return
-        for entry in fi.log[self._fi_traced:]:
+        mark = max(getattr(fi, "traced", 0), self._fi_traced)
+        for entry in fi.log[mark:]:
             detail = {k: v for k, v in entry.items() if k != "site"}
             tr.instant(f"CHAOS/{entry['site']}", cat="chaos", **detail)
         self._fi_traced = len(fi.log)
+        if hasattr(fi, "traced"):
+            fi.traced = len(fi.log)
 
     # --- queue ---------------------------------------------------------------
     def submit(self, req: Request, now: Optional[float] = None) -> None:
@@ -853,6 +888,7 @@ class ContinuousBatchingScheduler:
         t_sub = self._submit_times.pop(req.rid, now)
         self._cancelled.discard(req.rid)
         self._preempt_counts.pop(req.rid, None)
+        self._readmit_counts.pop(req.rid, None)
         self._trace_queued_end(req.rid)
         return self._obs_terminal(Completion(
             rid=req.rid, prompt=req.prompt,
@@ -880,6 +916,7 @@ class ContinuousBatchingScheduler:
             t_finish=now, status=status, error=error))
         self._cancelled.discard(req.rid)
         self._preempt_counts.pop(req.rid, None)
+        self._readmit_counts.pop(req.rid, None)
         self.tables.release(slot_id)
         self._clear_slot(slot_id)
         return comp
@@ -947,8 +984,37 @@ class ContinuousBatchingScheduler:
             return 0
         return self.pool.num_free
 
+    def _shed_queue(self, now: float) -> List[Completion]:
+        """Consult the admission controller over the current queue: its
+        victims resolve as structured REJECTED terminals (one per
+        request, through the ordinary ``_terminal_queued`` path), the
+        rest stay for the admit loop. In-flight slots are never shed."""
+        ctrl = self.admission
+        if ctrl is None:
+            return []
+        fi = self.fault_injector
+        storm = (fi is not None
+                 and fi.admission_storm(self._step_idx))
+        pool_free = self.pool.num_free / max(1, self.pool.num_blocks)
+        if not self.queue:
+            # still re-evaluate: the hysteresis gauge recovers and the
+            # SLO windows tick even between admission waves
+            ctrl.update(queue_depth=0, pool_free_frac=pool_free,
+                        storm=storm)
+            return []
+        victims = ctrl.shed(list(self.queue),
+                            queue_depth=len(self.queue),
+                            pool_free_frac=pool_free, storm=storm)
+        if not victims:
+            return []
+        shed_rids = {id(r) for r, _ in victims}
+        self.queue = deque(r for r in self.queue
+                           if id(r) not in shed_rids)
+        return [self._terminal_queued(req, REJECTED, reason, now)
+                for req, reason in victims]
+
     def _admit(self, now: float) -> List[Completion]:
-        done = []
+        done = self._shed_queue(now)
         for slot_id, slot in enumerate(self.slots):
             if not self.queue or not slot.free:
                 continue
@@ -1232,7 +1298,10 @@ class ContinuousBatchingScheduler:
         fi = self.fault_injector
         tr = self.tracer
         for slot_id in sorted(self._restores):
-            st = self._restores.pop(slot_id)
+            st = self._restores[slot_id]
+            if st.retry_at > time.monotonic():
+                continue               # backoff: lands on a later step
+            self._restores.pop(slot_id)
             req = st.req
             self._flush_spills()       # frames must land before scatter
             ok = False
@@ -1297,6 +1366,37 @@ class ContinuousBatchingScheduler:
                         f"executor restore error: {e}", t_err,
                         t_admitted=st2.t_admit))
                 break
+            if not ok and st.attempt < self.restore_retries:
+                # RETRY WITH BACKOFF: re-dispatch the transfer instead
+                # of degrading — bounded exponential delay with
+                # deterministic jitter (crc32 of (rid, attempt), so a
+                # replayed chaos plan backs off identically), landing
+                # at the first step boundary past ``retry_at``
+                handle = None
+                try:
+                    handle = self.executor.begin_restore(slot_id,
+                                                         st.entries)
+                except Exception as e:
+                    self.last_restore_error = f"begin_restore retry: {e}"
+                if handle is not None:
+                    seed = zlib.crc32(
+                        repr((req.rid, st.attempt)).encode())
+                    jitter = (seed % 1000) / 2000.0       # [0, 0.5)
+                    delay = (self.retry_backoff_s * (2 ** st.attempt)
+                             * (1.0 + jitter))
+                    st.handle = handle
+                    st.attempt += 1
+                    st.retry_at = time.monotonic() + delay
+                    self._restores[slot_id] = st
+                    self.restore_retry_count += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("serve.restore_retries")
+                    if tr is not None:
+                        tr.instant("RESTORE_RETRY", cat="serve",
+                                   rid=req.rid, slot=slot_id,
+                                   attempt=st.attempt,
+                                   delay_s=round(delay, 4))
+                    continue
             if tr is not None:
                 tr.span("RESTORING", st.t_mono, tr.now(),
                         tid=1 + slot_id, rid=req.rid, slot=slot_id,
@@ -1378,6 +1478,7 @@ class ContinuousBatchingScheduler:
             t_finish=t_finish))
         self._cancelled.discard(req.rid)
         self._preempt_counts.pop(req.rid, None)
+        self._readmit_counts.pop(req.rid, None)
         # index full blocks (now including generated content — a future
         # prompt that embeds this completion, e.g. a multi-turn
         # continuation, prefills only its new tokens) BEFORE releasing:
@@ -2030,12 +2131,46 @@ class ContinuousBatchingScheduler:
         if slot is not None and 0 <= int(slot) < self.num_slots \
                 and self.slots[int(slot)].req is not None:
             targets = [int(slot)]
+            attributed = True
         else:
             targets = [s for s in range(self.num_slots) if runnable[s]]
-        return [self._terminal_slot(
-                    s, FAILED, f"executor decode error: {e}", now,
-                    register=False)
-                for s in targets]
+            attributed = False
+        done: List[Completion] = []
+        for s in targets:
+            req = self.slots[s].req
+            if attributed and self._readmit(s, req):
+                continue               # restarted instead of FAILED
+            done.append(self._terminal_slot(
+                s, FAILED, f"executor decode error: {e}", now,
+                register=False))
+        return done
+
+    def _readmit(self, slot_id: int, req: Request) -> bool:
+        """Opt-in bounded readmission (``readmit_failed`` > 0): restart
+        an ATTRIBUTED mid-decode failure from its prompt — the same
+        restart-from-prompt mechanics as preemption, so the greedy
+        stream is byte-identical on retry success. KV integrity is in
+        doubt (executor fault), so nothing registers into the prefix
+        cache. Returns True when the request was requeued."""
+        if self.readmit_failed <= 0:
+            return False
+        count = self._readmit_counts.get(req.rid, 0)
+        if count >= self.readmit_failed:
+            self._readmit_counts.pop(req.rid, None)
+            return False
+        self._readmit_counts[req.rid] = count + 1
+        self.readmissions += 1
+        if self.metrics is not None:
+            self.metrics.inc("serve.readmissions")
+        if self.tracer is not None:
+            self.tracer.instant("READMIT", tid=1 + slot_id, slot=slot_id,
+                                rid=req.rid, count=count + 1)
+        self.tables.release(slot_id)
+        self._clear_slot(slot_id)
+        if self.tracer is not None:
+            self._submit_mono[req.rid] = self.tracer.now()
+        self.queue.appendleft(req)     # keeps original submit time
+        return True
 
     # --- invariant auditor ----------------------------------------------------
     def audit(self, context: str = "") -> None:

@@ -83,7 +83,9 @@ def build_sample(snap: dict, prev: Optional[dict] = None,
                    if k.startswith("serve.completions.")}
     burn = {k[len("serve.slo."):]: v for k, v in g.items()
             if k.startswith("serve.slo.") and ".burn_rate." in k}
-    fleet = {k: v for k, v in g.items() if k.startswith("fleet.")}
+    fleet = {k: v for k, v in g.items()
+             if k.startswith("fleet.")
+             and not k.startswith("fleet.controller.")}
     hosts = snap.get("labeled_gauges", {})
     per_host_step = dict(hosts.get("train.step_time_s", {}))
     # DP replica membership (merged fleet view): host → replica id from
@@ -124,6 +126,20 @@ def build_sample(snap: dict, prev: Optional[dict] = None,
             "degrades": c.get("serve.disagg.degrades", 0),
             "queue_depth": g.get("serve.disagg.handoff_queue_depth", 0),
             "handoff_s": pct("serve.disagg.handoff_latency_s"),
+        },
+        "admission": {
+            "shedding": g.get("serve.admission.shedding", 0),
+            "shed": c.get("serve.admission.shed", 0),
+            "episodes": c.get("serve.admission.shed_episodes", 0),
+            "rejected": completions.get("REJECTED", 0),
+        },
+        "fleet_controller": {
+            "healthy": g.get("fleet.controller.healthy"),
+            "suspect": g.get("fleet.controller.suspect", 0),
+            "draining": g.get("fleet.controller.draining", 0),
+            "respawning": g.get("fleet.controller.respawning", 0),
+            "respawns": c.get("fleet.controller.respawns", 0),
+            "failures": c.get("fleet.controller.failures", 0),
         },
         "fleet": fleet,
         "hosts": per_host_step,
@@ -181,6 +197,21 @@ def render_text(sample: dict, width: int = 78) -> str:
             f"  queued={int(dg['queue_depth'])}"
             + (f"  handoff p99 {_fmt(lat_s.get('p99'))}s"
                if lat_s.get("count") else ""))
+    adm = sample.get("admission") or {}
+    fc = sample.get("fleet_controller") or {}
+    if adm.get("shedding") or adm.get("shed") or adm.get("episodes") \
+            or fc.get("healthy") is not None:
+        state = "SHEDDING" if adm.get("shedding") else "admitting"
+        line = (f"admit  {state}  shed={int(adm.get('shed', 0))}"
+                f"  episodes={int(adm.get('episodes', 0))}"
+                f"  rejected={int(adm.get('rejected', 0))}")
+        if fc.get("healthy") is not None:
+            line += (f"   health H{int(fc.get('healthy', 0))}"
+                     f"/S{int(fc.get('suspect', 0))}"
+                     f"/D{int(fc.get('draining', 0))}"
+                     f"/R{int(fc.get('respawning', 0))}"
+                     f"  respawns={int(fc.get('respawns', 0))}")
+        lines.append(line)
     if sample["burn_rates"]:
         lines.append("burn   " + "  ".join(
             f"{k}={_fmt(v, 2)}" for k, v in sorted(

@@ -1,0 +1,237 @@
+"""SLO-driven admission control (inference/admission.py): hysteresis
+bands over burn-rate / queue-depth / pool-occupancy signals, priority
+and longest-prompt victim ranking, shed-to-target semantics, and the
+scheduler integration where every victim resolves as a structured
+``REJECTED`` terminal (never an exception) while the pool stays clean.
+
+All hysteresis tests drive the controller with explicit signal values
+— no wall-clock, no sleeps. The scheduler tests run the FakeExecutor
+path so the shed victims flow through the real terminal funnel
+(``_terminal_queued`` → ``_obs_terminal``)."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.admission import (
+    AdmissionConfig, AdmissionController,
+)
+from deepspeed_tpu.inference.faults import FaultInjector, FaultSpec
+from deepspeed_tpu.inference.kv_pool import BlockPool
+from deepspeed_tpu.inference.scheduler import (
+    COMPLETED, REJECTED, ContinuousBatchingScheduler, Request,
+)
+from deepspeed_tpu.observability import MetricsRegistry, RequestTracer
+from tests.unit.inference.test_chaos import assert_quiescent
+from tests.unit.inference.test_scheduler import FakeExecutor, drain
+
+
+# --- config -------------------------------------------------------------------
+
+def test_admission_config_validation():
+    with pytest.raises(ValueError, match="keep_fraction"):
+        AdmissionConfig(keep_fraction=0.0)
+    with pytest.raises(ValueError, match="keep_fraction"):
+        AdmissionConfig(keep_fraction=1.5)
+    with pytest.raises(ValueError, match="burn_rate_low"):
+        AdmissionConfig(burn_rate_high=1.0, burn_rate_low=2.0)
+    with pytest.raises(ValueError, match="queue_depth_low"):
+        AdmissionConfig(queue_depth_high=4, queue_depth_low=8)
+    with pytest.raises(ValueError, match="pool_free_high"):
+        AdmissionConfig(pool_free_low=0.5, pool_free_high=0.1)
+    # unknown keys fail FAST (SLOConfig convention)
+    with pytest.raises(ValueError, match="unknown admission config keys"):
+        AdmissionConfig.from_dict({"queue_depth_hi": 4})
+    cfg = AdmissionConfig.from_dict(
+        {"queue_depth_high": 8, "queue_depth_low": 2})
+    assert cfg.enabled_signals == ("queue_depth",)
+
+
+# --- hysteresis ---------------------------------------------------------------
+
+def test_queue_depth_hysteresis_band_is_sticky():
+    ctrl = AdmissionController(
+        AdmissionConfig(queue_depth_high=8, queue_depth_low=2))
+    assert not ctrl.update(queue_depth=7)        # below high: admitting
+    assert ctrl.update(queue_depth=8)            # crosses high: shed
+    assert ctrl.update(queue_depth=5)            # inside the band: STICKY
+    assert ctrl.update(queue_depth=3)            # still above low
+    assert not ctrl.update(queue_depth=2)        # at/below low: recover
+    assert not ctrl.update(queue_depth=7)        # band re-armed, no flap
+    sec = ctrl.section()
+    assert sec["episodes"] == 1
+    assert not sec["shedding"]
+
+
+def test_burn_rate_signal_reads_slo_gauges():
+    m = MetricsRegistry()
+    tracer = RequestTracer()
+    ctrl = AdmissionController(
+        AdmissionConfig(burn_rate_high=2.0, burn_rate_low=0.5),
+        metrics=m, tracer=tracer)
+    m.set_gauge("serve.slo.ttft.burn_rate.60s", 1.0)
+    assert not ctrl.update()
+    # the WORST burn across every signal/window gauge drives the band
+    m.set_gauge("serve.slo.availability.burn_rate.600s", 3.0)
+    assert ctrl.update()
+    assert m.gauge("serve.admission.shedding") == 1.0
+    assert m.counter("serve.admission.shed_episodes") == 1
+    m.set_gauge("serve.slo.availability.burn_rate.600s", 0.6)
+    assert ctrl.update()                         # other gauge still 1.0
+    m.set_gauge("serve.slo.ttft.burn_rate.60s", 0.1)
+    m.set_gauge("serve.slo.availability.burn_rate.600s", 0.2)
+    assert not ctrl.update()
+    assert m.gauge("serve.admission.shedding") == 0.0
+    names = [e["name"] for e in tracer.events]
+    assert names.count("ADMISSION/shed_start") == 1
+    assert names.count("ADMISSION/shed_stop") == 1
+
+
+def test_pool_free_signal_is_inverted():
+    ctrl = AdmissionController(
+        AdmissionConfig(pool_free_low=0.1, pool_free_high=0.3))
+    assert not ctrl.update(pool_free_frac=0.5)
+    assert ctrl.update(pool_free_frac=0.05)      # nearly full pool: shed
+    assert ctrl.update(pool_free_frac=0.2)       # band: sticky
+    assert not ctrl.update(pool_free_frac=0.4)   # recovered past high
+
+
+def test_storm_forces_shedding_regardless_of_bands():
+    ctrl = AdmissionController(AdmissionConfig())   # no band enabled
+    assert ctrl.update(storm=True)
+    assert ctrl.update(storm=True)
+    assert not ctrl.update(storm=False)
+    assert ctrl.section()["episodes"] == 1
+
+
+# --- victim selection ---------------------------------------------------------
+
+def _reqs(lens, prios=None):
+    prios = prios or [0] * len(lens)
+    return [Request(rid=i, prompt=np.arange(1, L + 1),
+                    max_new_tokens=4, priority=p)
+            for i, (L, p) in enumerate(zip(lens, prios))]
+
+
+def test_shed_picks_longest_prompt_lowest_priority_first():
+    ctrl = AdmissionController(AdmissionConfig(keep_fraction=0.5))
+    reqs = _reqs([4, 16, 8, 12], prios=[0, 1, 0, 0])
+    victims = ctrl.shed(reqs, queue_depth=4, storm=True)
+    # keep ceil(4*0.5)=2: priority-1 rid 1 survives despite the longest
+    # prompt; of the rest, the two longest prompts (rids 3, 2) go
+    assert {r.rid for r, _ in victims} == {2, 3}
+    assert all("admission shed" in why for _, why in victims)
+    sec = ctrl.section()
+    assert sec["shed"] == 2 and sec["admitted"] == 2
+
+
+def test_shed_trims_to_low_water_target_not_all():
+    ctrl = AdmissionController(
+        AdmissionConfig(queue_depth_high=4, queue_depth_low=3))
+    reqs = _reqs([4, 8, 12, 16, 20])
+    victims = ctrl.shed(reqs, queue_depth=5)
+    assert {r.rid for r, _ in victims} == {3, 4}  # trim 5 -> 3, longest go
+    # while still shedding, a queue already at target sheds nothing
+    assert ctrl.shedding
+    assert ctrl.shed(reqs[:3], queue_depth=3) == []
+
+
+def test_shed_returns_empty_while_admitting():
+    ctrl = AdmissionController(
+        AdmissionConfig(queue_depth_high=8, queue_depth_low=2))
+    reqs = _reqs([4, 8])
+    assert ctrl.shed(reqs, queue_depth=2) == []
+    assert ctrl.section()["admitted"] == 2
+
+
+# --- scheduler integration ----------------------------------------------------
+
+def test_scheduler_sheds_as_structured_rejected_terminals():
+    """Queue-depth overload through the real admit path: victims
+    resolve REJECTED (one terminal per request, priority kept), the
+    survivors COMPLETE byte-normally, the pool ends fully free."""
+    m = MetricsRegistry()
+    tracer = RequestTracer()
+    ctrl = AdmissionController(
+        AdmissionConfig(queue_depth_high=4, queue_depth_low=1),
+        metrics=m, tracer=tracer)
+    sched = ContinuousBatchingScheduler(
+        FakeExecutor(), 2, BlockPool(33, 4), 8,
+        admission=ctrl, metrics=m, tracer=tracer, audit_every=1)
+    for i in range(8):
+        sched.submit(Request(rid=i, prompt=np.arange(1, 5) + i,
+                             max_new_tokens=4,
+                             priority=(1 if i == 7 else 0)))
+    comps = drain(sched)
+    statuses = Counter(c.status for c in comps)
+    assert sorted(c.rid for c in comps) == list(range(8))  # one terminal each
+    assert statuses[REJECTED] == 7 and statuses[COMPLETED] == 1
+    by_rid = {c.rid: c for c in comps}
+    assert by_rid[7].status == COMPLETED       # priority class survived
+    assert "admission shed" in by_rid[0].error
+    assert list(by_rid[7].tokens)              # real tokens, not a stub
+    assert m.counter("serve.admission.shed") == 7
+    assert m.counter("serve.completions.REJECTED") == 7
+    assert m.gauge("serve.admission.shedding") == 0.0   # recovered
+    assert_quiescent(sched)
+
+
+def test_scheduler_never_sheds_inflight_slots():
+    """Shedding starts while two requests already hold slots: they run
+    to COMPLETED untouched; only queued work is rejected."""
+    ctrl = AdmissionController(
+        AdmissionConfig(queue_depth_high=3, queue_depth_low=0))
+    sched = ContinuousBatchingScheduler(
+        FakeExecutor(), 2, BlockPool(33, 4), 8,
+        admission=ctrl, audit_every=1)
+    sched.submit(Request(rid=0, prompt=np.arange(1, 5), max_new_tokens=6))
+    sched.submit(Request(rid=1, prompt=np.arange(2, 6), max_new_tokens=6))
+    done = sched.step()                        # both admitted into slots
+    assert not done
+    for i in range(2, 6):
+        sched.submit(Request(rid=i, prompt=np.arange(1, 9) + i,
+                             max_new_tokens=4))
+    comps = {c.rid: c for c in drain(sched)}
+    assert comps[0].status == COMPLETED and comps[1].status == COMPLETED
+    assert all(comps[i].status == REJECTED for i in range(2, 6))
+    assert_quiescent(sched)
+
+
+def test_admission_storm_fault_site_sheds_and_traces():
+    """The seeded ``admission_storm`` chaos site forces shedding for
+    its step range and mirrors into the trace as a CHAOS instant."""
+    tracer = RequestTracer()
+    fi = FaultInjector([FaultSpec(site="admission_storm", step=0,
+                                  duration=2)])
+    ctrl = AdmissionController(AdmissionConfig(keep_fraction=0.5),
+                               tracer=tracer)
+    sched = ContinuousBatchingScheduler(
+        FakeExecutor(), 2, BlockPool(33, 4), 8,
+        admission=ctrl, fault_injector=fi, tracer=tracer, audit_every=1)
+    for i in range(6):
+        sched.submit(Request(rid=i, prompt=np.arange(1, 5) + i,
+                             max_new_tokens=4))
+    comps = drain(sched)
+    statuses = Counter(c.status for c in comps)
+    assert statuses[REJECTED] == 3 and statuses[COMPLETED] == 3
+    assert any(e["site"] == "admission_storm" for e in fi.log)
+    names = [e["name"] for e in tracer.events]
+    assert "CHAOS/admission_storm" in names
+    assert "ADMISSION/shed_start" in names
+    assert_quiescent(sched)
+
+
+def test_engine_config_builds_admission_controller():
+    """`serve.admission` config dict reaches the engine-lifetime
+    controller; unknown keys fail fast at construction."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    cfg = DeepSpeedInferenceConfig(
+        dtype="float32",
+        serve={"admission": {"queue_depth_high": 8,
+                             "queue_depth_low": 2}})
+    assert cfg.serve.admission == {"queue_depth_high": 8,
+                                   "queue_depth_low": 2}
+    with pytest.raises(ValueError, match="unknown admission config keys"):
+        AdmissionConfig.from_dict({"burn_high": 2.0})

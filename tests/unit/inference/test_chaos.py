@@ -760,3 +760,138 @@ def test_chaos_spec_mid_verify_preemption_and_cancel():
     assert st["accepted_tokens"] > 0   # drafts really flowed
     assert sched.preemptions >= 1      # eviction mid-verify exercised
     assert_quiescent(sched)
+
+
+# --- retried handoffs: restore retry with backoff + bounded readmission ------
+
+def _retry_tiered_sched(fi=None, **kw):
+    """Tiered-KV scheduler with the restore-retry knobs live (same
+    shape as the restore-fault scenario above)."""
+    from deepspeed_tpu.inference.kv_tiering import HostKVTier
+    from tests.unit.inference.test_kv_tiering import TieredFakeExecutor
+
+    tier = HostKVTier(1 << 20)
+    ex = TieredFakeExecutor(tier)
+    pool = PrefixCachingBlockPool(11, 4)
+    kw.setdefault("retry_backoff_s", 0.001)
+    sched = ContinuousBatchingScheduler(
+        ex, 2, pool, 8, prefix_cache=True, host_tier=tier,
+        audit_every=1, fault_injector=fi, tracer=RequestTracer(),
+        metrics=MetricsRegistry(), **kw)
+    return sched
+
+
+def _restore_pressure_run(sched):
+    """Warm a shared prefix, flood it to the tier, then readmit the
+    prefix so rid 2 rides the host-restore path."""
+    shared = np.arange(1, 9)                        # 2 full blocks
+    all_comps = []
+    sched.submit(Request(rid=1, prompt=np.concatenate([shared, [91]]),
+                         max_new_tokens=4))
+    all_comps += drain(sched)
+    for i in range(3):
+        sched.submit(Request(rid=10 + i,
+                             prompt=np.arange(100 + 20 * i,
+                                              120 + 20 * i),
+                             max_new_tokens=4))
+    all_comps += drain(sched)
+    sched.submit(Request(rid=2, prompt=np.concatenate([shared,
+                                                       [81, 82]]),
+                         max_new_tokens=6))
+    sched.submit(Request(rid=3, prompt=np.concatenate([shared, [71]]),
+                         max_new_tokens=6))
+    all_comps += drain(sched)
+    return by_rid(all_comps)
+
+
+def test_chaos_restore_retry_recovers_without_degrade():
+    """A transient restore failure with ``restore_retries=1``: the
+    transfer is re-dispatched after backoff and LANDS — no cold-prefill
+    degrade, the victim's stream byte-identical, tier + pool clean."""
+    ref = _restore_pressure_run(_retry_tiered_sched())
+    fi = FaultInjector([FaultSpec(site="restore", rid=2,
+                                  message="transient device_put")])
+    sched = _retry_tiered_sched(fi, restore_retries=1)
+    comps = _restore_pressure_run(sched)
+    assert [e["kind"] for e in fi.log
+            if e["site"] == "restore"] == ["fail"]  # fired exactly once
+    assert sched.restore_retry_count == 1
+    assert sched.host_restore_failures == 0         # retried, not degraded
+    assert sched.host_restores >= 1
+    assert sched.metrics.counter("serve.restore_retries") == 1
+    retries = [e for e in sched.tracer.events
+               if e["name"] == "RESTORE_RETRY"]
+    assert len(retries) == 1 and retries[0]["args"]["attempt"] == 1
+    assert retries[0]["args"]["delay_s"] > 0        # backoff was real
+    for rid in (1, 2, 3, 10, 11, 12):
+        assert comps[rid].status == COMPLETED
+        np.testing.assert_array_equal(comps[rid].tokens, ref[rid].tokens)
+    assert not sched.host_tier.audit()
+    assert sched.pool.num_allocated == 0
+    sched.audit(context="post-retry")
+
+
+def test_chaos_restore_retry_exhausted_degrades_to_cold_prefill():
+    """The fault outlives the retry budget (times=2 vs retries=1): the
+    LAST failure falls back to the established degrade-to-cold contract
+    — still COMPLETED, still byte-identical, failure counted."""
+    ref = _restore_pressure_run(_retry_tiered_sched())
+    fi = FaultInjector([FaultSpec(site="restore", rid=2, times=2,
+                                  message="persistent device_put")])
+    sched = _retry_tiered_sched(fi, restore_retries=1)
+    comps = _restore_pressure_run(sched)
+    assert sched.restore_retry_count == 1           # budget spent
+    assert sched.host_restore_failures >= 1         # then degraded
+    for rid in (1, 2, 3, 10, 11, 12):
+        assert comps[rid].status == COMPLETED
+        np.testing.assert_array_equal(comps[rid].tokens, ref[rid].tokens)
+    assert not sched.host_tier.audit()
+    assert sched.pool.num_allocated == 0
+    sched.audit(context="post-retry-exhausted")
+
+
+def test_chaos_readmission_recovers_attributed_decode_fault():
+    """Opt-in bounded readmission: the mid-decode RequestFault victim
+    re-queues instead of resolving FAILED, re-prefills into a free
+    slot, and completes with the exact fault-free stream (greedy
+    byte-identity on retry success)."""
+    def reqs():
+        return [req(1, gen=10), req(2, gen=10)]
+
+    ref = fault_free(reqs)
+    fi = FaultInjector([FaultSpec(site="decode", step=3, slot=1,
+                                  message="transient decode NaN")])
+    sched, _, _ = make_sched(fault_injector=fi, readmit_failed=1)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert sched.readmissions == 1
+    assert sched.metrics.counter("serve.readmissions") == 1
+    assert any(e["name"] == "READMIT" for e in sched.tracer.events)
+    for rid in (1, 2):
+        assert comps[rid].status == COMPLETED, comps[rid].error
+        np.testing.assert_array_equal(comps[rid].tokens, ref[rid])
+    assert_quiescent(sched)
+
+
+def test_chaos_readmission_budget_is_bounded():
+    """The same request faulting past its readmission budget resolves
+    FAILED exactly once — retry is bounded, never a livelock."""
+    def reqs():
+        return [req(1, gen=10), req(2, gen=10)]
+
+    ref = fault_free(reqs)
+    # an unstepped slot-1 spec fires at EVERY decode round: the first
+    # firing readmits, the second exhausts the budget
+    fi = FaultInjector([FaultSpec(site="decode", slot=1, times=2,
+                                  message="persistent decode NaN")])
+    sched, _, _ = make_sched(fault_injector=fi, readmit_failed=1)
+    for r in reqs():
+        sched.submit(r)
+    comps = by_rid(drain(sched))
+    assert sched.readmissions == 1
+    assert comps[2].status == FAILED
+    assert "persistent decode NaN" in comps[2].error
+    assert comps[1].status == COMPLETED
+    np.testing.assert_array_equal(comps[1].tokens, ref[1])
+    assert_quiescent(sched)

@@ -240,3 +240,56 @@ def test_replica_group_concurrent_serve_keeps_loads_exact():
     # the last published assignment is internally consistent: one wave's
     # worth of requests spread over the replicas
     assert sum(len(b) for b in rg.last_assignment) == 3
+
+
+# --- FleetController lifecycle under contention ------------------------------
+
+def test_fleet_controller_start_stop_respawn_hammer():
+    """Racing start/stop/poll/note_failure/respawn from many threads:
+    the controller must never leak a second poll thread (the fresh
+    Event-per-generation contract), never deadlock (its lock order vs
+    the engines' registries), and end in a consistent state."""
+    from deepspeed_tpu.inference.fleet_controller import (
+        HEALTHY, SERVING_STATES, DRAINING, RESPAWNING,
+        FleetController, FleetControllerConfig,
+    )
+
+    group = ReplicaGroup([_StubEngine(), _StubEngine()])
+    ctrl = FleetController(group, FleetControllerConfig(
+        poll_interval_s=0.001))
+
+    def worker(tid):
+        for i in range(OPS):
+            op = (tid + i) % 5
+            if op == 0:
+                ctrl.start()
+            elif op == 1:
+                ctrl.stop()
+            elif op == 2:
+                ctrl.poll()
+                ctrl.healthy_indices()
+            elif op == 3:
+                ctrl.note_failure(i % 2, RuntimeError("hammer"))
+                ctrl.note_progress(i % 2)
+            else:
+                ctrl.respawn(i % 2)
+                ctrl.section()
+
+    hammer(N_THREADS, worker)
+    ctrl.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        live = [t for t in threading.enumerate()
+                if t.name == "fleet-controller" and t.is_alive()]
+        if not live:
+            break
+        time.sleep(0.01)
+    assert not live, f"{len(live)} poll threads leaked"
+    assert not ctrl.section()["running"]
+    # every state is a machine state, and respawn converges to HEALTHY
+    valid = set(SERVING_STATES) | {DRAINING, RESPAWNING}
+    assert set(ctrl.states()) <= valid
+    ctrl.respawn(0)
+    ctrl.respawn(1)
+    assert ctrl.states() == [HEALTHY, HEALTHY]
+    assert ctrl.healthy_indices() == [0, 1]

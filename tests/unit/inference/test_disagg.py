@@ -307,3 +307,103 @@ def test_replica_drain_error_resolves_failed_not_raises(engines,
     assert len(by_status[FAILED]) == len(group.last_assignment[1])
     for c in by_status[FAILED]:
         assert "replica 1" in c.error and "hardware lost" in c.error
+
+
+# --- self-healing: seeded replica death / stall / drain (PR-20) --------------
+
+def test_chaos_decode_replica_kill_mid_handoff_self_heals(engines):
+    """The seeded ``replica_kill`` plan takes the only decode replica
+    down mid-wave: every request — routed shorts AND queued handoffs —
+    resolves to exactly one structured FAILED terminal, both pools end
+    free, the fleet controller walks the dead replica DRAINING →
+    respawn, and the NEXT wave is byte-identical to a healthy run."""
+    from deepspeed_tpu.inference.fleet_controller import (
+        DRAINING, HEALTHY, FleetController, FleetControllerConfig,
+    )
+
+    kw = dict(_KW, attn_kernel="reference")
+    for eng in engines:
+        eng.reset_prefix_cache()
+    ref = {c.rid: list(c.tokens)
+           for c in engines[1].serve(trace(), **kw)}
+    group = fresh_group(engines)
+    ctrl = FleetController(group, FleetControllerConfig(
+        suspect_after_s=0.1, drain_after_s=0.2, drain_timeout_s=5.0))
+    fi = FaultInjector([FaultSpec(site="replica_kill", replica=1,
+                                  message="injected decode loss")])
+    comps = group.serve(trace(), per_replica_kwargs={
+        1: {"fault_injector": fi}}, **kw)
+    assert [e["site"] for e in fi.log] == ["replica_kill"]
+    rids = [c.rid for c in comps]
+    assert sorted(rids) == list(range(6))     # one terminal per request
+    assert len(set(rids)) == 6
+    for c in comps:
+        assert c.status == FAILED
+        # directly-routed work names the injected kill; handoffs that
+        # queued behind the death resolve via the stranded-drain path
+        assert "replica 1" in c.error
+    assert any("decode loss" in c.error for c in comps)
+    _pools_free_and_audited(group)
+    # the drain thread reported the failure: DRAINING, out of routing
+    assert ctrl.states()[1] == DRAINING
+    assert ctrl.healthy_indices() == [0]
+    # idle now → one poll drains + respawns it back to HEALTHY
+    assert ctrl.poll()[1] == HEALTHY
+    # self-healed: the next wave restores byte-identical service
+    for eng in engines:
+        eng.reset_prefix_cache()
+    group2 = fresh_group(engines)
+    comps2 = group2.serve(trace(), **kw)
+    got = {c.rid: (c.status, list(c.tokens)) for c in comps2}
+    assert got == {rid: (COMPLETED, toks) for rid, toks in ref.items()}
+
+
+def test_chaos_replica_stall_is_latency_not_loss(engines):
+    """A seeded ``replica_stall`` on the prefill role: the wave is
+    slower but every stream still completes byte-identical — a stuck
+    replica never corrupts the handoff contract."""
+    kw = dict(_KW, attn_kernel="reference")
+    for eng in engines:
+        eng.reset_prefix_cache()
+    ref = {c.rid: list(c.tokens)
+           for c in engines[1].serve(trace(), **kw)}
+    group = fresh_group(engines)
+    fi = FaultInjector([FaultSpec(site="replica_stall", replica=0,
+                                  seconds=0.05)])
+    comps = group.serve(trace(), per_replica_kwargs={
+        0: {"fault_injector": fi}}, **kw)
+    assert [e["site"] for e in fi.log] == ["replica_stall"]
+    got = {c.rid: (c.status, list(c.tokens)) for c in comps}
+    assert got == {rid: (COMPLETED, toks) for rid, toks in ref.items()}
+    _pools_free_and_audited(group)
+
+
+def test_drain_reroutes_queued_work_to_siblings(engines):
+    """Drain-with-queued-work, colocated: replica 1 is DRAINING when a
+    wave arrives, so the router sends EVERYTHING to its sibling — all
+    requests complete byte-identically, nothing routes to the draining
+    replica. With no healthy replica left the wave sheds as structured
+    REJECTED terminals instead of raising."""
+    from deepspeed_tpu.inference.fleet_controller import FleetController
+    from deepspeed_tpu.inference.scheduler import REJECTED
+
+    kw = dict(_KW, attn_kernel="reference")
+    for eng in engines:
+        eng.reset_prefix_cache()
+    ref = {c.rid: list(c.tokens)
+           for c in engines[0].serve(trace(seed=7), **kw)}
+    group = ReplicaGroup(engines)              # colocated, no roles
+    ctrl = FleetController(group)
+    ctrl.note_failure(1, RuntimeError("operator drain"))
+    comps = group.serve(trace(seed=7), **kw)
+    assert group.last_assignment[1] == []      # nothing routed to it
+    got = {c.rid: (c.status, list(c.tokens)) for c in comps}
+    assert got == {rid: (COMPLETED, toks) for rid, toks in ref.items()}
+    # both replicas draining: shed, never raise — one terminal each
+    ctrl.note_failure(0, RuntimeError("operator drain"))
+    comps2 = group.serve(trace(seed=7), **kw)
+    assert sorted(c.rid for c in comps2) == list(range(6))
+    for c in comps2:
+        assert c.status == REJECTED
+        assert "no healthy replica" in c.error
+    assert group.engines[0].metrics.counter("serve.admission.shed") >= 6

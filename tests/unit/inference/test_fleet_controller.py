@@ -1,0 +1,202 @@
+"""Replica health / drain / respawn (inference/fleet_controller.py):
+the HEALTHY→SUSPECT→DRAINING→RESPAWNING machine driven deterministically
+through ``poll()`` with an injected clock — no wall-clock sleeps, no
+background thread except in the explicit lifecycle tests.
+
+The progress watermark is fed through a real ``MetricsRegistry`` on
+each stub engine (the controller reads the same monotonic counters the
+serving path publishes), and drain/cancel actuation goes through the
+same ``live_rids``/``cancel_replica`` surface ``ReplicaGroup`` exposes.
+"""
+
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.fleet_controller import (
+    DRAINING, HEALTHY, RESPAWNING, SUSPECT,
+    FleetController, FleetControllerConfig,
+)
+from deepspeed_tpu.observability import MetricsRegistry, RequestTracer
+
+
+class _Eng:
+    def __init__(self):
+        self.metrics = MetricsRegistry()
+        self.released = 0
+
+    def release_serve_workspace(self):
+        self.released += 1
+
+
+class _Group:
+    """The surface FleetController consumes from ReplicaGroup."""
+
+    def __init__(self, n=2):
+        self.engines = [_Eng() for _ in range(n)]
+        self.busy = [False] * n
+        self.cancelled = []
+
+    def live_rids(self, i):
+        return {99} if self.busy[i] else set()
+
+    def cancel_replica(self, i):
+        self.cancelled.append(i)
+        self.busy[i] = False
+        return 1
+
+
+def make_ctrl(n=2, **cfg):
+    cfg.setdefault("suspect_after_s", 1.0)
+    cfg.setdefault("drain_after_s", 2.0)
+    cfg.setdefault("drain_timeout_s", 5.0)
+    clock = {"t": 0.0}
+    group = _Group(n)
+    m = MetricsRegistry()
+    tracer = RequestTracer()
+    ctrl = FleetController(group, FleetControllerConfig(**cfg),
+                           clock=lambda: clock["t"], metrics=m,
+                           tracer=tracer)
+    return ctrl, group, clock, m, tracer
+
+
+# --- config -------------------------------------------------------------------
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="positive"):
+        FleetControllerConfig(suspect_after_s=0.0)
+    with pytest.raises(ValueError, match="drain_after_s"):
+        FleetControllerConfig(suspect_after_s=5.0, drain_after_s=1.0)
+    with pytest.raises(ValueError, match="unknown fleet controller"):
+        FleetControllerConfig.from_dict({"suspect_after": 2.0})
+    cfg = FleetControllerConfig.from_dict({"respawn": False})
+    assert cfg.respawn is False
+
+
+# --- the state machine --------------------------------------------------------
+
+def test_stale_busy_replica_walks_suspect_drain_respawn():
+    ctrl, group, clock, m, tracer = make_ctrl()
+    group.busy[0] = True
+    assert ctrl.poll() == [HEALTHY, HEALTHY]        # fresh watermark
+    clock["t"] = 1.5                                # stale > suspect_after
+    assert ctrl.poll()[0] == SUSPECT
+    assert ctrl.healthy_indices() == [0, 1]         # SUSPECT still serves
+    clock["t"] = 2.5                                # stale > drain_after
+    assert ctrl.poll()[0] == DRAINING
+    assert ctrl.healthy_indices() == [1]            # drained out of routing
+    clock["t"] = 3.0
+    assert ctrl.poll()[0] == DRAINING               # in-flight: keep waiting
+    group.busy[0] = False                           # drain finished
+    clock["t"] = 3.5
+    assert ctrl.poll()[0] == HEALTHY                # respawned same poll
+    assert group.engines[0].released == 1           # executors rebuilt
+    assert m.counter("fleet.controller.respawns") == 1
+    assert m.gauge("fleet.controller.healthy") == 2.0
+    states = [e["name"] for e in tracer.events if e["name"].startswith("FLEET/")]
+    assert states == ["FLEET/SUSPECT", "FLEET/DRAINING",
+                      "FLEET/RESPAWNING", "FLEET/HEALTHY"]
+
+
+def test_progress_resets_suspicion():
+    ctrl, group, clock, *_ = make_ctrl()
+    group.busy[0] = True
+    clock["t"] = 1.5
+    assert ctrl.poll()[0] == SUSPECT
+    # the replica's own counters move: watermark refreshes, back to
+    # HEALTHY without ever draining
+    group.engines[0].metrics.inc("serve.tokens_sampled", 8)
+    clock["t"] = 2.6
+    assert ctrl.poll()[0] == HEALTHY
+    clock["t"] = 3.0
+    assert ctrl.poll()[0] == HEALTHY                # watermark was reset
+
+
+def test_idle_replica_is_never_suspect():
+    ctrl, group, clock, *_ = make_ctrl()
+    clock["t"] = 100.0                              # ages, but no work
+    assert ctrl.poll() == [HEALTHY, HEALTHY]
+
+
+def test_note_failure_drains_immediately():
+    ctrl, group, clock, m, _ = make_ctrl()
+    group.busy[1] = True
+    ctrl.note_failure(1, RuntimeError("executor died"))
+    assert ctrl.states()[1] == DRAINING
+    assert ctrl.healthy_indices() == [0]
+    assert m.counter("fleet.controller.failures") == 1
+    group.busy[1] = False                           # group resolved FAILED
+    clock["t"] = 0.5
+    assert ctrl.poll()[1] == HEALTHY                # drained -> respawned
+    assert ctrl.section()["failures"] == [0, 1]
+    assert ctrl.section()["respawns"] == [0, 1]
+
+
+def test_drain_timeout_cancels_inflight():
+    ctrl, group, clock, *_ = make_ctrl()
+    group.busy[0] = True
+    ctrl.note_failure(0)                            # DRAINING at t=0
+    clock["t"] = 4.0
+    assert ctrl.poll()[0] == DRAINING               # within drain_timeout
+    assert group.cancelled == []
+    clock["t"] = 6.0                                # past drain_timeout_s=5
+    assert ctrl.poll()[0] == HEALTHY                # cancelled + respawned
+    assert group.cancelled == [0]
+
+
+def test_respawn_disabled_stays_draining():
+    ctrl, group, clock, *_ = make_ctrl(respawn=False)
+    ctrl.note_failure(0)
+    group.busy[0] = False
+    clock["t"] = 1.0
+    assert ctrl.poll()[0] == DRAINING               # drain-only mode
+    assert group.engines[0].released == 0
+    # a manual respawn still works (operator action)
+    ctrl.respawn(0)
+    assert ctrl.states()[0] == HEALTHY
+
+
+def test_respawn_is_idempotent_and_warm_is_best_effort():
+    warmed = []
+
+    def warm(i):
+        warmed.append(i)
+        raise RuntimeError("warm-up hiccup")        # must not propagate
+
+    clock = {"t": 0.0}
+    group = _Group(1)
+    ctrl = FleetController(group, clock=lambda: clock["t"], warm=warm)
+    ctrl.respawn(0)                                 # HEALTHY: no-op
+    assert group.engines[0].released == 0 and warmed == []
+    ctrl.note_failure(0)
+    ctrl.respawn(0)
+    assert ctrl.states()[0] == HEALTHY
+    assert group.engines[0].released == 1 and warmed == [0]
+    assert ctrl.section()["respawns"] == [1]
+
+
+# --- lifecycle ----------------------------------------------------------------
+
+def test_start_stop_idempotent_and_single_thread():
+    ctrl, group, clock, *_ = make_ctrl(poll_interval_s=0.005)
+    try:
+        ctrl.start()
+        ctrl.start()                                # second start: no-op
+        live = [t for t in threading.enumerate()
+                if t.name == "fleet-controller"]
+        assert len(live) == 1
+        assert ctrl.section()["running"]
+        # the thread actually polls (fresh watermarks keep it HEALTHY)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if ctrl.metrics.gauge("fleet.controller.healthy") == 2.0:
+                break
+            time.sleep(0.005)
+        assert ctrl.metrics.gauge("fleet.controller.healthy") == 2.0
+    finally:
+        ctrl.stop()
+    ctrl.stop()                                     # second stop: no-op
+    assert not ctrl.section()["running"]
+    assert not [t for t in threading.enumerate()
+                if t.name == "fleet-controller" and t.is_alive()]

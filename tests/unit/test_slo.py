@@ -233,3 +233,53 @@ def test_scheduler_ticks_slo_tracker_at_chunk_boundaries():
     drain(sched)
     assert len(tr._marks) >= 1                       # ticked during steps
     assert m.gauge("serve.slo.availability.burn_rate.10s") == 0.0
+
+
+# --- idle staleness: the admission-decision tick (PR-20) ----------------------
+
+def test_burn_rate_decays_while_idle_via_admission_tick():
+    """Regression pin for the idle-staleness gap: with no scheduler
+    steps running, burn-rate gauges used to freeze at their last value.
+    The admission controller's ``update()`` (consulted on every
+    admission decision, even an empty queue) ticks the tracker, so an
+    idle engine's burn rate decays as its bad marks age out of the
+    window — and the controller's own hysteresis sees the decayed
+    value, not the stale spike."""
+    from deepspeed_tpu.inference.admission import (
+        AdmissionConfig, AdmissionController,
+    )
+
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, ttft_p95_s=1.0)    # 10s window
+    for _ in range(10):
+        reg.observe("serve.ttft_s", 9.0)             # 100% bad
+    tr.tick()
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") == pytest.approx(20.0)
+
+    ctrl = AdmissionController(
+        AdmissionConfig(burn_rate_high=2.0, burn_rate_low=0.5),
+        metrics=reg, slo=tr)
+    assert ctrl.update(now=0.0)                      # burning: shed
+
+    # the engine goes IDLE — no steps, no scrapes. 20s later the
+    # admission-decision tick alone must decay the window to zero and
+    # recover the controller.
+    clock["t"] = 20.0
+    assert not ctrl.update(now=20.0)
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") == 0.0
+    assert reg.gauge("serve.admission.shedding") == 0.0
+
+
+def test_section_scrape_also_ticks_when_idle():
+    """The other half of the satellite: a pull-time scrape (dsttop /
+    Prometheus) refreshes the same windows without any serving work."""
+    reg = MetricsRegistry()
+    tr, clock = make_tracker(reg, ttft_p95_s=1.0)
+    for _ in range(10):
+        reg.observe("serve.ttft_s", 9.0)
+    tr.tick()
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") > 0
+    clock["t"] = 30.0
+    sec = tr.section()                               # scrape-time tick
+    assert sec["ttft.burn_rate.10s"] == 0.0
+    assert reg.gauge("serve.slo.ttft.burn_rate.10s") == 0.0
